@@ -1,0 +1,48 @@
+"""adlb_tpu — a TPU-native distributed task-queue framework.
+
+A ground-up rebuild of the capabilities of ADLB (Asynchronous Dynamic
+Load-Balancing library, reference: kc9jud/adlb — see /root/reference and
+SURVEY.md): a typed, prioritized, globally load-balanced work pool for
+master/worker applications, exposed through the classic
+``Put / Reserve / Get_reserved`` API with targeting, answer-routing,
+batch/common-prefix puts, blocking and non-blocking reserves, exhaustion
+and explicit-termination protocols, a watchdog debug server, and
+stats/observability.
+
+Architecture (TPU-first, not a port):
+
+* **Runtime / data plane** — message-passing ranks (threads in-process, TCP
+  across processes/hosts) with a single-threaded server reactor per server
+  rank; reproduces the semantics of the reference's MPI tag protocol
+  (reference ``src/adlb.c:44-83``) without MPI.
+* **Balancer brain** — the reference's 0.1 s qmstat gossip ring plus greedy
+  per-server matching / RFR work stealing (reference ``src/adlb.c:806-822,
+  1802-2070``) is *replaced* by a periodic batched global assignment solve in
+  JAX: servers snapshot queued-task metadata into fixed-shape tensors, a
+  jitted bipartite solve computes task->worker placement on TPU, and the plan
+  is enacted through the work-transfer protocol.
+* **Native core** (in progress) — the hot queue operations are additionally
+  being implemented as a C++ library with ctypes bindings
+  (``adlb_tpu/native/``), mirroring the reference's all-native data plane;
+  the pure-Python queues remain the always-available fallback.
+"""
+
+from adlb_tpu.types import (  # noqa: F401
+    ADLB_SUCCESS,
+    ADLB_ERROR,
+    ADLB_NO_MORE_WORK,
+    ADLB_DONE_BY_EXHAUSTION,
+    ADLB_NO_CURRENT_WORK,
+    ADLB_PUT_REJECTED,
+    ADLB_LOWEST_PRIO,
+    ADLB_RESERVE_REQUEST_ANY,
+    ADLB_HANDLE_SIZE,
+    InfoKey,
+    WorkHandle,
+)
+from adlb_tpu.api import (  # noqa: F401
+    AdlbContext,
+    run_world,
+)
+
+__version__ = "0.1.0"

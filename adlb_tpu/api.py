@@ -1,0 +1,189 @@
+"""Public API.
+
+Two surfaces:
+
+* :class:`AdlbContext` — the per-rank object handed to application code, with
+  methods mirroring the reference's public C API one-for-one
+  (``ADLB_Put/Reserve/Ireserve/Get_reserved/...``, reference
+  ``include/adlb/adlb.h:42-88``) in Pythonic form.
+* :func:`run_world` — spins up a world in-process (ranks as threads, the
+  analogue of ``mpiexec -n k`` for the reference's examples) and runs an app
+  function on every app rank. Multi-process/multi-host worlds use the TCP
+  transport entry points instead (``adlb_tpu.runtime.transport_tcp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+from adlb_tpu.runtime.client import Client
+from adlb_tpu.runtime.debug_server import DebugServer
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_SUCCESS, AdlbAborted, InfoKey, WorkHandle
+
+
+class AdlbContext:
+    """Per-app-rank handle: the reference's client API surface."""
+
+    def __init__(self, client: Client) -> None:
+        self._c = client
+
+    @property
+    def rank(self) -> int:
+        return self._c.rank
+
+    @property
+    def num_app_ranks(self) -> int:
+        return self._c.world.num_app_ranks
+
+    @property
+    def world(self) -> WorldSpec:
+        return self._c.world
+
+    # The reference API, in order of include/adlb/adlb.h:
+    def put(
+        self,
+        payload: bytes,
+        work_type: int,
+        work_prio: int = 0,
+        target_rank: int = -1,
+        answer_rank: int = -1,
+    ) -> int:
+        return self._c.put(payload, work_type, work_prio, target_rank, answer_rank)
+
+    def reserve(self, req_types: Optional[Sequence[int]] = None):
+        return self._c.reserve(req_types)
+
+    def ireserve(self, req_types: Optional[Sequence[int]] = None):
+        return self._c.ireserve(req_types)
+
+    def get_reserved(self, handle: WorkHandle):
+        return self._c.get_reserved(handle)
+
+    def get_reserved_timed(self, handle: WorkHandle):
+        return self._c.get_reserved_timed(handle)
+
+    def begin_batch_put(self, common_buf: bytes) -> int:
+        return self._c.begin_batch_put(common_buf)
+
+    def end_batch_put(self) -> int:
+        return self._c.end_batch_put()
+
+    def set_problem_done(self) -> int:
+        return self._c.set_problem_done()
+
+    def info_num_work_units(self, work_type: int):
+        return self._c.info_num_work_units(work_type)
+
+    def abort(self, code: int) -> None:
+        self._c.abort(code)
+
+
+@dataclasses.dataclass
+class WorldResult:
+    """What run_world returns: per-app-rank results and per-server stats."""
+
+    app_results: dict[int, Any]
+    server_stats: dict[int, dict[int, float]]
+    aborted: bool
+    exception: Optional[BaseException] = None
+
+    def info_get(self, key: InfoKey) -> float:
+        """Aggregate a stats key over servers the way the reference's
+        examples read Info_get per server rank (max over servers)."""
+        return max((s.get(int(key), 0.0) for s in self.server_stats.values()),
+                   default=0.0)
+
+
+def run_world(
+    num_app_ranks: int,
+    nservers: int,
+    types: Sequence[int],
+    app_fn: Callable[[AdlbContext], Any],
+    cfg: Optional[Config] = None,
+    use_debug_server: bool = False,
+    timeout: float = 120.0,
+) -> WorldResult:
+    """Run a complete world in one process, one thread per rank."""
+    cfg = cfg or Config()
+    world = WorldSpec(
+        nranks=num_app_ranks + nservers + (1 if use_debug_server else 0),
+        nservers=nservers,
+        types=tuple(types),
+        use_debug_server=use_debug_server,
+    )
+    fabric = InProcFabric(world.nranks)
+    app_results: dict[int, Any] = {}
+    server_stats: dict[int, dict[int, float]] = {}
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def app_main(rank: int) -> None:
+        client = Client(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        ctx = AdlbContext(client)
+        try:
+            result = app_fn(ctx)
+            with lock:
+                app_results[rank] = result
+        except AdlbAborted:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced via WorldResult
+            with lock:
+                errors.append(e)
+            fabric.abort_event.set()
+        finally:
+            client.finalize()
+
+    def server_main(rank: int) -> None:
+        server = Server(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        try:
+            server.run()
+            with lock:
+                server_stats[rank] = server.finalize_stats()
+        except BaseException as e:  # noqa: BLE001
+            with lock:
+                errors.append(e)
+            fabric.abort_event.set()
+
+    def debug_main(rank: int) -> None:
+        ds = DebugServer(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        ds.run()
+
+    threads: list[threading.Thread] = []
+    for rank in range(world.nranks):
+        if world.is_app(rank):
+            target = app_main
+        elif world.is_server(rank):
+            target = server_main
+        else:
+            target = debug_main
+        t = threading.Thread(target=target, args=(rank,), daemon=True,
+                             name=f"adlb-rank-{rank}")
+        threads.append(t)
+        t.start()
+
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    for t in threads:
+        t.join(timeout=max(deadline - _time.monotonic(), 0.0))
+        if t.is_alive():
+            fabric.abort_event.set()
+            for t2 in threads:
+                t2.join(timeout=5.0)
+            errors.append(TimeoutError(f"world did not finish within {timeout}s"))
+            break
+
+    result = WorldResult(
+        app_results=app_results,
+        server_stats=server_stats,
+        aborted=fabric.abort_event.is_set(),
+        exception=errors[0] if errors else None,
+    )
+    if errors:
+        raise errors[0]
+    return result

@@ -1,0 +1,163 @@
+"""The batched global assignment solve.
+
+Inputs are fixed-shape tensors (S servers x K tasks, S x R requesters, T
+types) so the jitted computation never recompiles; variable-size queue state
+is truncated on the host side (highest priorities first) and anything that
+does not fit is simply handled next round — staleness is already part of the
+protocol contract (plan entries are validated against live state at
+enactment, like the reference's push/RFR races, ``src/adlb.c:2182-2192``).
+
+Algorithm: synchronous auction rounds, the classic parallelizable relaxation
+of bipartite matching (Bertsekas). Each round, every unassigned requester
+bids for its best compatible unassigned task (priority-ordered, matching the
+reference's algebraically-largest-``work_prio`` contract); ties are broken by
+requester index via a scatter-min, winners are committed, and the round
+repeats. Every round commits at least one assignment, and in practice almost
+everything lands in the first rounds, so a small fixed round count suffices
+for the fixed shapes involved.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel far below any real priority (int32-safe; real priorities are
+# clipped to +/-1e9, reference priorities are C ints).
+_NEG = jnp.int32(-(2**31) + 1)
+_PRIO_CLIP = 10**9
+
+
+@functools.partial(jax.jit, static_argnames=("rounds",))
+def _auction_assign(
+    task_prio: jax.Array,  # [NT] int32, _NEG for padding
+    task_type: jax.Array,  # [NT] int32 type *index*, -1 for padding
+    req_mask: jax.Array,  # [NR, T] bool: requester accepts type index
+    req_valid: jax.Array,  # [NR] bool
+    rounds: int = 6,
+) -> jax.Array:
+    """Returns assign[NR] int32: task index assigned to each requester, -1 if none."""
+    NT = task_prio.shape[0]
+    NR = req_mask.shape[0]
+
+    # [NR, NT] compatibility: requester r accepts task t's type
+    compat = jnp.where(
+        (task_type[None, :] >= 0) & req_valid[:, None],
+        jnp.take_along_axis(
+            req_mask, jnp.clip(task_type, 0)[None, :].repeat(NR, 0), axis=1
+        ),
+        False,
+    )
+
+    def one_round(state, _):
+        assign, task_taken = state
+        open_req = (assign < 0) & req_valid
+        open_task = ~task_taken
+        # score[r, t]: priority if biddable else sentinel
+        score = jnp.where(
+            compat & open_req[:, None] & open_task[None, :],
+            task_prio[None, :],
+            _NEG,
+        )
+        best_task = jnp.argmax(score, axis=1)  # [NR]
+        best_score = jnp.max(score, axis=1)
+        bidding = best_score > _NEG
+        # conflict resolution: lowest requester index wins each task
+        ridx = jnp.arange(NR, dtype=jnp.int32)
+        bids = jnp.where(bidding, ridx, jnp.int32(NR))
+        winner = (
+            jnp.full((NT,), NR, dtype=jnp.int32)
+            .at[jnp.where(bidding, best_task, 0)]
+            .min(jnp.where(bidding, bids, jnp.int32(NR)))
+        )
+        won = bidding & (winner[best_task] == ridx)
+        assign = jnp.where(won, best_task.astype(jnp.int32), assign)
+        task_taken = task_taken.at[jnp.where(won, best_task, NT)].set(
+            True, mode="drop"
+        )
+        return (assign, task_taken), None
+
+    assign0 = jnp.full((NR,), -1, dtype=jnp.int32)
+    taken0 = jnp.zeros((NT,), dtype=bool)
+    (assign, _), _ = jax.lax.scan(one_round, (assign0, taken0), None, length=rounds)
+    return assign
+
+
+class AssignmentSolver:
+    """Host-side wrapper: packs per-server snapshots into fixed-shape arrays,
+    runs the jitted auction, unpacks plan entries."""
+
+    def __init__(
+        self, types: Sequence[int], max_tasks: int, max_requesters: int,
+        rounds: int = 6,
+    ) -> None:
+        self.types = tuple(types)
+        self.type_index = {t: i for i, t in enumerate(self.types)}
+        self.K = max_tasks
+        self.R = max_requesters
+        self.rounds = rounds
+        self.solve_count = 0
+
+    def solve(self, snapshots: dict, world) -> list:
+        """snapshots: server_rank -> {"tasks": [(seqno, type, prio, len)...],
+        "reqs": [(rank, rqseqno, req_types|None)...]}.
+
+        Returns [(holder_server, seqno, req_home_server, for_rank, rqseqno)].
+        """
+        servers = sorted(snapshots)
+        S, K, R, T = len(servers), self.K, self.R, len(self.types)
+        if S == 0:
+            return []
+        task_prio = np.full((S * K,), int(_NEG), dtype=np.int32)
+        task_type = np.full((S * K,), -1, dtype=np.int32)
+        task_ref: list = [None] * (S * K)
+        req_mask = np.zeros((S * R, T), dtype=bool)
+        req_valid = np.zeros((S * R,), dtype=bool)
+        req_ref: list = [None] * (S * R)
+
+        for si, s in enumerate(servers):
+            snap = snapshots[s]
+            for ki, (seqno, wtype, prio, _len) in enumerate(snap["tasks"][:K]):
+                i = si * K + ki
+                task_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio))
+                task_type[i] = self.type_index.get(wtype, -1)
+                task_ref[i] = (s, seqno)
+            for ri, (rank, rqseqno, req_types) in enumerate(snap["reqs"][:R]):
+                i = si * R + ri
+                req_valid[i] = True
+                if req_types is None:
+                    req_mask[i, :] = True
+                else:
+                    for t in req_types:
+                        ti = self.type_index.get(t)
+                        if ti is not None:
+                            req_mask[i, ti] = True
+                req_ref[i] = (s, rank, rqseqno)
+
+        if not req_valid.any() or (task_type < 0).all():
+            return []
+
+        assign = np.asarray(
+            _auction_assign(
+                jnp.asarray(task_prio),
+                jnp.asarray(task_type),
+                jnp.asarray(req_mask),
+                jnp.asarray(req_valid),
+                rounds=self.rounds,
+            )
+        )
+        self.solve_count += 1
+
+        pairs = []
+        for i, t in enumerate(assign):
+            if t < 0 or req_ref[i] is None or task_ref[t] is None:
+                continue
+            holder, seqno = task_ref[t]
+            req_home, for_rank, rqseqno = req_ref[i]
+            pairs.append((holder, seqno, req_home, for_rank, rqseqno))
+        return pairs

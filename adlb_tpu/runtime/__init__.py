@@ -1,0 +1,1 @@
+"""Runtime: message protocol, transports, server reactor, client engine."""

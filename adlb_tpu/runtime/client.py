@@ -1,0 +1,293 @@
+"""Client-side protocol engine.
+
+Equivalent of the reference's L4 layer — ``ADLBP_Put`` / ``adlbp_Reserve`` /
+``adlbp_Get_reserved_timed`` / batch puts (reference ``src/adlb.c:2638-3176``)
+— over a Transport endpoint instead of tagged MPI sends.
+
+Behavioral contract kept from the reference:
+
+* targeted Puts are routed to the *target's* home server; untargeted Puts
+  round-robin over servers (reference ``src/adlb.c:2767-2773``);
+* rejected Puts retry at the server hinted by the rejecting server (the
+  least-loaded one it knows of), with bounded retries and a short sleep, then
+  return ADLB_PUT_REJECTED (reference ``src/adlb.c:2779-2796``);
+* a targeted Put accepted off the target's home server notifies the home
+  server so its targeted-work directory stays accurate (reference
+  ``src/adlb.c:2845-2852``);
+* Reserve blocks until work or a termination code; Ireserve returns
+  ADLB_NO_CURRENT_WORK immediately (reference ``src/adlb.c:2868-2957``);
+* Get_reserved fetches the batch-common prefix (possibly from a different
+  server) before the unique payload bytes (reference ``src/adlb.c:2976-3025``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.transport import Endpoint
+from adlb_tpu.runtime.world import Config, WorldSpec, normalize_req_types
+from adlb_tpu.types import (
+    ADLB_NO_CURRENT_WORK,
+    ADLB_PUT_REJECTED,
+    ADLB_SUCCESS,
+    AdlbAborted,
+    AdlbError,
+    ReserveResult,
+    WorkHandle,
+)
+
+
+@dataclasses.dataclass
+class _BatchState:
+    common_server: int
+    common_seqno: int
+    common_len: int
+    refcnt: int = 0
+
+
+class Client:
+    def __init__(
+        self, world: WorldSpec, cfg: Config, ep: Endpoint, abort_event=None
+    ) -> None:
+        self.world = world
+        self.cfg = cfg
+        self.ep = ep
+        self.rank = ep.rank
+        self.home = world.home_server(self.rank)
+        self._rr = self.rank % world.nservers  # round-robin cursor
+        self._batch: Optional[_BatchState] = None
+        self._rqseqno = 0
+        self._abort_event = abort_event
+        self.aborted = False
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _next_server(self) -> int:
+        s = self.world.num_app_ranks + self._rr
+        self._rr = (self._rr + 1) % self.world.nservers
+        return s
+
+    def _wait(self, want: Tag) -> Msg:
+        while True:
+            if self._abort_event is not None and self._abort_event.is_set():
+                self.aborted = True
+                raise AdlbAborted(-1)
+            m = self.ep.recv(timeout=0.5)
+            if m is None:
+                continue
+            if m.tag is Tag.TA_ABORT:
+                self.aborted = True
+                raise AdlbAborted(m.data.get("code", -1))
+            if m.tag is want:
+                return m
+            # A late RESERVE_RESP can cross a termination flush only if the
+            # origin server double-responded, which the rq discipline forbids.
+            raise AdlbError(f"rank {self.rank}: unexpected {m.tag} while waiting {want}")
+
+    # -- Put family ----------------------------------------------------------
+
+    def put(
+        self,
+        payload: bytes,
+        work_type: int,
+        work_prio: int = 0,
+        target_rank: int = -1,
+        answer_rank: int = -1,
+    ) -> int:
+        if not self.world.validate_type(work_type):
+            raise AdlbError(f"unregistered work type {work_type}")
+        if target_rank >= 0 and not self.world.is_app(target_rank):
+            raise AdlbError(f"target rank {target_rank} is not an app rank")
+        common = self._batch
+        if common is not None:
+            common.refcnt += 1
+
+        if target_rank >= 0:
+            server = self.world.home_server(target_rank)
+        else:
+            server = self._next_server()
+        attempts = 0
+        while True:
+            self.ep.send(
+                server,
+                msg(
+                    Tag.FA_PUT,
+                    self.rank,
+                    payload=bytes(payload),
+                    work_type=work_type,
+                    prio=work_prio,
+                    target_rank=target_rank,
+                    answer_rank=answer_rank,
+                    common_len=common.common_len if common else 0,
+                    common_server=common.common_server if common else -1,
+                    common_seqno=common.common_seqno if common else -1,
+                ),
+            )
+            resp = self._wait(Tag.TA_PUT_RESP)
+            rc = resp.rc
+            if rc != ADLB_PUT_REJECTED:
+                break
+            attempts += 1
+            if attempts > self.cfg.put_max_retries:
+                if common is not None:
+                    common.refcnt -= 1
+                return ADLB_PUT_REJECTED
+            hint = resp.data.get("hint", -1)
+            server = hint if hint >= 0 else self._next_server()
+            time.sleep(self.cfg.put_retry_sleep)
+        if rc != ADLB_SUCCESS and common is not None:
+            common.refcnt -= 1  # unit never stored; keep prefix GC reachable
+        if (
+            rc == ADLB_SUCCESS
+            and target_rank >= 0
+            and server != self.world.home_server(target_rank)
+        ):
+            self.ep.send(
+                self.world.home_server(target_rank),
+                msg(
+                    Tag.FA_DID_PUT_AT_REMOTE,
+                    self.rank,
+                    target_rank=target_rank,
+                    work_type=work_type,
+                    server_rank=server,
+                ),
+            )
+        return rc
+
+    def begin_batch_put(self, common_buf: bytes) -> int:
+        """Store a shared prefix once; subsequent puts reference it
+        (reference ``src/adlb.c:2638-2722``)."""
+        if self._batch is not None:
+            raise AdlbError("nested Begin_batch_put")
+        server = self._next_server()
+        self.ep.send(
+            server, msg(Tag.FA_PUT_COMMON, self.rank, payload=bytes(common_buf))
+        )
+        resp = self._wait(Tag.TA_PUT_COMMON_RESP)
+        if resp.rc != ADLB_SUCCESS:
+            return resp.rc
+        self._batch = _BatchState(
+            common_server=server,
+            common_seqno=resp.common_seqno,
+            common_len=len(common_buf),
+        )
+        return ADLB_SUCCESS
+
+    def end_batch_put(self) -> int:
+        """Ship the final refcount so the server can GC the prefix once every
+        member has been fetched (reference ``src/adlb.c:2724-2751``)."""
+        if self._batch is None:
+            raise AdlbError("End_batch_put without Begin_batch_put")
+        b = self._batch
+        self._batch = None
+        self.ep.send(
+            b.common_server,
+            msg(
+                Tag.FA_BATCH_DONE,
+                self.rank,
+                common_seqno=b.common_seqno,
+                refcnt=b.refcnt,
+            ),
+        )
+        return ADLB_SUCCESS
+
+    # -- Reserve / Get family ------------------------------------------------
+
+    def _reserve(
+        self, req_types: Optional[Sequence[int]], hang: bool
+    ) -> tuple[int, Optional[ReserveResult]]:
+        types = normalize_req_types(req_types, self.world.types)
+        self._rqseqno += 1
+        self.ep.send(
+            self.home,
+            msg(
+                Tag.FA_RESERVE,
+                self.rank,
+                req_types=None if types is None else sorted(types),
+                hang=hang,
+                rqseqno=self._rqseqno,
+            ),
+        )
+        resp = self._wait(Tag.TA_RESERVE_RESP)
+        if resp.rc != ADLB_SUCCESS:
+            return resp.rc, None
+        return ADLB_SUCCESS, ReserveResult(
+            work_type=resp.work_type,
+            work_prio=resp.prio,
+            handle=WorkHandle.from_ints(resp.handle),
+            work_len=resp.work_len,
+            answer_rank=resp.answer_rank,
+        )
+
+    def reserve(
+        self, req_types: Optional[Sequence[int]] = None
+    ) -> tuple[int, Optional[ReserveResult]]:
+        """Blocking reserve: returns only with work or a termination code."""
+        return self._reserve(req_types, hang=True)
+
+    def ireserve(
+        self, req_types: Optional[Sequence[int]] = None
+    ) -> tuple[int, Optional[ReserveResult]]:
+        """Non-blocking reserve: ADLB_NO_CURRENT_WORK if nothing matches now."""
+        rc, res = self._reserve(req_types, hang=False)
+        if rc == ADLB_NO_CURRENT_WORK:
+            return rc, None
+        return rc, res
+
+    def get_reserved_timed(
+        self, handle: WorkHandle
+    ) -> tuple[int, Optional[bytes], float]:
+        prefix = b""
+        if handle.common_len > 0:
+            self.ep.send(
+                handle.common_server_rank,
+                msg(Tag.FA_GET_COMMON, self.rank, common_seqno=handle.common_seqno),
+            )
+            resp = self._wait(Tag.TA_GET_COMMON_RESP)
+            prefix = resp.payload
+        self.ep.send(
+            handle.server_rank,
+            msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno),
+        )
+        resp = self._wait(Tag.TA_GET_RESERVED_RESP)
+        if resp.rc != ADLB_SUCCESS:
+            return resp.rc, None, 0.0
+        return ADLB_SUCCESS, prefix + resp.payload, resp.time_on_q
+
+    def get_reserved(self, handle: WorkHandle) -> tuple[int, Optional[bytes]]:
+        rc, buf, _ = self.get_reserved_timed(handle)
+        return rc, buf
+
+    # -- control -------------------------------------------------------------
+
+    def set_problem_done(self) -> int:
+        """Explicit termination (reference ADLB_Set_problem_done,
+        ``src/adlb.c:3054-3062``)."""
+        self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
+        return ADLB_SUCCESS
+
+    def info_num_work_units(self, work_type: int) -> tuple[int, int, int, int]:
+        """(rc, count, total bytes, max wq count) at the home server
+        (reference ``src/adlb.c:3027-3046``)."""
+        self.ep.send(
+            self.home, msg(Tag.FA_INFO_NUM_WORK_UNITS, self.rank, work_type=work_type)
+        )
+        resp = self._wait(Tag.TA_INFO_NUM_RESP)
+        return resp.rc, resp.count, resp.nbytes, resp.max_wq
+
+    def finalize(self) -> int:
+        if not self.aborted:
+            self.ep.send(self.home, msg(Tag.FA_LOCAL_APP_DONE, self.rank))
+        return ADLB_SUCCESS
+
+    def abort(self, code: int) -> None:
+        """Bring the whole world down (reference ADLB_Abort,
+        ``src/adlb.c:3165-3176``)."""
+        self.aborted = True
+        self.ep.send(self.home, msg(Tag.FA_ABORT, self.rank, code=code))
+        if self._abort_event is not None:
+            self._abort_event.set()
+        raise AdlbAborted(code)

@@ -1,0 +1,66 @@
+"""Debug-server watchdog.
+
+Equivalent of the reference's optional extra rank (``ADLBP_Debug_server``,
+reference ``src/adlb.c:2528-2635``): servers ship periodic counter summaries
+(DS_LOG); the watchdog aggregates them and **aborts the whole world if no
+message arrives within the timeout** — turning hangs into bounded-time
+failures with state dumps, which the reference's docs recommend as the soak-
+test harness (reference ``USERGUIDE.txt:60-80``).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from adlb_tpu.runtime.messages import Tag, msg
+from adlb_tpu.runtime.transport import Endpoint
+from adlb_tpu.runtime.world import Config, WorldSpec
+
+
+class DebugServer:
+    def __init__(
+        self, world: WorldSpec, cfg: Config, ep: Endpoint, abort_event=None
+    ) -> None:
+        self.world = world
+        self.cfg = cfg
+        self.ep = ep
+        self._abort_event = abort_event
+        self.aggregates: dict[int, dict] = {}
+        self.timed_out = False
+
+    def run(self) -> None:
+        ended: set[int] = set()
+        last_msg = time.monotonic()
+        while len(ended) < self.world.nservers:
+            if self._abort_event is not None and self._abort_event.is_set():
+                return
+            m = self.ep.recv(timeout=min(self.cfg.debug_server_timeout / 4, 0.25))
+            now = time.monotonic()
+            if m is None:
+                if now - last_msg > self.cfg.debug_server_timeout:
+                    self.timed_out = True
+                    print(
+                        f"[adlb debug server] no server heartbeat for "
+                        f"{self.cfg.debug_server_timeout:.1f}s — aborting world",
+                        file=sys.stderr,
+                    )
+                    for s in self.world.server_ranks:
+                        self.ep.send(s, msg(Tag.SS_ABORT, self.ep.rank, code=-2))
+                    for a in self.world.app_ranks:
+                        self.ep.send(a, msg(Tag.TA_ABORT, self.ep.rank, code=-2))
+                    if self._abort_event is not None:
+                        self._abort_event.set()
+                    return
+                continue
+            last_msg = now
+            if m.tag is Tag.DS_END:
+                ended.add(m.src)
+            elif m.tag is Tag.DS_LOG:
+                agg = self.aggregates.setdefault(
+                    m.src, {"wq_count": 0, "rq_count": 0, "nbytes": 0, "n": 0}
+                )
+                agg["wq_count"] = m.wq_count
+                agg["rq_count"] = m.rq_count
+                agg["nbytes"] = m.nbytes
+                agg["n"] += 1

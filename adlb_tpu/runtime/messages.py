@@ -1,0 +1,89 @@
+"""Message protocol.
+
+Functional equivalent of the reference's 40-tag MPI wire protocol (reference
+``src/adlb.c:44-83``), carried over any `Transport`. Differences from the
+reference, by design:
+
+* no rendezvous two-phase PUT (header/ack/Rsend): transports here deliver
+  whole messages, and admission control happens at the receiving server,
+  which replies with an accept/reject (+ least-loaded hint) like the
+  reference's ACK_AND_RC (reference ``src/adlb.c:908-958``);
+* the qmstat ring pass is replaced either by direct state broadcast
+  (heuristic mode) or by balancer snapshot/plan messages (TPU mode).
+
+Tag families keep the reference's naming: FA_* client->server, TA_*
+server->client, SS_* server<->server, DS_* debug-server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+
+class Tag(enum.Enum):
+    # client -> server
+    FA_PUT = enum.auto()
+    FA_PUT_COMMON = enum.auto()
+    FA_BATCH_DONE = enum.auto()
+    FA_DID_PUT_AT_REMOTE = enum.auto()
+    FA_RESERVE = enum.auto()
+    FA_GET_RESERVED = enum.auto()
+    FA_GET_COMMON = enum.auto()
+    FA_NO_MORE_WORK = enum.auto()
+    FA_LOCAL_APP_DONE = enum.auto()
+    FA_ABORT = enum.auto()
+    FA_INFO_NUM_WORK_UNITS = enum.auto()
+
+    # server -> client
+    TA_PUT_RESP = enum.auto()
+    TA_PUT_COMMON_RESP = enum.auto()
+    TA_RESERVE_RESP = enum.auto()
+    TA_GET_RESERVED_RESP = enum.auto()
+    TA_GET_COMMON_RESP = enum.auto()
+    TA_INFO_NUM_RESP = enum.auto()
+    TA_ABORT = enum.auto()
+
+    # server <-> server
+    SS_QMSTAT = enum.auto()
+    SS_RFR = enum.auto()
+    SS_RFR_RESP = enum.auto()
+    SS_UNRESERVE = enum.auto()
+    SS_PUSH_QUERY = enum.auto()
+    SS_PUSH_QUERY_RESP = enum.auto()
+    SS_PUSH_WORK = enum.auto()
+    SS_PUSH_DEL = enum.auto()
+    SS_MOVING_TARGETED_WORK = enum.auto()
+    SS_NO_MORE_WORK = enum.auto()
+    SS_EXHAUST_CHK_1 = enum.auto()
+    SS_EXHAUST_CHK_2 = enum.auto()
+    SS_DONE_BY_EXHAUSTION = enum.auto()
+    SS_END_1 = enum.auto()
+    SS_END_2 = enum.auto()
+    SS_ABORT = enum.auto()
+
+    # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
+    SS_STATE = enum.auto()
+    SS_PLAN_MATCH = enum.auto()
+
+    # debug server
+    DS_LOG = enum.auto()
+    DS_END = enum.auto()
+
+
+@dataclasses.dataclass
+class Msg:
+    tag: Tag
+    src: int
+    data: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["data"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+def msg(tag: Tag, src: int, **data: Any) -> Msg:
+    return Msg(tag=tag, src=src, data=data)

@@ -1,0 +1,419 @@
+"""Server-side queue structures.
+
+Functional equivalent of the reference's ``xq`` library and its five
+specialized queues (reference ``src/xq.h:91-134``, ``src/xq.c``), redesigned
+around indexes instead of linear scans:
+
+* the reference finds the highest-priority matching unit by walking a doubly
+  linked list per Reserve — O(|wq| * ntypes) (reference ``src/xq.c:190-247``);
+  here each (type) and (target, type) bucket is a lazy-deletion binary heap, so
+  match/insert/remove are O(log n).
+
+The semantic contract preserved from the reference:
+
+* highest ``work_prio`` (algebraically largest) wins; FIFO among equal
+  priorities (heap key includes the monotone seqno);
+* work targeted at rank R is only ever handed to R, and targeted work takes
+  precedence over untargeted work for its target (reference
+  ``src/adlb.c:1204-1237``);
+* pinned units (reserved but not yet fetched) are invisible to matching
+  (reference ``src/xq.h:44-45``, ``src/xq.c:199-201``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import time
+from typing import Iterable, Optional
+
+from adlb_tpu.types import ADLB_LOWEST_PRIO
+
+
+@dataclasses.dataclass
+class WorkUnit:
+    """One queued unit of work, metadata + opaque payload bytes.
+
+    Field set mirrors the reference's ``wq_struct_t`` (reference
+    ``src/xq.h:39-56``).
+    """
+
+    seqno: int
+    work_type: int
+    prio: int
+    target_rank: int  # -1 = untargeted
+    answer_rank: int
+    payload: bytes
+    home_server: int = -1
+    common_len: int = 0
+    common_server_rank: int = -1
+    common_seqno: int = -1
+    pinned: bool = False
+    pin_rank: int = -1
+    time_stamp: float = dataclasses.field(default_factory=time.monotonic)
+
+    @property
+    def work_len(self) -> int:
+        return len(self.payload) + self.common_len
+
+
+class WorkQueue:
+    """Indexed priority work queue (the reference's ``wq``)."""
+
+    def __init__(self) -> None:
+        self._units: dict[int, WorkUnit] = {}
+        # type -> heap of (-prio, seqno) over unpinned untargeted units
+        self._untargeted: dict[int, list[tuple[int, int]]] = {}
+        # (target_rank, type) -> heap of (-prio, seqno) over unpinned targeted units
+        self._targeted: dict[tuple[int, int], list[tuple[int, int]]] = {}
+        # target_rank -> types with a (possibly stale) bucket, so any-type
+        # targeted lookups touch only this rank's buckets, not every
+        # (rank, type) pair ever seen; pruned as buckets drain
+        self._targeted_types: dict[int, set[int]] = {}
+        self.count = 0
+        self.max_count = 0
+        self.total_bytes = 0
+
+    # -- insertion / removal -------------------------------------------------
+
+    def add(self, unit: WorkUnit) -> None:
+        assert unit.seqno not in self._units
+        self._units[unit.seqno] = unit
+        self.count += 1
+        self.max_count = max(self.max_count, self.count)
+        self.total_bytes += len(unit.payload)
+        if not unit.pinned:
+            self._index(unit)
+
+    def _index(self, unit: WorkUnit) -> None:
+        key = (-unit.prio, unit.seqno)
+        if unit.target_rank < 0:
+            heapq.heappush(self._untargeted.setdefault(unit.work_type, []), key)
+        else:
+            heapq.heappush(
+                self._targeted.setdefault((unit.target_rank, unit.work_type), []), key
+            )
+            self._targeted_types.setdefault(unit.target_rank, set()).add(
+                unit.work_type
+            )
+
+    def get(self, seqno: int) -> Optional[WorkUnit]:
+        return self._units.get(seqno)
+
+    def remove(self, seqno: int) -> WorkUnit:
+        unit = self._units.pop(seqno)
+        self.count -= 1
+        self.total_bytes -= len(unit.payload)
+        return unit  # stale heap entries are skipped lazily
+
+    # -- pin discipline ------------------------------------------------------
+
+    def pin(self, seqno: int, rank: int) -> None:
+        unit = self._units[seqno]
+        unit.pinned = True
+        unit.pin_rank = rank
+        # heap entry goes stale; skipped on pop
+
+    def unpin(self, seqno: int) -> None:
+        unit = self._units[seqno]
+        unit.pinned = False
+        unit.pin_rank = -1
+        self._index(unit)
+
+    # -- matching ------------------------------------------------------------
+
+    def _pop_best(
+        self, heap: Optional[list[tuple[int, int]]], targeted_to: int
+    ) -> Optional[WorkUnit]:
+        """Peek the best live entry of a lazy heap, discarding stale tops."""
+        if not heap:
+            return None
+        while heap:
+            neg_prio, seqno = heap[0]
+            unit = self._units.get(seqno)
+            if (
+                unit is None
+                or unit.pinned
+                or unit.prio != -neg_prio
+                or (targeted_to >= 0 and unit.target_rank != targeted_to)
+                or (targeted_to < 0 and unit.target_rank >= 0)
+            ):
+                heapq.heappop(heap)  # stale
+                continue
+            return unit
+        return None
+
+    def _best_of(
+        self, heaps: Iterable[tuple[Optional[list[tuple[int, int]]], int]]
+    ) -> Optional[WorkUnit]:
+        best: Optional[WorkUnit] = None
+        for heap, targeted_to in heaps:
+            unit = self._pop_best(heap, targeted_to)
+            if unit is not None and (
+                best is None
+                or unit.prio > best.prio
+                or (unit.prio == best.prio and unit.seqno < best.seqno)
+            ):
+                best = unit
+        return best
+
+    def find_targeted(self, rank: int, req_types: Optional[frozenset[int]]) -> Optional[WorkUnit]:
+        """Best unpinned unit targeted at `rank` with a requested type.
+
+        req_types None means "any type" (reference ADLB_RESERVE_REQUEST_ANY).
+        """
+        types = self._targeted_types.get(rank)
+        if not types:
+            return None
+        cand = types if req_types is None else types & req_types
+        best: Optional[WorkUnit] = None
+        for t in list(cand):
+            heap = self._targeted.get((rank, t))
+            unit = self._pop_best(heap, rank)
+            if unit is None:
+                if not heap:  # fully drained: prune (unpin re-indexes)
+                    self._targeted.pop((rank, t), None)
+                    types.discard(t)
+                continue
+            if best is None or unit.prio > best.prio or (
+                unit.prio == best.prio and unit.seqno < best.seqno
+            ):
+                best = unit
+        if not types:
+            del self._targeted_types[rank]
+        return best
+
+    def find_untargeted(self, req_types: Optional[frozenset[int]]) -> Optional[WorkUnit]:
+        """Best unpinned untargeted unit of a requested type."""
+        if req_types is None:
+            types: Iterable[int] = list(self._untargeted.keys())
+        else:
+            types = req_types
+        return self._best_of((self._untargeted.get(t), -1) for t in types)
+
+    def find_match(self, rank: int, req_types: Optional[frozenset[int]]) -> Optional[WorkUnit]:
+        """Reference match order: work targeted at the requester first, then
+        best untargeted by priority (reference ``src/adlb.c:1204-1237``)."""
+        unit = self.find_targeted(rank, req_types)
+        if unit is not None:
+            return unit
+        return self.find_untargeted(req_types)
+
+    def find_unpinned(self) -> Optional[WorkUnit]:
+        """Any unpinned unit — used by the memory-pressure push path
+        (reference ``src/xq.c:266-281``). Prefers untargeted (moving targeted
+        work requires directory fixups), lowest priority first so urgent work
+        stays local."""
+        worst: Optional[WorkUnit] = None
+        for unit in self._units.values():
+            if unit.pinned:
+                continue
+            if unit.target_rank < 0 and (worst is None or unit.prio < worst.prio):
+                worst = unit
+        if worst is not None:
+            return worst
+        for unit in self._units.values():
+            if not unit.pinned:
+                return unit
+        return None
+
+    # -- stats for gossip / balancer -----------------------------------------
+
+    def num_unpinned_untargeted(self) -> int:
+        return sum(
+            1 for u in self._units.values() if not u.pinned and u.target_rank < 0
+        )
+
+    def hi_prio_of_type(self, work_type: int) -> int:
+        """Highest priority among available (unpinned, untargeted) units of a
+        type, or ADLB_LOWEST_PRIO — one cell of the reference's qmstat vector
+        (reference ``src/adlb.c:151-159``)."""
+        unit = self._pop_best(self._untargeted.get(work_type), -1)
+        return unit.prio if unit is not None else ADLB_LOWEST_PRIO
+
+    def count_of_type(self, work_type: int) -> tuple[int, int]:
+        """(total units of type, total bytes) — for Info_num_work_units
+        (reference ``src/adlb.c:2466-2496``)."""
+        n = 0
+        nbytes = 0
+        for u in self._units.values():
+            if u.work_type == work_type:
+                n += 1
+                nbytes += u.work_len
+        return n, nbytes
+
+    def units(self) -> Iterable[WorkUnit]:
+        return self._units.values()
+
+
+@dataclasses.dataclass
+class RqEntry:
+    """A parked (blocking) Reserve waiting for work (reference ``src/xq.h:58-64``)."""
+
+    world_rank: int
+    rqseqno: int
+    req_types: Optional[frozenset[int]]  # None = any
+    time_stamp: float = dataclasses.field(default_factory=time.monotonic)
+
+    def wants(self, work_type: int) -> bool:
+        return self.req_types is None or work_type in self.req_types
+
+
+class ReserveQueue:
+    """Waiting requesters, FIFO within compatibility (the reference's ``rq``)."""
+
+    def __init__(self) -> None:
+        self._entries: dict[int, RqEntry] = {}  # world_rank -> entry, insert-ordered
+
+    def add(self, entry: RqEntry) -> None:
+        self._entries[entry.world_rank] = entry
+
+    def remove(self, world_rank: int) -> Optional[RqEntry]:
+        return self._entries.pop(world_rank, None)
+
+    def find_for_type(self, work_type: int, target_rank: int = -1) -> Optional[RqEntry]:
+        """First waiting requester a fresh unit could satisfy (reference
+        ``src/xq.c:352-444`` via ``rq_find_rank_queued_for_type``)."""
+        if target_rank >= 0:
+            e = self._entries.get(target_rank)
+            return e if e is not None and e.wants(work_type) else None
+        for e in self._entries.values():
+            if e.wants(work_type):
+                return e
+        return None
+
+    def waiting_ranks(self) -> list[int]:
+        return list(self._entries)
+
+    def entries(self) -> list[RqEntry]:
+        return list(self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, world_rank: int) -> bool:
+        return world_rank in self._entries
+
+
+class TargetedDirectory:
+    """Home server's directory of *off-home* targeted work (the reference's
+    ``tq``, ``src/xq.h:73-79``): for each (app_rank, type), on which remote
+    server targeted units currently sit and how many. Indexed per app rank so
+    lookups touch only that rank's entries."""
+
+    def __init__(self) -> None:
+        self._d: dict[int, dict[int, dict[int, int]]] = {}  # rank -> type -> server -> n
+
+    def add(self, app_rank: int, work_type: int, server_rank: int, n: int = 1) -> None:
+        by_type = self._d.setdefault(app_rank, {})
+        by_server = by_type.setdefault(work_type, {})
+        by_server[server_rank] = by_server.get(server_rank, 0) + n
+        if by_server[server_rank] <= 0:
+            del by_server[server_rank]
+            if not by_server:
+                del by_type[work_type]
+                if not by_type:
+                    del self._d[app_rank]
+
+    def remove(self, app_rank: int, work_type: int, server_rank: int, n: int = 1) -> None:
+        self.add(app_rank, work_type, server_rank, -n)
+
+    def lookup(
+        self, app_rank: int, req_types: Optional[frozenset[int]]
+    ) -> Optional[tuple[int, int]]:
+        """(remote server rank, work_type) believed to hold work targeted at
+        app_rank, or None."""
+        by_type = self._d.get(app_rank)
+        if not by_type:
+            return None
+        for wt, by_server in by_type.items():
+            if req_types is not None and wt not in req_types:
+                continue
+            for server_rank in by_server:
+                return server_rank, wt
+        return None
+
+
+class CommonStore:
+    """Batch-put common-prefix store (the reference's ``cq``,
+    ``src/xq.h:81-88``): a shared prefix stored once, refcounted, GC'd when
+    every member of the batch has been fetched (reference
+    ``src/adlb.c:1135-1160``)."""
+
+    @dataclasses.dataclass
+    class Entry:
+        seqno: int
+        buf: bytes
+        refcnt: int = -1  # -1 until End_batch_put ships the final count
+        ngets: int = 0
+
+    def __init__(self, on_gc=None) -> None:
+        self._entries: dict[int, CommonStore.Entry] = {}
+        self._next_seqno = 1
+        self._on_gc = on_gc  # called with the entry when its bytes are freed
+
+    def put(self, buf: bytes) -> int:
+        seqno = self._next_seqno
+        self._next_seqno += 1
+        self._entries[seqno] = CommonStore.Entry(seqno, buf)
+        return seqno
+
+    def set_refcnt(self, seqno: int, refcnt: int) -> None:
+        e = self._entries.get(seqno)
+        if e is None:
+            return
+        e.refcnt = refcnt
+        self._maybe_gc(e)
+
+    def get(self, seqno: int) -> bytes:
+        e = self._entries[seqno]
+        buf = e.buf
+        e.ngets += 1
+        self._maybe_gc(e)
+        return buf
+
+    def _maybe_gc(self, e: "CommonStore.Entry") -> None:
+        if e.refcnt >= 0 and e.ngets >= e.refcnt:
+            del self._entries[e.seqno]
+            if self._on_gc is not None:
+                self._on_gc(e)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class MemoryAccountant:
+    """Per-server byte budget and admission control (reference
+    ``src/adlb.c:3419-3474``): puts beyond the cap are rejected (the client
+    retries elsewhere), and crossing ``push_threshold`` triggers
+    memory-pressure pushes to less-loaded servers."""
+
+    PUSH_FRACTION = 0.95  # reference THRESHOLD_TO_START_PUSH (src/adlb.c:93)
+
+    def __init__(self, max_bytes: float) -> None:
+        self.max_bytes = max_bytes
+        self.curr = 0
+        self.total = 0
+        self.hwm = 0
+
+    def try_alloc(self, nbytes: int) -> bool:
+        """Admission-controlled alloc for puts (reference ``pmalloc``)."""
+        if self.max_bytes > 0 and self.curr + nbytes > self.max_bytes:
+            return False
+        self.alloc(nbytes)
+        return True
+
+    def alloc(self, nbytes: int) -> None:
+        self.curr += nbytes
+        self.total += nbytes
+        self.hwm = max(self.hwm, self.curr)
+
+    def free(self, nbytes: int) -> None:
+        self.curr -= nbytes
+
+    @property
+    def under_pressure(self) -> bool:
+        return self.max_bytes > 0 and self.curr > self.PUSH_FRACTION * self.max_bytes
+
+    def has_room(self, nbytes: int) -> bool:
+        return self.max_bytes <= 0 or self.curr + nbytes <= self.PUSH_FRACTION * self.max_bytes

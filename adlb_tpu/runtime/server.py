@@ -1,0 +1,1090 @@
+"""Server reactor.
+
+Equivalent of the reference's ~2,100-line single-threaded server event loop
+(``ADLBP_Server``, reference ``src/adlb.c:382-2506``): poll the transport,
+dispatch by tag, run periodic duties (state sync, push-trigger, exhaustion
+check, watchdog logging). Re-architected around indexed queues
+(:mod:`adlb_tpu.runtime.queues`) and two interchangeable cross-server
+balancing strategies:
+
+* **steal** — faithful-in-spirit rebuild of the reference heuristics:
+  per-server state broadcast (replacing the 0.1 s qmstat ring pass,
+  reference ``src/adlb.c:806-822,1705-1757``), pull-side RFR work stealing
+  with stale-state patching and UNRESERVE race compensation (reference
+  ``src/adlb.c:1802-2070``), and memory-pressure pushes with PUSH_DEL
+  cancellation (reference ``src/adlb.c:509-556,2109-2362``).
+* **tpu** — the reference's gossip+greedy matching is replaced by a periodic
+  batched global assignment solve: servers stream fixed-shape queue-state
+  snapshots to the balancer (the master server), a jitted JAX solve computes
+  task->requester placement, and plan entries are enacted through the same
+  pin/forward/UNRESERVE discipline so plan staleness is harmless (plan
+  entries are hints validated against live state, like the reference's
+  PUSH_QUERY_RESP validation, ``src/adlb.c:2182-2192``).
+
+Termination protocols (explicit no-more-work, double-pass exhaustion
+detection, held two-phase shutdown) follow the reference's ring-token designs
+(reference ``src/adlb.c:754-785,1385-1801``) over the server ring.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.queues import (
+    CommonStore,
+    MemoryAccountant,
+    ReserveQueue,
+    RqEntry,
+    TargetedDirectory,
+    WorkQueue,
+    WorkUnit,
+)
+from adlb_tpu.runtime.transport import Endpoint
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import (
+    ADLB_DONE_BY_EXHAUSTION,
+    ADLB_LOWEST_PRIO,
+    ADLB_NO_CURRENT_WORK,
+    ADLB_NO_MORE_WORK,
+    ADLB_PUT_REJECTED,
+    ADLB_SUCCESS,
+    AdlbError,
+    InfoKey,
+    WorkHandle,
+)
+
+
+class _PeerState:
+    """What this server believes about a peer — the reference's qmstat entry
+    {nbytes_used, qlen_unpin_untarg, type_hi_prio[]} (reference
+    ``src/adlb.c:151-159``)."""
+
+    def __init__(self) -> None:
+        self.nbytes = 0
+        self.qlen = 0
+        self.hi_prio: dict[int, int] = {}
+        self.stamp = 0.0
+
+
+class Server:
+    def __init__(
+        self, world: WorldSpec, cfg: Config, ep: Endpoint, abort_event=None
+    ) -> None:
+        self.world = world
+        self.cfg = cfg
+        self.ep = ep
+        self.rank = ep.rank
+        self.is_master = self.rank == world.master_server_rank
+        self.local_apps = set(world.local_apps(self.rank))
+
+        self.wq = WorkQueue()
+        self.rq = ReserveQueue()
+        self.tq = TargetedDirectory()
+        self.mem = MemoryAccountant(cfg.max_malloc_per_server)
+        self.cq = CommonStore(on_gc=lambda e: self.mem.free(len(e.buf)))
+
+        self._next_seqno = 1
+        self.peers: dict[int, _PeerState] = {
+            s: _PeerState() for s in world.server_ranks
+        }
+
+        # stealing state
+        self._rfr_out: set[int] = set()  # ranks with an outstanding RFR
+        self._rfr_excluded: dict[int, set[int]] = {}  # rank -> servers struck out
+        # push state: query_id -> seqno offered; receiver side: query_id -> reserved bytes
+        self._push_seq = 0
+        self._push_offered: dict[int, int] = {}
+        self._push_reserved: dict[int, int] = {}
+
+        # termination state
+        self.no_more_work = False
+        self.done_by_exhaustion = False
+        self.done = False
+        self._finalized: set[int] = set()
+        self._end1_pending = False  # END_1 token held until local apps finish
+        self._exhaust_held_since: Optional[float] = None
+        self._exhaust_inflight = False
+        self.activity = 0  # puts accepted + reservations handed out
+
+        # balancer state (master only, tpu mode)
+        self._snapshots: dict[int, dict] = {}
+        self._solver = None
+
+        # stats (InfoKey surface, reference src/adlb.c:3072-3141)
+        self.stats = {k: 0.0 for k in InfoKey}
+        self._rq_wait_sum = 0.0
+        self._rq_wait_n = 0
+        self._loop_t0 = time.monotonic()
+        self._loops = 0
+
+        self._abort_event = abort_event
+        self._aborted = False
+
+        # timers
+        now = time.monotonic()
+        self._next_state_sync = now
+        self._next_exhaust_check = now + cfg.exhaust_check_interval
+        self._next_ds_log = now
+        self._ds_counters = {"puts": 0, "reserves": 0, "rfrs": 0, "pushes": 0}
+
+        self._handlers = {
+            Tag.FA_PUT: self._on_put,
+            Tag.FA_PUT_COMMON: self._on_put_common,
+            Tag.FA_BATCH_DONE: self._on_batch_done,
+            Tag.FA_DID_PUT_AT_REMOTE: self._on_did_put_at_remote,
+            Tag.FA_RESERVE: self._on_reserve,
+            Tag.FA_GET_RESERVED: self._on_get_reserved,
+            Tag.FA_GET_COMMON: self._on_get_common,
+            Tag.FA_NO_MORE_WORK: self._on_fa_no_more_work,
+            Tag.FA_LOCAL_APP_DONE: self._on_local_app_done,
+            Tag.FA_ABORT: self._on_fa_abort,
+            Tag.FA_INFO_NUM_WORK_UNITS: self._on_info_num,
+            Tag.SS_QMSTAT: self._on_qmstat,
+            Tag.SS_RFR: self._on_rfr,
+            Tag.SS_RFR_RESP: self._on_rfr_resp,
+            Tag.SS_UNRESERVE: self._on_unreserve,
+            Tag.SS_PUSH_QUERY: self._on_push_query,
+            Tag.SS_PUSH_QUERY_RESP: self._on_push_query_resp,
+            Tag.SS_PUSH_WORK: self._on_push_work,
+            Tag.SS_PUSH_DEL: self._on_push_del,
+            Tag.SS_MOVING_TARGETED_WORK: self._on_moving_targeted,
+            Tag.SS_NO_MORE_WORK: self._on_ss_no_more_work,
+            Tag.SS_EXHAUST_CHK_1: self._on_exhaust_chk,
+            Tag.SS_EXHAUST_CHK_2: self._on_exhaust_chk,
+            Tag.SS_DONE_BY_EXHAUSTION: self._on_done_by_exhaustion,
+            Tag.SS_END_1: self._on_end_1,
+            Tag.SS_END_2: self._on_end_2,
+            Tag.SS_ABORT: self._on_ss_abort,
+            Tag.SS_STATE: self._on_state,
+            Tag.SS_PLAN_MATCH: self._on_plan_match,
+        }
+
+    # ------------------------------------------------------------------ loop
+
+    def run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            self._notify_debug_server_end()
+
+    def _run_loop(self) -> None:
+        interval = (
+            self.cfg.balancer_interval
+            if self.cfg.balancer == "tpu"
+            else self.cfg.qmstat_interval
+        )
+        while not self.done:
+            if self._abort_event is not None and self._abort_event.is_set():
+                return
+            now = time.monotonic()
+            self._loops += 1
+            self._periodic(now, interval)
+            deadline = min(
+                self._next_state_sync,
+                self._next_exhaust_check if self.is_master else now + 1.0,
+                self._next_ds_log
+                if self.world.use_debug_server
+                else now + 1.0,
+            )
+            m = self.ep.recv(timeout=max(deadline - time.monotonic(), 0.0))
+            t0 = time.monotonic()
+            if m is not None:
+                handler = self._handlers.get(m.tag)
+                if handler is None:
+                    raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
+                handler(m)
+                # drain whatever else is queued before paying the poll
+                # timeout — but bounded, so periodic duties (state sync,
+                # watchdog heartbeat, exhaustion checks) still run under
+                # sustained load
+                for _ in range(128):
+                    if self.done or time.monotonic() >= deadline:
+                        break
+                    m2 = self.ep.recv(timeout=0.0)
+                    if m2 is None:
+                        break
+                    h2 = self._handlers.get(m2.tag)
+                    if h2 is None:
+                        raise AdlbError(f"server {self.rank}: no handler for {m2.tag}")
+                    h2(m2)
+            self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
+
+    def _periodic(self, now: float, interval: float) -> None:
+        if now >= self._next_state_sync:
+            self._next_state_sync = now + interval
+            if self.cfg.balancer == "tpu":
+                self._send_snapshot()
+                if self.is_master:
+                    self._run_balancer_round()
+            else:
+                self._broadcast_qmstat()
+            if self.mem.under_pressure:
+                self._try_push()
+        if self.is_master and now >= self._next_exhaust_check:
+            self._next_exhaust_check = now + self.cfg.exhaust_check_interval
+            self._check_exhaustion(now)
+        if self.world.use_debug_server and now >= self._next_ds_log:
+            self._next_ds_log = now + self.cfg.debug_log_interval
+            self._send_ds_log()
+
+    # ------------------------------------------------------- helpers
+
+    def _least_loaded_peer(self, nbytes_needed: int = 0) -> int:
+        """Least-loaded peer believed to have room for nbytes_needed, else
+        least-loaded overall, else -1."""
+        cap = self.cfg.max_malloc_per_server
+        best, best_bytes = -1, None
+        fallback, fallback_bytes = -1, None
+        for s, st in self.peers.items():
+            if s == self.rank:
+                continue
+            if fallback_bytes is None or st.nbytes < fallback_bytes:
+                fallback, fallback_bytes = s, st.nbytes
+            if cap > 0 and st.nbytes + nbytes_needed > cap:
+                continue
+            if best_bytes is None or st.nbytes < best_bytes:
+                best, best_bytes = s, st.nbytes
+        return best if best >= 0 else fallback
+
+    def _reserve_resp(
+        self, app_rank: int, rc: int, unit: Optional[WorkUnit] = None,
+        holder: Optional[int] = None,
+    ) -> None:
+        if rc != ADLB_SUCCESS:
+            self.ep.send(app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc))
+            return
+        handle = WorkHandle(
+            seqno=unit.seqno,
+            server_rank=holder if holder is not None else self.rank,
+            common_len=unit.common_len,
+            common_server_rank=unit.common_server_rank,
+            common_seqno=unit.common_seqno,
+        )
+        self.ep.send(
+            app_rank,
+            msg(
+                Tag.TA_RESERVE_RESP,
+                self.rank,
+                rc=ADLB_SUCCESS,
+                work_type=unit.work_type,
+                prio=unit.prio,
+                handle=handle.to_ints(),
+                work_len=unit.work_len,
+                answer_rank=unit.answer_rank,
+            ),
+        )
+
+    def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
+                        holder: Optional[int] = None) -> None:
+        """Hand a unit to a parked requester and account the wait."""
+        self.rq.remove(entry.world_rank)
+        self._rfr_excluded.pop(entry.world_rank, None)
+        wait = time.monotonic() - entry.time_stamp
+        self._rq_wait_sum += wait
+        self._rq_wait_n += 1
+        self.activity += 1
+        self._reserve_resp(entry.world_rank, ADLB_SUCCESS, unit, holder=holder)
+
+    def _match_rq(self) -> None:
+        """Re-scan parked requesters against the local queue — run after any
+        event that adds/unpins work (the local analogue of the reference's
+        ``check_remote_work_for_queued_apps``, ``src/adlb.c:3536-3579``)."""
+        progressed = True
+        while progressed:
+            progressed = False
+            for entry in self.rq.entries():
+                unit = self.wq.find_match(entry.world_rank, entry.req_types)
+                if unit is not None:
+                    self.wq.pin(unit.seqno, entry.world_rank)
+                    self._satisfy_parked(entry, unit)
+                    progressed = True
+                    break
+
+    # ------------------------------------------------------- app handlers
+
+    def _on_put(self, m: Msg) -> None:
+        self._ds_counters["puts"] += 1
+        if self.no_more_work or self.done_by_exhaustion:
+            self.ep.send(
+                m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_NO_MORE_WORK)
+            )
+            return
+        payload: bytes = m.payload
+        if not self.mem.try_alloc(len(payload)):
+            self.stats[InfoKey.NREJECTED_PUTS] += 1
+            self.ep.send(
+                m.src,
+                msg(
+                    Tag.TA_PUT_RESP,
+                    self.rank,
+                    rc=ADLB_PUT_REJECTED,
+                    hint=self._least_loaded_peer(len(payload)),
+                ),
+            )
+            return
+        unit = WorkUnit(
+            seqno=self._next_seqno,
+            work_type=m.work_type,
+            prio=m.prio,
+            target_rank=m.target_rank,
+            answer_rank=m.answer_rank,
+            payload=payload,
+            home_server=self.rank,
+            common_len=m.common_len,
+            common_server_rank=m.common_server,
+            common_seqno=m.common_seqno,
+        )
+        self._next_seqno += 1
+        self.wq.add(unit)
+        self.stats[InfoKey.MAX_WQ_COUNT] = max(
+            self.stats[InfoKey.MAX_WQ_COUNT], self.wq.count
+        )
+        self.activity += 1
+        self._exhaust_held_since = None
+        # immediate match against parked requesters (reference
+        # rq_find_rank_queued_for_type on FA_PUT_HDR, src/adlb.c:988-1042)
+        entry = self.rq.find_for_type(unit.work_type, unit.target_rank)
+        if entry is not None:
+            self.wq.pin(unit.seqno, entry.world_rank)
+            self._satisfy_parked(entry, unit)
+        self.ep.send(m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS))
+
+    def _on_put_common(self, m: Msg) -> None:
+        if not self.mem.try_alloc(len(m.payload)):
+            self.ep.send(
+                m.src,
+                msg(Tag.TA_PUT_COMMON_RESP, self.rank, rc=ADLB_PUT_REJECTED,
+                    common_seqno=-1),
+            )
+            return
+        seqno = self.cq.put(m.payload)
+        self.ep.send(
+            m.src,
+            msg(Tag.TA_PUT_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
+                common_seqno=seqno),
+        )
+
+    def _on_batch_done(self, m: Msg) -> None:
+        self.cq.set_refcnt(m.common_seqno, m.refcnt)
+
+    def _on_did_put_at_remote(self, m: Msg) -> None:
+        """A targeted put landed off the target's home server; record it and,
+        if the target is already parked here, go fetch it (reference
+        ``src/adlb.c:2845-2852`` + tq, ``src/xq.h:73-79``)."""
+        self.tq.add(m.target_rank, m.work_type, m.server_rank)
+        for cand in self.rq.entries():
+            if cand.world_rank == m.target_rank and cand.wants(m.work_type):
+                self._try_rfr(cand)
+                break
+
+    def _on_reserve(self, m: Msg) -> None:
+        self._ds_counters["reserves"] += 1
+        self.stats[InfoKey.NUM_RESERVES] += 1
+        app = m.src
+        req_types = None if m.req_types is None else frozenset(m.req_types)
+        if self.no_more_work:
+            self._reserve_resp(app, ADLB_NO_MORE_WORK)
+            return
+        if self.done_by_exhaustion:
+            self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION)
+            return
+        unit = self.wq.find_match(app, req_types)
+        if unit is not None:
+            self.wq.pin(unit.seqno, app)
+            self.activity += 1
+            self._reserve_resp(app, ADLB_SUCCESS, unit)
+            return
+        if not m.hang:
+            self._reserve_resp(app, ADLB_NO_CURRENT_WORK)
+            return
+        self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ] += 1
+        entry = RqEntry(world_rank=app, rqseqno=m.rqseqno, req_types=req_types)
+        self.rq.add(entry)
+        self._rfr_excluded.pop(app, None)
+        self._try_rfr(entry)
+
+    def _on_get_reserved(self, m: Msg) -> None:
+        unit = self.wq.get(m.seqno)
+        if unit is None or not unit.pinned or unit.pin_rank != m.src:
+            # invalid handle — the reference aborts the job here
+            # (src/adlb.c:1349-1357)
+            raise AdlbError(
+                f"server {self.rank}: invalid GET_RESERVED seqno {m.seqno} "
+                f"from rank {m.src}"
+            )
+        self.wq.remove(unit.seqno)
+        self.mem.free(len(unit.payload))
+        self.ep.send(
+            m.src,
+            msg(
+                Tag.TA_GET_RESERVED_RESP,
+                self.rank,
+                rc=ADLB_SUCCESS,
+                payload=unit.payload,
+                time_on_q=time.monotonic() - unit.time_stamp,
+            ),
+        )
+
+    def _on_get_common(self, m: Msg) -> None:
+        buf = self.cq.get(m.common_seqno)
+        self.ep.send(
+            m.src, msg(Tag.TA_GET_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
+                       payload=buf)
+        )
+
+    def _on_info_num(self, m: Msg) -> None:
+        n, nbytes = self.wq.count_of_type(m.work_type)
+        self.ep.send(
+            m.src,
+            msg(
+                Tag.TA_INFO_NUM_RESP,
+                self.rank,
+                rc=ADLB_SUCCESS,
+                count=n,
+                nbytes=nbytes,
+                max_wq=int(self.stats[InfoKey.MAX_WQ_COUNT]),
+            ),
+        )
+
+    # ------------------------------------------------------- stealing (pull)
+
+    def _try_rfr(self, entry: RqEntry) -> None:
+        """Pick a peer believed to hold matching work and ask it to pin one
+        unit for this requester (reference RFR, ``src/adlb.c:1278-1309``)."""
+        app = entry.world_rank
+        if app in self._rfr_out:
+            return
+        excluded = self._rfr_excluded.setdefault(app, set())
+        # 1) exact directory hit for targeted work parked off-home
+        hit = self.tq.lookup(app, entry.req_types)
+        if hit is not None and hit[0] not in excluded and hit[0] != self.rank:
+            server, wtype = hit
+            self._send_rfr(entry, server, targeted_lookup=True, lookup_type=wtype)
+            return
+        if self.cfg.balancer == "tpu":
+            return  # untargeted stealing is the planner's job
+        # 2) best advertised priority among peers for the requested types
+        best_server, best_prio = -1, ADLB_LOWEST_PRIO
+        for s, st in self.peers.items():
+            if s == self.rank or s in excluded:
+                continue
+            types = (
+                entry.req_types if entry.req_types is not None else st.hi_prio.keys()
+            )
+            for t in types:
+                p = st.hi_prio.get(t, ADLB_LOWEST_PRIO)
+                if p > best_prio:
+                    best_server, best_prio = s, p
+        if best_server >= 0:
+            self._send_rfr(entry, best_server, targeted_lookup=False, lookup_type=-1)
+
+    def _send_rfr(
+        self, entry: RqEntry, server: int, targeted_lookup: bool, lookup_type: int
+    ) -> None:
+        self._rfr_out.add(entry.world_rank)
+        self._ds_counters["rfrs"] += 1
+        self.ep.send(
+            server,
+            msg(
+                Tag.SS_RFR,
+                self.rank,
+                for_rank=entry.world_rank,
+                rqseqno=entry.rqseqno,
+                req_types=None if entry.req_types is None
+                else sorted(entry.req_types),
+                targeted_lookup=targeted_lookup,
+                lookup_type=lookup_type,
+            ),
+        )
+
+    def _on_rfr(self, m: Msg) -> None:
+        req_types = None if m.req_types is None else frozenset(m.req_types)
+        unit = self.wq.find_match(m.for_rank, req_types)
+        if unit is not None:
+            self.wq.pin(unit.seqno, m.for_rank)
+            # a handoff is in flight: counts as activity so the exhaustion
+            # double-pass cannot declare done around it
+            self.activity += 1
+            self._exhaust_held_since = None
+            self.ep.send(
+                m.src,
+                msg(
+                    Tag.SS_RFR_RESP,
+                    self.rank,
+                    found=True,
+                    for_rank=m.for_rank,
+                    rqseqno=m.rqseqno,
+                    seqno=unit.seqno,
+                    work_type=unit.work_type,
+                    prio=unit.prio,
+                    target_rank=unit.target_rank,
+                    work_len=unit.work_len,
+                    answer_rank=unit.answer_rank,
+                    common_len=unit.common_len,
+                    common_server=unit.common_server_rank,
+                    common_seqno=unit.common_seqno,
+                ),
+            )
+        else:
+            self.ep.send(
+                m.src,
+                msg(
+                    Tag.SS_RFR_RESP,
+                    self.rank,
+                    found=False,
+                    for_rank=m.for_rank,
+                    rqseqno=m.rqseqno,
+                    req_types=m.req_types,
+                    targeted_lookup=m.targeted_lookup,
+                    lookup_type=m.lookup_type,
+                ),
+            )
+
+    def _on_rfr_resp(self, m: Msg) -> None:
+        app = m.for_rank
+        self._rfr_out.discard(app)
+        if m.found:
+            entry = None
+            for cand in self.rq.entries():
+                if cand.world_rank == app:
+                    entry = cand
+                    break
+            if (
+                entry is None
+                or entry.rqseqno != m.rqseqno
+                or not entry.wants(m.work_type)
+            ):
+                # requester got satisfied (and possibly re-parked with a new
+                # request) while the RFR was in flight — compensate
+                # (reference SS_UNRESERVE, src/adlb.c:1949-1963)
+                self.ep.send(m.src, msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno))
+                return
+            if m.target_rank >= 0 and app == m.target_rank:
+                self.tq.remove(app, m.work_type, m.src)
+            self.rq.remove(app)
+            self._rfr_excluded.pop(app, None)
+            wait = time.monotonic() - entry.time_stamp
+            self._rq_wait_sum += wait
+            self._rq_wait_n += 1
+            self.activity += 1
+            handle = WorkHandle(
+                seqno=m.seqno,
+                server_rank=m.src,
+                common_len=m.common_len,
+                common_server_rank=m.common_server,
+                common_seqno=m.common_seqno,
+            )
+            self.ep.send(
+                app,
+                msg(
+                    Tag.TA_RESERVE_RESP,
+                    self.rank,
+                    rc=ADLB_SUCCESS,
+                    work_type=m.work_type,
+                    prio=m.prio,
+                    handle=handle.to_ints(),
+                    work_len=m.work_len,
+                    answer_rank=m.answer_rank,
+                ),
+            )
+        else:
+            # stale belief: patch it like the reference patches qmstat
+            # (src/adlb.c:1979-2005), strike the peer out for this requester,
+            # and retry an alternate candidate.
+            if m.targeted_lookup:
+                self.tq.remove(app, m.lookup_type, m.src)
+            else:
+                st = self.peers.get(m.src)
+                if st is not None:
+                    types = m.req_types if m.req_types is not None else list(
+                        st.hi_prio.keys()
+                    )
+                    for t in types:
+                        st.hi_prio[t] = ADLB_LOWEST_PRIO
+            self._rfr_excluded.setdefault(app, set()).add(m.src)
+            for cand in self.rq.entries():
+                if cand.world_rank == app:
+                    self._try_rfr(cand)
+                    break
+
+    def _on_unreserve(self, m: Msg) -> None:
+        unit = self.wq.get(m.seqno)
+        if unit is not None and unit.pinned:
+            self.wq.unpin(m.seqno)
+            self._match_rq()
+
+    # ------------------------------------------------------- push (memory)
+
+    def _try_push(self) -> None:
+        if self._push_offered:
+            return  # one outstanding push at a time
+        unit = self.wq.find_unpinned()
+        if unit is None:
+            return
+        target = None
+        for s, st in self.peers.items():
+            if s == self.rank:
+                continue
+            cap = self.cfg.max_malloc_per_server
+            if cap <= 0 or st.nbytes + len(unit.payload) <= 0.9 * cap:
+                if target is None or st.nbytes < self.peers[target].nbytes:
+                    target = s
+        if target is None:
+            return
+        self._push_seq += 1
+        qid = (self.rank << 20) | self._push_seq
+        self._push_offered[qid] = unit.seqno
+        self._ds_counters["pushes"] += 1
+        self.ep.send(
+            target,
+            msg(
+                Tag.SS_PUSH_QUERY,
+                self.rank,
+                query_id=qid,
+                nbytes=len(unit.payload),
+            ),
+        )
+
+    def _on_push_query(self, m: Msg) -> None:
+        ok = self.mem.has_room(m.nbytes)
+        if ok:
+            self.mem.alloc(m.nbytes)  # budget reserved until WORK or DEL
+            self._push_reserved[m.query_id] = m.nbytes
+        self.ep.send(
+            m.src,
+            msg(Tag.SS_PUSH_QUERY_RESP, self.rank, query_id=m.query_id, accept=ok),
+        )
+
+    def _on_push_query_resp(self, m: Msg) -> None:
+        seqno = self._push_offered.pop(m.query_id, None)
+        if seqno is None:
+            return
+        unit = self.wq.get(seqno)
+        if not m.accept:
+            return
+        if unit is None or unit.pinned:
+            # got reserved while the query was in flight — cancel (reference
+            # SS_PUSH_DEL, src/adlb.c:2182-2192)
+            self.ep.send(m.src, msg(Tag.SS_PUSH_DEL, self.rank, query_id=m.query_id))
+            return
+        self.wq.remove(seqno)
+        self.mem.free(len(unit.payload))
+        self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
+        if unit.target_rank >= 0:
+            home = self.world.home_server(unit.target_rank)
+            self.ep.send(
+                home,
+                msg(
+                    Tag.SS_MOVING_TARGETED_WORK,
+                    self.rank,
+                    app_rank=unit.target_rank,
+                    work_type=unit.work_type,
+                    from_server=self.rank,
+                    to_server=m.src,
+                ),
+            )
+        self.ep.send(
+            m.src,
+            msg(
+                Tag.SS_PUSH_WORK,
+                self.rank,
+                query_id=m.query_id,
+                payload=unit.payload,
+                work_type=unit.work_type,
+                prio=unit.prio,
+                target_rank=unit.target_rank,
+                answer_rank=unit.answer_rank,
+                home_server=unit.home_server,
+                common_len=unit.common_len,
+                common_server=unit.common_server_rank,
+                common_seqno=unit.common_seqno,
+                time_stamp=unit.time_stamp,
+            ),
+        )
+
+    def _on_push_work(self, m: Msg) -> None:
+        self._push_reserved.pop(m.query_id, None)  # budget now owned by the unit
+        unit = WorkUnit(
+            seqno=self._next_seqno,
+            work_type=m.work_type,
+            prio=m.prio,
+            target_rank=m.target_rank,
+            answer_rank=m.answer_rank,
+            payload=m.payload,
+            home_server=m.home_server,
+            common_len=m.common_len,
+            common_server_rank=m.common_server,
+            common_seqno=m.common_seqno,
+            time_stamp=m.time_stamp,
+        )
+        self._next_seqno += 1
+        self.wq.add(unit)
+        self.stats[InfoKey.NPUSHED_TO_HERE] += 1
+        self._match_rq()
+
+    def _on_push_del(self, m: Msg) -> None:
+        nbytes = self._push_reserved.pop(m.query_id, None)
+        if nbytes is not None:
+            self.mem.free(nbytes)
+
+    def _on_moving_targeted(self, m: Msg) -> None:
+        """Home-server directory fixup when targeted work migrates
+        (reference ``src/adlb.c:2071-2108``)."""
+        if m.from_server != self.rank:
+            self.tq.remove(m.app_rank, m.work_type, m.from_server)
+        if m.to_server != self.rank:
+            self.tq.add(m.app_rank, m.work_type, m.to_server)
+        # the target may be parked here and able to use it now
+        for cand in self.rq.entries():
+            if cand.world_rank == m.app_rank and cand.wants(m.work_type):
+                self._try_rfr(cand)
+                break
+
+    # ------------------------------------------------------- state sync
+
+    def _qmstat_entry(self) -> dict:
+        return {
+            "nbytes": self.mem.curr,
+            "qlen": self.wq.num_unpinned_untargeted(),
+            "hi_prio": {t: self.wq.hi_prio_of_type(t) for t in self.world.types},
+        }
+
+    def _broadcast_qmstat(self) -> None:
+        ent = self._qmstat_entry()
+        st = self.peers[self.rank]
+        st.nbytes, st.qlen, st.hi_prio = ent["nbytes"], ent["qlen"], ent["hi_prio"]
+        st.stamp = time.monotonic()
+        for s in self.world.server_ranks:
+            if s != self.rank:
+                self.ep.send(s, msg(Tag.SS_QMSTAT, self.rank, entry=ent))
+
+    def _on_qmstat(self, m: Msg) -> None:
+        st = self.peers[m.src]
+        st.nbytes = m.entry["nbytes"]
+        st.qlen = m.entry["qlen"]
+        st.hi_prio = dict(m.entry["hi_prio"])
+        st.stamp = time.monotonic()
+        # fresh evidence of work at this peer lifts any strike-out, else a
+        # requester could permanently ignore a peer that refilled later
+        if any(p > ADLB_LOWEST_PRIO for p in st.hi_prio.values()):
+            for excluded in self._rfr_excluded.values():
+                excluded.discard(m.src)
+        # fresh knowledge may unblock parked requesters (reference
+        # check_remote_work_for_queued_apps after qmstat, src/adlb.c:3536-3579)
+        for entry in self.rq.entries():
+            if entry.world_rank not in self._rfr_out:
+                self._try_rfr(entry)
+
+    # ------------------------------------------------------- balancer (tpu)
+
+    def _send_snapshot(self) -> None:
+        K = self.cfg.balancer_max_tasks
+        tasks = []
+        for u in self.wq.units():
+            if not u.pinned and u.target_rank < 0:
+                tasks.append((u.seqno, u.work_type, u.prio, u.work_len))
+                if len(tasks) >= K * 2:
+                    break
+        tasks.sort(key=lambda t: -t[2])
+        tasks = tasks[:K]
+        reqs = [
+            (
+                e.world_rank,
+                e.rqseqno,
+                None if e.req_types is None else sorted(e.req_types),
+            )
+            for e in self.rq.entries()
+            if e.world_rank not in self._rfr_out
+        ][: self.cfg.balancer_max_requesters]
+        snap = {
+            "tasks": tasks,
+            "reqs": reqs,
+            "nbytes": self.mem.curr,
+            "stamp": time.monotonic(),
+        }
+        if self.is_master:
+            self._snapshots[self.rank] = snap
+        else:
+            self.ep.send(
+                self.world.master_server_rank,
+                msg(Tag.SS_STATE, self.rank, snap=snap),
+            )
+
+    def _on_state(self, m: Msg) -> None:
+        self._snapshots[m.src] = m.snap
+
+    def _run_balancer_round(self) -> None:
+        if len(self._snapshots) < 1:
+            return
+        if self._solver is None:
+            from adlb_tpu.balancer.solve import AssignmentSolver
+
+            self._solver = AssignmentSolver(
+                types=self.world.types,
+                max_tasks=self.cfg.balancer_max_tasks,
+                max_requesters=self.cfg.balancer_max_requesters,
+            )
+        pairs = self._solver.solve(self._snapshots, self.world)
+        for holder, seqno, req_home, for_rank, rqseqno in pairs:
+            if holder == req_home:
+                continue  # local work reaches local requesters without a plan
+            self.ep.send(
+                holder,
+                msg(
+                    Tag.SS_PLAN_MATCH,
+                    self.rank,
+                    seqno=seqno,
+                    for_rank=for_rank,
+                    req_home=req_home,
+                    rqseqno=rqseqno,
+                ),
+            )
+
+    def _on_plan_match(self, m: Msg) -> None:
+        """Enact one plan entry: validate against live state, pin, and hand
+        off through the RFR response path (plan staleness compensated exactly
+        like RFR races)."""
+        unit = self.wq.get(m.seqno)
+        if unit is None or unit.pinned or unit.target_rank >= 0:
+            return  # stale plan entry; next round will re-plan
+        self.wq.pin(unit.seqno, m.for_rank)
+        self.activity += 1
+        self._exhaust_held_since = None
+        self.ep.send(
+            m.req_home,
+            msg(
+                Tag.SS_RFR_RESP,
+                self.rank,
+                found=True,
+                for_rank=m.for_rank,
+                rqseqno=m.rqseqno,
+                seqno=unit.seqno,
+                work_type=unit.work_type,
+                prio=unit.prio,
+                target_rank=unit.target_rank,
+                work_len=unit.work_len,
+                answer_rank=unit.answer_rank,
+                common_len=unit.common_len,
+                common_server=unit.common_server_rank,
+                common_seqno=unit.common_seqno,
+            ),
+        )
+
+    # ------------------------------------------------------- termination
+
+    def _flush_rq(self, rc: int) -> None:
+        for entry in self.rq.entries():
+            self.rq.remove(entry.world_rank)
+            self._reserve_resp(entry.world_rank, rc)
+
+    def _on_fa_no_more_work(self, m: Msg) -> None:
+        if self.no_more_work:
+            return
+        if self.is_master:
+            self._on_ss_no_more_work(m)
+        else:
+            self.ep.send(
+                self.world.master_server_rank, msg(Tag.SS_NO_MORE_WORK, self.rank)
+            )
+
+    def _on_ss_no_more_work(self, m: Msg) -> None:
+        if self.no_more_work:
+            return
+        self.no_more_work = True
+        if self.is_master:
+            for s in self.world.server_ranks:
+                if s != self.rank:
+                    self.ep.send(s, msg(Tag.SS_NO_MORE_WORK, self.rank))
+        self._flush_rq(ADLB_NO_MORE_WORK)
+
+    def _all_local_apps_parked(self) -> bool:
+        """True when no active local app is off the rq — vacuously true for a
+        server with no (remaining) local apps, so worlds where some server
+        homes zero apps can still exhaust."""
+        active = self.local_apps - self._finalized
+        return all(r in self.rq for r in active)
+
+    def _check_exhaustion(self, now: float) -> None:
+        """Master: if every app everywhere might be blocked, run the two-pass
+        ring confirmation (reference ``src/adlb.c:754-785,1575-1650``)."""
+        if self.no_more_work or self.done_by_exhaustion or self._exhaust_inflight:
+            return
+        if not self._all_local_apps_parked():
+            self._exhaust_held_since = None
+            return
+        if self._exhaust_held_since is None:
+            self._exhaust_held_since = now
+            return
+        if now - self._exhaust_held_since < self.cfg.exhaust_check_interval:
+            return
+        self._exhaust_inflight = True
+        token = {
+            "origin": self.rank,
+            "ok": True,
+            "act": {self.rank: self.activity},
+            "nparked": len(self.rq),
+        }
+        self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
+
+    def _forward_exhaust(self, tag: Tag, token: dict) -> None:
+        nxt = self.world.ring_next(self.rank)
+        if nxt == token["origin"]:
+            self.ep.send(nxt, msg(tag, self.rank, token=token, complete=True))
+        else:
+            self.ep.send(nxt, msg(tag, self.rank, token=token, complete=False))
+
+    def _on_exhaust_chk(self, m: Msg) -> None:
+        token = m.token
+        phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
+        if m.data.get("complete") and token["origin"] == self.rank:
+            # token made it all the way around
+            ok = (
+                token["ok"]
+                and token["nparked"] > 0
+                and self.activity == token["act"].get(self.rank, -1)
+            )
+            if not ok:
+                self._exhaust_held_since = None
+                self._exhaust_inflight = False
+                return
+            if phase1:
+                token2 = {
+                    "origin": self.rank,
+                    "ok": True,
+                    "act": token["act"],
+                    "nparked": token["nparked"],
+                }
+                self._forward_exhaust(Tag.SS_EXHAUST_CHK_2, token2)
+            else:
+                self._exhaust_inflight = False
+                self._declare_exhaustion()
+            return
+        # contribute and forward
+        if phase1:
+            token["ok"] = token["ok"] and self._all_local_apps_parked()
+            token["act"][self.rank] = self.activity
+            token["nparked"] = token.get("nparked", 0) + len(self.rq)
+        else:
+            token["ok"] = (
+                token["ok"]
+                and self._all_local_apps_parked()
+                and self.activity == token["act"].get(self.rank, -1)
+            )
+        self._forward_exhaust(m.tag, token)
+
+    def _declare_exhaustion(self) -> None:
+        for s in self.world.server_ranks:
+            if s != self.rank:
+                self.ep.send(s, msg(Tag.SS_DONE_BY_EXHAUSTION, self.rank))
+        self._on_done_by_exhaustion(msg(Tag.SS_DONE_BY_EXHAUSTION, self.rank))
+
+    def _on_done_by_exhaustion(self, m: Msg) -> None:
+        if self.done_by_exhaustion:
+            return
+        self.done_by_exhaustion = True
+        self._flush_rq(ADLB_DONE_BY_EXHAUSTION)
+
+    def _on_local_app_done(self, m: Msg) -> None:
+        self._finalized.add(m.src)
+        if self._finalized >= self.local_apps:
+            if self.is_master and not self._end1_pending:
+                self._end1_pending = True
+                self._forward_end1({"origin": self.rank})
+            elif self._end1_pending:
+                self._end1_pending = False
+                self._forward_end1(self._held_end1)
+
+    def _forward_end1(self, token: dict) -> None:
+        nxt = self.world.ring_next(self.rank)
+        self.ep.send(
+            nxt,
+            msg(Tag.SS_END_1, self.rank, token=token,
+                complete=(nxt == token["origin"])),
+        )
+
+    def _on_end_1(self, m: Msg) -> None:
+        token = m.token
+        if m.data.get("complete") and token["origin"] == self.rank:
+            # every server's local apps have finalized: circulate phase 2
+            nxt = self.world.ring_next(self.rank)
+            self.ep.send(
+                nxt,
+                msg(Tag.SS_END_2, self.rank, token=token,
+                    complete=(nxt == token["origin"])),
+            )
+            if self.world.nservers == 1:
+                self.done = True
+            return
+        if self._finalized >= self.local_apps:
+            self._forward_end1(token)
+        else:
+            # hold the token until our apps finish (reference held END_LOOP_1,
+            # src/adlb.c:1790-1798)
+            self._end1_pending = True
+            self._held_end1 = token
+
+    def _on_end_2(self, m: Msg) -> None:
+        token = m.token
+        self.done = True
+        if not m.data.get("complete"):
+            nxt = self.world.ring_next(self.rank)
+            self.ep.send(
+                nxt,
+                msg(Tag.SS_END_2, self.rank, token=token,
+                    complete=(nxt == token["origin"])),
+            )
+
+    # ------------------------------------------------------- abort / watchdog
+
+    def _on_fa_abort(self, m: Msg) -> None:
+        self._do_abort(m.data.get("code", -1), broadcast=True)
+
+    def _on_ss_abort(self, m: Msg) -> None:
+        self._do_abort(m.data.get("code", -1), broadcast=False)
+
+    def _do_abort(self, code: int, broadcast: bool) -> None:
+        if self._aborted:
+            return
+        self._aborted = True
+        if broadcast:
+            for s in self.world.server_ranks:
+                if s != self.rank:
+                    self.ep.send(s, msg(Tag.SS_ABORT, self.rank, code=code))
+        for app in self.local_apps:
+            self.ep.send(app, msg(Tag.TA_ABORT, self.rank, code=code))
+        if self._abort_event is not None:
+            self._abort_event.set()
+        self.done = True
+
+    def _send_ds_log(self) -> None:
+        ds = self.world.debug_server_rank
+        if ds is None:
+            return
+        self.ep.send(
+            ds,
+            msg(
+                Tag.DS_LOG,
+                self.rank,
+                counters=dict(self._ds_counters),
+                wq_count=self.wq.count,
+                rq_count=len(self.rq),
+                nbytes=self.mem.curr,
+            ),
+        )
+
+    def _notify_debug_server_end(self) -> None:
+        ds = self.world.debug_server_rank
+        if ds is not None:
+            self.ep.send(ds, msg(Tag.DS_END, self.rank))
+
+    # ------------------------------------------------------- stats surface
+
+    def finalize_stats(self) -> dict:
+        s = self.stats
+        s[InfoKey.MALLOC_HWM] = float(self.mem.hwm)
+        s[InfoKey.AVG_TIME_ON_RQ] = (
+            self._rq_wait_sum / self._rq_wait_n if self._rq_wait_n else 0.0
+        )
+        return {int(k): float(v) for k, v in s.items()}

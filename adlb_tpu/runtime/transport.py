@@ -1,0 +1,65 @@
+"""Transports: how ranks exchange protocol messages.
+
+The reference's substrate is MPI point-to-point with Iprobe polling
+(reference ``src/adlb.c:856-868``). Here a `Transport` is a per-rank endpoint
+with ``send(dest, msg)`` and ``recv(timeout)``; the server reactor stays a
+single-threaded poll loop, as in the reference.
+
+* `InProcFabric` — ranks are threads in one process, inboxes are queues.
+  This is the testing substrate (the reference's analogue is ``mpiexec -n k``
+  on one host, SURVEY §4) and the low-latency single-host runtime.
+* `TcpFabric` (transport_tcp.py) — ranks are processes, possibly on many
+  hosts, length-prefixed msgpack-ish frames over sockets.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional, Protocol
+
+from adlb_tpu.runtime.messages import Msg
+
+
+class Endpoint(Protocol):
+    rank: int
+
+    def send(self, dest: int, m: Msg) -> None: ...
+
+    def recv(self, timeout: Optional[float]) -> Optional[Msg]: ...
+
+
+class InProcEndpoint:
+    def __init__(self, fabric: "InProcFabric", rank: int) -> None:
+        self._fabric = fabric
+        self.rank = rank
+        self.inbox: "queue.SimpleQueue[Msg]" = queue.SimpleQueue()
+        self.bytes_sent = 0
+        self.msgs_sent = 0
+
+    def send(self, dest: int, m: Msg) -> None:
+        self.msgs_sent += 1
+        payload = m.data.get("payload")
+        if isinstance(payload, (bytes, bytearray)):
+            self.bytes_sent += len(payload)
+        self._fabric.endpoints[dest].inbox.put(m)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
+        try:
+            if timeout is None:
+                return self.inbox.get()
+            return self.inbox.get(timeout=max(timeout, 0.0))
+        except queue.Empty:
+            return None
+
+
+class InProcFabric:
+    """All ranks in one process; message passing via thread-safe queues."""
+
+    def __init__(self, nranks: int) -> None:
+        self.nranks = nranks
+        self.endpoints = [InProcEndpoint(self, r) for r in range(nranks)]
+        self.abort_event = threading.Event()
+
+    def endpoint(self, rank: int) -> InProcEndpoint:
+        return self.endpoints[rank]

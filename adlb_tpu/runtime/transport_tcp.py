@@ -1,0 +1,145 @@
+"""TCP transport: ranks as processes, possibly on many hosts.
+
+The multi-process analogue of the reference's MPI substrate (reference
+``src/adlb.c:44-83`` tag protocol over ``MPI_Send/Irecv``): every rank runs a
+tiny acceptor thread; messages are length-prefixed pickled frames over
+persistent sockets, delivered into the same inbox interface the in-process
+fabric uses, so the server reactor and client engine are transport-agnostic.
+
+Bootstrap mirrors ``jax.distributed``-style initialization: a rendezvous
+file or coordinator address maps rank -> (host, port). For single-host
+multi-process use, :func:`spawn_world` forks one process per rank.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+from adlb_tpu.runtime.messages import Msg
+
+_HDR = struct.Struct("<I")
+
+
+class TcpEndpoint:
+    """One rank's endpoint: an acceptor thread feeding an inbox, plus lazily
+    opened persistent outbound connections to peers."""
+
+    def __init__(self, rank: int, addr_map: dict[int, tuple[str, int]]) -> None:
+        self.rank = rank
+        self.addr_map = dict(addr_map)
+        self.inbox: "queue.SimpleQueue[Msg]" = queue.SimpleQueue()
+        self._out: dict[int, socket.socket] = {}
+        self._out_lock = threading.Lock()
+        self._closed = False
+
+        host, port = self.addr_map[rank]
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        # rebind may have picked an ephemeral port
+        self.addr_map[rank] = self._listener.getsockname()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"adlb-tcp-acceptor-{rank}"
+        )
+        self._acceptor.start()
+
+    @property
+    def port(self) -> int:
+        return self.addr_map[self.rank][1]
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._reader, args=(conn,), daemon=True
+            ).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._read_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (n,) = _HDR.unpack(hdr)
+                body = self._read_exact(conn, n)
+                if body is None:
+                    return
+                self.inbox.put(pickle.loads(body))
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def send(self, dest: int, m: Msg) -> None:
+        body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HDR.pack(len(body)) + body
+        with self._out_lock:
+            sock = self._out.get(dest)
+            if sock is None:
+                sock = socket.create_connection(self.addr_map[dest], timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._out[dest] = sock
+            try:
+                sock.sendall(frame)
+            except OSError:
+                # one reconnect attempt; beyond that the watchdog handles it
+                sock = socket.create_connection(self.addr_map[dest], timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._out[dest] = sock
+                sock.sendall(frame)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
+        try:
+            if timeout is None:
+                return self.inbox.get()
+            return self.inbox.get(timeout=max(timeout, 0.0))
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._out_lock:
+            for s in self._out.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._out.clear()
+
+
+def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str, int]]:
+    """Pick nranks free ports on one host (rendezvous for tests/single-host)."""
+    addr_map = {}
+    socks = []
+    for r in range(nranks):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        socks.append(s)
+        addr_map[r] = (host, s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return addr_map

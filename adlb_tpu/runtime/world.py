@@ -1,0 +1,127 @@
+"""World topology and run configuration.
+
+Role layout matches the reference (reference ``src/adlb.c:238-283``): given W
+ranks and S servers, ranks ``0..W-S-1`` (minus an optional trailing debug
+server) are app ranks, the next S are servers, and the optional last rank is
+the debug-server watchdog. Each app rank has a static *home server*
+``num_app_ranks + (rank % nservers)`` (reference ``src/adlb.c:257``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldSpec:
+    nranks: int
+    nservers: int
+    types: tuple[int, ...]
+    use_debug_server: bool = False
+
+    def __post_init__(self) -> None:
+        if self.nservers < 1:
+            raise ValueError("need at least one server rank")
+        extra = 1 if self.use_debug_server else 0
+        if self.nranks < self.nservers + extra + 1:
+            raise ValueError("need at least one app rank")
+        if len(set(self.types)) != len(self.types):
+            raise ValueError("duplicate work types")
+
+    @property
+    def num_app_ranks(self) -> int:
+        return self.nranks - self.nservers - (1 if self.use_debug_server else 0)
+
+    @property
+    def master_server_rank(self) -> int:
+        return self.num_app_ranks
+
+    @property
+    def server_ranks(self) -> range:
+        return range(self.num_app_ranks, self.num_app_ranks + self.nservers)
+
+    @property
+    def app_ranks(self) -> range:
+        return range(self.num_app_ranks)
+
+    @property
+    def debug_server_rank(self) -> Optional[int]:
+        return self.nranks - 1 if self.use_debug_server else None
+
+    def is_server(self, rank: int) -> bool:
+        return rank in self.server_ranks
+
+    def is_app(self, rank: int) -> bool:
+        return rank < self.num_app_ranks
+
+    def home_server(self, app_rank: int) -> int:
+        return self.num_app_ranks + (app_rank % self.nservers)
+
+    def local_apps(self, server_rank: int) -> list[int]:
+        """App ranks homed at this server."""
+        return [r for r in self.app_ranks if self.home_server(r) == server_rank]
+
+    def ring_next(self, server_rank: int) -> int:
+        """Server ring successor (reference rhs_rank, ``src/adlb.c:272-283``),
+        used by the termination/exhaustion token passes."""
+        i = server_rank - self.num_app_ranks
+        return self.num_app_ranks + (i + 1) % self.nservers
+
+    def validate_type(self, work_type: int) -> bool:
+        return work_type in self.types
+
+
+@dataclasses.dataclass
+class Config:
+    """Run-time knobs. The reference exposes these as ADLB_Init/Server
+    arguments and compile-time constants (reference ``src/adlb.c:93-96,165``;
+    ``USERGUIDE.txt:96-130``)."""
+
+    # "steal" = reference-style heuristics (qmstat gossip + RFR pull + memory
+    # push); "tpu" = periodic batched global assignment solve in JAX.
+    balancer: str = "steal"
+
+    max_malloc_per_server: float = 0.0  # 0 = unlimited (reference hi_malloc)
+    qmstat_interval: float = 0.05  # reference 0.1 s (src/adlb.c:165)
+    balancer_interval: float = 0.02  # TPU-mode snapshot->solve->plan period
+    exhaust_check_interval: float = 0.25  # reference 5 s (src/adlb.c:754-785)
+    periodic_log_interval: float = 0.0  # 0 = off
+    debug_log_interval: float = 1.0  # DS_LOG cadence (src/adlb.c:842-854)
+    debug_server_timeout: float = 30.0
+    put_max_retries: int = 10  # reference retry loop (src/adlb.c:2779-2796)
+    put_retry_sleep: float = 0.002
+    # Max queued tasks & waiting requesters per server in one balancer
+    # snapshot (fixed shapes for the jitted solve).
+    balancer_max_tasks: int = 256
+    balancer_max_requesters: int = 64
+    trace: bool = False  # event tracing hooks (reference MPE shims)
+
+    def __post_init__(self) -> None:
+        if self.balancer not in ("steal", "tpu"):
+            raise ValueError(f"unknown balancer mode {self.balancer!r}")
+
+
+def normalize_req_types(
+    req_types: Optional[Sequence[int]], valid: Sequence[int]
+) -> Optional[frozenset[int]]:
+    """Validate a Reserve request vector; None / [-1] means any type
+    (reference ADLB_RESERVE_REQUEST_ANY). Raises on unregistered types
+    (reference aborts, ``src/adlb.c:2893-2902``)."""
+    from adlb_tpu.types import ADLB_RESERVE_REQUEST_ANY, REQ_TYPE_VECT_SZ, AdlbError
+
+    if req_types is None:
+        return None
+    kept = []
+    for t in req_types:
+        if t == ADLB_RESERVE_REQUEST_ANY:
+            return None
+        kept.append(t)
+    if not kept:
+        return None
+    if len(kept) > REQ_TYPE_VECT_SZ:
+        raise AdlbError(f"reserve requests at most {REQ_TYPE_VECT_SZ} types")
+    for t in kept:
+        if t not in valid:
+            raise AdlbError(f"unregistered work type {t}")
+    return frozenset(kept)

@@ -1,0 +1,97 @@
+"""Core constants and value types.
+
+Return codes and info keys mirror the reference public API so that programs
+written against ADLB translate directly (reference ``include/adlb/adlb.h:16-40``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+ADLB_SUCCESS = 1
+ADLB_ERROR = -1
+ADLB_NO_MORE_WORK = -999999999
+ADLB_DONE_BY_EXHAUSTION = -999999998
+ADLB_NO_CURRENT_WORK = -999999997
+ADLB_PUT_REJECTED = -999999996
+ADLB_LOWEST_PRIO = -999999999
+
+ADLB_RESERVE_REQUEST_ANY = -1
+ADLB_RESERVE_EOL = -1
+ADLB_HANDLE_SIZE = 5
+
+# Max number of distinct types one Reserve may request, matching the
+# reference's REQ_TYPE_VECT_SZ (reference src/xq.h:37).
+REQ_TYPE_VECT_SZ = 16
+
+
+class InfoKey(enum.IntEnum):
+    """Statistics keys for ``Info_get`` (reference include/adlb/adlb.h:25-36)."""
+
+    MALLOC_HWM = 1
+    AVG_TIME_ON_RQ = 2
+    NPUSHED_FROM_HERE = 3
+    NPUSHED_TO_HERE = 4
+    NREJECTED_PUTS = 5
+    LOOP_TOP_TIME = 6
+    MAX_QMSTAT_TRIP_TIME = 7
+    AVG_QMSTAT_TRIP_TIME = 8
+    NUM_QMS_EXCEED_INT = 9
+    NUM_RESERVES = 10
+    NUM_RESERVES_PUT_ON_RQ = 11
+    MAX_WQ_COUNT = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkHandle:
+    """Opaque-ish handle returned by Reserve, consumed by Get_reserved.
+
+    Mirrors the reference's 5-int handle {wqseqno, holding server rank,
+    common_len, common_server_rank, common_seqno} (reference
+    src/adlb.c:2935-2947) so a reserved unit can be fetched directly from
+    whichever server holds it, and its batch-common prefix from wherever the
+    prefix was stored.
+    """
+
+    seqno: int
+    server_rank: int
+    common_len: int = 0
+    common_server_rank: int = -1
+    common_seqno: int = -1
+
+    def to_ints(self) -> list[int]:
+        return [
+            self.seqno,
+            self.server_rank,
+            self.common_len,
+            self.common_server_rank,
+            self.common_seqno,
+        ]
+
+    @staticmethod
+    def from_ints(v: list[int]) -> "WorkHandle":
+        return WorkHandle(v[0], v[1], v[2], v[3], v[4])
+
+
+@dataclasses.dataclass(frozen=True)
+class ReserveResult:
+    """Everything a successful Reserve reports back to the app."""
+
+    work_type: int
+    work_prio: int
+    handle: WorkHandle
+    work_len: int
+    answer_rank: int
+
+
+class AdlbError(RuntimeError):
+    """Raised for API misuse (invalid type, invalid handle, ...)."""
+
+
+class AdlbAborted(RuntimeError):
+    """Raised in every rank when some rank called Abort."""
+
+    def __init__(self, code: int):
+        super().__init__(f"ADLB aborted with code {code}")
+        self.code = code

@@ -1,0 +1,196 @@
+"""End-to-end tests of the minimum slice: Put/Reserve/Get, priorities,
+targeting, batch puts, Ireserve, explicit termination — single- and
+multi-server worlds on the in-process fabric."""
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import (
+    ADLB_DONE_BY_EXHAUSTION,
+    ADLB_NO_CURRENT_WORK,
+    ADLB_NO_MORE_WORK,
+    ADLB_SUCCESS,
+)
+
+TYPE_TASK = 1
+TYPE_RESULT = 2
+
+
+def _echo_world(nservers):
+    """Rank 0 produces, everyone consumes and echoes payloads back via
+    answer-routed results; rank 0 validates the sum."""
+
+    NTASK = 40
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(NTASK):
+                rc = ctx.put(str(i).encode(), TYPE_TASK, work_prio=i)
+                assert rc == ADLB_SUCCESS
+            total = 0
+            for _ in range(NTASK):
+                rc, r = ctx.reserve([TYPE_RESULT])
+                assert rc == ADLB_SUCCESS
+                rc, buf = ctx.get_reserved(r.handle)
+                assert rc == ADLB_SUCCESS
+                total += int(buf)
+            ctx.set_problem_done()
+            return total
+        else:
+            while True:
+                rc, r = ctx.reserve([TYPE_TASK])
+                if rc != ADLB_SUCCESS:
+                    assert rc in (ADLB_NO_MORE_WORK, ADLB_DONE_BY_EXHAUSTION)
+                    return None
+                rc, buf = ctx.get_reserved(r.handle)
+                assert rc == ADLB_SUCCESS
+                v = int(buf) * 2
+                ctx.put(str(v).encode(), TYPE_RESULT, target_rank=r.answer_rank)
+
+    # answer_rank defaults to -1; use targeting to route results to rank 0
+    def app2(ctx):
+        if ctx.rank == 0:
+            return app(ctx)
+        while True:
+            rc, r = ctx.reserve([TYPE_TASK])
+            if rc != ADLB_SUCCESS:
+                return None
+            rc, buf = ctx.get_reserved(r.handle)
+            v = int(buf) * 2
+            ctx.put(str(v).encode(), TYPE_RESULT, target_rank=0)
+        return None
+
+    res = run_world(4, nservers, [TYPE_TASK, TYPE_RESULT], app2)
+    assert res.app_results[0] == 2 * sum(range(NTASK))
+
+
+def test_single_server_end_to_end():
+    _echo_world(nservers=1)
+
+
+def test_multi_server_end_to_end():
+    _echo_world(nservers=3)
+
+
+def test_priority_order_observed():
+    """A single consumer must see strictly descending priorities when all
+    work is queued before the first reserve."""
+
+    prios = [3, 9, 1, 7, 5]
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in prios:
+                ctx.put(str(i).encode(), TYPE_TASK, work_prio=i)
+            # hand the consumer a go signal so ordering is deterministic
+            ctx.put(b"go", TYPE_RESULT, target_rank=1)
+            # wait for the consumer to finish before declaring done
+            rc, r = ctx.reserve([TYPE_RESULT])
+            assert rc == ADLB_SUCCESS
+            ctx.get_reserved(r.handle)
+            ctx.set_problem_done()
+            return None
+        got = []
+        rc, r = ctx.reserve([TYPE_RESULT])
+        assert rc == ADLB_SUCCESS
+        ctx.get_reserved(r.handle)
+        for _ in prios:
+            rc, r = ctx.reserve([TYPE_TASK])
+            assert rc == ADLB_SUCCESS
+            rc, buf = ctx.get_reserved(r.handle)
+            got.append(int(buf))
+        ctx.put(b"done", TYPE_RESULT, target_rank=0)
+        return got
+
+    res = run_world(2, 1, [TYPE_TASK, TYPE_RESULT], app)
+    assert res.app_results[1] == [9, 7, 5, 3, 1]
+
+
+def test_ireserve_no_current_work():
+    def app(ctx):
+        if ctx.rank == 0:
+            rc, r = ctx.ireserve([TYPE_TASK])
+            assert rc == ADLB_NO_CURRENT_WORK and r is None
+            ctx.put(b"x", TYPE_TASK)
+            rc, r = ctx.ireserve([TYPE_TASK])
+            assert rc == ADLB_SUCCESS
+            rc, buf = ctx.get_reserved(r.handle)
+            assert buf == b"x"
+            ctx.set_problem_done()
+        return True
+
+    res = run_world(1, 1, [TYPE_TASK], app)
+    assert res.app_results[0] is True
+
+
+def test_batch_common_prefix():
+    NPUT = 6
+
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.begin_batch_put(b"COMMON:")
+            for i in range(NPUT):
+                ctx.put(str(i).encode(), TYPE_TASK)
+            ctx.end_batch_put()
+            rc, r = ctx.reserve([TYPE_RESULT])  # consumer's completion signal
+            ctx.get_reserved(r.handle)
+            ctx.set_problem_done()
+            return None
+        got = []
+        for _ in range(NPUT):
+            rc, r = ctx.reserve([TYPE_TASK])
+            assert rc == ADLB_SUCCESS
+            assert r.work_len == len("COMMON:") + 1
+            rc, buf = ctx.get_reserved(r.handle)
+            assert buf.startswith(b"COMMON:")
+            got.append(int(buf[len(b"COMMON:"):]))
+        ctx.put(b"done", TYPE_RESULT, target_rank=0)
+        return sorted(got)
+
+    res = run_world(2, 2, [TYPE_TASK, TYPE_RESULT], app)
+    assert res.app_results[1] == list(range(NPUT))
+
+
+def test_explicit_termination_unblocks_waiters():
+    def app(ctx):
+        if ctx.rank == 0:
+            import time
+
+            time.sleep(0.1)
+            ctx.set_problem_done()
+            return "producer"
+        rc, r = ctx.reserve([TYPE_TASK])  # blocks until NO_MORE_WORK
+        assert rc == ADLB_NO_MORE_WORK
+        return "unblocked"
+
+    res = run_world(3, 2, [TYPE_TASK], app)
+    assert res.app_results[1] == "unblocked"
+    assert res.app_results[2] == "unblocked"
+
+
+def test_exhaustion_termination():
+    """All ranks block with no producer: the double-pass exhaustion protocol
+    must flush everyone with ADLB_DONE_BY_EXHAUSTION."""
+
+    def app(ctx):
+        rc, r = ctx.reserve([TYPE_TASK])
+        return rc
+
+    res = run_world(3, 2, [TYPE_TASK], app, cfg=Config(exhaust_check_interval=0.1))
+    assert all(rc == ADLB_DONE_BY_EXHAUSTION for rc in res.app_results.values())
+
+
+def test_info_num_work_units():
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.put(b"abc", TYPE_TASK)
+            ctx.put(b"de", TYPE_TASK)
+            rc, count, nbytes, _ = ctx.info_num_work_units(TYPE_TASK)
+            assert rc == ADLB_SUCCESS
+            assert count == 2
+            assert nbytes == 5
+            ctx.set_problem_done()
+        return True
+
+    run_world(1, 1, [TYPE_TASK], app)

@@ -1,0 +1,183 @@
+"""Unit tests for the indexed server queues.
+
+Property-checks the semantic contract inherited from the reference xq library
+(pinned/targeted exclusion, priority order, FIFO tie-break — reference
+src/xq.c:190-247,199-201,229-231).
+"""
+
+import random
+
+from adlb_tpu.runtime.queues import (
+    CommonStore,
+    MemoryAccountant,
+    ReserveQueue,
+    RqEntry,
+    TargetedDirectory,
+    WorkQueue,
+    WorkUnit,
+)
+from adlb_tpu.types import ADLB_LOWEST_PRIO
+
+
+def mk(seqno, wtype=1, prio=0, target=-1, payload=b"x", answer=-1):
+    return WorkUnit(
+        seqno=seqno,
+        work_type=wtype,
+        prio=prio,
+        target_rank=target,
+        answer_rank=answer,
+        payload=payload,
+    )
+
+
+def test_priority_order_and_fifo_tiebreak():
+    wq = WorkQueue()
+    wq.add(mk(1, prio=5))
+    wq.add(mk(2, prio=9))
+    wq.add(mk(3, prio=9))
+    wq.add(mk(4, prio=1))
+    u = wq.find_match(rank=0, req_types=None)
+    assert u.seqno == 2  # highest prio, earliest seqno
+    wq.remove(2)
+    assert wq.find_match(0, None).seqno == 3
+    wq.remove(3)
+    assert wq.find_match(0, None).seqno == 1
+
+
+def test_type_filtering():
+    wq = WorkQueue()
+    wq.add(mk(1, wtype=1, prio=1))
+    wq.add(mk(2, wtype=2, prio=100))
+    assert wq.find_match(0, frozenset([1])).seqno == 1
+    assert wq.find_match(0, frozenset([2])).seqno == 2
+    assert wq.find_match(0, frozenset([3])) is None
+    assert wq.find_match(0, None).seqno == 2
+
+
+def test_targeted_only_given_to_target_and_takes_precedence():
+    wq = WorkQueue()
+    wq.add(mk(1, prio=100))          # untargeted, high prio
+    wq.add(mk(2, prio=0, target=7))  # targeted at 7, low prio
+    # rank 7: targeted work wins even at lower priority (reference order)
+    assert wq.find_match(7, None).seqno == 2
+    # rank 3 never sees rank-7-targeted work
+    assert wq.find_match(3, None).seqno == 1
+    wq.remove(1)
+    assert wq.find_match(3, None) is None
+
+
+def test_pinned_invisible_and_unpin_restores():
+    wq = WorkQueue()
+    wq.add(mk(1, prio=5))
+    wq.pin(1, rank=3)
+    assert wq.find_match(0, None) is None
+    assert wq.num_unpinned_untargeted() == 0
+    wq.unpin(1)
+    assert wq.find_match(0, None).seqno == 1
+
+
+def test_hi_prio_of_type_tracks_available_only():
+    wq = WorkQueue()
+    assert wq.hi_prio_of_type(1) == ADLB_LOWEST_PRIO
+    wq.add(mk(1, wtype=1, prio=4))
+    wq.add(mk(2, wtype=1, prio=9, target=5))  # targeted: not in qmstat cell
+    assert wq.hi_prio_of_type(1) == 4
+    wq.pin(1, 0)
+    assert wq.hi_prio_of_type(1) == ADLB_LOWEST_PRIO
+
+
+def test_randomized_against_naive_model():
+    rng = random.Random(1234)
+    wq = WorkQueue()
+    model: dict[int, WorkUnit] = {}
+    seqno = 0
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.5 or not model:
+            seqno += 1
+            u = mk(
+                seqno,
+                wtype=rng.randint(1, 3),
+                prio=rng.randint(-5, 5),
+                target=rng.choice([-1, -1, -1, 0, 1]),
+            )
+            wq.add(u)
+            model[seqno] = u
+        elif op < 0.75:
+            rank = rng.randint(0, 1)
+            req = rng.choice([None, frozenset([1]), frozenset([2, 3])])
+            got = wq.find_match(rank, req)
+            # naive: targeted-first then untargeted, max prio, min seqno
+            def naive(pred):
+                cands = [
+                    u for u in model.values()
+                    if not u.pinned and pred(u)
+                    and (req is None or u.work_type in req)
+                ]
+                return min(cands, key=lambda u: (-u.prio, u.seqno)) if cands else None
+            want = naive(lambda u: u.target_rank == rank) or naive(
+                lambda u: u.target_rank < 0
+            )
+            assert (got is None) == (want is None)
+            if got is not None:
+                assert got.seqno == want.seqno
+        elif op < 0.9:
+            s = rng.choice(list(model))
+            if not model[s].pinned:
+                wq.pin(s, 0)
+                model[s].pinned = True
+            else:
+                wq.unpin(s)
+                model[s].pinned = False
+        else:
+            s = rng.choice(list(model))
+            wq.remove(s)
+            del model[s]
+    assert wq.count == len(model)
+
+
+def test_reserve_queue_fifo_and_type_match():
+    rq = ReserveQueue()
+    rq.add(RqEntry(world_rank=3, rqseqno=1, req_types=frozenset([2])))
+    rq.add(RqEntry(world_rank=1, rqseqno=2, req_types=None))
+    assert rq.find_for_type(2).world_rank == 3  # FIFO: rank 3 parked first
+    assert rq.find_for_type(9).world_rank == 1  # only the any-type waiter
+    assert rq.find_for_type(2, target_rank=1).world_rank == 1
+    assert rq.find_for_type(2, target_rank=5) is None
+    rq.remove(3)
+    assert rq.find_for_type(2).world_rank == 1
+
+
+def test_targeted_directory():
+    tq = TargetedDirectory()
+    tq.add(app_rank=4, work_type=1, server_rank=10)
+    tq.add(app_rank=4, work_type=1, server_rank=10)
+    assert tq.lookup(4, None) == (10, 1)
+    assert tq.lookup(4, frozenset([2])) is None
+    tq.remove(4, 1, 10)
+    assert tq.lookup(4, None) == (10, 1)
+    tq.remove(4, 1, 10)
+    assert tq.lookup(4, None) is None
+
+
+def test_common_store_gc():
+    cq = CommonStore()
+    s = cq.put(b"prefix")
+    assert cq.get(s) == b"prefix"
+    assert len(cq) == 1  # refcnt unknown: no GC yet
+    cq.set_refcnt(s, 3)
+    assert len(cq) == 1
+    cq.get(s)
+    cq.get(s)
+    assert len(cq) == 0  # ngets == refcnt -> GC'd
+
+
+def test_memory_accountant():
+    m = MemoryAccountant(max_bytes=100)
+    assert m.try_alloc(60)
+    assert not m.try_alloc(50)  # over cap -> put rejected
+    assert m.try_alloc(40)
+    assert m.under_pressure
+    m.free(60)
+    assert not m.under_pressure
+    assert m.hwm == 100
