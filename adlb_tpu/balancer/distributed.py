@@ -3,21 +3,28 @@
 SPMD decomposition of :mod:`adlb_tpu.balancer.solve` over a
 ``jax.sharding.Mesh``: the task table — the big axis, scaling with servers x
 queue depth — is sharded over mesh axis ``"s"``; the requester table — small,
-bounded by world size — is replicated via ``all_gather``. Each auction round:
+bounded by world size — is replicated. Each round:
 
-1. every device scores its *local* task shard against all requesters and
-   reduces to each requester's best local (score, task);
-2. one ``all_gather`` of the per-device bests resolves the global winner
-   device per requester (ICI traffic: S x NR x 2 ints per round, a few KB);
-3. the winning device commits assignments for the requesters it won, with
-   local scatter-min conflict resolution among requesters that picked the
-   same task;
-4. an ``all_gather`` of requester-assigned flags closes the round.
+1. every device runs the *local* sequential greedy over its own task shard
+   (descending priority, first open compatible requester), producing at most
+   one proposal per requester;
+2. one ``all_gather`` of per-device proposal priorities resolves the global
+   winner device per requester (ICI traffic: S x NR ints per round, KBs);
+3. the winning device commits its proposals; losing devices keep their tasks
+   and re-propose next round; a ``psum`` merges the round's assignments.
+
+Rounds progress monotonically (any open requester with any open compatible
+task somewhere gets a winner), so `rounds >= requesters` reaches the maximal
+fixpoint; in practice a handful of rounds match almost everything, and
+leftovers are re-planned by the next balancer tick. The exact cross-shard
+pairing may differ from the single-device scan — parallel rounds instead of
+one sequential global order — which the protocol absorbs: plan entries are
+hints validated against live server state at enactment.
 
 This replaces the reference's qmstat ring gossip (reference
 ``src/adlb.c:806-822,1705-1757``): instead of an O(0.1 s) staleness window on
-an approximate load vector, the whole queue state is solved exactly every
-round, and scale comes from adding devices along ``"s"``.
+an approximate load vector, the whole queue state is solved every round, and
+scale comes from adding devices along ``"s"``.
 """
 
 from __future__ import annotations
@@ -39,67 +46,101 @@ except ImportError:  # pragma: no cover
 from adlb_tpu.balancer.solve import _NEG
 
 
+def _mark_varying(x, axis: str):
+    """Tag an array as device-varying for shard_map's vma tracking
+    (jax.lax.pcast on new jax, pvary on older)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis,), to="varying")
+    return jax.lax.pvary(x, (axis,))
+
+
+def _local_greedy_proposals(
+    task_prio: jax.Array,  # [Kl] this device's task shard (flattened)
+    task_type: jax.Array,  # [Kl]
+    req_mask: jax.Array,  # [NR, T] replicated
+    open_req: jax.Array,  # [NR] bool
+    task_taken: jax.Array,  # [Kl] bool, local
+    axis: str,
+):
+    """Local sequential greedy: this device's open tasks, in descending
+    priority, each propose to the first open compatible requester. Returns
+    (proposal_task[NR] local idx or -1, proposal_prio[NR])."""
+    Kl = task_prio.shape[0]
+    NR = req_mask.shape[0]
+    ridx = jnp.arange(NR, dtype=jnp.int32)
+    eff_prio = jnp.where(task_taken, _NEG, task_prio)
+    order = jnp.argsort(-eff_prio, stable=True)
+
+    def step(carry, t_idx):
+        open_r, prop_task, prop_prio = carry
+        prio = eff_prio[t_idx]
+        ttype = task_type[t_idx]
+        compat = (
+            open_r
+            & (prio > _NEG)
+            & (ttype >= 0)
+            & req_mask[:, jnp.clip(ttype, 0)]
+        )
+        r = jnp.argmax(compat)
+        found = compat[r]
+        hit = found & (ridx == r)
+        open_r = open_r & ~hit
+        prop_task = jnp.where(hit, t_idx.astype(jnp.int32), prop_task)
+        prop_prio = jnp.where(hit, prio, prop_prio)
+        return (open_r, prop_task, prop_prio), None
+
+    init = (
+        open_req,
+        _mark_varying(jnp.full((NR,), -1, dtype=jnp.int32), axis),
+        _mark_varying(jnp.full((NR,), _NEG, dtype=jnp.int32), axis),
+    )
+    (_, prop_task, prop_prio), _ = jax.lax.scan(step, init, order)
+    return prop_task, prop_prio
+
+
 def _local_round_body(
     task_prio: jax.Array,  # [Kl] this device's task shard
     task_type: jax.Array,  # [Kl]
     req_mask: jax.Array,  # [NR, T] replicated
     req_valid: jax.Array,  # [NR] replicated
-    assign_flag: jax.Array,  # [NR] bool, replicated
+    assign_flag: jax.Array,  # [NR] bool
     task_taken: jax.Array,  # [Kl] bool, local
     axis: str,
 ):
+    """One round: full local greedy matching per device, then global
+    per-requester conflict resolution (max proposal priority wins; lowest
+    device id on ties). Losing devices keep their tasks and retry next
+    round, so a handful of rounds converge even when one device holds all
+    the best work."""
     NR = req_mask.shape[0]
     Kl = task_prio.shape[0]
     my = jax.lax.axis_index(axis)
 
-    compat = jnp.where(
-        (task_type[None, :] >= 0) & req_valid[:, None],
-        jnp.take_along_axis(
-            req_mask, jnp.clip(task_type, 0)[None, :].repeat(NR, 0), axis=1
-        ),
-        False,
-    )  # [NR, Kl]
     open_req = (~assign_flag) & req_valid
-    score = jnp.where(
-        compat & open_req[:, None] & (~task_taken)[None, :],
-        task_prio[None, :],
-        _NEG,
-    )  # [NR, Kl]
-    best_local_task = jnp.argmax(score, axis=1)  # [NR]
-    best_local_score = jnp.max(score, axis=1)  # [NR]
-
-    # Which device offers each requester its best task? Gather per-device
-    # bests (small: [S, NR]) and pick the max score, lowest device id on ties.
-    all_scores = jax.lax.all_gather(best_local_score, axis)  # [S, NR]
-    winner_dev = jnp.argmax(all_scores, axis=0)  # [NR]
-    global_best = jnp.max(all_scores, axis=0)
-    i_won = (winner_dev == my) & (global_best > _NEG)  # [NR]
-
-    # Local conflict resolution among requesters I won that chose the same
-    # local task: lowest requester index wins (deterministic, matches the
-    # single-chip auction).
-    ridx = jnp.arange(NR, dtype=jnp.int32)
-    bids = jnp.where(i_won, ridx, jnp.int32(NR))
-    task_winner = (
-        jnp.full((Kl,), NR, dtype=jnp.int32)
-        .at[jnp.where(i_won, best_local_task, 0)]
-        .min(bids)
+    prop_task, prop_prio = _local_greedy_proposals(
+        task_prio, task_type, req_mask, open_req, task_taken, axis
     )
-    committed = i_won & (task_winner[best_local_task] == ridx)  # [NR]
-    task_taken = task_taken.at[jnp.where(committed, best_local_task, Kl)].set(
+
+    # global winner per requester: [S, NR] gather of proposal priorities
+    all_prio = jax.lax.all_gather(prop_prio, axis)  # [S, NR]
+    winner_dev = jnp.argmax(all_prio, axis=0)  # lowest device on ties
+    global_best = jnp.max(all_prio, axis=0)
+    committed = (
+        (winner_dev == my) & (global_best > _NEG) & (prop_task >= 0) & open_req
+    )
+    task_taken = task_taken.at[jnp.where(committed, prop_task, Kl)].set(
         True, mode="drop"
     )
-    # global task id = device * Kl + local index
     new_assign = jnp.where(
-        committed, (my * Kl + best_local_task).astype(jnp.int32), jnp.int32(-1)
+        committed, my.astype(jnp.int32) * Kl + prop_task, jnp.int32(-1)
     )
-    # every device learns which requesters got assigned this round
-    any_committed = jax.lax.all_gather(committed, axis).any(axis=0)
-    assign_flag = assign_flag | any_committed
+    any_committed = global_best > _NEG  # a winner exists for these requesters
+    assign_flag = assign_flag | (any_committed & open_req)
     return assign_flag, task_taken, new_assign
 
 
-def build_distributed_solver(mesh: Mesh, rounds: int = 6, axis: str = "s"):
+def build_distributed_solver(mesh: Mesh, rounds: int = 16, axis: str = "s"):
     """Returns a jitted fn(task_prio [S,K], task_type [S,K], req_mask [NR,T],
     req_valid [NR]) -> assign [rounds, NR] of global task ids (-1 = none),
     with the task tables sharded over `axis` of `mesh`."""
@@ -132,8 +173,8 @@ def build_distributed_solver(mesh: Mesh, rounds: int = 6, axis: str = "s"):
 
             assign0 = jnp.full((NR,), -1, dtype=jnp.int32)
             # mark device-varying carries for the new shard_map vma tracking
-            flag0 = jax.lax.pvary(jnp.zeros((NR,), dtype=bool), (axis,))
-            taken0 = jax.lax.pvary(jnp.zeros(tp.shape, dtype=bool), (axis,))
+            flag0 = _mark_varying(jnp.zeros((NR,), dtype=bool), axis)
+            taken0 = _mark_varying(jnp.zeros(tp.shape, dtype=bool), axis)
             (flag, taken, assign), _ = jax.lax.scan(
                 body, (flag0, taken0, assign0), None, length=rounds
             )
@@ -162,7 +203,7 @@ class DistributedAssignmentSolver:
         max_tasks_per_server: int,
         max_requesters: int,
         mesh: Mesh,
-        rounds: int = 6,
+        rounds: int = 16,
         servers_per_device: int = 1,
     ) -> None:
         self.types = tuple(types)

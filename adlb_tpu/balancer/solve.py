@@ -7,20 +7,19 @@ does not fit is simply handled next round — staleness is already part of the
 protocol contract (plan entries are validated against live state at
 enactment, like the reference's push/RFR races, ``src/adlb.c:2182-2192``).
 
-Algorithm: synchronous auction rounds, the classic parallelizable relaxation
-of bipartite matching (Bertsekas). Each round, every unassigned requester
-bids for its best compatible unassigned task (priority-ordered, matching the
-reference's algebraically-largest-``work_prio`` contract); ties are broken by
-requester index via a scatter-min, winners are committed, and the round
-repeats. Every round commits at least one assignment, and in practice almost
-everything lands in the first rounds, so a small fixed round count suffices
-for the fixed shapes involved.
+Algorithm (single device): exact sequential greedy under ``lax.scan`` — tasks
+in descending priority order (stable, so FIFO on ties, matching the
+reference's algebraically-largest-``work_prio`` + seqno contract), each
+taking the first open compatible requester. One scan step is O(NR) vector
+work; the whole solve is one fused loop on device. This is exactly the
+matching the reference's per-server ``wq_find_hi_prio`` loop would produce if
+it could see every server's queue at once (reference ``src/xq.c:190-247``) —
+which is the point: same semantics, global scope, O(1) staleness.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -28,80 +27,116 @@ import jax
 import jax.numpy as jnp
 
 # Sentinel far below any real priority (int32-safe; real priorities are
-# clipped to +/-1e9, reference priorities are C ints).
-_NEG = jnp.int32(-(2**31) + 1)
+# clipped to +/-1e9, reference priorities are C ints). A plain int, NOT a
+# jnp scalar: materializing a device array at import would initialize the
+# accelerator backend for every importer, including ones that only ever use
+# the numpy host path (and a wedged accelerator tunnel would hang them).
+_NEG = -(2**31) + 1
 _PRIO_CLIP = 10**9
 
 
-@functools.partial(jax.jit, static_argnames=("rounds",))
-def _auction_assign(
+@jax.jit
+def _greedy_assign(
     task_prio: jax.Array,  # [NT] int32, _NEG for padding
     task_type: jax.Array,  # [NT] int32 type *index*, -1 for padding
     req_mask: jax.Array,  # [NR, T] bool: requester accepts type index
     req_valid: jax.Array,  # [NR] bool
-    rounds: int = 6,
 ) -> jax.Array:
     """Returns assign[NR] int32: task index assigned to each requester, -1 if none."""
     NT = task_prio.shape[0]
     NR = req_mask.shape[0]
+    ridx = jnp.arange(NR, dtype=jnp.int32)
 
-    # [NR, NT] compatibility: requester r accepts task t's type
-    compat = jnp.where(
-        (task_type[None, :] >= 0) & req_valid[:, None],
-        jnp.take_along_axis(
-            req_mask, jnp.clip(task_type, 0)[None, :].repeat(NR, 0), axis=1
-        ),
-        False,
+    # descending priority, stable (ties resolve to lower task index = seqno)
+    order = jnp.argsort(-task_prio, stable=True)
+
+    def step(open_req, t_idx):
+        prio = task_prio[t_idx]
+        ttype = task_type[t_idx]
+        compat = (
+            open_req
+            & req_valid
+            & (prio > _NEG)
+            & (ttype >= 0)
+            & req_mask[:, jnp.clip(ttype, 0)]
+        )
+        r = jnp.argmax(compat)  # first open compatible requester
+        found = compat[r]
+        open_req = open_req & ~(found & (ridx == r))
+        return open_req, jnp.where(found, r.astype(jnp.int32), jnp.int32(-1))
+
+    open0 = jnp.ones((NR,), dtype=bool)
+    _, winner_per_task = jax.lax.scan(step, open0, order)
+    # invert: winner_per_task[k] is the requester chosen for task order[k]
+    # (-1 = none). Requesters win at most once, so the scatter is 1-1.
+    valid = winner_per_task >= 0
+    assign = jnp.full((NR,), -1, dtype=jnp.int32)
+    assign = assign.at[jnp.where(valid, winner_per_task, NR)].set(
+        jnp.where(valid, order.astype(jnp.int32), -1), mode="drop"
     )
+    return assign
 
-    def one_round(state, _):
-        assign, task_taken = state
-        open_req = (assign < 0) & req_valid
-        open_task = ~task_taken
-        # score[r, t]: priority if biddable else sentinel
-        score = jnp.where(
-            compat & open_req[:, None] & open_task[None, :],
-            task_prio[None, :],
-            _NEG,
-        )
-        best_task = jnp.argmax(score, axis=1)  # [NR]
-        best_score = jnp.max(score, axis=1)
-        bidding = best_score > _NEG
-        # conflict resolution: lowest requester index wins each task
-        ridx = jnp.arange(NR, dtype=jnp.int32)
-        bids = jnp.where(bidding, ridx, jnp.int32(NR))
-        winner = (
-            jnp.full((NT,), NR, dtype=jnp.int32)
-            .at[jnp.where(bidding, best_task, 0)]
-            .min(jnp.where(bidding, bids, jnp.int32(NR)))
-        )
-        won = bidding & (winner[best_task] == ridx)
-        assign = jnp.where(won, best_task.astype(jnp.int32), assign)
-        task_taken = task_taken.at[jnp.where(won, best_task, NT)].set(
-            True, mode="drop"
-        )
-        return (assign, task_taken), None
 
-    assign0 = jnp.full((NR,), -1, dtype=jnp.int32)
-    taken0 = jnp.zeros((NT,), dtype=bool)
-    (assign, _), _ = jax.lax.scan(one_round, (assign0, taken0), None, length=rounds)
+def _auction_assign(task_prio, task_type, req_mask, req_valid, rounds=6):
+    """Back-compat alias (the greedy scan superseded the bid auction, which
+    converged one-task-per-type-per-round under crowding)."""
+    del rounds
+    return _greedy_assign(task_prio, task_type, req_mask, req_valid)
+
+
+def _host_greedy(task_prio, task_type, req_mask, req_valid):
+    """Numpy twin of :func:`_greedy_assign` — bit-identical semantics, used
+    below a size threshold where an accelerator dispatch round-trip costs
+    more than the whole solve. Early-exits once every requester is matched,
+    so typical cost is O(matched * NR)."""
+    NR = req_mask.shape[0]
+    assign = np.full((NR,), -1, dtype=np.int32)
+    open_req = req_valid.copy()
+    n_open = int(open_req.sum())
+    if n_open == 0:
+        return assign
+    order = np.argsort(-task_prio, kind="stable")
+    for t in order:
+        prio = task_prio[t]
+        if prio <= int(_NEG):
+            break  # rest is padding
+        tt = task_type[t]
+        if tt < 0:
+            continue
+        compat = open_req & req_mask[:, tt]
+        r = int(np.argmax(compat))
+        if not compat[r]:
+            continue
+        assign[r] = t
+        open_req[r] = False
+        n_open -= 1
+        if n_open == 0:
+            break
     return assign
 
 
 class AssignmentSolver:
     """Host-side wrapper: packs per-server snapshots into fixed-shape arrays,
-    runs the jitted auction, unpacks plan entries."""
+    runs the greedy solve, unpacks plan entries.
+
+    Adaptive placement: instances with few live requesters run the numpy twin
+    on the host (an accelerator dispatch round-trip would dominate); larger
+    instances run the jitted scan on device. Both produce the identical
+    matching (same greedy order), so the threshold is purely a latency
+    knob."""
 
     def __init__(
         self, types: Sequence[int], max_tasks: int, max_requesters: int,
-        rounds: int = 6,
+        rounds: int = 6, host_threshold_reqs: Optional[int] = 64,
     ) -> None:
         self.types = tuple(types)
         self.type_index = {t: i for i, t in enumerate(self.types)}
         self.K = max_tasks
         self.R = max_requesters
         self.rounds = rounds
+        self.host_threshold_reqs = host_threshold_reqs
         self.solve_count = 0
+        self.host_solve_count = 0
 
     def solve(self, snapshots: dict, world) -> list:
         """snapshots: server_rank -> {"tasks": [(seqno, type, prio, len)...],
@@ -139,18 +174,25 @@ class AssignmentSolver:
                             req_mask[i, ti] = True
                 req_ref[i] = (s, rank, rqseqno)
 
-        if not req_valid.any() or (task_type < 0).all():
+        n_reqs = int(req_valid.sum())
+        if n_reqs == 0 or (task_type < 0).all():
             return []
 
-        assign = np.asarray(
-            _auction_assign(
-                jnp.asarray(task_prio),
-                jnp.asarray(task_type),
-                jnp.asarray(req_mask),
-                jnp.asarray(req_valid),
-                rounds=self.rounds,
+        if (
+            self.host_threshold_reqs is not None
+            and n_reqs <= self.host_threshold_reqs
+        ):
+            assign = _host_greedy(task_prio, task_type, req_mask, req_valid)
+            self.host_solve_count += 1
+        else:
+            assign = np.asarray(
+                _greedy_assign(
+                    jnp.asarray(task_prio),
+                    jnp.asarray(task_type),
+                    jnp.asarray(req_mask),
+                    jnp.asarray(req_valid),
+                )
             )
-        )
         self.solve_count += 1
 
         pairs = []

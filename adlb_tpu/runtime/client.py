@@ -107,6 +107,8 @@ class Client:
 
         if target_rank >= 0:
             server = self.world.home_server(target_rank)
+        elif self.cfg.put_routing == "home":
+            server = self.home
         else:
             server = self._next_server()
         attempts = 0

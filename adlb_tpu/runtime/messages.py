@@ -66,6 +66,9 @@ class Tag(enum.Enum):
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()
     SS_PLAN_MATCH = enum.auto()
+    SS_PLAN_MIGRATE = enum.auto()  # planner: move these units to dest
+    SS_MIGRATE_WORK = enum.auto()  # holder -> dest: the moved units
+    SS_MIGRATE_ACK = enum.auto()  # dest -> holder: units landed (or bounced)
 
     # debug server
     DS_LOG = enum.auto()

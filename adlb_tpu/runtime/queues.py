@@ -219,6 +219,12 @@ class WorkQueue:
 
     # -- stats for gossip / balancer -----------------------------------------
 
+    def num_unpinned(self) -> int:
+        """All unpinned units — used by the exhaustion check: a server with
+        deliverable work left cannot vote 'exhausted', else a slow balancing
+        path could lose a race against the double ring pass and strand work."""
+        return sum(1 for u in self._units.values() if not u.pinned)
+
     def num_unpinned_untargeted(self) -> int:
         return sum(
             1 for u in self._units.values() if not u.pinned and u.target_rank < 0

@@ -28,6 +28,7 @@ detection, held two-phase shutdown) follow the reference's ring-token designs
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -54,6 +55,170 @@ from adlb_tpu.types import (
     InfoKey,
     WorkHandle,
 )
+
+
+class _BalancerWorker(threading.Thread):
+    """The balancer brain, off the reactor thread.
+
+    The solve's device round-trip (notably over a remote-TPU tunnel, where
+    dispatch is milliseconds and first compile is tens of seconds) must never
+    block the master's protocol loop, so the master only *updates snapshots*
+    and wakes this thread; the thread coalesces to the latest state, solves,
+    and sends SS_PLAN_MATCH messages itself (endpoint sends are
+    thread-safe). Plan staleness this introduces is already handled by
+    enactment-time validation.
+
+    Re-planning storms are suppressed by remembering when each requester/task
+    was last planned: both stay ineligible until a *fresh* snapshot (stamp
+    newer than the plan) shows them still parked/queued.
+    """
+
+    def __init__(self, server: "Server") -> None:
+        super().__init__(daemon=True, name=f"adlb-balancer-{server.rank}")
+        self.server = server
+        self.wake = threading.Event()
+        self.stopped = False
+        self._planned_reqs: dict[tuple, float] = {}
+        self._planned_tasks: dict[tuple, float] = {}
+
+    def stop(self) -> None:
+        self.stopped = True
+        self.wake.set()
+
+    def run(self) -> None:
+        s = self.server
+        from adlb_tpu.balancer.solve import AssignmentSolver
+
+        solver = AssignmentSolver(
+            types=s.world.types,
+            max_tasks=s.cfg.balancer_max_tasks,
+            max_requesters=s.cfg.balancer_max_requesters,
+        )
+        s._solver = solver
+        while True:
+            self.wake.wait(timeout=0.25)
+            self.wake.clear()
+            if self.stopped or s.done:
+                return
+            snaps = dict(s._snapshots)
+            if not snaps:
+                continue
+            now = time.monotonic()
+            filtered = {}
+            for rank, snap in snaps.items():
+                stamp = snap.get("stamp", now)
+                reqs = [
+                    r for r in snap["reqs"]
+                    if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
+                ]
+                tasks = [
+                    t for t in snap["tasks"]
+                    if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
+                ]
+                filtered[rank] = {"tasks": tasks, "reqs": reqs}
+            if any(sn["reqs"] for sn in filtered.values()):
+                pairs = solver.solve(filtered, s.world)
+            else:
+                pairs = []  # nobody parked; still consider migrations below
+            t_planned = time.monotonic()
+            for holder, seqno, req_home, for_rank, rqseqno in pairs:
+                if holder == req_home:
+                    continue
+                self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
+                self._planned_tasks[(holder, seqno)] = t_planned
+                s.ep.send(
+                    holder,
+                    msg(
+                        Tag.SS_PLAN_MATCH,
+                        s.rank,
+                        seqno=seqno,
+                        for_rank=for_rank,
+                        req_home=req_home,
+                        rqseqno=rqseqno,
+                    ),
+                )
+            planned_away = {}
+            for holder, seqno, req_home, for_rank, rqseqno in pairs:
+                planned_away.setdefault(holder, set()).add(seqno)
+            self._plan_migrations(filtered, planned_away, t_planned)
+            # bound the memory of the plan ledgers
+            if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
+                cutoff = t_planned - 5.0
+                self._planned_reqs = {
+                    k: v for k, v in self._planned_reqs.items() if v > cutoff
+                }
+                self._planned_tasks = {
+                    k: v for k, v in self._planned_tasks.items() if v > cutoff
+                }
+            if s.cfg.balancer_min_gap > 0:
+                time.sleep(s.cfg.balancer_min_gap)
+
+    def _plan_migrations(
+        self, filtered: dict, planned_away: dict, t_planned: float
+    ) -> None:
+        """Demand-weighted inventory placement: top servers with hungry
+        consumers and empty shelves up from surplus servers, so the next
+        reserve matches locally instead of paying a cross-server round-trip.
+        The reference can only move work under memory pressure (reference
+        ``src/adlb.c:509-556``); a global planner moves it toward demand."""
+        s = self.server
+        snaps = s._snapshots
+        inv: dict[int, list] = {}
+        consumers: dict[int, int] = {}
+        for rank, f in filtered.items():
+            avail = [
+                t for t in f["tasks"] if t[0] not in planned_away.get(rank, ())
+            ]
+            inv[rank] = avail
+            consumers[rank] = snaps.get(rank, {}).get("consumers", 0)
+        total_consumers = sum(consumers.values())
+        if total_consumers == 0:
+            return
+        # deficits: fewer available units than active local consumers
+        deficits = {
+            r: 2 * c - len(inv[r])
+            for r, c in consumers.items()
+            if c > 0 and len(inv[r]) < c
+        }
+        if not deficits:
+            return
+        # surpluses: inventory beyond what this server's consumers need soon
+        surpluses = {
+            r: lst[max(2 * consumers.get(r, 0), 0):]
+            for r, lst in inv.items()
+            if len(lst) > 2 * consumers.get(r, 0)
+        }
+        cap = s.cfg.max_malloc_per_server
+        moves: dict[tuple[int, int], list[int]] = {}
+        for dest, want in sorted(deficits.items(), key=lambda kv: -kv[1]):
+            want = min(want, 64)  # bound the per-round burst
+            dest_bytes = snaps.get(dest, {}).get("nbytes", 0)
+            for src_rank, lst in surpluses.items():
+                if want <= 0:
+                    break
+                if src_rank == dest or not lst:
+                    continue
+                take = []
+                while lst and len(take) < want:
+                    t = lst[0]
+                    if cap > 0 and dest_bytes + t[3] > 0.9 * cap:
+                        break  # planner-side admission: dest believed full
+                    take.append(t)
+                    dest_bytes += t[3]
+                    lst = lst[1:]
+                surpluses[src_rank] = lst
+                if take:
+                    moves.setdefault((src_rank, dest), []).extend(
+                        t[0] for t in take
+                    )
+                    want -= len(take)
+        for (src_rank, dest), seqnos in moves.items():
+            for q in seqnos:
+                self._planned_tasks[(src_rank, q)] = t_planned
+            s.ep.send(
+                src_rank,
+                msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos),
+            )
 
 
 class _PeerState:
@@ -97,6 +262,10 @@ class Server:
         self._push_seq = 0
         self._push_offered: dict[int, int] = {}
         self._push_reserved: dict[int, int] = {}
+        # migration batches sent but not yet acked by the destination —
+        # in-flight work the exhaustion vote must see (units inside an
+        # unacked SS_MIGRATE_WORK live in no wq anywhere)
+        self._migrate_unacked = 0
 
         # termination state
         self.no_more_work = False
@@ -111,6 +280,9 @@ class Server:
         # balancer state (master only, tpu mode)
         self._snapshots: dict[int, dict] = {}
         self._solver = None
+        self._balancer: Optional[_BalancerWorker] = None
+        if cfg.balancer == "tpu" and self.is_master:
+            self._balancer = _BalancerWorker(self)
 
         # stats (InfoKey surface, reference src/adlb.c:3072-3141)
         self.stats = {k: 0.0 for k in InfoKey}
@@ -159,14 +331,21 @@ class Server:
             Tag.SS_ABORT: self._on_ss_abort,
             Tag.SS_STATE: self._on_state,
             Tag.SS_PLAN_MATCH: self._on_plan_match,
+            Tag.SS_PLAN_MIGRATE: self._on_plan_migrate,
+            Tag.SS_MIGRATE_WORK: self._on_migrate_work,
+            Tag.SS_MIGRATE_ACK: self._on_migrate_ack,
         }
 
     # ------------------------------------------------------------------ loop
 
     def run(self) -> None:
         try:
+            if self._balancer is not None:
+                self._balancer.start()
             self._run_loop()
         finally:
+            if self._balancer is not None:
+                self._balancer.stop()
             self._notify_debug_server_end()
 
     def _run_loop(self) -> None:
@@ -216,8 +395,6 @@ class Server:
             self._next_state_sync = now + interval
             if self.cfg.balancer == "tpu":
                 self._send_snapshot()
-                if self.is_master:
-                    self._run_balancer_round()
             else:
                 self._broadcast_qmstat()
             if self.mem.under_pressure:
@@ -404,6 +581,10 @@ class Server:
         self.rq.add(entry)
         self._rfr_excluded.pop(app, None)
         self._try_rfr(entry)
+        if self.cfg.balancer == "tpu":
+            # event-driven: a park immediately refreshes this server's
+            # snapshot at the balancer instead of waiting for the next tick
+            self._send_snapshot()
 
     def _on_get_reserved(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
@@ -802,11 +983,20 @@ class Server:
             "tasks": tasks,
             "reqs": reqs,
             "nbytes": self.mem.curr,
+            "consumers": len(self.local_apps - self._finalized),
             "stamp": time.monotonic(),
         }
         if self.is_master:
             self._snapshots[self.rank] = snap
+            if self._balancer is not None:
+                self._balancer.wake.set()
         else:
+            # suppress repeat empty snapshots: an idle server would otherwise
+            # wake the master every tick for nothing
+            empty = not tasks and not reqs
+            if empty and getattr(self, "_last_snap_empty", False):
+                return
+            self._last_snap_empty = empty
             self.ep.send(
                 self.world.master_server_rank,
                 msg(Tag.SS_STATE, self.rank, snap=snap),
@@ -814,33 +1004,8 @@ class Server:
 
     def _on_state(self, m: Msg) -> None:
         self._snapshots[m.src] = m.snap
-
-    def _run_balancer_round(self) -> None:
-        if len(self._snapshots) < 1:
-            return
-        if self._solver is None:
-            from adlb_tpu.balancer.solve import AssignmentSolver
-
-            self._solver = AssignmentSolver(
-                types=self.world.types,
-                max_tasks=self.cfg.balancer_max_tasks,
-                max_requesters=self.cfg.balancer_max_requesters,
-            )
-        pairs = self._solver.solve(self._snapshots, self.world)
-        for holder, seqno, req_home, for_rank, rqseqno in pairs:
-            if holder == req_home:
-                continue  # local work reaches local requesters without a plan
-            self.ep.send(
-                holder,
-                msg(
-                    Tag.SS_PLAN_MATCH,
-                    self.rank,
-                    seqno=seqno,
-                    for_rank=for_rank,
-                    req_home=req_home,
-                    rqseqno=rqseqno,
-                ),
-            )
+        if self._balancer is not None and m.snap["reqs"]:
+            self._balancer.wake.set()
 
     def _on_plan_match(self, m: Msg) -> None:
         """Enact one plan entry: validate against live state, pin, and hand
@@ -871,6 +1036,85 @@ class Server:
                 common_seqno=unit.common_seqno,
             ),
         )
+
+    def _on_plan_migrate(self, m: Msg) -> None:
+        """Planner-directed inventory move: ship the named (still live,
+        unpinned, untargeted) units to `dest` so consumers there match
+        locally. Demand-driven placement — the planner's generalization of
+        the reference's memory-pressure-only push (``src/adlb.c:509-556``)."""
+        units = []
+        for seqno in m.seqnos:
+            unit = self.wq.get(seqno)
+            if unit is None or unit.pinned or unit.target_rank >= 0:
+                continue  # stale plan entry
+            self.wq.remove(seqno)
+            self.mem.free(len(unit.payload))
+            self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
+            units.append(
+                {
+                    "payload": unit.payload,
+                    "work_type": unit.work_type,
+                    "prio": unit.prio,
+                    "answer_rank": unit.answer_rank,
+                    "home_server": unit.home_server,
+                    "common_len": unit.common_len,
+                    "common_server": unit.common_server_rank,
+                    "common_seqno": unit.common_seqno,
+                    "time_stamp": unit.time_stamp,
+                }
+            )
+        if units:
+            self.activity += 1
+            self._exhaust_held_since = None
+            self._migrate_unacked += 1
+            self.ep.send(
+                m.dest,
+                msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False),
+            )
+
+    def _on_migrate_work(self, m: Msg) -> None:
+        bounced_back = []
+        for u in m.units:
+            # admission control like every other ingress path; a unit already
+            # admitted to the system is never dropped, so on a full server it
+            # bounces back to the sender once, which then must keep it
+            # (overcommit beats losing work)
+            if not m.data.get("bounced") and not self.mem.try_alloc(
+                len(u["payload"])
+            ):
+                bounced_back.append(u)
+                continue
+            if m.data.get("bounced"):
+                self.mem.alloc(len(u["payload"]))
+            unit = WorkUnit(
+                seqno=self._next_seqno,
+                work_type=u["work_type"],
+                prio=u["prio"],
+                target_rank=-1,
+                answer_rank=u["answer_rank"],
+                payload=u["payload"],
+                home_server=u["home_server"],
+                common_len=u["common_len"],
+                common_server_rank=u["common_server"],
+                common_seqno=u["common_seqno"],
+                time_stamp=u["time_stamp"],
+            )
+            self._next_seqno += 1
+            self.wq.add(unit)
+            self.stats[InfoKey.NPUSHED_TO_HERE] += 1
+        self.ep.send(m.src, msg(Tag.SS_MIGRATE_ACK, self.rank))
+        if bounced_back:
+            self._migrate_unacked += 1
+            self.ep.send(
+                m.src,
+                msg(Tag.SS_MIGRATE_WORK, self.rank, units=bounced_back,
+                    bounced=True),
+            )
+        if m.units:
+            self._match_rq()
+
+    def _on_migrate_ack(self, m: Msg) -> None:
+        self._migrate_unacked -= 1
 
     # ------------------------------------------------------- termination
 
@@ -906,12 +1150,26 @@ class Server:
         active = self.local_apps - self._finalized
         return all(r in self.rq for r in active)
 
+    def _exhaust_vote(self) -> bool:
+        """This server's contribution to the exhaustion ring pass: all local
+        apps parked, no work units held here (pinned ones are in-flight
+        handoffs that resolve to a fetch or an UNRESERVE), and no migration
+        batch in transit. Stricter than the reference's apps-parked-only
+        condition (src/adlb.c:754-785) — it closes the races where work is
+        still being balanced toward a parked requester, or serialized inside
+        a migration message, while both ring passes complete."""
+        return (
+            self._all_local_apps_parked()
+            and self.wq.count == 0
+            and self._migrate_unacked == 0
+        )
+
     def _check_exhaustion(self, now: float) -> None:
         """Master: if every app everywhere might be blocked, run the two-pass
         ring confirmation (reference ``src/adlb.c:754-785,1575-1650``)."""
         if self.no_more_work or self.done_by_exhaustion or self._exhaust_inflight:
             return
-        if not self._all_local_apps_parked():
+        if not self._exhaust_vote():
             self._exhaust_held_since = None
             return
         if self._exhaust_held_since is None:
@@ -943,6 +1201,7 @@ class Server:
             ok = (
                 token["ok"]
                 and token["nparked"] > 0
+                and self._exhaust_vote()
                 and self.activity == token["act"].get(self.rank, -1)
             )
             if not ok:
@@ -963,13 +1222,13 @@ class Server:
             return
         # contribute and forward
         if phase1:
-            token["ok"] = token["ok"] and self._all_local_apps_parked()
+            token["ok"] = token["ok"] and self._exhaust_vote()
             token["act"][self.rank] = self.activity
             token["nparked"] = token.get("nparked", 0) + len(self.rq)
         else:
             token["ok"] = (
                 token["ok"]
-                and self._all_local_apps_parked()
+                and self._exhaust_vote()
                 and self.activity == token["act"].get(self.rank, -1)
             )
         self._forward_exhaust(m.tag, token)

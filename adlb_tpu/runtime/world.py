@@ -85,6 +85,13 @@ class Config:
     max_malloc_per_server: float = 0.0  # 0 = unlimited (reference hi_malloc)
     qmstat_interval: float = 0.05  # reference 0.1 s (src/adlb.c:165)
     balancer_interval: float = 0.02  # TPU-mode snapshot->solve->plan period
+    # min gap between event-driven solves (a park triggers an immediate
+    # snapshot+solve; this bounds solve rate under churn)
+    balancer_min_gap: float = 0.002
+    # untargeted put routing: "round_robin" spreads over servers (reference
+    # src/adlb.c:2771-2773); "home" keeps work at the putter's home server
+    # (data locality; relies on the balancer to redistribute)
+    put_routing: str = "round_robin"
     exhaust_check_interval: float = 0.25  # reference 5 s (src/adlb.c:754-785)
     periodic_log_interval: float = 0.0  # 0 = off
     debug_log_interval: float = 1.0  # DS_LOG cadence (src/adlb.c:842-854)
@@ -100,6 +107,8 @@ class Config:
     def __post_init__(self) -> None:
         if self.balancer not in ("steal", "tpu"):
             raise ValueError(f"unknown balancer mode {self.balancer!r}")
+        if self.put_routing not in ("round_robin", "home"):
+            raise ValueError(f"unknown put routing {self.put_routing!r}")
 
 
 def normalize_req_types(

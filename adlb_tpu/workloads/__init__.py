@@ -1,0 +1,21 @@
+"""Workload programs: the framework's "model zoo".
+
+The reference ships its test/benchmark story as example mini-apps
+(reference ``examples/``, SURVEY §4): self-checking known-answer programs
+that exercise the full Put/Reserve/answer economy. These are their
+re-designed equivalents, each a parameterizable function over
+:func:`adlb_tpu.api.run_world`, used both as integration tests and as
+benchmark drivers:
+
+* :mod:`~adlb_tpu.workloads.nq` — n-queens DFS (reference ``examples/nq.c``)
+* :mod:`~adlb_tpu.workloads.tsp` — branch-and-bound TSP with tree-broadcast
+  bound updates (reference ``examples/tsp.c``)
+* :mod:`~adlb_tpu.workloads.sudoku` — multi-type DFS (reference
+  ``examples/sudoku.c``)
+* :mod:`~adlb_tpu.workloads.batcher` — heterogeneous job bag (reference
+  ``examples/batcher.c``)
+* :mod:`~adlb_tpu.workloads.gfmc` — A/B/C/D work-package economy with
+  self-validating counts (reference ``examples/c4.c``)
+* :mod:`~adlb_tpu.workloads.coinop` — pop-latency probe (reference
+  ``examples/coinop.cpp``)
+"""

@@ -1,0 +1,94 @@
+"""coinop: the pop-latency microbenchmark.
+
+Mirrors the fork's addition (reference ``examples/coinop.cpp:79-126,190-213``):
+one producer floods N tokens through the pool; every worker measures the
+latency of each Reserve+Get pop and reports mean/stddev (gathered to the
+producer in the reference via MPI_Gather; here returned through app results).
+This is the steal-to-exec latency probe used by BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Optional
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+TOKEN = 1
+
+
+@dataclasses.dataclass
+class CoinopResult:
+    pops: int
+    latency_mean_ms: float
+    latency_p50_ms: float
+    latency_p95_ms: float
+    per_worker: dict[int, tuple[float, float]]  # rank -> (mean ms, stddev ms)
+    elapsed: float
+    pops_per_sec: float
+
+
+def run(
+    n_tokens: int = 500,
+    num_app_ranks: int = 4,
+    nservers: int = 2,
+    token_bytes: int = 64,
+    work_time: float = 0.0,
+    cfg: Optional[Config] = None,
+    timeout: float = 180.0,
+) -> CoinopResult:
+    payload = b"c" * token_bytes
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(n_tokens):
+                ctx.put(payload, TOKEN, work_prio=0)
+            # producer finalizes immediately; workers drain the pool and the
+            # exhaustion protocol ends the world once it runs dry
+            return []
+        lats = []
+        while True:
+            t0 = time.monotonic()
+            rc, r = ctx.reserve([TOKEN])
+            if rc != ADLB_SUCCESS:
+                return lats
+            rc, buf, _tq = ctx.get_reserved_timed(r.handle)
+            lats.append(time.monotonic() - t0)
+            if work_time > 0:
+                time.sleep(work_time)
+
+    t0 = time.monotonic()
+    res = run_world(
+        num_app_ranks,
+        nservers,
+        [TOKEN],
+        app,
+        cfg=cfg or Config(exhaust_check_interval=0.25),
+        timeout=timeout,
+    )
+    elapsed = time.monotonic() - t0
+    all_lats = sorted(
+        lat for rank, lats in res.app_results.items() for lat in lats
+    )
+    per_worker = {
+        rank: (
+            statistics.mean(lats) * 1e3,
+            (statistics.pstdev(lats) if len(lats) > 1 else 0.0) * 1e3,
+        )
+        for rank, lats in res.app_results.items()
+        if rank != 0 and lats
+    }
+    n = len(all_lats)
+    return CoinopResult(
+        pops=n,
+        latency_mean_ms=(statistics.mean(all_lats) * 1e3) if n else 0.0,
+        latency_p50_ms=(all_lats[n // 2] * 1e3) if n else 0.0,
+        latency_p95_ms=(all_lats[int(n * 0.95)] * 1e3) if n else 0.0,
+        per_worker=per_worker,
+        elapsed=elapsed,
+        pops_per_sec=n / elapsed if elapsed > 0 else 0.0,
+    )

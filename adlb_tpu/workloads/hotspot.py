@@ -1,0 +1,92 @@
+"""Hotspot: producer-concentrated load that must be rebalanced to be fast.
+
+The scenario the global balancer exists for (BASELINE.json north star): all
+work enters at one server (data-locality routing, ``put_routing="home"``)
+while consumers are spread across every server. Throughput is then limited
+by how quickly cross-server balancing moves work to parked workers — the
+reference's answer is qmstat-guided RFR stealing (reference
+``src/adlb.c:1802-2070``); this framework's answer is the batched global
+solve. Work is a GIL-free sleep so the in-process harness measures balancing,
+not Python compute.
+
+Reports tasks/sec and mean worker busy-fraction (1 - idle%), the BASELINE.md
+metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+TOKEN = 1
+
+
+@dataclasses.dataclass
+class HotspotResult:
+    tasks: int
+    elapsed: float
+    tasks_per_sec: float
+    busy_fraction: float  # mean over workers
+    idle_pct: float
+
+
+def run(
+    n_tasks: int = 300,
+    work_time: float = 0.004,
+    num_app_ranks: int = 8,
+    nservers: int = 4,
+    cfg: Optional[Config] = None,
+    timeout: float = 300.0,
+) -> HotspotResult:
+    base = cfg or Config()
+    cfg = dataclasses.replace(
+        base,
+        put_routing="home",
+        exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
+    )
+
+    def app(ctx):
+        if ctx.rank == 0:
+            # all tokens land on rank 0's home server
+            t_first = time.monotonic()
+            for i in range(n_tasks):
+                ctx.put(b"w", TOKEN, work_prio=0)
+            return t_first, t_first, 0, 0.0
+        done = 0
+        busy = 0.0
+        t_start = time.monotonic()
+        t_last = t_start
+        while True:
+            rc, r = ctx.reserve([TOKEN])
+            if rc != ADLB_SUCCESS:
+                # makespan measured to the last completed task; the
+                # exhaustion-termination tail is excluded (it is a constant,
+                # not a balancing cost)
+                return t_start, t_last, done, busy
+            rc, buf = ctx.get_reserved(r.handle)
+            t0 = time.monotonic()
+            time.sleep(work_time)  # GIL-free "compute"
+            busy += time.monotonic() - t0
+            done += 1
+            t_last = time.monotonic()
+
+    res = run_world(num_app_ranks, nservers, [TOKEN], app, cfg=cfg,
+                    timeout=timeout)
+    workers = [v for k, v in res.app_results.items() if k != 0 and v]
+    tasks = sum(w[2] for w in workers)
+    t_begin = min(v[0] for v in res.app_results.values())
+    t_end = max(w[1] for w in workers)
+    elapsed = max(t_end - t_begin, 1e-9)
+    busy = sum(w[3] / elapsed for w in workers) / len(workers) if workers else 0.0
+    return HotspotResult(
+        tasks=tasks,
+        elapsed=elapsed,
+        tasks_per_sec=tasks / elapsed,
+        busy_fraction=busy,
+        idle_pct=100.0 * (1.0 - busy),
+    )

@@ -1,0 +1,128 @@
+"""Benchmark: TPU global balancer vs reference-style stealing heuristics.
+
+Runs the nq and coinop workloads (the BASELINE.md configs) under both
+cross-server balancing strategies implemented by this framework:
+
+* steal — the rebuilt reference heuristics (qmstat state broadcast + RFR
+  pull stealing), the stand-in for upstream ADLB's behavior;
+* tpu — the periodic batched global assignment solve in JAX (the north-star
+  architecture from BASELINE.json).
+
+Prints ONE JSON line: value = TPU-mode nq tasks/sec, vs_baseline = ratio of
+TPU-mode to steal-mode tasks/sec on the identical workload.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _ensure_live_backend(probe_timeout: float = 60.0) -> str:
+    """Probe accelerator initialization in a subprocess; fall back to CPU if
+    it hangs or fails (a wedged TPU tunnel must degrade, not deadlock the
+    benchmark). Returns the platform used."""
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout,
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return os.environ.get("JAX_PLATFORMS", "default")
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu (accelerator unreachable)"
+
+
+def main() -> None:
+    platform = _ensure_live_backend()
+
+    from adlb_tpu.runtime.world import Config
+    from adlb_tpu.workloads import coinop, nq
+
+    N = 9
+    APPS, SERVERS = 6, 3
+    CUTOFF = 3
+
+    def cfg(mode: str) -> Config:
+        return Config(
+            balancer=mode,
+            exhaust_check_interval=0.2,
+            balancer_max_tasks=128,
+            balancer_max_requesters=32,
+        )
+
+    # warm the solver (host path) so setup cost stays out of the timing
+    from adlb_tpu.balancer.solve import AssignmentSolver
+
+    warm = AssignmentSolver(types=(1,), max_tasks=128, max_requesters=32)
+    warm.solve({0: {"tasks": [(1, 1, 1, 1)], "reqs": [(0, 1, None)]}}, None)
+
+    def best_of(mode: str, reps: int = 3):
+        best = None
+        for _ in range(reps):
+            r = nq.run(
+                n=N, num_app_ranks=APPS, nservers=SERVERS,
+                max_depth_for_puts=CUTOFF, cfg=cfg(mode), timeout=600.0,
+            )
+            assert r.solutions == nq.KNOWN_SOLUTIONS[N], (
+                f"{mode}: wrong answer {r.solutions}"
+            )
+            if best is None or r.tasks_per_sec > best.tasks_per_sec:
+                best = r
+        return best
+
+    steal = best_of("steal")
+    tpu = best_of("tpu")
+
+    lat_steal = coinop.run(
+        n_tokens=400, num_app_ranks=APPS, nservers=SERVERS, cfg=cfg("steal"),
+        timeout=300.0,
+    )
+    lat_tpu = coinop.run(
+        n_tokens=400, num_app_ranks=APPS, nservers=SERVERS, cfg=cfg("tpu"),
+        timeout=300.0,
+    )
+
+    result = {
+        "metric": "nq_tasks_per_sec_tpu_balancer",
+        "value": round(tpu.tasks_per_sec, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
+        if steal.tasks_per_sec
+        else 0.0,
+        "detail": {
+            "platform": platform,
+            "nq_n": N,
+            "app_ranks": APPS,
+            "servers": SERVERS,
+            "steal_tasks_per_sec": round(steal.tasks_per_sec, 1),
+            "tpu_tasks_per_sec": round(tpu.tasks_per_sec, 1),
+            "steal_pop_latency_p50_ms": round(lat_steal.latency_p50_ms, 3),
+            "tpu_pop_latency_p50_ms": round(lat_tpu.latency_p50_ms, 3),
+            "steal_pops_per_sec": round(lat_steal.pops_per_sec, 1),
+            "tpu_pops_per_sec": round(lat_tpu.pops_per_sec, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    try:
+        main()
+    except Exception as e:  # surface failures as a parseable line
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0,
+            "unit": "error",
+            "vs_baseline": 0,
+            "detail": {"error": repr(e), "elapsed_s": round(time.time() - t0, 1)},
+        }))
+        sys.exit(1)

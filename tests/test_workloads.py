@@ -1,0 +1,67 @@
+"""Known-answer workload integration tests (the reference's test strategy:
+self-checking mini-apps, SURVEY §4), in both balancer modes."""
+
+import pytest
+
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.workloads import batcher, coinop, gfmc, nq, sudoku, tsp
+
+
+STEAL = None  # default Config
+TPU = Config(
+    balancer="tpu", balancer_max_tasks=64, balancer_max_requesters=16,
+    exhaust_check_interval=0.15,
+)
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_nq_known_answer(mode):
+    cfg = None if mode == "steal" else TPU
+    res = nq.run(n=6, num_app_ranks=3, nservers=2, cfg=cfg)
+    assert res.solutions == nq.KNOWN_SOLUTIONS[6]
+    assert res.tasks_processed > 0
+
+
+def test_nq_deeper_cutoff():
+    res = nq.run(n=7, num_app_ranks=4, nservers=2, max_depth_for_puts=3)
+    assert res.solutions == nq.KNOWN_SOLUTIONS[7]
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_tsp_optimal(mode):
+    cfg = None if mode == "steal" else TPU
+    n = 8
+    dists = tsp.dist_matrix(tsp.make_cities(n, seed=3))
+    want = tsp.brute_force_optimum(dists)
+    res = tsp.run(n_cities=n, num_app_ranks=3, nservers=2, seed=3, cfg=cfg)
+    assert res.best == want
+
+
+def test_sudoku_solves():
+    res = sudoku.run(num_app_ranks=3, nservers=2)
+    assert res.valid, "sudoku solution missing or invalid"
+
+
+def test_batcher_parallel_speedup():
+    durations = [0.2, 0.1, 0.1, 0.05, 0.05, 0.05, 0.05, 0.1, 0.1]  # 0.8s serial
+    res = batcher.run(durations, num_app_ranks=4, nservers=1)
+    assert sum(res.jobs_run.values()) == len(durations)
+    # 3 workers on 0.8s of work: generous bound still proves parallelism
+    assert res.elapsed < 0.75 * res.serial_time, (
+        f"elapsed {res.elapsed:.2f}s vs serial {res.serial_time:.2f}s"
+    )
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_gfmc_economy_self_check(mode):
+    cfg = None if mode == "steal" else TPU
+    res = gfmc.run(num_a=4, bs_per_a=3, cs_per_b=2,
+                   num_app_ranks=4, nservers=2, cfg=cfg)
+    assert res.ok, f"counts {res.counts} != expected {res.expected}"
+
+
+def test_coinop_latency_probe():
+    res = coinop.run(n_tokens=200, num_app_ranks=4, nservers=2)
+    assert res.pops == 200
+    assert res.latency_p50_ms > 0
+    assert res.per_worker  # every reporting worker has stats
