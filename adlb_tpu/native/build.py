@@ -1,0 +1,98 @@
+"""Build + load the native core.
+
+Compiles ``wqcore.cpp`` into a shared library next to the source with the
+system ``g++`` (cached by mtime), then loads it with ctypes. No
+pip/pybind11/setuptools involvement — the reference's build layer is plain
+CMake over C sources (reference ``CMakeLists.txt:44-56``); this is the same
+spirit with less machinery.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wqcore.cpp")
+_LIB = os.path.join(_DIR, "libadlbwq.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _compile() -> None:
+    # compile to a private temp file and rename into place: concurrent
+    # processes racing to build must never dlopen a half-written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+    i32p, i64p = ctypes.POINTER(i32), ctypes.POINTER(i64)
+    sig = {
+        "adlb_wq_new": (p, []),
+        "adlb_wq_free": (None, [p]),
+        "adlb_wq_add": (i32, [p, i64, i32, i32, i32, i32, i32, i64]),
+        "adlb_wq_remove": (i32, [p, i64]),
+        "adlb_wq_pin": (i32, [p, i64, i32]),
+        "adlb_wq_unpin": (i32, [p, i64]),
+        "adlb_wq_find_match": (i64, [p, i32, i32p, i32]),
+        "adlb_wq_find_targeted": (i64, [p, i32, i32p, i32]),
+        "adlb_wq_find_untargeted": (i64, [p, i32p, i32]),
+        "adlb_wq_hi_prio_of_type": (i32, [p, i32, i32p]),
+        "adlb_wq_count": (i64, [p]),
+        "adlb_wq_max_count": (i64, [p]),
+        "adlb_wq_total_bytes": (i64, [p]),
+        "adlb_wq_num_unpinned": (i64, [p]),
+        "adlb_wq_num_unpinned_untargeted": (i64, [p]),
+        "adlb_wq_snapshot_untargeted": (i64, [p, i64, i64p, i32p, i32p, i64p]),
+        "adlb_wq_get": (i32, [p, i64, i32p, i32p, i32p, i32p, i64p]),
+    }
+    for name, (restype, argtypes) in sig.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def ensure_built() -> Optional[ctypes.CDLL]:
+    """Build if stale and load; returns None (and records why) on failure."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        try:
+            if (
+                not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                _compile()
+            _lib = _bind(ctypes.CDLL(_LIB))
+            return _lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _build_error = f"native core unavailable: {detail[:500]}"
+            return None
+
+
+def native_available() -> bool:
+    return ensure_built() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error

@@ -220,9 +220,9 @@ class WorkQueue:
     # -- stats for gossip / balancer -----------------------------------------
 
     def num_unpinned(self) -> int:
-        """All unpinned units — used by the exhaustion check: a server with
-        deliverable work left cannot vote 'exhausted', else a slow balancing
-        path could lose a race against the double ring pass and strand work."""
+        """All unpinned units. The exhaustion vote compares this against
+        ``count``: a difference means pinned units, i.e. handoffs still in
+        flight, and the server cannot vote 'exhausted'."""
         return sum(1 for u in self._units.values() if not u.pinned)
 
     def num_unpinned_untargeted(self) -> int:

@@ -100,58 +100,77 @@ class _BalancerWorker(threading.Thread):
             self.wake.clear()
             if self.stopped or s.done:
                 return
-            snaps = dict(s._snapshots)
-            if not snaps:
-                continue
-            now = time.monotonic()
-            filtered = {}
-            for rank, snap in snaps.items():
-                stamp = snap.get("stamp", now)
-                reqs = [
-                    r for r in snap["reqs"]
-                    if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
-                ]
-                tasks = [
-                    t for t in snap["tasks"]
-                    if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
-                ]
-                filtered[rank] = {"tasks": tasks, "reqs": reqs}
-            if any(sn["reqs"] for sn in filtered.values()):
-                pairs = solver.solve(filtered, s.world)
-            else:
-                pairs = []  # nobody parked; still consider migrations below
-            t_planned = time.monotonic()
-            for holder, seqno, req_home, for_rank, rqseqno in pairs:
-                if holder == req_home:
-                    continue
-                self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
-                self._planned_tasks[(holder, seqno)] = t_planned
-                s.ep.send(
-                    holder,
-                    msg(
-                        Tag.SS_PLAN_MATCH,
-                        s.rank,
-                        seqno=seqno,
-                        for_rank=for_rank,
-                        req_home=req_home,
-                        rqseqno=rqseqno,
-                    ),
+            try:
+                self._one_round(solver)
+            except Exception as e:  # noqa: BLE001
+                # The balancer must survive solver/backend errors — in tpu
+                # mode there is no other cross-server matching mechanism.
+                # Force the numpy host path (no accelerator involvement)
+                # and keep going.
+                import sys as _sys
+
+                print(
+                    f"[adlb balancer] solve failed ({e!r}); forcing host "
+                    f"solve path and retrying",
+                    file=_sys.stderr,
                 )
-            planned_away = {}
-            for holder, seqno, req_home, for_rank, rqseqno in pairs:
-                planned_away.setdefault(holder, set()).add(seqno)
-            self._plan_migrations(filtered, planned_away, t_planned)
-            # bound the memory of the plan ledgers
-            if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
-                cutoff = t_planned - 5.0
-                self._planned_reqs = {
-                    k: v for k, v in self._planned_reqs.items() if v > cutoff
-                }
-                self._planned_tasks = {
-                    k: v for k, v in self._planned_tasks.items() if v > cutoff
-                }
-            if s.cfg.balancer_min_gap > 0:
-                time.sleep(s.cfg.balancer_min_gap)
+                solver.host_threshold_reqs = 10**9
+                time.sleep(0.05)
+
+    def _one_round(self, solver) -> None:
+        s = self.server
+        snaps = dict(s._snapshots)
+        if not snaps:
+            return
+        now = time.monotonic()
+        filtered = {}
+        for rank, snap in snaps.items():
+            stamp = snap.get("stamp", now)
+            reqs = [
+                r for r in snap["reqs"]
+                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
+            ]
+            tasks = [
+                t for t in snap["tasks"]
+                if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
+            ]
+            filtered[rank] = {"tasks": tasks, "reqs": reqs}
+        if any(sn["reqs"] for sn in filtered.values()):
+            pairs = solver.solve(filtered, s.world)
+        else:
+            pairs = []  # nobody parked; still consider migrations below
+        t_planned = time.monotonic()
+        for holder, seqno, req_home, for_rank, rqseqno in pairs:
+            if holder == req_home:
+                continue
+            self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
+            self._planned_tasks[(holder, seqno)] = t_planned
+            s.ep.send(
+                holder,
+                msg(
+                    Tag.SS_PLAN_MATCH,
+                    s.rank,
+                    seqno=seqno,
+                    for_rank=for_rank,
+                    req_home=req_home,
+                    rqseqno=rqseqno,
+                ),
+            )
+        planned_away = {}
+        for holder, seqno, req_home, for_rank, rqseqno in pairs:
+            planned_away.setdefault(holder, set()).add(seqno)
+        self._plan_migrations(filtered, planned_away, t_planned)
+        # bound the memory of the plan ledgers
+        if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
+            cutoff = t_planned - 5.0
+            self._planned_reqs = {
+                k: v for k, v in self._planned_reqs.items() if v > cutoff
+            }
+            self._planned_tasks = {
+                k: v for k, v in self._planned_tasks.items() if v > cutoff
+            }
+        if s.cfg.balancer_min_gap > 0:
+            time.sleep(s.cfg.balancer_min_gap)
 
     def _plan_migrations(
         self, filtered: dict, planned_away: dict, t_planned: float
@@ -244,7 +263,7 @@ class Server:
         self.is_master = self.rank == world.master_server_rank
         self.local_apps = set(world.local_apps(self.rank))
 
-        self.wq = WorkQueue()
+        self.wq = self._make_wq(cfg)
         self.rq = ReserveQueue()
         self.tq = TargetedDirectory()
         self.mem = MemoryAccountant(cfg.max_malloc_per_server)
@@ -266,6 +285,7 @@ class Server:
         # in-flight work the exhaustion vote must see (units inside an
         # unacked SS_MIGRATE_WORK live in no wq anywhere)
         self._migrate_unacked = 0
+        self._last_event_snap = 0.0
 
         # termination state
         self.no_more_work = False
@@ -335,6 +355,21 @@ class Server:
             Tag.SS_MIGRATE_WORK: self._on_migrate_work,
             Tag.SS_MIGRATE_ACK: self._on_migrate_ack,
         }
+
+    @staticmethod
+    def _make_wq(cfg: Config):
+        """Pick the work-queue implementation: C++ core (ctypes) when wanted
+        and buildable, else the pure-Python indexed queue."""
+        if cfg.native_queues == "off":
+            return WorkQueue()
+        try:
+            from adlb_tpu.native.wq import NativeWorkQueue
+
+            return NativeWorkQueue()
+        except (RuntimeError, OSError, ImportError):
+            if cfg.native_queues == "on":
+                raise
+            return WorkQueue()
 
     # ------------------------------------------------------------------ loop
 
@@ -584,7 +619,11 @@ class Server:
         if self.cfg.balancer == "tpu":
             # event-driven: a park immediately refreshes this server's
             # snapshot at the balancer instead of waiting for the next tick
-            self._send_snapshot()
+            # (rate-limited; the periodic tick still covers the remainder)
+            now = time.monotonic()
+            if now - self._last_event_snap >= self.cfg.balancer_min_gap:
+                self._last_event_snap = now
+                self._send_snapshot()
 
     def _on_get_reserved(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
@@ -962,14 +1001,22 @@ class Server:
 
     def _send_snapshot(self) -> None:
         K = self.cfg.balancer_max_tasks
-        tasks = []
-        for u in self.wq.units():
-            if not u.pinned and u.target_rank < 0:
-                tasks.append((u.seqno, u.work_type, u.prio, u.work_len))
-                if len(tasks) >= K * 2:
-                    break
-        tasks.sort(key=lambda t: -t[2])
-        tasks = tasks[:K]
+        snapshot_fast = getattr(self.wq, "snapshot_untargeted", None)
+        if snapshot_fast is not None:
+            tasks = snapshot_fast(K)  # sorted in C++
+        else:
+            import heapq as _heapq
+
+            # O(n log K), not a full sort: this runs on the reactor thread
+            tasks = _heapq.nsmallest(
+                K,
+                (
+                    (-u.prio, u.seqno, u.work_type, len(u.payload))
+                    for u in self.wq.units()
+                    if not u.pinned and u.target_rank < 0
+                ),
+            )
+            tasks = [(s, t, -np_, ln) for np_, s, t, ln in tasks]
         reqs = [
             (
                 e.world_rank,
@@ -1003,6 +1050,9 @@ class Server:
             )
 
     def _on_state(self, m: Msg) -> None:
+        # re-stamp on the master's clock: plan-ledger comparisons must never
+        # mix monotonic clocks from different hosts
+        m.snap["stamp"] = time.monotonic()
         self._snapshots[m.src] = m.snap
         if self._balancer is not None and m.snap["reqs"]:
             self._balancer.wake.set()
@@ -1150,19 +1200,40 @@ class Server:
         active = self.local_apps - self._finalized
         return all(r in self.rq for r in active)
 
-    def _exhaust_vote(self) -> bool:
-        """This server's contribution to the exhaustion ring pass: all local
-        apps parked, no work units held here (pinned ones are in-flight
-        handoffs that resolve to a fetch or an UNRESERVE), and no migration
-        batch in transit. Stricter than the reference's apps-parked-only
-        condition (src/adlb.c:754-785) — it closes the races where work is
-        still being balanced toward a parked requester, or serialized inside
-        a migration message, while both ring passes complete."""
-        return (
-            self._all_local_apps_parked()
-            and self.wq.count == 0
-            and self._migrate_unacked == 0
-        )
+    def _exhaust_vote(self, parked: Optional[list] = None) -> bool:
+        """This server's contribution to the exhaustion ring pass.
+
+        Always required: all local apps parked, no pinned units (a pinned
+        unit is an in-flight handoff that resolves to a fetch or an
+        UNRESERVE), no migration batch in transit. When the token's global
+        parked-requester list is available (pass 2), additionally: no unit
+        here could satisfy any parked requester anywhere. Unmatchable
+        leftovers (e.g. types nobody asks for) deliberately do NOT block —
+        matching the reference, which exhausts with work still queued
+        (src/adlb.c:754-785) — while work that is still being balanced
+        toward a requester, or serialized inside a migration message, does.
+        """
+        if not self._all_local_apps_parked():
+            return False
+        if self._migrate_unacked != 0:
+            return False
+        if self.wq.count != self.wq.num_unpinned():
+            return False  # pinned = handoff in flight
+        if parked is not None:
+            for rank, req_types in parked:
+                types = None if req_types is None else frozenset(req_types)
+                if self.wq.find_match(rank, types) is not None:
+                    return False
+        return True
+
+    def _parked_list(self) -> list:
+        return [
+            (
+                e.world_rank,
+                None if e.req_types is None else sorted(e.req_types),
+            )
+            for e in self.rq.entries()
+        ]
 
     def _check_exhaustion(self, now: float) -> None:
         """Master: if every app everywhere might be blocked, run the two-pass
@@ -1183,6 +1254,7 @@ class Server:
             "ok": True,
             "act": {self.rank: self.activity},
             "nparked": len(self.rq),
+            "parked": self._parked_list(),
         }
         self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
 
@@ -1197,11 +1269,12 @@ class Server:
         token = m.token
         phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
         if m.data.get("complete") and token["origin"] == self.rank:
-            # token made it all the way around
+            # token made it all the way around; pass 2 validates against the
+            # globally-gathered parked list from pass 1
             ok = (
                 token["ok"]
                 and token["nparked"] > 0
-                and self._exhaust_vote()
+                and self._exhaust_vote(token["parked"])
                 and self.activity == token["act"].get(self.rank, -1)
             )
             if not ok:
@@ -1214,6 +1287,7 @@ class Server:
                     "ok": True,
                     "act": token["act"],
                     "nparked": token["nparked"],
+                    "parked": token["parked"],
                 }
                 self._forward_exhaust(Tag.SS_EXHAUST_CHK_2, token2)
             else:
@@ -1225,10 +1299,11 @@ class Server:
             token["ok"] = token["ok"] and self._exhaust_vote()
             token["act"][self.rank] = self.activity
             token["nparked"] = token.get("nparked", 0) + len(self.rq)
+            token["parked"] = token.get("parked", []) + self._parked_list()
         else:
             token["ok"] = (
                 token["ok"]
-                and self._exhaust_vote()
+                and self._exhaust_vote(token["parked"])
                 and self.activity == token["act"].get(self.rank, -1)
             )
         self._forward_exhaust(m.tag, token)

@@ -103,12 +103,17 @@ class Config:
     balancer_max_tasks: int = 256
     balancer_max_requesters: int = 64
     trace: bool = False  # event tracing hooks (reference MPE shims)
+    # server work-queue implementation: "auto" uses the C++ core when it
+    # builds, falling back to the pure-Python queues; "on" requires it
+    native_queues: str = "auto"
 
     def __post_init__(self) -> None:
         if self.balancer not in ("steal", "tpu"):
             raise ValueError(f"unknown balancer mode {self.balancer!r}")
         if self.put_routing not in ("round_robin", "home"):
             raise ValueError(f"unknown put routing {self.put_routing!r}")
+        if self.native_queues not in ("auto", "on", "off"):
+            raise ValueError(f"unknown native_queues {self.native_queues!r}")
 
 
 def normalize_req_types(

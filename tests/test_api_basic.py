@@ -17,7 +17,7 @@ TYPE_TASK = 1
 TYPE_RESULT = 2
 
 
-def _echo_world(nservers):
+def _echo_world(nservers, cfg=None):
     """Rank 0 produces, everyone consumes and echoes payloads back via
     answer-routed results; rank 0 validates the sum."""
 
@@ -61,7 +61,7 @@ def _echo_world(nservers):
             ctx.put(str(v).encode(), TYPE_RESULT, target_rank=0)
         return None
 
-    res = run_world(4, nservers, [TYPE_TASK, TYPE_RESULT], app2)
+    res = run_world(4, nservers, [TYPE_TASK, TYPE_RESULT], app2, cfg=cfg)
     assert res.app_results[0] == 2 * sum(range(NTASK))
 
 
@@ -71,6 +71,11 @@ def test_single_server_end_to_end():
 
 def test_multi_server_end_to_end():
     _echo_world(nservers=3)
+
+
+def test_multi_server_pure_python_queues():
+    # keep the Python work-queue path covered now that auto prefers native
+    _echo_world(nservers=3, cfg=Config(native_queues="off"))
 
 
 def test_priority_order_observed():
@@ -179,6 +184,26 @@ def test_exhaustion_termination():
 
     res = run_world(3, 2, [TYPE_TASK], app, cfg=Config(exhaust_check_interval=0.1))
     assert all(rc == ADLB_DONE_BY_EXHAUSTION for rc in res.app_results.values())
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_exhaustion_despite_orphaned_work(mode):
+    """Undeliverable leftovers (a type nobody requests) must not block the
+    exhaustion protocol — the reference exhausts with work still queued."""
+
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.put(b"orphan", TYPE_RESULT)  # nobody ever asks for this type
+        rc, _ = ctx.reserve([TYPE_TASK])
+        return rc
+
+    res = run_world(
+        3, 2, [TYPE_TASK, TYPE_RESULT], app,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.1), timeout=60,
+    )
+    assert all(
+        rc == ADLB_DONE_BY_EXHAUSTION for rc in res.app_results.values()
+    )
 
 
 def test_info_num_work_units():

@@ -1,0 +1,101 @@
+"""Native C++ work queue: build + behavioral parity with the Python queue."""
+
+import random
+
+import pytest
+
+from adlb_tpu.runtime.queues import WorkQueue, WorkUnit
+
+native = pytest.importorskip("adlb_tpu.native")
+if not native.native_available():  # pragma: no cover
+    pytest.skip("native core failed to build", allow_module_level=True)
+
+from adlb_tpu.native.wq import NativeWorkQueue  # noqa: E402
+
+
+def mk(seqno, wtype=1, prio=0, target=-1, payload=b"x"):
+    return WorkUnit(
+        seqno=seqno, work_type=wtype, prio=prio, target_rank=target,
+        answer_rank=-1, payload=payload,
+    )
+
+
+def mirror_pair():
+    return WorkQueue(), NativeWorkQueue()
+
+
+def test_basic_match_and_pin():
+    py, nat = mirror_pair()
+    for q in (py, nat):
+        q.add(mk(1, prio=5))
+        q.add(mk(2, prio=9, target=3))
+    assert py.find_match(3, None).seqno == nat.find_match(3, None).seqno == 2
+    assert py.find_match(0, None).seqno == nat.find_match(0, None).seqno == 1
+    for q in (py, nat):
+        q.pin(1, 0)
+    assert py.find_match(0, None) is None and nat.find_match(0, None) is None
+    for q in (py, nat):
+        q.unpin(1)
+    assert nat.find_match(0, None).seqno == 1
+
+
+def test_randomized_parity_with_python_queue():
+    rng = random.Random(99)
+    py, nat = mirror_pair()
+    alive: dict[int, WorkUnit] = {}
+    seqno = 0
+    for step in range(4000):
+        op = rng.random()
+        if op < 0.45 or not alive:
+            seqno += 1
+            u1 = mk(seqno, wtype=rng.randint(1, 4), prio=rng.randint(-9, 9),
+                    target=rng.choice([-1, -1, -1, 0, 1, 2]),
+                    payload=b"p" * rng.randint(0, 32))
+            u2 = mk(u1.seqno, u1.work_type, u1.prio, u1.target_rank,
+                    u1.payload)
+            py.add(u1)
+            nat.add(u2)
+            alive[seqno] = u1
+        elif op < 0.72:
+            rank = rng.randint(0, 2)
+            req = rng.choice(
+                [None, frozenset([1]), frozenset([2, 3]), frozenset([4, 1])]
+            )
+            a = py.find_match(rank, req)
+            b = nat.find_match(rank, req)
+            assert (a is None) == (b is None), f"step {step}"
+            if a is not None:
+                assert a.seqno == b.seqno, f"step {step}"
+        elif op < 0.86:
+            s = rng.choice(list(alive))
+            if alive[s].pinned:
+                py.unpin(s)
+                nat.unpin(s)
+            else:
+                py.pin(s, 0)
+                nat.pin(s, 0)
+        else:
+            s = rng.choice(list(alive))
+            py.remove(s)
+            nat.remove(s)
+            del alive[s]
+        if step % 500 == 0:
+            assert py.count == nat.count == len(alive)
+            for t in range(1, 5):
+                assert py.hi_prio_of_type(t) == nat.hi_prio_of_type(t)
+            assert (
+                py.num_unpinned_untargeted() == nat.num_unpinned_untargeted()
+            )
+            assert py.num_unpinned() == nat.num_unpinned()
+
+
+def test_snapshot_untargeted_sorted():
+    _, nat = mirror_pair()
+    nat.add(mk(1, prio=3))
+    nat.add(mk(2, prio=9))
+    nat.add(mk(3, prio=9))
+    nat.add(mk(4, prio=1, target=5))  # targeted: excluded
+    nat.pin(1, 0)  # pinned: excluded
+    snap = nat.snapshot_untargeted(cap=8)
+    assert [s[0] for s in snap] == [2, 3]
+    assert [s[2] for s in snap] == [9, 9]
