@@ -18,6 +18,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 from adlb_tpu.runtime.messages import Msg
@@ -117,17 +118,25 @@ class TcpEndpoint:
 
     def close(self) -> None:
         self._closed = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
         with self._out_lock:
             for s in self._out.values():
+                # Outbound sockets are unidirectional (replies arrive on the
+                # peer's own connection to our listener), so they never hold
+                # unread inbound data and close() can't RST away buffered
+                # frames; shutdown(SHUT_WR) makes the FIN-after-data explicit.
+                try:
+                    s.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
                 try:
                     s.close()
                 except OSError:
                     pass
             self._out.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
 
 
 def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str, int]]:
@@ -143,3 +152,189 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
     for s in socks:
         s.close()
     return addr_map
+
+
+# --------------------------------------------------------------- spawn_world
+
+
+def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
+    """One rank's process body: bind, rendezvous, run role, report result.
+
+    Exactly one message goes on result_q per rank — the parent counts ranks,
+    so a success followed by a teardown error must not report twice.
+    """
+    reported = False
+
+    def report(kind, value):
+        nonlocal reported
+        if not reported:
+            reported = True
+            result_q.put((kind, rank, value))
+
+    ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)})
+    try:
+        port_q.put((rank, ep.port))
+        ep.addr_map.update(conn.recv())  # full rank -> (host, port) map
+        if world.is_app(rank):
+            from adlb_tpu.api import AdlbContext
+            from adlb_tpu.runtime.client import Client
+
+            client = Client(world, cfg, ep, abort_event)
+            try:
+                report("app", app_fn(AdlbContext(client)))
+            finally:
+                try:
+                    client.finalize()
+                except Exception:  # home server already gone: benign
+                    pass
+        elif world.is_server(rank):
+            from adlb_tpu.runtime.server import Server
+
+            server = Server(world, cfg, ep, abort_event)
+            server.run()
+            report("server", server.finalize_stats())
+        else:
+            from adlb_tpu.runtime.debug_server import DebugServer
+
+            DebugServer(world, cfg, ep, abort_event).run()
+            report("debug", None)
+    except BaseException as e:  # noqa: BLE001 — surfaced to the parent
+        try:
+            from adlb_tpu.types import AdlbAborted
+
+            if isinstance(e, AdlbAborted):
+                report("aborted", e.code)
+            else:
+                abort_event.set()
+                report("error", repr(e))
+        except Exception:  # pragma: no cover
+            pass
+    finally:
+        ep.close()
+
+
+def spawn_world(
+    num_app_ranks: int,
+    nservers: int,
+    types,
+    app_fn,
+    cfg=None,
+    use_debug_server: bool = False,
+    timeout: float = 120.0,
+    start_method: str = "fork",
+):
+    """Run a world with one OS process per rank over TCP — the analogue of
+    ``mpiexec -n k`` for the reference's examples (reference
+    ``examples/README-batcher.txt:57``), and the building block for
+    multi-host deployment (replace the port rendezvous with a shared file).
+
+    Returns :class:`adlb_tpu.api.WorldResult`. With ``start_method="spawn"``
+    the ``app_fn`` must be picklable (module-level).
+    """
+    import multiprocessing as mp
+
+    from adlb_tpu.api import WorldResult
+    from adlb_tpu.runtime.world import Config, WorldSpec
+
+    cfg = cfg or Config()
+    world = WorldSpec(
+        nranks=num_app_ranks + nservers + (1 if use_debug_server else 0),
+        nservers=nservers,
+        types=tuple(types),
+        use_debug_server=use_debug_server,
+    )
+    ctx = mp.get_context(start_method)
+    port_q = ctx.Queue()
+    result_q = ctx.Queue()
+    abort_event = ctx.Event()
+    pipes = {}
+    procs = {}
+    for rank in range(world.nranks):
+        parent_end, child_end = ctx.Pipe()
+        pipes[rank] = parent_end
+        p = ctx.Process(
+            target=_child_main,
+            args=(rank, world, cfg, app_fn, port_q, child_end, result_q,
+                  abort_event),
+            name=f"adlb-rank-{rank}",
+        )
+        procs[rank] = p
+        p.start()
+
+    deadline = time.monotonic() + timeout
+    addr_map = {}
+    try:
+        while len(addr_map) < world.nranks:
+            try:
+                rank, port = port_q.get(timeout=0.25)
+            except queue.Empty:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "spawn_world: rendezvous did not complete"
+                    ) from None
+                dead = [r for r, p in procs.items()
+                        if not p.is_alive() and r not in addr_map]
+                if dead:
+                    # surface the child's real startup error if it reported one
+                    detail = ""
+                    try:
+                        kind, r, value = result_q.get(timeout=0.25)
+                        if kind == "error":
+                            detail = f": rank {r}: {value}"
+                    except queue.Empty:
+                        pass
+                    raise RuntimeError(
+                        f"spawn_world: rank(s) {dead} died before "
+                        f"rendezvous{detail}"
+                    )
+                continue
+            addr_map[rank] = ("127.0.0.1", port)
+        for conn in pipes.values():
+            conn.send(addr_map)
+    except Exception:
+        abort_event.set()
+        for p in procs.values():
+            p.terminate()
+        raise
+
+    app_results, server_stats = {}, {}
+    errors: list[str] = []
+    aborted_code = None
+    reported: set[int] = set()
+    while len(reported) < world.nranks:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            abort_event.set()
+            errors.append(f"world did not finish within {timeout}s")
+            break
+        try:
+            kind, rank, value = result_q.get(timeout=min(remaining, 1.0))
+        except queue.Empty:
+            if all(not p.is_alive() for p in procs.values()):
+                break  # a rank died without reporting (e.g. hard abort)
+            continue
+        reported.add(rank)
+        if kind == "app":
+            app_results[rank] = value
+        elif kind == "server":
+            server_stats[rank] = value
+        elif kind == "error":
+            errors.append(f"rank {rank}: {value}")
+        elif kind == "aborted":
+            aborted_code = value
+
+    for p in procs.values():
+        p.join(timeout=max(deadline - time.monotonic(), 1.0))
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=5.0)
+
+    result = WorldResult(
+        app_results=app_results,
+        server_stats=server_stats,
+        aborted=abort_event.is_set() or aborted_code is not None,
+        exception=RuntimeError("; ".join(errors)) if errors else None,
+    )
+    if errors:
+        raise RuntimeError("; ".join(errors))
+    return result

@@ -55,6 +55,38 @@ class NqResult:
     tasks_per_sec: float
 
 
+def app_main(ctx, n: int, max_depth_for_puts: int):
+    """Per-rank worker body: returns (solutions, tasks_processed, puts)."""
+    fmt = f"<{n}i"
+    processed = 0
+    puts = 0
+    solutions = 0
+    if ctx.rank == 0:
+        ctx.put(struct.pack(fmt, *([-1] * n)), WORK, work_prio=0)
+        puts += 1
+    while True:
+        rc, r = ctx.reserve([WORK])
+        if rc != ADLB_SUCCESS:
+            return solutions, processed, puts
+        rc, buf = ctx.get_reserved(r.handle)
+        rows = list(struct.unpack(fmt, buf))
+        processed += 1
+        col = n
+        for i in range(n):
+            if rows[i] < 0:
+                col = i
+                break
+        if col <= max_depth_for_puts and col < n:
+            for row in range(n):
+                if _safe(col, row, rows):
+                    rows[col] = row
+                    ctx.put(struct.pack(fmt, *rows), WORK, work_prio=col)
+                    puts += 1
+                    rows[col] = -1
+        else:
+            solutions += _count_subtree(n, rows, col)
+
+
 def run(
     n: int = 8,
     num_app_ranks: int = 4,
@@ -63,36 +95,8 @@ def run(
     cfg: Optional[Config] = None,
     timeout: float = 120.0,
 ) -> NqResult:
-    fmt = f"<{n}i"
-
     def app(ctx):
-        processed = 0
-        puts = 0
-        solutions = 0
-        if ctx.rank == 0:
-            ctx.put(struct.pack(fmt, *([-1] * n)), WORK, work_prio=0)
-            puts += 1
-        while True:
-            rc, r = ctx.reserve([WORK])
-            if rc != ADLB_SUCCESS:
-                return solutions, processed, puts
-            rc, buf = ctx.get_reserved(r.handle)
-            rows = list(struct.unpack(fmt, buf))
-            processed += 1
-            col = n
-            for i in range(n):
-                if rows[i] < 0:
-                    col = i
-                    break
-            if col <= max_depth_for_puts and col < n:
-                for row in range(n):
-                    if _safe(col, row, rows):
-                        rows[col] = row
-                        ctx.put(struct.pack(fmt, *rows), WORK, work_prio=col)
-                        puts += 1
-                        rows[col] = -1
-            else:
-                solutions += _count_subtree(n, rows, col)
+        return app_main(ctx, n, max_depth_for_puts)
 
     t0 = time.monotonic()
     res = run_world(

@@ -1,0 +1,85 @@
+"""Multi-process TCP worlds: the analogue of the reference's `mpiexec -n k`
+single-host testing story (SURVEY §4 — MPI is the only fake-able boundary;
+here the TCP fabric is exercised for real, one OS process per rank)."""
+
+import pytest
+
+from adlb_tpu.runtime.messages import Tag, msg
+from adlb_tpu.runtime.transport_tcp import TcpEndpoint, spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_DONE_BY_EXHAUSTION, ADLB_SUCCESS
+
+
+def test_tcp_endpoint_roundtrip():
+    a = TcpEndpoint(0, {0: ("127.0.0.1", 0)})
+    b = TcpEndpoint(1, {1: ("127.0.0.1", 0)})
+    a.addr_map[1] = b.addr_map[1]
+    b.addr_map[0] = a.addr_map[0]
+    try:
+        a.send(1, msg(Tag.FA_PUT, 0, payload=b"x" * 100000, work_type=1))
+        m = b.recv(timeout=5.0)
+        assert m is not None and m.tag is Tag.FA_PUT
+        assert m.payload == b"x" * 100000
+        b.send(0, msg(Tag.TA_PUT_RESP, 1, rc=ADLB_SUCCESS))
+        m2 = a.recv(timeout=5.0)
+        assert m2 is not None and m2.rc == ADLB_SUCCESS
+    finally:
+        a.close()
+        b.close()
+
+
+def _producer_consumer(ctx):
+    """Rank 0 puts tagged units; everyone consumes until exhaustion."""
+    made = 0
+    if ctx.rank == 0:
+        for i in range(40):
+            assert ctx.put(f"unit-{i}".encode(), work_type=1, work_prio=i) \
+                == ADLB_SUCCESS
+            made += 1
+    got = []
+    while True:
+        rc, res = ctx.reserve([1])
+        if rc != ADLB_SUCCESS:
+            assert rc == ADLB_DONE_BY_EXHAUSTION
+            break
+        rc2, buf = ctx.get_reserved(res.handle)
+        assert rc2 == ADLB_SUCCESS
+        got.append(buf.decode())
+    return made, got
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_spawn_world_exhaustion(mode):
+    r = spawn_world(
+        num_app_ranks=3,
+        nservers=2,
+        types=[1],
+        app_fn=_producer_consumer,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    assert set(r.app_results) == {0, 1, 2}
+    all_got = [u for _, got in r.app_results.values() for u in got]
+    assert sorted(all_got) == sorted(f"unit-{i}" for i in range(40))
+    assert len(r.server_stats) == 2
+
+
+def _nq_app(ctx):
+    from adlb_tpu.workloads import nq
+
+    return nq.app_main(ctx, n=6, max_depth_for_puts=2)
+
+
+def test_spawn_world_nq_known_answer():
+    from adlb_tpu.workloads import nq
+
+    r = spawn_world(
+        num_app_ranks=3,
+        nservers=2,
+        types=[nq.WORK],
+        app_fn=_nq_app,
+        cfg=Config(exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    total = sum(s for s, _, _ in r.app_results.values())
+    assert total == nq.KNOWN_SOLUTIONS[6]
