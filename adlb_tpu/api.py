@@ -79,6 +79,9 @@ class AdlbContext:
     def info_num_work_units(self, work_type: int):
         return self._c.info_num_work_units(work_type)
 
+    def info_get(self, key) -> tuple[int, float]:
+        return self._c.info_get(int(key))
+
     def abort(self, code: int) -> None:
         self._c.abort(code)
 

@@ -1,0 +1,205 @@
+"""Build + run harness for the native C client library.
+
+``libadlb.so`` implements the public C API (include/adlb/adlb.h) over the
+binary wire codec; this module compiles it (plain g++, same no-machinery
+spirit as the wq core build) and runs mixed worlds: Python servers on the
+TCP fabric + native client processes, rendezvousing through a file — the
+moral equivalent of the reference's `mpiexec -n k ./a.out` launch
+(reference examples/README-batcher.txt:57).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Sequence
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_DIR))
+_SRC = os.path.join(_DIR, "libadlb.cpp")
+_FSRC = os.path.join(_DIR, "adlbf.c")
+_LIB = os.path.join(_DIR, "libadlb.so")
+_INCLUDE = os.path.join(_REPO, "include")
+
+_lock = threading.Lock()
+
+
+def build_libadlb() -> str:
+    """Compile libadlb.so (cached by mtime); returns its path."""
+    with _lock:
+        srcs = [_SRC] + ([_FSRC] if os.path.exists(_FSRC) else [])
+        deps = srcs + [os.path.join(_INCLUDE, "adlb", "adlb.h")]
+        newest = max(os.path.getmtime(s) for s in deps)
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= newest:
+            return _LIB
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            f"-I{_INCLUDE}", "-o", tmp, *srcs,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"libadlb build failed:\n{e.stderr}") from e
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return _LIB
+
+
+def build_example(src: str, out: Optional[str] = None) -> str:
+    """Compile a C example against libadlb; returns the binary path."""
+    build_libadlb()
+    out = out or os.path.join(
+        tempfile.gettempdir(),
+        "adlb_" + os.path.splitext(os.path.basename(src))[0],
+    )
+    if os.path.exists(out) and os.path.getmtime(out) >= max(
+        os.path.getmtime(src), os.path.getmtime(_LIB)
+    ):
+        return out
+    cmd = [
+        "gcc", "-O2", f"-I{_INCLUDE}", "-o", out, src,
+        f"-L{_DIR}", "-ladlb", f"-Wl,-rpath,{_DIR}",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"example build failed:\n{e.stderr}") from e
+    return out
+
+
+def run_native_world(
+    n_clients: int,
+    nservers: int,
+    types: Sequence[int],
+    exe: str,
+    cfg=None,
+    use_debug_server: bool = False,
+    env_extra: Optional[dict] = None,
+    timeout: float = 120.0,
+):
+    """Python servers (threads) + native client processes (one per app rank).
+
+    Returns (results: list of (returncode, stdout, stderr) per client,
+    server_stats: dict rank -> stats).
+    """
+    from adlb_tpu.runtime.debug_server import DebugServer
+    from adlb_tpu.runtime.server import Server
+    from adlb_tpu.runtime.transport_tcp import TcpEndpoint, local_addr_map
+    from adlb_tpu.runtime.world import Config, WorldSpec
+
+    cfg = cfg or Config()
+    world = WorldSpec(
+        nranks=n_clients + nservers + (1 if use_debug_server else 0),
+        nservers=nservers,
+        types=tuple(types),
+        use_debug_server=use_debug_server,
+    )
+    addr_map = local_addr_map(world.nranks)
+    binary = set(range(n_clients))  # native ranks speak the TLV codec
+    abort_event = threading.Event()
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".adlb", delete=False
+    ) as f:
+        for r, (host, port) in sorted(addr_map.items()):
+            f.write(f"{r} {host} {port}\n")
+        rendezvous = f.name
+
+    server_stats: dict[int, dict] = {}
+    errors: list[BaseException] = []
+    # bind every Python listener BEFORE any rank starts sending: a server's
+    # first DS_LOG can otherwise race the debug server's bind and die on
+    # connection-refused
+    endpoints = {
+        rank: TcpEndpoint(rank, addr_map, binary_peers=binary)
+        for rank in (
+            list(world.server_ranks)
+            + ([world.debug_server_rank] if use_debug_server else [])
+        )
+    }
+
+    def server_main(rank: int) -> None:
+        try:
+            server = Server(world, cfg, endpoints[rank], abort_event)
+            server.run()
+            server_stats[rank] = server.finalize_stats()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            abort_event.set()
+
+    def debug_main(rank: int) -> None:
+        DebugServer(world, cfg, endpoints[rank], abort_event).run()
+
+    threads = []
+    for rank in world.server_ranks:
+        t = threading.Thread(target=server_main, args=(rank,), daemon=True)
+        threads.append(t)
+        t.start()
+    if use_debug_server:
+        t = threading.Thread(
+            target=debug_main, args=(world.debug_server_rank,), daemon=True
+        )
+        threads.append(t)
+        t.start()
+
+    env = dict(os.environ)
+    env["ADLB_RENDEZVOUS"] = rendezvous
+    env["ADLB_NUM_SERVERS"] = str(nservers)
+    if use_debug_server:
+        env["ADLB_USE_DEBUG_SERVER"] = "1"
+    env.update(env_extra or {})
+
+    procs = []
+    for rank in range(n_clients):
+        e = dict(env)
+        e["ADLB_RANK"] = str(rank)
+        procs.append(
+            subprocess.Popen(
+                [exe],
+                env=e,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+
+    import time as _time
+
+    results = []
+    deadline = _time.monotonic() + timeout  # shared wall-clock bound
+    try:
+        for p in procs:
+            out, err = p.communicate(
+                timeout=max(deadline - _time.monotonic(), 0.1)
+            )
+            results.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        abort_event.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                out, err = p.communicate()
+                results.append((-9, out, err))
+        raise TimeoutError(
+            f"native world did not finish within {timeout}s; "
+            f"client outputs: {results}"
+        )
+    finally:
+        for t in threads:
+            t.join(timeout=15.0)
+        if any(t.is_alive() for t in threads):
+            abort_event.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        for ep in endpoints.values():
+            ep.close()
+        os.unlink(rendezvous)
+
+    if errors:
+        raise errors[0]
+    return results, server_stats

@@ -1,0 +1,702 @@
+// Native client library: the C API of include/adlb/adlb.h over the binary
+// TLV wire codec (twin of adlb_tpu/runtime/codec.py — keep tables in sync).
+//
+// This is the native equivalent of the reference's client-side protocol
+// engine (reference src/adlb.c:2638-3176): Put routing + reject/retry with
+// least-loaded hints, blocking/non-blocking Reserve, Get_reserved with
+// batch-common prefix fetch, batch puts, Info queries, finalize/abort —
+// re-targeted from tagged MPI sends to the framework's TCP fabric.
+//
+// Threads: one acceptor + one reader per inbound connection feed a single
+// inbox (deque + condvar); the API itself is strictly request/response like
+// the reference's client (blocking MPI_Wait), so no other locking is needed.
+// Little-endian hosts assumed (as is the Python struct '<' side).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../../include/adlb/adlb.h"
+
+namespace {
+
+// ---- wire tags (codec.py WIRE_TAG) ----------------------------------------
+enum WireTag : uint16_t {
+  T_FA_PUT = 1001,
+  T_FA_PUT_COMMON = 1003,
+  T_FA_BATCH_DONE = 1005,
+  T_FA_DID_PUT_AT_REMOTE = 1006,
+  T_FA_RESERVE = 1007,
+  T_TA_RESERVE_RESP = 1008,
+  T_FA_GET_RESERVED = 1009,
+  T_TA_GET_RESERVED_RESP = 1010,
+  T_FA_NO_MORE_WORK = 1011,
+  T_FA_LOCAL_APP_DONE = 1012,
+  T_TA_PUT_RESP = 1020,
+  T_FA_ABORT = 1027,
+  T_FA_INFO_NUM_WORK_UNITS = 1037,
+  T_FA_GET_COMMON = 1038,
+  T_TA_GET_COMMON_RESP = 1039,
+  T_FA_INFO_GET = 1041,
+  T_TA_PUT_COMMON_RESP = 1042,
+  T_TA_INFO_NUM_RESP = 1043,
+  T_TA_INFO_GET_RESP = 1044,
+  T_TA_ABORT = 1046,
+};
+
+// ---- field ids (codec.py FIELDS) ------------------------------------------
+enum Field : uint8_t {
+  F_PAYLOAD = 1,
+  F_WORK_TYPE = 2,
+  F_PRIO = 3,
+  F_TARGET_RANK = 4,
+  F_ANSWER_RANK = 5,
+  F_COMMON_LEN = 6,
+  F_COMMON_SERVER = 7,
+  F_COMMON_SEQNO = 8,
+  F_RC = 9,
+  F_HINT = 10,
+  F_REQ_TYPES = 11,
+  F_HANG = 12,
+  F_RQSEQNO = 13,
+  F_HANDLE = 14,
+  F_WORK_LEN = 15,
+  F_TIME_ON_Q = 16,
+  F_COUNT = 17,
+  F_NBYTES = 18,
+  F_MAX_WQ = 19,
+  F_CODE = 20,
+  F_SEQNO = 21,
+  F_REFCNT = 22,
+  F_SERVER_RANK = 23,
+  F_KEY = 24,
+  F_VALUE = 25,
+};
+
+enum Kind : uint8_t { K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3 };
+
+constexpr uint8_t BINARY_MAGIC = 0x01;
+
+struct Msg {
+  uint16_t tag = 0;
+  int32_t src = -1;
+  std::map<uint8_t, int64_t> ints;
+  std::map<uint8_t, double> dbls;
+  std::map<uint8_t, std::string> blobs;
+  std::map<uint8_t, std::vector<int64_t>> lists;
+
+  int64_t geti(uint8_t f, int64_t dflt = 0) const {
+    auto it = ints.find(f);
+    return it == ints.end() ? dflt : it->second;
+  }
+};
+
+// ---- encoding -------------------------------------------------------------
+
+void put_u16(std::string &b, uint16_t v) { b.append((const char *)&v, 2); }
+void put_u32(std::string &b, uint32_t v) { b.append((const char *)&v, 4); }
+void put_i32(std::string &b, int32_t v) { b.append((const char *)&v, 4); }
+void put_i64(std::string &b, int64_t v) { b.append((const char *)&v, 8); }
+void put_f64(std::string &b, double v) { b.append((const char *)&v, 8); }
+
+struct Encoder {
+  std::string body;
+  uint16_t nfields = 0;
+
+  explicit Encoder(uint16_t tag, int32_t src) {
+    body.push_back((char)BINARY_MAGIC);
+    put_u16(body, tag);
+    put_i32(body, src);
+    put_u16(body, 0);  // nfields backpatched in finish()
+  }
+  Encoder &i(uint8_t f, int64_t v) {
+    body.push_back((char)f);
+    body.push_back((char)K_I64);
+    put_i64(body, v);
+    nfields++;
+    return *this;
+  }
+  Encoder &bytes(uint8_t f, const void *p, size_t n) {
+    body.push_back((char)f);
+    body.push_back((char)K_BYTES);
+    put_u32(body, (uint32_t)n);
+    body.append((const char *)p, n);
+    nfields++;
+    return *this;
+  }
+  Encoder &list(uint8_t f, const std::vector<int64_t> &v) {
+    body.push_back((char)f);
+    body.push_back((char)K_LIST);
+    put_u16(body, (uint16_t)v.size());
+    for (int64_t x : v) put_i64(body, x);
+    nfields++;
+    return *this;
+  }
+  std::string finish() {
+    memcpy(&body[7], &nfields, 2);  // offset of nfields in the header
+    return std::move(body);
+  }
+};
+
+bool decode(const std::string &body, Msg *out) {
+  if (body.size() < 9 || (uint8_t)body[0] != BINARY_MAGIC) return false;
+  size_t off = 1;
+  auto need = [&](size_t n) { return off + n <= body.size(); };
+  auto rd = [&](void *p, size_t n) {
+    memcpy(p, body.data() + off, n);
+    off += n;
+  };
+  uint16_t nf;
+  rd(&out->tag, 2);
+  rd(&out->src, 4);
+  rd(&nf, 2);
+  for (uint16_t k = 0; k < nf; k++) {
+    if (!need(2)) return false;
+    uint8_t fid = body[off], kind = body[off + 1];
+    off += 2;
+    if (kind == K_I64) {
+      if (!need(8)) return false;
+      int64_t v;
+      rd(&v, 8);
+      out->ints[fid] = v;
+    } else if (kind == K_BYTES) {
+      if (!need(4)) return false;
+      uint32_t n;
+      rd(&n, 4);
+      if (!need(n)) return false;
+      out->blobs[fid].assign(body.data() + off, n);
+      off += n;
+    } else if (kind == K_LIST) {
+      if (!need(2)) return false;
+      uint16_t cnt;
+      rd(&cnt, 2);
+      if (!need((size_t)8 * cnt)) return false;
+      auto &lst = out->lists[fid];
+      lst.resize(cnt);
+      for (uint16_t j = 0; j < cnt; j++) rd(&lst[j], 8);
+    } else if (kind == K_F64) {
+      if (!need(8)) return false;
+      double v;
+      rd(&v, 8);
+      out->dbls[fid] = v;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---- context --------------------------------------------------------------
+
+struct Ctx {
+  int rank = -1, nranks = 0, nservers = 0, num_app_ranks = 0, home = -1;
+  int aprintf_flag = 0;
+  std::vector<int> types;
+  std::vector<std::pair<std::string, int>> addr;  // per rank
+
+  int listen_fd = -1;
+  std::thread acceptor;
+  std::vector<std::thread> readers;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Msg> inbox;
+  std::map<int, int> out_fds;
+  std::atomic<bool> closed{false};
+
+  int rr = 0;       // round-robin cursor over servers
+  int rqseqno = 0;  // reserve sequence number
+  // batch-put state (reference src/adlb.c:2638-2751)
+  bool batch_active = false;
+  int batch_server = -1, batch_len = 0, batch_refcnt = 0;
+  int64_t batch_seqno = -1;
+};
+
+Ctx *g = nullptr;
+
+void die(const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "[adlb rank %d] ", g ? g->rank : -1);
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(1);
+}
+
+// ---- sockets --------------------------------------------------------------
+
+bool read_exact(int fd, void *p, size_t n) {
+  char *c = (char *)p;
+  while (n > 0) {
+    ssize_t r = read(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void *p, size_t n) {
+  const char *c = (const char *)p;
+  while (n > 0) {
+    ssize_t r = write(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void reader_loop(int fd) {
+  for (;;) {
+    uint32_t len;
+    if (!read_exact(fd, &len, 4)) break;
+    std::string body(len, '\0');
+    if (!read_exact(fd, &body[0], len)) break;
+    Msg m;
+    if ((uint8_t)body[0] != BINARY_MAGIC) {
+      // A pickled frame can only reach a native client as an unsolicited
+      // server->client message, and the only unsolicited message is
+      // TA_ABORT: treat it as one.
+      m.tag = T_TA_ABORT;
+      m.ints[F_CODE] = ADLB_ERROR;
+    } else if (!decode(body, &m)) {
+      die("undecodable binary frame (%u bytes)", len);
+    }
+    {
+      std::lock_guard<std::mutex> lk(g->mu);
+      g->inbox.push_back(std::move(m));
+    }
+    g->cv.notify_all();
+  }
+  close(fd);
+}
+
+void accept_loop() {
+  for (;;) {
+    int fd = accept(g->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (g->closed.load()) return;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::lock_guard<std::mutex> lk(g->mu);
+    g->readers.emplace_back(reader_loop, fd);
+  }
+}
+
+int connect_to(int dest) {
+  auto &hp = g->addr[dest];
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char port[16];
+  snprintf(port, sizeof port, "%d", hp.second);
+  // servers may come up after us: retry with backoff for ~15 s
+  for (int attempt = 0; attempt < 60; attempt++) {
+    if (getaddrinfo(hp.first.c_str(), port, &hints, &res) == 0) {
+      int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        freeaddrinfo(res);
+        return fd;
+      }
+      if (fd >= 0) close(fd);
+      freeaddrinfo(res);
+      res = nullptr;
+    }
+    usleep(250 * 1000);
+  }
+  die("cannot connect to rank %d at %s:%d", dest, hp.first.c_str(), hp.second);
+  return -1;
+}
+
+void send_msg(int dest, Encoder &enc) {
+  std::string body = enc.finish();
+  uint32_t len = (uint32_t)body.size();
+  auto it = g->out_fds.find(dest);
+  int fd = it == g->out_fds.end() ? -1 : it->second;
+  if (fd < 0) {
+    fd = connect_to(dest);
+    g->out_fds[dest] = fd;
+  }
+  if (!write_all(fd, &len, 4) || !write_all(fd, body.data(), body.size())) {
+    close(fd);
+    fd = connect_to(dest);  // one reconnect attempt
+    g->out_fds[dest] = fd;
+    if (!write_all(fd, &len, 4) || !write_all(fd, body.data(), body.size()))
+      die("send to rank %d failed", dest);
+  }
+}
+
+// Blocks until a frame with `want` arrives.  TA_ABORT terminates the process
+// (the reference client dies inside MPI_Abort in the same situation,
+// reference src/adlb.c:3165-3176).
+Msg wait_for(uint16_t want) {
+  std::unique_lock<std::mutex> lk(g->mu);
+  for (;;) {
+    g->cv.wait(lk, [] { return !g->inbox.empty(); });
+    Msg m = std::move(g->inbox.front());
+    g->inbox.pop_front();
+    if (m.tag == T_TA_ABORT) {
+      int code = (int)m.geti(F_CODE, ADLB_ERROR);
+      fprintf(stderr, "[adlb rank %d] world aborted (code %d)\n", g->rank,
+              code);
+      exit(code == 0 ? 1 : (code < 0 ? -code : code));
+    }
+    if (m.tag == want) return m;
+    die("unexpected tag %u while waiting for %u", m.tag, want);
+  }
+}
+
+int home_server(int app_rank) {
+  return g->num_app_ranks + (app_rank % g->nservers);
+}
+
+int next_server() {
+  int s = g->num_app_ranks + g->rr;
+  g->rr = (g->rr + 1) % g->nservers;
+  return s;
+}
+
+bool valid_type(int t) {
+  for (int x : g->types)
+    if (x == t) return true;
+  return false;
+}
+
+}  // namespace
+
+// ---- public API -----------------------------------------------------------
+
+extern "C" {
+
+int ADLBP_Init(int num_servers, int use_debug_server, int aprintf_flag,
+               int ntypes, int type_vect[], int *am_server,
+               int *am_debug_server, int *num_app_ranks) {
+  if (g) return ADLB_ERROR;
+  const char *rv = getenv("ADLB_RENDEZVOUS");
+  const char *rk = getenv("ADLB_RANK");
+  if (!rv || !rk) {
+    fprintf(stderr, "adlb: ADLB_RENDEZVOUS and ADLB_RANK must be set\n");
+    return ADLB_ERROR;
+  }
+  g = new Ctx();
+  g->rank = atoi(rk);
+  g->aprintf_flag = aprintf_flag;
+  g->types.assign(type_vect, type_vect + ntypes);
+
+  FILE *f = fopen(rv, "r");
+  if (!f) die("cannot open rendezvous file %s", rv);
+  int r, port;
+  char host[256];
+  int maxrank = -1;
+  std::map<int, std::pair<std::string, int>> entries;
+  while (fscanf(f, "%d %255s %d", &r, host, &port) == 3) {
+    entries[r] = {host, port};
+    if (r > maxrank) maxrank = r;
+  }
+  fclose(f);
+  g->nranks = maxrank + 1;
+  g->addr.resize(g->nranks);
+  for (auto &kv : entries) g->addr[kv.first] = kv.second;
+  g->nservers = num_servers;
+  g->num_app_ranks = g->nranks - num_servers - (use_debug_server ? 1 : 0);
+  if (g->rank < 0 || g->rank >= g->num_app_ranks)
+    die("ADLB_RANK %d is not an app rank (0..%d)", g->rank,
+        g->num_app_ranks - 1);
+  g->home = home_server(g->rank);
+  g->rr = g->rank % g->nservers;
+
+  // bind our listener at the advertised address
+  g->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(g->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  sa.sin_port = htons((uint16_t)g->addr[g->rank].second);
+  if (bind(g->listen_fd, (struct sockaddr *)&sa, sizeof sa) != 0)
+    die("cannot bind port %d", g->addr[g->rank].second);
+  if (listen(g->listen_fd, 64) != 0) die("listen failed");
+  g->acceptor = std::thread(accept_loop);
+
+  if (am_server) *am_server = 0;
+  if (am_debug_server) *am_debug_server = 0;
+  if (num_app_ranks) *num_app_ranks = g->num_app_ranks;
+  return ADLB_SUCCESS;
+}
+
+int ADLB_Init(int num_servers, int use_debug_server, int aprintf_flag,
+              int ntypes, int type_vect[], int *am_server,
+              int *am_debug_server, int *num_app_ranks) {
+  return ADLBP_Init(num_servers, use_debug_server, aprintf_flag, ntypes,
+                    type_vect, am_server, am_debug_server, num_app_ranks);
+}
+
+int ADLBP_Server(double, double) { return ADLB_ERROR; }
+int ADLB_Server(double a, double b) { return ADLBP_Server(a, b); }
+int ADLBP_Debug_server(double) { return ADLB_ERROR; }
+int ADLB_Debug_server(double t) { return ADLBP_Debug_server(t); }
+
+int ADLBP_Put(void *work_buf, int work_len, int target_rank, int answer_rank,
+              int work_type, int work_prio) {
+  if (!g) return ADLB_ERROR;
+  if (!valid_type(work_type)) die("Put of unregistered type %d", work_type);
+  if (g->batch_active) g->batch_refcnt++;
+  int server;
+  if (target_rank >= 0)
+    server = home_server(target_rank);
+  else
+    server = next_server();
+  int attempts = 0;
+  int rc;
+  for (;;) {
+    Encoder e(T_FA_PUT, g->rank);
+    e.bytes(F_PAYLOAD, work_buf, (size_t)work_len)
+        .i(F_WORK_TYPE, work_type)
+        .i(F_PRIO, work_prio)
+        .i(F_TARGET_RANK, target_rank)
+        .i(F_ANSWER_RANK, answer_rank)
+        .i(F_COMMON_LEN, g->batch_active ? g->batch_len : 0)
+        .i(F_COMMON_SERVER, g->batch_active ? g->batch_server : -1)
+        .i(F_COMMON_SEQNO, g->batch_active ? g->batch_seqno : -1);
+    send_msg(server, e);
+    Msg resp = wait_for(T_TA_PUT_RESP);
+    rc = (int)resp.geti(F_RC);
+    if (rc != ADLB_PUT_REJECTED) break;
+    if (++attempts > 10) {  // reference retry loop, src/adlb.c:2779-2796
+      if (g->batch_active) g->batch_refcnt--;
+      return ADLB_PUT_REJECTED;
+    }
+    int hint = (int)resp.geti(F_HINT, -1);
+    server = hint >= 0 ? hint : next_server();
+    usleep(2000);
+  }
+  if (rc != ADLB_SUCCESS && g->batch_active) g->batch_refcnt--;
+  if (rc == ADLB_SUCCESS && target_rank >= 0 &&
+      server != home_server(target_rank)) {
+    Encoder e(T_FA_DID_PUT_AT_REMOTE, g->rank);
+    e.i(F_TARGET_RANK, target_rank)
+        .i(F_WORK_TYPE, work_type)
+        .i(F_SERVER_RANK, server);
+    send_msg(home_server(target_rank), e);
+  }
+  return rc;
+}
+int ADLB_Put(void *b, int l, int t, int a, int w, int p) {
+  return ADLBP_Put(b, l, t, a, w, p);
+}
+
+static int reserve_impl(int *req_types, int *work_type, int *work_prio,
+                        int *work_handle, int *work_len, int *answer_rank,
+                        int hang) {
+  if (!g) return ADLB_ERROR;
+  std::vector<int64_t> types;
+  bool any = false;
+  if (!req_types || req_types[0] == ADLB_RESERVE_REQUEST_ANY) {
+    any = true;
+  } else {
+    for (int i = 0; i < 16 && req_types[i] != ADLB_RESERVE_EOL; i++) {
+      if (!valid_type(req_types[i]))
+        die("Reserve of unregistered type %d", req_types[i]);
+      types.push_back(req_types[i]);
+    }
+    if (types.empty()) any = true;
+  }
+  g->rqseqno++;
+  Encoder e(T_FA_RESERVE, g->rank);
+  e.i(F_HANG, hang).i(F_RQSEQNO, g->rqseqno);
+  if (!any) e.list(F_REQ_TYPES, types);
+  send_msg(g->home, e);
+  Msg resp = wait_for(T_TA_RESERVE_RESP);
+  int rc = (int)resp.geti(F_RC);
+  if (rc != ADLB_SUCCESS) return rc;
+  if (work_type) *work_type = (int)resp.geti(F_WORK_TYPE);
+  if (work_prio) *work_prio = (int)resp.geti(F_PRIO);
+  if (work_len) *work_len = (int)resp.geti(F_WORK_LEN);
+  if (answer_rank) *answer_rank = (int)resp.geti(F_ANSWER_RANK, -1);
+  auto it = resp.lists.find(F_HANDLE);
+  if (it == resp.lists.end() || it->second.size() != ADLB_HANDLE_SIZE)
+    die("malformed reserve handle");
+  for (int i = 0; i < ADLB_HANDLE_SIZE; i++)
+    work_handle[i] = (int)it->second[i];
+  return ADLB_SUCCESS;
+}
+
+int ADLBP_Reserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
+  return reserve_impl(rt, wt, wp, wh, wl, ar, 1);
+}
+int ADLB_Reserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
+  return reserve_impl(rt, wt, wp, wh, wl, ar, 1);
+}
+int ADLBP_Ireserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
+  return reserve_impl(rt, wt, wp, wh, wl, ar, 0);
+}
+int ADLB_Ireserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
+  return reserve_impl(rt, wt, wp, wh, wl, ar, 0);
+}
+
+int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
+                             double *time_on_queue) {
+  if (!g) return ADLB_ERROR;
+  // handle = {seqno, holder server, common_len, common_server, common_seqno}
+  // (reference src/adlb.c:2935-2947)
+  int64_t seqno = work_handle[0];
+  int holder = work_handle[1];
+  int common_len = work_handle[2];
+  int common_server = work_handle[3];
+  int64_t common_seqno = work_handle[4];
+  char *out = (char *)work_buf;
+  if (common_len > 0) {
+    Encoder e(T_FA_GET_COMMON, g->rank);
+    e.i(F_COMMON_SEQNO, common_seqno);
+    send_msg(common_server, e);
+    Msg resp = wait_for(T_TA_GET_COMMON_RESP);
+    const std::string &prefix = resp.blobs[F_PAYLOAD];
+    memcpy(out, prefix.data(), prefix.size());
+    out += prefix.size();
+  }
+  Encoder e(T_FA_GET_RESERVED, g->rank);
+  e.i(F_SEQNO, seqno);
+  send_msg(holder, e);
+  Msg resp = wait_for(T_TA_GET_RESERVED_RESP);
+  int rc = (int)resp.geti(F_RC);
+  if (rc != ADLB_SUCCESS) return rc;
+  const std::string &payload = resp.blobs[F_PAYLOAD];
+  memcpy(out, payload.data(), payload.size());
+  if (time_on_queue) {
+    auto it = resp.dbls.find(F_TIME_ON_Q);
+    *time_on_queue = it == resp.dbls.end() ? 0.0 : it->second;
+  }
+  return ADLB_SUCCESS;
+}
+int ADLB_Get_reserved_timed(void *b, int *h, double *t) {
+  return ADLBP_Get_reserved_timed(b, h, t);
+}
+int ADLBP_Get_reserved(void *b, int *h) {
+  return ADLBP_Get_reserved_timed(b, h, nullptr);
+}
+int ADLB_Get_reserved(void *b, int *h) {
+  return ADLBP_Get_reserved_timed(b, h, nullptr);
+}
+
+int ADLBP_Begin_batch_put(void *common_buf, int len_common) {
+  if (!g || g->batch_active) return ADLB_ERROR;
+  int server = next_server();
+  Encoder e(T_FA_PUT_COMMON, g->rank);
+  e.bytes(F_PAYLOAD, common_buf, (size_t)len_common);
+  send_msg(server, e);
+  Msg resp = wait_for(T_TA_PUT_COMMON_RESP);
+  int rc = (int)resp.geti(F_RC);
+  if (rc != ADLB_SUCCESS) return rc;
+  g->batch_active = true;
+  g->batch_server = server;
+  g->batch_len = len_common;
+  g->batch_seqno = resp.geti(F_COMMON_SEQNO, -1);
+  g->batch_refcnt = 0;
+  return ADLB_SUCCESS;
+}
+int ADLB_Begin_batch_put(void *b, int l) { return ADLBP_Begin_batch_put(b, l); }
+
+int ADLBP_End_batch_put(void) {
+  if (!g || !g->batch_active) return ADLB_ERROR;
+  Encoder e(T_FA_BATCH_DONE, g->rank);
+  e.i(F_COMMON_SEQNO, g->batch_seqno).i(F_REFCNT, g->batch_refcnt);
+  send_msg(g->batch_server, e);
+  g->batch_active = false;
+  return ADLB_SUCCESS;
+}
+int ADLB_End_batch_put(void) { return ADLBP_End_batch_put(); }
+
+int ADLBP_Set_problem_done(void) {
+  if (!g) return ADLB_ERROR;
+  Encoder e(T_FA_NO_MORE_WORK, g->rank);
+  send_msg(g->home, e);
+  return ADLB_SUCCESS;
+}
+int ADLB_Set_problem_done(void) { return ADLBP_Set_problem_done(); }
+int ADLBP_Set_no_more_work(void) { return ADLBP_Set_problem_done(); }
+int ADLB_Set_no_more_work(void) { return ADLBP_Set_problem_done(); }
+
+int ADLBP_Info_get(int key, double *value) {
+  if (!g) return ADLB_ERROR;
+  Encoder e(T_FA_INFO_GET, g->rank);
+  e.i(F_KEY, key);
+  send_msg(g->home, e);
+  Msg resp = wait_for(T_TA_INFO_GET_RESP);
+  if (value) {
+    auto it = resp.dbls.find(F_VALUE);
+    *value = it == resp.dbls.end() ? 0.0 : it->second;
+  }
+  return (int)resp.geti(F_RC);
+}
+int ADLB_Info_get(int k, double *v) { return ADLBP_Info_get(k, v); }
+
+int ADLBP_Info_num_work_units(int work_type, int *num_units, int *num_bytes,
+                              int *max_wq_count) {
+  if (!g) return ADLB_ERROR;
+  Encoder e(T_FA_INFO_NUM_WORK_UNITS, g->rank);
+  e.i(F_WORK_TYPE, work_type);
+  send_msg(g->home, e);
+  Msg resp = wait_for(T_TA_INFO_NUM_RESP);
+  if (num_units) *num_units = (int)resp.geti(F_COUNT);
+  if (num_bytes) *num_bytes = (int)resp.geti(F_NBYTES);
+  if (max_wq_count) *max_wq_count = (int)resp.geti(F_MAX_WQ);
+  return (int)resp.geti(F_RC);
+}
+int ADLB_Info_num_work_units(int w, int *n, int *b, int *m) {
+  return ADLBP_Info_num_work_units(w, n, b, m);
+}
+
+int ADLBP_Finalize(void) {
+  if (!g) return ADLB_ERROR;
+  Encoder e(T_FA_LOCAL_APP_DONE, g->rank);
+  send_msg(g->home, e);
+  g->closed.store(true);
+  for (auto &kv : g->out_fds) {
+    shutdown(kv.second, SHUT_WR);  // FIN after data; no unread inbound
+    close(kv.second);
+  }
+  shutdown(g->listen_fd, SHUT_RDWR);
+  close(g->listen_fd);
+  return ADLB_SUCCESS;
+}
+int ADLB_Finalize(void) { return ADLBP_Finalize(); }
+
+int ADLBP_Abort(int code) {
+  if (g) {
+    Encoder e(T_FA_ABORT, g->rank);
+    e.i(F_CODE, code);
+    send_msg(g->home, e);
+    usleep(100 * 1000);  // let the frame flush before hard exit
+  }
+  fprintf(stderr, "[adlb rank %d] ADLB_Abort(%d)\n", g ? g->rank : -1, code);
+  exit(code == 0 ? 1 : (code < 0 ? -code : code));
+}
+int ADLB_Abort(int code) { return ADLBP_Abort(code); }
+
+int ADLB_World_rank(void) { return g ? g->rank : -1; }
+int ADLB_World_size(void) { return g ? g->nranks : -1; }
+int ADLB_Num_app_ranks(void) { return g ? g->num_app_ranks : -1; }
+
+}  // extern "C"

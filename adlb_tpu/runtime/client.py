@@ -271,6 +271,13 @@ class Client:
         self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
         return ADLB_SUCCESS
 
+    def info_get(self, key: int) -> tuple[int, float]:
+        """One live stats value from this rank's home server (reference
+        ADLB_Info_get, ``src/adlb.c:3072-3141``)."""
+        self.ep.send(self.home, msg(Tag.FA_INFO_GET, self.rank, key=int(key)))
+        resp = self._wait(Tag.TA_INFO_GET_RESP)
+        return resp.rc, resp.value
+
     def info_num_work_units(self, work_type: int) -> tuple[int, int, int, int]:
         """(rc, count, total bytes, max wq count) at the home server
         (reference ``src/adlb.c:3027-3046``)."""

@@ -1,0 +1,194 @@
+"""Binary wire codec for the client<->server protocol.
+
+Python ranks exchange pickled `Msg` frames; native (C/C++/Fortran) clients
+speak this compact TLV codec instead — the moral equivalent of the
+reference's fixed-layout int-vector headers (``IBUF_NUMINTS``, reference
+``src/adlb.c:89-91``), but self-describing so the protocol can grow.
+
+Frame body layout (after the transport's u32 length prefix):
+
+    u8  magic      0x01  (pickle bodies start with 0x80 — the PROTO opcode —
+                          so the first byte discriminates the codec)
+    u16 tag        wire id (reference-style numbering, src/adlb.c:44-83)
+    i32 src        sender world rank
+    u16 nfields
+    then per field:
+      u8 field_id
+      u8 kind      0 = i64, 1 = bytes (u32 len + data), 2 = i64 list
+                   (u16 count + i64s), 3 = f64
+      ...value...
+
+All integers little-endian. A field absent from the frame is absent from
+``Msg.data`` (the Python side treats missing ``req_types`` as "any type",
+matching the reference's ADLB_RESERVE_REQUEST_ANY).
+
+The C twin of this file is ``adlb_tpu/native/libadlb.cpp``; keep the tables
+in sync.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from adlb_tpu.runtime.messages import Msg, Tag
+
+BINARY_MAGIC = 0x01
+PICKLE_MAGIC = 0x80  # pickle protocol >= 2 PROTO opcode
+
+# Wire ids: client-facing tags keep the reference's numbers where one exists
+# (reference src/adlb.c:44-83); the rest are assigned in the 11xx block.
+WIRE_TAG: dict[Tag, int] = {
+    Tag.FA_PUT: 1001,
+    Tag.FA_PUT_COMMON: 1003,
+    Tag.FA_BATCH_DONE: 1005,
+    Tag.FA_DID_PUT_AT_REMOTE: 1006,
+    Tag.FA_RESERVE: 1007,
+    Tag.FA_GET_RESERVED: 1009,
+    Tag.FA_NO_MORE_WORK: 1011,
+    Tag.FA_LOCAL_APP_DONE: 1012,
+    Tag.FA_ABORT: 1027,
+    Tag.FA_INFO_NUM_WORK_UNITS: 1037,
+    Tag.FA_GET_COMMON: 1038,
+    Tag.FA_INFO_GET: 1041,
+    Tag.TA_RESERVE_RESP: 1008,
+    Tag.TA_GET_RESERVED_RESP: 1010,
+    Tag.TA_PUT_RESP: 1020,
+    Tag.TA_GET_COMMON_RESP: 1039,
+    Tag.TA_PUT_COMMON_RESP: 1042,
+    Tag.TA_INFO_NUM_RESP: 1043,
+    Tag.TA_INFO_GET_RESP: 1044,
+    Tag.TA_ABORT: 1046,
+    # server<->server + balancer + debug tags (Python<->Python, normally
+    # pickled; ids exist so the codec is total)
+    Tag.SS_QMSTAT: 1101,
+    Tag.SS_RFR: 1102,
+    Tag.SS_RFR_RESP: 1103,
+    Tag.SS_UNRESERVE: 1104,
+    Tag.SS_PUSH_QUERY: 1105,
+    Tag.SS_PUSH_QUERY_RESP: 1106,
+    Tag.SS_PUSH_WORK: 1107,
+    Tag.SS_PUSH_DEL: 1108,
+    Tag.SS_MOVING_TARGETED_WORK: 1109,
+    Tag.SS_NO_MORE_WORK: 1110,
+    Tag.SS_EXHAUST_CHK_1: 1111,
+    Tag.SS_EXHAUST_CHK_2: 1112,
+    Tag.SS_DONE_BY_EXHAUSTION: 1113,
+    Tag.SS_END_1: 1114,
+    Tag.SS_END_2: 1115,
+    Tag.SS_ABORT: 1116,
+    Tag.SS_STATE: 1117,
+    Tag.SS_PLAN_MATCH: 1118,
+    Tag.SS_PLAN_MIGRATE: 1119,
+    Tag.SS_MIGRATE_WORK: 1120,
+    Tag.SS_MIGRATE_ACK: 1121,
+    Tag.DS_LOG: 1131,
+    Tag.DS_END: 1132,
+}
+TAG_FOR_WIRE = {v: k for k, v in WIRE_TAG.items()}
+
+_KIND_I64 = 0
+_KIND_BYTES = 1
+_KIND_LIST = 2
+_KIND_F64 = 3
+
+# field name -> (wire id, kind)
+FIELDS: dict[str, tuple[int, int]] = {
+    "payload": (1, _KIND_BYTES),
+    "work_type": (2, _KIND_I64),
+    "prio": (3, _KIND_I64),
+    "target_rank": (4, _KIND_I64),
+    "answer_rank": (5, _KIND_I64),
+    "common_len": (6, _KIND_I64),
+    "common_server": (7, _KIND_I64),
+    "common_seqno": (8, _KIND_I64),
+    "rc": (9, _KIND_I64),
+    "hint": (10, _KIND_I64),
+    "req_types": (11, _KIND_LIST),
+    "hang": (12, _KIND_I64),
+    "rqseqno": (13, _KIND_I64),
+    "handle": (14, _KIND_LIST),
+    "work_len": (15, _KIND_I64),
+    "time_on_q": (16, _KIND_F64),
+    "count": (17, _KIND_I64),
+    "nbytes": (18, _KIND_I64),
+    "max_wq": (19, _KIND_I64),
+    "code": (20, _KIND_I64),
+    "seqno": (21, _KIND_I64),
+    "refcnt": (22, _KIND_I64),
+    "server_rank": (23, _KIND_I64),
+    "key": (24, _KIND_I64),
+    "value": (25, _KIND_F64),
+}
+FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
+
+_HDR = struct.Struct("<BHiH")  # magic, tag, src, nfields
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
+
+
+def encodable(m: Msg) -> bool:
+    """True if every field of m has a binary field id (None values are
+    encoded by omission)."""
+    return all(k in FIELDS for k, v in m.data.items() if v is not None)
+
+
+def encode_binary(m: Msg) -> bytes:
+    fields = [(k, v) for k, v in m.data.items() if v is not None]
+    out = [_HDR.pack(BINARY_MAGIC, WIRE_TAG[m.tag], m.src, len(fields))]
+    for name, value in fields:
+        fid, kind = FIELDS[name]
+        out.append(struct.pack("<BB", fid, kind))
+        if kind == _KIND_I64:
+            out.append(_I64.pack(int(value)))
+        elif kind == _KIND_BYTES:
+            b = bytes(value)
+            out.append(_U32.pack(len(b)))
+            out.append(b)
+        elif kind == _KIND_LIST:
+            seq = [int(x) for x in value]
+            out.append(_U16.pack(len(seq)))
+            out.extend(_I64.pack(x) for x in seq)
+        else:
+            out.append(_F64.pack(float(value)))
+    return b"".join(out)
+
+
+def decode_binary(body: bytes) -> Msg:
+    magic, wire_tag, src, nfields = _HDR.unpack_from(body, 0)
+    if magic != BINARY_MAGIC:
+        raise ValueError(f"bad binary frame magic {magic:#x}")
+    tag = TAG_FOR_WIRE[wire_tag]
+    off = _HDR.size
+    data: dict = {}
+    for _ in range(nfields):
+        fid, kind = struct.unpack_from("<BB", body, off)
+        off += 2
+        if kind == _KIND_I64:
+            (value,) = _I64.unpack_from(body, off)
+            off += 8
+        elif kind == _KIND_BYTES:
+            (n,) = _U32.unpack_from(body, off)
+            off += 4
+            value = body[off:off + n]
+            off += n
+        elif kind == _KIND_LIST:
+            (cnt,) = _U16.unpack_from(body, off)
+            off += 2
+            value = [
+                _I64.unpack_from(body, off + 8 * i)[0] for i in range(cnt)
+            ]
+            off += 8 * cnt
+        elif kind == _KIND_F64:
+            (value,) = _F64.unpack_from(body, off)
+            off += 8
+        else:
+            raise ValueError(f"bad field kind {kind}")
+        entry = FIELD_FOR_WIRE.get(fid)
+        if entry is not None:  # unknown fields are skipped, not fatal
+            data[entry[0]] = value
+    # protocol-level conveniences: hang arrives as 0/1
+    if "hang" in data:
+        data["hang"] = bool(data["hang"])
+    return Msg(tag=tag, src=src, data=data)

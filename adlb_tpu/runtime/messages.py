@@ -35,6 +35,7 @@ class Tag(enum.Enum):
     FA_LOCAL_APP_DONE = enum.auto()
     FA_ABORT = enum.auto()
     FA_INFO_NUM_WORK_UNITS = enum.auto()
+    FA_INFO_GET = enum.auto()
 
     # server -> client
     TA_PUT_RESP = enum.auto()
@@ -43,6 +44,7 @@ class Tag(enum.Enum):
     TA_GET_RESERVED_RESP = enum.auto()
     TA_GET_COMMON_RESP = enum.auto()
     TA_INFO_NUM_RESP = enum.auto()
+    TA_INFO_GET_RESP = enum.auto()
     TA_ABORT = enum.auto()
 
     # server <-> server

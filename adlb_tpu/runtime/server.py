@@ -333,6 +333,7 @@ class Server:
             Tag.FA_LOCAL_APP_DONE: self._on_local_app_done,
             Tag.FA_ABORT: self._on_fa_abort,
             Tag.FA_INFO_NUM_WORK_UNITS: self._on_info_num,
+            Tag.FA_INFO_GET: self._on_info_get,
             Tag.SS_QMSTAT: self._on_qmstat,
             Tag.SS_RFR: self._on_rfr,
             Tag.SS_RFR_RESP: self._on_rfr_resp,
@@ -595,7 +596,9 @@ class Server:
         self._ds_counters["reserves"] += 1
         self.stats[InfoKey.NUM_RESERVES] += 1
         app = m.src
-        req_types = None if m.req_types is None else frozenset(m.req_types)
+        # binary-codec clients encode "any type" by omitting the field
+        raw_types = m.data.get("req_types")
+        req_types = None if raw_types is None else frozenset(raw_types)
         if self.no_more_work:
             self._reserve_resp(app, ADLB_NO_MORE_WORK)
             return
@@ -666,6 +669,27 @@ class Server:
                 nbytes=nbytes,
                 max_wq=int(self.stats[InfoKey.MAX_WQ_COUNT]),
             ),
+        )
+
+    def _on_info_get(self, m: Msg) -> None:
+        """Live Info_get from a client: one stats value from its home server
+        (reference ``src/adlb.c:3072-3141``)."""
+        try:
+            key = InfoKey(m.key)
+        except ValueError:
+            self.ep.send(
+                m.src, msg(Tag.TA_INFO_GET_RESP, self.rank, rc=-1, value=0.0)
+            )
+            return
+        if key is InfoKey.MALLOC_HWM:
+            value = float(self.mem.hwm)
+        elif key is InfoKey.AVG_TIME_ON_RQ:
+            value = self._rq_wait_sum / self._rq_wait_n if self._rq_wait_n else 0.0
+        else:
+            value = float(self.stats.get(key, 0.0))
+        self.ep.send(
+            m.src,
+            msg(Tag.TA_INFO_GET_RESP, self.rank, rc=ADLB_SUCCESS, value=value),
         )
 
     # ------------------------------------------------------- stealing (pull)

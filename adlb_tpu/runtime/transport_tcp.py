@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Optional
 
+from adlb_tpu.runtime.codec import decode_binary, encode_binary
 from adlb_tpu.runtime.messages import Msg
 
 _HDR = struct.Struct("<I")
@@ -30,13 +31,23 @@ class TcpEndpoint:
     """One rank's endpoint: an acceptor thread feeding an inbox, plus lazily
     opened persistent outbound connections to peers."""
 
-    def __init__(self, rank: int, addr_map: dict[int, tuple[str, int]]) -> None:
+    def __init__(
+        self,
+        rank: int,
+        addr_map: dict[int, tuple[str, int]],
+        binary_peers: Optional[set[int]] = None,
+    ) -> None:
         self.rank = rank
         self.addr_map = dict(addr_map)
         self.inbox: "queue.SimpleQueue[Msg]" = queue.SimpleQueue()
         self._out: dict[int, socket.socket] = {}
-        self._out_lock = threading.Lock()
+        self._out_lock = threading.Lock()  # guards the maps only
+        self._dest_locks: dict[int, threading.Lock] = {}
         self._closed = False
+        # ranks that speak the binary TLV codec (native C/Fortran clients).
+        # Learned automatically from inbound frames — clients always send
+        # first (FA_*) — or declared upfront via the rendezvous.
+        self.binary_peers: set[int] = set(binary_peers or ())
 
         host, port = self.addr_map[rank]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -74,7 +85,25 @@ class TcpEndpoint:
                 body = self._read_exact(conn, n)
                 if body is None:
                     return
-                self.inbox.put(pickle.loads(body))
+                if body[:1] == b"\x01":
+                    try:
+                        m = decode_binary(body)
+                    except Exception as e:  # noqa: BLE001 — stale C peer
+                        # A malformed frame (e.g. a native client built
+                        # against stale codec tables) must be diagnosable,
+                        # not a silent reader-thread death + peer hang.
+                        import sys
+
+                        print(
+                            f"[adlb tcp rank {self.rank}] dropping "
+                            f"undecodable binary frame ({len(body)}B): {e!r}",
+                            file=sys.stderr,
+                        )
+                        continue
+                    self.binary_peers.add(m.src)
+                else:
+                    m = pickle.loads(body)
+                self.inbox.put(m)
         except OSError:
             return
         finally:
@@ -90,22 +119,44 @@ class TcpEndpoint:
             buf.extend(chunk)
         return bytes(buf)
 
-    def send(self, dest: int, m: Msg) -> None:
-        body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = _HDR.pack(len(body)) + body
-        with self._out_lock:
-            sock = self._out.get(dest)
-            if sock is None:
+    def _connect(self, dest: int) -> socket.socket:
+        """Connect to a peer, tolerating a listener that is still coming up
+        (ranks bind at different times in thread/process worlds)."""
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
                 sock = socket.create_connection(self.addr_map[dest], timeout=30)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._out[dest] = sock
+                return sock
+            except ConnectionRefusedError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+
+    def send(self, dest: int, m: Msg) -> None:
+        if dest in self.binary_peers:
+            body = encode_binary(m)
+        else:
+            body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HDR.pack(len(body)) + body
+        # per-destination serialization: a slow/dead peer (15 s connect
+        # retry) must not stall sends to every other rank
+        with self._out_lock:
+            dlock = self._dest_locks.setdefault(dest, threading.Lock())
+        with dlock:
+            with self._out_lock:
+                sock = self._out.get(dest)
+            if sock is None:
+                sock = self._connect(dest)
+                with self._out_lock:
+                    self._out[dest] = sock
             try:
                 sock.sendall(frame)
             except OSError:
                 # one reconnect attempt; beyond that the watchdog handles it
-                sock = socket.create_connection(self.addr_map[dest], timeout=30)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._out[dest] = sock
+                sock = self._connect(dest)
+                with self._out_lock:
+                    self._out[dest] = sock
                 sock.sendall(frame)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
@@ -311,7 +362,12 @@ def spawn_world(
             kind, rank, value = result_q.get(timeout=min(remaining, 1.0))
         except queue.Empty:
             if all(not p.is_alive() for p in procs.values()):
-                break  # a rank died without reporting (e.g. hard abort)
+                missing = sorted(set(procs) - reported)
+                if missing:
+                    errors.append(
+                        f"rank(s) {missing} died without reporting a result"
+                    )
+                break
             continue
         reported.add(rank)
         if kind == "app":
@@ -329,12 +385,11 @@ def spawn_world(
             p.terminate()
             p.join(timeout=5.0)
 
-    result = WorldResult(
+    if errors:
+        raise RuntimeError("; ".join(errors))
+    return WorldResult(
         app_results=app_results,
         server_stats=server_stats,
         aborted=abort_event.is_set() or aborted_code is not None,
-        exception=RuntimeError("; ".join(errors)) if errors else None,
+        exception=None,
     )
-    if errors:
-        raise RuntimeError("; ".join(errors))
-    return result

