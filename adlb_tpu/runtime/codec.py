@@ -81,6 +81,7 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_PLAN_MIGRATE: 1119,
     Tag.SS_MIGRATE_WORK: 1120,
     Tag.SS_MIGRATE_ACK: 1121,
+    Tag.SS_PERIODIC_STATS: 1122,
     Tag.DS_LOG: 1131,
     Tag.DS_END: 1132,
 }

@@ -64,6 +64,7 @@ class Tag(enum.Enum):
     SS_END_1 = enum.auto()
     SS_END_2 = enum.auto()
     SS_ABORT = enum.auto()
+    SS_PERIODIC_STATS = enum.auto()  # stats ring token (src/adlb.c:2391-2465)
 
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()

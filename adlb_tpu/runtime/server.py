@@ -321,6 +321,15 @@ class Server:
         self._next_ds_log = now
         self._ds_counters = {"puts": 0, "reserves": 0, "rfrs": 0, "pushes": 0}
 
+        # periodic cluster-wide stats ring (reference src/adlb.c:712-753)
+        self.resolved_reserves = 0
+        self._pstats_seq = 0
+        self._next_pstats = (
+            now + cfg.periodic_log_interval
+            if cfg.periodic_log_interval > 0
+            else float("inf")
+        )
+
         self._handlers = {
             Tag.FA_PUT: self._on_put,
             Tag.FA_PUT_COMMON: self._on_put_common,
@@ -350,6 +359,7 @@ class Server:
             Tag.SS_END_1: self._on_end_1,
             Tag.SS_END_2: self._on_end_2,
             Tag.SS_ABORT: self._on_ss_abort,
+            Tag.SS_PERIODIC_STATS: self._on_periodic_stats,
             Tag.SS_STATE: self._on_state,
             Tag.SS_PLAN_MATCH: self._on_plan_match,
             Tag.SS_PLAN_MIGRATE: self._on_plan_migrate,
@@ -402,6 +412,7 @@ class Server:
                 self._next_ds_log
                 if self.world.use_debug_server
                 else now + 1.0,
+                self._next_pstats if self.is_master else now + 1.0,
             )
             m = self.ep.recv(timeout=max(deadline - time.monotonic(), 0.0))
             t0 = time.monotonic()
@@ -441,6 +452,9 @@ class Server:
         if self.world.use_debug_server and now >= self._next_ds_log:
             self._next_ds_log = now + self.cfg.debug_log_interval
             self._send_ds_log()
+        if self.is_master and now >= self._next_pstats:
+            self._next_pstats = now + self.cfg.periodic_log_interval
+            self._kick_periodic_stats(now)
 
     # ------------------------------------------------------- helpers
 
@@ -468,6 +482,7 @@ class Server:
         if rc != ADLB_SUCCESS:
             self.ep.send(app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc))
             return
+        self.resolved_reserves += 1
         handle = WorkHandle(
             seqno=unit.seqno,
             server_rank=holder if holder is not None else self.rank,
@@ -488,6 +503,47 @@ class Server:
                 answer_rank=unit.answer_rank,
             ),
         )
+
+    def _kick_periodic_stats(self, now: float) -> None:
+        """Master starts a stats token around the server ring; each server
+        adds its contribution and forwards; back at the master the sum is
+        printed as STAT_APS chunks (reference ``src/adlb.c:712-753,
+        2391-2465``)."""
+        from adlb_tpu.runtime import stats as pstats
+
+        if self.no_more_work or self.done_by_exhaustion:
+            return  # ring peers may already be shutting down
+        self._pstats_seq += 1
+        token = {
+            "seq": self._pstats_seq,
+            "t0": now,
+            "entries": {self.rank: pstats.contribution(self)},
+        }
+        if self.world.nservers == 1:
+            pstats.emit_stat_aps(pstats.aggregate(token, time.monotonic()))
+            return
+        self._forward_pstats(token)
+
+    def _forward_pstats(self, token: dict) -> None:
+        # best-effort: a ring peer that already exited must not kill the
+        # sender — stats tokens are droppable, the protocol ring is not
+        try:
+            self.ep.send(
+                self.world.ring_next(self.rank),
+                msg(Tag.SS_PERIODIC_STATS, self.rank, token=token),
+            )
+        except OSError:
+            pass
+
+    def _on_periodic_stats(self, m: Msg) -> None:
+        from adlb_tpu.runtime import stats as pstats
+
+        token = m.token
+        if self.is_master:
+            pstats.emit_stat_aps(pstats.aggregate(token, time.monotonic()))
+            return
+        token["entries"][self.rank] = pstats.contribution(self)
+        self._forward_pstats(token)
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
                         holder: Optional[int] = None) -> None:

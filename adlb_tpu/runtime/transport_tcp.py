@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Optional
 
-from adlb_tpu.runtime.codec import decode_binary, encode_binary
+from adlb_tpu.runtime.codec import decode_binary, encodable, encode_binary
 from adlb_tpu.runtime.messages import Msg
 
 _HDR = struct.Struct("<I")
@@ -135,6 +135,11 @@ class TcpEndpoint:
 
     def send(self, dest: int, m: Msg) -> None:
         if dest in self.binary_peers:
+            if not encodable(m):
+                raise ValueError(
+                    f"message {m.tag} carries fields outside the binary "
+                    f"codec but rank {dest} is a native (non-pickle) client"
+                )
             body = encode_binary(m)
         else:
             body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)

@@ -1,0 +1,126 @@
+"""Periodic cluster-wide stats ring (reference src/adlb.c:712-753,2391-2465)
+and the offline decoder (reference scripts/get_stats.py)."""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.stats import (
+    emit_stat_aps,
+    parse_stat_lines,
+    set_sink,
+    summarize,
+)
+from adlb_tpu.runtime.world import Config
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _collect_lines():
+    lines = []
+    set_sink(lines.append)
+    return lines
+
+
+def teardown_function(_fn):
+    set_sink(None)
+
+
+def test_periodic_stats_ring_aggregates_all_servers():
+    lines = _collect_lines()
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(40):
+                ctx.put(b"x" * 64, work_type=1, work_prio=i)
+        done = 0
+        while True:
+            rc, r = ctx.reserve([1])
+            if rc < 0:
+                break
+            ctx.get_reserved(r.handle)
+            done += 1
+            time.sleep(0.002)
+            if ctx.rank == 0 and done == 10:
+                # keep the world alive long enough for >=2 stat periods
+                time.sleep(0.15)
+        if ctx.rank == 0:
+            ctx.set_problem_done()
+        return done
+
+    run_world(
+        num_app_ranks=3,
+        nservers=3,
+        types=[1],
+        app_fn=app,
+        cfg=Config(periodic_log_interval=0.03, exhaust_check_interval=5.0),
+        timeout=60.0,
+    )
+
+    records = parse_stat_lines(lines)
+    assert records, "no STAT_APS records emitted"
+    # every aggregate must include all three servers' contributions
+    assert all(r["nservers"] == 3 for r in records)
+    # counters are cumulative and monotone
+    puts = [r["total"]["puts"] for r in records]
+    assert puts == sorted(puts)
+    assert puts[-1] == 40
+    rows = summarize(records)
+    assert rows[0]["seq"] == records[0]["seq"]
+
+
+def test_stat_aps_chunking_roundtrip():
+    lines = _collect_lines()
+    big = {
+        "seq": 7,
+        "t": 123.0,
+        "trip_s": 0.001,
+        "nservers": 64,
+        "by_type": {str(t): {"targeted": t, "untargeted": 2 * t} for t in range(40)},
+        "total": {"wq": 1, "rq": 2, "puts": 3, "resolved": 4, "nbytes": 5},
+        "per_server": {str(r): {"wq": r, "rq": 0, "nbytes": 0} for r in range(64)},
+    }
+    emit_stat_aps(big)
+    assert len(lines) > 1, "expected multi-chunk STAT_APS output"
+    assert all(line.startswith("STAT_APS: seq=7 part=") for line in lines)
+    [rec] = parse_stat_lines(lines)
+    assert rec == big
+    # interleaved with noise and a second record, both still decode
+    emit_stat_aps({**big, "seq": 8})
+    noisy = ["unrelated log line"] + lines + ["more noise"]
+    recs = parse_stat_lines(noisy)
+    assert [r["seq"] for r in recs] == [7, 8]
+
+
+def test_get_stats_script(tmp_path):
+    lines = _collect_lines()
+    for seq in (1, 2):
+        emit_stat_aps(
+            {
+                "seq": seq,
+                "t": 100.0 + seq,
+                "trip_s": 0.002,
+                "nservers": 2,
+                "by_type": {"1": {"targeted": 0, "untargeted": 5}},
+                "total": {
+                    "wq": 5,
+                    "rq": 1,
+                    "puts": 10 * seq,
+                    "resolved": 8 * seq,
+                    "nbytes": 320,
+                },
+                "per_server": {},
+            }
+        )
+    log = tmp_path / "run.log"
+    log.write_text("\n".join(lines) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "get_stats.py"), str(log)],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    assert "seq" in out.stdout
+    assert "10.0" in out.stdout  # puts/s between the two periods (dt=1s)
