@@ -94,6 +94,14 @@ class WorldResult:
     server_stats: dict[int, dict[int, float]]
     aborted: bool
     exception: Optional[BaseException] = None
+    # merged Chrome-trace events when Config(trace=True) (the reference's
+    # MPE output, reference src/adlb_prof.c:46-74)
+    trace_events: list[dict] = dataclasses.field(default_factory=list)
+
+    def save_trace(self, path: str) -> None:
+        from adlb_tpu.runtime.trace import save_chrome_trace
+
+        save_chrome_trace(self.trace_events, path)
 
     def info_get(self, key: InfoKey) -> float:
         """Aggregate a stats key over servers the way the reference's
@@ -122,6 +130,7 @@ def run_world(
     fabric = InProcFabric(world.nranks)
     app_results: dict[int, Any] = {}
     server_stats: dict[int, dict[int, float]] = {}
+    trace_events: list[dict] = []
     errors: list[BaseException] = []
     lock = threading.Lock()
 
@@ -140,6 +149,9 @@ def run_world(
             fabric.abort_event.set()
         finally:
             client.finalize()
+            if client.tracer is not None:
+                with lock:
+                    trace_events.extend(client.tracer.events)
 
     def server_main(rank: int) -> None:
         server = Server(world, cfg, fabric.endpoint(rank), fabric.abort_event)
@@ -181,11 +193,14 @@ def run_world(
             errors.append(TimeoutError(f"world did not finish within {timeout}s"))
             break
 
+    with lock:  # a timed-out client thread may still be appending
+        trace_events = sorted(trace_events, key=lambda e: e["ts"])
     result = WorldResult(
         app_results=app_results,
         server_stats=server_stats,
         aborted=fabric.abort_event.is_set(),
         exception=errors[0] if errors else None,
+        trace_events=trace_events,
     )
     if errors:
         raise errors[0]

@@ -26,7 +26,10 @@ import dataclasses
 import time
 from typing import Optional, Sequence
 
+from contextlib import nullcontext
+
 from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.trace import Tracer
 from adlb_tpu.runtime.transport import Endpoint
 from adlb_tpu.runtime.world import Config, WorldSpec, normalize_req_types
 from adlb_tpu.types import (
@@ -62,6 +65,17 @@ class Client:
         self._rqseqno = 0
         self._abort_event = abort_event
         self.aborted = False
+        # MPE-equivalent event tracing (reference src/adlb_prof.c:46-74),
+        # a run-time flag here instead of a compile-time one
+        self.tracer: Optional[Tracer] = Tracer(self.rank) if cfg.trace else None
+        self._reserved_types: dict[tuple[int, int], int] = {}  # (holder, seqno) -> type
+
+    def _span(self, name: str, **args):
+        """API-call trace span + user-state inference boundary."""
+        if self.tracer is None:
+            return nullcontext()
+        self.tracer.api_entry()
+        return self.tracer.span(name, **args)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -96,6 +110,19 @@ class Client:
         work_prio: int = 0,
         target_rank: int = -1,
         answer_rank: int = -1,
+    ) -> int:
+        with self._span(
+            "adlb:put", work_type=work_type, prio=work_prio, len=len(payload)
+        ):
+            return self._put(payload, work_type, work_prio, target_rank, answer_rank)
+
+    def _put(
+        self,
+        payload: bytes,
+        work_type: int,
+        work_prio: int,
+        target_rank: int,
+        answer_rank: int,
     ) -> int:
         if not self.world.validate_type(work_type):
             raise AdlbError(f"unregistered work type {work_type}")
@@ -164,6 +191,11 @@ class Client:
         (reference ``src/adlb.c:2638-2722``)."""
         if self._batch is not None:
             raise AdlbError("nested Begin_batch_put")
+        ctx = self._span("adlb:begin_batch_put", len=len(common_buf))
+        with ctx:
+            return self._begin_batch_put(common_buf)
+
+    def _begin_batch_put(self, common_buf: bytes) -> int:
         server = self._next_server()
         self.ep.send(
             server, msg(Tag.FA_PUT_COMMON, self.rank, payload=bytes(common_buf))
@@ -185,15 +217,16 @@ class Client:
             raise AdlbError("End_batch_put without Begin_batch_put")
         b = self._batch
         self._batch = None
-        self.ep.send(
-            b.common_server,
-            msg(
-                Tag.FA_BATCH_DONE,
-                self.rank,
-                common_seqno=b.common_seqno,
-                refcnt=b.refcnt,
-            ),
-        )
+        with self._span("adlb:end_batch_put"):
+            self.ep.send(
+                b.common_server,
+                msg(
+                    Tag.FA_BATCH_DONE,
+                    self.rank,
+                    common_seqno=b.common_seqno,
+                    refcnt=b.refcnt,
+                ),
+            )
         return ADLB_SUCCESS
 
     # -- Reserve / Get family ------------------------------------------------
@@ -216,30 +249,52 @@ class Client:
         resp = self._wait(Tag.TA_RESERVE_RESP)
         if resp.rc != ADLB_SUCCESS:
             return resp.rc, None
-        return ADLB_SUCCESS, ReserveResult(
+        result = ReserveResult(
             work_type=resp.work_type,
             work_prio=resp.prio,
             handle=WorkHandle.from_ints(resp.handle),
             work_len=resp.work_len,
             answer_rank=resp.answer_rank,
         )
+        if self.tracer is not None:
+            # remembered so get_reserved can start the inferred user-state
+            # span with the unit's type (reference src/adlb_prof.c:185-236);
+            # keyed by (holder, seqno) — seqnos are per-server counters
+            key = (result.handle.server_rank, result.handle.seqno)
+            self._reserved_types[key] = result.work_type
+        return ADLB_SUCCESS, result
 
     def reserve(
         self, req_types: Optional[Sequence[int]] = None
     ) -> tuple[int, Optional[ReserveResult]]:
         """Blocking reserve: returns only with work or a termination code."""
-        return self._reserve(req_types, hang=True)
+        with self._span("adlb:reserve"):
+            return self._reserve(req_types, hang=True)
 
     def ireserve(
         self, req_types: Optional[Sequence[int]] = None
     ) -> tuple[int, Optional[ReserveResult]]:
         """Non-blocking reserve: ADLB_NO_CURRENT_WORK if nothing matches now."""
-        rc, res = self._reserve(req_types, hang=False)
+        with self._span("adlb:ireserve"):
+            rc, res = self._reserve(req_types, hang=False)
         if rc == ADLB_NO_CURRENT_WORK:
             return rc, None
         return rc, res
 
     def get_reserved_timed(
+        self, handle: WorkHandle
+    ) -> tuple[int, Optional[bytes], float]:
+        with self._span("adlb:get_reserved"):
+            rc, buf, t = self._get_reserved_timed(handle)
+        if self.tracer is not None:
+            wt = self._reserved_types.pop(
+                (handle.server_rank, handle.seqno), -1
+            )
+            if rc == ADLB_SUCCESS:
+                self.tracer.got_work(wt)
+        return rc, buf, t
+
+    def _get_reserved_timed(
         self, handle: WorkHandle
     ) -> tuple[int, Optional[bytes], float]:
         prefix = b""
@@ -268,7 +323,8 @@ class Client:
     def set_problem_done(self) -> int:
         """Explicit termination (reference ADLB_Set_problem_done,
         ``src/adlb.c:3054-3062``)."""
-        self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
+        with self._span("adlb:set_problem_done"):
+            self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
         return ADLB_SUCCESS
 
     def info_get(self, key: int) -> tuple[int, float]:
@@ -288,6 +344,8 @@ class Client:
         return resp.rc, resp.count, resp.nbytes, resp.max_wq
 
     def finalize(self) -> int:
+        if self.tracer is not None:
+            self.tracer.api_entry()  # close any open inferred user span
         if not self.aborted:
             self.ep.send(self.home, msg(Tag.FA_LOCAL_APP_DONE, self.rank))
         return ADLB_SUCCESS

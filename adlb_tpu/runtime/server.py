@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Optional
 
+from adlb_tpu.runtime.debug import FlightRecorder, aprintf, self_diagnosis
 from adlb_tpu.runtime.messages import Msg, Tag, msg
 from adlb_tpu.runtime.queues import (
     CommonStore,
@@ -330,6 +331,15 @@ class Server:
             else float("inf")
         )
 
+        # debug plumbing (reference src/adlb.c:176-179,558-710)
+        self.flight = FlightRecorder(self.rank)
+        self.tag_freq: dict[Tag, int] = {}
+        self._next_selfdiag = (
+            now + cfg.selfdiag_interval
+            if cfg.selfdiag_interval > 0
+            else float("inf")
+        )
+
         self._handlers = {
             Tag.FA_PUT: self._on_put,
             Tag.FA_PUT_COMMON: self._on_put_common,
@@ -385,6 +395,11 @@ class Server:
     # ------------------------------------------------------------------ loop
 
     def run(self) -> None:
+        aprintf(
+            self.cfg.aprintf_flag, self.rank,
+            f"server starting (master={self.is_master}, "
+            f"apps={sorted(self.local_apps)}, balancer={self.cfg.balancer})",
+        )
         try:
             if self._balancer is not None:
                 self._balancer.start()
@@ -393,6 +408,11 @@ class Server:
             if self._balancer is not None:
                 self._balancer.stop()
             self._notify_debug_server_end()
+            aprintf(
+                self.cfg.aprintf_flag, self.rank,
+                f"server exiting (wq_max={self.wq.max_count}, "
+                f"activity={self.activity}, aborted={self._aborted})",
+            )
 
     def _run_loop(self) -> None:
         interval = (
@@ -402,6 +422,12 @@ class Server:
         )
         while not self.done:
             if self._abort_event is not None and self._abort_event.is_set():
+                # every server dumps state on abort (the reference gives a
+                # 10 s grace for exactly this, src/adlb.c:2508-2526)
+                if not self._aborted:
+                    self._aborted = True
+                    self.flight.record("abort event observed")
+                    self.flight.dump(reason="abort")
                 return
             now = time.monotonic()
             self._loops += 1
@@ -420,6 +446,7 @@ class Server:
                 handler = self._handlers.get(m.tag)
                 if handler is None:
                     raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
+                self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
                 handler(m)
                 # drain whatever else is queued before paying the poll
                 # timeout — but bounded, so periodic duties (state sync,
@@ -434,6 +461,7 @@ class Server:
                     h2 = self._handlers.get(m2.tag)
                     if h2 is None:
                         raise AdlbError(f"server {self.rank}: no handler for {m2.tag}")
+                    self.tag_freq[m2.tag] = self.tag_freq.get(m2.tag, 0) + 1
                     h2(m2)
             self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
 
@@ -455,6 +483,9 @@ class Server:
         if self.is_master and now >= self._next_pstats:
             self._next_pstats = now + self.cfg.periodic_log_interval
             self._kick_periodic_stats(now)
+        if now >= self._next_selfdiag:
+            self._next_selfdiag = now + self.cfg.selfdiag_interval
+            self_diagnosis(self, now, stuck_after=self.cfg.selfdiag_stuck_after)
 
     # ------------------------------------------------------- helpers
 
@@ -583,6 +614,10 @@ class Server:
         payload: bytes = m.payload
         if not self.mem.try_alloc(len(payload)):
             self.stats[InfoKey.NREJECTED_PUTS] += 1
+            self.flight.record(
+                f"put rejected from rank {m.src} ({len(payload)}B, "
+                f"curr={self.mem.curr})"
+            )
             self.ep.send(
                 m.src,
                 msg(
@@ -785,6 +820,10 @@ class Server:
     ) -> None:
         self._rfr_out.add(entry.world_rank)
         self._ds_counters["rfrs"] += 1
+        self.flight.record(
+            f"rfr -> server {server} for rank {entry.world_rank} "
+            f"(targeted={targeted_lookup})"
+        )
         self.ep.send(
             server,
             msg(
@@ -1398,6 +1437,7 @@ class Server:
         if self.done_by_exhaustion:
             return
         self.done_by_exhaustion = True
+        self.flight.record("done by exhaustion; flushing rq")
         self._flush_rq(ADLB_DONE_BY_EXHAUSTION)
 
     def _on_local_app_done(self, m: Msg) -> None:
@@ -1462,6 +1502,11 @@ class Server:
         if self._aborted:
             return
         self._aborted = True
+        aprintf(self.cfg.aprintf_flag, self.rank, f"aborting, code {code}")
+        # the reference dumps every server's state on abort with a grace
+        # period (src/adlb.c:2508-2526); here: the in-memory flight recorder
+        self.flight.record(f"abort code={code} broadcast={broadcast}")
+        self.flight.dump(reason=f"abort {code}")
         if broadcast:
             for s in self.world.server_ranks:
                 if s != self.rank:

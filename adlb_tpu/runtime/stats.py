@@ -19,25 +19,15 @@ the tests.
 from __future__ import annotations
 
 import json
-import sys
-from typing import Callable, Iterable, Optional
+from typing import Iterable
+
+from adlb_tpu.runtime.sink import Sink
 
 CHUNK = 500  # reference prints periodic stats in <=500-byte chunks
 
-_sink: Optional[Callable[[str], None]] = None
-
-
-def set_sink(fn: Optional[Callable[[str], None]]) -> None:
-    """Redirect STAT_APS lines (tests); None restores stderr."""
-    global _sink
-    _sink = fn
-
-
-def _emit(line: str) -> None:
-    if _sink is not None:
-        _sink(line)
-    else:
-        print(line, file=sys.stderr, flush=True)
+_SINK = Sink()
+set_sink = _SINK.set
+_emit = _SINK.emit
 
 
 def contribution(server) -> dict:

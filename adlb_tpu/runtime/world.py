@@ -103,6 +103,10 @@ class Config:
     balancer_max_tasks: int = 256
     balancer_max_requesters: int = 64
     trace: bool = False  # event tracing hooks (reference MPE shims)
+    aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
+    selfdiag_interval: float = 30.0  # server health dumps; 0 = off
+    # (src/adlb.c:558-710; the reference hard-codes 30 s)
+    selfdiag_stuck_after: float = 5.0  # rq age that counts as "stuck"
     # server work-queue implementation: "auto" uses the C++ core when it
     # builds, falling back to the pure-Python queues; "on" requires it
     native_queues: str = "auto"

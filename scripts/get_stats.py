@@ -25,23 +25,26 @@ from adlb_tpu.runtime.stats import parse_stat_lines, summarize  # noqa: E402
 def main(argv: list[str]) -> int:
     as_json = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
-    records: list[dict] = []
+    # parse and summarize each file independently: seq numbers and cumulative
+    # counters restart per run, so records must never mix across files
+    groups: list[list[dict]] = []
     if paths:
-        # parse each file independently so a truncated record in one log
-        # cannot poison the same seq number in another
         for p in paths:
-            records.extend(parse_stat_lines(Path(p).read_text().splitlines()))
+            groups.append(parse_stat_lines(Path(p).read_text().splitlines()))
     else:
-        records = parse_stat_lines(sys.stdin.read().splitlines())
-    if not records:
+        groups.append(parse_stat_lines(sys.stdin.read().splitlines()))
+    if not any(groups):
         print("no STAT_APS records found", file=sys.stderr)
         return 1
     if as_json:
-        for r in records:
-            print(json.dumps(r))
+        for records in groups:
+            for r in records:
+                print(json.dumps(r))
         return 0
 
-    rows = summarize(records)
+    rows: list[dict] = []
+    for records in groups:
+        rows.extend(summarize(records))
     hdr = f"{'seq':>5} {'wq':>7} {'rq':>5} {'KB':>8} {'puts/s':>9} {'res/s':>9} {'trip_ms':>8}  by_type"
     print(hdr)
     print("-" * len(hdr))

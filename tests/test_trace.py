@@ -1,0 +1,99 @@
+"""MPE-equivalent event tracing (reference src/adlb_prof.c:46-74,185-236)."""
+
+import json
+import time
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.trace import Tracer, merge, span_names
+from adlb_tpu.runtime.world import Config
+
+
+def test_tracer_user_state_inference():
+    tr = Tracer(rank=3)
+    with tr.span("adlb:reserve"):
+        pass
+    tr.got_work(7)
+    time.sleep(0.005)
+    tr.api_entry()  # next API call closes the inferred span
+    user = [e for e in tr.events if e["name"] == "user:type7"]
+    assert len(user) == 1
+    assert user[0]["dur"] >= 4_000  # microseconds
+    assert user[0]["tid"] == 3
+    # no open span left behind
+    tr.api_entry()
+    assert len([e for e in tr.events if e["name"].startswith("user:")]) == 1
+
+
+def test_world_trace_collection(tmp_path):
+    T = 1
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(6):
+                ctx.put(b"w" * 16, T, work_prio=i)
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc < 0:
+                break
+            rc, buf = ctx.get_reserved(r.handle)
+            time.sleep(0.002)  # "user compute" the tracer should infer
+            n += 1
+        if ctx.rank == 0:
+            ctx.set_problem_done()
+        return n
+
+    res = run_world(
+        num_app_ranks=2,
+        nservers=1,
+        types=[T],
+        app_fn=app,
+        cfg=Config(trace=True),
+        timeout=60.0,
+    )
+    assert sum(res.app_results.values()) == 6
+    names = span_names(res.trace_events)
+    assert {"adlb:put", "adlb:reserve", "adlb:get_reserved",
+            "adlb:set_problem_done", f"user:type{T}"} <= names
+    # six units fetched -> six inferred user-compute spans, each >= the sleep
+    user = [e for e in res.trace_events if e["name"] == f"user:type{T}"]
+    assert len(user) == 6
+    assert all(e["dur"] >= 1_500 for e in user)
+    assert all(e["args"]["work_type"] == T for e in user)
+    # both app ranks traced
+    assert {e["tid"] for e in res.trace_events} == {0, 1}
+    # events arrive time-sorted and the file is valid chrome trace JSON
+    ts = [e["ts"] for e in res.trace_events]
+    assert ts == sorted(ts)
+    out = tmp_path / "trace.json"
+    res.save_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"], "empty trace file"
+
+
+def test_trace_off_by_default():
+    T = 1
+
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.put(b"x", T, target_rank=0)
+            rc, r = ctx.reserve([T])
+            ctx.get_reserved(r.handle)
+            ctx.set_problem_done()
+        else:
+            rc, _ = ctx.reserve([T])
+        return True
+
+    res = run_world(num_app_ranks=2, nservers=1, types=[T], app_fn=app,
+                    timeout=60.0)
+    assert res.trace_events == []
+
+
+def test_merge_orders_events():
+    a, b = Tracer(0), Tracer(1)
+    with b.span("later"):
+        pass
+    with a.span("latest"):
+        pass
+    events = merge([a, b])
+    assert [e["tid"] for e in events] == [1, 0]
