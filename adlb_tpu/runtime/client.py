@@ -196,6 +196,13 @@ class Client:
             return self._begin_batch_put(common_buf)
 
     def _begin_batch_put(self, common_buf: bytes) -> int:
+        if len(common_buf) == 0:
+            # NULL/empty prefix (the reference allows it, src/adlb.c:2638):
+            # batch bracketing with nothing to share — no server round trip,
+            # nothing for the server to store or GC
+            self._batch = _BatchState(common_server=-1, common_seqno=-1,
+                                      common_len=0)
+            return ADLB_SUCCESS
         server = self._next_server()
         self.ep.send(
             server, msg(Tag.FA_PUT_COMMON, self.rank, payload=bytes(common_buf))
@@ -217,6 +224,8 @@ class Client:
             raise AdlbError("End_batch_put without Begin_batch_put")
         b = self._batch
         self._batch = None
+        if b.common_server < 0:  # empty-prefix batch: nothing stored
+            return ADLB_SUCCESS
         with self._span("adlb:end_batch_put"):
             self.ep.send(
                 b.common_server,

@@ -18,4 +18,12 @@ benchmark drivers:
   self-validating counts (reference ``examples/c4.c``)
 * :mod:`~adlb_tpu.workloads.coinop` — pop-latency probe (reference
   ``examples/coinop.cpp``)
+* :mod:`~adlb_tpu.workloads.grid` — data-affinity Jacobi relaxation with a
+  sequential oracle (reference ``examples/grid_daf.c`` / ``grid_uni.c``)
+* :mod:`~adlb_tpu.workloads.add2` — answer-economy smoke test (reference
+  ``examples/add2.c``)
+* :mod:`~adlb_tpu.workloads.skel` — 8-type synthetic stress probe
+  (reference ``examples/skel.c`` / ``c2.c``)
+* :mod:`~adlb_tpu.workloads.hotspot` — producer-concentrated balancing
+  scenario (no reference analogue; the BASELINE.json north-star probe)
 """

@@ -1,0 +1,79 @@
+"""grid / add2 / skel workloads (reference examples/grid_daf.c, add2.c,
+skel.c) — known-answer and self-checking runs."""
+
+import numpy as np
+import pytest
+
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.workloads import add2, grid, skel
+
+
+def test_grid_sequential_oracle_properties():
+    g = grid.run_sequential(6, 6, 0)
+    # zero iterations leaves the interior at its initial value
+    assert np.all(g[1:-1, 1:-1] == 0.0)
+    g1 = grid.run_sequential(6, 6, 1)
+    # one sweep pulls boundary values one cell inward
+    assert g1[1, 1] == (g[0, 1] + g[2, 1] + g[1, 0] + g[1, 2]) / 4.0
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_grid_matches_sequential(mode):
+    nrows, ncols, niters = 6, 5, 3
+    want = grid.run_sequential(nrows, ncols, niters)
+    got = grid.run(
+        nrows, ncols, niters, num_app_ranks=3, nservers=2,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.25),
+    )
+    np.testing.assert_array_equal(got.grid, want)
+    assert got.average == float(want[1:-1, 1:-1].mean())
+    # every row x iteration was computed exactly once, by someone
+    assert sum(got.rows_computed.values()) == nrows * niters
+
+
+def test_add2_known_answer():
+    pairs = [(i, 2 * i + 1) for i in range(30)]
+    r = add2.run(pairs, num_app_ranks=3, nservers=2)
+    assert r.ok, f"sum {r.total} != {r.expected}"
+    assert sum(v for k, v in r.sums_by_rank.items() if k != 0) == len(pairs)
+
+
+def test_skel_stress_accounting():
+    r = skel.run(num_app_ranks=4, nservers=2)
+    assert r.ok, f"consumed {r.consumed} != produced {r.produced}"
+    assert r.tasks_per_sec > 0
+
+
+def test_skel_respects_priorities_single_consumer():
+    # one rank, one server: strict priority order within a type mix
+    mix = [
+        skel.TypeSpec(work_type=1, count=5, prio=1),
+        skel.TypeSpec(work_type=2, count=5, prio=9),
+    ]
+    order = []
+
+    import struct
+    import time
+
+    from adlb_tpu.api import run_world
+    from adlb_tpu.types import ADLB_SUCCESS
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for s in mix:
+                for _ in range(s.count):
+                    ctx.put(struct.pack("<i", s.work_type), s.work_type,
+                            work_prio=s.prio)
+            time.sleep(0.1)  # let everything enqueue before consuming
+            while True:
+                rc, r = ctx.reserve()
+                if rc != ADLB_SUCCESS:
+                    return True
+                ctx.get_reserved(r.handle)
+                order.append(r.work_type)
+                if len(order) == 10:
+                    ctx.set_problem_done()
+        return True
+
+    run_world(1, 1, [1, 2], app, cfg=Config(exhaust_check_interval=5.0))
+    assert order == [2] * 5 + [1] * 5
