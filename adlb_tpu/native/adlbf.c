@@ -12,6 +12,12 @@
 #define ADLB_FC_GLOBAL(lc, UC) lc##_
 #endif
 
+/* the build compiles this file with g++ alongside libadlb.cpp; the shims
+ * must keep unmangled Fortran-visible names either way */
+#ifdef __cplusplus
+extern "C" {
+#endif
+
 void ADLB_FC_GLOBAL(adlb_init, ADLB_INIT)(int *nservers, int *use_debug_server,
                                           int *aprintf_flag, int *ntypes,
                                           int type_vect[], int *am_server,
@@ -119,3 +125,7 @@ void ADLB_FC_GLOBAL(adlb_world_rank, ADLB_WORLD_RANK)(int *rank) {
 void ADLB_FC_GLOBAL(adlb_world_size, ADLB_WORLD_SIZE)(int *size) {
   *size = ADLB_World_size();
 }
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif

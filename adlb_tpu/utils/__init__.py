@@ -1,0 +1,5 @@
+"""Framework utilities (running statistics, small shared helpers)."""
+
+from adlb_tpu.utils.stats import RunningStats
+
+__all__ = ["RunningStats"]

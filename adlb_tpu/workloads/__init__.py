@@ -26,4 +26,16 @@ benchmark drivers:
   (reference ``examples/skel.c`` / ``c2.c``)
 * :mod:`~adlb_tpu.workloads.hotspot` — producer-concentrated balancing
   scenario (no reference analogue; the BASELINE.json north-star probe)
+* :mod:`~adlb_tpu.workloads.pmcmc` — embarrassingly-parallel MCMC hard-disk
+  demo with targeted solution returns (reference ``examples/pmcmc.c``)
+
+The reference's ``c1.c``/``c2.c``/``c3.c`` are evolutionary precursors of
+``c4.c`` (the same GFMC A/B/C economy with fewer stages / app_comm answer
+plumbing); their behavior is covered by :mod:`~adlb_tpu.workloads.gfmc` and
+:mod:`~adlb_tpu.workloads.skel`. ``model.c`` (master puts N dummy problems,
+everyone reserves any-type and sleeps, exhaustion terminates) is the same
+shape as :mod:`~adlb_tpu.workloads.hotspot`. ``partest.c`` is an unfinished
+scratch program in the reference (``examples/partest.c:1-3`` says so
+itself); ``stats.c`` is a standalone statistics library, ported as
+:mod:`adlb_tpu.utils.stats`.
 """

@@ -1,0 +1,128 @@
+/* Smoke test of the Fortran binding (adlb_tpu/native/adlbf.c), driven from C.
+ *
+ * The image has no Fortran compiler, so this program emits exactly the call
+ * sequence a GNU-mangled Fortran 77 program would: every shim is the
+ * lowercase_ symbol, every argument passed by reference, following the flow
+ * of the reference's f1.f (reference examples/f1.f): zero-length
+ * begin/end_batch_put bracket, by-reference ADLB_PUT of real*8 payloads,
+ * any-type blocking RESERVE, type-filtered IRESERVE polling, targeted
+ * answer puts, SET_PROBLEM_DONE + INFO_GET at the master.  Exit 0 only if
+ * every by-reference out-parameter round-trips correctly.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <adlb/adlb.h>
+
+/* the Fortran shims (GNU default mangling: lowercase + trailing _) */
+extern void adlb_init_(int *, int *, int *, int *, int *, int *, int *, int *,
+                       int *);
+extern void adlb_put_(void *, int *, int *, int *, int *, int *, int *);
+extern void adlb_reserve_(int *, int *, int *, int *, int *, int *, int *);
+extern void adlb_ireserve_(int *, int *, int *, int *, int *, int *, int *);
+extern void adlb_get_reserved_(void *, int *, int *);
+extern void adlb_get_reserved_timed_(void *, int *, double *, int *);
+extern void adlb_begin_batch_put_(void *, int *, int *);
+extern void adlb_end_batch_put_(int *);
+extern void adlb_set_problem_done_(int *);
+extern void adlb_info_get_(int *, double *, int *);
+extern void adlb_info_num_work_units_(int *, int *, int *, int *, int *);
+extern void adlb_finalize_(int *);
+extern void adlb_world_rank_(int *);
+extern void adlb_world_size_(int *);
+
+#define TYPE_A 1
+#define TYPE_ANS 2
+#define NUM_AS 12
+
+int main(void) {
+  int types[2] = {TYPE_A, TYPE_ANS};
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int use_dbg = 0, aflag = 0, ntypes = 2;
+  int am_server = -1, am_debug = -1, num_apps = 0, ierr = -42;
+
+  adlb_init_(&nservers, &use_dbg, &aflag, &ntypes, types, &am_server,
+             &am_debug, &num_apps, &ierr);
+  if (ierr != ADLB_SUCCESS || am_server != 0 || am_debug != 0 ||
+      num_apps < 1) {
+    fprintf(stderr, "fshim: init ierr=%d\n", ierr);
+    return 2;
+  }
+  int me = -1, wsize = -1;
+  adlb_world_rank_(&me);
+  adlb_world_size_(&wsize);
+  if (me < 0 || wsize <= me) return 3;
+
+  if (me == 0) {
+    /* f1.f brackets its A-puts in a zero-length batch (examples/f1.f:163) */
+    int zero = 0;
+    adlb_begin_batch_put_(types /* unused */, &zero, &ierr);
+    if (ierr != ADLB_SUCCESS) return 4;
+    for (int i = 0; i < NUM_AS; i++) {
+      double work_a[20];
+      memset(work_a, 0, sizeof work_a);
+      work_a[0] = (double)me;
+      work_a[1] = (double)(i + 1);
+      int len = 20 * 8, tgt = -1, ans = me, wtype = TYPE_A, prio = -i;
+      adlb_put_(work_a, &len, &tgt, &ans, &wtype, &prio, &ierr);
+      if (ierr != ADLB_SUCCESS) return 5;
+    }
+    adlb_end_batch_put_(&ierr);
+    if (ierr != ADLB_SUCCESS) return 6;
+  }
+
+  int handle[ADLB_HANDLE_SIZE];
+  int processed = 0, answers = 0;
+  if (me == 0) {
+    /* master: collect one answer per A via blocking type-filtered reserve */
+    while (answers < NUM_AS) {
+      int req[2] = {TYPE_ANS, ADLB_RESERVE_EOL};
+      int wt = -1, wp = 0, wl = -1, ar = -1;
+      adlb_reserve_(req, &wt, &wp, handle, &wl, &ar, &ierr);
+      if (ierr != ADLB_SUCCESS || wt != TYPE_ANS || wl != 8) return 7;
+      double ans_val = -1.0;
+      adlb_get_reserved_(&ans_val, handle, &ierr);
+      if (ierr != ADLB_SUCCESS || ans_val < 1.0) return 8;
+      answers++;
+    }
+    int wtype = TYPE_A, num = -1, nbytes = -1, maxwq = -1;
+    adlb_info_num_work_units_(&wtype, &num, &nbytes, &maxwq, &ierr);
+    if (ierr != ADLB_SUCCESS || maxwq < 1) return 9;
+    double hwm = -1.0;
+    int key = ADLB_INFO_MALLOC_HWM;
+    adlb_info_get_(&key, &hwm, &ierr);
+    if (ierr != ADLB_SUCCESS || hwm <= 0.0) return 10;
+    adlb_set_problem_done_(&ierr);
+    if (ierr != ADLB_SUCCESS) return 11;
+  } else {
+    /* workers: poll with IRESERVE (f1.f's inner loop), fall back to the
+     * blocking reserve, answer each A with a targeted put to rank 0 */
+    for (;;) {
+      int req[2] = {TYPE_A, ADLB_RESERVE_EOL};
+      int wt = -1, wp = 0, wl = -1, ar = -1;
+      adlb_ireserve_(req, &wt, &wp, handle, &wl, &ar, &ierr);
+      if (ierr == ADLB_NO_CURRENT_WORK) {
+        adlb_reserve_(req, &wt, &wp, handle, &wl, &ar, &ierr);
+      }
+      if (ierr == ADLB_NO_MORE_WORK || ierr == ADLB_DONE_BY_EXHAUSTION)
+        break;
+      if (ierr != ADLB_SUCCESS || wt != TYPE_A || wl != 20 * 8) return 12;
+      double work_a[20];
+      double tq = -1.0;
+      adlb_get_reserved_timed_(work_a, handle, &tq, &ierr);
+      if (ierr != ADLB_SUCCESS || tq < 0.0) return 13;
+      double ans_val = work_a[1]; /* echo the A's index back */
+      if (ans_val < 1.0) return 14;
+      int len = 8, tgt = 0, ans = -1, wtype = TYPE_ANS, prio = 5;
+      adlb_put_(&ans_val, &len, &tgt, &ans, &wtype, &prio, &ierr);
+      if (ierr != ADLB_SUCCESS) return 15;
+      processed++;
+    }
+  }
+
+  printf("fshim rank %d: processed=%d answers=%d OK\n", me, processed,
+         answers);
+  adlb_finalize_(&ierr);
+  return ierr == ADLB_SUCCESS ? 0 : 16;
+}

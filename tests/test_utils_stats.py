@@ -1,0 +1,50 @@
+"""Running-stats utility (reference examples/stats.c port)."""
+
+import math
+import random
+
+import numpy as np
+
+from adlb_tpu.utils import RunningStats
+
+
+def test_gate_and_reset():
+    s = RunningStats("t1")
+    assert not s.enter(5.0)  # starts off, like the reference statsinit
+    s.on()
+    for v in (1.0, 2.0, 3.0):
+        assert s.enter(v)
+    s.off()
+    assert not s.enter(1_000_000.0)  # ignored while off
+    assert s.numvals == 3
+    assert s.sum == 6.0
+    assert s.min == 1.0 and s.max == 3.0
+    assert s.mean == 2.0
+    assert math.isclose(s.stddev, 1.0)
+    s.reset()
+    assert s.numvals == 0 and s.sum == 0.0 and s.mean == 0.0
+    assert not s.active
+
+
+def test_constant_sequence_has_zero_stddev():
+    s = RunningStats()
+    s.on()
+    for _ in range(1000):
+        s.enter(500.0)
+    assert s.numvals == 1000
+    assert s.mean == 500.0
+    assert s.stddev == 0.0
+
+
+def test_matches_numpy_on_random_stream():
+    rng = random.Random(7)
+    vals = [rng.uniform(-50, 50) for _ in range(5000)]
+    s = RunningStats()
+    s.on()
+    for v in vals:
+        s.enter(v)
+    a = np.array(vals)
+    assert math.isclose(s.mean, float(a.mean()), rel_tol=1e-12)
+    assert math.isclose(s.stddev, float(a.std(ddof=1)), rel_tol=1e-10)
+    assert s.min == float(a.min()) and s.max == float(a.max())
+    assert "n=5000" in s.dump()
