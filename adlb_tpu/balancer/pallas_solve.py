@@ -1,0 +1,126 @@
+"""Pallas TPU kernel for the greedy assignment inner loop.
+
+The batched global solve (see :mod:`adlb_tpu.balancer.solve`) is this
+framework's hot op — the TPU-native replacement for the reference's
+per-Reserve O(|wq|·16) linear scans (reference ``src/xq.c:190-247``) run
+once per balancer round over every server's queue at once.
+
+Kernel design (SURVEY §7 stage 5, "Pallas for the auction inner loop"):
+
+* An XLA pre-pass folds priority ordering, padding, requester validity and
+  the type mask into one ``[NT, NRp]`` int32 *compatibility matrix*
+  (``compat[k, r] = 1`` iff the k-th task in descending-priority order may
+  go to requester ``r``) — pure vectorized gather work XLA fuses well.
+* The Pallas kernel then runs the inherently sequential greedy sweep with
+  ALL state resident in VMEM: one ``fori_loop`` over task rows, each step a
+  VPU-width mask/min over the open-requester vector, a scalar winner write,
+  and an in-place open-vector update.  No HBM traffic inside the loop, no
+  per-step XLA dispatch — exactly the "keep the inner loop on-chip" recipe.
+* Winner inversion (task-order → per-requester assignment) is another tiny
+  XLA scatter after the kernel.
+
+Semantics are bit-identical to :func:`adlb_tpu.balancer.solve._host_greedy`
+(tasks in stable descending-priority order, each taking the lowest-index
+open compatible requester), so all three backends — host numpy, jitted XLA
+scan, Pallas — are interchangeable and cross-checked in tests.
+
+On non-TPU backends the kernel runs in interpreter mode (tests, CPU dev);
+on TPU it compiles with Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from adlb_tpu.balancer.solve import _NEG
+
+_LANE = 128  # TPU lane width: requester vectors are padded to a multiple
+
+
+def _greedy_sweep_kernel(compat_ref, winner_ref, open_scr):
+    """Sequential greedy over priority-ordered task rows, entirely in VMEM.
+
+    compat_ref: [NT, NRp] int32 (1 = this task may go to this requester)
+    winner_ref: [NT, 1] int32 out — requester index per task row, -1 = none
+    open_scr:   [1, NRp] int32 scratch — 1 while a requester is unmatched
+    """
+    nt = compat_ref.shape[0]
+    nrp = compat_ref.shape[1]
+    open_scr[:] = jnp.ones((1, nrp), dtype=jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, nrp), 1)
+
+    def body(t, _):
+        row = compat_ref[pl.ds(t, 1), :] * open_scr[:]
+        # lowest-index open compatible requester (the host twin's argmax on
+        # a bool mask picks the same first-True index)
+        idx = jnp.min(jnp.where(row > 0, lane, nrp))
+        found = idx < nrp
+        winner_ref[pl.ds(t, 1), :] = jnp.where(found, idx, -1).reshape(1, 1)
+        open_scr[:] = jnp.where(found & (lane == idx), 0, open_scr[:])
+        return 0
+
+    jax.lax.fori_loop(0, nt, body, 0)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_greedy_assign(
+    task_prio: jax.Array,  # [NT] int32, _NEG for padding
+    task_type: jax.Array,  # [NT] int32 type index, -1 for padding
+    req_mask: jax.Array,  # [NR, T] bool
+    req_valid: jax.Array,  # [NR] bool
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in twin of :func:`adlb_tpu.balancer.solve._greedy_assign` with
+    the sweep as a Pallas kernel. Returns assign[NR] int32 (task index per
+    requester, -1 if none)."""
+    NT = task_prio.shape[0]
+    NR = req_mask.shape[0]
+    NRp = _round_up(max(NR, 1), _LANE)
+
+    # XLA pre-pass: stable descending-priority order + compat matrix
+    order = jnp.argsort(-task_prio, stable=True)
+    s_prio = task_prio[order]
+    s_type = task_type[order]
+    live = (s_prio > _NEG) & (s_type >= 0)
+    compat = (
+        live[:, None]
+        & req_valid[None, :]
+        & req_mask[:, jnp.clip(s_type, 0)].T
+    )
+    compat = jnp.pad(compat, ((0, 0), (0, NRp - NR))).astype(jnp.int32)
+
+    winner = pl.pallas_call(
+        _greedy_sweep_kernel,
+        out_shape=jax.ShapeDtypeStruct((NT, 1), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((1, NRp), jnp.int32)],
+        interpret=interpret,
+    )(compat)[:, 0]
+
+    # invert winner-per-ordered-task into per-requester assignment; each
+    # requester wins at most once so the scatter is 1-1
+    valid = winner >= 0
+    assign = jnp.full((NR,), -1, dtype=jnp.int32)
+    assign = assign.at[jnp.where(valid, winner, NR)].set(
+        jnp.where(valid, order.astype(jnp.int32), -1), mode="drop"
+    )
+    return assign
+
+
+def make_pallas_assign(interpret: bool | None = None):
+    """Returns a (task_prio, task_type, req_mask, req_valid) -> assign
+    callable; interpret defaults to True off-TPU so tests and CPU dev runs
+    exercise the same kernel code path."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return functools.partial(pallas_greedy_assign, interpret=interpret)

@@ -94,6 +94,7 @@ class _BalancerWorker(threading.Thread):
             types=s.world.types,
             max_tasks=s.cfg.balancer_max_tasks,
             max_requesters=s.cfg.balancer_max_requesters,
+            backend=s.cfg.solver_backend,
         )
         s._solver = solver
         while True:

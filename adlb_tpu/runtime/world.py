@@ -102,6 +102,9 @@ class Config:
     # snapshot (fixed shapes for the jitted solve).
     balancer_max_tasks: int = 256
     balancer_max_requesters: int = 64
+    # device solve implementation: "auto" = Pallas sweep kernel on TPU, XLA
+    # scan elsewhere; explicit "xla"/"pallas" force one
+    solver_backend: str = "auto"
     trace: bool = False  # event tracing hooks (reference MPE shims)
     aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
     selfdiag_interval: float = 30.0  # server health dumps; 0 = off
@@ -118,6 +121,8 @@ class Config:
             raise ValueError(f"unknown put routing {self.put_routing!r}")
         if self.native_queues not in ("auto", "on", "off"):
             raise ValueError(f"unknown native_queues {self.native_queues!r}")
+        if self.solver_backend not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown solver_backend {self.solver_backend!r}")
 
 
 def normalize_req_types(

@@ -5,8 +5,8 @@ Port of the reference's standalone stats library (reference
 min / max / mean / sample standard deviation stay current after every
 contribution, using the numerically stable incremental update from Higham,
 *Accuracy and Stability of Numerical Algorithms*, pp. 12-13 (the same
-algorithm the reference cites, ``examples/stats.c:1-9``). Used by workloads
-(coinop-style latency probes) and by server self-diagnosis.
+algorithm the reference cites, ``examples/stats.c:1-9``). Used by the
+coinop workload's worker-side pop-latency accumulation.
 """
 
 from __future__ import annotations

@@ -1,9 +1,11 @@
 """coinop: the pop-latency microbenchmark.
 
 Mirrors the fork's addition (reference ``examples/coinop.cpp:79-126,190-213``):
-one producer floods N tokens through the pool; every worker measures the
-latency of each Reserve+Get pop and reports mean/stddev (gathered to the
-producer in the reference via MPI_Gather; here returned through app results).
+one producer floods N tokens through the pool; every worker accumulates the
+latency of each Reserve+Get pop in a streaming :class:`RunningStats` (the
+reference's stats.c accumulator pattern) and reports mean/stddev (gathered
+to the producer in the reference via MPI_Gather; here returned through app
+results, along with the raw latencies for driver-side percentiles).
 This is the steal-to-exec latency probe used by BASELINE.md.
 """
 
@@ -17,6 +19,7 @@ from typing import Optional
 from adlb_tpu.api import run_world
 from adlb_tpu.runtime.world import Config
 from adlb_tpu.types import ADLB_SUCCESS
+from adlb_tpu.utils import RunningStats
 
 TOKEN = 1
 
@@ -49,15 +52,19 @@ def run(
                 ctx.put(payload, TOKEN, work_prio=0)
             # producer finalizes immediately; workers drain the pool and the
             # exhaustion protocol ends the world once it runs dry
-            return []
+            return [], 0.0, 0.0
         lats = []
+        stats = RunningStats(f"pop-latency-rank{ctx.rank}")
+        stats.on()
         while True:
             t0 = time.monotonic()
             rc, r = ctx.reserve([TOKEN])
             if rc != ADLB_SUCCESS:
-                return lats
+                return lats, stats.mean, stats.stddev
             rc, buf, _tq = ctx.get_reserved_timed(r.handle)
-            lats.append(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            lats.append(dt)
+            stats.enter(dt)
             if work_time > 0:
                 time.sleep(work_time)
 
@@ -72,14 +79,12 @@ def run(
     )
     elapsed = time.monotonic() - t0
     all_lats = sorted(
-        lat for rank, lats in res.app_results.items() for lat in lats
+        lat for rank, (lats, _m, _s) in res.app_results.items()
+        for lat in lats
     )
     per_worker = {
-        rank: (
-            statistics.mean(lats) * 1e3,
-            (statistics.pstdev(lats) if len(lats) > 1 else 0.0) * 1e3,
-        )
-        for rank, lats in res.app_results.items()
+        rank: (mean * 1e3, stddev * 1e3)
+        for rank, (lats, mean, stddev) in res.app_results.items()
         if rank != 0 and lats
     }
     n = len(all_lats)
