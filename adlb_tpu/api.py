@@ -85,6 +85,21 @@ class AdlbContext:
     def abort(self, code: int) -> None:
         self._c.abort(code)
 
+    # app<->app messaging: the reference hands app code a dedicated
+    # communicator (app_comm from ADLB_Init, reference src/adlb.c:256,318)
+    # for ordinary point-to-point traffic next to ADLB calls (c1.c's
+    # TAG_B_ANSWER flow); these are its MPI_Send/Iprobe/Recv equivalents.
+    def app_send(self, dest_app_rank: int, payload, apptag: int = 0) -> None:
+        self._c.app_send(dest_app_rank, payload, apptag)
+
+    def app_iprobe(self, apptag: Optional[int] = None,
+                   src: Optional[int] = None) -> bool:
+        return self._c.app_iprobe(apptag, src)
+
+    def app_recv(self, apptag: Optional[int] = None, src: Optional[int] = None,
+                 timeout: Optional[float] = None):
+        return self._c.app_recv(apptag, src, timeout)
+
 
 @dataclasses.dataclass
 class WorldResult:

@@ -69,6 +69,11 @@ class Client:
         # a run-time flag here instead of a compile-time one
         self.tracer: Optional[Tracer] = Tracer(self.rank) if cfg.trace else None
         self._reserved_types: dict[tuple[int, int], int] = {}  # (holder, seqno) -> type
+        # app<->app messages that arrived while waiting for a protocol
+        # response (the reference's app_comm traffic is a separate MPI
+        # communicator, so it can never be confused with ADLB's tags; here
+        # one fabric carries both, so AM_APP frames are stashed)
+        self._app_inbox: list[Msg] = []
 
     def _span(self, name: str, **args):
         """API-call trace span + user-state inference boundary."""
@@ -92,14 +97,11 @@ class Client:
             m = self.ep.recv(timeout=0.5)
             if m is None:
                 continue
-            if m.tag is Tag.TA_ABORT:
-                self.aborted = True
-                raise AdlbAborted(m.data.get("code", -1))
             if m.tag is want:
                 return m
             # A late RESERVE_RESP can cross a termination flush only if the
             # origin server double-responded, which the rq discipline forbids.
-            raise AdlbError(f"rank {self.rank}: unexpected {m.tag} while waiting {want}")
+            self._dispatch_passive(m, waiting=want)
 
     # -- Put family ----------------------------------------------------------
 
@@ -326,6 +328,94 @@ class Client:
     def get_reserved(self, handle: WorkHandle) -> tuple[int, Optional[bytes]]:
         rc, buf, _ = self.get_reserved_timed(handle)
         return rc, buf
+
+    # -- app <-> app messaging (the reference's app_comm) ---------------------
+    #
+    # ADLB_Init returns an app-ranks-only communicator on which applications
+    # exchange ordinary point-to-point messages alongside ADLB calls — e.g.
+    # c1.c ships B/C answers rank-to-rank with MPI_Send/Iprobe/Recv on
+    # app_comm (reference src/adlb.c:256,318; examples/c1.c). Here the same
+    # fabric carries those messages under the AM_APP tag with a user tag
+    # inside; app rank numbering coincides with world rank numbering for
+    # ranks < num_app_ranks, as in the reference (src/adlb.c:252-257).
+
+    def app_send(self, dest_app_rank: int, payload, apptag: int = 0) -> None:
+        """Point-to-point message to another app rank (MPI_Send on app_comm)."""
+        if not (0 <= dest_app_rank < self.world.num_app_ranks):
+            raise AdlbError(f"app_send: {dest_app_rank} is not an app rank")
+        self.ep.send(
+            dest_app_rank,
+            msg(Tag.AM_APP, self.rank, payload=payload, apptag=int(apptag)),
+        )
+
+    def _match_app(self, apptag: Optional[int], src: Optional[int]) -> Optional[int]:
+        for i, m in enumerate(self._app_inbox):
+            if apptag is not None and m.apptag != apptag:
+                continue
+            if src is not None and m.src != src:
+                continue
+            return i
+        return None
+
+    def app_iprobe(
+        self, apptag: Optional[int] = None, src: Optional[int] = None
+    ) -> bool:
+        """Non-blocking check for a pending app message (MPI_Iprobe)."""
+        self._drain_inbox()
+        return self._match_app(apptag, src) is not None
+
+    def app_recv(
+        self,
+        apptag: Optional[int] = None,
+        src: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ):
+        """Receive an app message; returns (payload, src_rank, apptag).
+
+        Blocks until a matching message arrives (MPI_Recv), or returns None
+        on timeout.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            # drain already-delivered frames first so a zero/expired timeout
+            # still sees messages sitting in the endpoint queue
+            self._drain_inbox()
+            i = self._match_app(apptag, src)
+            if i is not None:
+                m = self._app_inbox.pop(i)
+                return m.payload, m.src, m.apptag
+            if self._abort_event is not None and self._abort_event.is_set():
+                self.aborted = True
+                raise AdlbAborted(-1)
+            remaining = 0.2
+            if deadline is not None:
+                remaining = min(remaining, deadline - time.monotonic())
+                if remaining <= 0:
+                    return None
+            m = self.ep.recv(timeout=remaining)
+            if m is None:
+                continue
+            self._dispatch_passive(m)
+
+    def _drain_inbox(self) -> None:
+        """Pull everything already delivered without blocking."""
+        while True:
+            m = self.ep.recv(timeout=0.0)
+            if m is None:
+                return
+            self._dispatch_passive(m)
+
+    def _dispatch_passive(self, m: Msg, waiting: Optional[Tag] = None) -> None:
+        """Handle a message that is not the awaited response: abort frames
+        raise, app messages are stashed, anything else is a protocol error."""
+        if m.tag is Tag.TA_ABORT:
+            self.aborted = True
+            raise AdlbAborted(m.data.get("code", -1))
+        if m.tag is Tag.AM_APP:
+            self._app_inbox.append(m)
+            return
+        ctx = f" while waiting {waiting}" if waiting is not None else ""
+        raise AdlbError(f"rank {self.rank}: unexpected {m.tag}{ctx}")
 
     # -- control -------------------------------------------------------------
 

@@ -58,6 +58,12 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.TA_INFO_NUM_RESP: 1043,
     Tag.TA_INFO_GET_RESP: 1044,
     Tag.TA_ABORT: 1046,
+    # app<->app point-to-point (the reference's app_comm traffic). The id
+    # exists so the codec stays total, but native C clients have no
+    # app-messaging API yet, so encodable() refuses AM_APP — a Python rank
+    # app_send-ing to a native rank gets a clear error instead of killing
+    # the C client with an unknown tag.
+    Tag.AM_APP: 1047,
     # server<->server + balancer + debug tags (Python<->Python, normally
     # pickled; ids exist so the codec is total)
     Tag.SS_QMSTAT: 1101,
@@ -119,6 +125,7 @@ FIELDS: dict[str, tuple[int, int]] = {
     "server_rank": (23, _KIND_I64),
     "key": (24, _KIND_I64),
     "value": (25, _KIND_F64),
+    "apptag": (26, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 
@@ -132,6 +139,10 @@ _U16 = struct.Struct("<H")
 def encodable(m: Msg) -> bool:
     """True if every field of m has a binary field id (None values are
     encoded by omission)."""
+    if m.tag is Tag.AM_APP:
+        # the native client library has no app-receive API (and arbitrary
+        # Python payloads don't survive the bytes-only TLV form)
+        return False
     return all(k in FIELDS for k, v in m.data.items() if v is not None)
 
 

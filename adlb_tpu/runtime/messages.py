@@ -73,6 +73,12 @@ class Tag(enum.Enum):
     SS_MIGRATE_WORK = enum.auto()  # holder -> dest: the moved units
     SS_MIGRATE_ACK = enum.auto()  # dest -> holder: units landed (or bounced)
 
+    # app <-> app (the reference's app_comm: ADLB_Init hands back a
+    # communicator on which app ranks exchange ordinary point-to-point
+    # messages, e.g. c1.c's TAG_B_ANSWER answer flow; here the same fabric
+    # carries them, tagged AM_APP with a user tag inside)
+    AM_APP = enum.auto()
+
     # debug server
     DS_LOG = enum.auto()
     DS_END = enum.auto()

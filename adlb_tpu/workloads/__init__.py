@@ -29,13 +29,21 @@ benchmark drivers:
 * :mod:`~adlb_tpu.workloads.pmcmc` — embarrassingly-parallel MCMC hard-disk
   demo with targeted solution returns (reference ``examples/pmcmc.c``)
 
-The reference's ``c1.c``/``c2.c``/``c3.c`` are evolutionary precursors of
-``c4.c`` (the same GFMC A/B/C economy with fewer stages / app_comm answer
-plumbing); their behavior is covered by :mod:`~adlb_tpu.workloads.gfmc` and
-:mod:`~adlb_tpu.workloads.skel`. ``model.c`` (master puts N dummy problems,
-everyone reserves any-type and sleeps, exhaustion terminates) is the same
-shape as :mod:`~adlb_tpu.workloads.hotspot`. ``partest.c`` is an unfinished
-scratch program in the reference (``examples/partest.c:1-3`` says so
-itself); ``stats.c`` is a standalone statistics library, ported as
-:mod:`adlb_tpu.utils.stats`.
+* :mod:`~adlb_tpu.workloads.model` — minimal master/worker dummy-work model
+  terminating by exhaustion (reference ``examples/model.c``)
+* :mod:`~adlb_tpu.workloads.c1` — GFMC-precursor epoch workload whose B/C
+  answers travel as app-to-app point-to-point messages, exercising the
+  app_comm-equivalent messaging layer (reference ``examples/c1.c``)
+* :mod:`~adlb_tpu.workloads.c3` — batch-generation GFMC variant with a
+  park-until-exhaustion master (reference ``examples/c3.c``)
+* :mod:`~adlb_tpu.workloads.partest` — synthetic-work calibration utility
+  (define_work/do_work nugget loops, reference ``examples/partest.c``)
+
+``c2.c`` is the skeleton behind :mod:`~adlb_tpu.workloads.skel` and is
+covered there; ``stats.c`` is a standalone statistics library, ported as
+:mod:`adlb_tpu.utils.stats`; ``grid_old_daf.c`` is a superseded draft of
+``grid_daf.c`` (covered by :mod:`~adlb_tpu.workloads.grid`); ``f1.f`` /
+``fbatcher.f`` are Fortran twins of c1/batcher exercising the Fortran
+binding, which this framework validates through the C shim tests instead
+(``tests/test_fshim.py``).
 """
