@@ -96,3 +96,48 @@ def native_available() -> bool:
 
 def build_error() -> Optional[str]:
     return _build_error
+
+
+# ---------------------------------------------------------------- serverd
+
+_SERVERD_SRC = os.path.join(_DIR, "serverd.cpp")
+_SERVERD_HDR = os.path.join(_DIR, "wqcore.hpp")
+_SERVERD_BIN = os.path.join(_DIR, "adlb_serverd")
+
+_serverd_lock = threading.Lock()
+_serverd_error: Optional[str] = None
+
+
+def ensure_serverd() -> str:
+    """Build (if stale) and return the path of the native server daemon.
+
+    Raises RuntimeError when the toolchain is unavailable — callers asked
+    for server_impl="native" explicitly, so there is no silent fallback.
+    """
+    global _serverd_error
+    with _serverd_lock:
+        if _serverd_error is not None:
+            raise RuntimeError(_serverd_error)
+        src_mtime = max(
+            os.path.getmtime(_SERVERD_SRC), os.path.getmtime(_SERVERD_HDR)
+        )
+        if (
+            not os.path.exists(_SERVERD_BIN)
+            or os.path.getmtime(_SERVERD_BIN) < src_mtime
+        ):
+            tmp = f"{_SERVERD_BIN}.{os.getpid()}.tmp"
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-pthread", "-o", tmp,
+                _SERVERD_SRC,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                os.replace(tmp, _SERVERD_BIN)
+            except (OSError, subprocess.CalledProcessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                _serverd_error = f"native server unavailable: {detail[:800]}"
+                raise RuntimeError(_serverd_error) from None
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        return _SERVERD_BIN

@@ -99,9 +99,37 @@ def run_native_world(
         types=tuple(types),
         use_debug_server=use_debug_server,
     )
+    all_native = cfg.server_impl == "native"
+    if all_native and use_debug_server:
+        raise ValueError("native servers do not carry DS_LOG frames yet")
     addr_map = local_addr_map(world.nranks)
     binary = set(range(n_clients))  # native ranks speak the TLV codec
     abort_event = threading.Event()
+
+    server_stats: dict[int, dict] = {}
+    errors: list[BaseException] = []
+    threads = []
+    endpoints = {}
+    daemons: dict[int, subprocess.Popen] = {}
+
+    if all_native:
+        # all-native world: C clients + C++ server daemons. Daemons bind
+        # their own ports, so the rendezvous map is completed from their
+        # PORT hellos before any client starts. A failed bootstrap must not
+        # leak the daemons already spawned.
+        from adlb_tpu.native import daemon as daemon_mod
+
+        try:
+            for rank in world.server_ranks:
+                daemons[rank] = daemon_mod.spawn_daemon(world, cfg, rank)
+            for rank, p in daemons.items():
+                addr_map[rank] = ("127.0.0.1", daemon_mod.read_hello(p, rank))
+            for p in daemons.values():
+                daemon_mod.send_addrs(p, addr_map)
+        except BaseException:
+            for p in daemons.values():
+                p.kill()
+            raise
 
     with tempfile.NamedTemporaryFile(
         "w", suffix=".adlb", delete=False
@@ -110,42 +138,40 @@ def run_native_world(
             f.write(f"{r} {host} {port}\n")
         rendezvous = f.name
 
-    server_stats: dict[int, dict] = {}
-    errors: list[BaseException] = []
-    # bind every Python listener BEFORE any rank starts sending: a server's
-    # first DS_LOG can otherwise race the debug server's bind and die on
-    # connection-refused
-    endpoints = {
-        rank: TcpEndpoint(rank, addr_map, binary_peers=binary)
-        for rank in (
-            list(world.server_ranks)
-            + ([world.debug_server_rank] if use_debug_server else [])
-        )
-    }
+    if not all_native:
+        # bind every Python listener BEFORE any rank starts sending: a
+        # server's first DS_LOG can otherwise race the debug server's bind
+        # and die on connection-refused
+        endpoints = {
+            rank: TcpEndpoint(rank, addr_map, binary_peers=binary)
+            for rank in (
+                list(world.server_ranks)
+                + ([world.debug_server_rank] if use_debug_server else [])
+            )
+        }
 
-    def server_main(rank: int) -> None:
-        try:
-            server = Server(world, cfg, endpoints[rank], abort_event)
-            server.run()
-            server_stats[rank] = server.finalize_stats()
-        except BaseException as e:  # noqa: BLE001
-            errors.append(e)
-            abort_event.set()
+        def server_main(rank: int) -> None:
+            try:
+                server = Server(world, cfg, endpoints[rank], abort_event)
+                server.run()
+                server_stats[rank] = server.finalize_stats()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                abort_event.set()
 
-    def debug_main(rank: int) -> None:
-        DebugServer(world, cfg, endpoints[rank], abort_event).run()
+        def debug_main(rank: int) -> None:
+            DebugServer(world, cfg, endpoints[rank], abort_event).run()
 
-    threads = []
-    for rank in world.server_ranks:
-        t = threading.Thread(target=server_main, args=(rank,), daemon=True)
-        threads.append(t)
-        t.start()
-    if use_debug_server:
-        t = threading.Thread(
-            target=debug_main, args=(world.debug_server_rank,), daemon=True
-        )
-        threads.append(t)
-        t.start()
+        for rank in world.server_ranks:
+            t = threading.Thread(target=server_main, args=(rank,), daemon=True)
+            threads.append(t)
+            t.start()
+        if use_debug_server:
+            t = threading.Thread(
+                target=debug_main, args=(world.debug_server_rank,), daemon=True
+            )
+            threads.append(t)
+            t.start()
 
     env = dict(os.environ)
     env["ADLB_RENDEZVOUS"] = rendezvous
@@ -198,6 +224,13 @@ def run_native_world(
                 t.join(timeout=5.0)
         for ep in endpoints.values():
             ep.close()
+        if daemons:
+            from adlb_tpu.native import daemon as daemon_mod
+
+            for rank, p in daemons.items():
+                stats, _abort_code, _rc = daemon_mod.collect_stats(p)
+                if stats is not None:
+                    server_stats[rank] = stats
         os.unlink(rendezvous)
 
     if errors:

@@ -1,0 +1,99 @@
+"""Bootstrap protocol for the native server daemon (serverd.cpp).
+
+One place for the stdin/stdout handshake both launchers speak
+(transport_tcp._native_server_main and capi.run_native_world):
+
+    stdin:  config lines ... "endconfig"
+    stdout: "PORT <n>"
+    stdin:  "addr <rank> <host> <port>" ... "endaddrs"
+    ... runs ...
+    stdout: "STATS {json}" (and/or "ABORT <code>")
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Optional
+
+
+def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
+    """Start adlb_serverd for one server rank and ship its config."""
+    from adlb_tpu.native.build import ensure_serverd
+
+    proc = subprocess.Popen(
+        [ensure_serverd()],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    lines = [
+        f"nranks {world.nranks}",
+        f"nservers {world.nservers}",
+        f"use_debug_server {1 if world.use_debug_server else 0}",
+        "types " + " ".join(str(t) for t in world.types),
+        f"rank {rank}",
+        f"qmstat_interval {cfg.qmstat_interval}",
+        f"exhaust_check_interval {cfg.exhaust_check_interval}",
+        f"max_malloc {cfg.max_malloc_per_server}",
+        "endconfig",
+    ]
+    proc.stdin.write("\n".join(lines) + "\n")
+    proc.stdin.flush()
+    return proc
+
+
+def read_hello(proc: subprocess.Popen, rank: int) -> int:
+    """Read the PORT line; raises (after killing the daemon) on anything
+    else, so a crashed daemon fails loudly instead of hanging the world."""
+    line = (proc.stdout.readline() or "").strip()
+    if not line.startswith("PORT "):
+        proc.kill()
+        raise RuntimeError(
+            f"native server rank {rank}: bad hello {line!r} "
+            f"(exit={proc.poll()})"
+        )
+    return int(line.split()[1])
+
+
+def send_addrs(proc: subprocess.Popen, addr_map: dict) -> None:
+    lines = [
+        f"addr {r} {host} {port}"
+        for r, (host, port) in sorted(addr_map.items())
+    ] + ["endaddrs"]
+    proc.stdin.write("\n".join(lines) + "\n")
+    proc.stdin.flush()
+
+
+def drain_output(proc: subprocess.Popen):
+    """Consume the daemon's stdout to completion; returns
+    (stats dict (int key -> float) or None, abort code or None)."""
+    stats: Optional[dict] = None
+    abort_code: Optional[int] = None
+    for line in proc.stdout:
+        line = line.strip()
+        if line.startswith("STATS "):
+            stats = {int(k): v for k, v in json.loads(line[6:]).items()}
+        elif line.startswith("ABORT "):
+            abort_code = int(line.split()[1])
+    return stats, abort_code
+
+
+def collect_stats(proc: subprocess.Popen, timeout: float = 15.0):
+    """Wait for exit and parse trailing output (for callers that did not
+    stream stdout); kills on timeout. Returns (stats, abort_code,
+    returncode)."""
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    stats: Optional[dict] = None
+    abort_code: Optional[int] = None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("STATS "):
+            stats = {int(k): v for k, v in json.loads(line[6:]).items()}
+        elif line.startswith("ABORT "):
+            abort_code = int(line.split()[1])
+    return stats, abort_code, proc.returncode

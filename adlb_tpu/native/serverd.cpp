@@ -1,0 +1,1721 @@
+// Native server daemon: the C++ twin of the Python server reactor
+// (adlb_tpu/runtime/server.py), covering the reference's full steal-mode
+// protocol — the equivalent of ADLBP_Server's ~2,100-line event loop
+// (reference src/adlb.c:382-2506): Put admission + immediate rq match,
+// Reserve with targeted-first indexed matching, Get/common fetch, qmstat
+// state broadcast (reference src/adlb.c:806-822), RFR pull stealing with
+// stale-state patching and UNRESERVE compensation (reference
+// src/adlb.c:1802-2070), memory-pressure push with PUSH_DEL cancellation
+// (reference src/adlb.c:509-556,2109-2362), the double-pass exhaustion vote
+// (reference src/adlb.c:754-785,1575-1650), held two-phase shutdown ring
+// (reference src/adlb.c:1493-1574), abort fan-out, and the Info stats
+// surface (reference src/adlb.c:3072-3141).
+//
+// Runs one process per server rank. Clients may be Python (binary-codec
+// frames; spawn_world declares native servers as binary peers) or native C
+// (libadlb.cpp). Server<->server frames reuse the same TLV form with
+// field ids >= 27, which exist only here: worlds never mix native and
+// Python servers, so those ids never reach the Python decoder.
+//
+// Bootstrap protocol with the Python wrapper (transport_tcp._child_main):
+//   stdin:  config lines ... "endconfig"
+//   stdout: "PORT <n>"
+//   stdin:  "addr <rank> <host> <port>" lines ... "endaddrs"
+//   ... runs ...
+//   stdout: "STATS {json}"   (finalize_stats), or "ABORT <code>"
+//
+// The balancer brain stays in Python/JAX (SURVEY §7's language split);
+// balancer="tpu" worlds use the Python server.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "wqcore.hpp"
+
+namespace {
+
+// ---- constants (adlb_tpu/types.py) ----------------------------------------
+constexpr int ADLB_SUCCESS = 1;
+constexpr int ADLB_NO_MORE_WORK = -999999999;
+constexpr int ADLB_DONE_BY_EXHAUSTION = -999999998;
+constexpr int ADLB_NO_CURRENT_WORK = -999999997;
+constexpr int ADLB_PUT_REJECTED = -999999996;
+constexpr int ADLB_LOWEST_PRIO = -999999999;
+
+// InfoKey (adlb_tpu/types.py InfoKey)
+enum InfoKey {
+  K_MALLOC_HWM = 1,
+  K_AVG_TIME_ON_RQ = 2,
+  K_NPUSHED_FROM_HERE = 3,
+  K_NPUSHED_TO_HERE = 4,
+  K_NREJECTED_PUTS = 5,
+  K_LOOP_TOP_TIME = 6,
+  K_MAX_QMSTAT_TRIP_TIME = 7,
+  K_AVG_QMSTAT_TRIP_TIME = 8,
+  K_NUM_QMS_EXCEED_INT = 9,
+  K_NUM_RESERVES = 10,
+  K_NUM_RESERVES_PUT_ON_RQ = 11,
+  K_MAX_WQ_COUNT = 12,
+  K_LAST = 13,
+};
+
+// ---- wire tags (codec.py WIRE_TAG) ----------------------------------------
+enum WireTag : uint16_t {
+  T_FA_PUT = 1001,
+  T_FA_PUT_COMMON = 1003,
+  T_FA_BATCH_DONE = 1005,
+  T_FA_DID_PUT_AT_REMOTE = 1006,
+  T_FA_RESERVE = 1007,
+  T_TA_RESERVE_RESP = 1008,
+  T_FA_GET_RESERVED = 1009,
+  T_TA_GET_RESERVED_RESP = 1010,
+  T_FA_NO_MORE_WORK = 1011,
+  T_FA_LOCAL_APP_DONE = 1012,
+  T_TA_PUT_RESP = 1020,
+  T_FA_ABORT = 1027,
+  T_FA_INFO_NUM_WORK_UNITS = 1037,
+  T_FA_GET_COMMON = 1038,
+  T_TA_GET_COMMON_RESP = 1039,
+  T_FA_INFO_GET = 1041,
+  T_TA_PUT_COMMON_RESP = 1042,
+  T_TA_INFO_NUM_RESP = 1043,
+  T_TA_INFO_GET_RESP = 1044,
+  T_TA_ABORT = 1046,
+  // server <-> server (codec.py 11xx block)
+  T_SS_QMSTAT = 1101,
+  T_SS_RFR = 1102,
+  T_SS_RFR_RESP = 1103,
+  T_SS_UNRESERVE = 1104,
+  T_SS_PUSH_QUERY = 1105,
+  T_SS_PUSH_QUERY_RESP = 1106,
+  T_SS_PUSH_WORK = 1107,
+  T_SS_PUSH_DEL = 1108,
+  T_SS_MOVING_TARGETED_WORK = 1109,
+  T_SS_NO_MORE_WORK = 1110,
+  T_SS_EXHAUST_CHK_1 = 1111,
+  T_SS_EXHAUST_CHK_2 = 1112,
+  T_SS_DONE_BY_EXHAUSTION = 1113,
+  T_SS_END_1 = 1114,
+  T_SS_END_2 = 1115,
+  T_SS_ABORT = 1116,
+};
+
+// ---- field ids ------------------------------------------------------------
+// 1..26 mirror codec.py FIELDS (shared with Python/native clients);
+// >= 27 are native-server-only (server<->server frames).
+enum FieldId : uint8_t {
+  F_PAYLOAD = 1,       // bytes
+  F_WORK_TYPE = 2,     // i64
+  F_PRIO = 3,          // i64
+  F_TARGET_RANK = 4,   // i64
+  F_ANSWER_RANK = 5,   // i64
+  F_COMMON_LEN = 6,    // i64
+  F_COMMON_SERVER = 7, // i64
+  F_COMMON_SEQNO = 8,  // i64
+  F_RC = 9,            // i64
+  F_HINT = 10,         // i64
+  F_REQ_TYPES = 11,    // list
+  F_HANG = 12,         // i64
+  F_RQSEQNO = 13,      // i64
+  F_HANDLE = 14,       // list
+  F_WORK_LEN = 15,     // i64
+  F_TIME_ON_Q = 16,    // f64
+  F_COUNT = 17,        // i64
+  F_NBYTES = 18,       // i64
+  F_MAX_WQ = 19,       // i64
+  F_CODE = 20,         // i64
+  F_SEQNO = 21,        // i64
+  F_REFCNT = 22,       // i64
+  F_SERVER_RANK = 23,  // i64
+  F_KEY = 24,          // i64
+  F_VALUE = 25,        // f64
+  // -- native-only --
+  F_QLEN = 27,            // i64
+  F_HI_PRIO = 28,         // list: prios in world-types order
+  F_FOR_RANK = 29,        // i64
+  F_TARGETED_LOOKUP = 30, // i64
+  F_LOOKUP_TYPE = 31,     // i64
+  F_FOUND = 32,           // i64
+  F_QUERY_ID = 33,        // i64
+  F_ACCEPT = 34,          // i64
+  F_HOME_SERVER = 35,     // i64
+  F_TIME_STAMP = 36,      // f64
+  F_APP_RANK = 37,        // i64
+  F_FROM_SERVER = 38,     // i64
+  F_TO_SERVER = 39,       // i64
+  F_ORIGIN = 40,          // i64
+  F_VOTE_OK = 41,              // i64
+  F_COMPLETE = 42,        // i64
+  F_NPARKED = 43,         // i64
+  F_ACT = 44,             // list: alternating (rank, activity)
+  F_PARKED = 45,          // list: flattened (rank, ntypes, t0..tn)*
+};
+
+enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
+
+struct FieldVal {
+  uint8_t kind = KIND_I64;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string b;
+  std::vector<int64_t> l;
+};
+
+struct NMsg {
+  uint16_t tag = 0;
+  int32_t src = -1;
+  std::map<uint8_t, FieldVal> f;
+
+  bool has(uint8_t id) const { return f.count(id) != 0; }
+  int64_t geti(uint8_t id, int64_t dflt = 0) const {
+    auto it = f.find(id);
+    return it == f.end() ? dflt : it->second.i;
+  }
+  double getd(uint8_t id, double dflt = 0.0) const {
+    auto it = f.find(id);
+    return it == f.end() ? dflt : it->second.d;
+  }
+  const std::string* getb(uint8_t id) const {
+    auto it = f.find(id);
+    return it == f.end() ? nullptr : &it->second.b;
+  }
+  const std::vector<int64_t>* getl(uint8_t id) const {
+    auto it = f.find(id);
+    return it == f.end() ? nullptr : &it->second.l;
+  }
+  NMsg& seti(uint8_t id, int64_t v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_I64;
+    fv.i = v;
+    return *this;
+  }
+  NMsg& setd(uint8_t id, double v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_F64;
+    fv.d = v;
+    return *this;
+  }
+  NMsg& setb(uint8_t id, std::string v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_BYTES;
+    fv.b = std::move(v);
+    return *this;
+  }
+  NMsg& setl(uint8_t id, std::vector<int64_t> v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_LIST;
+    fv.l = std::move(v);
+    return *this;
+  }
+};
+
+[[noreturn]] void die(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  std::fprintf(stderr, "[adlb_serverd] fatal: ");
+  std::vfprintf(stderr, fmt, ap);
+  std::fprintf(stderr, "\n");
+  va_end(ap);
+  std::exit(1);
+}
+
+double monotonic() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+// ---- TLV codec (codec.py encode_binary/decode_binary) ---------------------
+
+void put_u16(std::string& out, uint16_t v) { out.append((const char*)&v, 2); }
+void put_u32(std::string& out, uint32_t v) { out.append((const char*)&v, 4); }
+void put_i32(std::string& out, int32_t v) { out.append((const char*)&v, 4); }
+void put_i64(std::string& out, int64_t v) { out.append((const char*)&v, 8); }
+void put_f64(std::string& out, double v) { out.append((const char*)&v, 8); }
+
+std::string encode(const NMsg& m) {
+  std::string out;
+  out.push_back(char(0x01));  // BINARY_MAGIC
+  put_u16(out, m.tag);
+  put_i32(out, m.src);
+  put_u16(out, uint16_t(m.f.size()));
+  for (const auto& kv : m.f) {
+    out.push_back(char(kv.first));
+    out.push_back(char(kv.second.kind));
+    switch (kv.second.kind) {
+      case KIND_I64: put_i64(out, kv.second.i); break;
+      case KIND_F64: put_f64(out, kv.second.d); break;
+      case KIND_BYTES:
+        put_u32(out, uint32_t(kv.second.b.size()));
+        out.append(kv.second.b);
+        break;
+      case KIND_LIST:
+        put_u16(out, uint16_t(kv.second.l.size()));
+        for (int64_t x : kv.second.l) put_i64(out, x);
+        break;
+    }
+  }
+  return out;
+}
+
+NMsg decode(const std::string& body) {
+  if (body.size() < 9 || body[0] != 0x01) die("bad frame magic");
+  NMsg m;
+  size_t off = 1;
+  std::memcpy(&m.tag, body.data() + off, 2); off += 2;
+  std::memcpy(&m.src, body.data() + off, 4); off += 4;
+  uint16_t nfields;
+  std::memcpy(&nfields, body.data() + off, 2); off += 2;
+  auto need = [&](size_t n) {
+    if (off + n > body.size()) die("truncated frame (tag %u)", m.tag);
+  };
+  for (uint16_t i = 0; i < nfields; ++i) {
+    need(2);
+    uint8_t fid = uint8_t(body[off]);
+    uint8_t kind = uint8_t(body[off + 1]);
+    off += 2;
+    FieldVal fv;
+    fv.kind = kind;
+    switch (kind) {
+      case KIND_I64:
+        need(8);
+        std::memcpy(&fv.i, body.data() + off, 8); off += 8;
+        break;
+      case KIND_F64:
+        need(8);
+        std::memcpy(&fv.d, body.data() + off, 8); off += 8;
+        break;
+      case KIND_BYTES: {
+        need(4);
+        uint32_t n;
+        std::memcpy(&n, body.data() + off, 4); off += 4;
+        need(n);
+        fv.b.assign(body.data() + off, n); off += n;
+        break;
+      }
+      case KIND_LIST: {
+        need(2);
+        uint16_t cnt;
+        std::memcpy(&cnt, body.data() + off, 2); off += 2;
+        need(size_t(cnt) * 8);
+        fv.l.resize(cnt);
+        for (uint16_t j = 0; j < cnt; ++j) {
+          std::memcpy(&fv.l[j], body.data() + off, 8); off += 8;
+        }
+        break;
+      }
+      default: die("bad field kind %u", kind);
+    }
+    m.f.emplace(fid, std::move(fv));
+  }
+  return m;
+}
+
+// ---- endpoint: acceptor + readers -> inbox, lazy outbound -----------------
+// Same shape as the native client's transport (libadlb.cpp) and the Python
+// TcpEndpoint: one listener, one reader thread per inbound connection,
+// persistent outbound sockets, 4-byte LE length prefix per frame.
+
+class Endpoint {
+ public:
+  Endpoint() = default;
+
+  int listen_any() {
+    lsock_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (lsock_ < 0) die("socket: %s", strerror(errno));
+    int one = 1;
+    setsockopt(lsock_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    if (bind(lsock_, (sockaddr*)&addr, sizeof(addr)) < 0)
+      die("bind: %s", strerror(errno));
+    if (listen(lsock_, 64) < 0) die("listen: %s", strerror(errno));
+    socklen_t len = sizeof(addr);
+    getsockname(lsock_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    acceptor_ = std::thread([this] { accept_loop(); });
+    return port_;
+  }
+
+  void set_addr(int rank, std::string host, int port) {
+    addr_map_[rank] = {std::move(host), port};
+  }
+
+  void send(int dest, const NMsg& m) {
+    std::string body = encode(m);
+    std::string frame;
+    put_u32(frame, uint32_t(body.size()));
+    frame += body;
+    std::unique_lock<std::mutex> lk(out_mu_);
+    int& sock = out_socks_[dest];
+    if (sock == 0) sock = connect_to(dest);
+    if (sock < 0) {
+      // peer unreachable after the retry window (shutdown races): drop this
+      // frame loudly, but leave the slot retryable so a recovered peer is
+      // reconnected on the next send instead of being black-holed forever
+      sock = 0;
+      std::fprintf(stderr,
+                   "[adlb_serverd] dropping frame tag %u to unreachable "
+                   "rank %d\n", m.tag, dest);
+      return;
+    }
+    const char* p = frame.data();
+    size_t left = frame.size();
+    while (left > 0) {
+      ssize_t n = ::send(sock, p, left, MSG_NOSIGNAL);
+      if (n <= 0) {
+        close(sock);
+        sock = connect_to(dest);  // one reconnect attempt
+        if (sock < 0) return;
+        p = frame.data();
+        left = frame.size();
+        continue;
+      }
+      p += n;
+      left -= size_t(n);
+    }
+  }
+
+  // blocking receive with timeout (seconds); false on timeout
+  bool recv(NMsg* out, double timeout) {
+    std::unique_lock<std::mutex> lk(in_mu_);
+    if (inbox_.empty()) {
+      in_cv_.wait_for(lk, std::chrono::duration<double>(timeout),
+                      [this] { return !inbox_.empty(); });
+    }
+    if (inbox_.empty()) return false;
+    *out = std::move(inbox_.front());
+    inbox_.pop_front();
+    return true;
+  }
+
+  bool recv_now(NMsg* out) {
+    std::unique_lock<std::mutex> lk(in_mu_);
+    if (inbox_.empty()) return false;
+    *out = std::move(inbox_.front());
+    inbox_.pop_front();
+    return true;
+  }
+
+  void close_all() {
+    closed_ = true;
+    if (lsock_ >= 0) { shutdown(lsock_, SHUT_RDWR); close(lsock_); }
+    std::unique_lock<std::mutex> lk(out_mu_);
+    for (auto& kv : out_socks_)
+      if (kv.second > 0) { shutdown(kv.second, SHUT_WR); close(kv.second); }
+  }
+
+ private:
+  void accept_loop() {
+    while (!closed_) {
+      int conn = accept(lsock_, nullptr, nullptr);
+      if (conn < 0) return;
+      std::thread([this, conn] { reader(conn); }).detach();
+    }
+  }
+
+  void reader(int conn) {
+    for (;;) {
+      uint32_t n;
+      if (!read_exact(conn, (char*)&n, 4)) break;
+      std::string body(n, '\0');
+      if (!read_exact(conn, body.data(), n)) break;
+      if (n > 0 && body[0] != 0x01) {
+        // pickle frame: only possible from a misconfigured Python peer —
+        // worlds with native servers declare them binary peers upfront
+        std::fprintf(stderr,
+                     "[adlb_serverd] dropping non-binary frame (%u B)\n", n);
+        continue;
+      }
+      NMsg m = decode(body);
+      {
+        std::lock_guard<std::mutex> lk(in_mu_);
+        inbox_.push_back(std::move(m));
+      }
+      in_cv_.notify_one();
+    }
+    close(conn);
+  }
+
+  static bool read_exact(int fd, char* buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = ::recv(fd, buf + got, n - got, 0);
+      if (r <= 0) return false;
+      got += size_t(r);
+    }
+    return true;
+  }
+
+  int connect_to(int dest) {
+    auto it = addr_map_.find(dest);
+    if (it == addr_map_.end()) die("no address for rank %d", dest);
+    double deadline = monotonic() + 15.0;
+    for (;;) {
+      int sock = socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      inet_pton(AF_INET, it->second.first.c_str(), &addr.sin_addr);
+      addr.sin_port = htons(uint16_t(it->second.second));
+      if (connect(sock, (sockaddr*)&addr, sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return sock;
+      }
+      close(sock);
+      if (monotonic() >= deadline || closed_) return -1;
+      usleep(50000);
+    }
+  }
+
+  int lsock_ = -1;
+  int port_ = 0;
+  bool closed_ = false;
+  std::thread acceptor_;
+  std::map<int, std::pair<std::string, int>> addr_map_;
+  std::map<int, int> out_socks_;
+  std::mutex out_mu_;
+  std::deque<NMsg> inbox_;
+  std::mutex in_mu_;
+  std::condition_variable in_cv_;
+};
+
+// ---- world / config -------------------------------------------------------
+
+struct World {
+  int nranks = 0;
+  int nservers = 0;
+  bool use_debug_server = false;
+  std::vector<int> types;
+
+  int num_app_ranks() const {
+    return nranks - nservers - (use_debug_server ? 1 : 0);
+  }
+  int master_server_rank() const { return num_app_ranks(); }
+  bool is_server(int r) const {
+    return r >= num_app_ranks() && r < num_app_ranks() + nservers;
+  }
+  bool is_app(int r) const { return r < num_app_ranks(); }
+  int home_server(int app) const {
+    return num_app_ranks() + (app % nservers);
+  }
+  int ring_next(int s) const {
+    int i = s - num_app_ranks();
+    return num_app_ranks() + (i + 1) % nservers;
+  }
+};
+
+struct Cfg {
+  double qmstat_interval = 0.05;
+  double exhaust_check_interval = 0.25;
+  double max_malloc = 0.0;
+};
+
+// ---- server state ---------------------------------------------------------
+
+struct Meta {  // per-unit fields beyond the matching index
+  std::string payload;
+  int32_t answer_rank = -1;
+  int32_t home_server = -1;
+  int64_t common_len = 0, common_server = -1, common_seqno = -1;
+  double time_stamp = 0.0;
+};
+
+struct RqEntry {
+  int world_rank;
+  int64_t rqseqno;
+  bool any_type;
+  std::vector<int32_t> req_types;  // sorted when !any_type
+  double time_stamp;
+
+  bool wants(int32_t t) const {
+    if (any_type) return true;
+    for (int32_t x : req_types)
+      if (x == t) return true;
+    return false;
+  }
+};
+
+struct PeerState {  // reference qmstat entry (src/adlb.c:151-159)
+  int64_t nbytes = 0;
+  int64_t qlen = 0;
+  std::unordered_map<int32_t, int32_t> hi_prio;
+};
+
+struct CommonEntry {
+  std::string buf;
+  int64_t refcnt = -1;
+  int64_t ngets = 0;
+};
+
+class Server {
+ public:
+  Server(World w, Cfg cfg, int rank, Endpoint* ep)
+      : w_(w), cfg_(cfg), rank_(rank), ep_(ep) {
+    master_ = (rank_ == w_.master_server_rank());
+    for (int r = 0; r < w_.num_app_ranks(); ++r)
+      if (w_.home_server(r) == rank_) local_apps_.insert(r);
+    for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers; ++s)
+      peers_[s];  // default entries
+    stats_.assign(K_LAST, 0.0);
+  }
+
+  void run() {
+    double now = monotonic();
+    next_qmstat_ = now;
+    next_exhaust_ = now + cfg_.exhaust_check_interval;
+    while (!done_) {
+      now = monotonic();
+      periodic(now);
+      double deadline = next_qmstat_;
+      if (master_ && next_exhaust_ < deadline) deadline = next_exhaust_;
+      NMsg m;
+      bool got = ep_->recv(&m, std::max(deadline - monotonic(), 0.0));
+      double t0 = monotonic();
+      if (got) {
+        dispatch(m);
+        // bounded drain before paying the poll timeout again
+        for (int i = 0; i < 128 && !done_; ++i) {
+          if (monotonic() >= deadline) break;
+          NMsg m2;
+          if (!ep_->recv_now(&m2)) break;
+          dispatch(m2);
+        }
+      }
+      stats_[K_LOOP_TOP_TIME] += monotonic() - t0;
+    }
+  }
+
+  void print_stats() {
+    stats_[K_MALLOC_HWM] = double(mem_hwm_);
+    stats_[K_AVG_TIME_ON_RQ] =
+        rq_wait_n_ ? rq_wait_sum_ / double(rq_wait_n_) : 0.0;
+    stats_[K_MAX_WQ_COUNT] = double(wq_.max_count);
+    std::ostringstream os;
+    os << "STATS {";
+    char num[64];
+    for (int k = 1; k < K_LAST; ++k) {
+      if (k > 1) os << ", ";
+      // full precision: default ostream formatting rounds to 6 significant
+      // digits, corrupting large counters and MALLOC_HWM
+      std::snprintf(num, sizeof(num), "%.17g", stats_[k]);
+      os << "\"" << k << "\": " << num;
+    }
+    os << "}";
+    std::printf("%s\n", os.str().c_str());
+    std::fflush(stdout);
+  }
+
+  bool aborted() const { return aborted_; }
+  int abort_code() const { return abort_code_; }
+
+ private:
+  // ---- memory accounting (reference src/adlb.c:3419-3474) -----------------
+  bool mem_try_alloc(int64_t n) {
+    if (cfg_.max_malloc > 0 && double(mem_curr_ + n) > cfg_.max_malloc)
+      return false;
+    mem_alloc(n);
+    return true;
+  }
+  void mem_alloc(int64_t n) {
+    mem_curr_ += n;
+    if (mem_curr_ > mem_hwm_) mem_hwm_ = mem_curr_;
+  }
+  void mem_free(int64_t n) { mem_curr_ -= n; }
+  bool mem_under_pressure() const {
+    return cfg_.max_malloc > 0 && double(mem_curr_) > 0.95 * cfg_.max_malloc;
+  }
+  bool mem_has_room(int64_t n) const {
+    return cfg_.max_malloc <= 0 ||
+           double(mem_curr_ + n) <= 0.95 * cfg_.max_malloc;
+  }
+
+  // ---- small helpers ------------------------------------------------------
+  const adlbwq::Unit* wq_find_match(int rank, const RqEntry& e) {
+    const int32_t* tp = e.any_type ? nullptr : e.req_types.data();
+    int32_t nt = e.any_type ? 0 : int32_t(e.req_types.size());
+    const adlbwq::Unit* u = wq_.find_targeted(rank, tp, nt);
+    if (u == nullptr) u = wq_.find_untargeted(tp, nt);
+    return u;
+  }
+
+  int64_t wq_num_unpinned() const {
+    int64_t n = 0;
+    for (const auto& kv : wq_.units)
+      if (kv.second.pin_rank < 0) n += 1;
+    return n;
+  }
+
+  int64_t wq_num_unpinned_untargeted() const {
+    int64_t n = 0;
+    for (const auto& kv : wq_.units)
+      if (kv.second.pin_rank < 0 && kv.second.target_rank < 0) n += 1;
+    return n;
+  }
+
+  RqEntry* rq_find_rank(int world_rank) {
+    for (auto& e : rq_)
+      if (e.world_rank == world_rank) return &e;
+    return nullptr;
+  }
+
+  void rq_remove(int world_rank) {
+    for (auto it = rq_.begin(); it != rq_.end(); ++it)
+      if (it->world_rank == world_rank) { rq_.erase(it); return; }
+  }
+
+  // parked requester matching a freshly available (type, target) — the
+  // reference's rq_find_rank_queued_for_type (src/adlb.c:988-1042)
+  RqEntry* rq_find_for_type(int32_t work_type, int32_t target_rank) {
+    if (target_rank >= 0) {
+      RqEntry* e = rq_find_rank(target_rank);
+      return (e != nullptr && e->wants(work_type)) ? e : nullptr;
+    }
+    for (auto& e : rq_)
+      if (e.wants(work_type)) return &e;
+    return nullptr;
+  }
+
+  NMsg mk(uint16_t tag) {
+    NMsg m;
+    m.tag = tag;
+    m.src = rank_;
+    return m;
+  }
+
+  void reserve_resp_fail(int app, int rc) {
+    NMsg r = mk(T_TA_RESERVE_RESP);
+    r.seti(F_RC, rc);
+    ep_->send(app, r);
+  }
+
+  void reserve_resp_ok(int app, const adlbwq::Unit& u, const Meta& meta,
+                       int holder) {
+    NMsg r = mk(T_TA_RESERVE_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.seti(F_WORK_TYPE, u.work_type);
+    r.seti(F_PRIO, u.prio);
+    r.setl(F_HANDLE, {u.seqno, holder, meta.common_len, meta.common_server,
+                      meta.common_seqno});
+    r.seti(F_WORK_LEN, u.payload_len + meta.common_len);
+    r.seti(F_ANSWER_RANK, meta.answer_rank);
+    ep_->send(app, r);
+  }
+
+  void satisfy_parked(const RqEntry& e, const adlbwq::Unit& u,
+                      const Meta& meta) {
+    int app = e.world_rank;
+    double wait = monotonic() - e.time_stamp;
+    rq_remove(app);
+    rfr_excluded_.erase(app);
+    rq_wait_sum_ += wait;
+    rq_wait_n_ += 1;
+    activity_ += 1;
+    reserve_resp_ok(app, u, meta, rank_);
+  }
+
+  void match_rq() {
+    // local analogue of check_remote_work_for_queued_apps
+    // (reference src/adlb.c:3536-3579)
+    bool progressed = true;
+    while (progressed) {
+      progressed = false;
+      for (auto& e : rq_) {
+        const adlbwq::Unit* u = wq_find_match(e.world_rank, e);
+        if (u != nullptr) {
+          int64_t seqno = u->seqno;
+          wq_.units[seqno].pin_rank = e.world_rank;
+          RqEntry copy = e;
+          satisfy_parked(copy, wq_.units[seqno], meta_[seqno]);
+          progressed = true;
+          break;
+        }
+      }
+    }
+  }
+
+  int least_loaded_peer(int64_t nbytes_needed) {
+    int best = -1, fallback = -1;
+    int64_t best_bytes = 0, fallback_bytes = 0;
+    for (const auto& kv : peers_) {
+      if (kv.first == rank_) continue;
+      if (fallback < 0 || kv.second.nbytes < fallback_bytes) {
+        fallback = kv.first;
+        fallback_bytes = kv.second.nbytes;
+      }
+      if (cfg_.max_malloc > 0 &&
+          double(kv.second.nbytes + nbytes_needed) > cfg_.max_malloc)
+        continue;
+      if (best < 0 || kv.second.nbytes < best_bytes) {
+        best = kv.first;
+        best_bytes = kv.second.nbytes;
+      }
+    }
+    return best >= 0 ? best : fallback;
+  }
+
+  // ---- dispatch -----------------------------------------------------------
+  void dispatch(const NMsg& m) {
+    switch (m.tag) {
+      case T_FA_PUT: on_put(m); break;
+      case T_FA_PUT_COMMON: on_put_common(m); break;
+      case T_FA_BATCH_DONE: on_batch_done(m); break;
+      case T_FA_DID_PUT_AT_REMOTE: on_did_put_at_remote(m); break;
+      case T_FA_RESERVE: on_reserve(m); break;
+      case T_FA_GET_RESERVED: on_get_reserved(m); break;
+      case T_FA_GET_COMMON: on_get_common(m); break;
+      case T_FA_NO_MORE_WORK: on_fa_no_more_work(m); break;
+      case T_FA_LOCAL_APP_DONE: on_local_app_done(m); break;
+      case T_FA_ABORT: do_abort(int(m.geti(F_CODE, -1)), true); break;
+      case T_FA_INFO_NUM_WORK_UNITS: on_info_num(m); break;
+      case T_FA_INFO_GET: on_info_get(m); break;
+      case T_SS_QMSTAT: on_qmstat(m); break;
+      case T_SS_RFR: on_rfr(m); break;
+      case T_SS_RFR_RESP: on_rfr_resp(m); break;
+      case T_SS_UNRESERVE: on_unreserve(m); break;
+      case T_SS_PUSH_QUERY: on_push_query(m); break;
+      case T_SS_PUSH_QUERY_RESP: on_push_query_resp(m); break;
+      case T_SS_PUSH_WORK: on_push_work(m); break;
+      case T_SS_PUSH_DEL: on_push_del(m); break;
+      case T_SS_MOVING_TARGETED_WORK: on_moving_targeted(m); break;
+      case T_SS_NO_MORE_WORK: on_ss_no_more_work(); break;
+      case T_SS_EXHAUST_CHK_1: on_exhaust_chk(m, true); break;
+      case T_SS_EXHAUST_CHK_2: on_exhaust_chk(m, false); break;
+      case T_SS_DONE_BY_EXHAUSTION: on_done_by_exhaustion(); break;
+      case T_SS_END_1: on_end_1(m); break;
+      case T_SS_END_2: on_end_2(m); break;
+      case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
+      default: die("no handler for tag %u", m.tag);
+    }
+  }
+
+  void periodic(double now) {
+    if (now >= next_qmstat_) {
+      next_qmstat_ = now + cfg_.qmstat_interval;
+      broadcast_qmstat();
+      if (mem_under_pressure()) try_push();
+    }
+    if (master_ && now >= next_exhaust_) {
+      next_exhaust_ = now + cfg_.exhaust_check_interval;
+      check_exhaustion(now);
+    }
+  }
+
+  // ---- app handlers (reference src/adlb.c:889-1383) -----------------------
+  void on_put(const NMsg& m) {
+    if (no_more_work_ || done_by_exhaustion_) {
+      NMsg r = mk(T_TA_PUT_RESP);
+      r.seti(F_RC, ADLB_NO_MORE_WORK);
+      ep_->send(m.src, r);
+      return;
+    }
+    const std::string* payload = m.getb(F_PAYLOAD);
+    static const std::string kEmpty;
+    if (payload == nullptr) payload = &kEmpty;
+    if (!mem_try_alloc(int64_t(payload->size()))) {
+      stats_[K_NREJECTED_PUTS] += 1;
+      NMsg r = mk(T_TA_PUT_RESP);
+      r.seti(F_RC, ADLB_PUT_REJECTED);
+      r.seti(F_HINT, least_loaded_peer(int64_t(payload->size())));
+      ep_->send(m.src, r);
+      return;
+    }
+    int64_t seqno = next_seqno_++;
+    adlbwq::Unit u{seqno, int32_t(m.geti(F_WORK_TYPE)),
+                   int32_t(m.geti(F_PRIO)), int32_t(m.geti(F_TARGET_RANK, -1)),
+                   -1, int64_t(payload->size())};
+    wq_.units.emplace(seqno, u);
+    wq_.count += 1;
+    if (wq_.count > wq_.max_count) wq_.max_count = wq_.count;
+    wq_.total_bytes += u.payload_len;
+    wq_.index(u);
+    Meta& meta = meta_[seqno];
+    meta.payload = *payload;
+    meta.answer_rank = int32_t(m.geti(F_ANSWER_RANK, -1));
+    meta.home_server = rank_;
+    meta.common_len = m.geti(F_COMMON_LEN, 0);
+    meta.common_server = m.geti(F_COMMON_SERVER, -1);
+    meta.common_seqno = m.geti(F_COMMON_SEQNO, -1);
+    meta.time_stamp = monotonic();
+    if (double(wq_.count) > stats_[K_MAX_WQ_COUNT])
+      stats_[K_MAX_WQ_COUNT] = double(wq_.count);
+    activity_ += 1;
+    exhaust_held_ = false;
+    RqEntry* e = rq_find_for_type(u.work_type, u.target_rank);
+    if (e != nullptr) {
+      wq_.units[seqno].pin_rank = e->world_rank;
+      RqEntry copy = *e;
+      satisfy_parked(copy, wq_.units[seqno], meta);
+    }
+    NMsg r = mk(T_TA_PUT_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    ep_->send(m.src, r);
+  }
+
+  void on_put_common(const NMsg& m) {
+    const std::string* payload = m.getb(F_PAYLOAD);
+    static const std::string kEmpty;
+    if (payload == nullptr) payload = &kEmpty;
+    NMsg r = mk(T_TA_PUT_COMMON_RESP);
+    if (!mem_try_alloc(int64_t(payload->size()))) {
+      r.seti(F_RC, ADLB_PUT_REJECTED);
+      r.seti(F_COMMON_SEQNO, -1);
+    } else {
+      int64_t seqno = next_common_seqno_++;
+      cq_[seqno].buf = *payload;
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.seti(F_COMMON_SEQNO, seqno);
+    }
+    ep_->send(m.src, r);
+  }
+
+  void cq_maybe_gc(int64_t seqno) {
+    auto it = cq_.find(seqno);
+    if (it == cq_.end()) return;
+    if (it->second.refcnt >= 0 && it->second.ngets >= it->second.refcnt) {
+      mem_free(int64_t(it->second.buf.size()));
+      cq_.erase(it);
+    }
+  }
+
+  void on_batch_done(const NMsg& m) {
+    int64_t seqno = m.geti(F_COMMON_SEQNO);
+    auto it = cq_.find(seqno);
+    if (it == cq_.end()) return;
+    it->second.refcnt = m.geti(F_REFCNT);
+    cq_maybe_gc(seqno);
+  }
+
+  void on_did_put_at_remote(const NMsg& m) {
+    // reference src/adlb.c:2845-2852 + tq (src/xq.h:73-79)
+    int app = int(m.geti(F_TARGET_RANK));
+    int32_t wt = int32_t(m.geti(F_WORK_TYPE));
+    int server = int(m.geti(F_SERVER_RANK));
+    tq_[app][wt][server] += 1;
+    RqEntry* e = rq_find_rank(app);
+    if (e != nullptr && e->wants(wt)) try_rfr(*e);
+  }
+
+  void on_reserve(const NMsg& m) {
+    stats_[K_NUM_RESERVES] += 1;
+    int app = m.src;
+    RqEntry e;
+    e.world_rank = app;
+    e.rqseqno = m.geti(F_RQSEQNO);
+    const std::vector<int64_t>* types = m.getl(F_REQ_TYPES);
+    e.any_type = (types == nullptr);
+    if (types != nullptr)
+      for (int64_t t : *types) e.req_types.push_back(int32_t(t));
+    e.time_stamp = monotonic();
+    if (no_more_work_) { reserve_resp_fail(app, ADLB_NO_MORE_WORK); return; }
+    if (done_by_exhaustion_) {
+      reserve_resp_fail(app, ADLB_DONE_BY_EXHAUSTION);
+      return;
+    }
+    const adlbwq::Unit* u = wq_find_match(app, e);
+    if (u != nullptr) {
+      int64_t seqno = u->seqno;
+      wq_.units[seqno].pin_rank = app;
+      activity_ += 1;
+      reserve_resp_ok(app, wq_.units[seqno], meta_[seqno], rank_);
+      return;
+    }
+    if (m.geti(F_HANG, 0) == 0) {
+      reserve_resp_fail(app, ADLB_NO_CURRENT_WORK);
+      return;
+    }
+    stats_[K_NUM_RESERVES_PUT_ON_RQ] += 1;
+    rq_remove(app);  // re-park replaces (one entry per rank)
+    rq_.push_back(e);
+    rfr_excluded_.erase(app);
+    try_rfr(rq_.back());
+  }
+
+  void on_get_reserved(const NMsg& m) {
+    int64_t seqno = m.geti(F_SEQNO);
+    auto it = wq_.units.find(seqno);
+    if (it == wq_.units.end() || it->second.pin_rank != m.src)
+      die("invalid GET_RESERVED seqno %lld from rank %d",
+          (long long)seqno, m.src);  // reference aborts too (src/adlb.c:1349)
+    Meta meta = std::move(meta_[seqno]);
+    meta_.erase(seqno);
+    wq_.total_bytes -= it->second.payload_len;
+    wq_.units.erase(it);
+    wq_.count -= 1;
+    mem_free(int64_t(meta.payload.size()));
+    NMsg r = mk(T_TA_GET_RESERVED_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.setb(F_PAYLOAD, std::move(meta.payload));
+    r.setd(F_TIME_ON_Q, monotonic() - meta.time_stamp);
+    ep_->send(m.src, r);
+  }
+
+  void on_get_common(const NMsg& m) {
+    int64_t seqno = m.geti(F_COMMON_SEQNO);
+    auto it = cq_.find(seqno);
+    if (it == cq_.end())
+      die("invalid GET_COMMON seqno %lld", (long long)seqno);
+    NMsg r = mk(T_TA_GET_COMMON_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.setb(F_PAYLOAD, it->second.buf);
+    ep_->send(m.src, r);
+    it->second.ngets += 1;
+    cq_maybe_gc(seqno);
+  }
+
+  void on_info_num(const NMsg& m) {
+    int32_t wt = int32_t(m.geti(F_WORK_TYPE));
+    int64_t n = 0, nbytes = 0;
+    for (const auto& kv : wq_.units)
+      if (kv.second.work_type == wt) {
+        n += 1;
+        nbytes += kv.second.payload_len;
+      }
+    NMsg r = mk(T_TA_INFO_NUM_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.seti(F_COUNT, n);
+    r.seti(F_NBYTES, nbytes);
+    r.seti(F_MAX_WQ, int64_t(stats_[K_MAX_WQ_COUNT]));
+    ep_->send(m.src, r);
+  }
+
+  void on_info_get(const NMsg& m) {
+    int key = int(m.geti(F_KEY));
+    NMsg r = mk(T_TA_INFO_GET_RESP);
+    if (key < 1 || key >= K_LAST) {
+      r.seti(F_RC, -1);
+      r.setd(F_VALUE, 0.0);
+    } else {
+      double v;
+      if (key == K_MALLOC_HWM) v = double(mem_hwm_);
+      else if (key == K_AVG_TIME_ON_RQ)
+        v = rq_wait_n_ ? rq_wait_sum_ / double(rq_wait_n_) : 0.0;
+      else v = stats_[key];
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.setd(F_VALUE, v);
+    }
+    ep_->send(m.src, r);
+  }
+
+  // ---- stealing: RFR (reference src/adlb.c:1802-2070,3487-3579) -----------
+  void try_rfr(const RqEntry& e) {
+    int app = e.world_rank;
+    if (rfr_out_.count(app)) return;
+    auto& excluded = rfr_excluded_[app];
+    // 1) targeted-directory hit
+    auto tit = tq_.find(app);
+    if (tit != tq_.end()) {
+      for (const auto& by_type : tit->second) {
+        if (!e.wants(by_type.first)) continue;
+        for (const auto& by_server : by_type.second) {
+          if (by_server.second <= 0) continue;
+          int server = by_server.first;
+          if (server == rank_ || excluded.count(server)) continue;
+          send_rfr(e, server, true, by_type.first);
+          return;
+        }
+      }
+    }
+    // 2) best advertised untargeted priority among peers
+    int best_server = -1;
+    int32_t best_prio = ADLB_LOWEST_PRIO;
+    for (const auto& kv : peers_) {
+      if (kv.first == rank_ || excluded.count(kv.first)) continue;
+      if (e.any_type) {
+        for (const auto& tp : kv.second.hi_prio)
+          if (tp.second > best_prio) {
+            best_server = kv.first;
+            best_prio = tp.second;
+          }
+      } else {
+        for (int32_t t : e.req_types) {
+          auto hit = kv.second.hi_prio.find(t);
+          if (hit != kv.second.hi_prio.end() && hit->second > best_prio) {
+            best_server = kv.first;
+            best_prio = hit->second;
+          }
+        }
+      }
+    }
+    if (best_server >= 0) send_rfr(e, best_server, false, -1);
+  }
+
+  void send_rfr(const RqEntry& e, int server, bool targeted, int32_t ltype) {
+    rfr_out_.insert(e.world_rank);
+    NMsg m = mk(T_SS_RFR);
+    m.seti(F_FOR_RANK, e.world_rank);
+    m.seti(F_RQSEQNO, e.rqseqno);
+    if (!e.any_type) {
+      std::vector<int64_t> ts(e.req_types.begin(), e.req_types.end());
+      m.setl(F_REQ_TYPES, ts);
+    }
+    m.seti(F_TARGETED_LOOKUP, targeted ? 1 : 0);
+    m.seti(F_LOOKUP_TYPE, ltype);
+    ep_->send(server, m);
+  }
+
+  void on_rfr(const NMsg& m) {
+    RqEntry probe;
+    probe.world_rank = int(m.geti(F_FOR_RANK));
+    probe.rqseqno = m.geti(F_RQSEQNO);
+    const std::vector<int64_t>* types = m.getl(F_REQ_TYPES);
+    probe.any_type = (types == nullptr);
+    if (types != nullptr)
+      for (int64_t t : *types) probe.req_types.push_back(int32_t(t));
+    const adlbwq::Unit* u = wq_find_match(probe.world_rank, probe);
+    if (u != nullptr) {
+      int64_t seqno = u->seqno;
+      adlbwq::Unit& unit = wq_.units[seqno];
+      unit.pin_rank = probe.world_rank;
+      activity_ += 1;
+      exhaust_held_ = false;
+      const Meta& meta = meta_[seqno];
+      NMsg r = mk(T_SS_RFR_RESP);
+      r.seti(F_FOUND, 1);
+      r.seti(F_FOR_RANK, probe.world_rank);
+      r.seti(F_RQSEQNO, probe.rqseqno);
+      r.seti(F_SEQNO, seqno);
+      r.seti(F_WORK_TYPE, unit.work_type);
+      r.seti(F_PRIO, unit.prio);
+      r.seti(F_TARGET_RANK, unit.target_rank);
+      r.seti(F_WORK_LEN, unit.payload_len + meta.common_len);
+      r.seti(F_ANSWER_RANK, meta.answer_rank);
+      r.seti(F_COMMON_LEN, meta.common_len);
+      r.seti(F_COMMON_SERVER, meta.common_server);
+      r.seti(F_COMMON_SEQNO, meta.common_seqno);
+      ep_->send(m.src, r);
+    } else {
+      NMsg r = mk(T_SS_RFR_RESP);
+      r.seti(F_FOUND, 0);
+      r.seti(F_FOR_RANK, probe.world_rank);
+      r.seti(F_RQSEQNO, probe.rqseqno);
+      if (types != nullptr) r.setl(F_REQ_TYPES, *types);
+      r.seti(F_TARGETED_LOOKUP, m.geti(F_TARGETED_LOOKUP));
+      r.seti(F_LOOKUP_TYPE, m.geti(F_LOOKUP_TYPE));
+      ep_->send(m.src, r);
+    }
+  }
+
+  void tq_remove(int app, int32_t wt, int server) {
+    auto ait = tq_.find(app);
+    if (ait == tq_.end()) return;
+    auto tit = ait->second.find(wt);
+    if (tit == ait->second.end()) return;
+    auto sit = tit->second.find(server);
+    if (sit == tit->second.end()) return;
+    if (--sit->second <= 0) tit->second.erase(sit);
+    if (tit->second.empty()) ait->second.erase(tit);
+    if (ait->second.empty()) tq_.erase(ait);
+  }
+
+  void on_rfr_resp(const NMsg& m) {
+    int app = int(m.geti(F_FOR_RANK));
+    rfr_out_.erase(app);
+    if (m.geti(F_FOUND)) {
+      RqEntry* e = rq_find_rank(app);
+      int32_t wt = int32_t(m.geti(F_WORK_TYPE));
+      if (e == nullptr || e->rqseqno != m.geti(F_RQSEQNO) || !e->wants(wt)) {
+        // satisfied while the RFR flew — compensate (reference SS_UNRESERVE,
+        // src/adlb.c:1949-1963)
+        NMsg u = mk(T_SS_UNRESERVE);
+        u.seti(F_SEQNO, m.geti(F_SEQNO));
+        ep_->send(m.src, u);
+        return;
+      }
+      int64_t target = m.geti(F_TARGET_RANK, -1);
+      if (target >= 0 && app == int(target)) tq_remove(app, wt, m.src);
+      double wait = monotonic() - e->time_stamp;
+      rq_remove(app);
+      rfr_excluded_.erase(app);
+      rq_wait_sum_ += wait;
+      rq_wait_n_ += 1;
+      activity_ += 1;
+      NMsg r = mk(T_TA_RESERVE_RESP);
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.seti(F_WORK_TYPE, wt);
+      r.seti(F_PRIO, m.geti(F_PRIO));
+      r.setl(F_HANDLE, {m.geti(F_SEQNO), m.src, m.geti(F_COMMON_LEN),
+                        m.geti(F_COMMON_SERVER), m.geti(F_COMMON_SEQNO)});
+      r.seti(F_WORK_LEN, m.geti(F_WORK_LEN));
+      r.seti(F_ANSWER_RANK, m.geti(F_ANSWER_RANK, -1));
+      ep_->send(app, r);
+    } else {
+      // stale belief: patch it (reference src/adlb.c:1979-2005)
+      if (m.geti(F_TARGETED_LOOKUP)) {
+        tq_remove(app, int32_t(m.geti(F_LOOKUP_TYPE)), m.src);
+      } else {
+        auto pit = peers_.find(m.src);
+        if (pit != peers_.end()) {
+          const std::vector<int64_t>* types = m.getl(F_REQ_TYPES);
+          if (types != nullptr) {
+            for (int64_t t : *types)
+              pit->second.hi_prio[int32_t(t)] = ADLB_LOWEST_PRIO;
+          } else {
+            for (auto& tp : pit->second.hi_prio) tp.second = ADLB_LOWEST_PRIO;
+          }
+        }
+      }
+      rfr_excluded_[app].insert(m.src);
+      RqEntry* e = rq_find_rank(app);
+      if (e != nullptr) try_rfr(*e);
+    }
+  }
+
+  void on_unreserve(const NMsg& m) {
+    int64_t seqno = m.geti(F_SEQNO);
+    auto it = wq_.units.find(seqno);
+    if (it != wq_.units.end() && it->second.pin_rank >= 0) {
+      it->second.pin_rank = -1;
+      wq_.index(it->second);
+      match_rq();
+    }
+  }
+
+  // ---- push (memory pressure; reference src/adlb.c:509-556,2109-2362) -----
+  const adlbwq::Unit* find_unpinned_for_push() {
+    // prefer untargeted lowest priority; else any unpinned
+    const adlbwq::Unit* worst = nullptr;
+    for (const auto& kv : wq_.units) {
+      const adlbwq::Unit& u = kv.second;
+      if (u.pin_rank >= 0) continue;
+      if (u.target_rank < 0 && (worst == nullptr || u.prio < worst->prio))
+        worst = &u;
+    }
+    if (worst != nullptr) return worst;
+    for (const auto& kv : wq_.units)
+      if (kv.second.pin_rank < 0) return &kv.second;
+    return nullptr;
+  }
+
+  void try_push() {
+    if (!push_offered_.empty()) return;  // one outstanding push at a time
+    const adlbwq::Unit* u = find_unpinned_for_push();
+    if (u == nullptr) return;
+    int target = -1;
+    for (const auto& kv : peers_) {
+      if (kv.first == rank_) continue;
+      if (cfg_.max_malloc <= 0 ||
+          double(kv.second.nbytes + u->payload_len) <= 0.9 * cfg_.max_malloc) {
+        if (target < 0 || kv.second.nbytes < peers_[target].nbytes)
+          target = kv.first;
+      }
+    }
+    if (target < 0) return;
+    int64_t qid = (int64_t(rank_) << 20) | (++push_seq_);
+    push_offered_[qid] = u->seqno;
+    NMsg m = mk(T_SS_PUSH_QUERY);
+    m.seti(F_QUERY_ID, qid);
+    m.seti(F_NBYTES, u->payload_len);
+    ep_->send(target, m);
+  }
+
+  void on_push_query(const NMsg& m) {
+    int64_t nbytes = m.geti(F_NBYTES);
+    bool ok = mem_has_room(nbytes);
+    if (ok) {
+      mem_alloc(nbytes);  // reserved until WORK or DEL
+      push_reserved_[m.geti(F_QUERY_ID)] = nbytes;
+    }
+    NMsg r = mk(T_SS_PUSH_QUERY_RESP);
+    r.seti(F_QUERY_ID, m.geti(F_QUERY_ID));
+    r.seti(F_ACCEPT, ok ? 1 : 0);
+    ep_->send(m.src, r);
+  }
+
+  void on_push_query_resp(const NMsg& m) {
+    int64_t qid = m.geti(F_QUERY_ID);
+    auto oit = push_offered_.find(qid);
+    if (oit == push_offered_.end()) return;
+    int64_t seqno = oit->second;
+    push_offered_.erase(oit);
+    if (!m.geti(F_ACCEPT)) return;
+    auto uit = wq_.units.find(seqno);
+    if (uit == wq_.units.end() || uit->second.pin_rank >= 0) {
+      // reserved while the query flew — cancel (reference SS_PUSH_DEL,
+      // src/adlb.c:2182-2192)
+      NMsg d = mk(T_SS_PUSH_DEL);
+      d.seti(F_QUERY_ID, qid);
+      ep_->send(m.src, d);
+      return;
+    }
+    adlbwq::Unit unit = uit->second;
+    Meta meta = std::move(meta_[seqno]);
+    meta_.erase(seqno);
+    wq_.total_bytes -= unit.payload_len;
+    wq_.units.erase(uit);
+    wq_.count -= 1;
+    mem_free(int64_t(meta.payload.size()));
+    stats_[K_NPUSHED_FROM_HERE] += 1;
+    if (unit.target_rank >= 0) {
+      int home = w_.home_server(unit.target_rank);
+      NMsg mv = mk(T_SS_MOVING_TARGETED_WORK);
+      mv.seti(F_APP_RANK, unit.target_rank);
+      mv.seti(F_WORK_TYPE, unit.work_type);
+      mv.seti(F_FROM_SERVER, rank_);
+      mv.seti(F_TO_SERVER, m.src);
+      ep_->send(home, mv);
+    }
+    NMsg wk = mk(T_SS_PUSH_WORK);
+    wk.seti(F_QUERY_ID, qid);
+    wk.setb(F_PAYLOAD, std::move(meta.payload));
+    wk.seti(F_WORK_TYPE, unit.work_type);
+    wk.seti(F_PRIO, unit.prio);
+    wk.seti(F_TARGET_RANK, unit.target_rank);
+    wk.seti(F_ANSWER_RANK, meta.answer_rank);
+    wk.seti(F_HOME_SERVER, meta.home_server);
+    wk.seti(F_COMMON_LEN, meta.common_len);
+    wk.seti(F_COMMON_SERVER, meta.common_server);
+    wk.seti(F_COMMON_SEQNO, meta.common_seqno);
+    wk.setd(F_TIME_STAMP, meta.time_stamp);
+    ep_->send(m.src, wk);
+  }
+
+  void on_push_work(const NMsg& m) {
+    push_reserved_.erase(m.geti(F_QUERY_ID));  // budget now owned by the unit
+    const std::string* payload = m.getb(F_PAYLOAD);
+    static const std::string kEmpty;
+    if (payload == nullptr) payload = &kEmpty;
+    int64_t seqno = next_seqno_++;
+    adlbwq::Unit u{seqno, int32_t(m.geti(F_WORK_TYPE)),
+                   int32_t(m.geti(F_PRIO)), int32_t(m.geti(F_TARGET_RANK, -1)),
+                   -1, int64_t(payload->size())};
+    wq_.units.emplace(seqno, u);
+    wq_.count += 1;
+    if (wq_.count > wq_.max_count) wq_.max_count = wq_.count;
+    wq_.total_bytes += u.payload_len;
+    wq_.index(u);
+    Meta& meta = meta_[seqno];
+    meta.payload = *payload;
+    meta.answer_rank = int32_t(m.geti(F_ANSWER_RANK, -1));
+    meta.home_server = int32_t(m.geti(F_HOME_SERVER, -1));
+    meta.common_len = m.geti(F_COMMON_LEN, 0);
+    meta.common_server = m.geti(F_COMMON_SERVER, -1);
+    meta.common_seqno = m.geti(F_COMMON_SEQNO, -1);
+    meta.time_stamp = m.getd(F_TIME_STAMP, monotonic());
+    stats_[K_NPUSHED_TO_HERE] += 1;
+    match_rq();
+  }
+
+  void on_push_del(const NMsg& m) {
+    auto it = push_reserved_.find(m.geti(F_QUERY_ID));
+    if (it != push_reserved_.end()) {
+      mem_free(it->second);
+      push_reserved_.erase(it);
+    }
+  }
+
+  void on_moving_targeted(const NMsg& m) {
+    // home-server directory fixup (reference src/adlb.c:2071-2108)
+    int app = int(m.geti(F_APP_RANK));
+    int32_t wt = int32_t(m.geti(F_WORK_TYPE));
+    int from = int(m.geti(F_FROM_SERVER));
+    int to = int(m.geti(F_TO_SERVER));
+    if (from != rank_) tq_remove(app, wt, from);
+    if (to != rank_) tq_[app][wt][to] += 1;
+    RqEntry* e = rq_find_rank(app);
+    if (e != nullptr && e->wants(wt)) try_rfr(*e);
+  }
+
+  // ---- qmstat state broadcast (reference src/adlb.c:806-822) --------------
+  void broadcast_qmstat() {
+    PeerState& self = peers_[rank_];
+    self.nbytes = mem_curr_;
+    self.qlen = wq_num_unpinned_untargeted();
+    std::vector<int64_t> prios;
+    prios.reserve(w_.types.size());
+    for (int32_t t : w_.types) {
+      auto it = wq_.untargeted.find(t);
+      const adlbwq::Unit* u =
+          (it == wq_.untargeted.end()) ? nullptr : wq_.peek_best(&it->second, -1);
+      int32_t p = (u == nullptr) ? ADLB_LOWEST_PRIO : u->prio;
+      self.hi_prio[t] = p;
+      prios.push_back(p);
+    }
+    for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers;
+         ++s) {
+      if (s == rank_) continue;
+      NMsg m = mk(T_SS_QMSTAT);
+      m.seti(F_NBYTES, self.nbytes);
+      m.seti(F_QLEN, self.qlen);
+      m.setl(F_HI_PRIO, prios);
+      ep_->send(s, m);
+    }
+  }
+
+  void on_qmstat(const NMsg& m) {
+    PeerState& st = peers_[m.src];
+    st.nbytes = m.geti(F_NBYTES);
+    st.qlen = m.geti(F_QLEN);
+    const std::vector<int64_t>* prios = m.getl(F_HI_PRIO);
+    bool any_work = false;
+    if (prios != nullptr) {
+      for (size_t i = 0; i < w_.types.size() && i < prios->size(); ++i) {
+        st.hi_prio[w_.types[i]] = int32_t((*prios)[i]);
+        if ((*prios)[i] > ADLB_LOWEST_PRIO) any_work = true;
+      }
+    }
+    if (any_work)
+      for (auto& kv : rfr_excluded_) kv.second.erase(m.src);
+    for (auto& e : rq_)
+      if (!rfr_out_.count(e.world_rank)) try_rfr(e);
+  }
+
+  // ---- termination (reference src/adlb.c:754-785,1385-1801) ---------------
+  void flush_rq(int rc) {
+    std::vector<RqEntry> entries = rq_;
+    rq_.clear();
+    for (const auto& e : entries) reserve_resp_fail(e.world_rank, rc);
+  }
+
+  void on_fa_no_more_work(const NMsg& m) {
+    if (no_more_work_) return;
+    if (master_) {
+      on_ss_no_more_work();
+    } else {
+      ep_->send(w_.master_server_rank(), mk(T_SS_NO_MORE_WORK));
+    }
+  }
+
+  void on_ss_no_more_work() {
+    if (no_more_work_) return;
+    no_more_work_ = true;
+    if (master_) {
+      for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers;
+           ++s)
+        if (s != rank_) ep_->send(s, mk(T_SS_NO_MORE_WORK));
+    }
+    flush_rq(ADLB_NO_MORE_WORK);
+  }
+
+  bool all_local_apps_parked() {
+    for (int app : local_apps_) {
+      if (finalized_.count(app)) continue;
+      if (rq_find_rank(app) == nullptr) return false;
+    }
+    return true;
+  }
+
+  bool exhaust_vote(const std::vector<int64_t>* parked) {
+    if (!all_local_apps_parked()) return false;
+    if (wq_.count != wq_num_unpinned()) return false;  // handoff in flight
+    if (parked != nullptr) {
+      // flattened (rank, ntypes, t0..tn)*
+      size_t i = 0;
+      while (i < parked->size()) {
+        RqEntry probe;
+        probe.world_rank = int((*parked)[i++]);
+        int64_t nt = (*parked)[i++];
+        probe.any_type = (nt < 0);
+        for (int64_t j = 0; j < nt; ++j)
+          probe.req_types.push_back(int32_t((*parked)[i++]));
+        if (wq_find_match(probe.world_rank, probe) != nullptr) return false;
+      }
+    }
+    return true;
+  }
+
+  std::vector<int64_t> parked_list() {
+    std::vector<int64_t> out;
+    for (const auto& e : rq_) {
+      out.push_back(e.world_rank);
+      if (e.any_type) {
+        out.push_back(-1);
+      } else {
+        out.push_back(int64_t(e.req_types.size()));
+        for (int32_t t : e.req_types) out.push_back(t);
+      }
+    }
+    return out;
+  }
+
+  void forward_exhaust(uint16_t tag, NMsg token) {
+    int nxt = w_.ring_next(rank_);
+    token.tag = tag;
+    token.src = rank_;
+    token.seti(F_COMPLETE, nxt == int(token.geti(F_ORIGIN)) ? 1 : 0);
+    ep_->send(nxt, token);
+  }
+
+  void check_exhaustion(double now) {
+    if (no_more_work_ || done_by_exhaustion_ || exhaust_inflight_) return;
+    if (!exhaust_vote(nullptr)) { exhaust_held_ = false; return; }
+    if (!exhaust_held_) {
+      exhaust_held_ = true;
+      exhaust_held_since_ = now;
+      return;
+    }
+    if (now - exhaust_held_since_ < cfg_.exhaust_check_interval) return;
+    exhaust_inflight_ = true;
+    NMsg token = mk(T_SS_EXHAUST_CHK_1);
+    token.seti(F_ORIGIN, rank_);
+    token.seti(F_VOTE_OK, 1);
+    token.setl(F_ACT, {rank_, activity_});
+    token.seti(F_NPARKED, int64_t(rq_.size()));
+    token.setl(F_PARKED, parked_list());
+    forward_exhaust(T_SS_EXHAUST_CHK_1, token);
+  }
+
+  int64_t act_for_self(const std::vector<int64_t>* act) {
+    if (act == nullptr) return -1;
+    for (size_t i = 0; i + 1 < act->size(); i += 2)
+      if ((*act)[i] == rank_) return (*act)[i + 1];
+    return -1;
+  }
+
+  void on_exhaust_chk(const NMsg& m, bool phase1) {
+    NMsg token = m;  // copy; we mutate fields then forward
+    if (m.geti(F_COMPLETE) && int(m.geti(F_ORIGIN)) == rank_) {
+      const std::vector<int64_t>* parked = m.getl(F_PARKED);
+      bool ok = m.geti(F_VOTE_OK) != 0 && m.geti(F_NPARKED) > 0 &&
+                exhaust_vote(parked) &&
+                activity_ == act_for_self(m.getl(F_ACT));
+      if (!ok) {
+        exhaust_held_ = false;
+        exhaust_inflight_ = false;
+        return;
+      }
+      if (phase1) {
+        token.f.erase(F_COMPLETE);
+        forward_exhaust(T_SS_EXHAUST_CHK_2, token);
+      } else {
+        exhaust_inflight_ = false;
+        declare_exhaustion();
+      }
+      return;
+    }
+    if (phase1) {
+      bool vote = exhaust_vote(nullptr);
+      token.seti(F_VOTE_OK, (m.geti(F_VOTE_OK) != 0 && vote) ? 1 : 0);
+      std::vector<int64_t> act =
+          m.getl(F_ACT) ? *m.getl(F_ACT) : std::vector<int64_t>{};
+      act.push_back(rank_);
+      act.push_back(activity_);
+      token.setl(F_ACT, act);
+      token.seti(F_NPARKED, m.geti(F_NPARKED) + int64_t(rq_.size()));
+      std::vector<int64_t> parked =
+          m.getl(F_PARKED) ? *m.getl(F_PARKED) : std::vector<int64_t>{};
+      std::vector<int64_t> mine = parked_list();
+      parked.insert(parked.end(), mine.begin(), mine.end());
+      token.setl(F_PARKED, parked);
+      forward_exhaust(uint16_t(m.tag), token);
+    } else {
+      bool ok = m.geti(F_VOTE_OK) != 0 && exhaust_vote(m.getl(F_PARKED)) &&
+                activity_ == act_for_self(m.getl(F_ACT));
+      token.seti(F_VOTE_OK, ok ? 1 : 0);
+      forward_exhaust(uint16_t(m.tag), token);
+    }
+  }
+
+  void declare_exhaustion() {
+    for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers; ++s)
+      if (s != rank_) ep_->send(s, mk(T_SS_DONE_BY_EXHAUSTION));
+    on_done_by_exhaustion();
+  }
+
+  void on_done_by_exhaustion() {
+    if (done_by_exhaustion_) return;
+    done_by_exhaustion_ = true;
+    flush_rq(ADLB_DONE_BY_EXHAUSTION);
+  }
+
+  void on_local_app_done(const NMsg& m) {
+    finalized_.insert(m.src);
+    bool all_done = true;
+    for (int app : local_apps_)
+      if (!finalized_.count(app)) { all_done = false; break; }
+    if (all_done) {
+      if (master_ && !end1_pending_) {
+        end1_pending_ = true;
+        NMsg token = mk(T_SS_END_1);
+        token.seti(F_ORIGIN, rank_);
+        forward_end1(token);
+      } else if (end1_pending_) {
+        end1_pending_ = false;
+        forward_end1(held_end1_);
+      }
+    }
+  }
+
+  void forward_end1(NMsg token) {
+    int nxt = w_.ring_next(rank_);
+    token.tag = T_SS_END_1;
+    token.src = rank_;
+    token.seti(F_COMPLETE, nxt == int(token.geti(F_ORIGIN)) ? 1 : 0);
+    ep_->send(nxt, token);
+  }
+
+  void on_end_1(const NMsg& m) {
+    if (m.geti(F_COMPLETE) && int(m.geti(F_ORIGIN)) == rank_) {
+      int nxt = w_.ring_next(rank_);
+      NMsg token = mk(T_SS_END_2);
+      token.seti(F_ORIGIN, m.geti(F_ORIGIN));
+      token.seti(F_COMPLETE, nxt == int(m.geti(F_ORIGIN)) ? 1 : 0);
+      ep_->send(nxt, token);
+      if (w_.nservers == 1) done_ = true;
+      return;
+    }
+    bool all_done = true;
+    for (int app : local_apps_)
+      if (!finalized_.count(app)) { all_done = false; break; }
+    if (all_done) {
+      NMsg token = m;
+      forward_end1(token);
+    } else {
+      // hold until our apps finish (reference held END_LOOP_1,
+      // src/adlb.c:1790-1798)
+      end1_pending_ = true;
+      held_end1_ = m;
+    }
+  }
+
+  void on_end_2(const NMsg& m) {
+    done_ = true;
+    if (!m.geti(F_COMPLETE)) {
+      int nxt = w_.ring_next(rank_);
+      NMsg token = mk(T_SS_END_2);
+      token.seti(F_ORIGIN, m.geti(F_ORIGIN));
+      token.seti(F_COMPLETE, nxt == int(m.geti(F_ORIGIN)) ? 1 : 0);
+      ep_->send(nxt, token);
+    }
+  }
+
+  // ---- abort --------------------------------------------------------------
+  void do_abort(int code, bool broadcast) {
+    if (aborted_) return;
+    aborted_ = true;
+    abort_code_ = code;
+    if (broadcast) {
+      for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers;
+           ++s) {
+        if (s == rank_) continue;
+        NMsg a = mk(T_SS_ABORT);
+        a.seti(F_CODE, code);
+        ep_->send(s, a);
+      }
+    }
+    for (int app : local_apps_) {
+      NMsg a = mk(T_TA_ABORT);
+      a.seti(F_CODE, code);
+      ep_->send(app, a);
+    }
+    std::printf("ABORT %d\n", code);
+    std::fflush(stdout);
+    done_ = true;
+  }
+
+  World w_;
+  Cfg cfg_;
+  int rank_;
+  Endpoint* ep_;
+  bool master_ = false;
+  std::set<int> local_apps_;
+
+  adlbwq::WorkQueue wq_;
+  std::unordered_map<int64_t, Meta> meta_;
+  std::vector<RqEntry> rq_;  // insert-ordered, one per rank
+  // tq: app -> type -> server -> count (reference src/xq.h:73-79)
+  std::unordered_map<int, std::unordered_map<int32_t, std::map<int, int>>> tq_;
+  std::unordered_map<int64_t, CommonEntry> cq_;
+  std::map<int, PeerState> peers_;
+
+  int64_t next_seqno_ = 1;
+  int64_t next_common_seqno_ = 1;
+  int64_t mem_curr_ = 0, mem_hwm_ = 0;
+
+  std::unordered_set<int> rfr_out_;
+  std::unordered_map<int, std::unordered_set<int>> rfr_excluded_;
+  int64_t push_seq_ = 0;
+  std::unordered_map<int64_t, int64_t> push_offered_;   // qid -> seqno
+  std::unordered_map<int64_t, int64_t> push_reserved_;  // qid -> bytes
+
+  bool no_more_work_ = false;
+  bool done_by_exhaustion_ = false;
+  bool done_ = false;
+  bool aborted_ = false;
+  int abort_code_ = 0;
+  std::set<int> finalized_;
+  bool end1_pending_ = false;
+  NMsg held_end1_;
+  bool exhaust_held_ = false;
+  double exhaust_held_since_ = 0.0;
+  bool exhaust_inflight_ = false;
+  int64_t activity_ = 0;
+
+  std::vector<double> stats_;
+  double rq_wait_sum_ = 0.0;
+  int64_t rq_wait_n_ = 0;
+  double next_qmstat_ = 0.0, next_exhaust_ = 0.0;
+};
+
+}  // namespace
+
+int main() {
+  World w;
+  Cfg cfg;
+  int rank = -1;
+  std::string line;
+  // phase 1: config
+  while (std::getline(std::cin, line)) {
+    std::istringstream is(line);
+    std::string key;
+    is >> key;
+    if (key == "endconfig") break;
+    if (key == "nranks") is >> w.nranks;
+    else if (key == "nservers") is >> w.nservers;
+    else if (key == "use_debug_server") { int v; is >> v; w.use_debug_server = v != 0; }
+    else if (key == "types") { int t; while (is >> t) w.types.push_back(t); }
+    else if (key == "rank") is >> rank;
+    else if (key == "qmstat_interval") is >> cfg.qmstat_interval;
+    else if (key == "exhaust_check_interval") is >> cfg.exhaust_check_interval;
+    else if (key == "max_malloc") is >> cfg.max_malloc;
+    else if (!key.empty()) die("unknown config key '%s'", key.c_str());
+  }
+  if (rank < 0 || !w.is_server(rank)) die("bad or missing rank");
+  Endpoint ep;
+  int port = ep.listen_any();
+  std::printf("PORT %d\n", port);
+  std::fflush(stdout);
+  // phase 2: address map
+  while (std::getline(std::cin, line)) {
+    std::istringstream is(line);
+    std::string key;
+    is >> key;
+    if (key == "endaddrs") break;
+    if (key == "addr") {
+      int r, p;
+      std::string host;
+      is >> r >> host >> p;
+      ep.set_addr(r, host, p);
+    }
+  }
+  Server server(w, cfg, rank, &ep);
+  server.run();
+  server.print_stats();
+  ep.close_all();
+  // readers may still be blocked in recv; exit hard after stats are out
+  std::_Exit(server.aborted() ? 2 : 0);
+}

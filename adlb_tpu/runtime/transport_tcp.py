@@ -213,12 +213,16 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
 # --------------------------------------------------------------- spawn_world
 
 
-def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
-    """One rank's process body: bind, rendezvous, run role, report result.
+def _native_server_main(rank, world, cfg, port_q, conn, result_q, abort_event):
+    """Wrapper for a native C++ server rank: launch adlb_serverd, relay the
+    rendezvous (PORT line out, addr map in), parse the final STATS line.
 
-    Exactly one message goes on result_q per rank — the parent counts ranks,
-    so a success followed by a teardown error must not report twice.
-    """
+    The daemon speaks the same binary TLV protocol as the native C client;
+    Python app ranks are told to use binary frames toward server ranks (see
+    ``binary_peers`` in :func:`_child_main`)."""
+    from adlb_tpu.native import daemon
+
+    proc = daemon.spawn_daemon(world, cfg, rank)
     reported = False
 
     def report(kind, value):
@@ -227,7 +231,72 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
             reported = True
             result_q.put((kind, rank, value))
 
-    ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)})
+    try:
+        port_q.put((rank, daemon.read_hello(proc, rank)))
+        daemon.send_addrs(proc, conn.recv())
+
+        # kill the daemon if the world aborts around it (an app rank died)
+        def watch_abort():
+            while proc.poll() is None:
+                if abort_event.wait(timeout=0.25):
+                    proc.terminate()
+                    return
+
+        threading.Thread(target=watch_abort, daemon=True).start()
+
+        stats, abort_code = daemon.drain_output(proc)
+        if abort_code is not None:
+            abort_event.set()
+        proc.wait(timeout=30.0)
+        if abort_code is not None:
+            # parity with the Python-server path: the abort code must be
+            # recoverable from WorldResult, not just the aborted flag
+            report("aborted", abort_code)
+        elif stats is None:
+            if abort_event.is_set():
+                report("server", {})  # killed by watch_abort: not this
+                # rank's failure; the erroring rank reports the cause
+            else:
+                # daemon died without printing STATS: attribute the failure
+                # instead of reporting a clean empty-stats server
+                raise RuntimeError(
+                    f"native server rank {rank} exited {proc.returncode} "
+                    f"without STATS"
+                )
+        else:
+            report("server", stats)
+    except BaseException as e:  # noqa: BLE001 — surfaced to the parent
+        abort_event.set()
+        proc.terminate()
+        report("error", repr(e))
+
+
+def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
+    """One rank's process body: bind, rendezvous, run role, report result.
+
+    Exactly one message goes on result_q per rank — the parent counts ranks,
+    so a success followed by a teardown error must not report twice.
+    """
+    if cfg.server_impl == "native" and world.is_server(rank):
+        _native_server_main(
+            rank, world, cfg, port_q, conn, result_q, abort_event
+        )
+        return
+
+    reported = False
+
+    def report(kind, value):
+        nonlocal reported
+        if not reported:
+            reported = True
+            result_q.put((kind, rank, value))
+
+    # with native servers, Python ranks must speak the binary codec toward
+    # every server rank (the daemon cannot read pickle frames)
+    binary_peers = (
+        set(world.server_ranks) if cfg.server_impl == "native" else None
+    )
+    ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)}, binary_peers=binary_peers)
     try:
         port_q.put((rank, ep.port))
         ep.addr_map.update(conn.recv())  # full rank -> (host, port) map
@@ -293,6 +362,15 @@ def spawn_world(
     from adlb_tpu.runtime.world import Config, WorldSpec
 
     cfg = cfg or Config()
+    if cfg.server_impl == "native":
+        if use_debug_server:
+            raise ValueError(
+                "server_impl='native' does not carry DS_LOG frames yet; "
+                "run the debug server with Python servers"
+            )
+        from adlb_tpu.native.build import ensure_serverd
+
+        ensure_serverd()  # build once up front, not per server rank
     world = WorldSpec(
         nranks=num_app_ranks + nservers + (1 if use_debug_server else 0),
         nservers=nservers,

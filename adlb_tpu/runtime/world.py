@@ -113,6 +113,13 @@ class Config:
     # server work-queue implementation: "auto" uses the C++ core when it
     # builds, falling back to the pure-Python queues; "on" requires it
     native_queues: str = "auto"
+    # server reactor implementation (spawn_world / TCP worlds only):
+    # "python" runs adlb_tpu.runtime.server.Server per server rank; "native"
+    # runs the C++ daemon (adlb_tpu/native/serverd.cpp) — the reference's
+    # all-native data plane (SURVEY §7 language split). Native servers
+    # implement the steal balancer; tpu mode keeps the Python server (the
+    # balancer brain is JAX).
+    server_impl: str = "python"
 
     def __post_init__(self) -> None:
         if self.balancer not in ("steal", "tpu"):
@@ -123,6 +130,13 @@ class Config:
             raise ValueError(f"unknown native_queues {self.native_queues!r}")
         if self.solver_backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown solver_backend {self.solver_backend!r}")
+        if self.server_impl not in ("python", "native"):
+            raise ValueError(f"unknown server_impl {self.server_impl!r}")
+        if self.server_impl == "native" and self.balancer == "tpu":
+            raise ValueError(
+                "server_impl='native' implements the steal balancer; the tpu "
+                "balancer brain is JAX and runs under the Python server"
+            )
 
 
 def normalize_req_types(

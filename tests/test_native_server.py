@@ -1,0 +1,237 @@
+"""Native C++ server daemon (adlb_tpu/native/serverd.cpp): the all-native
+data plane of SURVEY §7's language split. Python clients over the binary
+codec, multi-server stealing, exhaustion, batch-common puts, memory
+admission, abort — and a fully native world (C clients + C++ servers)."""
+
+import os
+import shutil
+import struct
+
+import pytest
+
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_PUT_REJECTED, ADLB_SUCCESS, InfoKey
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+NATIVE = Config(server_impl="native")
+
+
+def _answer_economy(ctx):
+    T_AB, T_C = 1, 2
+    if ctx.rank == 0:
+        pairs = [(i, i * 3) for i in range(24)]
+        for a, b in pairs:
+            ctx.put(struct.pack("<qq", a, b), T_AB, answer_rank=0)
+        total = 0
+        for _ in range(len(pairs)):
+            rc, r = ctx.reserve([T_C])
+            assert rc == ADLB_SUCCESS
+            rc, buf = ctx.get_reserved(r.handle)
+            total += struct.unpack("<q", buf)[0]
+        ctx.set_problem_done()
+        return total
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T_AB])
+        if rc != ADLB_SUCCESS:
+            return n
+        rc, buf = ctx.get_reserved(r.handle)
+        a, b = struct.unpack("<qq", buf)
+        ctx.put(struct.pack("<q", a + b), T_C, target_rank=r.answer_rank)
+        n += 1
+
+
+def test_native_answer_economy_two_servers():
+    res = spawn_world(3, 2, [1, 2], _answer_economy, cfg=NATIVE, timeout=60.0)
+    assert res.app_results[0] == sum(i + i * 3 for i in range(24))
+    assert sum(v for k, v in res.app_results.items() if k != 0) == 24
+    assert sorted(res.server_stats) == [3, 4]
+    # stats surface carried through: someone answered reserves
+    assert sum(
+        s.get(int(InfoKey.NUM_RESERVES), 0) for s in res.server_stats.values()
+    ) > 0
+
+
+def _exhaustion_app(ctx):
+    T = 1
+    if ctx.rank == 0:
+        for i in range(10):
+            ctx.put(struct.pack("<q", i), T)
+    n = 0
+    while True:
+        rc, r = ctx.reserve()  # wildcard; ends by exhaustion
+        if rc != ADLB_SUCCESS:
+            return n
+        rc, _ = ctx.get_reserved(r.handle)
+        n += 1
+
+
+def test_native_exhaustion_termination():
+    res = spawn_world(
+        3, 2, [1], _exhaustion_app,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.15),
+        timeout=60.0,
+    )
+    assert sum(res.app_results.values()) == 10
+
+
+def _batch_common_app(ctx):
+    T = 1
+    prefix = b"COMMONPREFIX"
+    if ctx.rank == 0:
+        ctx.begin_batch_put(prefix)
+        for i in range(6):
+            ctx.put(struct.pack("<q", i), T)
+        ctx.end_batch_put()
+    got = []
+    while True:
+        rc, r = ctx.reserve([T])  # terminate by exhaustion: problem_done
+        if rc != ADLB_SUCCESS:    # would drop still-queued units
+            return sorted(got)
+        rc, buf = ctx.get_reserved(r.handle)
+        assert buf.startswith(prefix), buf
+        got.append(struct.unpack("<q", buf[len(prefix):])[0])
+
+
+def test_native_batch_common_prefix():
+    res = spawn_world(
+        3, 2, [1], _batch_common_app,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.15),
+        timeout=60.0,
+    )
+    all_got = sorted(
+        x for v in res.app_results.values() if v for x in v
+    )
+    assert all_got == list(range(6))
+
+
+def _memcap_app(ctx):
+    T = 1
+    rcs = []
+    if ctx.rank == 0:
+        # server cap is 4KB; 3 x 2KB puts must spill across servers via
+        # reject + least-loaded hint (reference src/adlb.c:2779-2796)
+        rcs = [ctx.put(b"x" * 2048, T) for _ in range(3)]
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T])  # all ranks drain; exhaustion terminates
+        if rc != ADLB_SUCCESS:
+            return (rcs, n)
+        ctx.get_reserved(r.handle)
+        n += 1
+
+
+def test_native_put_rejection_and_hint_redirect():
+    res = spawn_world(
+        2, 2, [1],
+        _memcap_app,
+        cfg=Config(
+            server_impl="native", max_malloc_per_server=4096,
+            exhaust_check_interval=0.15,
+        ),
+        timeout=60.0,
+    )
+    rcs = res.app_results[0][0]
+    assert all(rc in (ADLB_SUCCESS, ADLB_PUT_REJECTED) for rc in rcs)
+    # with two 4KB servers all three 2KB units fit somewhere
+    assert rcs.count(ADLB_SUCCESS) == 3, rcs
+    assert sum(n for _, n in res.app_results.values()) == 3
+
+
+def _info_app(ctx):
+    T = 1
+    if ctx.rank == 0:
+        for i in range(5):
+            ctx.put(struct.pack("<q", i), T, work_prio=i)
+        rc, count, nbytes, max_wq = ctx.info_num_work_units(T)
+        assert rc == ADLB_SUCCESS
+        rc, hwm = ctx.info_get(InfoKey.MALLOC_HWM)
+        assert rc == ADLB_SUCCESS
+        ctx.set_problem_done()
+        return (count, nbytes, max_wq, hwm)
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return None
+        ctx.get_reserved(r.handle)
+
+
+def test_native_info_surface():
+    res = spawn_world(2, 1, [1], _info_app, cfg=NATIVE, timeout=60.0)
+    count, nbytes, max_wq, hwm = res.app_results[0]
+    assert 0 <= count <= 5 and max_wq >= 1 and hwm >= 8
+
+
+def _abort_app(ctx):
+    if ctx.rank == 0:
+        ctx.put(b"x", 1)
+        ctx.abort(42)  # raises AdlbAborted
+    while True:
+        rc, r = ctx.reserve([1])
+        if rc != ADLB_SUCCESS:
+            return None
+        ctx.get_reserved(r.handle)
+
+
+def test_native_abort_fans_out():
+    res = spawn_world(3, 2, [1], _abort_app, cfg=NATIVE, timeout=60.0)
+    assert res.aborted
+
+
+def test_all_native_world_c_clients():
+    """C clients (libadlb.so) against C++ server daemons — zero Python in
+    the data plane."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.native.capi import build_example, run_native_world
+
+    examples = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples",
+    )
+    exe = build_example(os.path.join(examples, "capi_smoke.c"))
+    results, stats = run_native_world(
+        n_clients=3,
+        nservers=2,
+        types=[1, 2],
+        exe=exe,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+        assert "OK" in out
+    total = sum(
+        int(out.split("processed=")[1].split()[0]) for _, out, _ in results
+    )
+    assert total == 24
+    assert len(stats) == 2  # daemon STATS lines parsed
+
+
+def test_all_native_nq_known_answer():
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.native.capi import build_example, run_native_world
+
+    examples = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples",
+    )
+    exe = build_example(os.path.join(examples, "nq_c.c"))
+    results, _ = run_native_world(
+        n_clients=3,
+        nservers=2,
+        types=[1, 2],
+        exe=exe,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    total = 0
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+        total += int(out.split("solutions")[1].split()[0])
+    assert total == 40  # n-queens(7), examples/nq_c.c EXPECTED
