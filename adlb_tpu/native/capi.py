@@ -228,9 +228,19 @@ def run_native_world(
             from adlb_tpu.native import daemon as daemon_mod
 
             for rank, p in daemons.items():
-                stats, _abort_code, _rc = daemon_mod.collect_stats(p)
+                stats, abort_code, rc = daemon_mod.collect_stats(p)
                 if stats is not None:
                     server_stats[rank] = stats
+                elif abort_code is None and rc not in (-9, -15):
+                    # crashed daemon (not one we killed on teardown):
+                    # attribute it, parity with transport_tcp's
+                    # 'exited without STATS'
+                    errors.append(
+                        RuntimeError(
+                            f"native server rank {rank} exited {rc} "
+                            f"without STATS"
+                        )
+                    )
         os.unlink(rendezvous)
 
     if errors:

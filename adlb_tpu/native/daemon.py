@@ -65,18 +65,24 @@ def send_addrs(proc: subprocess.Popen, addr_map: dict) -> None:
     proc.stdin.flush()
 
 
-def drain_output(proc: subprocess.Popen):
-    """Consume the daemon's stdout to completion; returns
+def _parse_trailer(lines):
+    """Parse STATS/ABORT lines from an iterable; returns
     (stats dict (int key -> float) or None, abort code or None)."""
     stats: Optional[dict] = None
     abort_code: Optional[int] = None
-    for line in proc.stdout:
+    for line in lines:
         line = line.strip()
         if line.startswith("STATS "):
             stats = {int(k): v for k, v in json.loads(line[6:]).items()}
         elif line.startswith("ABORT "):
             abort_code = int(line.split()[1])
     return stats, abort_code
+
+
+def drain_output(proc: subprocess.Popen):
+    """Consume the daemon's stdout to completion; returns
+    (stats, abort_code) per :func:`_parse_trailer`."""
+    return _parse_trailer(proc.stdout)
 
 
 def collect_stats(proc: subprocess.Popen, timeout: float = 15.0):
@@ -88,12 +94,5 @@ def collect_stats(proc: subprocess.Popen, timeout: float = 15.0):
     except subprocess.TimeoutExpired:
         proc.kill()
         out, _ = proc.communicate()
-    stats: Optional[dict] = None
-    abort_code: Optional[int] = None
-    for line in (out or "").splitlines():
-        line = line.strip()
-        if line.startswith("STATS "):
-            stats = {int(k): v for k, v in json.loads(line[6:]).items()}
-        elif line.startswith("ABORT "):
-            abort_code = int(line.split()[1])
+    stats, abort_code = _parse_trailer((out or "").splitlines())
     return stats, abort_code, proc.returncode

@@ -861,8 +861,6 @@ class Server {
     meta.common_server = m.geti(F_COMMON_SERVER, -1);
     meta.common_seqno = m.geti(F_COMMON_SEQNO, -1);
     meta.time_stamp = monotonic();
-    if (double(wq_.count) > stats_[K_MAX_WQ_COUNT])
-      stats_[K_MAX_WQ_COUNT] = double(wq_.count);
     activity_ += 1;
     exhaust_held_ = false;
     RqEntry* e = rq_find_for_type(u.work_type, u.target_rank);
@@ -999,7 +997,7 @@ class Server {
     r.seti(F_RC, ADLB_SUCCESS);
     r.seti(F_COUNT, n);
     r.seti(F_NBYTES, nbytes);
-    r.seti(F_MAX_WQ, int64_t(stats_[K_MAX_WQ_COUNT]));
+    r.seti(F_MAX_WQ, wq_.max_count);
     ep_->send(m.src, r);
   }
 
@@ -1014,6 +1012,7 @@ class Server {
       if (key == K_MALLOC_HWM) v = double(mem_hwm_);
       else if (key == K_AVG_TIME_ON_RQ)
         v = rq_wait_n_ ? rq_wait_sum_ / double(rq_wait_n_) : 0.0;
+      else if (key == K_MAX_WQ_COUNT) v = double(wq_.max_count);
       else v = stats_[key];
       r.seti(F_RC, ADLB_SUCCESS);
       r.setd(F_VALUE, v);

@@ -195,24 +195,39 @@ class _BalancerWorker(threading.Thread):
         total_consumers = sum(consumers.values())
         if total_consumers == 0:
             return
-        # deficits: fewer available units than active local consumers
+        # Fair-share placement: the planner sees the WHOLE inventory, so it
+        # places each server's consumer-weighted share in one round — the
+        # global solve's structural advantage over stealing's one-unit-per-
+        # round-trip RFRs (and over drip-feeding a fixed small burst, which
+        # re-idles the destination every round). Snapshot truncation
+        # (balancer_max_tasks) only delays the tail, not the first wave.
+        total_avail = sum(len(v) for v in inv.values())
+        if total_avail == 0:
+            return
+
+        def share(r: int) -> int:
+            # ceil of the consumer-weighted share, so rounding never
+            # strands a destination at zero
+            c = consumers.get(r, 0)
+            return -(-total_avail * c // total_consumers) if c else 0
+
+        # deficits: servers holding less than their share
         deficits = {
-            r: 2 * c - len(inv[r])
+            r: share(r) - len(inv[r])
             for r, c in consumers.items()
-            if c > 0 and len(inv[r]) < c
+            if c > 0 and len(inv[r]) < share(r)
         }
         if not deficits:
             return
-        # surpluses: inventory beyond what this server's consumers need soon
+        # surpluses: inventory beyond this server's own share
         surpluses = {
-            r: lst[max(2 * consumers.get(r, 0), 0):]
+            r: lst[share(r):]
             for r, lst in inv.items()
-            if len(lst) > 2 * consumers.get(r, 0)
+            if len(lst) > share(r)
         }
         cap = s.cfg.max_malloc_per_server
         moves: dict[tuple[int, int], list[int]] = {}
         for dest, want in sorted(deficits.items(), key=lambda kv: -kv[1]):
-            want = min(want, 64)  # bound the per-round burst
             dest_bytes = snaps.get(dest, {}).get("nbytes", 0)
             for src_rank, lst in surpluses.items():
                 if want <= 0:
@@ -655,6 +670,14 @@ class Server:
             self.wq.pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
         self.ep.send(m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS))
+        if entry is None and self.cfg.balancer == "tpu":
+            # event-driven like parks: new unmatched inventory refreshes the
+            # balancer's view immediately (rate-limited), so a requester
+            # parked on ANOTHER server isn't left waiting for the next tick
+            now = time.monotonic()
+            if now - self._last_event_snap >= self.cfg.balancer_min_gap:
+                self._last_event_snap = now
+                self._send_snapshot()
 
     def _on_put_common(self, m: Msg) -> None:
         if not self.mem.try_alloc(len(m.payload)):
@@ -1096,21 +1119,72 @@ class Server:
         st = self.peers[self.rank]
         st.nbytes, st.qlen, st.hi_prio = ent["nbytes"], ent["qlen"], ent["hi_prio"]
         st.stamp = time.monotonic()
+        if self.cfg.qmstat_mode == "ring":
+            # reference-faithful store-and-forward ring token: only the
+            # master kicks one per interval (reference src/adlb.c:806-822).
+            # The token carries the FULL table — each hop installs it,
+            # refreshes its own entry, and forwards, so the k-th hop sees
+            # everyone else's state k..S hops stale (src/adlb.c:1705-1757).
+            if self.is_master and self.world.nservers > 1:
+                table = {
+                    s: {"nbytes": p.nbytes, "qlen": p.qlen,
+                        "hi_prio": dict(p.hi_prio)}
+                    for s, p in self.peers.items()
+                }
+                table[self.rank] = ent
+                self.ep.send(
+                    self.world.ring_next(self.rank),
+                    msg(Tag.SS_QMSTAT, self.rank,
+                        table=table, origin=self.rank,
+                        t0=time.monotonic()),
+                )
+            return
         for s in self.world.server_ranks:
             if s != self.rank:
                 self.ep.send(s, msg(Tag.SS_QMSTAT, self.rank, entry=ent))
 
-    def _on_qmstat(self, m: Msg) -> None:
-        st = self.peers[m.src]
-        st.nbytes = m.entry["nbytes"]
-        st.qlen = m.entry["qlen"]
-        st.hi_prio = dict(m.entry["hi_prio"])
+    def _apply_qmstat_entry(self, src: int, ent: dict) -> None:
+        st = self.peers[src]
+        st.nbytes = ent["nbytes"]
+        st.qlen = ent["qlen"]
+        st.hi_prio = dict(ent["hi_prio"])
         st.stamp = time.monotonic()
         # fresh evidence of work at this peer lifts any strike-out, else a
         # requester could permanently ignore a peer that refilled later
         if any(p > ADLB_LOWEST_PRIO for p in st.hi_prio.values()):
             for excluded in self._rfr_excluded.values():
-                excluded.discard(m.src)
+                excluded.discard(src)
+
+    def _on_qmstat(self, m: Msg) -> None:
+        if "table" in m.data:
+            # ring token (reference src/adlb.c:1705-1757): install every
+            # entry except our own, then refresh ours and forward — unless
+            # the token is back at its origin, which records the trip time
+            # (reference src/adlb.c:1731-1743)
+            for src, ent in m.table.items():
+                if src != self.rank:
+                    self._apply_qmstat_entry(src, ent)
+            if m.origin == self.rank:
+                trip = time.monotonic() - m.t0
+                self.stats[InfoKey.MAX_QMSTAT_TRIP_TIME] = max(
+                    self.stats[InfoKey.MAX_QMSTAT_TRIP_TIME], trip
+                )
+                n = self._qmstat_trips = getattr(self, "_qmstat_trips", 0) + 1
+                avg = self.stats[InfoKey.AVG_QMSTAT_TRIP_TIME]
+                self.stats[InfoKey.AVG_QMSTAT_TRIP_TIME] = (
+                    avg + (trip - avg) / n
+                )
+                if trip > self.cfg.qmstat_interval:
+                    self.stats[InfoKey.NUM_QMS_EXCEED_INT] += 1
+            else:
+                m.table[self.rank] = self._qmstat_entry()
+                self.ep.send(
+                    self.world.ring_next(self.rank),
+                    msg(Tag.SS_QMSTAT, self.rank, table=m.table,
+                        origin=m.origin, t0=m.t0),
+                )
+        else:
+            self._apply_qmstat_entry(m.src, m.entry)
         # fresh knowledge may unblock parked requesters (reference
         # check_remote_work_for_queued_apps after qmstat, src/adlb.c:3536-3579)
         for entry in self.rq.entries():

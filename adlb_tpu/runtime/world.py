@@ -84,6 +84,14 @@ class Config:
 
     max_malloc_per_server: float = 0.0  # 0 = unlimited (reference hi_malloc)
     qmstat_interval: float = 0.05  # reference 0.1 s (src/adlb.c:165)
+    # qmstat propagation: "broadcast" sends each server's entry directly to
+    # every peer each interval (this framework's improvement); "ring" is the
+    # reference-faithful store-and-forward token pass — the master kicks one
+    # token per interval, each server overwrites the table except its own
+    # entry and forwards (reference src/adlb.c:806-822,1705-1757), so the
+    # k-th hop sees k-hop-stale state. Use "ring" + 0.1 s to reproduce
+    # upstream's behavior as a baseline.
+    qmstat_mode: str = "broadcast"
     balancer_interval: float = 0.02  # TPU-mode snapshot->solve->plan period
     # min gap between event-driven solves (a park triggers an immediate
     # snapshot+solve; this bounds solve rate under churn)
@@ -132,10 +140,17 @@ class Config:
             raise ValueError(f"unknown solver_backend {self.solver_backend!r}")
         if self.server_impl not in ("python", "native"):
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
+        if self.qmstat_mode not in ("broadcast", "ring"):
+            raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
         if self.server_impl == "native" and self.balancer == "tpu":
             raise ValueError(
                 "server_impl='native' implements the steal balancer; the tpu "
                 "balancer brain is JAX and runs under the Python server"
+            )
+        if self.server_impl == "native" and self.qmstat_mode != "broadcast":
+            raise ValueError(
+                "server_impl='native' implements broadcast qmstat only; the "
+                "ring-gossip baseline runs under the Python server"
             )
 
 

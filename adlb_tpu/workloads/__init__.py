@@ -26,6 +26,9 @@ benchmark drivers:
   (reference ``examples/skel.c`` / ``c2.c``)
 * :mod:`~adlb_tpu.workloads.hotspot` — producer-concentrated balancing
   scenario (no reference analogue; the BASELINE.json north-star probe)
+* :mod:`~adlb_tpu.workloads.trickle` — steady single-server work arrival
+  with remote-only consumers, isolating dispatch/discovery latency (no
+  reference analogue; the steal-to-exec-latency probe of BASELINE.md)
 * :mod:`~adlb_tpu.workloads.pmcmc` — embarrassingly-parallel MCMC hard-disk
   demo with targeted solution returns (reference ``examples/pmcmc.c``)
 
