@@ -67,8 +67,8 @@ def main() -> None:
         return Config(
             balancer="tpu",
             exhaust_check_interval=0.2,
-            balancer_max_tasks=128,
-            balancer_max_requesters=32,
+            balancer_max_tasks=256,
+            balancer_max_requesters=64,
         )
 
     # warm the solver (host path) so setup cost stays out of the timing
@@ -95,15 +95,19 @@ def main() -> None:
     tpu = best_of("tpu")
 
     # hotspot: all work enters one server, consumers everywhere — the
-    # balancing scenario ADLB exists for; makespan-based, GIL-free work
+    # balancing scenario ADLB exists for; makespan-based, GIL-free work.
+    # 16 ranks / 8 servers: enough ring hops that upstream's gossip
+    # staleness shows, while staying under the one-interpreter message cap
+    HOT_APPS, HOT_SERVERS, HOT_N = 16, 8, 1200
+
     def hot(mode: str, reps: int = 3):
         best = None
         for _ in range(reps):
             r = hotspot.run(
-                n_tasks=600, work_time=0.004, num_app_ranks=8, nservers=4,
-                cfg=cfg(mode), timeout=300.0,
+                n_tasks=HOT_N, work_time=0.004, num_app_ranks=HOT_APPS,
+                nservers=HOT_SERVERS, cfg=cfg(mode), timeout=300.0,
             )
-            assert r.tasks == 600, f"hotspot {mode}: lost work ({r.tasks})"
+            assert r.tasks == HOT_N, f"hotspot {mode}: lost work ({r.tasks})"
             if best is None or r.tasks_per_sec > best.tasks_per_sec:
                 best = r
         return best
@@ -172,8 +176,8 @@ def main() -> None:
             "dispatch_speedup_vs_upstream": round(
                 tric_steal.dispatch_p50_ms / tric_tpu.dispatch_p50_ms, 2)
             if tric_tpu.dispatch_p50_ms else 0.0,
-            "hotspot_app_ranks": 8,
-            "hotspot_servers": 4,
+            "hotspot_app_ranks": HOT_APPS,
+            "hotspot_servers": HOT_SERVERS,
             "nq_n": N,
             "nq_steal_tasks_per_sec": round(steal.tasks_per_sec, 1),
             "nq_tpu_tasks_per_sec": round(tpu.tasks_per_sec, 1),
