@@ -1,0 +1,168 @@
+"""Plan engine: one balancer round over queue-state snapshots.
+
+Pure planning — callers transport the results. Used by two hosts:
+
+* the in-server balancer thread (Python servers, ``runtime/server.py``);
+* the sidecar process driving the native C++ data plane
+  (``balancer/sidecar.py``) — SURVEY §7's language split: C++ for the
+  data plane, Python/JAX only for the balancer brain.
+
+A round takes the latest per-server snapshots
+``{server_rank: {"tasks": [(seqno, type, prio, len)...],
+"reqs": [(rank, rqseqno, types|None)...], "nbytes": int, "consumers": int,
+"stamp": float}}`` and returns
+
+* ``matches`` — ``(holder, seqno, req_home, for_rank, rqseqno)`` tuples:
+  cross-server task->requester assignments from the batched solve;
+* ``migrations`` — ``(src, dest, [seqnos])``: fair-share inventory moves so
+  each server holds its consumer-weighted share of the global pool (the
+  global solve's structural advantage over per-unit stealing round trips).
+
+Re-planning storms are suppressed by remembering when each requester/task
+was last planned: both stay ineligible until a *fresh* snapshot (stamp
+newer than the plan) shows them still parked/queued. Plan staleness is
+compensated at enactment (holders validate against live state).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class PlanEngine:
+    def __init__(
+        self,
+        types,
+        max_tasks: int,
+        max_requesters: int,
+        backend: str = "auto",
+        max_malloc_per_server: float = 0.0,
+    ) -> None:
+        from adlb_tpu.balancer.solve import AssignmentSolver
+
+        self.solver = AssignmentSolver(
+            types=tuple(types),
+            max_tasks=max_tasks,
+            max_requesters=max_requesters,
+            backend=backend,
+        )
+        self.max_malloc_per_server = max_malloc_per_server
+        self._planned_reqs: dict[tuple, float] = {}
+        self._planned_tasks: dict[tuple, float] = {}
+
+    def force_host_path(self) -> None:
+        """After a device/backend failure: keep planning on numpy."""
+        self.solver.host_threshold_reqs = 10**9
+
+    def round(self, snapshots: dict, world=None):
+        """One planning round; returns (matches, migrations)."""
+        if not snapshots:
+            return [], []
+        now = time.monotonic()
+        filtered = {}
+        for rank, snap in snapshots.items():
+            stamp = snap.get("stamp", now)
+            reqs = [
+                r for r in snap["reqs"]
+                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
+            ]
+            tasks = [
+                t for t in snap["tasks"]
+                if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
+            ]
+            filtered[rank] = {"tasks": tasks, "reqs": reqs}
+        if any(sn["reqs"] for sn in filtered.values()):
+            pairs = self.solver.solve(filtered, world)
+        else:
+            pairs = []  # nobody parked; still consider migrations below
+        t_planned = time.monotonic()
+        matches = []
+        planned_away: dict[int, set] = {}
+        for holder, seqno, req_home, for_rank, rqseqno in pairs:
+            planned_away.setdefault(holder, set()).add(seqno)
+            if holder == req_home:
+                continue
+            self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
+            self._planned_tasks[(holder, seqno)] = t_planned
+            matches.append((holder, seqno, req_home, for_rank, rqseqno))
+        migrations = self._plan_migrations(
+            snapshots, filtered, planned_away, t_planned
+        )
+        # bound the memory of the plan ledgers
+        if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
+            cutoff = t_planned - 5.0
+            self._planned_reqs = {
+                k: v for k, v in self._planned_reqs.items() if v > cutoff
+            }
+            self._planned_tasks = {
+                k: v for k, v in self._planned_tasks.items() if v > cutoff
+            }
+        return matches, migrations
+
+    def _plan_migrations(
+        self, snaps: dict, filtered: dict, planned_away: dict, t_planned: float
+    ):
+        """Fair-share inventory placement (see module docstring)."""
+        inv: dict[int, list] = {}
+        consumers: dict[int, int] = {}
+        for rank, f in filtered.items():
+            avail = [
+                t for t in f["tasks"] if t[0] not in planned_away.get(rank, ())
+            ]
+            inv[rank] = avail
+            consumers[rank] = snaps.get(rank, {}).get("consumers", 0)
+        total_consumers = sum(consumers.values())
+        if total_consumers == 0:
+            return []
+        total_avail = sum(len(v) for v in inv.values())
+        if total_avail == 0:
+            return []
+
+        def share(r: int) -> int:
+            # ceil of the consumer-weighted share, so rounding never
+            # strands a destination at zero
+            c = consumers.get(r, 0)
+            return -(-total_avail * c // total_consumers) if c else 0
+
+        deficits = {
+            r: share(r) - len(inv[r])
+            for r, c in consumers.items()
+            if c > 0 and len(inv[r]) < share(r)
+        }
+        if not deficits:
+            return []
+        surpluses = {
+            r: lst[share(r):]
+            for r, lst in inv.items()
+            if len(lst) > share(r)
+        }
+        cap = self.max_malloc_per_server
+        moves: dict[tuple[int, int], list[int]] = {}
+        for dest, want in sorted(deficits.items(), key=lambda kv: -kv[1]):
+            dest_bytes = snaps.get(dest, {}).get("nbytes", 0)
+            for src_rank, lst in surpluses.items():
+                if want <= 0:
+                    break
+                if src_rank == dest or not lst:
+                    continue
+                take = []
+                while lst and len(take) < want:
+                    t = lst[0]
+                    if cap > 0 and dest_bytes + t[3] > 0.9 * cap:
+                        break  # planner-side admission: dest believed full
+                    take.append(t)
+                    dest_bytes += t[3]
+                    lst = lst[1:]
+                surpluses[src_rank] = lst
+                if take:
+                    moves.setdefault((src_rank, dest), []).extend(
+                        t[0] for t in take
+                    )
+                    want -= len(take)
+        out = []
+        for (src_rank, dest), seqnos in moves.items():
+            for q in seqnos:
+                self._planned_tasks[(src_rank, q)] = t_planned
+            out.append((src_rank, dest, seqnos))
+        return out

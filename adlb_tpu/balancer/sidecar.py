@@ -1,0 +1,142 @@
+"""Balancer sidecar: the Python/JAX brain driving the native C++ data plane.
+
+SURVEY §7's language split realized end-to-end: native servers
+(``adlb_tpu/native/serverd.cpp``) keep the entire data plane — queues,
+protocol, payloads — and stream fixed-shape queue-state snapshots
+(``SS_STATE``: flattened task/requester metadata, a few KB) to this
+process, which runs the batched assignment solve (:mod:`.engine` /
+:mod:`.solve`, Pallas on TPU) and answers with ``SS_PLAN_MATCH`` /
+``SS_PLAN_MIGRATE``. Payload bytes never cross into Python — exactly the
+"balancer brain in a sidecar exchanging fixed-shape arrays" design.
+
+The sidecar occupies a pseudo-rank one past the world (it is not an app or
+a server; no role math changes), speaks the binary TLV codec toward
+servers, and exits when every server has sent DS_END (or on abort).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from adlb_tpu.runtime.messages import Tag, msg
+
+
+def start_sidecar(world, cfg, abort_event=None):
+    """Bind the sidecar's endpoint at pseudo-rank ``world.nranks`` and build
+    its (not-yet-started) thread. Returns (endpoint, thread): add the
+    endpoint's port to the world's address map, update ``ep.addr_map``,
+    then ``thread.start()``. Use :func:`stop_sidecar` to tear down — also
+    on bootstrap failure, or the thread/endpoint leak."""
+    from adlb_tpu.runtime.transport_tcp import TcpEndpoint
+
+    ep = TcpEndpoint(
+        world.nranks, {world.nranks: ("127.0.0.1", 0)},
+        binary_peers=set(world.server_ranks),
+    )
+    thread = threading.Thread(
+        target=run_sidecar,
+        args=(world, cfg, ep, abort_event),
+        daemon=True,
+        name="adlb-balancer-sidecar",
+    )
+    return ep, thread
+
+
+def stop_sidecar(ep, thread, abort_event=None, timeout: float = 10.0) -> None:
+    """Join (the loop exits on the servers' DS_ENDs, or on abort_event) and
+    close the endpoint."""
+    if thread.is_alive():
+        thread.join(timeout=timeout)
+        if thread.is_alive() and abort_event is not None:
+            abort_event.set()
+            thread.join(timeout=2.0)
+    ep.close()
+
+
+def decode_snapshot(m) -> dict:
+    """Unflatten a native SS_STATE frame into the engine's snapshot shape."""
+    tf = m.data.get("tasks_flat") or []
+    tasks = [
+        (tf[i], tf[i + 1], tf[i + 2], tf[i + 3]) for i in range(0, len(tf), 4)
+    ]
+    rf = m.data.get("reqs_flat") or []
+    reqs = []
+    i = 0
+    while i < len(rf):
+        rank, rqseqno, ntypes = rf[i], rf[i + 1], rf[i + 2]
+        i += 3
+        if ntypes < 0:
+            types = None
+        else:
+            types = [int(t) for t in rf[i:i + ntypes]]
+            i += ntypes
+        reqs.append((rank, rqseqno, types))
+    return {
+        "tasks": tasks,
+        "reqs": reqs,
+        "nbytes": m.data.get("nbytes", 0),
+        "consumers": m.data.get("consumers", 0),
+        "stamp": time.monotonic(),  # receiver clock: never mix hosts' clocks
+    }
+
+
+def run_sidecar(world, cfg, ep, abort_event=None) -> int:
+    """Serve balancer rounds until every server says DS_END; returns the
+    number of planning rounds executed."""
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    engine = PlanEngine(
+        types=world.types,
+        max_tasks=cfg.balancer_max_tasks,
+        max_requesters=cfg.balancer_max_requesters,
+        backend=cfg.solver_backend,
+        max_malloc_per_server=cfg.max_malloc_per_server,
+    )
+    snapshots: dict[int, dict] = {}
+    ended: set[int] = set()
+    servers = set(world.server_ranks)
+    rounds = 0
+    dirty = False
+    while ended < servers:
+        if abort_event is not None and abort_event.is_set():
+            break
+        m = ep.recv(timeout=0.25)
+        while m is not None:
+            if m.tag is Tag.SS_STATE:
+                snapshots[m.src] = decode_snapshot(m)
+                dirty = True
+            elif m.tag is Tag.DS_END:
+                ended.add(m.src)
+                snapshots.pop(m.src, None)
+            m = ep.recv(timeout=0.0)
+        if not dirty or not snapshots:
+            continue
+        dirty = False
+        try:
+            matches, migrations = engine.round(snapshots, world)
+        except Exception as e:  # noqa: BLE001 — must keep serving
+            import sys
+
+            print(
+                f"[adlb sidecar] solve failed ({e!r}); forcing host path",
+                file=sys.stderr,
+            )
+            engine.force_host_path()
+            continue
+        rounds += 1
+        me = world.nranks  # pseudo-rank
+        for holder, seqno, req_home, for_rank, rqseqno in matches:
+            ep.send(
+                holder,
+                msg(Tag.SS_PLAN_MATCH, me, seqno=seqno, for_rank=for_rank,
+                    req_home=req_home, rqseqno=rqseqno),
+            )
+        for src_rank, dest, seqnos in migrations:
+            ep.send(
+                src_rank,
+                msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos),
+            )
+        if cfg.balancer_min_gap > 0:
+            time.sleep(cfg.balancer_min_gap)
+    return rounds

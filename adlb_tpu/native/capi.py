@@ -112,6 +112,7 @@ def run_native_world(
     endpoints = {}
     daemons: dict[int, subprocess.Popen] = {}
 
+    sidecar_thread = None
     if all_native:
         # all-native world: C clients + C++ server daemons. Daemons bind
         # their own ports, so the rendezvous map is completed from their
@@ -119,23 +120,45 @@ def run_native_world(
         # leak the daemons already spawned.
         from adlb_tpu.native import daemon as daemon_mod
 
+        sidecar_ep = None
         try:
             for rank in world.server_ranks:
                 daemons[rank] = daemon_mod.spawn_daemon(world, cfg, rank)
             for rank, p in daemons.items():
                 addr_map[rank] = ("127.0.0.1", daemon_mod.read_hello(p, rank))
+            if cfg.balancer == "tpu":
+                # JAX balancer sidecar thread at pseudo-rank world.nranks
+                from adlb_tpu.balancer.sidecar import start_sidecar
+
+                sidecar_ep, sidecar_thread = start_sidecar(
+                    world, cfg, abort_event
+                )
+                addr_map[world.nranks] = ("127.0.0.1", sidecar_ep.port)
+                sidecar_ep.addr_map.update(addr_map)
+                endpoints[world.nranks] = sidecar_ep
+                sidecar_thread.start()
             for p in daemons.values():
                 daemon_mod.send_addrs(p, addr_map)
         except BaseException:
             for p in daemons.values():
                 p.kill()
+            abort_event.set()
+            if sidecar_ep is not None:
+                from adlb_tpu.balancer.sidecar import stop_sidecar
+
+                endpoints.pop(world.nranks, None)
+                stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
             raise
 
     with tempfile.NamedTemporaryFile(
         "w", suffix=".adlb", delete=False
     ) as f:
+        # world ranks only: the C client derives the world size from the
+        # line count, so the balancer sidecar's pseudo-rank (world.nranks,
+        # used by servers alone) must not appear here
         for r, (host, port) in sorted(addr_map.items()):
-            f.write(f"{r} {host} {port}\n")
+            if r < world.nranks:
+                f.write(f"{r} {host} {port}\n")
         rendezvous = f.name
 
     if not all_native:
@@ -222,6 +245,8 @@ def run_native_world(
             abort_event.set()
             for t in threads:
                 t.join(timeout=5.0)
+        if sidecar_thread is not None:
+            sidecar_thread.join(timeout=10.0)  # exits on servers' DS_ENDs
         for ep in endpoints.values():
             ep.close()
         if daemons:

@@ -36,8 +36,18 @@ def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
         f"qmstat_interval {cfg.qmstat_interval}",
         f"exhaust_check_interval {cfg.exhaust_check_interval}",
         f"max_malloc {cfg.max_malloc_per_server}",
-        "endconfig",
     ]
+    if cfg.balancer == "tpu":
+        # the JAX balancer sidecar listens at pseudo-rank world.nranks
+        lines += [
+            "balancer tpu",
+            f"balancer_rank {world.nranks}",
+            f"balancer_interval {cfg.balancer_interval}",
+            f"balancer_min_gap {cfg.balancer_min_gap}",
+            f"balancer_max_tasks {cfg.balancer_max_tasks}",
+            f"balancer_max_requesters {cfg.balancer_max_requesters}",
+        ]
+    lines.append("endconfig")
     proc.stdin.write("\n".join(lines) + "\n")
     proc.stdin.flush()
     return proc

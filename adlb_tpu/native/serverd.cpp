@@ -120,6 +120,12 @@ enum WireTag : uint16_t {
   T_SS_END_1 = 1114,
   T_SS_END_2 = 1115,
   T_SS_ABORT = 1116,
+  T_SS_STATE = 1117,
+  T_SS_PLAN_MATCH = 1118,
+  T_SS_PLAN_MIGRATE = 1119,
+  T_SS_MIGRATE_WORK = 1120,
+  T_SS_MIGRATE_ACK = 1121,
+  T_DS_END = 1132,
 };
 
 // ---- field ids ------------------------------------------------------------
@@ -171,6 +177,15 @@ enum FieldId : uint8_t {
   F_NPARKED = 43,         // i64
   F_ACT = 44,             // list: alternating (rank, activity)
   F_PARKED = 45,          // list: flattened (rank, ntypes, t0..tn)*
+  // -- balancer sidecar (shared with codec.py: the sidecar is Python) --
+  F_REQ_HOME = 46,        // i64
+  F_DEST = 47,            // i64
+  F_SEQNOS = 48,          // list
+  F_TASKS_FLAT = 49,      // list: (seqno, type, prio, len)*
+  F_REQS_FLAT = 50,       // list: (rank, rqseqno, ntypes, t0..tn)*
+  F_CONSUMERS = 51,       // i64
+  F_BOUNCED = 52,         // i64
+  F_UNITS_BLOB = 53,      // bytes: packed migrate batch
 };
 
 enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
@@ -272,6 +287,11 @@ std::string encode(const NMsg& m) {
         out.append(kv.second.b);
         break;
       case KIND_LIST:
+        // the codec's element count is a u16; silent wrap-around would
+        // make the frame undecodable at the receiver — fail fast instead
+        if (kv.second.l.size() > 65535)
+          die("list field %u overflows the u16 codec bound (%zu elements)",
+              kv.first, kv.second.l.size());
         put_u16(out, uint16_t(kv.second.l.size()));
         for (int64_t x : kv.second.l) put_i64(out, x);
         break;
@@ -533,6 +553,14 @@ struct Cfg {
   double qmstat_interval = 0.05;
   double exhaust_check_interval = 0.25;
   double max_malloc = 0.0;
+  // tpu mode: stream snapshots to a Python/JAX balancer sidecar and enact
+  // its plan (SURVEY §7 language split: C++ data plane, JAX brain)
+  bool tpu_mode = false;
+  int balancer_rank = -1;
+  double balancer_interval = 0.02;
+  double balancer_min_gap = 0.002;
+  int64_t balancer_max_tasks = 256;
+  int64_t balancer_max_requesters = 64;
 };
 
 // ---- server state ---------------------------------------------------------
@@ -632,6 +660,11 @@ class Server {
 
   bool aborted() const { return aborted_; }
   int abort_code() const { return abort_code_; }
+
+  void notify_balancer_end() {
+    if (cfg_.tpu_mode && cfg_.balancer_rank >= 0)
+      ep_->send(cfg_.balancer_rank, mk(T_DS_END));
+  }
 
  private:
   // ---- memory accounting (reference src/adlb.c:3419-3474) -----------------
@@ -809,14 +842,20 @@ class Server {
       case T_SS_END_1: on_end_1(m); break;
       case T_SS_END_2: on_end_2(m); break;
       case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
+      case T_SS_PLAN_MATCH: on_plan_match(m); break;
+      case T_SS_PLAN_MIGRATE: on_plan_migrate(m); break;
+      case T_SS_MIGRATE_WORK: on_migrate_work(m); break;
+      case T_SS_MIGRATE_ACK: migrate_unacked_ -= 1; break;
       default: die("no handler for tag %u", m.tag);
     }
   }
 
   void periodic(double now) {
     if (now >= next_qmstat_) {
-      next_qmstat_ = now + cfg_.qmstat_interval;
-      broadcast_qmstat();
+      next_qmstat_ = cfg_.tpu_mode ? now + cfg_.balancer_interval
+                                   : now + cfg_.qmstat_interval;
+      if (cfg_.tpu_mode) send_snapshot();
+      else broadcast_qmstat();
       if (mem_under_pressure()) try_push();
     }
     if (master_ && now >= next_exhaust_) {
@@ -872,6 +911,7 @@ class Server {
     NMsg r = mk(T_TA_PUT_RESP);
     r.seti(F_RC, ADLB_SUCCESS);
     ep_->send(m.src, r);
+    if (e == nullptr) maybe_event_snapshot();
   }
 
   void on_put_common(const NMsg& m) {
@@ -951,6 +991,7 @@ class Server {
     rq_.push_back(e);
     rfr_excluded_.erase(app);
     try_rfr(rq_.back());
+    maybe_event_snapshot();
   }
 
   void on_get_reserved(const NMsg& m) {
@@ -1039,6 +1080,7 @@ class Server {
         }
       }
     }
+    if (cfg_.tpu_mode) return;  // untargeted stealing is the planner's job
     // 2) best advertised untargeted priority among peers
     int best_server = -1;
     int32_t best_prio = ADLB_LOWEST_PRIO;
@@ -1420,6 +1462,7 @@ class Server {
 
   bool exhaust_vote(const std::vector<int64_t>* parked) {
     if (!all_local_apps_parked()) return false;
+    if (migrate_unacked_ != 0) return false;  // units inside a message
     if (wq_.count != wq_num_unpinned()) return false;  // handoff in flight
     if (parked != nullptr) {
       // flattened (rank, ntypes, t0..tn)*
@@ -1602,6 +1645,223 @@ class Server {
     }
   }
 
+  // ---- balancer sidecar (tpu mode) ----------------------------------------
+  // The JAX brain runs in a Python sidecar process; this server streams
+  // fixed-shape queue-state snapshots to it and enacts SS_PLAN_MATCH /
+  // SS_PLAN_MIGRATE exactly like the Python server does (plan entries are
+  // hints validated against live state; staleness is harmless).
+
+  void maybe_event_snapshot() {
+    if (!cfg_.tpu_mode) return;
+    double now = monotonic();
+    if (now - last_event_snap_ < cfg_.balancer_min_gap) return;
+    last_event_snap_ = now;
+    send_snapshot();
+  }
+
+  void send_snapshot() {
+    if (cfg_.balancer_rank < 0) return;
+    // top-K unpinned untargeted by (prio desc, seqno asc)
+    std::vector<const adlbwq::Unit*> avail;
+    avail.reserve(wq_.units.size());
+    for (const auto& kv : wq_.units)
+      if (kv.second.pin_rank < 0 && kv.second.target_rank < 0)
+        avail.push_back(&kv.second);
+    std::sort(avail.begin(), avail.end(),
+              [](const adlbwq::Unit* a, const adlbwq::Unit* b) {
+                if (a->prio != b->prio) return a->prio > b->prio;
+                return a->seqno < b->seqno;
+              });
+    size_t k = std::min<size_t>(avail.size(), size_t(cfg_.balancer_max_tasks));
+    std::vector<int64_t> tasks;
+    tasks.reserve(4 * k);
+    for (size_t i = 0; i < k; ++i) {
+      tasks.push_back(avail[i]->seqno);
+      tasks.push_back(avail[i]->work_type);
+      tasks.push_back(avail[i]->prio);
+      tasks.push_back(avail[i]->payload_len);
+    }
+    std::vector<int64_t> reqs;
+    int64_t nreqs = 0;
+    for (const auto& e : rq_) {
+      if (nreqs >= cfg_.balancer_max_requesters) break;
+      if (reqs.size() + 3 + e.req_types.size() > 60000) break;  // u16 codec
+      if (rfr_out_.count(e.world_rank)) continue;  // RFR handoff pending
+      reqs.push_back(e.world_rank);
+      reqs.push_back(e.rqseqno);
+      if (e.any_type) {
+        reqs.push_back(-1);
+      } else {
+        reqs.push_back(int64_t(e.req_types.size()));
+        for (int32_t t : e.req_types) reqs.push_back(t);
+      }
+      nreqs += 1;
+    }
+    // suppress repeat empty snapshots (an idle server must not wake the
+    // sidecar every tick for nothing)
+    bool empty = tasks.empty() && reqs.empty();
+    if (empty && last_snap_empty_) return;
+    last_snap_empty_ = empty;
+    int64_t consumers = 0;
+    for (int app : local_apps_)
+      if (!finalized_.count(app)) consumers += 1;
+    NMsg m = mk(T_SS_STATE);
+    m.setl(F_TASKS_FLAT, tasks);
+    m.setl(F_REQS_FLAT, reqs);
+    m.seti(F_NBYTES, mem_curr_);
+    m.seti(F_CONSUMERS, consumers);
+    ep_->send(cfg_.balancer_rank, m);
+  }
+
+  void on_plan_match(const NMsg& m) {
+    // enact one plan entry through the RFR response path (mirrors the
+    // Python server's _on_plan_match)
+    int64_t seqno = m.geti(F_SEQNO);
+    auto it = wq_.units.find(seqno);
+    if (it == wq_.units.end() || it->second.pin_rank >= 0 ||
+        it->second.target_rank >= 0)
+      return;  // stale plan entry; next round re-plans
+    int for_rank = int(m.geti(F_FOR_RANK));
+    it->second.pin_rank = for_rank;
+    activity_ += 1;
+    exhaust_held_ = false;
+    const Meta& meta = meta_[seqno];
+    NMsg r = mk(T_SS_RFR_RESP);
+    r.seti(F_FOUND, 1);
+    r.seti(F_FOR_RANK, for_rank);
+    r.seti(F_RQSEQNO, m.geti(F_RQSEQNO));
+    r.seti(F_SEQNO, seqno);
+    r.seti(F_WORK_TYPE, it->second.work_type);
+    r.seti(F_PRIO, it->second.prio);
+    r.seti(F_TARGET_RANK, it->second.target_rank);
+    r.seti(F_WORK_LEN, it->second.payload_len + meta.common_len);
+    r.seti(F_ANSWER_RANK, meta.answer_rank);
+    r.seti(F_COMMON_LEN, meta.common_len);
+    r.seti(F_COMMON_SERVER, meta.common_server);
+    r.seti(F_COMMON_SEQNO, meta.common_seqno);
+    ep_->send(int(m.geti(F_REQ_HOME)), r);
+  }
+
+  static void blob_u32(std::string& b, uint32_t v) { b.append((const char*)&v, 4); }
+  static void blob_i32(std::string& b, int32_t v) { b.append((const char*)&v, 4); }
+  static void blob_i64(std::string& b, int64_t v) { b.append((const char*)&v, 8); }
+  static void blob_f64(std::string& b, double v) { b.append((const char*)&v, 8); }
+
+  void on_plan_migrate(const NMsg& m) {
+    const std::vector<int64_t>* seqnos = m.getl(F_SEQNOS);
+    if (seqnos == nullptr) return;
+    // batch blob: [u32 n] then per unit
+    // u32 plen, i32 type, i32 prio, i32 answer, i32 home,
+    // i64 clen, i64 cserver, i64 cseqno, f64 ts, payload bytes
+    std::string blob;
+    uint32_t n = 0;
+    blob_u32(blob, 0);  // patched below
+    for (int64_t seqno : *seqnos) {
+      auto it = wq_.units.find(seqno);
+      if (it == wq_.units.end() || it->second.pin_rank >= 0 ||
+          it->second.target_rank >= 0)
+        continue;  // stale plan entry
+      adlbwq::Unit unit = it->second;
+      Meta meta = std::move(meta_[seqno]);
+      meta_.erase(seqno);
+      wq_.total_bytes -= unit.payload_len;
+      wq_.units.erase(it);
+      wq_.count -= 1;
+      mem_free(int64_t(meta.payload.size()));
+      stats_[K_NPUSHED_FROM_HERE] += 1;
+      blob_u32(blob, uint32_t(meta.payload.size()));
+      blob_i32(blob, unit.work_type);
+      blob_i32(blob, unit.prio);
+      blob_i32(blob, meta.answer_rank);
+      blob_i32(blob, meta.home_server);
+      blob_i64(blob, meta.common_len);
+      blob_i64(blob, meta.common_server);
+      blob_i64(blob, meta.common_seqno);
+      blob_f64(blob, meta.time_stamp);
+      blob.append(meta.payload);
+      n += 1;
+    }
+    if (n == 0) return;
+    std::memcpy(blob.data(), &n, 4);
+    activity_ += 1;
+    exhaust_held_ = false;
+    migrate_unacked_ += 1;
+    NMsg wk = mk(T_SS_MIGRATE_WORK);
+    wk.setb(F_UNITS_BLOB, std::move(blob));
+    wk.seti(F_BOUNCED, 0);
+    ep_->send(int(m.geti(F_DEST)), wk);
+  }
+
+  void on_migrate_work(const NMsg& m) {
+    const std::string* blob = m.getb(F_UNITS_BLOB);
+    if (blob == nullptr || blob->size() < 4) return;
+    bool bounced = m.geti(F_BOUNCED) != 0;
+    size_t off = 0;
+    uint32_t n;
+    std::memcpy(&n, blob->data(), 4); off = 4;
+    std::string bounce_blob;
+    uint32_t n_bounced = 0;
+    blob_u32(bounce_blob, 0);
+    bool any_added = false;
+    for (uint32_t i = 0; i < n; ++i) {
+      if (off + 4 > blob->size()) die("truncated migrate blob");
+      uint32_t plen;
+      std::memcpy(&plen, blob->data() + off, 4);
+      size_t rec = 4 + 4 * 4 + 3 * 8 + 8;
+      if (off + rec + plen > blob->size()) die("truncated migrate blob");
+      // admission control like every other ingress; an admitted unit is
+      // never dropped — on a full server it bounces back ONCE, and the
+      // sender must then keep it (overcommit beats losing work)
+      if (!bounced && !mem_try_alloc(int64_t(plen))) {
+        bounce_blob.append(*blob, off, rec + plen);
+        n_bounced += 1;
+        off += rec + plen;
+        continue;
+      }
+      if (bounced) mem_alloc(int64_t(plen));
+      int32_t wtype, prio, answer, home;
+      int64_t clen, cserver, cseqno;
+      double ts;
+      size_t o = off + 4;
+      std::memcpy(&wtype, blob->data() + o, 4); o += 4;
+      std::memcpy(&prio, blob->data() + o, 4); o += 4;
+      std::memcpy(&answer, blob->data() + o, 4); o += 4;
+      std::memcpy(&home, blob->data() + o, 4); o += 4;
+      std::memcpy(&clen, blob->data() + o, 8); o += 8;
+      std::memcpy(&cserver, blob->data() + o, 8); o += 8;
+      std::memcpy(&cseqno, blob->data() + o, 8); o += 8;
+      std::memcpy(&ts, blob->data() + o, 8); o += 8;
+      int64_t seqno = next_seqno_++;
+      adlbwq::Unit u{seqno, wtype, prio, -1, -1, int64_t(plen)};
+      wq_.units.emplace(seqno, u);
+      wq_.count += 1;
+      if (wq_.count > wq_.max_count) wq_.max_count = wq_.count;
+      wq_.total_bytes += u.payload_len;
+      wq_.index(u);
+      Meta& meta = meta_[seqno];
+      meta.payload.assign(blob->data() + o, plen);
+      meta.answer_rank = answer;
+      meta.home_server = home;
+      meta.common_len = clen;
+      meta.common_server = cserver;
+      meta.common_seqno = cseqno;
+      meta.time_stamp = ts;
+      stats_[K_NPUSHED_TO_HERE] += 1;
+      any_added = true;
+      off += rec + plen;
+    }
+    ep_->send(m.src, mk(T_SS_MIGRATE_ACK));
+    if (n_bounced > 0) {
+      std::memcpy(bounce_blob.data(), &n_bounced, 4);
+      migrate_unacked_ += 1;
+      NMsg wk = mk(T_SS_MIGRATE_WORK);
+      wk.setb(F_UNITS_BLOB, std::move(bounce_blob));
+      wk.seti(F_BOUNCED, 1);
+      ep_->send(m.src, wk);
+    }
+    if (any_added) match_rq();
+  }
+
   // ---- abort --------------------------------------------------------------
   void do_abort(int code, bool broadcast) {
     if (aborted_) return;
@@ -1650,6 +1910,9 @@ class Server {
   int64_t push_seq_ = 0;
   std::unordered_map<int64_t, int64_t> push_offered_;   // qid -> seqno
   std::unordered_map<int64_t, int64_t> push_reserved_;  // qid -> bytes
+  int64_t migrate_unacked_ = 0;
+  double last_event_snap_ = 0.0;
+  bool last_snap_empty_ = false;
 
   bool no_more_work_ = false;
   bool done_by_exhaustion_ = false;
@@ -1691,6 +1954,15 @@ int main() {
     else if (key == "qmstat_interval") is >> cfg.qmstat_interval;
     else if (key == "exhaust_check_interval") is >> cfg.exhaust_check_interval;
     else if (key == "max_malloc") is >> cfg.max_malloc;
+    else if (key == "balancer") {
+      std::string v; is >> v;
+      cfg.tpu_mode = (v == "tpu");
+    }
+    else if (key == "balancer_rank") is >> cfg.balancer_rank;
+    else if (key == "balancer_interval") is >> cfg.balancer_interval;
+    else if (key == "balancer_min_gap") is >> cfg.balancer_min_gap;
+    else if (key == "balancer_max_tasks") is >> cfg.balancer_max_tasks;
+    else if (key == "balancer_max_requesters") is >> cfg.balancer_max_requesters;
     else if (!key.empty()) die("unknown config key '%s'", key.c_str());
   }
   if (rank < 0 || !w.is_server(rank)) die("bad or missing rank");
@@ -1713,6 +1985,7 @@ int main() {
   }
   Server server(w, cfg, rank, &ep);
   server.run();
+  server.notify_balancer_end();
   server.print_stats();
   ep.close_all();
   // readers may still be blocked in recv; exit hard after stats are out

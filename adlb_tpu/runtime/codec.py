@@ -126,6 +126,16 @@ FIELDS: dict[str, tuple[int, int]] = {
     "key": (24, _KIND_I64),
     "value": (25, _KIND_F64),
     "apptag": (26, _KIND_I64),
+    # balancer sidecar <-> native server (ids 27..45 are native-server-only,
+    # defined in serverd.cpp; these cross the Python boundary because the
+    # sidecar is the Python/JAX balancer brain driving native servers)
+    "for_rank": (29, _KIND_I64),
+    "req_home": (46, _KIND_I64),
+    "dest": (47, _KIND_I64),
+    "seqnos": (48, _KIND_LIST),
+    "tasks_flat": (49, _KIND_LIST),
+    "reqs_flat": (50, _KIND_LIST),
+    "consumers": (51, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

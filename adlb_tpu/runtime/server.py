@@ -79,8 +79,6 @@ class _BalancerWorker(threading.Thread):
         self.server = server
         self.wake = threading.Event()
         self.stopped = False
-        self._planned_reqs: dict[tuple, float] = {}
-        self._planned_tasks: dict[tuple, float] = {}
 
     def stop(self) -> None:
         self.stopped = True
@@ -88,22 +86,23 @@ class _BalancerWorker(threading.Thread):
 
     def run(self) -> None:
         s = self.server
-        from adlb_tpu.balancer.solve import AssignmentSolver
+        from adlb_tpu.balancer.engine import PlanEngine
 
-        solver = AssignmentSolver(
+        engine = PlanEngine(
             types=s.world.types,
             max_tasks=s.cfg.balancer_max_tasks,
             max_requesters=s.cfg.balancer_max_requesters,
             backend=s.cfg.solver_backend,
+            max_malloc_per_server=s.cfg.max_malloc_per_server,
         )
-        s._solver = solver
+        s._solver = engine.solver
         while True:
             self.wake.wait(timeout=0.25)
             self.wake.clear()
             if self.stopped or s.done:
                 return
             try:
-                self._one_round(solver)
+                self._one_round(engine)
             except Exception as e:  # noqa: BLE001
                 # The balancer must survive solver/backend errors — in tpu
                 # mode there is no other cross-server matching mechanism.
@@ -116,37 +115,13 @@ class _BalancerWorker(threading.Thread):
                     f"solve path and retrying",
                     file=_sys.stderr,
                 )
-                solver.host_threshold_reqs = 10**9
+                engine.force_host_path()
                 time.sleep(0.05)
 
-    def _one_round(self, solver) -> None:
+    def _one_round(self, engine) -> None:
         s = self.server
-        snaps = dict(s._snapshots)
-        if not snaps:
-            return
-        now = time.monotonic()
-        filtered = {}
-        for rank, snap in snaps.items():
-            stamp = snap.get("stamp", now)
-            reqs = [
-                r for r in snap["reqs"]
-                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
-            ]
-            tasks = [
-                t for t in snap["tasks"]
-                if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
-            ]
-            filtered[rank] = {"tasks": tasks, "reqs": reqs}
-        if any(sn["reqs"] for sn in filtered.values()):
-            pairs = solver.solve(filtered, s.world)
-        else:
-            pairs = []  # nobody parked; still consider migrations below
-        t_planned = time.monotonic()
-        for holder, seqno, req_home, for_rank, rqseqno in pairs:
-            if holder == req_home:
-                continue
-            self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
-            self._planned_tasks[(holder, seqno)] = t_planned
+        matches, migrations = engine.round(dict(s._snapshots), s.world)
+        for holder, seqno, req_home, for_rank, rqseqno in matches:
             s.ep.send(
                 holder,
                 msg(
@@ -158,103 +133,13 @@ class _BalancerWorker(threading.Thread):
                     rqseqno=rqseqno,
                 ),
             )
-        planned_away = {}
-        for holder, seqno, req_home, for_rank, rqseqno in pairs:
-            planned_away.setdefault(holder, set()).add(seqno)
-        self._plan_migrations(filtered, planned_away, t_planned)
-        # bound the memory of the plan ledgers
-        if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
-            cutoff = t_planned - 5.0
-            self._planned_reqs = {
-                k: v for k, v in self._planned_reqs.items() if v > cutoff
-            }
-            self._planned_tasks = {
-                k: v for k, v in self._planned_tasks.items() if v > cutoff
-            }
-        if s.cfg.balancer_min_gap > 0:
-            time.sleep(s.cfg.balancer_min_gap)
-
-    def _plan_migrations(
-        self, filtered: dict, planned_away: dict, t_planned: float
-    ) -> None:
-        """Demand-weighted inventory placement: top servers with hungry
-        consumers and empty shelves up from surplus servers, so the next
-        reserve matches locally instead of paying a cross-server round-trip.
-        The reference can only move work under memory pressure (reference
-        ``src/adlb.c:509-556``); a global planner moves it toward demand."""
-        s = self.server
-        snaps = s._snapshots
-        inv: dict[int, list] = {}
-        consumers: dict[int, int] = {}
-        for rank, f in filtered.items():
-            avail = [
-                t for t in f["tasks"] if t[0] not in planned_away.get(rank, ())
-            ]
-            inv[rank] = avail
-            consumers[rank] = snaps.get(rank, {}).get("consumers", 0)
-        total_consumers = sum(consumers.values())
-        if total_consumers == 0:
-            return
-        # Fair-share placement: the planner sees the WHOLE inventory, so it
-        # places each server's consumer-weighted share in one round — the
-        # global solve's structural advantage over stealing's one-unit-per-
-        # round-trip RFRs (and over drip-feeding a fixed small burst, which
-        # re-idles the destination every round). Snapshot truncation
-        # (balancer_max_tasks) only delays the tail, not the first wave.
-        total_avail = sum(len(v) for v in inv.values())
-        if total_avail == 0:
-            return
-
-        def share(r: int) -> int:
-            # ceil of the consumer-weighted share, so rounding never
-            # strands a destination at zero
-            c = consumers.get(r, 0)
-            return -(-total_avail * c // total_consumers) if c else 0
-
-        # deficits: servers holding less than their share
-        deficits = {
-            r: share(r) - len(inv[r])
-            for r, c in consumers.items()
-            if c > 0 and len(inv[r]) < share(r)
-        }
-        if not deficits:
-            return
-        # surpluses: inventory beyond this server's own share
-        surpluses = {
-            r: lst[share(r):]
-            for r, lst in inv.items()
-            if len(lst) > share(r)
-        }
-        cap = s.cfg.max_malloc_per_server
-        moves: dict[tuple[int, int], list[int]] = {}
-        for dest, want in sorted(deficits.items(), key=lambda kv: -kv[1]):
-            dest_bytes = snaps.get(dest, {}).get("nbytes", 0)
-            for src_rank, lst in surpluses.items():
-                if want <= 0:
-                    break
-                if src_rank == dest or not lst:
-                    continue
-                take = []
-                while lst and len(take) < want:
-                    t = lst[0]
-                    if cap > 0 and dest_bytes + t[3] > 0.9 * cap:
-                        break  # planner-side admission: dest believed full
-                    take.append(t)
-                    dest_bytes += t[3]
-                    lst = lst[1:]
-                surpluses[src_rank] = lst
-                if take:
-                    moves.setdefault((src_rank, dest), []).extend(
-                        t[0] for t in take
-                    )
-                    want -= len(take)
-        for (src_rank, dest), seqnos in moves.items():
-            for q in seqnos:
-                self._planned_tasks[(src_rank, q)] = t_planned
+        for src_rank, dest, seqnos in migrations:
             s.ep.send(
                 src_rank,
                 msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos),
             )
+        if s.cfg.balancer_min_gap > 0:
+            time.sleep(s.cfg.balancer_min_gap)
 
 
 class _PeerState:

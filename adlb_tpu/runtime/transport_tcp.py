@@ -395,6 +395,15 @@ def spawn_world(
         procs[rank] = p
         p.start()
 
+    # native + tpu: the JAX balancer brain runs as a sidecar thread in the
+    # parent at pseudo-rank world.nranks; servers stream snapshots to it
+    sidecar_ep = None
+    sidecar_thread = None
+    if cfg.server_impl == "native" and cfg.balancer == "tpu":
+        from adlb_tpu.balancer.sidecar import start_sidecar
+
+        sidecar_ep, sidecar_thread = start_sidecar(world, cfg, abort_event)
+
     deadline = time.monotonic() + timeout
     addr_map = {}
     try:
@@ -423,12 +432,20 @@ def spawn_world(
                     )
                 continue
             addr_map[rank] = ("127.0.0.1", port)
+        if sidecar_ep is not None:
+            addr_map[world.nranks] = ("127.0.0.1", sidecar_ep.port)
+            sidecar_ep.addr_map.update(addr_map)
+            sidecar_thread.start()
         for conn in pipes.values():
             conn.send(addr_map)
     except Exception:
         abort_event.set()
         for p in procs.values():
             p.terminate()
+        if sidecar_ep is not None:
+            from adlb_tpu.balancer.sidecar import stop_sidecar
+
+            stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
         raise
 
     app_results, server_stats = {}, {}
@@ -467,6 +484,10 @@ def spawn_world(
         if p.is_alive():
             p.terminate()
             p.join(timeout=5.0)
+    if sidecar_thread is not None:
+        from adlb_tpu.balancer.sidecar import stop_sidecar
+
+        stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
 
     if errors:
         raise RuntimeError("; ".join(errors))

@@ -124,9 +124,10 @@ class Config:
     # server reactor implementation (spawn_world / TCP worlds only):
     # "python" runs adlb_tpu.runtime.server.Server per server rank; "native"
     # runs the C++ daemon (adlb_tpu/native/serverd.cpp) — the reference's
-    # all-native data plane (SURVEY §7 language split). Native servers
-    # implement the steal balancer; tpu mode keeps the Python server (the
-    # balancer brain is JAX).
+    # all-native data plane (SURVEY §7 language split). With
+    # balancer="tpu", native servers stream snapshots to a Python/JAX
+    # balancer sidecar process (adlb_tpu/balancer/sidecar.py) and enact its
+    # plan; with "steal" they run the heuristics natively.
     server_impl: str = "python"
 
     def __post_init__(self) -> None:
@@ -142,11 +143,13 @@ class Config:
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
         if self.qmstat_mode not in ("broadcast", "ring"):
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
-        if self.server_impl == "native" and self.balancer == "tpu":
-            raise ValueError(
-                "server_impl='native' implements the steal balancer; the tpu "
-                "balancer brain is JAX and runs under the Python server"
-            )
+        # snapshot lists are flattened into binary-codec list fields whose
+        # element count is a u16 (4 entries per task, 3+ntypes per
+        # requester); keep a wide safety margin under 65535
+        if not (0 < self.balancer_max_tasks <= 8192):
+            raise ValueError("balancer_max_tasks must be in 1..8192")
+        if not (0 < self.balancer_max_requesters <= 2048):
+            raise ValueError("balancer_max_requesters must be in 1..2048")
         if self.server_impl == "native" and self.qmstat_mode != "broadcast":
             raise ValueError(
                 "server_impl='native' implements broadcast qmstat only; the "
