@@ -100,8 +100,6 @@ def run_native_world(
         use_debug_server=use_debug_server,
     )
     all_native = cfg.server_impl == "native"
-    if all_native and use_debug_server:
-        raise ValueError("native servers do not carry DS_LOG frames yet")
     addr_map = local_addr_map(world.nranks)
     binary = set(range(n_clients))  # native ranks speak the TLV codec
     abort_event = threading.Event()
@@ -137,6 +135,21 @@ def run_native_world(
                 sidecar_ep.addr_map.update(addr_map)
                 endpoints[world.nranks] = sidecar_ep
                 sidecar_thread.start()
+            if use_debug_server:
+                # the watchdog stays Python even in all-native worlds;
+                # daemons heartbeat it with binary DS_LOG frames
+                dbg_rank = world.debug_server_rank
+                endpoints[dbg_rank] = TcpEndpoint(
+                    dbg_rank, addr_map, binary_peers=set(world.server_ranks)
+                )
+                t = threading.Thread(
+                    target=lambda: DebugServer(
+                        world, cfg, endpoints[dbg_rank], abort_event
+                    ).run(),
+                    daemon=True,
+                )
+                threads.append(t)
+                t.start()
             for p in daemons.values():
                 daemon_mod.send_addrs(p, addr_map)
         except BaseException:

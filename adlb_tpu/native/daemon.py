@@ -36,6 +36,7 @@ def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
         f"qmstat_interval {cfg.qmstat_interval}",
         f"exhaust_check_interval {cfg.exhaust_check_interval}",
         f"max_malloc {cfg.max_malloc_per_server}",
+        f"debug_log_interval {cfg.debug_log_interval}",
     ]
     if cfg.balancer == "tpu":
         # the JAX balancer sidecar listens at pseudo-rank world.nranks

@@ -125,6 +125,7 @@ enum WireTag : uint16_t {
   T_SS_PLAN_MIGRATE = 1119,
   T_SS_MIGRATE_WORK = 1120,
   T_SS_MIGRATE_ACK = 1121,
+  T_DS_LOG = 1131,
   T_DS_END = 1132,
 };
 
@@ -186,6 +187,8 @@ enum FieldId : uint8_t {
   F_CONSUMERS = 51,       // i64
   F_BOUNCED = 52,         // i64
   F_UNITS_BLOB = 53,      // bytes: packed migrate batch
+  F_WQ_COUNT = 54,        // i64 (DS_LOG heartbeat)
+  F_RQ_COUNT = 55,        // i64 (DS_LOG heartbeat)
 };
 
 enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
@@ -556,6 +559,7 @@ struct Cfg {
   // tpu mode: stream snapshots to a Python/JAX balancer sidecar and enact
   // its plan (SURVEY §7 language split: C++ data plane, JAX brain)
   bool tpu_mode = false;
+  double debug_log_interval = 1.0;
   int balancer_rank = -1;
   double balancer_interval = 0.02;
   double balancer_min_gap = 0.002;
@@ -664,6 +668,8 @@ class Server {
   void notify_balancer_end() {
     if (cfg_.tpu_mode && cfg_.balancer_rank >= 0)
       ep_->send(cfg_.balancer_rank, mk(T_DS_END));
+    if (w_.use_debug_server)
+      ep_->send(w_.nranks - 1, mk(T_DS_END));
   }
 
  private:
@@ -861,6 +867,14 @@ class Server {
     if (master_ && now >= next_exhaust_) {
       next_exhaust_ = now + cfg_.exhaust_check_interval;
       check_exhaustion(now);
+    }
+    if (w_.use_debug_server && now >= next_ds_log_) {
+      next_ds_log_ = now + cfg_.debug_log_interval;
+      NMsg m = mk(T_DS_LOG);
+      m.seti(F_WQ_COUNT, wq_.count);
+      m.seti(F_RQ_COUNT, int64_t(rq_.size()));
+      m.seti(F_NBYTES, mem_curr_);
+      ep_->send(w_.nranks - 1, m);  // debug server is the last world rank
     }
   }
 
@@ -1930,7 +1944,7 @@ class Server {
   std::vector<double> stats_;
   double rq_wait_sum_ = 0.0;
   int64_t rq_wait_n_ = 0;
-  double next_qmstat_ = 0.0, next_exhaust_ = 0.0;
+  double next_qmstat_ = 0.0, next_exhaust_ = 0.0, next_ds_log_ = 0.0;
 };
 
 }  // namespace
@@ -1959,6 +1973,7 @@ int main() {
       cfg.tpu_mode = (v == "tpu");
     }
     else if (key == "balancer_rank") is >> cfg.balancer_rank;
+    else if (key == "debug_log_interval") is >> cfg.debug_log_interval;
     else if (key == "balancer_interval") is >> cfg.balancer_interval;
     else if (key == "balancer_min_gap") is >> cfg.balancer_min_gap;
     else if (key == "balancer_max_tasks") is >> cfg.balancer_max_tasks;

@@ -136,6 +136,9 @@ FIELDS: dict[str, tuple[int, int]] = {
     "tasks_flat": (49, _KIND_LIST),
     "reqs_flat": (50, _KIND_LIST),
     "consumers": (51, _KIND_I64),
+    # native server -> Python debug server heartbeats (DS_LOG)
+    "wq_count": (54, _KIND_I64),
+    "rq_count": (55, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -363,11 +363,6 @@ def spawn_world(
 
     cfg = cfg or Config()
     if cfg.server_impl == "native":
-        if use_debug_server:
-            raise ValueError(
-                "server_impl='native' does not carry DS_LOG frames yet; "
-                "run the debug server with Python servers"
-            )
         from adlb_tpu.native.build import ensure_serverd
 
         ensure_serverd()  # build once up front, not per server rank

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Bump ADLB_VERSION_NUMBER / ADLB_VERSION_DATE in include/adlb/adlb.h.
+
+Port of the reference's release helper (reference
+``scripts/fix_version.py:1-27``), which derived the new version from the
+svn revision; here the number is the repo's commit count (``git rev-list
+--count HEAD``) and the date is today, written in place.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HDR = os.path.join(_REPO, "include", "adlb", "adlb.h")
+
+
+def main() -> int:
+    try:
+        n = int(
+            subprocess.run(
+                ["git", "rev-list", "--count", "HEAD"],
+                cwd=_REPO, check=True, capture_output=True, text=True,
+            ).stdout.strip()
+        )
+    except (subprocess.CalledProcessError, OSError, ValueError) as e:
+        print(f"cannot obtain revision number: {e}", file=sys.stderr)
+        return 1
+    date = time.strftime("%d-%b-%Y")
+    out = []
+    saw_number = saw_date = False
+    with open(_HDR) as f:
+        for line in f:
+            if re.match(r"#define\s+ADLB_VERSION_NUMBER\b", line):
+                out.append(f"#define ADLB_VERSION_NUMBER {n}\n")
+                saw_number = True
+            elif re.match(r"#define\s+ADLB_VERSION_DATE\b", line):
+                out.append(f'#define ADLB_VERSION_DATE "{date}"\n')
+                saw_date = True
+            else:
+                out.append(line)
+    if saw_number and not saw_date:
+        # insert the date right after the number, like the reference header
+        for i, line in enumerate(out):
+            if "ADLB_VERSION_NUMBER" in line:
+                out.insert(i + 1, f'#define ADLB_VERSION_DATE "{date}"\n')
+                break
+    if not saw_number:
+        print("ADLB_VERSION_NUMBER not found in adlb.h", file=sys.stderr)
+        return 1
+    with open(_HDR, "w") as f:
+        f.writelines(out)
+    print(f"adlb.h -> version {n}, {date}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,6 +243,21 @@ def test_all_native_tpu_c_clients():
     assert total == 24
 
 
+def test_native_with_debug_server_watchdog():
+    """Native daemons heartbeat the Python watchdog with binary DS_LOG
+    frames and release it with DS_END at shutdown."""
+    cfg = Config(
+        server_impl="native", exhaust_check_interval=0.15,
+        debug_log_interval=0.1,
+    )
+    res = spawn_world(
+        3, 2, [1], _exhaustion_app, cfg=cfg, use_debug_server=True,
+        timeout=60.0,
+    )
+    assert sum(res.app_results.values()) == 10
+    assert not res.aborted
+
+
 def test_all_native_world_c_clients():
     """C clients (libadlb.so) against C++ server daemons — zero Python in
     the data plane."""
