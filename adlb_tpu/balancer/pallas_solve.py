@@ -12,10 +12,15 @@ Kernel design (SURVEY §7 stage 5, "Pallas for the auction inner loop"):
   (``compat[k, r] = 1`` iff the k-th task in descending-priority order may
   go to requester ``r``) — pure vectorized gather work XLA fuses well.
 * The Pallas kernel then runs the inherently sequential greedy sweep with
-  ALL state resident in VMEM: one ``fori_loop`` over task rows, each step a
-  VPU-width mask/min over the open-requester vector, a scalar winner write,
-  and an in-place open-vector update.  No HBM traffic inside the loop, no
-  per-step XLA dispatch — exactly the "keep the inner loop on-chip" recipe.
+  the live state resident in VMEM: a grid over task-row *blocks* (so the
+  compatibility matrix streams through VMEM block by block instead of
+  having to fit whole — 16k x 2k once hit the 128M VMEM cap exactly), one
+  ``fori_loop`` over the block's rows, each step a VPU-width mask/min over
+  the open-requester vector, a scalar winner write, and an in-place
+  open-vector update.  The open vector lives in persistent VMEM scratch
+  across grid steps (TPU grids execute sequentially).  No HBM traffic
+  inside the loop, no per-step XLA dispatch — exactly the "keep the inner
+  loop on-chip" recipe.
 * Winner inversion (task-order → per-requester assignment) is another tiny
   XLA scatter after the kernel.
 
@@ -40,18 +45,27 @@ from jax.experimental.pallas import tpu as pltpu
 from adlb_tpu.balancer.solve import _NEG
 
 _LANE = 128  # TPU lane width: requester vectors are padded to a multiple
+# per-grid-step compat slab budget; Mosaic double-buffers windowed inputs
+# and the scoped VMEM budget is 16 MiB (tests shrink this to force
+# multi-block sweeps at small shapes)
+_SLAB_BYTES = 4 << 20
 
 
 def _greedy_sweep_kernel(compat_ref, winner_ref, open_scr):
-    """Sequential greedy over priority-ordered task rows, entirely in VMEM.
+    """Sequential greedy over one block of priority-ordered task rows.
 
-    compat_ref: [NT, NRp] int32 (1 = this task may go to this requester)
-    winner_ref: [NT, 1] int32 out — requester index per task row, -1 = none
-    open_scr:   [1, NRp] int32 scratch — 1 while a requester is unmatched
+    compat_ref: [B, NRp] int32 (1 = this task may go to this requester)
+    winner_ref: [B, 1] int32 out — requester index per task row, -1 = none
+    open_scr:   [1, NRp] int32 scratch — 1 while a requester is unmatched;
+                persists across the (sequential) task-block grid
     """
-    nt = compat_ref.shape[0]
+    nb = compat_ref.shape[0]
     nrp = compat_ref.shape[1]
-    open_scr[:] = jnp.ones((1, nrp), dtype=jnp.int32)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        open_scr[:] = jnp.ones((1, nrp), dtype=jnp.int32)
+
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, nrp), 1)
 
     def body(t, _):
@@ -64,7 +78,7 @@ def _greedy_sweep_kernel(compat_ref, winner_ref, open_scr):
         open_scr[:] = jnp.where(found & (lane == idx), 0, open_scr[:])
         return 0
 
-    jax.lax.fori_loop(0, nt, body, 0)
+    jax.lax.fori_loop(0, nb, body, 0)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -85,6 +99,10 @@ def pallas_greedy_assign(
     NT = task_prio.shape[0]
     NR = req_mask.shape[0]
     NRp = _round_up(max(NR, 1), _LANE)
+    # task-block size: keep each block's compat slab small (see _SLAB_BYTES)
+    block = max(min(NT, _SLAB_BYTES // (4 * NRp)), 8)
+    block = min(_round_up(block, 8), _round_up(NT, 8))
+    NTp = _round_up(NT, block)
 
     # XLA pre-pass: stable descending-priority order + compat matrix
     order = jnp.argsort(-task_prio, stable=True)
@@ -96,16 +114,21 @@ def pallas_greedy_assign(
         & req_valid[None, :]
         & req_mask[:, jnp.clip(s_type, 0)].T
     )
-    compat = jnp.pad(compat, ((0, 0), (0, NRp - NR))).astype(jnp.int32)
+    compat = jnp.pad(compat, ((0, NTp - NT), (0, NRp - NR))).astype(jnp.int32)
 
     winner = pl.pallas_call(
         _greedy_sweep_kernel,
-        out_shape=jax.ShapeDtypeStruct((NT, 1), jnp.int32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        grid=(NTp // block,),
+        out_shape=jax.ShapeDtypeStruct((NTp, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec((block, NRp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((1, NRp), jnp.int32)],
         interpret=interpret,
-    )(compat)[:, 0]
+    )(compat)[:NT, 0]
 
     # invert winner-per-ordered-task into per-requester assignment; each
     # requester wins at most once so the scatter is 1-1

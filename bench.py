@@ -134,6 +134,47 @@ def main() -> None:
     tric_fast = tric("steal_fast")
     tric_tpu = tric("tpu")
 
+    # solve scale: end-to-end snapshot->pairs latency of the batched global
+    # solve at pool sizes far beyond the reference's feasible scale (its
+    # 0.1s ring gossip + O(n) scans); device path forced
+    def solve_scale(S, K, R, reps=3):
+        import numpy as np
+
+        from adlb_tpu.balancer.solve import AssignmentSolver
+
+        rng = np.random.default_rng(0)
+        solver = AssignmentSolver(
+            types=(1, 2, 3, 4), max_tasks=K, max_requesters=R,
+            backend="auto", host_threshold_reqs=0,
+        )
+        snaps = {}
+        for s in range(S):
+            snaps[100 + s] = {
+                "tasks": [
+                    (i + 1, int(rng.integers(1, 5)),
+                     int(rng.integers(-50, 50)), 64)
+                    for i in range(K)
+                ],
+                "reqs": [
+                    (s * R + i, i + 1, [int(rng.integers(1, 5))])
+                    for i in range(R)
+                ],
+            }
+        solver.solve(snaps, None)  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            pairs = solver.solve(snaps, None)
+            best = min(best, time.perf_counter() - t0)
+        assert len(pairs) == S * R
+        return round(best * 1e3, 1)
+
+    import jax as _jax
+
+    on_tpu = _jax.default_backend() not in ("cpu",)
+    solve_4k_ms = solve_scale(8, 512, 64)
+    solve_16k_ms = solve_scale(16, 1024, 128) if on_tpu else None
+
     lat_steal = coinop.run(
         n_tokens=400, num_app_ranks=APPS, nservers=SERVERS, cfg=cfg("steal"),
         timeout=300.0,
@@ -176,6 +217,8 @@ def main() -> None:
             "dispatch_speedup_vs_upstream": round(
                 tric_steal.dispatch_p50_ms / tric_tpu.dispatch_p50_ms, 2)
             if tric_tpu.dispatch_p50_ms else 0.0,
+            "solve_4096x512_ms": solve_4k_ms,
+            "solve_16384x2048_ms": solve_16k_ms,
             "hotspot_app_ranks": HOT_APPS,
             "hotspot_servers": HOT_SERVERS,
             "nq_n": N,

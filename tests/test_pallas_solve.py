@@ -76,3 +76,26 @@ def test_solver_pallas_backend_matches_host():
     assert sorted(base.solve(dict(snaps), None)) == sorted(
         pal.solve(dict(snaps), None)
     )
+
+
+def test_pallas_multiblock_sweep_matches_host(monkeypatch):
+    """Force the task-block grid (several sequential blocks sharing the
+    open-vector scratch) at small shapes; must stay bit-exact with the
+    host greedy — this is the path large pools (e.g. 16k x 2k once hit
+    the VMEM cap) take on real hardware."""
+    import jax.numpy as jnp
+
+    from adlb_tpu.balancer import pallas_solve
+
+    # 16 KiB slab -> block = 16384/(4*128) = 32 rows -> NT=300 uses 10 blocks
+    monkeypatch.setattr(pallas_solve, "_SLAB_BYTES", 16 << 10)
+    kern = pallas_solve.make_pallas_assign()
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        tp, tt, rm, rv = _random_instance(rng, 300, 60, 4)
+        want = _host_greedy(tp, tt, rm, rv)
+        got = np.asarray(
+            kern(jnp.asarray(tp), jnp.asarray(tt), jnp.asarray(rm),
+                 jnp.asarray(rv))
+        )
+        np.testing.assert_array_equal(got, want)
