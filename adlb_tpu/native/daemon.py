@@ -34,6 +34,7 @@ def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
         "types " + " ".join(str(t) for t in world.types),
         f"rank {rank}",
         f"qmstat_interval {cfg.qmstat_interval}",
+        f"qmstat_mode {cfg.qmstat_mode}",
         f"exhaust_check_interval {cfg.exhaust_check_interval}",
         f"max_malloc {cfg.max_malloc_per_server}",
         f"debug_log_interval {cfg.debug_log_interval}",

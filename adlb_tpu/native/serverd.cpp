@@ -189,6 +189,7 @@ enum FieldId : uint8_t {
   F_UNITS_BLOB = 53,      // bytes: packed migrate batch
   F_WQ_COUNT = 54,        // i64 (DS_LOG heartbeat)
   F_RQ_COUNT = 55,        // i64 (DS_LOG heartbeat)
+  F_QM_TABLE = 56,        // list: (rank, nbytes, qlen, prio[T])* ring token
 };
 
 enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
@@ -554,6 +555,7 @@ struct World {
 
 struct Cfg {
   double qmstat_interval = 0.05;
+  bool qmstat_ring = false;  // reference-faithful ring token gossip
   double exhaust_check_interval = 0.25;
   double max_malloc = 0.0;
   // tpu mode: stream snapshots to a Python/JAX balancer sidecar and enact
@@ -1396,7 +1398,7 @@ class Server {
   }
 
   // ---- qmstat state broadcast (reference src/adlb.c:806-822) --------------
-  void broadcast_qmstat() {
+  std::vector<int64_t> refresh_self_entry() {
     PeerState& self = peers_[rank_];
     self.nbytes = mem_curr_;
     self.qlen = wq_num_unpinned_untargeted();
@@ -1410,6 +1412,58 @@ class Server {
       self.hi_prio[t] = p;
       prios.push_back(p);
     }
+    return prios;
+  }
+
+  // flattened ring-token entry layout: (rank, nbytes, qlen, prio[T])*
+  void token_set_entry(std::vector<int64_t>& tbl, int rank,
+                       const PeerState& st,
+                       const std::vector<int64_t>* prios) {
+    size_t stride = 3 + w_.types.size();
+    for (size_t i = 0; i + stride <= tbl.size(); i += stride) {
+      if (tbl[i] == rank) {
+        tbl[i + 1] = st.nbytes;
+        tbl[i + 2] = st.qlen;
+        for (size_t j = 0; j < w_.types.size(); ++j)
+          tbl[i + 3 + j] = prios != nullptr
+                               ? (*prios)[j]
+                               : st.hi_prio.count(w_.types[j])
+                                     ? st.hi_prio.at(w_.types[j])
+                                     : ADLB_LOWEST_PRIO;
+        return;
+      }
+    }
+    tbl.push_back(rank);
+    tbl.push_back(st.nbytes);
+    tbl.push_back(st.qlen);
+    for (size_t j = 0; j < w_.types.size(); ++j)
+      tbl.push_back(prios != nullptr
+                        ? (*prios)[j]
+                        : st.hi_prio.count(w_.types[j])
+                              ? st.hi_prio.at(w_.types[j])
+                              : ADLB_LOWEST_PRIO);
+  }
+
+  void broadcast_qmstat() {
+    std::vector<int64_t> prios = refresh_self_entry();
+    PeerState& self = peers_[rank_];
+    if (cfg_.qmstat_ring) {
+      // reference-faithful store-and-forward ring token: master-kicked,
+      // full table, per-hop staleness (reference src/adlb.c:806-822,
+      // 1705-1757)
+      if (master_ && w_.nservers > 1) {
+        std::vector<int64_t> tbl;
+        for (const auto& kv : peers_)
+          token_set_entry(tbl, kv.first, kv.second,
+                          kv.first == rank_ ? &prios : nullptr);
+        NMsg m = mk(T_SS_QMSTAT);
+        m.setl(F_QM_TABLE, tbl);
+        m.seti(F_ORIGIN, rank_);
+        m.setd(F_TIME_STAMP, monotonic());
+        ep_->send(w_.ring_next(rank_), m);
+      }
+      return;
+    }
     for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers;
          ++s) {
       if (s == rank_) continue;
@@ -1421,20 +1475,56 @@ class Server {
     }
   }
 
-  void on_qmstat(const NMsg& m) {
-    PeerState& st = peers_[m.src];
-    st.nbytes = m.geti(F_NBYTES);
-    st.qlen = m.geti(F_QLEN);
-    const std::vector<int64_t>* prios = m.getl(F_HI_PRIO);
+  void apply_peer_entry(int src, int64_t nbytes, int64_t qlen,
+                        const int64_t* prios, size_t nprios) {
+    PeerState& st = peers_[src];
+    st.nbytes = nbytes;
+    st.qlen = qlen;
     bool any_work = false;
-    if (prios != nullptr) {
-      for (size_t i = 0; i < w_.types.size() && i < prios->size(); ++i) {
-        st.hi_prio[w_.types[i]] = int32_t((*prios)[i]);
-        if ((*prios)[i] > ADLB_LOWEST_PRIO) any_work = true;
-      }
+    for (size_t i = 0; i < w_.types.size() && i < nprios; ++i) {
+      st.hi_prio[w_.types[i]] = int32_t(prios[i]);
+      if (prios[i] > ADLB_LOWEST_PRIO) any_work = true;
     }
     if (any_work)
-      for (auto& kv : rfr_excluded_) kv.second.erase(m.src);
+      for (auto& kv : rfr_excluded_) kv.second.erase(src);
+  }
+
+  void on_qmstat(const NMsg& m) {
+    const std::vector<int64_t>* tbl = m.getl(F_QM_TABLE);
+    if (tbl != nullptr) {
+      // ring token: install every entry except our own, then either record
+      // the trip (back at origin, reference src/adlb.c:1731-1743) or
+      // refresh our entry and forward
+      size_t stride = 3 + w_.types.size();
+      for (size_t i = 0; i + stride <= tbl->size(); i += stride) {
+        int src = int((*tbl)[i]);
+        if (src != rank_)
+          apply_peer_entry(src, (*tbl)[i + 1], (*tbl)[i + 2],
+                           tbl->data() + i + 3, w_.types.size());
+      }
+      if (int(m.geti(F_ORIGIN)) == rank_) {
+        double trip = monotonic() - m.getd(F_TIME_STAMP);
+        if (trip > stats_[K_MAX_QMSTAT_TRIP_TIME])
+          stats_[K_MAX_QMSTAT_TRIP_TIME] = trip;
+        qm_trips_ += 1;
+        stats_[K_AVG_QMSTAT_TRIP_TIME] +=
+            (trip - stats_[K_AVG_QMSTAT_TRIP_TIME]) / double(qm_trips_);
+        if (trip > cfg_.qmstat_interval) stats_[K_NUM_QMS_EXCEED_INT] += 1;
+      } else {
+        std::vector<int64_t> out = *tbl;
+        std::vector<int64_t> prios = refresh_self_entry();
+        token_set_entry(out, rank_, peers_[rank_], &prios);
+        NMsg fwd = mk(T_SS_QMSTAT);
+        fwd.setl(F_QM_TABLE, out);
+        fwd.seti(F_ORIGIN, m.geti(F_ORIGIN));
+        fwd.setd(F_TIME_STAMP, m.getd(F_TIME_STAMP));
+        ep_->send(w_.ring_next(rank_), fwd);
+      }
+    } else {
+      apply_peer_entry(m.src, m.geti(F_NBYTES), m.geti(F_QLEN),
+                       m.getl(F_HI_PRIO) ? m.getl(F_HI_PRIO)->data() : nullptr,
+                       m.getl(F_HI_PRIO) ? m.getl(F_HI_PRIO)->size() : 0);
+    }
     for (auto& e : rq_)
       if (!rfr_out_.count(e.world_rank)) try_rfr(e);
   }
@@ -1945,6 +2035,7 @@ class Server {
   double rq_wait_sum_ = 0.0;
   int64_t rq_wait_n_ = 0;
   double next_qmstat_ = 0.0, next_exhaust_ = 0.0, next_ds_log_ = 0.0;
+  int64_t qm_trips_ = 0;
 };
 
 }  // namespace
@@ -1974,6 +2065,10 @@ int main() {
     }
     else if (key == "balancer_rank") is >> cfg.balancer_rank;
     else if (key == "debug_log_interval") is >> cfg.debug_log_interval;
+    else if (key == "qmstat_mode") {
+      std::string v; is >> v;
+      cfg.qmstat_ring = (v == "ring");
+    }
     else if (key == "balancer_interval") is >> cfg.balancer_interval;
     else if (key == "balancer_min_gap") is >> cfg.balancer_min_gap;
     else if (key == "balancer_max_tasks") is >> cfg.balancer_max_tasks;

@@ -150,11 +150,6 @@ class Config:
             raise ValueError("balancer_max_tasks must be in 1..8192")
         if not (0 < self.balancer_max_requesters <= 2048):
             raise ValueError("balancer_max_requesters must be in 1..2048")
-        if self.server_impl == "native" and self.qmstat_mode != "broadcast":
-            raise ValueError(
-                "server_impl='native' implements broadcast qmstat only; the "
-                "ring-gossip baseline runs under the Python server"
-            )
 
 
 def normalize_req_types(

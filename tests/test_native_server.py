@@ -243,6 +243,40 @@ def test_all_native_tpu_c_clients():
     assert total == 24
 
 
+def _ring_app(ctx):
+    import struct
+    import time
+
+    T = 1
+    if ctx.rank == 0:
+        for i in range(24):
+            ctx.put(struct.pack("<q", i), T)
+        time.sleep(0.3)  # let ring tokens complete trips
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return n
+        ctx.get_reserved(r.handle)
+        n += 1
+
+
+def test_native_ring_qmstat_gossip():
+    """Reference-faithful ring-token gossip runs natively: the master
+    records trip times and stolen work reaches other servers."""
+    cfg = Config(
+        server_impl="native", qmstat_mode="ring", qmstat_interval=0.05,
+        put_routing="home", exhaust_check_interval=0.2,
+    )
+    res = spawn_world(6, 3, [1], _ring_app, cfg=cfg, timeout=90.0)
+    assert sum(res.app_results.values()) == 24
+    trip = max(
+        s.get(int(InfoKey.AVG_QMSTAT_TRIP_TIME), 0)
+        for s in res.server_stats.values()
+    )
+    assert trip > 0, "master recorded no ring trips"
+
+
 def test_native_with_debug_server_watchdog():
     """Native daemons heartbeat the Python watchdog with binary DS_LOG
     frames and release it with DS_END at shutdown."""
