@@ -38,22 +38,62 @@ class PlanEngine:
         max_requesters: int,
         backend: str = "auto",
         max_malloc_per_server: float = 0.0,
+        use_mesh: bool = False,
+        nservers: Optional[int] = None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
 
-        self.solver = AssignmentSolver(
-            types=tuple(types),
-            max_tasks=max_tasks,
-            max_requesters=max_requesters,
-            backend=backend,
-        )
+        self.solver = None
+        if use_mesh:
+            # multi-chip: shard the task table over a device mesh
+            # (balancer/distributed.py); falls back to the single-device
+            # solver on a 1-device host
+            import jax
+
+            devs = jax.devices()
+            if len(devs) > 1:
+                import numpy as np
+                from jax.sharding import Mesh
+
+                from adlb_tpu.balancer.distributed import (
+                    DistributedAssignmentSolver,
+                )
+
+                spd = 1
+                if nservers is not None and nservers > len(devs):
+                    spd = -(-nservers // len(devs))
+                self.solver = DistributedAssignmentSolver(
+                    types=tuple(types),
+                    max_tasks_per_server=max_tasks,
+                    max_requesters=max_requesters,
+                    mesh=Mesh(np.array(devs), axis_names=("s",)),
+                    servers_per_device=spd,
+                )
+        if self.solver is None:
+            self.solver = AssignmentSolver(
+                types=tuple(types),
+                max_tasks=max_tasks,
+                max_requesters=max_requesters,
+                backend=backend,
+            )
         self.max_malloc_per_server = max_malloc_per_server
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
 
     def force_host_path(self) -> None:
-        """After a device/backend failure: keep planning on numpy."""
-        self.solver.host_threshold_reqs = 10**9
+        """After a device/backend failure: keep planning on numpy — for the
+        mesh solver, by swapping in a single-device host-path solver."""
+        if hasattr(self.solver, "host_threshold_reqs"):
+            self.solver.host_threshold_reqs = 10**9
+        else:
+            from adlb_tpu.balancer.solve import AssignmentSolver
+
+            self.solver = AssignmentSolver(
+                types=self.solver.types,
+                max_tasks=self.solver.K,
+                max_requesters=self.solver.R,
+                host_threshold_reqs=10**9,
+            )
 
     def round(self, snapshots: dict, world=None):
         """One planning round; returns (matches, migrations)."""

@@ -92,6 +92,8 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         max_requesters=cfg.balancer_max_requesters,
         backend=cfg.solver_backend,
         max_malloc_per_server=cfg.max_malloc_per_server,
+        use_mesh=cfg.balancer_mesh == "auto",
+        nservers=world.nservers,
     )
     snapshots: dict[int, dict] = {}
     ended: set[int] = set()

@@ -94,6 +94,8 @@ class _BalancerWorker(threading.Thread):
             max_requesters=s.cfg.balancer_max_requesters,
             backend=s.cfg.solver_backend,
             max_malloc_per_server=s.cfg.max_malloc_per_server,
+            use_mesh=s.cfg.balancer_mesh == "auto",
+            nservers=s.world.nservers,
         )
         s._solver = engine.solver
         while True:

@@ -113,6 +113,10 @@ class Config:
     # device solve implementation: "auto" = Pallas sweep kernel on TPU, XLA
     # scan elsewhere; explicit "xla"/"pallas" force one
     solver_backend: str = "auto"
+    # "auto" = when more than one accelerator device is visible, shard the
+    # balancer's task table over a jax.sharding.Mesh (one shard per device,
+    # balancer/distributed.py); "off" = single-device solve
+    balancer_mesh: str = "off"
     trace: bool = False  # event tracing hooks (reference MPE shims)
     aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
     selfdiag_interval: float = 30.0  # server health dumps; 0 = off
@@ -150,6 +154,8 @@ class Config:
             raise ValueError("balancer_max_tasks must be in 1..8192")
         if not (0 < self.balancer_max_requesters <= 2048):
             raise ValueError("balancer_max_requesters must be in 1..2048")
+        if self.balancer_mesh not in ("off", "auto"):
+            raise ValueError(f"unknown balancer_mesh {self.balancer_mesh!r}")
 
 
 def normalize_req_types(

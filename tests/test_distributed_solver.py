@@ -95,3 +95,40 @@ def test_runs_on_mesh_without_recompile(mesh):
         101: {"tasks": [(9, T1, 2, 8)], "reqs": []},
     }
     assert dist.solve(snaps2, None) == [(101, 9, 100, 3, 7)]
+
+
+def test_plan_engine_uses_mesh_when_available():
+    """PlanEngine(use_mesh=True) shards the solve over all visible devices
+    (8 virtual CPU devices in CI) and plans cross-server matches."""
+    import jax
+
+    from adlb_tpu.balancer.distributed import DistributedAssignmentSolver
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    assert len(jax.devices()) == 8
+    engine = PlanEngine(types=(1, 2), max_tasks=8, max_requesters=4,
+                        use_mesh=True, nservers=4)
+    assert isinstance(engine.solver, DistributedAssignmentSolver)
+    snaps = {
+        100: {"tasks": [(1, 1, 5, 8), (2, 2, 3, 8)], "reqs": [],
+              "nbytes": 16, "consumers": 1},
+        101: {"tasks": [], "reqs": [(7, 1, [1]), (8, 2, [2])],
+              "nbytes": 0, "consumers": 2},
+    }
+    matches, migrations = engine.round(snaps, None)
+    assert len(matches) == 2
+    for holder, seqno, req_home, for_rank, rqseqno in matches:
+        assert holder == 100 and req_home == 101
+
+
+def test_world_runs_with_mesh_balancer():
+    from adlb_tpu.runtime.world import Config
+    from adlb_tpu.workloads import model
+
+    res = model.run(
+        numprobs=10, work_secs=0.003, num_app_ranks=3, nservers=2,
+        cfg=Config(balancer="tpu", balancer_mesh="auto",
+                   balancer_max_tasks=16, balancer_max_requesters=8,
+                   exhaust_check_interval=0.2),
+    )
+    assert res.ok, res
