@@ -47,28 +47,41 @@ class PlanEngine:
         if use_mesh:
             # multi-chip: shard the task table over a device mesh
             # (balancer/distributed.py); falls back to the single-device
-            # solver on a 1-device host
-            import jax
+            # solver on a 1-device host, AND on any accelerator-init
+            # failure — engine construction happens before the callers'
+            # solver-failure recovery loops, so it must not be able to
+            # kill the balancer (tpu mode has no other matching mechanism)
+            try:
+                import jax
 
-            devs = jax.devices()
-            if len(devs) > 1:
-                import numpy as np
-                from jax.sharding import Mesh
+                devs = jax.devices()
+                if len(devs) > 1:
+                    import numpy as np
+                    from jax.sharding import Mesh
 
-                from adlb_tpu.balancer.distributed import (
-                    DistributedAssignmentSolver,
+                    from adlb_tpu.balancer.distributed import (
+                        DistributedAssignmentSolver,
+                    )
+
+                    spd = 1
+                    if nservers is not None and nservers > len(devs):
+                        spd = -(-nservers // len(devs))
+                    self.solver = DistributedAssignmentSolver(
+                        types=tuple(types),
+                        max_tasks_per_server=max_tasks,
+                        max_requesters=max_requesters,
+                        mesh=Mesh(np.array(devs), axis_names=("s",)),
+                        servers_per_device=spd,
+                    )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                import sys
+
+                print(
+                    f"[adlb balancer] mesh solver unavailable ({e!r}); "
+                    f"using the single-device solver",
+                    file=sys.stderr,
                 )
-
-                spd = 1
-                if nservers is not None and nservers > len(devs):
-                    spd = -(-nservers // len(devs))
-                self.solver = DistributedAssignmentSolver(
-                    types=tuple(types),
-                    max_tasks_per_server=max_tasks,
-                    max_requesters=max_requesters,
-                    mesh=Mesh(np.array(devs), axis_names=("s",)),
-                    servers_per_device=spd,
-                )
+                self.solver = None
         if self.solver is None:
             self.solver = AssignmentSolver(
                 types=tuple(types),

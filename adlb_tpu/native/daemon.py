@@ -38,6 +38,7 @@ def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
         f"exhaust_check_interval {cfg.exhaust_check_interval}",
         f"max_malloc {cfg.max_malloc_per_server}",
         f"debug_log_interval {cfg.debug_log_interval}",
+        f"periodic_log_interval {cfg.periodic_log_interval}",
     ]
     if cfg.balancer == "tpu":
         # the JAX balancer sidecar listens at pseudo-rank world.nranks
@@ -78,16 +79,23 @@ def send_addrs(proc: subprocess.Popen, addr_map: dict) -> None:
 
 
 def _parse_trailer(lines):
-    """Parse STATS/ABORT lines from an iterable; returns
-    (stats dict (int key -> float) or None, abort code or None)."""
+    """Parse STATS/ABORT lines from an iterable; other output (STAT_APS
+    chunks, diagnostics) passes through to stdout so the offline decoder
+    and the operator still see it. Returns (stats dict (int key -> float)
+    or None, abort code or None)."""
+    import sys
+
     stats: Optional[dict] = None
     abort_code: Optional[int] = None
     for line in lines:
-        line = line.strip()
-        if line.startswith("STATS "):
-            stats = {int(k): v for k, v in json.loads(line[6:]).items()}
-        elif line.startswith("ABORT "):
-            abort_code = int(line.split()[1])
+        line = line.rstrip("\n")
+        stripped = line.strip()
+        if stripped.startswith("STATS "):
+            stats = {int(k): v for k, v in json.loads(stripped[6:]).items()}
+        elif stripped.startswith("ABORT "):
+            abort_code = int(stripped.split()[1])
+        elif stripped:
+            print(line, file=sys.stdout)
     return stats, abort_code
 
 

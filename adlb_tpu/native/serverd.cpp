@@ -42,6 +42,7 @@
 #include <ctime>
 #include <deque>
 #include <iostream>
+#include <array>
 #include <map>
 #include <mutex>
 #include <set>
@@ -120,6 +121,7 @@ enum WireTag : uint16_t {
   T_SS_END_1 = 1114,
   T_SS_END_2 = 1115,
   T_SS_ABORT = 1116,
+  T_SS_PERIODIC_STATS = 1122,
   T_SS_STATE = 1117,
   T_SS_PLAN_MATCH = 1118,
   T_SS_PLAN_MIGRATE = 1119,
@@ -190,6 +192,7 @@ enum FieldId : uint8_t {
   F_WQ_COUNT = 54,        // i64 (DS_LOG heartbeat)
   F_RQ_COUNT = 55,        // i64 (DS_LOG heartbeat)
   F_QM_TABLE = 56,        // list: (rank, nbytes, qlen, prio[T])* ring token
+  F_PSTATS_BLOB = 57,     // bytes: packed periodic-stats ring token entries
 };
 
 enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
@@ -561,6 +564,7 @@ struct Cfg {
   // tpu mode: stream snapshots to a Python/JAX balancer sidecar and enact
   // its plan (SURVEY §7 language split: C++ data plane, JAX brain)
   bool tpu_mode = false;
+  double periodic_log_interval = 0.0;  // 0 = off (reference src/adlb.c:712)
   double debug_log_interval = 1.0;
   int balancer_rank = -1;
   double balancer_interval = 0.02;
@@ -622,6 +626,7 @@ class Server {
     double now = monotonic();
     next_qmstat_ = now;
     next_exhaust_ = now + cfg_.exhaust_check_interval;
+    next_pstats_ = now + cfg_.periodic_log_interval;
     while (!done_) {
       now = monotonic();
       periodic(now);
@@ -756,6 +761,7 @@ class Server {
 
   void reserve_resp_ok(int app, const adlbwq::Unit& u, const Meta& meta,
                        int holder) {
+    resolved_ctr_ += 1;
     NMsg r = mk(T_TA_RESERVE_RESP);
     r.seti(F_RC, ADLB_SUCCESS);
     r.seti(F_WORK_TYPE, u.work_type);
@@ -850,6 +856,7 @@ class Server {
       case T_SS_END_1: on_end_1(m); break;
       case T_SS_END_2: on_end_2(m); break;
       case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
+      case T_SS_PERIODIC_STATS: on_periodic_stats(m); break;
       case T_SS_PLAN_MATCH: on_plan_match(m); break;
       case T_SS_PLAN_MIGRATE: on_plan_migrate(m); break;
       case T_SS_MIGRATE_WORK: on_migrate_work(m); break;
@@ -870,6 +877,10 @@ class Server {
       next_exhaust_ = now + cfg_.exhaust_check_interval;
       check_exhaustion(now);
     }
+    if (master_ && cfg_.periodic_log_interval > 0 && now >= next_pstats_) {
+      next_pstats_ = now + cfg_.periodic_log_interval;
+      kick_periodic_stats(now);
+    }
     if (w_.use_debug_server && now >= next_ds_log_) {
       next_ds_log_ = now + cfg_.debug_log_interval;
       NMsg m = mk(T_DS_LOG);
@@ -882,6 +893,7 @@ class Server {
 
   // ---- app handlers (reference src/adlb.c:889-1383) -----------------------
   void on_put(const NMsg& m) {
+    puts_ctr_ += 1;
     if (no_more_work_ || done_by_exhaustion_) {
       NMsg r = mk(T_TA_PUT_RESP);
       r.seti(F_RC, ADLB_NO_MORE_WORK);
@@ -1211,6 +1223,7 @@ class Server {
       rq_wait_sum_ += wait;
       rq_wait_n_ += 1;
       activity_ += 1;
+      resolved_ctr_ += 1;
       NMsg r = mk(T_TA_RESERVE_RESP);
       r.seti(F_RC, ADLB_SUCCESS);
       r.seti(F_WORK_TYPE, wt);
@@ -1749,6 +1762,133 @@ class Server {
     }
   }
 
+  // ---- periodic cluster-wide stats ring (reference src/adlb.c:712-753,
+  // 2391-2465): master kicks a token; each server appends its packed
+  // contribution; back at the master the sum is printed as <=500-byte
+  // STAT_APS chunks, same format as the Python side (stats.py), parsed by
+  // scripts/get_stats.py. Entry layout:
+  //   i32 rank, i64 wq_count, i64 rq, i64 puts, i64 resolved, i64 nbytes,
+  //   u32 nhist, (i32 type, i32 tgt, i64 n)*
+
+  void append_pstats_entry(std::string& blob) {
+    blob_i32(blob, rank_);
+    blob_i64(blob, wq_.count);
+    blob_i64(blob, int64_t(rq_.size()));
+    blob_i64(blob, puts_ctr_);
+    blob_i64(blob, resolved_ctr_);
+    blob_i64(blob, mem_curr_);
+    std::map<std::pair<int32_t, int32_t>, int64_t> hist;
+    for (const auto& kv : wq_.units) {
+      int32_t tgt = kv.second.target_rank < 0 ? -1 : kv.second.target_rank;
+      hist[{kv.second.work_type, tgt}] += 1;
+    }
+    blob_u32(blob, uint32_t(hist.size()));
+    for (const auto& h : hist) {
+      blob_i32(blob, h.first.first);
+      blob_i32(blob, h.first.second);
+      blob_i64(blob, h.second);
+    }
+  }
+
+  void kick_periodic_stats(double now) {
+    if (no_more_work_ || done_by_exhaustion_) return;  // peers may be gone
+    pstats_seq_ += 1;
+    std::string blob;
+    append_pstats_entry(blob);
+    if (w_.nservers == 1) {
+      emit_stat_aps(blob, pstats_seq_, now);
+      return;
+    }
+    NMsg m = mk(T_SS_PERIODIC_STATS);
+    m.setb(F_PSTATS_BLOB, std::move(blob));
+    m.seti(F_SEQNO, pstats_seq_);
+    m.seti(F_ORIGIN, rank_);
+    m.setd(F_TIME_STAMP, now);
+    ep_->send(w_.ring_next(rank_), m);
+  }
+
+  void on_periodic_stats(const NMsg& m) {
+    const std::string* blob = m.getb(F_PSTATS_BLOB);
+    if (blob == nullptr) return;
+    if (int(m.geti(F_ORIGIN)) == rank_) {
+      emit_stat_aps(*blob, m.geti(F_SEQNO), m.getd(F_TIME_STAMP));
+      return;
+    }
+    std::string out = *blob;
+    append_pstats_entry(out);
+    NMsg fwd = mk(T_SS_PERIODIC_STATS);
+    fwd.setb(F_PSTATS_BLOB, std::move(out));
+    fwd.seti(F_SEQNO, m.geti(F_SEQNO));
+    fwd.seti(F_ORIGIN, m.geti(F_ORIGIN));
+    fwd.setd(F_TIME_STAMP, m.getd(F_TIME_STAMP));
+    ep_->send(w_.ring_next(rank_), fwd);
+  }
+
+  void emit_stat_aps(const std::string& blob, int64_t seq, double t0) {
+    // aggregate the packed entries into the JSON record stats.py emits
+    struct Cell { int64_t targeted = 0, untargeted = 0; };
+    std::map<int32_t, Cell> by_type;
+    int64_t twq = 0, trq = 0, tputs = 0, tres = 0, tnb = 0;
+    std::map<int32_t, std::array<int64_t, 3>> per_server;  // wq, rq, nbytes
+    size_t off = 0;
+    auto rd_i32 = [&](int32_t* v) {
+      std::memcpy(v, blob.data() + off, 4); off += 4;
+    };
+    auto rd_i64 = [&](int64_t* v) {
+      std::memcpy(v, blob.data() + off, 8); off += 8;
+    };
+    while (off + 4 + 5 * 8 + 4 <= blob.size()) {
+      int32_t rank; int64_t wq, rq, puts, res, nb; uint32_t nhist;
+      rd_i32(&rank); rd_i64(&wq); rd_i64(&rq); rd_i64(&puts);
+      rd_i64(&res); rd_i64(&nb);
+      std::memcpy(&nhist, blob.data() + off, 4); off += 4;
+      for (uint32_t i = 0; i < nhist && off + 16 <= blob.size(); ++i) {
+        int32_t t, tgt; int64_t n;
+        rd_i32(&t); rd_i32(&tgt); rd_i64(&n);
+        if (tgt >= 0) by_type[t].targeted += n;
+        else by_type[t].untargeted += n;
+      }
+      twq += wq; trq += rq; tputs += puts; tres += res; tnb += nb;
+      per_server[rank] = {wq, rq, nb};
+    }
+    double now = monotonic();
+    std::ostringstream js;
+    char num[64];
+    std::snprintf(num, sizeof(num), "%.6f", now);
+    js << "{\"seq\":" << seq << ",\"t\":" << num;
+    std::snprintf(num, sizeof(num), "%.6f", now - t0);
+    js << ",\"trip_s\":" << num
+       << ",\"nservers\":" << per_server.size() << ",\"by_type\":{";
+    bool first = true;
+    for (const auto& kv : by_type) {
+      if (!first) js << ",";
+      first = false;
+      js << "\"" << kv.first << "\":{\"targeted\":" << kv.second.targeted
+         << ",\"untargeted\":" << kv.second.untargeted << "}";
+    }
+    js << "},\"total\":{\"wq\":" << twq << ",\"rq\":" << trq
+       << ",\"puts\":" << tputs << ",\"resolved\":" << tres
+       << ",\"nbytes\":" << tnb << "},\"per_server\":{";
+    first = true;
+    for (const auto& kv : per_server) {
+      if (!first) js << ",";
+      first = false;
+      js << "\"" << kv.first << "\":{\"wq\":" << kv.second[0]
+         << ",\"rq\":" << kv.second[1] << ",\"nbytes\":" << kv.second[2]
+         << "}";
+    }
+    js << "}}";
+    std::string payload = js.str();
+    size_t nparts = (payload.size() + 499) / 500;
+    if (nparts == 0) nparts = 1;
+    for (size_t i = 0; i < nparts; ++i) {
+      std::printf("STAT_APS: seq=%lld part=%zu/%zu %s\n",
+                  (long long)seq, i + 1, nparts,
+                  payload.substr(i * 500, 500).c_str());
+    }
+    std::fflush(stdout);
+  }
+
   // ---- balancer sidecar (tpu mode) ----------------------------------------
   // The JAX brain runs in a Python sidecar process; this server streams
   // fixed-shape queue-state snapshots to it and enacts SS_PLAN_MATCH /
@@ -2036,6 +2176,8 @@ class Server {
   int64_t rq_wait_n_ = 0;
   double next_qmstat_ = 0.0, next_exhaust_ = 0.0, next_ds_log_ = 0.0;
   int64_t qm_trips_ = 0;
+  int64_t puts_ctr_ = 0, resolved_ctr_ = 0, pstats_seq_ = 0;
+  double next_pstats_ = 0.0;
 };
 
 }  // namespace
@@ -2065,6 +2207,7 @@ int main() {
     }
     else if (key == "balancer_rank") is >> cfg.balancer_rank;
     else if (key == "debug_log_interval") is >> cfg.debug_log_interval;
+    else if (key == "periodic_log_interval") is >> cfg.periodic_log_interval;
     else if (key == "qmstat_mode") {
       std::string v; is >> v;
       cfg.qmstat_ring = (v == "ring");

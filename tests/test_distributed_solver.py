@@ -105,7 +105,7 @@ def test_plan_engine_uses_mesh_when_available():
     from adlb_tpu.balancer.distributed import DistributedAssignmentSolver
     from adlb_tpu.balancer.engine import PlanEngine
 
-    assert len(jax.devices()) == 8
+    assert len(jax.devices()) >= 2  # conftest forces a virtual CPU mesh
     engine = PlanEngine(types=(1, 2), max_tasks=8, max_requesters=4,
                         use_mesh=True, nservers=4)
     assert isinstance(engine.solver, DistributedAssignmentSolver)

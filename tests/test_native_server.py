@@ -277,6 +277,41 @@ def test_native_ring_qmstat_gossip():
     assert trip > 0, "master recorded no ring trips"
 
 
+def test_native_periodic_stats_ring(capfd):
+    """Native masters emit STAT_APS chunks in the decoder's format
+    (reference src/adlb.c:712-753; scripts/get_stats.py)."""
+    import time
+
+    from adlb_tpu.runtime.stats import parse_stat_lines
+
+    def app(ctx):
+        T = 1
+        if ctx.rank == 0:
+            for i in range(30):
+                ctx.put(struct.pack("<q", i), T)
+            time.sleep(0.5)  # keep the world alive across several ticks
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return n
+            ctx.get_reserved(r.handle)
+            time.sleep(0.004)
+            n += 1
+
+    cfg = Config(
+        server_impl="native", periodic_log_interval=0.1,
+        exhaust_check_interval=0.2,
+    )
+    res = spawn_world(3, 2, [1], app, cfg=cfg, timeout=90.0)
+    assert sum(res.app_results.values()) == 30
+    out, _ = capfd.readouterr()
+    records = parse_stat_lines(out.splitlines())
+    assert records, "no STAT_APS records emitted"
+    assert records[-1]["total"]["puts"] == 30
+    assert records[-1]["nservers"] == 2
+
+
 def test_native_with_debug_server_watchdog():
     """Native daemons heartbeat the Python watchdog with binary DS_LOG
     frames and release it with DS_END at shutdown."""
