@@ -390,6 +390,72 @@ bool valid_type(int t) {
 
 extern "C" {
 
+// ---- run-time tracing: the reference's MPE profiling wrapper layer
+// (reference src/adlb_prof.c — compile-time LOG_ADLB_INTERNALS per-call
+// state events and LOG_GUESS_USER_STATE inferred per-type user intervals
+// between Get_reserved calls), gated here by ADLB_TRACE=<path prefix> at
+// run time. ADLB_Finalize writes <prefix>.<rank>.trace.json in Chrome
+// trace-event format (one file per rank; concatenate the arrays to merge).
+static double trace_now() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+struct TraceEv {
+  const char *name;
+  int wt;  // work type for inferred user states, -1 for API calls
+  double ts, dur;
+};
+static bool trace_on = false;
+static std::string trace_prefix;
+static std::vector<TraceEv> trace_events;
+static double trace_user_t0 = -1.0;
+static int trace_user_wt = -1;
+static int trace_last_reserved_wt = -1;
+
+static void trace_api_entry() {
+  if (!trace_on) return;
+  if (trace_user_t0 >= 0) {  // close the open inferred user-state span
+    trace_events.push_back(
+        {"user", trace_user_wt, trace_user_t0, trace_now() - trace_user_t0});
+    trace_user_t0 = -1.0;
+  }
+}
+static void trace_call(const char *name, double t0) {
+  if (!trace_on) return;
+  trace_events.push_back({name, -1, t0, trace_now() - t0});
+}
+static void trace_got_work() {  // successful Get_reserved opens a user span
+  if (!trace_on) return;
+  trace_user_t0 = trace_now();
+  trace_user_wt = trace_last_reserved_wt;
+}
+static void trace_flush(int rank) {
+  if (!trace_on) return;
+  trace_api_entry();
+  std::string path = trace_prefix + "." + std::to_string(rank) +
+                     ".trace.json";
+  FILE *f = fopen(path.c_str(), "w");
+  if (f == nullptr) return;
+  fprintf(f, "[");
+  for (size_t i = 0; i < trace_events.size(); ++i) {
+    const TraceEv &e = trace_events[i];
+    if (i) fprintf(f, ",");
+    if (e.wt >= 0)
+      fprintf(f,
+              "{\"name\":\"user:type%d\",\"ph\":\"X\",\"ts\":%.3f,"
+              "\"dur\":%.3f,\"pid\":%d,\"tid\":%d}",
+              e.wt, e.ts * 1e6, e.dur * 1e6, rank, rank);
+    else
+      fprintf(f,
+              "{\"name\":\"%s\",\"ph\":\"X\",\"ts\":%.3f,"
+              "\"dur\":%.3f,\"pid\":%d,\"tid\":%d}",
+              e.name, e.ts * 1e6, e.dur * 1e6, rank, rank);
+  }
+  fprintf(f, "]\n");
+  fclose(f);
+}
+
 int ADLBP_Init(int num_servers, int use_debug_server, int aprintf_flag,
                int ntypes, int type_vect[], int *am_server,
                int *am_debug_server, int *num_app_ranks) {
@@ -449,14 +515,21 @@ int ADLBP_Init(int num_servers, int use_debug_server, int aprintf_flag,
 int ADLB_Init(int num_servers, int use_debug_server, int aprintf_flag,
               int ntypes, int type_vect[], int *am_server,
               int *am_debug_server, int *num_app_ranks) {
-  return ADLBP_Init(num_servers, use_debug_server, aprintf_flag, ntypes,
-                    type_vect, am_server, am_debug_server, num_app_ranks);
+  int rc = ADLBP_Init(num_servers, use_debug_server, aprintf_flag, ntypes,
+                      type_vect, am_server, am_debug_server, num_app_ranks);
+  const char *tp = getenv("ADLB_TRACE");
+  if (rc == ADLB_SUCCESS && tp != nullptr && tp[0] != '\0') {
+    trace_on = true;
+    trace_prefix = tp;
+  }
+  return rc;
 }
 
 int ADLBP_Server(double, double) { return ADLB_ERROR; }
 int ADLB_Server(double a, double b) { return ADLBP_Server(a, b); }
 int ADLBP_Debug_server(double) { return ADLB_ERROR; }
 int ADLB_Debug_server(double t) { return ADLBP_Debug_server(t); }
+
 
 int ADLBP_Put(void *work_buf, int work_len, int target_rank, int answer_rank,
               int work_type, int work_prio) {
@@ -504,7 +577,12 @@ int ADLBP_Put(void *work_buf, int work_len, int target_rank, int answer_rank,
   return rc;
 }
 int ADLB_Put(void *b, int l, int t, int a, int w, int p) {
-  return ADLBP_Put(b, l, t, a, w, p);
+  if (!trace_on) return ADLBP_Put(b, l, t, a, w, p);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Put(b, l, t, a, w, p);
+  trace_call("adlb:put", t0);
+  return rc;
 }
 
 static int reserve_impl(int *req_types, int *work_type, int *work_prio,
@@ -532,6 +610,7 @@ static int reserve_impl(int *req_types, int *work_type, int *work_prio,
   int rc = (int)resp.geti(F_RC);
   if (rc != ADLB_SUCCESS) return rc;
   if (work_type) *work_type = (int)resp.geti(F_WORK_TYPE);
+  trace_last_reserved_wt = (int)resp.geti(F_WORK_TYPE);
   if (work_prio) *work_prio = (int)resp.geti(F_PRIO);
   if (work_len) *work_len = (int)resp.geti(F_WORK_LEN);
   if (answer_rank) *answer_rank = (int)resp.geti(F_ANSWER_RANK, -1);
@@ -547,13 +626,23 @@ int ADLBP_Reserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
   return reserve_impl(rt, wt, wp, wh, wl, ar, 1);
 }
 int ADLB_Reserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
-  return reserve_impl(rt, wt, wp, wh, wl, ar, 1);
+  if (!trace_on) return reserve_impl(rt, wt, wp, wh, wl, ar, 1);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = reserve_impl(rt, wt, wp, wh, wl, ar, 1);
+  trace_call("adlb:reserve", t0);
+  return rc;
 }
 int ADLBP_Ireserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
   return reserve_impl(rt, wt, wp, wh, wl, ar, 0);
 }
 int ADLB_Ireserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
-  return reserve_impl(rt, wt, wp, wh, wl, ar, 0);
+  if (!trace_on) return reserve_impl(rt, wt, wp, wh, wl, ar, 0);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = reserve_impl(rt, wt, wp, wh, wl, ar, 0);
+  trace_call("adlb:ireserve", t0);
+  return rc;
 }
 
 int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
@@ -591,13 +680,19 @@ int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
   return ADLB_SUCCESS;
 }
 int ADLB_Get_reserved_timed(void *b, int *h, double *t) {
-  return ADLBP_Get_reserved_timed(b, h, t);
+  if (!trace_on) return ADLBP_Get_reserved_timed(b, h, t);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Get_reserved_timed(b, h, t);
+  trace_call("adlb:get_reserved", t0);
+  if (rc == ADLB_SUCCESS) trace_got_work();
+  return rc;
 }
 int ADLBP_Get_reserved(void *b, int *h) {
   return ADLBP_Get_reserved_timed(b, h, nullptr);
 }
 int ADLB_Get_reserved(void *b, int *h) {
-  return ADLBP_Get_reserved_timed(b, h, nullptr);
+  return ADLB_Get_reserved_timed(b, h, nullptr);
 }
 
 int ADLBP_Begin_batch_put(void *common_buf, int len_common) {
@@ -681,7 +776,10 @@ int ADLBP_Finalize(void) {
   close(g->listen_fd);
   return ADLB_SUCCESS;
 }
-int ADLB_Finalize(void) { return ADLBP_Finalize(); }
+int ADLB_Finalize(void) {
+  trace_flush(g ? g->rank : -1);
+  return ADLBP_Finalize();
+}
 
 int ADLBP_Abort(int code) {
   if (g) {

@@ -45,6 +45,34 @@ def test_capi_smoke(mode):
     assert total_processed == 24
 
 
+def test_capi_trace_files(tmp_path):
+    """ADLB_TRACE arms the C client's profiling wrapper layer (the
+    reference's MPE hooks, src/adlb_prof.c): per-call spans + inferred
+    user states land in Chrome-trace JSON, one file per rank."""
+    import json
+
+    exe = build_example(os.path.join(_EXAMPLES, "capi_smoke.c"))
+    prefix = str(tmp_path / "capi")
+    results, _ = run_native_world(
+        n_clients=2,
+        nservers=1,
+        types=[1, 2],
+        exe=exe,
+        cfg=Config(exhaust_check_interval=0.2),
+        env_extra={"ADLB_TRACE": prefix},
+        timeout=90.0,
+    )
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+    for rank in range(2):
+        path = tmp_path / f"capi.{rank}.trace.json"
+        assert path.exists(), f"missing trace for rank {rank}"
+        events = json.loads(path.read_text())
+        names = {e["name"] for e in events}
+        assert "adlb:put" in names and "adlb:reserve" in names
+        assert any(n.startswith("user:type") for n in names)
+
+
 def test_capi_nq_known_answer():
     exe = build_example(os.path.join(_EXAMPLES, "nq_c.c"))
     results, _ = run_native_world(
