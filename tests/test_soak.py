@@ -1,0 +1,114 @@
+"""Randomized conservation soak: churn the pool hard and prove no unit is
+lost or duplicated, in every plane x balancer combination.
+
+The reference's soak-test harness is the debug-server watchdog turning
+hangs into bounded-time aborts (SURVEY §4, reference src/adlb.c:2528-2635);
+here the same role is played by run timeouts, and the oracle is
+conservation: with exhaustion-only termination, every accepted put must be
+consumed exactly once. Producers interleave targeted and untargeted puts of
+several types and priorities with batch/common prefixes; consumers mix
+blocking and non-blocking reserves with random type subsets.
+"""
+
+import random
+import struct
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_NO_CURRENT_WORK, ADLB_SUCCESS
+
+TYPES = [1, 2, 3]
+N_PER_PRODUCER = 40
+
+
+def _app(ctx):
+    rng = random.Random(1234 + ctx.rank)
+    accepted = []
+    consumed = []
+    producers = max(ctx.num_app_ranks // 2, 1)
+    if ctx.rank < producers:
+        in_batch = False
+        for i in range(N_PER_PRODUCER):
+            if not in_batch and rng.random() < 0.15:
+                ctx.begin_batch_put(b"PFX%d" % ctx.rank)
+                in_batch = True
+            elif in_batch and rng.random() < 0.4:
+                ctx.end_batch_put()
+                in_batch = False
+            t = rng.choice(TYPES)
+            target = (
+                rng.randrange(ctx.num_app_ranks) if rng.random() < 0.25 else -1
+            )
+            payload = struct.pack("<iii", ctx.rank, i, t)
+            rc = ctx.put(payload, t, work_prio=rng.randrange(-5, 6),
+                         target_rank=target, answer_rank=ctx.rank)
+            if rc == ADLB_SUCCESS:
+                accepted.append((ctx.rank, i))
+        if in_batch:
+            ctx.end_batch_put()
+    # everyone consumes until exhaustion. Non-blocking probes use random
+    # type subsets; the blocking park is always wildcard — a rank parked on
+    # a subset excluding its own targeted unit's type would let the world
+    # exhaust with that unit still queued (legitimate ADLB semantics,
+    # reference src/adlb.c:754-785, but it would break this conservation
+    # oracle).
+    while True:
+        subset = (
+            None if rng.random() < 0.5
+            else rng.sample(TYPES, rng.randrange(1, len(TYPES) + 1))
+        )
+        if rng.random() < 0.3:
+            rc, r = ctx.ireserve(subset)
+            if rc == ADLB_NO_CURRENT_WORK:
+                rc, r = ctx.reserve()  # park wildcard, never starve a unit
+        else:
+            rc, r = ctx.reserve()
+        if rc != ADLB_SUCCESS:
+            break
+        rc, buf = ctx.get_reserved(r.handle)
+        if rc != ADLB_SUCCESS:
+            break
+        src, i, t = struct.unpack("<iii", buf[-12:])
+        assert r.work_type == t
+        consumed.append((src, i))
+    return accepted, consumed
+
+
+def _check(res, num_app_ranks):
+    accepted = sorted(
+        x for v in res.app_results.values() if v for x in v[0]
+    )
+    consumed = sorted(
+        x for v in res.app_results.values() if v for x in v[1]
+    )
+    assert len(res.app_results) == num_app_ranks, "a rank died"
+    assert consumed == accepted, (
+        f"conservation broken: {len(accepted)} accepted, "
+        f"{len(consumed)} consumed; "
+        f"lost={set(accepted) - set(consumed)} "
+        f"dup_or_phantom={set(consumed) - set(accepted)}"
+    )
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_soak_inproc(mode):
+    cfg = Config(
+        balancer=mode, exhaust_check_interval=0.2,
+        balancer_max_tasks=64, balancer_max_requesters=16,
+        max_malloc_per_server=8192,  # small: forces rejects + pushes
+    )
+    res = run_world(6, 3, TYPES, _app, cfg=cfg, timeout=120.0)
+    _check(res, 6)
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_soak_native(mode):
+    cfg = Config(
+        server_impl="native", balancer=mode, exhaust_check_interval=0.2,
+        max_malloc_per_server=8192,
+    )
+    res = spawn_world(6, 3, TYPES, _app, cfg=cfg, timeout=120.0)
+    _check(res, 6)
