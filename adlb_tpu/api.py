@@ -82,6 +82,9 @@ class AdlbContext:
     def info_get(self, key) -> tuple[int, float]:
         return self._c.info_get(int(key))
 
+    def checkpoint(self, path_prefix: str) -> tuple[int, int]:
+        return self._c.checkpoint(path_prefix)
+
     def abort(self, code: int) -> None:
         self._c.abort(code)
 

@@ -426,6 +426,23 @@ class Client:
             self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
         return ADLB_SUCCESS
 
+    def checkpoint(self, path_prefix: str) -> tuple[int, int]:
+        """Snapshot the whole pool to ``<path_prefix>.<server>.ckpt`` shards
+        (no reference analogue — upstream loses all queued work on exit).
+        Returns (rc, units captured). Units pinned mid-handoff are excluded;
+        restore with ``Config(restore_path=path_prefix)`` on an identical
+        world shape."""
+        if self.cfg.server_impl == "native":
+            raise AdlbError(
+                "checkpoint is not carried by the native server protocol yet"
+            )
+        with self._span("adlb:checkpoint"):
+            self.ep.send(
+                self.home, msg(Tag.FA_CHECKPOINT, self.rank, path=path_prefix)
+            )
+            resp = self._wait(Tag.TA_CHECKPOINT_RESP)
+        return resp.rc, resp.count
+
     def info_get(self, key: int) -> tuple[int, float]:
         """One live stats value from this rank's home server (reference
         ADLB_Info_get, ``src/adlb.c:3072-3141``)."""

@@ -58,6 +58,10 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.TA_INFO_NUM_RESP: 1043,
     Tag.TA_INFO_GET_RESP: 1044,
     Tag.TA_ABORT: 1046,
+    # checkpoint/resume (Python-server feature; pickle-only frames — the
+    # client refuses it toward native servers)
+    Tag.FA_CHECKPOINT: 1048,
+    Tag.TA_CHECKPOINT_RESP: 1049,
     # app<->app point-to-point (the reference's app_comm traffic). The id
     # exists so the codec stays total, but native C clients have no
     # app-messaging API yet, so encodable() refuses AM_APP — a Python rank
@@ -88,6 +92,7 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_MIGRATE_WORK: 1120,
     Tag.SS_MIGRATE_ACK: 1121,
     Tag.SS_PERIODIC_STATS: 1122,
+    Tag.SS_CHECKPOINT: 1123,
     Tag.DS_LOG: 1131,
     Tag.DS_END: 1132,
 }

@@ -73,6 +73,14 @@ class Tag(enum.Enum):
     SS_MIGRATE_WORK = enum.auto()  # holder -> dest: the moved units
     SS_MIGRATE_ACK = enum.auto()  # dest -> holder: units landed (or bounced)
 
+    # checkpoint/resume (no reference analogue — the reference has no pool
+    # serialization at all, SURVEY §5; this framework adds it): a client
+    # asks its home server, the master circulates a ring token, every
+    # server writes its shard, the origin client gets an ack with counts
+    FA_CHECKPOINT = enum.auto()
+    TA_CHECKPOINT_RESP = enum.auto()
+    SS_CHECKPOINT = enum.auto()
+
     # app <-> app (the reference's app_comm: ADLB_Init hands back a
     # communicator on which app ranks exchange ordinary point-to-point
     # messages, e.g. c1.c's TAG_B_ANSWER answer flow; here the same fabric

@@ -364,6 +364,15 @@ class CommonStore:
         self._entries[seqno] = CommonStore.Entry(seqno, buf)
         return seqno
 
+    def entries(self) -> list["CommonStore.Entry"]:
+        return list(self._entries.values())
+
+    def restore(self, seqno: int, refcnt: int, ngets: int, buf: bytes) -> None:
+        """Re-install a checkpointed entry under its original seqno (handles
+        and queued units reference it by number)."""
+        self._entries[seqno] = CommonStore.Entry(seqno, buf, refcnt, ngets)
+        self._next_seqno = max(self._next_seqno, seqno + 1)
+
     def set_refcnt(self, seqno: int, refcnt: int) -> None:
         e = self._entries.get(seqno)
         if e is None:

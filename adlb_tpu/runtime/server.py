@@ -243,7 +243,12 @@ class Server:
             else float("inf")
         )
 
+        if cfg.restore_path:
+            self._restore_from_checkpoint(cfg.restore_path)
+
         self._handlers = {
+            Tag.FA_CHECKPOINT: self._on_fa_checkpoint,
+            Tag.SS_CHECKPOINT: self._on_ss_checkpoint,
             Tag.FA_PUT: self._on_put,
             Tag.FA_PUT_COMMON: self._on_put_common,
             Tag.FA_BATCH_DONE: self._on_batch_done,
@@ -504,6 +509,101 @@ class Server:
                     self._satisfy_parked(entry, unit)
                     progressed = True
                     break
+
+    # ------------------------------------------------- checkpoint / resume
+    # No reference analogue (SURVEY §5: pool serialization absent there).
+    # A client's FA_CHECKPOINT reaches the master, which circulates a ring
+    # token; every server writes <prefix>.<rank>.ckpt (unpinned units + the
+    # batch-common store); the master acks the origin client with the total
+    # unit count. Restore happens at server init from the same shards.
+
+    def _restore_from_checkpoint(self, prefix: str) -> None:
+        from adlb_tpu.runtime import checkpoint
+
+        stray = set(checkpoint.existing_shard_ranks(prefix)) - set(
+            self.world.server_ranks
+        )
+        if stray:
+            # silently dropping higher-rank shards would lose their units;
+            # the restore world must match the checkpoint's server set
+            raise AdlbError(
+                f"checkpoint {prefix} has shards for server ranks "
+                f"{sorted(stray)} outside this world "
+                f"({list(self.world.server_ranks)}); restore with the same "
+                f"world shape"
+            )
+        units, centries = checkpoint.load_shard(prefix, self.rank)
+        for u in units:
+            payload = u.pop("payload")
+            self.mem.alloc(len(payload))
+            self.wq.add(WorkUnit(seqno=self._next_seqno, payload=payload,
+                                 home_server=self.rank, **u))
+            self._next_seqno += 1
+        for seqno, refcnt, ngets, buf in centries:
+            self.mem.alloc(len(buf))
+            self.cq.restore(seqno, refcnt, ngets, buf)
+        aprintf(
+            self.cfg.aprintf_flag, self.rank,
+            f"restored {len(units)} units, {len(centries)} common entries "
+            f"from {prefix}",
+        )
+
+    def _write_checkpoint_shard(self, prefix: str) -> int:
+        from adlb_tpu.runtime import checkpoint
+
+        return checkpoint.save_shard(prefix, self.rank, self.wq.units(),
+                                     self.cq)
+
+    def _on_fa_checkpoint(self, m: Msg) -> None:
+        fwd = msg(Tag.SS_CHECKPOINT, self.rank, path=m.path, client=m.src,
+                  started=False)
+        if self.is_master:
+            self._on_ss_checkpoint(fwd)
+        else:
+            self.ep.send(self.world.master_server_rank, fwd)
+
+    def _on_ss_checkpoint(self, m: Msg) -> None:
+        # units inside an unacked SS_MIGRATE_WORK live in no wq; holding
+        # the token until the ack lands keeps them out of the lost-update
+        # window (they are then in the destination's wq, and the
+        # destination is later in the ring or re-sends bounces likewise)
+        if self._migrate_unacked != 0:
+            self._held_checkpoint = m
+            return
+        self._process_checkpoint(m)
+
+    def _process_checkpoint(self, m: Msg) -> None:
+        if self.is_master and not m.started:
+            n = self._write_checkpoint_shard(m.path)
+            token = {"path": m.path, "client": m.client,
+                     "counts": {self.rank: n}}
+            if self.world.nservers == 1:
+                self._ack_checkpoint(token)
+            else:
+                self.ep.send(
+                    self.world.ring_next(self.rank),
+                    msg(Tag.SS_CHECKPOINT, self.rank, started=True,
+                        token=token),
+                )
+            return
+        token = m.token
+        if self.is_master:  # token came back around
+            self._ack_checkpoint(token)
+            return
+        token["counts"][self.rank] = self._write_checkpoint_shard(
+            token["path"]
+        )
+        self.ep.send(
+            self.world.ring_next(self.rank),
+            msg(Tag.SS_CHECKPOINT, self.rank, started=True, token=token),
+        )
+
+    def _ack_checkpoint(self, token: dict) -> None:
+        self.ep.send(
+            token["client"],
+            msg(Tag.TA_CHECKPOINT_RESP, self.rank, rc=ADLB_SUCCESS,
+                count=sum(token["counts"].values())),
+        )
 
     # ------------------------------------------------------- app handlers
 
@@ -1246,6 +1346,10 @@ class Server:
 
     def _on_migrate_ack(self, m: Msg) -> None:
         self._migrate_unacked -= 1
+        held = getattr(self, "_held_checkpoint", None)
+        if held is not None and self._migrate_unacked == 0:
+            self._held_checkpoint = None
+            self._process_checkpoint(held)
 
     # ------------------------------------------------------- termination
 

@@ -118,6 +118,10 @@ class Config:
     # balancer/distributed.py); "off" = single-device solve
     balancer_mesh: str = "off"
     trace: bool = False  # event tracing hooks (reference MPE shims)
+    # restore pool state from checkpoint shards written by ctx.checkpoint()
+    # (no reference analogue — SURVEY §5: checkpoint/resume absent there);
+    # requires the same world shape the checkpoint was taken with
+    restore_path: Optional[str] = None
     aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
     selfdiag_interval: float = 30.0  # server health dumps; 0 = off
     # (src/adlb.c:558-710; the reference hard-codes 30 s)
@@ -156,6 +160,11 @@ class Config:
             raise ValueError("balancer_max_requesters must be in 1..2048")
         if self.balancer_mesh not in ("off", "auto"):
             raise ValueError(f"unknown balancer_mesh {self.balancer_mesh!r}")
+        if self.restore_path and self.server_impl == "native":
+            raise ValueError(
+                "checkpoint restore is a Python-server feature; native "
+                "daemons do not load shards"
+            )
 
 
 def normalize_req_types(

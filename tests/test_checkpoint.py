@@ -1,0 +1,116 @@
+"""Pool checkpoint/resume — a capability the reference lacks entirely
+(SURVEY §5: no serialization of wq state; killing a run loses every queued
+unit)."""
+
+import struct
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+T1, T2, T_NEVER = 1, 2, 3
+
+
+def test_checkpoint_and_resume_roundtrip(tmp_path):
+    prefix = str(tmp_path / "pool")
+
+    def phase1(ctx):
+        """Put 30 units, consume 10, checkpoint the remainder, stop."""
+        if ctx.rank == 0:
+            for i in range(30):
+                ctx.put(struct.pack("<q", i), T1 if i % 2 else T2,
+                        work_prio=i % 7,
+                        target_rank=1 if i % 5 == 0 else -1)
+            got = []
+            for _ in range(10):
+                rc, r = ctx.reserve()
+                assert rc == ADLB_SUCCESS
+                rc, buf = ctx.get_reserved(r.handle)
+                got.append(struct.unpack("<q", buf)[0])
+            rc, n = ctx.checkpoint(prefix)
+            assert rc == ADLB_SUCCESS
+            ctx.set_problem_done()
+            return got, n
+        rc, _ = ctx.reserve([T_NEVER])  # parked; nothing may match before
+        assert rc != ADLB_SUCCESS       # the termination flush
+        return None
+
+    res1 = run_world(3, 2, [T1, T2, T_NEVER], phase1,
+                     cfg=Config(exhaust_check_interval=10.0))
+    got1, n_captured = res1.app_results[0]
+    assert len(got1) == 10
+    assert n_captured == 20, f"checkpoint captured {n_captured} units"
+
+    def phase2(ctx):
+        """Fresh world restores the shards and drains the remainder."""
+        got = []
+        while True:
+            rc, r = ctx.reserve()
+            if rc != ADLB_SUCCESS:
+                return got
+            rc, buf = ctx.get_reserved(r.handle)
+            got.append((struct.unpack("<q", buf)[0], r.work_type))
+
+    res2 = run_world(
+        3, 2, [T1, T2, T_NEVER], phase2,
+        cfg=Config(restore_path=prefix, exhaust_check_interval=0.2),
+    )
+    drained = sorted(x for v in res2.app_results.values() for x in (v or []))
+    assert len(drained) == 20
+    # exactly the unconsumed 20 of the 30, with types intact
+    expected = sorted(
+        (i, T1 if i % 2 else T2) for i in range(30) if i not in got1
+    )
+    assert drained == expected
+    # targeted units went to their target
+    targeted = [i for i in range(30) if i % 5 == 0 and i not in got1]
+    rank1 = [i for i, _ in (res2.app_results[1] or [])]
+    assert set(targeted) <= set(rank1), (targeted, rank1)
+
+
+def test_checkpoint_preserves_batch_common_prefix(tmp_path):
+    prefix = str(tmp_path / "pool2")
+    common = b"SHAREDHDR:"
+
+    def phase1(ctx):
+        if ctx.rank == 0:
+            ctx.begin_batch_put(common)
+            for i in range(6):
+                ctx.put(struct.pack("<q", i), T1)
+            ctx.end_batch_put()
+            rc, n = ctx.checkpoint(prefix)
+            assert rc == ADLB_SUCCESS and n == 6
+            ctx.set_problem_done()
+        else:
+            rc, _ = ctx.reserve([T_NEVER])
+            assert rc != ADLB_SUCCESS
+        return None
+
+    run_world(2, 2, [T1, T2, T_NEVER], phase1,
+              cfg=Config(exhaust_check_interval=10.0))
+
+    def phase2(ctx):
+        got = []
+        while True:
+            rc, r = ctx.reserve([T1])
+            if rc != ADLB_SUCCESS:
+                return got
+            rc, buf = ctx.get_reserved(r.handle)
+            assert buf.startswith(common), buf
+            got.append(struct.unpack("<q", buf[len(common):])[0])
+
+    res = run_world(
+        2, 2, [T1, T2, T_NEVER], phase2,
+        cfg=Config(restore_path=prefix, exhaust_check_interval=0.2),
+    )
+    drained = sorted(x for v in res.app_results.values() for x in (v or []))
+    assert drained == list(range(6))
+
+
+def test_checkpoint_missing_shard_is_loud(tmp_path):
+    from adlb_tpu.runtime.checkpoint import load_shard
+
+    with pytest.raises(FileNotFoundError):
+        load_shard(str(tmp_path / "nothing"), 3)

@@ -327,6 +327,26 @@ def test_native_with_debug_server_watchdog():
     assert not res.aborted
 
 
+def _nq_app(ctx):
+    from adlb_tpu.workloads import nq
+
+    return nq.app_main(ctx, n=6, max_depth_for_puts=2)
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_native_nq_known_answer(mode):
+    """The nq workload (Python clients) over native C++ servers in both
+    balancer modes reproduces the known answer."""
+    from adlb_tpu.workloads import nq
+
+    cfg = Config(
+        server_impl="native", balancer=mode, exhaust_check_interval=0.2,
+    )
+    res = spawn_world(3, 2, [nq.WORK], _nq_app, cfg=cfg, timeout=90.0)
+    total = sum(v[0] for v in res.app_results.values())
+    assert total == nq.KNOWN_SOLUTIONS[6]
+
+
 def test_all_native_world_c_clients():
     """C clients (libadlb.so) against C++ server daemons — zero Python in
     the data plane."""
