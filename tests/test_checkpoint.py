@@ -109,6 +109,63 @@ def test_checkpoint_preserves_batch_common_prefix(tmp_path):
     assert drained == list(range(6))
 
 
+def test_checkpoint_under_balancer_churn(tmp_path):
+    """Checkpoint taken while the TPU balancer is actively migrating a
+    hot server's inventory: the token is held at servers with unacked
+    migration batches, so accepted = consumed-before + drained-after."""
+    import time
+
+    prefix = str(tmp_path / "pool3")
+
+    def phase1(ctx):
+        if ctx.rank == 0:
+            for i in range(80):
+                ctx.put(struct.pack("<q", i), T1, work_prio=i % 5)
+            time.sleep(0.08)  # migrations in flight
+            rc, n = ctx.checkpoint(prefix)
+            assert rc == ADLB_SUCCESS
+            ctx.set_problem_done()
+            return ("ckpt", n)
+        got = []
+        while True:
+            rc, r = ctx.reserve([T1])
+            if rc != ADLB_SUCCESS:
+                return ("got", got)
+            rc, buf = ctx.get_reserved(r.handle)
+            got.append(struct.unpack("<q", buf)[0])
+            time.sleep(0.004)
+
+    cfg1 = Config(
+        balancer="tpu", put_routing="home", exhaust_check_interval=10.0,
+        balancer_max_tasks=64, balancer_max_requesters=16,
+    )
+    res1 = run_world(4, 3, [T1, T2, T_NEVER], phase1, cfg=cfg1)
+    consumed1 = sorted(
+        x for v in res1.app_results.values() if v[0] == "got" for x in v[1]
+    )
+
+    def phase2(ctx):
+        got = []
+        while True:
+            rc, r = ctx.reserve([T1])
+            if rc != ADLB_SUCCESS:
+                return got
+            rc, buf = ctx.get_reserved(r.handle)
+            got.append(struct.unpack("<q", buf)[0])
+
+    res2 = run_world(
+        4, 3, [T1, T2, T_NEVER], phase2,
+        cfg=Config(restore_path=prefix, exhaust_check_interval=0.2),
+    )
+    drained = sorted(x for v in res2.app_results.values() for x in (v or []))
+    # snapshot semantics: everything put is either consumed before the
+    # NO_MORE_WORK flush or present in the checkpoint; units consumed
+    # between token and flush may legitimately appear in both
+    assert set(consumed1) | set(drained) == set(range(80)), (
+        sorted(set(range(80)) - (set(consumed1) | set(drained)))
+    )
+
+
 def test_checkpoint_missing_shard_is_loud(tmp_path):
     from adlb_tpu.runtime.checkpoint import load_shard
 

@@ -333,6 +333,8 @@ def _nq_app(ctx):
     return nq.app_main(ctx, n=6, max_depth_for_puts=2)
 
 
+
+
 @pytest.mark.parametrize("mode", ["steal", "tpu"])
 def test_native_nq_known_answer(mode):
     """The nq workload (Python clients) over native C++ servers in both
