@@ -59,6 +59,7 @@ enum WireTag : uint16_t {
   T_TA_INFO_NUM_RESP = 1043,
   T_TA_INFO_GET_RESP = 1044,
   T_TA_ABORT = 1046,
+  T_AM_APP = 1047,
 };
 
 // ---- field ids (codec.py FIELDS) ------------------------------------------
@@ -88,6 +89,7 @@ enum Field : uint8_t {
   F_SERVER_RANK = 23,
   F_KEY = 24,
   F_VALUE = 25,
+  F_APPTAG = 26,
 };
 
 enum Kind : uint8_t { K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3 };
@@ -217,6 +219,7 @@ struct Ctx {
   std::mutex mu;
   std::condition_variable cv;
   std::deque<Msg> inbox;
+  std::deque<Msg> app_inbox;  // stashed AM_APP frames (the app_comm channel)
   std::map<int, int> out_fds;
   std::atomic<bool> closed{false};
 
@@ -351,20 +354,30 @@ void send_msg(int dest, Encoder &enc) {
 // Blocks until a frame with `want` arrives.  TA_ABORT terminates the process
 // (the reference client dies inside MPI_Abort in the same situation,
 // reference src/adlb.c:3165-3176).
+// Handle a frame that is not an awaited protocol response: abort frames
+// terminate, app_comm traffic is stashed, anything else is fatal.
+void dispatch_passive(Msg m) {
+  if (m.tag == T_TA_ABORT) {
+    int code = (int)m.geti(F_CODE, ADLB_ERROR);
+    fprintf(stderr, "[adlb rank %d] world aborted (code %d)\n", g->rank,
+            code);
+    exit(code == 0 ? 1 : (code < 0 ? -code : code));
+  }
+  if (m.tag == T_AM_APP) {
+    g->app_inbox.push_back(std::move(m));
+    return;
+  }
+  die("unexpected tag %u outside a pending request", m.tag);
+}
+
 Msg wait_for(uint16_t want) {
   std::unique_lock<std::mutex> lk(g->mu);
   for (;;) {
     g->cv.wait(lk, [] { return !g->inbox.empty(); });
     Msg m = std::move(g->inbox.front());
     g->inbox.pop_front();
-    if (m.tag == T_TA_ABORT) {
-      int code = (int)m.geti(F_CODE, ADLB_ERROR);
-      fprintf(stderr, "[adlb rank %d] world aborted (code %d)\n", g->rank,
-              code);
-      exit(code == 0 ? 1 : (code < 0 ? -code : code));
-    }
     if (m.tag == want) return m;
-    die("unexpected tag %u while waiting for %u", m.tag, want);
+    dispatch_passive(std::move(m));
   }
 }
 
@@ -792,6 +805,89 @@ int ADLBP_Abort(int code) {
   exit(code == 0 ? 1 : (code < 0 ? -code : code));
 }
 int ADLB_Abort(int code) { return ADLBP_Abort(code); }
+
+// ---- app <-> app messaging (the reference's app_comm: ADLB_Init returns a
+// communicator for direct point-to-point traffic among app ranks, e.g.
+// c1.c's TAG_B_ANSWER flow; here the same fabric carries it as AM_APP
+// frames with a user tag inside) --------------------------------------------
+
+int ADLBP_App_send(int dest_app_rank, void *buf, int len, int apptag) {
+  if (!g) return ADLB_ERROR;
+  if (dest_app_rank < 0 || dest_app_rank >= g->num_app_ranks)
+    die("App_send: %d is not an app rank", dest_app_rank);
+  Encoder e(T_AM_APP, g->rank);
+  e.bytes(F_PAYLOAD, buf, (size_t)len).i(F_APPTAG, apptag);
+  send_msg(dest_app_rank, e);
+  return ADLB_SUCCESS;
+}
+int ADLB_App_send(int d, void *b, int l, int t) {
+  if (!trace_on) return ADLBP_App_send(d, b, l, t);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_App_send(d, b, l, t);
+  trace_call("adlb:app_send", t0);
+  return rc;
+}
+
+// drain frames already delivered while idle; call with g->mu held
+static void drain_inbox_locked() {
+  while (!g->inbox.empty()) {
+    Msg m = std::move(g->inbox.front());
+    g->inbox.pop_front();
+    dispatch_passive(std::move(m));
+  }
+}
+
+int ADLBP_App_iprobe(int *src, int *apptag, int *len) {
+  if (!g) return ADLB_ERROR;
+  std::unique_lock<std::mutex> lk(g->mu);
+  drain_inbox_locked();
+  if (g->app_inbox.empty()) return 0;
+  const Msg &m = g->app_inbox.front();
+  if (src) *src = m.src;
+  if (apptag) *apptag = (int)m.geti(F_APPTAG, 0);
+  if (len) {
+    auto it = m.blobs.find(F_PAYLOAD);
+    *len = it == m.blobs.end() ? 0 : (int)it->second.size();
+  }
+  return 1;
+}
+int ADLB_App_iprobe(int *s_, int *t, int *l) {
+  if (!trace_on) return ADLBP_App_iprobe(s_, t, l);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_App_iprobe(s_, t, l);
+  trace_call("adlb:app_iprobe", t0);
+  return rc;
+}
+
+int ADLBP_App_recv(void *buf, int maxlen, int *src, int *apptag) {
+  if (!g) return ADLB_ERROR;
+  std::unique_lock<std::mutex> lk(g->mu);
+  for (;;) {
+    drain_inbox_locked();
+    if (!g->app_inbox.empty()) break;
+    g->cv.wait(lk, [] { return !g->inbox.empty(); });
+  }
+  Msg m = std::move(g->app_inbox.front());
+  g->app_inbox.pop_front();
+  auto it = m.blobs.find(F_PAYLOAD);
+  int n = it == m.blobs.end() ? 0 : (int)it->second.size();
+  if (n > maxlen)
+    die("App_recv: message of %d bytes exceeds buffer of %d", n, maxlen);
+  if (n > 0) memcpy(buf, it->second.data(), (size_t)n);
+  if (src) *src = m.src;
+  if (apptag) *apptag = (int)m.geti(F_APPTAG, 0);
+  return n;
+}
+int ADLB_App_recv(void *b, int m, int *s_, int *t) {
+  if (!trace_on) return ADLBP_App_recv(b, m, s_, t);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_App_recv(b, m, s_, t);
+  trace_call("adlb:app_recv", t0);
+  return rc;
+}
 
 int ADLB_World_rank(void) { return g ? g->rank : -1; }
 int ADLB_World_size(void) { return g ? g->nranks : -1; }

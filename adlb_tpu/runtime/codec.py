@@ -62,11 +62,9 @@ WIRE_TAG: dict[Tag, int] = {
     # client refuses it toward native servers)
     Tag.FA_CHECKPOINT: 1048,
     Tag.TA_CHECKPOINT_RESP: 1049,
-    # app<->app point-to-point (the reference's app_comm traffic). The id
-    # exists so the codec stays total, but native C clients have no
-    # app-messaging API yet, so encodable() refuses AM_APP — a Python rank
-    # app_send-ing to a native rank gets a clear error instead of killing
-    # the C client with an unknown tag.
+    # app<->app point-to-point (the reference's app_comm traffic; native
+    # clients receive it via ADLB_App_recv — bytes payloads only, enforced
+    # by encodable())
     Tag.AM_APP: 1047,
     # server<->server + balancer + debug tags (Python<->Python, normally
     # pickled; ids exist so the codec is total)
@@ -158,9 +156,10 @@ def encodable(m: Msg) -> bool:
     """True if every field of m has a binary field id (None values are
     encoded by omission)."""
     if m.tag is Tag.AM_APP:
-        # the native client library has no app-receive API (and arbitrary
-        # Python payloads don't survive the bytes-only TLV form)
-        return False
+        # native clients receive app messages via ADLB_App_recv, but only
+        # raw bytes survive the TLV form — arbitrary Python payloads would
+        # silently corrupt, so they are refused with a clear error
+        return isinstance(m.data.get("payload"), (bytes, bytearray))
     return all(k in FIELDS for k, v in m.data.items() if v is not None)
 
 

@@ -1,0 +1,71 @@
+/* App-to-app messaging smoke: the c1.c pattern in C against this
+ * framework — answers travel OUTSIDE the pool as direct app messages
+ * (reference examples/c1.c ships B/C answers with MPI_Send on app_comm;
+ * here ADLB_App_send/App_recv play that role).
+ *
+ * Rank 0 puts NJOBS numbered units and then blocks in App_recv collecting
+ * one squared answer per unit; workers reserve units, square the value,
+ * and App_send the result tagged TAG_ANS back to rank 0.  Rank 0 checks
+ * the sum of squares and declares the problem done.  Exit 0 = all checks
+ * passed.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define NJOBS 18
+#define TAG_ANS 7
+
+int main(void) {
+  int types[1] = {WORK};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) return 2;
+  int me = ADLB_World_rank();
+
+  if (me == 0) {
+    long expect = 0;
+    for (int i = 1; i <= NJOBS; i++) {
+      rc = ADLB_Put(&i, sizeof i, -1, 0, WORK, 0);
+      if (rc != ADLB_SUCCESS) return 3;
+      expect += (long)i * i;
+    }
+    long sum = 0;
+    for (int k = 0; k < NJOBS; k++) {
+      long v;
+      int src = -1, tag = -1;
+      int n = ADLB_App_recv(&v, sizeof v, &src, &tag);
+      if (n != sizeof v || tag != TAG_ANS) return 4;
+      sum += v;
+    }
+    ADLB_Set_problem_done();
+    if (sum != expect) {
+      fprintf(stderr, "appmsg: sum %ld != expected %ld\n", sum, expect);
+      return 5;
+    }
+    printf("appmsg rank 0 sum %ld OK\n", sum);
+  } else {
+    int handled = 0;
+    for (;;) {
+      int req[2] = {WORK, ADLB_RESERVE_EOL};
+      int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+      rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+      if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / exhaustion */
+      int v;
+      rc = ADLB_Get_reserved(&v, handle);
+      if (rc != ADLB_SUCCESS) break;
+      long ans = (long)v * v;
+      rc = ADLB_App_send(ar, &ans, sizeof ans, TAG_ANS);
+      if (rc != ADLB_SUCCESS) return 6;
+      handled++;
+    }
+    printf("appmsg rank %d handled %d\n", me, handled);
+  }
+  ADLB_Finalize();
+  return 0;
+}

@@ -45,6 +45,29 @@ def test_capi_smoke(mode):
     assert total_processed == 24
 
 
+@pytest.mark.parametrize("server_impl", ["python", "native"])
+def test_capi_app_messaging(server_impl):
+    """The c1.c pattern in C: answers as direct app-to-app messages
+    (ADLB_App_send/App_recv, the reference's app_comm role) — against both
+    server implementations."""
+    exe = build_example(os.path.join(_EXAMPLES, "appmsg_c.c"))
+    results, _ = run_native_world(
+        n_clients=3,
+        nservers=2,
+        types=[1],
+        exe=exe,
+        cfg=Config(server_impl=server_impl, exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    handled = 0
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+        if "handled" in out:
+            handled += int(out.split("handled")[1].split()[0])
+    assert handled == 18
+    assert any("sum" in out and "OK" in out for _, out, _ in results)
+
+
 def test_capi_trace_files(tmp_path):
     """ADLB_TRACE arms the C client's profiling wrapper layer (the
     reference's MPE hooks, src/adlb_prof.c): per-call spans + inferred
