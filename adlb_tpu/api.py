@@ -64,8 +64,30 @@ class AdlbContext:
     def get_reserved(self, handle: WorkHandle):
         return self._c.get_reserved(handle)
 
+    def get_work(self, req_types: Optional[Sequence[int]] = None):
+        """Fused blocking reserve+get: one round trip when the unit is local
+        and prefix-free (no reference analogue)."""
+        return self._c.get_work(req_types)
+
     def get_reserved_timed(self, handle: WorkHandle):
         return self._c.get_reserved_timed(handle)
+
+    def iput(
+        self,
+        payload: bytes,
+        work_type: int,
+        work_prio: int = 0,
+        target_rank: int = -1,
+        answer_rank: int = -1,
+    ) -> int:
+        """Pipelined put (no reference analogue): streams the request and
+        settles accept/reject at flush_puts(). A producer is then bounded by
+        bandwidth, not one round trip per unit."""
+        return self._c.iput(payload, work_type, work_prio, target_rank,
+                            answer_rank)
+
+    def flush_puts(self) -> int:
+        return self._c.flush_puts()
 
     def begin_batch_put(self, common_buf: bytes) -> int:
         return self._c.begin_batch_put(common_buf)

@@ -192,6 +192,8 @@ enum FieldId : uint8_t {
   F_WQ_COUNT = 54,        // i64 (DS_LOG heartbeat)
   F_RQ_COUNT = 55,        // i64 (DS_LOG heartbeat)
   F_QM_TABLE = 56,        // list: (rank, nbytes, qlen, prio[T])* ring token
+  F_PUT_ID = 58,          // i64: pipelined-put id echoed in TA_PUT_RESP
+  F_FETCH = 59,           // i64: fused reserve+get request (get_work)
   F_PSTATS_BLOB = 57,     // bytes: packed periodic-stats ring token entries
 };
 
@@ -589,6 +591,7 @@ struct RqEntry {
   bool any_type;
   std::vector<int32_t> req_types;  // sorted when !any_type
   double time_stamp;
+  bool fetch = false;  // fused reserve+get (this framework's extension)
 
   bool wants(int32_t t) const {
     if (any_type) return true;
@@ -723,6 +726,19 @@ class Server {
     return n;
   }
 
+  // remove a unit and its metadata from the queue, returning the Meta
+  // (payload + bookkeeping); shared by Get_reserved and the fused path
+  Meta consume_unit(int64_t seqno) {
+    Meta meta = std::move(meta_[seqno]);
+    meta_.erase(seqno);
+    auto it = wq_.units.find(seqno);
+    wq_.total_bytes -= it->second.payload_len;
+    wq_.units.erase(it);
+    wq_.count -= 1;
+    mem_free(int64_t(meta.payload.size()));
+    return meta;
+  }
+
   RqEntry* rq_find_rank(int world_rank) {
     for (auto& e : rq_)
       if (e.world_rank == world_rank) return &e;
@@ -760,8 +776,23 @@ class Server {
   }
 
   void reserve_resp_ok(int app, const adlbwq::Unit& u, const Meta& meta,
-                       int holder) {
+                       int holder, bool fetch = false) {
     resolved_ctr_ += 1;
+    if (fetch && holder == rank_ && meta.common_len == 0) {
+      // fused reserve+get (no reference analogue): local prefix-free unit,
+      // consume now and inline the payload in the reservation response
+      NMsg r = mk(T_TA_RESERVE_RESP);
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.seti(F_WORK_TYPE, u.work_type);
+      r.seti(F_PRIO, u.prio);
+      r.seti(F_WORK_LEN, u.payload_len);
+      r.seti(F_ANSWER_RANK, meta.answer_rank);
+      Meta m2 = consume_unit(u.seqno);
+      r.setd(F_TIME_ON_Q, monotonic() - m2.time_stamp);
+      r.setb(F_PAYLOAD, std::move(m2.payload));
+      ep_->send(app, r);
+      return;
+    }
     NMsg r = mk(T_TA_RESERVE_RESP);
     r.seti(F_RC, ADLB_SUCCESS);
     r.seti(F_WORK_TYPE, u.work_type);
@@ -776,13 +807,14 @@ class Server {
   void satisfy_parked(const RqEntry& e, const adlbwq::Unit& u,
                       const Meta& meta) {
     int app = e.world_rank;
+    bool fetch = e.fetch;
     double wait = monotonic() - e.time_stamp;
     rq_remove(app);
     rfr_excluded_.erase(app);
     rq_wait_sum_ += wait;
     rq_wait_n_ += 1;
     activity_ += 1;
-    reserve_resp_ok(app, u, meta, rank_);
+    reserve_resp_ok(app, u, meta, rank_, fetch);
   }
 
   void match_rq() {
@@ -894,9 +926,15 @@ class Server {
   // ---- app handlers (reference src/adlb.c:889-1383) -----------------------
   void on_put(const NMsg& m) {
     puts_ctr_ += 1;
+    bool has_pid = m.has(F_PUT_ID);
+    int64_t pid = m.geti(F_PUT_ID);
+    auto echo_pid = [&](NMsg& r) {
+      if (has_pid) r.seti(F_PUT_ID, pid);
+    };
     if (no_more_work_ || done_by_exhaustion_) {
       NMsg r = mk(T_TA_PUT_RESP);
       r.seti(F_RC, ADLB_NO_MORE_WORK);
+      echo_pid(r);
       ep_->send(m.src, r);
       return;
     }
@@ -908,6 +946,7 @@ class Server {
       NMsg r = mk(T_TA_PUT_RESP);
       r.seti(F_RC, ADLB_PUT_REJECTED);
       r.seti(F_HINT, least_loaded_peer(int64_t(payload->size())));
+      echo_pid(r);
       ep_->send(m.src, r);
       return;
     }
@@ -938,6 +977,7 @@ class Server {
     }
     NMsg r = mk(T_TA_PUT_RESP);
     r.seti(F_RC, ADLB_SUCCESS);
+    echo_pid(r);
     ep_->send(m.src, r);
     if (e == nullptr) maybe_event_snapshot();
   }
@@ -997,6 +1037,7 @@ class Server {
     if (types != nullptr)
       for (int64_t t : *types) e.req_types.push_back(int32_t(t));
     e.time_stamp = monotonic();
+    e.fetch = m.geti(F_FETCH, 0) != 0;
     if (no_more_work_) { reserve_resp_fail(app, ADLB_NO_MORE_WORK); return; }
     if (done_by_exhaustion_) {
       reserve_resp_fail(app, ADLB_DONE_BY_EXHAUSTION);
@@ -1007,7 +1048,7 @@ class Server {
       int64_t seqno = u->seqno;
       wq_.units[seqno].pin_rank = app;
       activity_ += 1;
-      reserve_resp_ok(app, wq_.units[seqno], meta_[seqno], rank_);
+      reserve_resp_ok(app, wq_.units[seqno], meta_[seqno], rank_, e.fetch);
       return;
     }
     if (m.geti(F_HANG, 0) == 0) {
@@ -1028,12 +1069,7 @@ class Server {
     if (it == wq_.units.end() || it->second.pin_rank != m.src)
       die("invalid GET_RESERVED seqno %lld from rank %d",
           (long long)seqno, m.src);  // reference aborts too (src/adlb.c:1349)
-    Meta meta = std::move(meta_[seqno]);
-    meta_.erase(seqno);
-    wq_.total_bytes -= it->second.payload_len;
-    wq_.units.erase(it);
-    wq_.count -= 1;
-    mem_free(int64_t(meta.payload.size()));
+    Meta meta = consume_unit(seqno);
     NMsg r = mk(T_TA_GET_RESERVED_RESP);
     r.seti(F_RC, ADLB_SUCCESS);
     r.setb(F_PAYLOAD, std::move(meta.payload));

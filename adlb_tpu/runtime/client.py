@@ -34,10 +34,12 @@ from adlb_tpu.runtime.transport import Endpoint
 from adlb_tpu.runtime.world import Config, WorldSpec, normalize_req_types
 from adlb_tpu.types import (
     ADLB_NO_CURRENT_WORK,
+    ADLB_NO_MORE_WORK,
     ADLB_PUT_REJECTED,
     ADLB_SUCCESS,
     AdlbAborted,
     AdlbError,
+    GotWork,
     ReserveResult,
     WorkHandle,
 )
@@ -74,6 +76,12 @@ class Client:
         # communicator, so it can never be confused with ADLB's tags; here
         # one fabric carries both, so AM_APP frames are stashed)
         self._app_inbox: list[Msg] = []
+        # pipelined puts (iput): put_id -> request args, awaiting a
+        # TA_PUT_RESP that may arrive out of band
+        self._next_put_id = 1
+        self._pending_puts: dict[int, dict] = {}
+        self._failed_puts = 0
+        self._failed_nmw = False
 
     def _span(self, name: str, **args):
         """API-call trace span + user-state inference boundary."""
@@ -89,6 +97,19 @@ class Client:
         self._rr = (self._rr + 1) % self.world.nservers
         return s
 
+    def _route_put(self, target_rank: int) -> int:
+        """Initial server for a put (reference src/adlb.c:2767-2773)."""
+        if target_rank >= 0:
+            return self.world.home_server(target_rank)
+        if self.cfg.put_routing == "home":
+            return self.home
+        return self._next_server()
+
+    def _retry_server(self, hint) -> int:
+        """Where a rejected put retries: the rejecting server's least-loaded
+        hint, else round-robin (reference src/adlb.c:2779-2796)."""
+        return hint if hint is not None and hint >= 0 else self._next_server()
+
     def _wait(self, want: Tag) -> Msg:
         while True:
             if self._abort_event is not None and self._abort_event.is_set():
@@ -97,7 +118,12 @@ class Client:
             m = self.ep.recv(timeout=0.5)
             if m is None:
                 continue
-            if m.tag is want:
+            if m.tag is want and not (
+                m.tag is Tag.TA_PUT_RESP
+                and m.data.get("put_id") in self._pending_puts
+            ):
+                # (the guard keeps an out-of-band pipelined-put response
+                # from answering a synchronous put)
                 return m
             # A late RESERVE_RESP can cross a termination flush only if the
             # origin server double-responded, which the rq discipline forbids.
@@ -134,12 +160,7 @@ class Client:
         if common is not None:
             common.refcnt += 1
 
-        if target_rank >= 0:
-            server = self.world.home_server(target_rank)
-        elif self.cfg.put_routing == "home":
-            server = self.home
-        else:
-            server = self._next_server()
+        server = self._route_put(target_rank)
         attempts = 0
         while True:
             self.ep.send(
@@ -166,8 +187,7 @@ class Client:
                 if common is not None:
                     common.refcnt -= 1
                 return ADLB_PUT_REJECTED
-            hint = resp.data.get("hint", -1)
-            server = hint if hint >= 0 else self._next_server()
+            server = self._retry_server(resp.data.get("hint"))
             time.sleep(self.cfg.put_retry_sleep)
         if rc != ADLB_SUCCESS and common is not None:
             common.refcnt -= 1  # unit never stored; keep prefix GC reachable
@@ -329,6 +349,58 @@ class Client:
         rc, buf, _ = self.get_reserved_timed(handle)
         return rc, buf
 
+    def get_work(
+        self, req_types: Optional[Sequence[int]] = None
+    ) -> tuple[int, Optional[GotWork]]:
+        """Fused blocking reserve+get (no reference analogue — upstream
+        always pays a second round trip for the payload, reference
+        ``src/adlb.c:2976-3025``). When the matched unit is local to the
+        responding server and has no batch-common prefix, the payload rides
+        the reservation response; otherwise this transparently falls back
+        to the handle + Get_reserved path (remote holders, prefixed
+        units)."""
+        with self._span("adlb:get_work"):
+            types = normalize_req_types(req_types, self.world.types)
+            self._rqseqno += 1
+            self.ep.send(
+                self.home,
+                msg(
+                    Tag.FA_RESERVE,
+                    self.rank,
+                    req_types=None if types is None else sorted(types),
+                    hang=True,
+                    rqseqno=self._rqseqno,
+                    fetch=True,
+                ),
+            )
+            resp = self._wait(Tag.TA_RESERVE_RESP)
+            if resp.rc != ADLB_SUCCESS:
+                return resp.rc, None
+            if "payload" in resp.data:  # fused: already consumed
+                got = GotWork(
+                    work_type=resp.work_type,
+                    work_prio=resp.prio,
+                    payload=resp.payload,
+                    answer_rank=resp.answer_rank,
+                    time_on_q=resp.data.get("time_on_q", 0.0),
+                )
+                if self.tracer is not None:
+                    self.tracer.got_work(got.work_type)
+                return ADLB_SUCCESS, got
+            handle = WorkHandle.from_ints(resp.handle)
+            rc, buf, t_q = self._get_reserved_timed(handle)
+            if rc != ADLB_SUCCESS:
+                return rc, None
+            if self.tracer is not None:
+                self.tracer.got_work(resp.work_type)
+            return ADLB_SUCCESS, GotWork(
+                work_type=resp.work_type,
+                work_prio=resp.prio,
+                payload=buf,
+                answer_rank=resp.answer_rank,
+                time_on_q=t_q,
+            )
+
     # -- app <-> app messaging (the reference's app_comm) ---------------------
     #
     # ADLB_Init returns an app-ranks-only communicator on which applications
@@ -407,15 +479,139 @@ class Client:
 
     def _dispatch_passive(self, m: Msg, waiting: Optional[Tag] = None) -> None:
         """Handle a message that is not the awaited response: abort frames
-        raise, app messages are stashed, anything else is a protocol error."""
+        raise, app messages are stashed, pipelined-put responses are
+        settled, anything else is a protocol error."""
         if m.tag is Tag.TA_ABORT:
             self.aborted = True
             raise AdlbAborted(m.data.get("code", -1))
         if m.tag is Tag.AM_APP:
             self._app_inbox.append(m)
             return
+        if (
+            m.tag is Tag.TA_PUT_RESP
+            and m.data.get("put_id") in self._pending_puts
+        ):
+            self._settle_put(m)
+            return
         ctx = f" while waiting {waiting}" if waiting is not None else ""
         raise AdlbError(f"rank {self.rank}: unexpected {m.tag}{ctx}")
+
+    # -- pipelined puts -------------------------------------------------------
+    #
+    # No reference analogue: upstream's Put is a synchronous two-phase
+    # exchange per unit (reference src/adlb.c:2811-2843), which caps a
+    # producer at one network round trip per unit. iput() streams requests
+    # with a client-chosen put_id echoed in the response; flush_puts()
+    # settles them, replaying rejects at the hinted server like the
+    # synchronous retry loop.
+
+    def iput(
+        self,
+        payload: bytes,
+        work_type: int,
+        work_prio: int = 0,
+        target_rank: int = -1,
+        answer_rank: int = -1,
+    ) -> int:
+        """Asynchronous put: returns ADLB_SUCCESS when queued locally; the
+        accept/reject outcome settles at :meth:`flush_puts`. Not usable
+        inside a batch-common region (the prefix refcount must be exact)."""
+        if self._batch is not None:
+            raise AdlbError("iput inside begin_batch_put is not supported")
+        if not self.world.validate_type(work_type):
+            raise AdlbError(f"unregistered work type {work_type}")
+        if target_rank >= 0 and not self.world.is_app(target_rank):
+            raise AdlbError(f"target rank {target_rank} is not an app rank")
+        # opportunistically settle responses already delivered, so a pure
+        # producer loop's pending map (payload copies!) and the transport
+        # queue stay bounded by in-flight work, not the whole stream
+        while True:
+            m = self.ep.recv(timeout=0.0)
+            if m is None:
+                break
+            self._dispatch_passive(m)
+        server = self._route_put(target_rank)
+        put_id = self._next_put_id
+        self._next_put_id += 1
+        req = dict(
+            payload=bytes(payload), work_type=work_type, prio=work_prio,
+            target_rank=target_rank, answer_rank=answer_rank,
+            attempts=0, server=server,
+        )
+        self._pending_puts[put_id] = req
+        self._send_iput(put_id, req)
+        return ADLB_SUCCESS
+
+    def _send_iput(self, put_id: int, req: dict) -> None:
+        self.ep.send(
+            req["server"],
+            msg(
+                Tag.FA_PUT,
+                self.rank,
+                payload=req["payload"],
+                work_type=req["work_type"],
+                prio=req["prio"],
+                target_rank=req["target_rank"],
+                answer_rank=req["answer_rank"],
+                common_len=0,
+                common_server=-1,
+                common_seqno=-1,
+                put_id=put_id,
+            ),
+        )
+
+    def _settle_put(self, m: Msg) -> None:
+        put_id = m.put_id
+        req = self._pending_puts[put_id]
+        rc = m.rc
+        if rc == ADLB_PUT_REJECTED:
+            req["attempts"] += 1
+            if req["attempts"] <= self.cfg.put_max_retries:
+                req["server"] = self._retry_server(m.data.get("hint"))
+                # same pacing as the synchronous retry loop: without it all
+                # retries burn in a few RTTs while consumers are still
+                # draining the full servers
+                time.sleep(self.cfg.put_retry_sleep)
+                self._send_iput(put_id, req)
+                return
+        del self._pending_puts[put_id]
+        if rc != ADLB_SUCCESS:
+            self._failed_puts += 1
+            if rc == ADLB_NO_MORE_WORK:
+                # termination, not capacity: the producer must see it
+                self._failed_nmw = True
+            return
+        target = req["target_rank"]
+        if target >= 0 and req["server"] != self.world.home_server(target):
+            self.ep.send(
+                self.world.home_server(target),
+                msg(
+                    Tag.FA_DID_PUT_AT_REMOTE,
+                    self.rank,
+                    target_rank=target,
+                    work_type=req["work_type"],
+                    server_rank=req["server"],
+                ),
+            )
+
+    def flush_puts(self) -> int:
+        """Settle every outstanding iput. Returns ADLB_SUCCESS when all were
+        accepted; ADLB_NO_MORE_WORK when any failed because the world
+        terminated (the producer's stop signal, like the synchronous put's
+        rc); else ADLB_PUT_REJECTED for capacity failures after retries."""
+        while self._pending_puts:
+            if self._abort_event is not None and self._abort_event.is_set():
+                self.aborted = True
+                raise AdlbAborted(-1)
+            m = self.ep.recv(timeout=0.5)
+            if m is None:
+                continue
+            self._dispatch_passive(m)
+        failed, self._failed_puts = self._failed_puts, 0
+        nmw, self._failed_nmw = self._failed_nmw, False
+        if nmw:
+            return ADLB_NO_MORE_WORK
+        return ADLB_PUT_REJECTED if failed else ADLB_SUCCESS
 
     # -- control -------------------------------------------------------------
 
@@ -462,9 +658,23 @@ class Client:
     def finalize(self) -> int:
         if self.tracer is not None:
             self.tracer.api_entry()  # close any open inferred user span
+        rc = ADLB_SUCCESS
         if not self.aborted:
+            if self._pending_puts:
+                # un-settled pipelined puts must land before LOCAL_APP_DONE
+                # or the shutdown ring could outrun them; a terminal failure
+                # here must not vanish silently
+                rc = self.flush_puts()
+                if rc not in (ADLB_SUCCESS, ADLB_NO_MORE_WORK):
+                    import sys
+
+                    print(
+                        f"[adlb rank {self.rank}] finalize: pipelined puts "
+                        f"terminally rejected (rc={rc})",
+                        file=sys.stderr,
+                    )
             self.ep.send(self.home, msg(Tag.FA_LOCAL_APP_DONE, self.rank))
-        return ADLB_SUCCESS
+        return rc
 
     def abort(self, code: int) -> None:
         """Bring the whole world down (reference ADLB_Abort,

@@ -142,6 +142,12 @@ FIELDS: dict[str, tuple[int, int]] = {
     # native server -> Python debug server heartbeats (DS_LOG)
     "wq_count": (54, _KIND_I64),
     "rq_count": (55, _KIND_I64),
+    # pipelined puts: client-chosen id echoed in TA_PUT_RESP so responses
+    # can arrive out of band (iput/flush_puts)
+    "put_id": (58, _KIND_I64),
+    # fused reserve+get (get_work): payload rides TA_RESERVE_RESP when the
+    # unit is local and prefix-free
+    "fetch": (59, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

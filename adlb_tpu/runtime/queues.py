@@ -254,12 +254,16 @@ class WorkQueue:
 
 @dataclasses.dataclass
 class RqEntry:
-    """A parked (blocking) Reserve waiting for work (reference ``src/xq.h:58-64``)."""
+    """A parked (blocking) Reserve waiting for work (reference
+    ``src/xq.h:58-64``). ``fetch`` marks a fused reserve+get (this
+    framework's extension): when the match is local and prefix-free the
+    payload rides the response."""
 
     world_rank: int
     rqseqno: int
     req_types: Optional[frozenset[int]]  # None = any
     time_stamp: float = dataclasses.field(default_factory=time.monotonic)
+    fetch: bool = False
 
     def wants(self, work_type: int) -> bool:
         return self.req_types is None or work_type in self.req_types

@@ -416,12 +416,38 @@ class Server:
 
     def _reserve_resp(
         self, app_rank: int, rc: int, unit: Optional[WorkUnit] = None,
-        holder: Optional[int] = None,
+        holder: Optional[int] = None, fetch: bool = False,
     ) -> None:
         if rc != ADLB_SUCCESS:
             self.ep.send(app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc))
             return
         self.resolved_reserves += 1
+        if (
+            fetch
+            and (holder is None or holder == self.rank)
+            and unit.common_len == 0
+        ):
+            # fused reserve+get (no reference analogue — upstream always
+            # pays a second round trip, src/adlb.c:2976-3025): the unit is
+            # local and prefix-free, so consume it now and inline the
+            # payload in the reservation response
+            self.wq.remove(unit.seqno)
+            self.mem.free(len(unit.payload))
+            self.ep.send(
+                app_rank,
+                msg(
+                    Tag.TA_RESERVE_RESP,
+                    self.rank,
+                    rc=ADLB_SUCCESS,
+                    work_type=unit.work_type,
+                    prio=unit.prio,
+                    work_len=unit.work_len,
+                    answer_rank=unit.answer_rank,
+                    payload=unit.payload,
+                    time_on_q=time.monotonic() - unit.time_stamp,
+                ),
+            )
+            return
         handle = WorkHandle(
             seqno=unit.seqno,
             server_rank=holder if holder is not None else self.rank,
@@ -493,7 +519,8 @@ class Server:
         self._rq_wait_sum += wait
         self._rq_wait_n += 1
         self.activity += 1
-        self._reserve_resp(entry.world_rank, ADLB_SUCCESS, unit, holder=holder)
+        self._reserve_resp(entry.world_rank, ADLB_SUCCESS, unit,
+                           holder=holder, fetch=entry.fetch)
 
     def _match_rq(self) -> None:
         """Re-scan parked requesters against the local queue — run after any
@@ -609,9 +636,13 @@ class Server:
 
     def _on_put(self, m: Msg) -> None:
         self._ds_counters["puts"] += 1
+        # pipelined puts (iput) tag each request; the id is echoed so the
+        # client can match out-of-band responses
+        put_id = m.data.get("put_id")
         if self.no_more_work or self.done_by_exhaustion:
             self.ep.send(
-                m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_NO_MORE_WORK)
+                m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_NO_MORE_WORK,
+                           put_id=put_id)
             )
             return
         payload: bytes = m.payload
@@ -628,6 +659,7 @@ class Server:
                     self.rank,
                     rc=ADLB_PUT_REJECTED,
                     hint=self._least_loaded_peer(len(payload)),
+                    put_id=put_id,
                 ),
             )
             return
@@ -656,7 +688,10 @@ class Server:
         if entry is not None:
             self.wq.pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
-        self.ep.send(m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS))
+        self.ep.send(
+            m.src,
+            msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS, put_id=put_id),
+        )
         if entry is None and self.cfg.balancer == "tpu":
             # event-driven like parks: new unmatched inventory refreshes the
             # balancer's view immediately (rate-limited), so a requester
@@ -707,17 +742,19 @@ class Server:
         if self.done_by_exhaustion:
             self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION)
             return
+        fetch = bool(m.data.get("fetch", False))
         unit = self.wq.find_match(app, req_types)
         if unit is not None:
             self.wq.pin(unit.seqno, app)
             self.activity += 1
-            self._reserve_resp(app, ADLB_SUCCESS, unit)
+            self._reserve_resp(app, ADLB_SUCCESS, unit, fetch=fetch)
             return
         if not m.hang:
             self._reserve_resp(app, ADLB_NO_CURRENT_WORK)
             return
         self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ] += 1
-        entry = RqEntry(world_rank=app, rqseqno=m.rqseqno, req_types=req_types)
+        entry = RqEntry(world_rank=app, rqseqno=m.rqseqno,
+                        req_types=req_types, fetch=fetch)
         self.rq.add(entry)
         self._rfr_excluded.pop(app, None)
         self._try_rfr(entry)

@@ -85,6 +85,18 @@ class ReserveResult:
     answer_rank: int
 
 
+@dataclasses.dataclass(frozen=True)
+class GotWork:
+    """A fused reserve+get result (this framework's extension): the unit is
+    already consumed — no handle, no second round trip."""
+
+    work_type: int
+    work_prio: int
+    payload: bytes
+    answer_rank: int
+    time_on_q: float
+
+
 class AdlbError(RuntimeError):
     """Raised for API misuse (invalid type, invalid handle, ...)."""
 

@@ -1,0 +1,134 @@
+"""Pipelined puts (iput/flush_puts) — a throughput extension with no
+reference analogue (upstream's Put is one synchronous round trip per
+unit)."""
+
+import struct
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_PUT_REJECTED, ADLB_SUCCESS
+
+T = 1
+
+
+def _producer_consumer(ctx):
+    if ctx.rank == 0:
+        for i in range(200):
+            assert ctx.iput(struct.pack("<q", i), T, work_prio=i % 7) \
+                == ADLB_SUCCESS
+        assert ctx.flush_puts() == ADLB_SUCCESS
+    got = []
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return got
+        rc, buf = ctx.get_reserved(r.handle)
+        got.append(struct.unpack("<q", buf)[0])
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_iput_conservation(mode):
+    cfg = Config(balancer=mode, exhaust_check_interval=0.2,
+                 balancer_max_tasks=256, balancer_max_requesters=16)
+    res = run_world(4, 2, [T], _producer_consumer, cfg=cfg)
+    got = sorted(x for v in res.app_results.values() for x in (v or []))
+    assert got == list(range(200))
+
+
+def test_iput_mixed_with_sync_put_and_reserve():
+    """Out-of-band put responses must never answer a synchronous put, and
+    reserves interleave safely with unsettled iputs."""
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(50):
+                ctx.iput(struct.pack("<q", i), T)
+            # sync put while 50 responses are in flight
+            assert ctx.put(struct.pack("<q", 999), T) == ADLB_SUCCESS
+            # reserve while still unsettled
+            rc, r = ctx.reserve([T])
+            assert rc == ADLB_SUCCESS
+            ctx.get_reserved(r.handle)
+            assert ctx.flush_puts() == ADLB_SUCCESS
+        got = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return got
+            ctx.get_reserved(r.handle)
+            got += 1
+
+    res = run_world(3, 2, [T], app, cfg=Config(exhaust_check_interval=0.2))
+    total = sum(v if isinstance(v, int) else 0 for v in res.app_results.values())
+    assert total + 1 == 51  # 50 iputs + 1 sync put, one consumed by rank 0
+
+
+def test_iput_rejects_settle_at_flush():
+    """With a tiny per-server cap and no consumers until flush, some iputs
+    must terminally reject after retries — reported by flush_puts."""
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(20):
+                ctx.iput(b"x" * 1024, T)
+            rc = ctx.flush_puts()
+            ctx.set_problem_done()
+            return rc
+        rc, _ = ctx.reserve([2])  # park on an unused type
+        assert rc != ADLB_SUCCESS
+        return None
+
+    res = run_world(
+        2, 2, [T, 2], app,
+        cfg=Config(max_malloc_per_server=4096, put_max_retries=2,
+                   exhaust_check_interval=10.0),
+    )
+    # 20 KB offered into 8 KB of capacity: flush must report rejections
+    assert res.app_results[0] == ADLB_PUT_REJECTED
+
+
+def test_iput_flush_reports_no_more_work():
+    """Termination, not capacity: a producer whose pipelined puts land
+    after set_problem_done must see ADLB_NO_MORE_WORK (its stop signal),
+    not a capacity rejection."""
+    import time
+
+    from adlb_tpu.types import ADLB_NO_MORE_WORK
+
+    def app(ctx):
+        if ctx.rank == 1:
+            ctx.set_problem_done()
+            return None
+        time.sleep(0.3)  # let NO_MORE_WORK propagate to the servers
+        for i in range(5):
+            ctx.iput(struct.pack("<q", i), T)
+        return ctx.flush_puts()
+
+    res = run_world(2, 2, [T], app, cfg=Config(exhaust_check_interval=10.0))
+    assert res.app_results[0] == ADLB_NO_MORE_WORK
+
+
+def test_iput_native_servers():
+    cfg = Config(server_impl="native", exhaust_check_interval=0.2)
+    res = spawn_world(4, 2, [T], _producer_consumer, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in (v or []))
+    assert got == list(range(200))
+
+
+def test_iput_inside_batch_refused():
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.begin_batch_put(b"pfx")
+            with pytest.raises(Exception, match="iput inside"):
+                ctx.iput(b"x", T)
+            ctx.end_batch_put()
+            ctx.set_problem_done()
+        else:
+            rc, _ = ctx.reserve([2])
+            assert rc != ADLB_SUCCESS
+        return None
+
+    run_world(2, 1, [T, 2], app, cfg=Config(exhaust_check_interval=10.0))
