@@ -90,6 +90,8 @@ enum Field : uint8_t {
   F_KEY = 24,
   F_VALUE = 25,
   F_APPTAG = 26,
+  F_PUT_ID = 58,
+  F_FETCH = 59,
 };
 
 enum Kind : uint8_t { K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3 };
@@ -354,6 +356,62 @@ void send_msg(int dest, Encoder &enc) {
 // Blocks until a frame with `want` arrives.  TA_ABORT terminates the process
 // (the reference client dies inside MPI_Abort in the same situation,
 // reference src/adlb.c:3165-3176).
+// ---- pipelined puts (iput; no reference analogue — upstream's Put is one
+// synchronous round trip per unit, src/adlb.c:2811-2843). Requests carry a
+// put_id echoed in the response; settle out of band, replaying rejects at
+// the hinted server with the synchronous path's pacing. ------------------
+int home_server(int app_rank);
+int next_server();
+
+struct PendingPut {
+  std::string payload;
+  int work_type, prio, target_rank, answer_rank, attempts, server;
+};
+static std::map<int64_t, PendingPut> pending_puts;
+static int64_t next_put_id = 1;
+static int failed_puts = 0;
+static bool failed_nmw = false;
+
+static void send_iput(int64_t id, const PendingPut &pp) {
+  Encoder e(T_FA_PUT, g->rank);
+  e.bytes(F_PAYLOAD, pp.payload.data(), pp.payload.size())
+      .i(F_WORK_TYPE, pp.work_type)
+      .i(F_PRIO, pp.prio)
+      .i(F_TARGET_RANK, pp.target_rank)
+      .i(F_ANSWER_RANK, pp.answer_rank)
+      .i(F_COMMON_LEN, 0)
+      .i(F_COMMON_SERVER, -1)
+      .i(F_COMMON_SEQNO, -1)
+      .i(F_PUT_ID, id);
+  send_msg(pp.server, e);
+}
+
+static void settle_put(const Msg &m) {  // called with g->mu held
+  int64_t id = m.geti(F_PUT_ID);
+  auto it = pending_puts.find(id);
+  if (it == pending_puts.end()) return;
+  int rc = (int)m.geti(F_RC);
+  if (rc == ADLB_PUT_REJECTED && ++it->second.attempts <= 10) {
+    int hint = (int)m.geti(F_HINT, -1);
+    it->second.server = hint >= 0 ? hint : next_server();
+    usleep(2000);  // pace like the synchronous retry loop
+    send_iput(id, it->second);
+    return;
+  }
+  if (rc != ADLB_SUCCESS) {
+    failed_puts++;
+    if (rc == ADLB_NO_MORE_WORK) failed_nmw = true;
+  } else if (it->second.target_rank >= 0 &&
+             it->second.server != home_server(it->second.target_rank)) {
+    Encoder e(T_FA_DID_PUT_AT_REMOTE, g->rank);
+    e.i(F_TARGET_RANK, it->second.target_rank)
+        .i(F_WORK_TYPE, it->second.work_type)
+        .i(F_SERVER_RANK, it->second.server);
+    send_msg(home_server(it->second.target_rank), e);
+  }
+  pending_puts.erase(it);
+}
+
 // Handle a frame that is not an awaited protocol response: abort frames
 // terminate, app_comm traffic is stashed, anything else is fatal.
 void dispatch_passive(Msg m) {
@@ -367,6 +425,10 @@ void dispatch_passive(Msg m) {
     g->app_inbox.push_back(std::move(m));
     return;
   }
+  if (m.tag == T_TA_PUT_RESP && m.ints.count(F_PUT_ID)) {
+    settle_put(m);
+    return;
+  }
   die("unexpected tag %u outside a pending request", m.tag);
 }
 
@@ -376,7 +438,9 @@ Msg wait_for(uint16_t want) {
     g->cv.wait(lk, [] { return !g->inbox.empty(); });
     Msg m = std::move(g->inbox.front());
     g->inbox.pop_front();
-    if (m.tag == want) return m;
+    if (m.tag == want &&
+        !(m.tag == T_TA_PUT_RESP && m.ints.count(F_PUT_ID)))
+      return m;
     dispatch_passive(std::move(m));
   }
 }
@@ -778,6 +842,15 @@ int ADLB_Info_num_work_units(int w, int *n, int *b, int *m) {
 
 int ADLBP_Finalize(void) {
   if (!g) return ADLB_ERROR;
+  if (!pending_puts.empty()) {
+    // un-settled pipelined puts must land before LOCAL_APP_DONE, or the
+    // shutdown ring could outrun them
+    int rc = ADLBP_Flush_puts();
+    if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK)
+      fprintf(stderr,
+              "[adlb rank %d] finalize: pipelined puts terminally "
+              "rejected (rc=%d)\n", g->rank, rc);
+  }
   Encoder e(T_FA_LOCAL_APP_DONE, g->rank);
   send_msg(g->home, e);
   g->closed.store(true);
@@ -886,6 +959,121 @@ int ADLB_App_recv(void *b, int m, int *s_, int *t) {
   double t0 = trace_now();
   int rc = ADLBP_App_recv(b, m, s_, t);
   trace_call("adlb:app_recv", t0);
+  return rc;
+}
+
+// ---- pipelined puts + fused reserve/get (framework extensions) ----------
+
+int ADLBP_Iput(void *work_buf, int work_len, int target_rank, int answer_rank,
+               int work_type, int work_prio) {
+  if (!g) return ADLB_ERROR;
+  if (!valid_type(work_type)) die("Iput of unregistered type %d", work_type);
+  std::unique_lock<std::mutex> lk(g->mu);
+  drain_inbox_locked();  // settle delivered responses: stay bounded
+  PendingPut pp;
+  pp.payload.assign((const char *)work_buf, (size_t)work_len);
+  pp.work_type = work_type;
+  pp.prio = work_prio;
+  pp.target_rank = target_rank;
+  pp.answer_rank = answer_rank;
+  pp.attempts = 0;
+  pp.server = target_rank >= 0 ? home_server(target_rank) : next_server();
+  int64_t id = next_put_id++;
+  auto &slot = pending_puts[id];
+  slot = std::move(pp);
+  send_iput(id, slot);
+  return ADLB_SUCCESS;
+}
+int ADLB_Iput(void *b, int l, int t, int a, int w, int p) {
+  if (!trace_on) return ADLBP_Iput(b, l, t, a, w, p);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Iput(b, l, t, a, w, p);
+  trace_call("adlb:iput", t0);
+  return rc;
+}
+
+int ADLBP_Flush_puts(void) {
+  if (!g) return ADLB_ERROR;
+  std::unique_lock<std::mutex> lk(g->mu);
+  while (!pending_puts.empty()) {
+    drain_inbox_locked();
+    if (pending_puts.empty()) break;
+    g->cv.wait(lk, [] { return !g->inbox.empty(); });
+  }
+  int failed = failed_puts;
+  bool nmw = failed_nmw;
+  failed_puts = 0;
+  failed_nmw = false;
+  if (nmw) return ADLB_NO_MORE_WORK;
+  return failed ? ADLB_PUT_REJECTED : ADLB_SUCCESS;
+}
+int ADLB_Flush_puts(void) {
+  if (!trace_on) return ADLBP_Flush_puts();
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Flush_puts();
+  trace_call("adlb:flush_puts", t0);
+  return rc;
+}
+
+int ADLBP_Get_work(int *req_types, int *work_type, int *work_prio,
+                   void *work_buf, int max_len, int *work_len,
+                   int *answer_rank) {
+  if (!g) return ADLB_ERROR;
+  std::vector<int64_t> types;
+  bool any = false;
+  if (!req_types || req_types[0] == ADLB_RESERVE_REQUEST_ANY) {
+    any = true;
+  } else {
+    for (int i = 0; i < 16 && req_types[i] != ADLB_RESERVE_EOL; i++) {
+      if (!valid_type(req_types[i]))
+        die("Get_work of unregistered type %d", req_types[i]);
+      types.push_back(req_types[i]);
+    }
+    if (types.empty()) any = true;
+  }
+  g->rqseqno++;
+  Encoder e(T_FA_RESERVE, g->rank);
+  e.i(F_HANG, 1).i(F_RQSEQNO, g->rqseqno).i(F_FETCH, 1);
+  if (!any) e.list(F_REQ_TYPES, types);
+  send_msg(g->home, e);
+  Msg resp = wait_for(T_TA_RESERVE_RESP);
+  int rc = (int)resp.geti(F_RC);
+  if (rc != ADLB_SUCCESS) return rc;
+  if (work_type) *work_type = (int)resp.geti(F_WORK_TYPE);
+  if (work_prio) *work_prio = (int)resp.geti(F_PRIO);
+  if (answer_rank) *answer_rank = (int)resp.geti(F_ANSWER_RANK, -1);
+  trace_last_reserved_wt = (int)resp.geti(F_WORK_TYPE);
+  auto bit = resp.blobs.find(F_PAYLOAD);
+  if (bit != resp.blobs.end()) {  // fused: unit already consumed
+    int n = (int)bit->second.size();
+    if (n > max_len)
+      die("Get_work: payload of %d bytes exceeds buffer of %d", n, max_len);
+    memcpy(work_buf, bit->second.data(), (size_t)n);
+    if (work_len) *work_len = n;
+    return ADLB_SUCCESS;
+  }
+  // fallback: remote holder or batch-common unit — handle + Get
+  auto it = resp.lists.find(F_HANDLE);
+  if (it == resp.lists.end() || it->second.size() != ADLB_HANDLE_SIZE)
+    die("malformed reserve handle");
+  int handle[ADLB_HANDLE_SIZE];
+  for (int i = 0; i < ADLB_HANDLE_SIZE; i++) handle[i] = (int)it->second[i];
+  int wl = (int)resp.geti(F_WORK_LEN);
+  if (wl > max_len)
+    die("Get_work: payload of %d bytes exceeds buffer of %d", wl, max_len);
+  if (work_len) *work_len = wl;
+  return ADLBP_Get_reserved_timed(work_buf, handle, nullptr);
+}
+int ADLB_Get_work(int *rt, int *wt, int *wp, void *b, int ml, int *wl,
+                  int *ar) {
+  if (!trace_on) return ADLBP_Get_work(rt, wt, wp, b, ml, wl, ar);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Get_work(rt, wt, wp, b, ml, wl, ar);
+  trace_call("adlb:get_work", t0);
+  if (rc == ADLB_SUCCESS) trace_got_work();
   return rc;
 }
 
