@@ -20,6 +20,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdarg>
 #include <cstdint>
@@ -368,6 +369,7 @@ struct PendingPut {
   int work_type, prio, target_rank, answer_rank, attempts, server;
 };
 static std::map<int64_t, PendingPut> pending_puts;
+static std::vector<int64_t> resend_queue;  // rejected ids awaiting replay
 static int64_t next_put_id = 1;
 static int failed_puts = 0;
 static bool failed_nmw = false;
@@ -394,8 +396,9 @@ static void settle_put(const Msg &m) {  // called with g->mu held
   if (rc == ADLB_PUT_REJECTED && ++it->second.attempts <= 10) {
     int hint = (int)m.geti(F_HINT, -1);
     it->second.server = hint >= 0 ? hint : next_server();
-    usleep(2000);  // pace like the synchronous retry loop
-    send_iput(id, it->second);
+    // replay happens in pump_resends() with the lock RELEASED: sleeping or
+    // sending here would stall the reader threads (and abort delivery)
+    resend_queue.push_back(id);
     return;
   }
   if (rc != ADLB_SUCCESS) {
@@ -410,6 +413,32 @@ static void settle_put(const Msg &m) {  // called with g->mu held
     send_msg(home_server(it->second.target_rank), e);
   }
   pending_puts.erase(it);
+}
+
+// Replay rejected pipelined puts queued by settle_put. Call WITHOUT g->mu:
+// the pacing sleep and the (possibly connect-blocking) send must not stall
+// inbound frames.
+static void pump_resends() {
+  for (;;) {
+    int64_t id = -1;
+    PendingPut copy;
+    {
+      std::lock_guard<std::mutex> lk(g->mu);
+      while (!resend_queue.empty()) {
+        int64_t cand = resend_queue.front();
+        resend_queue.erase(resend_queue.begin());
+        auto it = pending_puts.find(cand);
+        if (it != pending_puts.end()) {
+          id = cand;
+          copy = it->second;
+          break;
+        }
+      }
+    }
+    if (id < 0) return;
+    usleep(2000);  // pace like the synchronous retry loop
+    send_iput(id, copy);
+  }
 }
 
 // Handle a frame that is not an awaited protocol response: abort frames
@@ -433,15 +462,18 @@ void dispatch_passive(Msg m) {
 }
 
 Msg wait_for(uint16_t want) {
-  std::unique_lock<std::mutex> lk(g->mu);
   for (;;) {
-    g->cv.wait(lk, [] { return !g->inbox.empty(); });
-    Msg m = std::move(g->inbox.front());
-    g->inbox.pop_front();
-    if (m.tag == want &&
-        !(m.tag == T_TA_PUT_RESP && m.ints.count(F_PUT_ID)))
-      return m;
-    dispatch_passive(std::move(m));
+    {
+      std::unique_lock<std::mutex> lk(g->mu);
+      g->cv.wait(lk, [] { return !g->inbox.empty(); });
+      Msg m = std::move(g->inbox.front());
+      g->inbox.pop_front();
+      if (m.tag == want &&
+          !(m.tag == T_TA_PUT_RESP && m.ints.count(F_PUT_ID)))
+        return m;
+      dispatch_passive(std::move(m));
+    }
+    pump_resends();  // lock released: replays queued by settle_put
   }
 }
 
@@ -664,7 +696,7 @@ int ADLB_Put(void *b, int l, int t, int a, int w, int p) {
 
 static int reserve_impl(int *req_types, int *work_type, int *work_prio,
                         int *work_handle, int *work_len, int *answer_rank,
-                        int hang) {
+                        int hang, int fetch = 0, Msg *raw = nullptr) {
   if (!g) return ADLB_ERROR;
   std::vector<int64_t> types;
   bool any = false;
@@ -681,6 +713,7 @@ static int reserve_impl(int *req_types, int *work_type, int *work_prio,
   g->rqseqno++;
   Encoder e(T_FA_RESERVE, g->rank);
   e.i(F_HANG, hang).i(F_RQSEQNO, g->rqseqno);
+  if (fetch) e.i(F_FETCH, 1);
   if (!any) e.list(F_REQ_TYPES, types);
   send_msg(g->home, e);
   Msg resp = wait_for(T_TA_RESERVE_RESP);
@@ -691,6 +724,10 @@ static int reserve_impl(int *req_types, int *work_type, int *work_prio,
   if (work_prio) *work_prio = (int)resp.geti(F_PRIO);
   if (work_len) *work_len = (int)resp.geti(F_WORK_LEN);
   if (answer_rank) *answer_rank = (int)resp.geti(F_ANSWER_RANK, -1);
+  if (raw != nullptr) {  // fused caller inspects payload-vs-handle itself
+    *raw = std::move(resp);
+    return ADLB_SUCCESS;
+  }
   auto it = resp.lists.find(F_HANDLE);
   if (it == resp.lists.end() || it->second.size() != ADLB_HANDLE_SIZE)
     die("malformed reserve handle");
@@ -968,20 +1005,30 @@ int ADLBP_Iput(void *work_buf, int work_len, int target_rank, int answer_rank,
                int work_type, int work_prio) {
   if (!g) return ADLB_ERROR;
   if (!valid_type(work_type)) die("Iput of unregistered type %d", work_type);
-  std::unique_lock<std::mutex> lk(g->mu);
-  drain_inbox_locked();  // settle delivered responses: stay bounded
-  PendingPut pp;
-  pp.payload.assign((const char *)work_buf, (size_t)work_len);
-  pp.work_type = work_type;
-  pp.prio = work_prio;
-  pp.target_rank = target_rank;
-  pp.answer_rank = answer_rank;
-  pp.attempts = 0;
-  pp.server = target_rank >= 0 ? home_server(target_rank) : next_server();
-  int64_t id = next_put_id++;
-  auto &slot = pending_puts[id];
-  slot = std::move(pp);
-  send_iput(id, slot);
+  if (g->batch_active)
+    die("Iput inside Begin_batch_put is not supported (the common-prefix "
+        "refcount must be exact)");
+  if (target_rank >= 0 && target_rank >= g->num_app_ranks)
+    die("Iput target rank %d is not an app rank", target_rank);
+  PendingPut copy;
+  int64_t id;
+  {
+    std::unique_lock<std::mutex> lk(g->mu);
+    drain_inbox_locked();  // settle delivered responses: stay bounded
+    PendingPut pp;
+    pp.payload.assign((const char *)work_buf, (size_t)work_len);
+    pp.work_type = work_type;
+    pp.prio = work_prio;
+    pp.target_rank = target_rank;
+    pp.answer_rank = answer_rank;
+    pp.attempts = 0;
+    pp.server = target_rank >= 0 ? home_server(target_rank) : next_server();
+    id = next_put_id++;
+    pending_puts[id] = pp;
+    copy = std::move(pp);
+  }
+  send_iput(id, copy);  // lock released: sends may block on connect
+  pump_resends();
   return ADLB_SUCCESS;
 }
 int ADLB_Iput(void *b, int l, int t, int a, int w, int p) {
@@ -995,12 +1042,18 @@ int ADLB_Iput(void *b, int l, int t, int a, int w, int p) {
 
 int ADLBP_Flush_puts(void) {
   if (!g) return ADLB_ERROR;
-  std::unique_lock<std::mutex> lk(g->mu);
-  while (!pending_puts.empty()) {
-    drain_inbox_locked();
-    if (pending_puts.empty()) break;
-    g->cv.wait(lk, [] { return !g->inbox.empty(); });
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(g->mu);
+      drain_inbox_locked();
+      if (pending_puts.empty() && resend_queue.empty()) break;
+      if (resend_queue.empty())
+        g->cv.wait_for(lk, std::chrono::milliseconds(100),
+                       [] { return !g->inbox.empty(); });
+    }
+    pump_resends();  // lock released: pacing + sends must not stall readers
   }
+  std::lock_guard<std::mutex> lk(g->mu);
   int failed = failed_puts;
   bool nmw = failed_nmw;
   failed_puts = 0;
@@ -1021,30 +1074,10 @@ int ADLBP_Get_work(int *req_types, int *work_type, int *work_prio,
                    void *work_buf, int max_len, int *work_len,
                    int *answer_rank) {
   if (!g) return ADLB_ERROR;
-  std::vector<int64_t> types;
-  bool any = false;
-  if (!req_types || req_types[0] == ADLB_RESERVE_REQUEST_ANY) {
-    any = true;
-  } else {
-    for (int i = 0; i < 16 && req_types[i] != ADLB_RESERVE_EOL; i++) {
-      if (!valid_type(req_types[i]))
-        die("Get_work of unregistered type %d", req_types[i]);
-      types.push_back(req_types[i]);
-    }
-    if (types.empty()) any = true;
-  }
-  g->rqseqno++;
-  Encoder e(T_FA_RESERVE, g->rank);
-  e.i(F_HANG, 1).i(F_RQSEQNO, g->rqseqno).i(F_FETCH, 1);
-  if (!any) e.list(F_REQ_TYPES, types);
-  send_msg(g->home, e);
-  Msg resp = wait_for(T_TA_RESERVE_RESP);
-  int rc = (int)resp.geti(F_RC);
+  Msg resp;
+  int rc = reserve_impl(req_types, work_type, work_prio, nullptr, nullptr,
+                        answer_rank, /*hang=*/1, /*fetch=*/1, &resp);
   if (rc != ADLB_SUCCESS) return rc;
-  if (work_type) *work_type = (int)resp.geti(F_WORK_TYPE);
-  if (work_prio) *work_prio = (int)resp.geti(F_PRIO);
-  if (answer_rank) *answer_rank = (int)resp.geti(F_ANSWER_RANK, -1);
-  trace_last_reserved_wt = (int)resp.geti(F_WORK_TYPE);
   auto bit = resp.blobs.find(F_PAYLOAD);
   if (bit != resp.blobs.end()) {  // fused: unit already consumed
     int n = (int)bit->second.size();

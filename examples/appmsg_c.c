@@ -22,7 +22,13 @@
 int main(void) {
   int types[1] = {WORK};
   int am_server = -1, am_debug = -1, num_apps = 0;
-  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  const char *ns = getenv("ADLB_NUM_SERVERS");
+  if (!ns) {
+    fprintf(stderr, "%s: ADLB_NUM_SERVERS not set (run under the "
+            "framework's launcher)\n", __FILE__);
+    return 2;
+  }
+  int nservers = atoi(ns);
   int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,
                      &num_apps);
   if (rc != ADLB_SUCCESS || am_server || am_debug) return 2;
