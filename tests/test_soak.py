@@ -31,6 +31,7 @@ def _app(ctx):
     producers = max(ctx.num_app_ranks // 2, 1)
     if ctx.rank < producers:
         in_batch = False
+        pipelined = []  # (i) issued via iput; settled at flush
         for i in range(N_PER_PRODUCER):
             if not in_batch and rng.random() < 0.15:
                 ctx.begin_batch_put(b"PFX%d" % ctx.rank)
@@ -43,12 +44,26 @@ def _app(ctx):
                 rng.randrange(ctx.num_app_ranks) if rng.random() < 0.25 else -1
             )
             payload = struct.pack("<iii", ctx.rank, i, t)
+            if not in_batch and rng.random() < 0.3:
+                # pipelined path: counts as accepted only if the whole
+                # flush succeeds (per-put outcomes are aggregated)
+                ctx.iput(payload, t, work_prio=rng.randrange(-5, 6),
+                         target_rank=target, answer_rank=ctx.rank)
+                pipelined.append(i)
+                continue
             rc = ctx.put(payload, t, work_prio=rng.randrange(-5, 6),
                          target_rank=target, answer_rank=ctx.rank)
             if rc == ADLB_SUCCESS:
                 accepted.append((ctx.rank, i))
         if in_batch:
             ctx.end_batch_put()
+        if pipelined:
+            rc = ctx.flush_puts()
+            assert rc == ADLB_SUCCESS, (
+                f"soak flush failed rc={rc}; per-put attribution would "
+                f"need put-level results"
+            )
+            accepted.extend((ctx.rank, i) for i in pipelined)
     # everyone consumes until exhaustion. Non-blocking probes use random
     # type subsets; the blocking park is always wildcard — a rank parked on
     # a subset excluding its own targeted unit's type would let the world
@@ -60,6 +75,15 @@ def _app(ctx):
             None if rng.random() < 0.5
             else rng.sample(TYPES, rng.randrange(1, len(TYPES) + 1))
         )
+        if rng.random() < 0.3:
+            # fused path: one exchange, payload inline
+            rc, w = ctx.get_work()
+            if rc != ADLB_SUCCESS:
+                break
+            src, i, t = struct.unpack("<iii", w.payload[-12:])
+            assert w.work_type == t
+            consumed.append((src, i))
+            continue
         if rng.random() < 0.3:
             rc, r = ctx.ireserve(subset)
             if rc == ADLB_NO_CURRENT_WORK:
