@@ -150,6 +150,83 @@ class WorldResult:
                    default=0.0)
 
 
+class JoinedWorld:
+    """Context manager for an app rank joined to an externally launched
+    world (see :mod:`adlb_tpu.runtime.launch`): finalizes the client and
+    closes the endpoint on exit."""
+
+    def __init__(self, ctx: AdlbContext, ep) -> None:
+        self.ctx = ctx
+        self._ep = ep
+
+    def __enter__(self) -> AdlbContext:
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # finalize even when the app body raised: without FA_LOCAL_APP_DONE
+        # the shutdown ring never completes and the whole world hangs
+        try:
+            self.ctx._c.finalize()
+        except Exception:  # teardown races (home server gone) are benign
+            pass
+        finally:
+            self._ep.close()
+
+
+def join_world(
+    types: Sequence[int],
+    nservers: Optional[int] = None,
+    cfg: Optional[Config] = None,
+    rank: Optional[int] = None,
+    rendezvous: Optional[str] = None,
+) -> JoinedWorld:
+    """Join an externally launched world as an app rank (the Python analogue
+    of the C client's ADLB_Init env contract). Reads ``ADLB_RANK`` /
+    ``ADLB_RENDEZVOUS`` / ``ADLB_NUM_SERVERS`` (and ``ADLB_SERVER_IMPL``)
+    when not given:
+
+        with join_world(types=[1]) as ctx:
+            ctx.put(b"...", 1)
+
+    The rendezvous file lists every world rank as ``rank host port`` lines;
+    this process binds its own rank's port. An explicit ``nservers`` that
+    disagrees with the launcher's exported value would silently misroute
+    every message, so a mismatch is rejected.
+    """
+    import os
+
+    from adlb_tpu.runtime.transport_tcp import TcpEndpoint
+
+    env_ns = os.environ.get("ADLB_NUM_SERVERS")
+    if nservers is None:
+        if env_ns is None:
+            raise ValueError("nservers not given and ADLB_NUM_SERVERS not set")
+        nservers = int(env_ns)
+    elif env_ns is not None and int(env_ns) != nservers:
+        raise ValueError(
+            f"nservers={nservers} disagrees with the launcher's "
+            f"ADLB_NUM_SERVERS={env_ns}"
+        )
+    rank = int(os.environ["ADLB_RANK"]) if rank is None else rank
+    path = rendezvous or os.environ["ADLB_RENDEZVOUS"]
+    addr_map: dict[int, tuple[str, int]] = {}
+    with open(path) as f:
+        for line in f:
+            r, h, p = line.split()
+            addr_map[int(r)] = (h, int(p))
+    cfg = cfg or Config(
+        server_impl=os.environ.get("ADLB_SERVER_IMPL", "python")
+    )
+    world = WorldSpec(
+        nranks=len(addr_map), nservers=nservers, types=tuple(types)
+    )
+    binary_peers = (
+        set(world.server_ranks) if cfg.server_impl == "native" else None
+    )
+    ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers)
+    return JoinedWorld(AdlbContext(Client(world, cfg, ep)), ep)
+
+
 def run_world(
     num_app_ranks: int,
     nservers: int,

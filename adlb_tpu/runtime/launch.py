@@ -1,0 +1,260 @@
+"""Multi-host world launcher — the ``mpiexec -n k`` replacement.
+
+The reference's deployment story is MPI's launcher (reference
+``examples/README-batcher.txt:57``: ``mpiexec -n <k>``); this framework's
+worlds span hosts over TCP, so the launcher's job is the rendezvous. Run
+one launcher per host with that host's rank range:
+
+    host A:  python -m adlb_tpu.runtime.launch --rendezvous /shared/w1 \
+                 --nranks 8 --nservers 2 --types 1,2 --ranks 0-3 -- prog...
+    host B:  python -m adlb_tpu.runtime.launch --rendezvous /shared/w1 \
+                 --nranks 8 --nservers 2 --types 1,2 --ranks 4-7 -- prog...
+
+Per rank, the launcher publishes ``<dir>/<rank>.addr`` on the shared
+rendezvous directory and waits for all ``nranks`` files. Server ranks bind
+first and publish their real ports (Python reactors in-launcher, native
+daemons as subprocesses); app-rank ports are pre-allocated, and the app
+program is exec'd with ``ADLB_RENDEZVOUS``/``ADLB_RANK``/
+``ADLB_NUM_SERVERS`` set — the C client's env contract, and the one
+:func:`adlb_tpu.api.join_world` reads for Python apps.
+
+Caveat (v1): with ``--server-impl native --balancer tpu`` the JAX sidecar
+binds loopback on the master server's host, so all *servers* must run on
+that host (apps may be anywhere).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def _parse_ranks(spec: str) -> list[int]:
+    out: list[int] = []
+    for part in spec.split(","):
+        if "-" in part:
+            a, b = part.split("-")
+            out.extend(range(int(a), int(b) + 1))
+        else:
+            out.append(int(part))
+    return sorted(set(out))
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()  # released; the rank rebinds it (same pattern the single-host
+    return port  # harness uses; the window is narrow and loud on collision)
+
+
+def _publish(dirpath: str, rank: int, host: str, port: int) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    tmp = os.path.join(dirpath, f".{rank}.addr.tmp")
+    with open(tmp, "w") as f:
+        f.write(f"{host} {port}\n")
+    os.replace(tmp, os.path.join(dirpath, f"{rank}.addr"))
+
+
+def _await_all(dirpath: str, nranks: int, timeout: float) -> dict:
+    deadline = time.monotonic() + timeout
+    addr_map: dict[int, tuple[str, int]] = {}
+    while len(addr_map) < nranks:
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(nranks)) - set(addr_map))
+            raise TimeoutError(
+                f"rendezvous incomplete after {timeout}s: waiting for ranks "
+                f"{missing[:10]}{'...' if len(missing) > 10 else ''}"
+            )
+        for r in range(nranks):
+            if r in addr_map:
+                continue
+            try:
+                with open(os.path.join(dirpath, f"{r}.addr")) as f:
+                    h, p = f.read().split()
+                addr_map[r] = (h, int(p))
+            except (OSError, ValueError):
+                continue
+        if len(addr_map) < nranks:
+            time.sleep(0.05)
+    return addr_map
+
+
+def write_rendezvous_file(path: str, addr_map: dict) -> None:
+    """The single-file format the C client reads (rank host port lines)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        for r, (h, p) in sorted(addr_map.items()):
+            f.write(f"{r} {h} {p}\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch this host's share of an adlb-tpu world."
+    )
+    ap.add_argument("--rendezvous", required=True,
+                    help="shared directory for the world's rendezvous")
+    ap.add_argument("--nranks", type=int, required=True)
+    ap.add_argument("--nservers", type=int, required=True)
+    ap.add_argument("--types", required=True,
+                    help="comma-separated work types, e.g. 1,2,3")
+    ap.add_argument("--ranks", required=True,
+                    help="this host's world ranks, e.g. 0-3 or 0,2,5")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="address other hosts reach this one at")
+    ap.add_argument("--server-impl", default="python",
+                    choices=["python", "native"])
+    ap.add_argument("--balancer", default="steal", choices=["steal", "tpu"])
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("prog", nargs="*",
+                    help="app program (exec'd per app rank with "
+                         "ADLB_RENDEZVOUS/ADLB_RANK set)")
+    args = ap.parse_args(argv)
+
+    from adlb_tpu.runtime.world import Config, WorldSpec
+
+    types = [int(t) for t in args.types.split(",")]
+    world = WorldSpec(nranks=args.nranks, nservers=args.nservers,
+                      types=tuple(types))
+    cfg = Config(balancer=args.balancer, server_impl=args.server_impl)
+    my_ranks = _parse_ranks(args.ranks)
+    host = args.host
+    rdv = args.rendezvous
+    failures: list[str] = []
+    threads: list[threading.Thread] = []
+    server_eps = {}   # rank -> TcpEndpoint (python impl)
+    daemons = {}      # rank -> Popen (native impl)
+
+    # 1. servers bind first and publish REAL ports
+    sidecar = None
+    for rank in my_ranks:
+        if not world.is_server(rank):
+            continue
+        if args.server_impl == "native":
+            from adlb_tpu.native import daemon
+
+            proc = daemon.spawn_daemon(world, cfg, rank)
+            daemons[rank] = proc
+            _publish(rdv, rank, host, daemon.read_hello(proc, rank))
+        else:
+            from adlb_tpu.runtime.transport_tcp import TcpEndpoint
+
+            ep = TcpEndpoint(rank, {rank: (host, 0)})
+            server_eps[rank] = ep
+            _publish(rdv, rank, host, ep.port)
+    if (args.server_impl == "native" and args.balancer == "tpu"
+            and world.master_server_rank in my_ranks):
+        from adlb_tpu.balancer.sidecar import start_sidecar
+
+        sidecar = start_sidecar(world, cfg, None)
+        _publish(rdv, world.nranks, host, sidecar[0].port)
+
+    # 2. app ranks publish pre-allocated ports
+    for rank in my_ranks:
+        if world.is_app(rank):
+            _publish(rdv, rank, host, _free_port(host))
+
+    # 3. global rendezvous
+    addr_map = _await_all(rdv, world.nranks, args.timeout)
+    try:
+        with open(os.path.join(rdv, f"{world.nranks}.addr")) as f:
+            h, p = f.read().split()
+        addr_map[world.nranks] = (h, int(p))
+    except OSError:
+        pass
+    merged = os.path.join(rdv, "world.addr")
+    write_rendezvous_file(
+        merged, {r: a for r, a in addr_map.items() if r < world.nranks}
+    )
+
+    # 4. run servers
+    if sidecar is not None:
+        sidecar[0].addr_map.update(addr_map)
+        sidecar[1].start()
+    for rank, proc in daemons.items():
+        from adlb_tpu.native import daemon
+
+        daemon.send_addrs(proc, addr_map)
+
+        def wait_daemon(rank=rank, proc=proc):
+            from adlb_tpu.native import daemon as dm
+
+            stats, abort_code, rc = dm.collect_stats(proc, timeout=10**9)
+            if stats is None and abort_code is None:
+                failures.append(f"native server rank {rank} exited {rc}")
+
+        t = threading.Thread(target=wait_daemon, daemon=True)
+        threads.append(t)
+        t.start()
+    for rank, ep in server_eps.items():
+        ep.addr_map.update(addr_map)
+
+        def run_server(rank=rank, ep=ep):
+            from adlb_tpu.runtime.server import Server
+
+            try:
+                Server(world, cfg, ep).run()
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"server rank {rank}: {e!r}")
+            finally:
+                ep.close()
+
+        t = threading.Thread(target=run_server, daemon=True)
+        threads.append(t)
+        t.start()
+
+    # 5. exec app programs
+    procs: list[subprocess.Popen] = []
+    for rank in my_ranks:
+        if world.is_app(rank):
+            if not args.prog:
+                failures.append(f"app rank {rank}: no program given")
+                continue
+            env = dict(os.environ)
+            env["ADLB_RENDEZVOUS"] = merged
+            env["ADLB_RANK"] = str(rank)
+            env["ADLB_NUM_SERVERS"] = str(world.nservers)
+            if args.server_impl == "native":
+                env["ADLB_SERVER_IMPL"] = "native"
+            procs.append(subprocess.Popen(args.prog, env=env))
+
+    # apps must not outlive a failed server: without this, a dead server
+    # leaves every app blocked in reserve and the launcher waiting forever
+    rc_final = 0
+    while any(p.poll() is None for p in procs):
+        if failures:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            break
+        time.sleep(0.2)
+    for p in procs:
+        try:
+            p.wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            failures.append("app process killed after timeout")
+        if p.returncode:
+            rc_final = p.returncode
+    for t in threads:
+        t.join(timeout=args.timeout)
+        if t.is_alive():
+            failures.append("a server did not terminate (hung shutdown?)")
+    if sidecar is not None:
+        from adlb_tpu.balancer.sidecar import stop_sidecar
+
+        stop_sidecar(*sidecar)
+    for f in failures:
+        print(f"[adlb_launch] {f}", file=sys.stderr)
+    return rc_final if not failures else (rc_final or 1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

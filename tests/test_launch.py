@@ -1,0 +1,69 @@
+"""Multi-host launcher (adlb_tpu.runtime.launch) + join_world: two
+launcher invocations (one per simulated host) rendezvous through a shared
+directory and run a complete world."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_APP = textwrap.dedent(
+    """
+    import os, struct, sys
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from adlb_tpu.api import join_world
+    from adlb_tpu.types import ADLB_SUCCESS
+
+    T = 1
+    with join_world(types=[T]) as ctx:
+        if ctx.rank == 0:
+            for i in range(40):
+                ctx.iput(struct.pack("<q", i), T)
+            assert ctx.flush_puts() == ADLB_SUCCESS
+        got = []
+        while True:
+            rc, w = ctx.get_work([T])
+            if rc != ADLB_SUCCESS:
+                break
+            got.append(struct.unpack("<q", w.payload)[0])
+        print("APP", ctx.rank, "GOT", sorted(got))
+    """
+) % (_REPO,)
+
+
+@pytest.mark.parametrize("server_impl", ["python", "native"])
+def test_two_launchers_one_world(tmp_path, server_impl):
+    app_py = tmp_path / "app.py"
+    app_py.write_text(_APP)
+    rdv = str(tmp_path / "world")
+    common = [
+        sys.executable, "-m", "adlb_tpu.runtime.launch",
+        "--rendezvous", rdv, "--nranks", "6", "--nservers", "2",
+        "--types", "1", "--server-impl", server_impl,
+        "--timeout", "60",
+    ]
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    # "host A": apps 0,1 + server 4; "host B": apps 2,3 + server 5
+    pa = subprocess.Popen(
+        common + ["--ranks", "0,1,4", sys.executable, str(app_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    pb = subprocess.Popen(
+        common + ["--ranks", "2,3,5", sys.executable, str(app_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out_a, err_a = pa.communicate(timeout=120)
+    out_b, err_b = pb.communicate(timeout=120)
+    assert pa.returncode == 0, f"launcher A rc={pa.returncode}\n{out_a}\n{err_a}"
+    assert pb.returncode == 0, f"launcher B rc={pb.returncode}\n{out_b}\n{err_b}"
+    got = []
+    for out in (out_a, out_b):
+        for line in out.splitlines():
+            if line.startswith("APP "):
+                got.extend(eval(line.split("GOT", 1)[1]))
+    assert sorted(got) == list(range(40)), sorted(got)
