@@ -36,6 +36,41 @@ _APP = textwrap.dedent(
 ) % (_REPO,)
 
 
+def test_launcher_with_c_clients(tmp_path):
+    """The launcher's env contract drives native C binaries directly."""
+    import shutil
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.native.capi import build_example
+
+    exe = build_example(os.path.join(_REPO, "examples", "fastpath_c.c"))
+    rdv = str(tmp_path / "worldc")
+    common = [
+        sys.executable, "-m", "adlb_tpu.runtime.launch",
+        "--rendezvous", rdv, "--nranks", "5", "--nservers", "2",
+        "--types", "1", "--server-impl", "native", "--timeout", "60",
+    ]
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    pa = subprocess.Popen(common + ["--ranks", "0,1,3", exe], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    pb = subprocess.Popen(common + ["--ranks", "2,4", exe], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    out_a, err_a = pa.communicate(timeout=120)
+    out_b, err_b = pb.communicate(timeout=120)
+    assert pa.returncode == 0, f"A rc={pa.returncode}\n{out_a}\n{err_a}"
+    assert pb.returncode == 0, f"B rc={pb.returncode}\n{out_b}\n{err_b}"
+    total_n = sum(
+        int(line.split("got")[1].split()[0])
+        for out in (out_a, out_b)
+        for line in out.splitlines()
+        if "fastpath rank" in line
+    )
+    assert total_n == 40
+
+
 @pytest.mark.parametrize("server_impl", ["python", "native"])
 def test_two_launchers_one_world(tmp_path, server_impl):
     app_py = tmp_path / "app.py"
