@@ -22,16 +22,18 @@ import time
 from adlb_tpu.runtime.messages import Tag, msg
 
 
-def start_sidecar(world, cfg, abort_event=None):
+def start_sidecar(world, cfg, abort_event=None, host: str = "127.0.0.1"):
     """Bind the sidecar's endpoint at pseudo-rank ``world.nranks`` and build
     its (not-yet-started) thread. Returns (endpoint, thread): add the
     endpoint's port to the world's address map, update ``ep.addr_map``,
     then ``thread.start()``. Use :func:`stop_sidecar` to tear down — also
-    on bootstrap failure, or the thread/endpoint leak."""
+    on bootstrap failure, or the thread/endpoint leak. Pass the host other
+    machines reach this one at for multi-host worlds (servers on other
+    hosts must stream snapshots here)."""
     from adlb_tpu.runtime.transport_tcp import TcpEndpoint
 
     ep = TcpEndpoint(
-        world.nranks, {world.nranks: ("127.0.0.1", 0)},
+        world.nranks, {world.nranks: (host, 0)},
         binary_peers=set(world.server_ranks),
     )
     thread = threading.Thread(

@@ -18,9 +18,9 @@ program is exec'd with ``ADLB_RENDEZVOUS``/``ADLB_RANK``/
 ``ADLB_NUM_SERVERS`` set — the C client's env contract, and the one
 :func:`adlb_tpu.api.join_world` reads for Python apps.
 
-Caveat (v1): with ``--server-impl native --balancer tpu`` the JAX sidecar
-binds loopback on the master server's host, so all *servers* must run on
-that host (apps may be anywhere).
+With ``--server-impl native --balancer tpu`` the JAX sidecar runs on the
+master server's host, bound to that host's ``--host`` address so servers
+anywhere can stream snapshots to it.
 """
 
 from __future__ import annotations
@@ -153,7 +153,7 @@ def main(argv=None) -> int:
             and world.master_server_rank in my_ranks):
         from adlb_tpu.balancer.sidecar import start_sidecar
 
-        sidecar = start_sidecar(world, cfg, None)
+        sidecar = start_sidecar(world, cfg, None, host=host)
         _publish(rdv, world.nranks, host, sidecar[0].port)
 
     # 2. app ranks publish pre-allocated ports
