@@ -129,6 +129,7 @@ enum WireTag : uint16_t {
   T_SS_MIGRATE_ACK = 1121,
   T_DS_LOG = 1131,
   T_DS_END = 1132,
+  T_PEER_EOF = 1999,  // transport-internal synthetic signal (never on wire)
 };
 
 // ---- field ids ------------------------------------------------------------
@@ -468,6 +469,7 @@ class Endpoint {
   }
 
   void reader(int conn) {
+    int32_t last_src = -1;
     for (;;) {
       uint32_t n;
       if (!read_exact(conn, (char*)&n, 4)) break;
@@ -481,9 +483,23 @@ class Endpoint {
         continue;
       }
       NMsg m = decode(body);
+      last_src = m.src;
       {
         std::lock_guard<std::mutex> lk(in_mu_);
         inbox_.push_back(std::move(m));
+      }
+      in_cv_.notify_one();
+    }
+    // EOF after the peer's frames: synthetic in-order signal so the
+    // reactor can tell a finalized peer from a dead one (the reference's
+    // failure model is rank-death-kills-job, src/adlb.c:2508-2526)
+    if (last_src >= 0 && !closed_) {
+      NMsg eof;
+      eof.tag = T_PEER_EOF;
+      eof.src = last_src;
+      {
+        std::lock_guard<std::mutex> lk(in_mu_);
+        inbox_.push_back(std::move(eof));
       }
       in_cv_.notify_one();
     }
@@ -888,6 +904,7 @@ class Server {
       case T_SS_END_1: on_end_1(m); break;
       case T_SS_END_2: on_end_2(m); break;
       case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
+      case T_PEER_EOF: on_peer_eof(m); break;
       case T_SS_PERIODIC_STATS: on_periodic_stats(m); break;
       case T_SS_PLAN_MATCH: on_plan_match(m); break;
       case T_SS_PLAN_MIGRATE: on_plan_migrate(m); break;
@@ -1764,6 +1781,7 @@ class Server {
   }
 
   void on_end_1(const NMsg& m) {
+    ending_ = true;
     if (m.geti(F_COMPLETE) && int(m.geti(F_ORIGIN)) == rank_) {
       int nxt = w_.ring_next(rank_);
       NMsg token = mk(T_SS_END_2);
@@ -1788,6 +1806,7 @@ class Server {
   }
 
   void on_end_2(const NMsg& m) {
+    ending_ = true;
     done_ = true;
     if (!m.geti(F_COMPLETE)) {
       int nxt = w_.ring_next(rank_);
@@ -2142,6 +2161,27 @@ class Server {
     if (any_added) match_rq();
   }
 
+  void on_peer_eof(const NMsg& m) {
+    // benign during termination; before it, a rank died without finalizing
+    // (connection-based: a rank that never sent a frame is invisible here).
+    // Only the HOME server judges an app EOF — finalize knowledge is
+    // home-local, and finished apps legitimately EOF at other servers.
+    if (done_ || no_more_work_ || done_by_exhaustion_ || aborted_ || ending_)
+      return;
+    if (w_.is_app(m.src) && w_.home_server(m.src) == rank_ &&
+        !finalized_.count(m.src)) {
+      std::fprintf(stderr,
+                   "[adlb_serverd %d] app rank %d connection lost before "
+                   "finalize; aborting the world\n", rank_, m.src);
+      do_abort(-3, true);
+    } else if (w_.is_server(m.src)) {
+      std::fprintf(stderr,
+                   "[adlb_serverd %d] server rank %d connection lost "
+                   "mid-run; aborting\n", rank_, m.src);
+      do_abort(-3, true);
+    }
+  }
+
   // ---- abort --------------------------------------------------------------
   void do_abort(int code, bool broadcast) {
     if (aborted_) return;
@@ -2201,6 +2241,7 @@ class Server {
   int abort_code_ = 0;
   std::set<int> finalized_;
   bool end1_pending_ = false;
+  bool ending_ = false;  // shutdown ring underway: peer EOFs are benign
   NMsg held_end1_;
   bool exhaust_held_ = false;
   double exhaust_held_since_ = 0.0;

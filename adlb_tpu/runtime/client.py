@@ -493,6 +493,15 @@ class Client:
         ):
             self._settle_put(m)
             return
+        if m.tag is Tag.PEER_EOF:
+            if m.src == self.home:
+                # the lifeline is gone: error out instead of hanging in the
+                # next blocking wait (reference: rank failure kills the job)
+                self.aborted = True
+                raise AdlbError(
+                    f"rank {self.rank}: home server {m.src} connection lost"
+                )
+            return  # other peers closing is normal at termination
         ctx = f" while waiting {waiting}" if waiting is not None else ""
         raise AdlbError(f"rank {self.rank}: unexpected {m.tag}{ctx}")
 

@@ -93,6 +93,9 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_CHECKPOINT: 1123,
     Tag.DS_LOG: 1131,
     Tag.DS_END: 1132,
+    # transport-internal synthetic signal (never actually on the wire; the
+    # id exists only so the codec table stays total)
+    Tag.PEER_EOF: 1999,
 }
 TAG_FOR_WIRE = {v: k for k, v in WIRE_TAG.items()}
 

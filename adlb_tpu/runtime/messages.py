@@ -91,6 +91,12 @@ class Tag(enum.Enum):
     DS_LOG = enum.auto()
     DS_END = enum.auto()
 
+    # transport-internal (never on the wire): a peer's connection hit EOF.
+    # The reference's failure model is "any rank failure kills the job"
+    # (MPI_Abort paths, reference src/adlb.c:2508-2526); over TCP the
+    # analogous signal is an app connection closing before LOCAL_APP_DONE.
+    PEER_EOF = enum.auto()
+
 
 @dataclasses.dataclass
 class Msg:

@@ -197,6 +197,7 @@ class Server:
         self.done = False
         self._finalized: set[int] = set()
         self._end1_pending = False  # END_1 token held until local apps finish
+        self._ending = False  # shutdown ring underway: peer EOFs are benign
         self._exhaust_held_since: Optional[float] = None
         self._exhaust_inflight = False
         self.activity = 0  # puts accepted + reservations handed out
@@ -247,6 +248,7 @@ class Server:
             self._restore_from_checkpoint(cfg.restore_path)
 
         self._handlers = {
+            Tag.PEER_EOF: self._on_peer_eof,
             Tag.FA_CHECKPOINT: self._on_fa_checkpoint,
             Tag.SS_CHECKPOINT: self._on_ss_checkpoint,
             Tag.FA_PUT: self._on_put,
@@ -1562,6 +1564,7 @@ class Server:
         )
 
     def _on_end_1(self, m: Msg) -> None:
+        self._ending = True
         token = m.token
         if m.data.get("complete") and token["origin"] == self.rank:
             # every server's local apps have finalized: circulate phase 2
@@ -1583,6 +1586,7 @@ class Server:
             self._held_end1 = token
 
     def _on_end_2(self, m: Msg) -> None:
+        self._ending = True
         token = m.token
         self.done = True
         if not m.data.get("complete"):
@@ -1592,6 +1596,41 @@ class Server:
                 msg(Tag.SS_END_2, self.rank, token=token,
                     complete=(nxt == token["origin"])),
             )
+
+    def _on_peer_eof(self, m: Msg) -> None:
+        """A peer's connection closed. Benign during termination; before it,
+        a rank died without finalizing — the reference's failure model is
+        rank-death-kills-job (``MPI_Abort`` paths, reference
+        ``src/adlb.c:2508-2526``), and the alternative here is a silent
+        world hang. Detection is connection-based: a rank that dies before
+        ever sending a frame leaves no connection to EOF, and only the
+        launch harness's timeout (or the watchdog, for servers) catches
+        it."""
+        if (
+            self.done or self.no_more_work or self.done_by_exhaustion
+            or self._aborted or self._ending
+        ):
+            return
+        if (
+            self.world.is_app(m.src)
+            and self.world.home_server(m.src) == self.rank
+            and m.src not in self._finalized
+        ):
+            # only the HOME server judges an app EOF: finalize knowledge is
+            # home-local, and a finished app legitimately EOFs at every
+            # other server it ever fetched from
+            aprintf(
+                True, self.rank,
+                f"app rank {m.src} connection lost before finalize; "
+                f"aborting the world (reference rank-failure semantics)",
+            )
+            self._do_abort(-3, broadcast=True)
+        elif self.world.is_server(m.src):
+            aprintf(
+                True, self.rank,
+                f"server rank {m.src} connection lost mid-run; aborting",
+            )
+            self._do_abort(-3, broadcast=True)
 
     # ------------------------------------------------------- abort / watchdog
 

@@ -22,7 +22,7 @@ import time
 from typing import Optional
 
 from adlb_tpu.runtime.codec import decode_binary, encodable, encode_binary
-from adlb_tpu.runtime.messages import Msg
+from adlb_tpu.runtime.messages import Msg, Tag
 
 _HDR = struct.Struct("<I")
 
@@ -76,6 +76,7 @@ class TcpEndpoint:
             ).start()
 
     def _reader(self, conn: socket.socket) -> None:
+        last_src: Optional[int] = None
         try:
             while True:
                 hdr = self._read_exact(conn, _HDR.size)
@@ -103,10 +104,17 @@ class TcpEndpoint:
                     self.binary_peers.add(m.src)
                 else:
                     m = pickle.loads(body)
+                last_src = m.src
                 self.inbox.put(m)
         except OSError:
             return
         finally:
+            # EOF after the peer's frames: a synthetic in-order signal so
+            # role logic can tell a finalized peer from a dead one (the
+            # reference's failure model is rank-death-kills-job,
+            # src/adlb.c:2508-2526; a silent EOF here would hang instead)
+            if last_src is not None and not self._closed:
+                self.inbox.put(Msg(tag=Tag.PEER_EOF, src=last_src))
             conn.close()
 
     @staticmethod

@@ -1,0 +1,56 @@
+"""Rank-failure detection over TCP: the reference's model is any rank
+failure kills the job (MPI_Abort paths, reference src/adlb.c:2508-2526).
+A TCP world must not do worse — a SIGKILLed app used to hang everyone
+until the harness timeout; now the home server sees the connection EOF
+before LOCAL_APP_DONE and aborts the world."""
+
+import os
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+
+def _app_with_casualty(ctx):
+    T = 1
+    if ctx.rank == 0:
+        for i in range(10):
+            ctx.put(struct.pack("<q", i), T)
+        # rank 0 keeps producing slowly so the world is mid-flight
+        time.sleep(0.2)
+    if ctx.rank == 1:
+        # die mid-protocol (after real traffic, so connections exist —
+        # EOF detection is connection-based; a rank that dies before ever
+        # contacting a server is only caught by the harness timeout)
+        rc, r = ctx.reserve([T])
+        assert rc == ADLB_SUCCESS
+        ctx.get_reserved(r.handle)
+        os._exit(1)  # simulated crash: no finalize, no goodbye
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return n
+        ctx.get_reserved(r.handle)
+        time.sleep(0.02)
+        n += 1
+
+
+@pytest.mark.parametrize("server_impl", ["python", "native"])
+def test_dead_app_aborts_world_quickly(server_impl):
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        # the dying rank reports nothing; the EOF-driven abort tears the
+        # rest down well before the 60s harness timeout
+        spawn_world(
+            3, 2, [1], _app_with_casualty,
+            cfg=Config(server_impl=server_impl,
+                       exhaust_check_interval=10.0),  # exhaustion can't save it
+            timeout=60.0,
+        )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30.0, f"failure detection took {elapsed:.1f}s"
