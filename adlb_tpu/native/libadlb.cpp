@@ -1110,6 +1110,20 @@ int ADLB_Get_work(int *rt, int *wt, int *wp, void *b, int ml, int *wl,
   return rc;
 }
 
+// Stamped debug printing (reference src/adlb.c:3395-3417): rank, source
+// line and seconds-since-init prefix, gated by both the call-site flag and
+// the aprintf_flag given to ADLB_Init.
+void adlbp_dbgprintf(int flag, int linenum, const char *fmt, ...) {
+  if (!flag || g == nullptr || !g->aprintf_flag) return;
+  static double t0 = trace_now();
+  fprintf(stderr, "[r=%d] <%d> %.6f: ", g->rank, linenum, trace_now() - t0);
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(stderr, fmt, ap);
+  va_end(ap);
+  fflush(stderr);
+}
+
 int ADLB_World_rank(void) { return g ? g->rank : -1; }
 int ADLB_World_size(void) { return g ? g->nranks : -1; }
 int ADLB_Num_app_ranks(void) { return g ? g->num_app_ranks : -1; }

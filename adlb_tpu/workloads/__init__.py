@@ -44,8 +44,10 @@ benchmark drivers:
 
 ``c2.c`` is the skeleton behind :mod:`~adlb_tpu.workloads.skel` and is
 covered there; ``stats.c`` is a standalone statistics library, ported as
-:mod:`adlb_tpu.utils.stats`; ``grid_old_daf.c`` is a superseded draft of
-``grid_daf.c`` (covered by :mod:`~adlb_tpu.workloads.grid`); ``f1.f`` /
+:mod:`adlb_tpu.utils.stats`; ``grid_old_daf.c`` is a superseded draft
+whose own header says it "does not agree with grid_uni in terms of
+computed result" (reference ``examples/grid_old_daf.c:1-8``) — the
+corrected algorithm is :mod:`~adlb_tpu.workloads.grid`; ``f1.f`` /
 ``fbatcher.f`` are Fortran twins of c1/batcher exercising the Fortran
 binding, which this framework validates through the C shim tests instead
 (``tests/test_fshim.py``).
