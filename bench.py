@@ -94,6 +94,21 @@ def main() -> None:
     steal = best_of("steal")
     tpu = best_of("tpu")
 
+    # tsp: the other BASELINE.json-named workload (branch-and-bound with
+    # broadcast bound updates; compute-bound like nq at this scale)
+    from adlb_tpu.workloads import tsp
+
+    def tsp_rate(mode: str):
+        dists = tsp.dist_matrix(tsp.make_cities(9, seed=3))
+        want = tsp.brute_force_optimum(dists)
+        r = tsp.run(n_cities=9, num_app_ranks=APPS, nservers=SERVERS,
+                    seed=3, cfg=cfg(mode), timeout=600.0)
+        assert r.best == want, f"tsp {mode}: {r.best} != {want}"
+        return r.tasks_per_sec
+
+    tsp_steal = tsp_rate("steal")
+    tsp_tpu = tsp_rate("tpu")
+
     # hotspot: all work enters one server, consumers everywhere — the
     # balancing scenario ADLB exists for; makespan-based, GIL-free work.
     # 16 ranks / 8 servers: enough ring hops that upstream's gossip
@@ -226,6 +241,8 @@ def main() -> None:
             "nq_tpu_tasks_per_sec": round(tpu.tasks_per_sec, 1),
             "nq_ratio": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
             if steal.tasks_per_sec else 0.0,
+            "tsp_steal_tasks_per_sec": round(tsp_steal, 1),
+            "tsp_tpu_tasks_per_sec": round(tsp_tpu, 1),
             "steal_pop_latency_p50_ms": round(lat_steal.latency_p50_ms, 3),
             "tpu_pop_latency_p50_ms": round(lat_tpu.latency_p50_ms, 3),
             "steal_pops_per_sec": round(lat_steal.pops_per_sec, 1),
