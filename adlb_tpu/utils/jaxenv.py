@@ -1,0 +1,45 @@
+"""Force JAX onto a virtual multi-device CPU mesh.
+
+Multi-chip TPU hardware is not available in this environment; sharding
+correctness is validated on XLA's host platform with virtual devices
+instead (the analogue of testing the reference's multi-rank protocols
+under ``mpiexec -n k`` on one host, reference ``examples/nq.c:179-183``).
+
+The ambient environment may have registered a single-chip accelerator
+plugin in *every* Python process (via sitecustomize) and pinned
+``jax_platforms`` at the config level — overriding env vars — so forcing
+the CPU platform requires all three steps below, in order.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n_devices: int = 8):
+    """Make JAX expose ``n_devices`` virtual CPU devices; returns jax.
+
+    Safe to call whether or not JAX has been imported or initialized:
+    sets the env vars (for any backend not yet created), pins the
+    platform at the config level (beats ambient config pins), and drops
+    any backend an accelerator plugin pre-initialized so the CPU
+    backend re-reads ``XLA_FLAGS`` on next use.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():  # pragma: no cover
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    return jax

@@ -1,30 +1,12 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
-validated on XLA's host platform with 8 virtual devices instead.
-
-The ambient environment may have registered a single-chip accelerator plugin
-and pinned ``jax_platforms`` at the *config* level (overriding env vars), so
-this both sets the env and updates the config, clearing any backends that
-were initialized before pytest imported us.
+validated on XLA's host platform with 8 virtual devices instead. The
+full env/config/backend-reset dance lives in
+``adlb_tpu.utils.jaxenv.force_cpu_devices`` (shared with
+``__graft_entry__.dryrun_multichip``'s self-provisioned subprocess).
 """
 
-import os
+from adlb_tpu.utils.jaxenv import force_cpu_devices
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-if _xb.backends_are_initialized():  # pragma: no cover
-    from jax.extend.backend import clear_backends
-
-    clear_backends()
+force_cpu_devices(8)
