@@ -113,19 +113,30 @@ class PlanEngine:
         if not snapshots:
             return [], []
         now = time.monotonic()
-        filtered = {}
+        # requester-side ledger filter first (reqs are few): rounds run at
+        # event rate, so a round that can plan nothing must cost O(reqs),
+        # not O(queued tasks)
+        freqs = {}
         for rank, snap in snapshots.items():
             stamp = snap.get("stamp", now)
-            reqs = [
+            freqs[rank] = [
                 r for r in snap["reqs"]
                 if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
             ]
+        have_reqs = any(freqs.values())
+        if not have_reqs and not self._maybe_imbalanced(snapshots):
+            return [], []
+        filtered = {}
+        for rank, snap in snapshots.items():
+            # task eligibility uses the task-side stamp: a reqs-only park
+            # snapshot must not re-eligibilize in-flight planned tasks
+            tstamp = snap.get("task_stamp", snap.get("stamp", now))
             tasks = [
                 t for t in snap["tasks"]
-                if self._planned_tasks.get((rank, t[0]), -1.0) < stamp
+                if self._planned_tasks.get((rank, t[0]), -1.0) < tstamp
             ]
-            filtered[rank] = {"tasks": tasks, "reqs": reqs}
-        if any(sn["reqs"] for sn in filtered.values()):
+            filtered[rank] = {"tasks": tasks, "reqs": freqs[rank]}
+        if have_reqs:
             pairs = self.solver.solve(filtered, world)
         else:
             pairs = []  # nobody parked; still consider migrations below
@@ -153,6 +164,26 @@ class PlanEngine:
             }
         return matches, migrations
 
+    def _maybe_imbalanced(self, snaps: dict) -> bool:
+        """Cheap pre-check (raw snapshot counts, no ledger filtering) for
+        whether fair-share migration planning could possibly trigger; the
+        exact check re-runs on filtered inventory. Errs a round late on
+        ledger-heavy edges, which the next fresh snapshot corrects."""
+        consumers = {
+            r: snaps[r].get("consumers", 0) for r in snaps
+        }
+        total_c = sum(consumers.values())
+        if total_c == 0:
+            return False
+        raw = {r: len(snaps[r]["tasks"]) for r in snaps}
+        total = sum(raw.values())
+        if total < total_c:
+            return False  # scarcity: matches handle it (see below)
+        return any(
+            c > 0 and 2 * raw[r] * total_c < total * c
+            for r, c in consumers.items()
+        )
+
     def _plan_migrations(
         self, snaps: dict, filtered: dict, planned_away: dict, t_planned: float
     ):
@@ -169,7 +200,14 @@ class PlanEngine:
         if total_consumers == 0:
             return []
         total_avail = sum(len(v) for v in inv.values())
-        if total_avail == 0:
+        # Anticipatory placement only pays when there is a real backlog to
+        # pre-position (hotspot's bulk). When work is scarcer than one unit
+        # per consumer, the demand-driven match path moves individual units
+        # more directly than a migrate round-trip — and scarce pools are
+        # exactly where migrate churn (a unit bouncing between servers,
+        # briefly unavailable each hop) hurts most (gfmc's shallow
+        # answer-economy queues).
+        if total_avail < total_consumers:
             return []
 
         def share(r: int) -> int:
@@ -178,10 +216,18 @@ class PlanEngine:
             c = consumers.get(r, 0)
             return -(-total_avail * c // total_consumers) if c else 0
 
+        # Hysteresis: only treat a server as deficient when it holds less
+        # than HALF its fair share. Without the band, servers hovering near
+        # their share (e.g. compute-bound workloads whose untargeted puts
+        # already round-robin evenly, like tsp) trigger a constant shuffle
+        # of inventory moves — each one costs transfer messages and makes
+        # the unit briefly unavailable — for no placement benefit. Truly
+        # starved destinations (hotspot's empty servers) sit far below the
+        # band and still trigger immediately.
         deficits = {
             r: share(r) - len(inv[r])
             for r, c in consumers.items()
-            if c > 0 and len(inv[r]) < share(r)
+            if c > 0 and 2 * len(inv[r]) < share(r)
         }
         if not deficits:
             return []

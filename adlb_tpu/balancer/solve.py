@@ -87,22 +87,28 @@ def _auction_assign(task_prio, task_type, req_mask, req_valid, rounds=6):
 def _host_greedy(task_prio, task_type, req_mask, req_valid):
     """Numpy twin of :func:`_greedy_assign` — bit-identical semantics, used
     below a size threshold where an accelerator dispatch round-trip costs
-    more than the whole solve. Early-exits once every requester is matched,
-    so typical cost is O(matched * NR)."""
+    more than the whole solve.
+
+    Considers only tasks whose type some open requester accepts (tasks of
+    other types can never match, so skipping them cannot change the greedy
+    outcome) and early-exits once every requester is matched — so a round
+    where the only parked requester wants a type with no queued inventory
+    (gfmc's answer collector) costs one vectorized mask, not a scan."""
     NR = req_mask.shape[0]
     assign = np.full((NR,), -1, dtype=np.int32)
     open_req = req_valid.copy()
     n_open = int(open_req.sum())
     if n_open == 0:
         return assign
-    order = np.argsort(-task_prio, kind="stable")
+    wanted = req_mask[open_req].any(axis=0)  # [T]
+    live = (task_prio > int(_NEG)) & (task_type >= 0)
+    live &= wanted[np.clip(task_type, 0, None)]
+    cand = np.nonzero(live)[0]
+    if cand.size == 0:
+        return assign
+    order = cand[np.argsort(-task_prio[cand], kind="stable")]
     for t in order:
-        prio = task_prio[t]
-        if prio <= int(_NEG):
-            break  # rest is padding
         tt = task_type[t]
-        if tt < 0:
-            continue
         compat = open_req & req_mask[:, tt]
         r = int(np.argmax(compat))
         if not compat[r]:
@@ -176,21 +182,13 @@ class AssignmentSolver:
         S, K, R, T = len(servers), self.K, self.R, len(self.types)
         if S == 0:
             return []
-        task_prio = np.full((S * K,), int(_NEG), dtype=np.int32)
-        task_type = np.full((S * K,), -1, dtype=np.int32)
-        task_ref: list = [None] * (S * K)
         req_mask = np.zeros((S * R, T), dtype=bool)
         req_valid = np.zeros((S * R,), dtype=bool)
         req_ref: list = [None] * (S * R)
-
         for si, s in enumerate(servers):
-            snap = snapshots[s]
-            for ki, (seqno, wtype, prio, _len) in enumerate(snap["tasks"][:K]):
-                i = si * K + ki
-                task_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio))
-                task_type[i] = self.type_index.get(wtype, -1)
-                task_ref[i] = (s, seqno)
-            for ri, (rank, rqseqno, req_types) in enumerate(snap["reqs"][:R]):
+            for ri, (rank, rqseqno, req_types) in enumerate(
+                snapshots[s]["reqs"][:R]
+            ):
                 i = si * R + ri
                 req_valid[i] = True
                 if req_types is None:
@@ -201,18 +199,50 @@ class AssignmentSolver:
                         if ti is not None:
                             req_mask[i, ti] = True
                 req_ref[i] = (s, rank, rqseqno)
-
         n_reqs = int(req_valid.sum())
-        if n_reqs == 0 or (task_type < 0).all():
+        if n_reqs == 0:
             return []
 
-        if (
+        host = (
             self.host_threshold_reqs is not None
             and n_reqs <= self.host_threshold_reqs
-        ):
+        )
+        if host:
+            # pack only tasks of a type some requester wants: others can
+            # never match, and skipping them up front keeps the per-round
+            # host cost proportional to useful work, not queue depth
+            wanted = req_mask[req_valid].any(axis=0)  # [T]
+            prios: list = []
+            ttypes: list = []
+            task_ref = []
+            for si, s in enumerate(servers):
+                for seqno, wtype, prio, _len in snapshots[s]["tasks"][:K]:
+                    ti = self.type_index.get(wtype, -1)
+                    if ti < 0 or not wanted[ti]:
+                        continue
+                    prios.append(max(-_PRIO_CLIP, min(_PRIO_CLIP, prio)))
+                    ttypes.append(ti)
+                    task_ref.append((s, seqno))
+            if not task_ref:
+                return []
+            task_prio = np.asarray(prios, dtype=np.int32)
+            task_type = np.asarray(ttypes, dtype=np.int32)
             assign = _host_greedy(task_prio, task_type, req_mask, req_valid)
             self.host_solve_count += 1
         else:
+            task_prio = np.full((S * K,), int(_NEG), dtype=np.int32)
+            task_type = np.full((S * K,), -1, dtype=np.int32)
+            task_ref = [None] * (S * K)
+            for si, s in enumerate(servers):
+                for ki, (seqno, wtype, prio, _len) in enumerate(
+                    snapshots[s]["tasks"][:K]
+                ):
+                    i = si * K + ki
+                    task_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio))
+                    task_type[i] = self.type_index.get(wtype, -1)
+                    task_ref[i] = (s, seqno)
+            if (task_type < 0).all():
+                return []
             assign = np.asarray(
                 self._device_assign()(
                     jnp.asarray(task_prio),

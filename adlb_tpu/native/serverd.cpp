@@ -123,6 +123,8 @@ enum WireTag : uint16_t {
   T_SS_ABORT = 1116,
   T_SS_PERIODIC_STATS = 1122,
   T_SS_STATE = 1117,
+  T_SS_STATE_DELTA = 1125,
+  T_SS_HUNGRY = 1124,
   T_SS_PLAN_MATCH = 1118,
   T_SS_PLAN_MIGRATE = 1119,
   T_SS_MIGRATE_WORK = 1120,
@@ -195,6 +197,8 @@ enum FieldId : uint8_t {
   F_QM_TABLE = 56,        // list: (rank, nbytes, qlen, prio[T])* ring token
   F_PUT_ID = 58,          // i64: pipelined-put id echoed in TA_PUT_RESP
   F_FETCH = 59,           // i64: fused reserve+get request (get_work)
+  F_HUNGRY = 60,          // i64: balancer -> servers, parked reqs exist
+  F_GREW = 61,            // i64: the hungry wanted-set grew
   F_PSTATS_BLOB = 57,     // bytes: packed periodic-stats ring token entries
 };
 
@@ -906,6 +910,18 @@ class Server {
       case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
       case T_PEER_EOF: on_peer_eof(m); break;
       case T_SS_PERIODIC_STATS: on_periodic_stats(m); break;
+      case T_SS_HUNGRY: {
+        hungry_ = m.geti(F_HUNGRY, 0) != 0;
+        const std::vector<int64_t>* ts = m.getl(F_REQ_TYPES);
+        hungry_any_ = hungry_ && ts == nullptr;
+        hungry_types_.clear();
+        if (ts != nullptr)
+          for (int64_t t : *ts) hungry_types_.insert(int32_t(t));
+        // when the wanted-set grows our inventory of those types may be
+        // heartbeat-stale at the sidecar: refresh so the solve sees it
+        if (hungry_ && m.geti(F_GREW, 0) != 0) send_snapshot();
+        break;
+      }
       case T_SS_PLAN_MATCH: on_plan_match(m); break;
       case T_SS_PLAN_MIGRATE: on_plan_migrate(m); break;
       case T_SS_MIGRATE_WORK: on_migrate_work(m); break;
@@ -918,8 +934,20 @@ class Server {
     if (now >= next_qmstat_) {
       next_qmstat_ = cfg_.tpu_mode ? now + cfg_.balancer_interval
                                    : now + cfg_.qmstat_interval;
-      if (cfg_.tpu_mode) send_snapshot();
-      else broadcast_qmstat();
+      if (cfg_.tpu_mode) {
+        // O(wq) walk: fast cadence only while someone is parked AND this
+        // server could contribute (inventory for the solve, or its own
+        // parked requesters whose fresh stamps keep them re-plannable),
+        // or under memory pressure; slow heartbeat otherwise (parks send
+        // event snapshots themselves)
+        bool relevant = hungry_ && (!rq_.empty() || wq_has_untargeted());
+        if (relevant || mem_under_pressure() || now >= next_idle_snap_) {
+          next_idle_snap_ = now + 0.25;
+          send_snapshot();
+        }
+      } else {
+        broadcast_qmstat();
+      }
       if (mem_under_pressure()) try_push();
     }
     if (master_ && now >= next_exhaust_) {
@@ -996,7 +1024,14 @@ class Server {
     r.seti(F_RC, ADLB_SUCCESS);
     echo_pid(r);
     ep_->send(m.src, r);
-    if (e == nullptr) maybe_event_snapshot();
+    // event path for an untargeted put of a type some parked requester
+    // wants (SS_HUNGRY): an O(1) DELTA carrying just this unit, not the
+    // O(wq) snapshot walk; targeted puts match at the target's home
+    // server and never enter snapshots, and the periodic heartbeat
+    // covers everything else
+    if (e == nullptr && u.target_rank < 0 && hungry_ &&
+        (hungry_any_ || hungry_types_.count(u.work_type)))
+      maybe_event_delta(seqno, u.work_type, u.prio, int64_t(u.payload_len));
   }
 
   void on_put_common(const NMsg& m) {
@@ -1950,12 +1985,37 @@ class Server {
   // SS_PLAN_MIGRATE exactly like the Python server does (plan entries are
   // hints validated against live state; staleness is harmless).
 
+  // Any available (unpinned, untargeted) unit? Amortized-cheap: peek_best
+  // pops stale lazy-heap tops, each popped at most once over its lifetime.
+  // A server holding only targeted work (gfmc's answer collectors) must
+  // not count as snapshot-relevant — its walk would ship nothing.
+  bool wq_has_untargeted() {
+    for (auto& kv : wq_.untargeted)
+      if (wq_.peek_best(&kv.second, -1) != nullptr) return true;
+    return false;
+  }
+
   void maybe_event_snapshot() {
     if (!cfg_.tpu_mode) return;
     double now = monotonic();
     if (now - last_event_snap_ < cfg_.balancer_min_gap) return;
     last_event_snap_ = now;
     send_snapshot();
+  }
+
+  void maybe_event_delta(int64_t seqno, int32_t wtype, int32_t prio,
+                         int64_t len) {
+    if (!cfg_.tpu_mode || cfg_.balancer_rank < 0) return;
+    double now = monotonic();
+    if (now - last_event_snap_ < cfg_.balancer_min_gap) return;
+    last_event_snap_ = now;
+    NMsg m = mk(T_SS_STATE_DELTA);
+    m.seti(F_SEQNO, seqno);
+    m.seti(F_WORK_TYPE, wtype);
+    m.seti(F_PRIO, prio);
+    m.seti(F_WORK_LEN, len);
+    m.seti(F_NBYTES, mem_curr_);
+    ep_->send(cfg_.balancer_rank, m);
   }
 
   void send_snapshot() {
@@ -2232,6 +2292,10 @@ class Server {
   std::unordered_map<int64_t, int64_t> push_reserved_;  // qid -> bytes
   int64_t migrate_unacked_ = 0;
   double last_event_snap_ = 0.0;
+  bool hungry_ = false;  // sidecar says: parked requesters exist somewhere
+  bool hungry_any_ = false;  // ... and one of them accepts any type
+  std::set<int32_t> hungry_types_;  // the types parked requesters want
+  double next_idle_snap_ = 0.0;  // slow snapshot heartbeat when not hungry
   bool last_snap_empty_ = false;
 
   bool no_more_work_ = false;

@@ -132,6 +132,10 @@ class NativeWorkQueue:
     def num_unpinned_untargeted(self) -> int:
         return self._lib.adlb_wq_num_unpinned_untargeted(self._h)
 
+    # availability signal for the balancer's snapshot gating (the Python
+    # queue keeps an O(1) counter; the C core's count is cheap per tick)
+    untargeted_avail = property(num_unpinned_untargeted)
+
     def hi_prio_of_type(self, work_type: int) -> int:
         out = ctypes.c_int32()
         rc = self._lib.adlb_wq_hi_prio_of_type(

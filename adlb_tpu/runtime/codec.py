@@ -85,6 +85,8 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_END_2: 1115,
     Tag.SS_ABORT: 1116,
     Tag.SS_STATE: 1117,
+    Tag.SS_STATE_DELTA: 1125,
+    Tag.SS_HUNGRY: 1124,
     Tag.SS_PLAN_MATCH: 1118,
     Tag.SS_PLAN_MIGRATE: 1119,
     Tag.SS_MIGRATE_WORK: 1120,
@@ -151,6 +153,11 @@ FIELDS: dict[str, tuple[int, int]] = {
     # fused reserve+get (get_work): payload rides TA_RESERVE_RESP when the
     # unit is local and prefix-free
     "fetch": (59, _KIND_I64),
+    # balancer -> servers: parked requesters exist somewhere, so put-side
+    # event snapshots are worth sending (SS_HUNGRY; req_types carries the
+    # wanted-type set, omitted = an any-type requester is parked)
+    "hungry": (60, _KIND_I64),
+    "grew": (61, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -68,6 +68,8 @@ class Tag(enum.Enum):
 
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()
+    SS_STATE_DELTA = enum.auto()  # one new task appended to last snapshot
+    SS_HUNGRY = enum.auto()  # master -> servers: parked requesters exist
     SS_PLAN_MATCH = enum.auto()
     SS_PLAN_MIGRATE = enum.auto()  # planner: move these units to dest
     SS_MIGRATE_WORK = enum.auto()  # holder -> dest: the moved units

@@ -73,6 +73,9 @@ class WorkQueue:
         self.count = 0
         self.max_count = 0
         self.total_bytes = 0
+        # O(1) availability signal for the balancer's snapshot gating:
+        # number of unpinned untargeted units (exact, unlike the lazy heaps)
+        self.untargeted_avail = 0
 
     # -- insertion / removal -------------------------------------------------
 
@@ -84,6 +87,8 @@ class WorkQueue:
         self.total_bytes += len(unit.payload)
         if not unit.pinned:
             self._index(unit)
+            if unit.target_rank < 0:
+                self.untargeted_avail += 1
 
     def _index(self, unit: WorkUnit) -> None:
         key = (-unit.prio, unit.seqno)
@@ -104,18 +109,24 @@ class WorkQueue:
         unit = self._units.pop(seqno)
         self.count -= 1
         self.total_bytes -= len(unit.payload)
+        if not unit.pinned and unit.target_rank < 0:
+            self.untargeted_avail -= 1
         return unit  # stale heap entries are skipped lazily
 
     # -- pin discipline ------------------------------------------------------
 
     def pin(self, seqno: int, rank: int) -> None:
         unit = self._units[seqno]
+        if not unit.pinned and unit.target_rank < 0:
+            self.untargeted_avail -= 1
         unit.pinned = True
         unit.pin_rank = rank
         # heap entry goes stale; skipped on pop
 
     def unpin(self, seqno: int) -> None:
         unit = self._units[seqno]
+        if unit.pinned and unit.target_rank < 0:
+            self.untargeted_avail += 1
         unit.pinned = False
         unit.pin_rank = -1
         self._index(unit)

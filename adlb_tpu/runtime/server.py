@@ -208,6 +208,26 @@ class Server:
         self._balancer: Optional[_BalancerWorker] = None
         if cfg.balancer == "tpu" and self.is_master:
             self._balancer = _BalancerWorker(self)
+        # "hungry" = some requester is parked somewhere in the world whose
+        # requested types new inventory could satisfy, so an untargeted put
+        # of such a type is worth snapshotting immediately. Gates the
+        # put-side event snapshots: without it every put pays the O(wq)
+        # snapshot walk even when nobody is waiting (a measurable GIL tax
+        # on compute-bound workloads). Type-aware so a permanently parked
+        # collector of targeted answers (gfmc's master waiting on TYPE_D,
+        # which only ever arrives as targeted puts the planner never sees)
+        # does not keep the whole world snapshotting. Master tracks parked
+        # types from the snapshots it already receives and broadcasts only
+        # set changes. A stale-low flag merely defers discovery to the
+        # balancer's periodic snapshot heartbeat.
+        self._hungry = False  # some parked requester exists (any type)
+        self._hungry_any = False  # a parked requester accepts any type
+        self._hungry_types: frozenset = frozenset()
+        self._parked_types: dict[int, tuple] = {}  # src -> (any, types)
+        self._hungry_shrink_since: Optional[float] = None  # held shrink
+        self._park_res_local: dict[int, bool] = {}  # rank -> last park local?
+        self._req_sigs: dict[int, tuple] = {}  # src -> last parked-req set
+        self._next_idle_snap = 0.0  # slow snapshot heartbeat when not hungry
 
         # stats (InfoKey surface, reference src/adlb.c:3072-3141)
         self.stats = {k: 0.0 for k in InfoKey}
@@ -281,6 +301,8 @@ class Server:
             Tag.SS_ABORT: self._on_ss_abort,
             Tag.SS_PERIODIC_STATS: self._on_periodic_stats,
             Tag.SS_STATE: self._on_state,
+            Tag.SS_STATE_DELTA: self._on_state_delta,
+            Tag.SS_HUNGRY: self._on_hungry,
             Tag.SS_PLAN_MATCH: self._on_plan_match,
             Tag.SS_PLAN_MIGRATE: self._on_plan_migrate,
             Tag.SS_MIGRATE_WORK: self._on_migrate_work,
@@ -379,11 +401,31 @@ class Server:
         if now >= self._next_state_sync:
             self._next_state_sync = now + interval
             if self.cfg.balancer == "tpu":
-                self._send_snapshot()
+                # The snapshot walk is O(wq); at the fast balancer cadence
+                # it is a real GIL tax on compute-bound workloads. Walk it
+                # fast only while it matters: someone is parked (_hungry)
+                # AND this server could contribute — untargeted inventory
+                # for the solve, or its own parked requesters whose fresh
+                # stamps keep them re-plannable. Memory pressure also
+                # qualifies (planner-side admission wants fresh nbytes).
+                # Otherwise a slow heartbeat (parks themselves send event
+                # snapshots immediately).
+                relevant = self._hungry and (
+                    self.wq.untargeted_avail > 0 or len(self.rq) > 0
+                )
+                if (
+                    relevant
+                    or self.mem.under_pressure
+                    or now >= self._next_idle_snap
+                ):
+                    self._next_idle_snap = now + 0.25
+                    self._send_snapshot()
             else:
                 self._broadcast_qmstat()
             if self.mem.under_pressure:
                 self._try_push()
+        if self.is_master and self.cfg.balancer == "tpu":
+            self._flush_hungry_shrink(now)
         if self.is_master and now >= self._next_exhaust_check:
             self._next_exhaust_check = now + self.cfg.exhaust_check_interval
             self._check_exhaustion(now)
@@ -513,9 +555,16 @@ class Server:
         self._forward_pstats(token)
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
-                        holder: Optional[int] = None) -> None:
-        """Hand a unit to a parked requester and account the wait."""
+                        holder: Optional[int] = None,
+                        local: bool = True) -> None:
+        """Hand a unit to a parked requester and account the wait.
+
+        ``local`` records how this rank's park got resolved — by a local
+        put (True) or by cross-server delivery (push/migrate/unreserve
+        re-match, False) — which drives the adaptive park-event gating in
+        ``_on_reserve``."""
         self.rq.remove(entry.world_rank)
+        self._park_res_local[entry.world_rank] = local
         self._rfr_excluded.pop(entry.world_rank, None)
         wait = time.monotonic() - entry.time_stamp
         self._rq_wait_sum += wait
@@ -535,7 +584,9 @@ class Server:
                 unit = self.wq.find_match(entry.world_rank, entry.req_types)
                 if unit is not None:
                     self.wq.pin(unit.seqno, entry.world_rank)
-                    self._satisfy_parked(entry, unit)
+                    # _match_rq runs after cross-server deliveries
+                    # (push/migrate arrivals, unreserve compensation)
+                    self._satisfy_parked(entry, unit, local=False)
                     progressed = True
                     break
 
@@ -694,14 +745,25 @@ class Server:
             m.src,
             msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS, put_id=put_id),
         )
-        if entry is None and self.cfg.balancer == "tpu":
-            # event-driven like parks: new unmatched inventory refreshes the
-            # balancer's view immediately (rate-limited), so a requester
-            # parked on ANOTHER server isn't left waiting for the next tick
+        if (
+            entry is None
+            and self.cfg.balancer == "tpu"
+            and unit.target_rank < 0
+            and self._hungry_for(unit.work_type)
+        ):
+            # event-driven like parks: new unmatched inventory reaches the
+            # balancer immediately (rate-limited), so a requester parked on
+            # ANOTHER server isn't left waiting for the next heartbeat.
+            # Only untargeted puts of a type someone is parked for —
+            # targeted puts match at the target's home server and never
+            # enter snapshots. An O(1) DELTA (just this unit's metadata),
+            # not the O(wq) snapshot walk: at put rates the walk is a
+            # measurable GIL tax (the full snapshot still flows on parks,
+            # hungry-transitions, and the heartbeat).
             now = time.monotonic()
             if now - self._last_event_snap >= self.cfg.balancer_min_gap:
                 self._last_event_snap = now
-                self._send_snapshot()
+                self._send_task_delta(unit)
 
     def _on_put_common(self, m: Msg) -> None:
         if not self.mem.try_alloc(len(m.payload)):
@@ -760,14 +822,24 @@ class Server:
         self.rq.add(entry)
         self._rfr_excluded.pop(app, None)
         self._try_rfr(entry)
-        if self.cfg.balancer == "tpu":
+        if self.cfg.balancer == "tpu" and not self._park_res_local.get(
+            app, False
+        ):
             # event-driven: a park immediately refreshes this server's
-            # snapshot at the balancer instead of waiting for the next tick
-            # (rate-limited; the periodic tick still covers the remainder)
+            # requester state at the balancer instead of waiting for the
+            # next heartbeat (rate-limited). Reqs-only: the park changed
+            # the rq, not the wq, so the O(wq) task walk + fat frame are
+            # skipped. Adaptive: skipped entirely for ranks whose last park
+            # resolved locally (fine-grained answer economies park per
+            # task and are served by local/targeted puts in microseconds —
+            # the balancer can't beat that, and the event would be pure
+            # GIL tax); a rank the balancer last had to serve remotely
+            # keeps the immediate event flow. A misprediction only defers
+            # discovery to the heartbeat.
             now = time.monotonic()
             if now - self._last_event_snap >= self.cfg.balancer_min_gap:
                 self._last_event_snap = now
-                self._send_snapshot()
+                self._send_snapshot(reqs_only=True)
 
     def _on_get_reserved(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
@@ -953,6 +1025,7 @@ class Server:
             if m.target_rank >= 0 and app == m.target_rank:
                 self.tq.remove(app, m.work_type, m.src)
             self.rq.remove(app)
+            self._park_res_local[app] = False  # RFR/plan = remote delivery
             self._rfr_excluded.pop(app, None)
             wait = time.monotonic() - entry.time_stamp
             self._rq_wait_sum += wait
@@ -1219,24 +1292,30 @@ class Server:
 
     # ------------------------------------------------------- balancer (tpu)
 
-    def _send_snapshot(self) -> None:
-        K = self.cfg.balancer_max_tasks
-        snapshot_fast = getattr(self.wq, "snapshot_untargeted", None)
-        if snapshot_fast is not None:
-            tasks = snapshot_fast(K)  # sorted in C++
+    def _send_snapshot(self, reqs_only: bool = False) -> None:
+        """Ship queue state to the balancer. ``reqs_only`` skips the O(wq)
+        task walk (and the fat task list in the frame) for events that only
+        changed the rq — the receiver keeps its previous task view."""
+        if reqs_only:
+            tasks = None
         else:
-            import heapq as _heapq
+            K = self.cfg.balancer_max_tasks
+            snapshot_fast = getattr(self.wq, "snapshot_untargeted", None)
+            if snapshot_fast is not None:
+                tasks = snapshot_fast(K)  # sorted in C++
+            else:
+                import heapq as _heapq
 
-            # O(n log K), not a full sort: this runs on the reactor thread
-            tasks = _heapq.nsmallest(
-                K,
-                (
-                    (-u.prio, u.seqno, u.work_type, len(u.payload))
-                    for u in self.wq.units()
-                    if not u.pinned and u.target_rank < 0
-                ),
-            )
-            tasks = [(s, t, -np_, ln) for np_, s, t, ln in tasks]
+                # O(n log K), not a full sort: runs on the reactor thread
+                tasks = _heapq.nsmallest(
+                    K,
+                    (
+                        (-u.prio, u.seqno, u.work_type, len(u.payload))
+                        for u in self.wq.units()
+                        if not u.pinned and u.target_rank < 0
+                    ),
+                )
+                tasks = [(s, t, -np_, ln) for np_, s, t, ln in tasks]
         reqs = [
             (
                 e.world_rank,
@@ -1254,9 +1333,7 @@ class Server:
             "stamp": time.monotonic(),
         }
         if self.is_master:
-            self._snapshots[self.rank] = snap
-            if self._balancer is not None:
-                self._balancer.wake.set()
+            self._accept_snapshot(self.rank, snap)
         else:
             # suppress repeat empty snapshots: an idle server would otherwise
             # wake the master every tick for nothing
@@ -1269,13 +1346,189 @@ class Server:
                 msg(Tag.SS_STATE, self.rank, snap=snap),
             )
 
+    def _accept_snapshot(self, src: int, snap: dict) -> None:
+        """Master-side snapshot intake, shared by the local and remote
+        paths. A reqs-only snapshot (tasks=None) merges with the sender's
+        previous task view; stamps are split so a fresh req stamp does not
+        re-eligibilize in-flight planned tasks (and vice versa)."""
+        prev = self._snapshots.get(src)
+        if snap["tasks"] is None:
+            snap["tasks"] = prev["tasks"] if prev is not None else []
+            snap["task_stamp"] = (
+                prev.get("task_stamp", prev["stamp"]) if prev is not None
+                else snap["stamp"]
+            )
+        else:
+            snap["task_stamp"] = snap["stamp"]
+        self._snapshots[src] = snap
+        self._update_parked(src, snap["reqs"])
+        self._maybe_wake_balancer(src, snap)
+
+    def _send_task_delta(self, unit) -> None:
+        """O(1) event path for new hungry-matched untargeted inventory: ship
+        just this unit's metadata; the receiver appends it to the sender's
+        last full snapshot. Consumed-but-still-listed units are already
+        tolerated (plan entries are hints validated at enactment), so a
+        delta between full refreshes adds no new race class."""
+        # len(payload), NOT unit.work_len (payload + common prefix): full
+        # snapshots record payload bytes, and the planner's admission math
+        # compares against payload-only memory accounting
+        nlen = len(unit.payload)
+        if self.is_master:
+            self._merge_task_delta(
+                self.rank, unit.seqno, unit.work_type, unit.prio,
+                nlen, self.mem.curr,
+            )
+        else:
+            self.ep.send(
+                self.world.master_server_rank,
+                msg(
+                    Tag.SS_STATE_DELTA,
+                    self.rank,
+                    seqno=unit.seqno,
+                    work_type=unit.work_type,
+                    prio=unit.prio,
+                    work_len=nlen,
+                    nbytes=self.mem.curr,
+                ),
+            )
+
+    def _merge_task_delta(
+        self, src: int, seqno: int, work_type: int, prio: int,
+        work_len: int, nbytes: int,
+    ) -> None:
+        snap = self._snapshots.get(src)
+        if snap is None:
+            return  # no baseline yet; the next full snapshot delivers it
+        if len(snap["tasks"]) < self.cfg.balancer_max_tasks:
+            snap["tasks"].append((seqno, work_type, prio, work_len))
+        snap["nbytes"] = nbytes
+        # NOTE: snap["stamp"] is NOT bumped — requester (re-)eligibility in
+        # the plan ledger must only come from full snapshots that re-observe
+        # the requester parked; the new task is eligible under any stamp.
+        if self._balancer is not None:
+            self._balancer.wake.set()
+
+    def _on_state_delta(self, m: Msg) -> None:
+        self._merge_task_delta(
+            m.src, m.seqno, m.work_type, m.prio, m.work_len, m.nbytes
+        )
+
     def _on_state(self, m: Msg) -> None:
         # re-stamp on the master's clock: plan-ledger comparisons must never
         # mix monotonic clocks from different hosts
         m.snap["stamp"] = time.monotonic()
-        self._snapshots[m.src] = m.snap
-        if self._balancer is not None and m.snap["reqs"]:
+        self._accept_snapshot(m.src, m.snap)
+
+    def _maybe_wake_balancer(self, src: int, snap: dict) -> None:
+        """Wake the balancer thread only when a round could plan something
+        new: this server's parked-requester set changed (a new park to
+        match / a satisfied one to retire), or it reports inventory while
+        someone somewhere is parked (the match case for event snapshots).
+        A permanently parked requester re-reported in every snapshot (a
+        collector of targeted answers, e.g. gfmc's master) must NOT keep
+        the round loop spinning — rounds cost real GIL time."""
+        if self._balancer is None:
+            return
+        sig = tuple(sorted((r[0], r[1]) for r in snap["reqs"]))
+        changed = sig != self._req_sigs.get(src)
+        self._req_sigs[src] = sig
+        if changed or (
+            snap["tasks"]
+            and self._hungry
+            and (
+                self._hungry_any
+                or any(t[1] in self._hungry_types for t in snap["tasks"])
+            )
+        ):
             self._balancer.wake.set()
+
+    def _update_parked(self, src: int, reqs) -> None:
+        """Master bookkeeping of which work types parked requesters want;
+        on a change of the global wanted-set, broadcast SS_HUNGRY so peers
+        know which puts make an event snapshot worth the walk.
+
+        Set GROWTH broadcasts immediately (a newly wanted type must start
+        flowing event deltas now); set shrinkage is held for a grace
+        period — fine-grained workloads park/unpark the same types many
+        times a second, and flapping the set would churn broadcasts and
+        the grew-triggered snapshot refreshes."""
+        any_type = any(r[2] is None for r in reqs)
+        types = frozenset(t for r in reqs if r[2] is not None for t in r[2])
+        self._parked_types[src] = (any_type, types)
+        hungry_any = any(v[0] for v in self._parked_types.values())
+        hungry_types = frozenset(
+            t for v in self._parked_types.values() for t in v[1]
+        )
+        grew = (hungry_any and not self._hungry_any) or bool(
+            hungry_types - self._hungry_types
+        )
+        if not grew:
+            if (hungry_any, hungry_types) == (
+                self._hungry_any, self._hungry_types,
+            ):
+                self._hungry_shrink_since = None
+                return
+            # pure shrink: hold it; flush happens in _periodic after grace
+            if self._hungry_shrink_since is None:
+                self._hungry_shrink_since = time.monotonic()
+            return
+        self._hungry_shrink_since = None
+        self._broadcast_hungry(hungry_any, hungry_types, grew=True)
+
+    def _flush_hungry_shrink(self, now: float) -> None:
+        """Master: apply a held hungry-set shrink once stable for 100 ms."""
+        if (
+            self._hungry_shrink_since is None
+            or now - self._hungry_shrink_since < 0.1
+        ):
+            return
+        self._hungry_shrink_since = None
+        hungry_any = any(v[0] for v in self._parked_types.values())
+        hungry_types = frozenset(
+            t for v in self._parked_types.values() for t in v[1]
+        )
+        if (hungry_any, hungry_types) != (
+            self._hungry_any, self._hungry_types,
+        ):
+            self._broadcast_hungry(hungry_any, hungry_types, grew=False)
+
+    def _broadcast_hungry(
+        self, hungry_any: bool, hungry_types: frozenset, grew: bool
+    ) -> None:
+        self._hungry_any = hungry_any
+        self._hungry_types = hungry_types
+        self._hungry = hungry_any or bool(hungry_types)
+        for s in self.world.server_ranks:
+            if s != self.rank:
+                self.ep.send(
+                    s,
+                    msg(
+                        Tag.SS_HUNGRY,
+                        self.rank,
+                        hungry=int(self._hungry),
+                        # req_types omitted (None) = any-type requester
+                        req_types=(
+                            None if hungry_any else sorted(hungry_types)
+                        ),
+                        grew=int(grew),
+                    ),
+                )
+
+    def _hungry_for(self, work_type: int) -> bool:
+        return self._hungry and (
+            self._hungry_any or work_type in self._hungry_types
+        )
+
+    def _on_hungry(self, m: Msg) -> None:
+        self._hungry = bool(m.hungry)
+        raw = m.data.get("req_types")
+        self._hungry_any = self._hungry and raw is None
+        self._hungry_types = frozenset(raw or ())
+        if self._hungry and m.data.get("grew"):
+            # the wanted-set grew: our inventory of the newly wanted types
+            # may be heartbeat-stale at the balancer — refresh it now
+            self._send_snapshot()
 
     def _on_plan_match(self, m: Msg) -> None:
         """Enact one plan entry: validate against live state, pin, and hand

@@ -95,19 +95,66 @@ def main() -> None:
     tpu = best_of("tpu")
 
     # tsp: the other BASELINE.json-named workload (branch-and-bound with
-    # broadcast bound updates; compute-bound like nq at this scale)
+    # broadcast bound updates; compute-bound like nq at this scale).
+    # n_cities=10 so the run is long enough (~3.5 s) that the 0.2 s
+    # exhaustion-termination quantum stays noise (<5%), and best-of-3 like
+    # nq — B&B node counts are nondeterministic run to run in both modes.
     from adlb_tpu.workloads import tsp
 
-    def tsp_rate(mode: str):
-        dists = tsp.dist_matrix(tsp.make_cities(9, seed=3))
-        want = tsp.brute_force_optimum(dists)
-        r = tsp.run(n_cities=9, num_app_ranks=APPS, nservers=SERVERS,
-                    seed=3, cfg=cfg(mode), timeout=600.0)
-        assert r.best == want, f"tsp {mode}: {r.best} != {want}"
-        return r.tasks_per_sec
+    TSP_N = 10
+    tsp_want = tsp.brute_force_optimum(
+        tsp.dist_matrix(tsp.make_cities(TSP_N, seed=3))
+    )
+
+    def tsp_rate(mode: str, reps: int = 3):
+        best = 0.0
+        for _ in range(reps):
+            r = tsp.run(n_cities=TSP_N, num_app_ranks=APPS, nservers=SERVERS,
+                        seed=3, cfg=cfg(mode), timeout=600.0)
+            assert r.best == tsp_want, f"tsp {mode}: {r.best} != {tsp_want}"
+            best = max(best, r.tasks_per_sec)
+        return best
 
     tsp_steal = tsp_rate("steal")
     tsp_tpu = tsp_rate("tpu")
+
+    # sudoku + gfmc (the self-checking GFMC mini-app economy, reference
+    # examples/c4.c): the remaining reference-named workloads, mode vs mode
+    from adlb_tpu.workloads import gfmc, sudoku
+
+    # 17-clue grid: enough search that the run is not over in one burst.
+    # First-solution search luck swings node counts per run, so the rate is
+    # aggregated over reps (total tasks / total time), not best-of.
+    SUDOKU_HARD = (
+        "000000010400000000020000000000050407008000300001090000"
+        "300400200050100000000806000"
+    )
+
+    def sudoku_rate(mode: str, reps: int = 3):
+        tasks = 0
+        secs = 0.0
+        for _ in range(reps):
+            r = sudoku.run(puzzle=SUDOKU_HARD, num_app_ranks=APPS,
+                           nservers=SERVERS, cfg=cfg(mode), timeout=600.0)
+            assert r.valid, f"sudoku {mode}: invalid solution"
+            tasks += r.tasks_processed
+            secs += r.elapsed
+        return tasks / secs
+
+    def gfmc_rate(mode: str, reps: int = 3):
+        best = 0.0
+        for _ in range(reps):
+            r = gfmc.run(num_a=400, bs_per_a=8, cs_per_b=5,
+                         num_app_ranks=APPS, nservers=SERVERS,
+                         cfg=cfg(mode), timeout=600.0)
+            assert r.ok, f"gfmc {mode}: wrong counts {r.counts}"
+            best = max(best, r.tasks_per_sec)
+        return best
+
+    sudoku_steal = sudoku_rate("steal")
+    sudoku_tpu = sudoku_rate("tpu")
+    gfmc_steal = gfmc_rate("steal")
+    gfmc_tpu = gfmc_rate("tpu")
 
     # hotspot: all work enters one server, consumers everywhere — the
     # balancing scenario ADLB exists for; makespan-based, GIL-free work.
@@ -241,8 +288,18 @@ def main() -> None:
             "nq_tpu_tasks_per_sec": round(tpu.tasks_per_sec, 1),
             "nq_ratio": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
             if steal.tasks_per_sec else 0.0,
+            "tsp_n_cities": TSP_N,
             "tsp_steal_tasks_per_sec": round(tsp_steal, 1),
             "tsp_tpu_tasks_per_sec": round(tsp_tpu, 1),
+            "tsp_ratio": round(tsp_tpu / tsp_steal, 3) if tsp_steal else 0.0,
+            "sudoku_steal_tasks_per_sec": round(sudoku_steal, 1),
+            "sudoku_tpu_tasks_per_sec": round(sudoku_tpu, 1),
+            "sudoku_ratio": round(sudoku_tpu / sudoku_steal, 3)
+            if sudoku_steal else 0.0,
+            "gfmc_steal_tasks_per_sec": round(gfmc_steal, 1),
+            "gfmc_tpu_tasks_per_sec": round(gfmc_tpu, 1),
+            "gfmc_ratio": round(gfmc_tpu / gfmc_steal, 3)
+            if gfmc_steal else 0.0,
             "steal_pop_latency_p50_ms": round(lat_steal.latency_p50_ms, 3),
             "tpu_pop_latency_p50_ms": round(lat_tpu.latency_p50_ms, 3),
             "steal_pops_per_sec": round(lat_steal.pops_per_sec, 1),

@@ -97,3 +97,90 @@ def test_tpu_mode_end_to_end():
     assert res.app_results[0] == 3 * sum(range(NTASK))
     # workers collectively processed everything
     assert sum(res.app_results[r] for r in range(1, 4)) == NTASK
+
+
+def test_migration_hysteresis():
+    """Fair-share migrations fire only below half share: servers hovering
+    near their share must not shuffle inventory (a GIL/message tax on
+    already-balanced compute-bound workloads), while a starved server
+    still gets supplied immediately."""
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=16, max_requesters=4)
+    # near-balanced: 5 vs 4 with equal consumers -> no moves
+    snaps = {
+        10: {"tasks": [(i, T1, 1, 8) for i in range(5)], "reqs": [],
+             "consumers": 1},
+        11: {"tasks": [(i, T1, 1, 8) for i in range(4)], "reqs": [],
+             "consumers": 1},
+    }
+    _, migs = eng.round(snaps, None)
+    assert migs == []
+    # starved: 8 vs 0 -> the empty server is under half share
+    eng2 = PlanEngine(types=(T1,), max_tasks=16, max_requesters=4)
+    snaps2 = {
+        10: {"tasks": [(i, T1, 1, 8) for i in range(8)], "reqs": [],
+             "consumers": 1},
+        11: {"tasks": [], "reqs": [], "consumers": 1},
+    }
+    _, migs2 = eng2.round(snaps2, None)
+    assert migs2 and migs2[0][0] == 10 and migs2[0][1] == 11
+
+
+def test_hungry_gates_put_snapshots(monkeypatch):
+    """A world whose cross-rank traffic is all TARGETED (gfmc's collector
+    shape: answers only ever arrive as targeted puts) must not pay an
+    event snapshot per put — only the parked-reserve events plus the slow
+    idle heartbeat remain."""
+    from adlb_tpu.runtime import server as srv
+
+    calls = {"n": 0}
+    orig = srv.Server._send_snapshot
+
+    def counting(self, reqs_only=False):
+        calls["n"] += 1
+        orig(self, reqs_only=reqs_only)
+
+    monkeypatch.setattr(srv.Server, "_send_snapshot", counting)
+    NTASK = 300
+
+    def app(ctx):
+        import time as _t
+
+        if ctx.rank == 0:
+            for i in range(NTASK):
+                # targeted straight at rank 1: matches at its home server,
+                # never enters a balancer snapshot
+                assert (
+                    ctx.put(str(i).encode(), T1, work_prio=1, target_rank=1)
+                    == ADLB_SUCCESS
+                )
+            rc, r = ctx.reserve([T2])  # consumer's all-done ack
+            assert rc == ADLB_SUCCESS
+            ctx.get_reserved(r.handle)
+            ctx.set_problem_done()
+            return 0
+        # let the producer run ahead so consuming never parks (each park
+        # legitimately sends an ungated event snapshot, like steal's RFR)
+        _t.sleep(0.5)
+        n = 0
+        for _ in range(NTASK):
+            rc, r = ctx.reserve([T1])
+            assert rc == ADLB_SUCCESS
+            ctx.get_reserved(r.handle)
+            n += 1
+        ctx.put(b"done", T2, target_rank=0)
+        rc, _ = ctx.reserve([T1])  # parks until NO_MORE_WORK
+        assert rc != ADLB_SUCCESS
+        return n
+
+    res = run_world(
+        2, 2, [T1, T2], app,
+        cfg=Config(balancer="tpu", balancer_max_tasks=64,
+                   balancer_max_requesters=16),
+        timeout=300.0,
+    )
+    assert res.app_results[1] == NTASK
+    # ungated, this would be >= NTASK/2 snapshots (one per couple of
+    # puts); gated it is a few parks + the slow idle heartbeat
+    assert calls["n"] < 40, calls["n"]
