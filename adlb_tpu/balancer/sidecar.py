@@ -102,9 +102,24 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
     servers = set(world.server_ranks)
     rounds = 0
     dirty = False
-    hungry = (False, frozenset())  # (any-type parked, wanted type set)
-    shrink_since = None  # pending hungry-set shrink, held for grace
+    # one state machine shared with the in-server master: growth
+    # broadcasts immediately, shrinks held for grace (see hungry.py)
+    from adlb_tpu.balancer.hungry import HungryTracker
+
+    tracker = HungryTracker()
     me = world.nranks  # pseudo-rank
+
+    def broadcast(payload) -> None:
+        if payload is None:
+            return
+        is_hungry, req_types, grew = payload
+        for s in servers - ended:
+            ep.send(
+                s,
+                msg(Tag.SS_HUNGRY, me, hungry=int(is_hungry),
+                    req_types=req_types, grew=int(grew)),
+            )
+
     while ended < servers:
         if abort_event is not None and abort_event.is_set():
             break
@@ -112,6 +127,7 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         while m is not None:
             if m.tag is Tag.SS_STATE:
                 snapshots[m.src] = decode_snapshot(m)
+                broadcast(tracker.update(m.src, snapshots[m.src]["reqs"]))
                 dirty = True
             elif m.tag is Tag.SS_STATE_DELTA:
                 # O(1) put-event: append one task to the sender's last full
@@ -128,48 +144,9 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
             elif m.tag is Tag.DS_END:
                 ended.add(m.src)
                 snapshots.pop(m.src, None)
+                tracker.drop(m.src)
             m = ep.recv(timeout=0.0)
-        # parked requesters exist -> tell servers which puts make an event
-        # snapshot worth sending (type-aware; a stale-low flag just defers
-        # discovery to the periodic snapshot heartbeat). Growth broadcasts
-        # immediately; shrinks are held for a 100 ms grace like the Python
-        # master's — fine-grained workloads park/unpark the same types many
-        # times a second, and flapping would churn broadcasts plus the
-        # grew-triggered snapshot refreshes on every server.
-        now_any = any(
-            r[2] is None for s in snapshots.values() for r in s["reqs"]
-        )
-        now_types = frozenset(
-            t
-            for s in snapshots.values()
-            for r in s["reqs"]
-            if r[2] is not None
-            for t in r[2]
-        )
-        was_any, was_types = hungry
-        grew = (now_any and not was_any) or bool(now_types - was_types)
-        if (now_any, now_types) == hungry:
-            shrink_since = None
-        elif not grew and shrink_since is None:
-            shrink_since = time.monotonic()
-        if grew or (
-            shrink_since is not None
-            and time.monotonic() - shrink_since >= 0.1
-        ):
-            shrink_since = None
-            hungry = (now_any, now_types)
-            is_hungry = now_any or bool(now_types)
-            for s in servers - ended:
-                ep.send(
-                    s,
-                    msg(
-                        Tag.SS_HUNGRY,
-                        me,
-                        hungry=int(is_hungry),
-                        req_types=(None if now_any else sorted(now_types)),
-                        grew=int(grew),
-                    ),
-                )
+        broadcast(tracker.flush(time.monotonic()))
         if not dirty or not snapshots:
             continue
         dirty = False

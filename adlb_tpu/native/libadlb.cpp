@@ -227,6 +227,7 @@ struct Ctx {
   std::atomic<bool> closed{false};
 
   int rr = 0;       // round-robin cursor over servers
+  bool route_home = false;  // ADLB_PUT_ROUTING=home: untargeted puts -> home
   int rqseqno = 0;  // reserve sequence number
   // batch-put state (reference src/adlb.c:2638-2751)
   bool batch_active = false;
@@ -482,6 +483,10 @@ int home_server(int app_rank) {
 }
 
 int next_server() {
+  // data-locality routing (the Python runtime's put_routing="home"): all
+  // of this rank's untargeted puts land on its home server, the scenario
+  // shape where cross-server balancing is load-bearing
+  if (g->route_home) return g->home;
   int s = g->num_app_ranks + g->rr;
   g->rr = (g->rr + 1) % g->nservers;
   return s;
@@ -601,6 +606,8 @@ int ADLBP_Init(int num_servers, int use_debug_server, int aprintf_flag,
         g->num_app_ranks - 1);
   g->home = home_server(g->rank);
   g->rr = g->rank % g->nservers;
+  const char *routing = getenv("ADLB_PUT_ROUTING");
+  g->route_home = (routing != nullptr && strcmp(routing, "home") == 0);
 
   // bind our listener at the advertised address
   g->listen_fd = socket(AF_INET, SOCK_STREAM, 0);

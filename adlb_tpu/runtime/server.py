@@ -223,8 +223,9 @@ class Server:
         self._hungry = False  # some parked requester exists (any type)
         self._hungry_any = False  # a parked requester accepts any type
         self._hungry_types: frozenset = frozenset()
-        self._parked_types: dict[int, tuple] = {}  # src -> (any, types)
-        self._hungry_shrink_since: Optional[float] = None  # held shrink
+        from adlb_tpu.balancer.hungry import HungryTracker
+
+        self._hungry_tracker = HungryTracker()  # master only
         self._park_res_local: dict[int, bool] = {}  # rank -> last park local?
         self._req_sigs: dict[int, tuple] = {}  # src -> last parked-req set
         self._next_idle_snap = 0.0  # slow snapshot heartbeat when not hungry
@@ -1445,60 +1446,21 @@ class Server:
 
     def _update_parked(self, src: int, reqs) -> None:
         """Master bookkeeping of which work types parked requesters want;
-        on a change of the global wanted-set, broadcast SS_HUNGRY so peers
-        know which puts make an event snapshot worth the walk.
-
-        Set GROWTH broadcasts immediately (a newly wanted type must start
-        flowing event deltas now); set shrinkage is held for a grace
-        period — fine-grained workloads park/unpark the same types many
-        times a second, and flapping the set would churn broadcasts and
-        the grew-triggered snapshot refreshes."""
-        any_type = any(r[2] is None for r in reqs)
-        types = frozenset(t for r in reqs if r[2] is not None for t in r[2])
-        self._parked_types[src] = (any_type, types)
-        hungry_any = any(v[0] for v in self._parked_types.values())
-        hungry_types = frozenset(
-            t for v in self._parked_types.values() for t in v[1]
-        )
-        grew = (hungry_any and not self._hungry_any) or bool(
-            hungry_types - self._hungry_types
-        )
-        if not grew:
-            if (hungry_any, hungry_types) == (
-                self._hungry_any, self._hungry_types,
-            ):
-                self._hungry_shrink_since = None
-                return
-            # pure shrink: hold it; flush happens in _periodic after grace
-            if self._hungry_shrink_since is None:
-                self._hungry_shrink_since = time.monotonic()
-            return
-        self._hungry_shrink_since = None
-        self._broadcast_hungry(hungry_any, hungry_types, grew=True)
+        the shared :class:`HungryTracker` decides when the wanted-set
+        change is worth broadcasting (growth immediately, shrinks held —
+        see adlb_tpu/balancer/hungry.py)."""
+        self._broadcast_hungry(self._hungry_tracker.update(src, reqs))
 
     def _flush_hungry_shrink(self, now: float) -> None:
-        """Master: apply a held hungry-set shrink once stable for 100 ms."""
-        if (
-            self._hungry_shrink_since is None
-            or now - self._hungry_shrink_since < 0.1
-        ):
-            return
-        self._hungry_shrink_since = None
-        hungry_any = any(v[0] for v in self._parked_types.values())
-        hungry_types = frozenset(
-            t for v in self._parked_types.values() for t in v[1]
-        )
-        if (hungry_any, hungry_types) != (
-            self._hungry_any, self._hungry_types,
-        ):
-            self._broadcast_hungry(hungry_any, hungry_types, grew=False)
+        self._broadcast_hungry(self._hungry_tracker.flush(now))
 
-    def _broadcast_hungry(
-        self, hungry_any: bool, hungry_types: frozenset, grew: bool
-    ) -> None:
-        self._hungry_any = hungry_any
-        self._hungry_types = hungry_types
-        self._hungry = hungry_any or bool(hungry_types)
+    def _broadcast_hungry(self, payload) -> None:
+        if payload is None:
+            return
+        hungry, req_types, grew = payload
+        self._hungry = hungry
+        self._hungry_any = hungry and req_types is None
+        self._hungry_types = frozenset(req_types or ())
         for s in self.world.server_ranks:
             if s != self.rank:
                 self.ep.send(
@@ -1506,11 +1468,9 @@ class Server:
                     msg(
                         Tag.SS_HUNGRY,
                         self.rank,
-                        hungry=int(self._hungry),
+                        hungry=int(hungry),
                         # req_types omitted (None) = any-type requester
-                        req_types=(
-                            None if hungry_any else sorted(hungry_types)
-                        ),
+                        req_types=req_types,
                         grew=int(grew),
                     ),
                 )

@@ -1,0 +1,85 @@
+"""Hotspot on the all-native plane: C clients + C++ server daemons (+ JAX
+balancer sidecar in tpu mode), every rank its own OS process.
+
+This is the scale story the in-process harness cannot tell: one Python
+interpreter caps a threaded world at ~5k messages/s (GIL), while the
+native plane runs the entire data path in C/C++ processes — the Python
+runtime appears only as the balancer brain. Scenario shape and metrics
+match :mod:`adlb_tpu.workloads.hotspot` (all work enters one server via
+home routing, consumers spread everywhere; reference analogue: the
+skel.c synthetic stress, reference ``examples/skel.c:10-40``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.workloads.hotspot import HotspotResult
+
+
+def run(
+    n_tasks: int = 2000,
+    work_us: int = 2000,
+    num_app_ranks: int = 32,
+    nservers: int = 8,
+    cfg: Optional[Config] = None,
+    timeout: float = 300.0,
+) -> HotspotResult:
+    from adlb_tpu.native.capi import build_example, run_native_world
+
+    base = cfg or Config()
+    cfg = dataclasses.replace(
+        base,
+        server_impl="native",
+        exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
+    )
+    examples = os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "examples",
+    )
+    exe = build_example(os.path.join(examples, "hotspot_c.c"))
+    results, _stats = run_native_world(
+        n_clients=num_app_ranks,
+        nservers=nservers,
+        types=[1],
+        exe=exe,
+        cfg=cfg,
+        env_extra={
+            "ADLB_PUT_ROUTING": "home",
+            "ADLB_HOT_NTASKS": str(n_tasks),
+            "ADLB_HOT_WORK_US": str(work_us),
+        },
+        timeout=timeout,
+    )
+    rows = []
+    for rank, (rc, out, err) in enumerate(results):
+        if rc != 0:
+            raise RuntimeError(
+                f"hotspot_c rank {rank} exited {rc}\nstdout:{out}\nstderr:{err}"
+            )
+        line = next(ln for ln in out.splitlines() if ln.startswith("HOT "))
+        kv = dict(f.split("=") for f in line.split()[1:])
+        rows.append(
+            (int(kv["done"]), float(kv["busy"]), float(kv["t0"]),
+             float(kv["t1"]))
+        )
+    workers = rows[1:]
+    tasks = sum(r[0] for r in workers)
+    t_begin = min(r[2] for r in rows)
+    t_end = max(r[3] for r in workers)
+    elapsed = max(t_end - t_begin, 1e-9)
+    busy = (
+        sum(r[1] / elapsed for r in workers) / len(workers) if workers else 0.0
+    )
+    return HotspotResult(
+        tasks=tasks,
+        elapsed=elapsed,
+        tasks_per_sec=tasks / elapsed,
+        busy_fraction=busy,
+        idle_pct=100.0 * (1.0 - busy),
+    )

@@ -93,40 +93,70 @@ class SudokuResult:
     elapsed: float
 
 
+def _relabel(puzzle: str, seed: int) -> str:
+    """Digit-relabeled isomorph: permuting the digit alphabet preserves
+    sudoku validity but reorders every candidate list, giving a distinct
+    search tree — a cheap way to batch independent instances."""
+    import random
+
+    perm = list(range(1, 10))
+    random.Random(seed).shuffle(perm)
+    table = {"0": "0"}
+    for i, p in enumerate(perm):
+        table[str(i + 1)] = str(p)
+    return "".join(table[ch] for ch in puzzle)
+
+
 def run(
     puzzle: str = DEFAULT_PUZZLE,
     num_app_ranks: int = 4,
     nservers: int = 2,
     cfg: Optional[Config] = None,
     timeout: float = 120.0,
+    n_puzzles: int = 1,
 ) -> SudokuResult:
-    start = bytes(int(ch) for ch in puzzle)
+    """Solve ``n_puzzles`` digit-relabeled isomorphs of ``puzzle`` in one
+    world (board payloads carry a puzzle-id byte). Batching keeps the pool
+    busy long enough that first-solution search luck and the serial warmup
+    average out — single-instance runs are rate-noise at benchmark scale."""
+    puzzles = [puzzle] + [
+        _relabel(puzzle, seed) for seed in range(1, n_puzzles)
+    ]
+    starts = [
+        bytes(int(ch) for ch in p) + bytes([pid])
+        for pid, p in enumerate(puzzles)
+    ]
 
     def app(ctx):
         processed = 0
         if ctx.rank == 0:
-            ctx.put(start, WORK, work_prio=sum(1 for b in start if b))
-            # rank 0 collects the solution (reference nq/sudoku pattern:
-            # collector rank + workers)
-            rc, r = ctx.reserve([SOLUTION])
-            if rc != ADLB_SUCCESS:
-                return None, processed
-            rc, buf = ctx.get_reserved(r.handle)
+            for pid, s in enumerate(starts):
+                ctx.put(s, WORK, work_prio=sum(1 for b in s[:81] if b))
+            # rank 0 collects one solution per puzzle (reference nq/sudoku
+            # pattern: collector rank + workers)
+            sols: dict[int, bytes] = {}
+            while len(sols) < len(starts):
+                rc, r = ctx.reserve([SOLUTION])
+                if rc != ADLB_SUCCESS:
+                    break
+                rc, buf = ctx.get_reserved(r.handle)
+                sols.setdefault(buf[81], bytes(buf[:81]))
             ctx.set_problem_done()
-            return buf, processed
+            return sols, processed
         while True:
             rc, r = ctx.reserve([WORK])
             if rc != ADLB_SUCCESS:
                 return None, processed
-            rc, board = ctx.get_reserved(r.handle)
+            rc, buf = ctx.get_reserved(r.handle)
             processed += 1
+            board, pid = bytes(buf[:81]), buf[81]
             idx, cands = _most_constrained(board)
             if idx < 0:  # solved
-                ctx.put(board, SOLUTION, 999999999, target_rank=0)
+                ctx.put(buf, SOLUTION, 999999999, target_rank=0)
                 continue
             filled = sum(1 for b in board if b)
             for d in cands:
-                child = bytearray(board)
+                child = bytearray(buf)
                 child[idx] = d
                 ctx.put(bytes(child), WORK, work_prio=filled + 1)
 
@@ -140,11 +170,14 @@ def run(
         timeout=timeout,
     )
     elapsed = time.monotonic() - t0
-    solution = res.app_results[0][0]
+    sols = res.app_results[0][0] or {}
     tasks = sum(v[1] for v in res.app_results.values())
+    valid = len(sols) == len(puzzles) and all(
+        check_solution(sols[pid], puzzles[pid]) for pid in sols
+    )
     return SudokuResult(
-        solution=solution,
-        valid=solution is not None and check_solution(solution, puzzle),
+        solution=sols.get(0),
+        valid=valid,
         tasks_processed=tasks,
         elapsed=elapsed,
     )

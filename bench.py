@@ -135,7 +135,8 @@ def main() -> None:
         secs = 0.0
         for _ in range(reps):
             r = sudoku.run(puzzle=SUDOKU_HARD, num_app_ranks=APPS,
-                           nservers=SERVERS, cfg=cfg(mode), timeout=600.0)
+                           nservers=SERVERS, cfg=cfg(mode), timeout=600.0,
+                           n_puzzles=8)
             assert r.valid, f"sudoku {mode}: invalid solution"
             tasks += r.tasks_processed
             secs += r.elapsed
@@ -177,6 +178,52 @@ def main() -> None:
     hot_steal = hot("steal")
     hot_fast = hot("steal_fast")
     hot_tpu = hot("tpu")
+
+    # hotspot on the ALL-NATIVE plane: C clients + C++ server daemons, every
+    # rank an OS process (no GIL coupling); the Python runtime appears only
+    # as the balancer sidecar. 64 app ranks / 16 servers is the scale the
+    # one-interpreter harness cannot reach. Work grain 8 ms keeps the
+    # single-core host scheduling-bound, not message-bound.
+    from adlb_tpu.workloads import hotspot_native
+
+    def hot_native(mode: str, apps: int, servers: int, n: int):
+        if mode == "steal":
+            c = Config(balancer="steal", qmstat_mode="ring",
+                       qmstat_interval=0.1)
+        else:
+            c = Config(balancer="tpu", balancer_max_tasks=2048,
+                       balancer_max_requesters=256)
+        r = hotspot_native.run(
+            n_tasks=n, work_us=8000, num_app_ranks=apps, nservers=servers,
+            cfg=c, timeout=300.0,
+        )
+        assert r.tasks == n, f"native hotspot {mode}: lost work ({r.tasks})"
+        return r
+
+    try:
+        nat16_steal = hot_native("steal", 16, 4, 1500)
+        nat16_tpu = hot_native("tpu", 16, 4, 1500)
+        nat64_steal = hot_native("steal", 64, 16, 4000)
+        nat64_tpu = hot_native("tpu", 64, 16, 4000)
+        native_rows = {
+            "native_16r_steal_tasks_per_sec": round(
+                nat16_steal.tasks_per_sec, 1),
+            "native_16r_tpu_tasks_per_sec": round(nat16_tpu.tasks_per_sec, 1),
+            "native_16r_ratio": round(
+                nat16_tpu.tasks_per_sec / nat16_steal.tasks_per_sec, 3),
+            "native_16r_steal_idle_pct": round(nat16_steal.idle_pct, 1),
+            "native_16r_tpu_idle_pct": round(nat16_tpu.idle_pct, 1),
+            "native_64r_steal_tasks_per_sec": round(
+                nat64_steal.tasks_per_sec, 1),
+            "native_64r_tpu_tasks_per_sec": round(nat64_tpu.tasks_per_sec, 1),
+            "native_64r_ratio": round(
+                nat64_tpu.tasks_per_sec / nat64_steal.tasks_per_sec, 3),
+            "native_64r_steal_idle_pct": round(nat64_steal.idle_pct, 1),
+            "native_64r_tpu_idle_pct": round(nat64_tpu.idle_pct, 1),
+        }
+    except (RuntimeError, OSError) as e:
+        # no C toolchain (or daemon spawn failure): report, don't die
+        native_rows = {"native_error": repr(e)}
 
     # trickle: steady arrival at one server, consumers elsewhere — isolates
     # dispatch (discovery) latency, the structural gap between gossip-driven
@@ -300,6 +347,7 @@ def main() -> None:
             "gfmc_tpu_tasks_per_sec": round(gfmc_tpu, 1),
             "gfmc_ratio": round(gfmc_tpu / gfmc_steal, 3)
             if gfmc_steal else 0.0,
+            **native_rows,
             "steal_pop_latency_p50_ms": round(lat_steal.latency_p50_ms, 3),
             "tpu_pop_latency_p50_ms": round(lat_tpu.latency_p50_ms, 3),
             "steal_pops_per_sec": round(lat_steal.pops_per_sec, 1),

@@ -1,0 +1,84 @@
+/* Hotspot at native scale: the BASELINE.json scenario shape (all work
+ * enters one server, consumers spread everywhere — the situation
+ * cross-server balancing exists for; compare the reference's skel.c
+ * synthetic stress shape, reference examples/skel.c:10-40) driven
+ * entirely by native processes: C clients (this file) against the C++
+ * server daemons, with the JAX balancer sidecar planning in tpu mode.
+ *
+ * Rank 0 produces ADLB_HOT_NTASKS tokens; with ADLB_PUT_ROUTING=home they
+ * all land on rank 0's home server. Every other rank consumes with
+ * ADLB_HOT_WORK_US of usleep "compute" per token. Each worker prints one
+ * machine-readable line:
+ *
+ *   HOT done=<n> busy=<secs> t0=<mono> t1=<mono>
+ *
+ * (CLOCK_MONOTONIC is system-wide on Linux, so the harness can take
+ * cross-process makespans.) The producer prints HOT done=0 ... with its
+ * first-put timestamp. Termination is by exhaustion.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <adlb/adlb.h>
+
+#define TOKEN 1
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+int main(void) {
+  int types[1] = {TOKEN};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int n_tasks = getenv("ADLB_HOT_NTASKS") ? atoi(getenv("ADLB_HOT_NTASKS")) : 200;
+  int work_us = getenv("ADLB_HOT_WORK_US") ? atoi(getenv("ADLB_HOT_WORK_US")) : 2000;
+  int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) {
+    fprintf(stderr, "hotspot: init failed rc=%d\n", rc);
+    return 2;
+  }
+  int me = ADLB_World_rank();
+
+  if (me == 0) {
+    /* pure producer, like the Python hotspot: put everything, then leave;
+     * workers terminate by exhaustion once the pool drains */
+    double t0 = mono();
+    for (int i = 0; i < n_tasks; i++) {
+      rc = ADLB_Put("w", 1, -1, -1, TOKEN, 0);
+      if (rc != ADLB_SUCCESS) {
+        fprintf(stderr, "hotspot: put %d failed rc=%d\n", i, rc);
+        return 3;
+      }
+    }
+    printf("HOT done=0 busy=0.000000 t0=%.6f t1=%.6f\n", t0, t0);
+    ADLB_Finalize();
+    return 0;
+  }
+
+  int req[2] = {TOKEN, ADLB_RESERVE_EOL};
+  int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+  int done = 0;
+  double busy = 0.0;
+  double t0 = mono(), t1 = t0;
+  for (;;) {
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / DONE_BY_EXHAUSTION */
+    char buf[8];
+    rc = ADLB_Get_reserved(buf, handle);
+    if (rc != ADLB_SUCCESS) break;
+    double w0 = mono();
+    usleep((useconds_t)work_us);
+    busy += mono() - w0;
+    done++;
+    t1 = mono();
+  }
+  printf("HOT done=%d busy=%.6f t0=%.6f t1=%.6f\n", done, busy, t0, t1);
+  ADLB_Finalize();
+  return 0;
+}

@@ -402,3 +402,21 @@ def test_all_native_nq_known_answer():
         assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
         total += int(out.split("solutions")[1].split()[0])
     assert total == 40  # n-queens(7), examples/nq_c.c EXPECTED
+
+
+def test_all_native_hotspot_harness():
+    """The native-scale hotspot bench harness: home-routed C producers, C
+    worker processes, C++ daemons, tpu balancer sidecar — every token
+    accounted and idle% computed from per-process monotonic stamps."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.workloads import hotspot_native
+
+    r = hotspot_native.run(
+        n_tasks=120, work_us=1000, num_app_ranks=6, nservers=3,
+        cfg=Config(balancer="tpu", exhaust_check_interval=0.2),
+        timeout=120.0,
+    )
+    assert r.tasks == 120
+    assert r.tasks_per_sec > 0
+    assert 0.0 <= r.idle_pct <= 100.0
