@@ -137,6 +137,9 @@ class WorldResult:
     # merged Chrome-trace events when Config(trace=True) (the reference's
     # MPE output, reference src/adlb_prof.c:46-74)
     trace_events: list[dict] = dataclasses.field(default_factory=list)
+    # the watchdog instance when use_debug_server=True (its aggregates and
+    # printed per-interval summary lines are inspectable post-run)
+    debug_server: Optional[Any] = None
 
     def save_trace(self, path: str) -> None:
         from adlb_tpu.runtime.trace import save_chrome_trace
@@ -281,8 +284,11 @@ def run_world(
                 errors.append(e)
             fabric.abort_event.set()
 
+    debug_servers: list[DebugServer] = []
+
     def debug_main(rank: int) -> None:
         ds = DebugServer(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        debug_servers.append(ds)
         ds.run()
 
     threads: list[threading.Thread] = []
@@ -318,6 +324,7 @@ def run_world(
         aborted=fabric.abort_event.is_set(),
         exception=errors[0] if errors else None,
         trace_events=trace_events,
+        debug_server=debug_servers[0] if debug_servers else None,
     )
     if errors:
         raise errors[0]

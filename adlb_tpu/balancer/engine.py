@@ -40,6 +40,7 @@ class PlanEngine:
         max_malloc_per_server: float = 0.0,
         use_mesh: bool = False,
         nservers: Optional[int] = None,
+        host_threshold_reqs: Optional[int] = None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
 
@@ -83,11 +84,15 @@ class PlanEngine:
                 )
                 self.solver = None
         if self.solver is None:
+            kw = {}
+            if host_threshold_reqs is not None:
+                kw["host_threshold_reqs"] = host_threshold_reqs
             self.solver = AssignmentSolver(
                 types=tuple(types),
                 max_tasks=max_tasks,
                 max_requesters=max_requesters,
                 backend=backend,
+                **kw,
             )
         self.max_malloc_per_server = max_malloc_per_server
         self._planned_reqs: dict[tuple, float] = {}

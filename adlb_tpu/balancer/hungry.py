@@ -74,7 +74,18 @@ class HungryTracker:
         return None
 
     def drop(self, src: int) -> None:
-        self._per_src.pop(src, None)
+        """Forget an ended source. Dropping can only shrink the wanted
+        set, so arm the grace timer like any other shrink — otherwise the
+        survivors would keep paying for event snapshots until an
+        unrelated update happened to re-derive the set."""
+        if self._per_src.pop(src, None) is None:
+            return
+        now_state = self._now_state()
+        if (
+            now_state != (self.hungry_any, self.hungry_types)
+            and self._shrink_since is None
+        ):
+            self._shrink_since = time.monotonic()
 
     def flush(self, now: float):
         """Apply a held shrink once stable for the grace period; returns a

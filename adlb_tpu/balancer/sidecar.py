@@ -96,6 +96,7 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         max_malloc_per_server=cfg.max_malloc_per_server,
         use_mesh=cfg.balancer_mesh == "auto",
         nservers=world.nservers,
+        host_threshold_reqs=cfg.solver_host_threshold,
     )
     snapshots: dict[int, dict] = {}
     ended: set[int] = set()

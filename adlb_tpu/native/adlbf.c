@@ -8,6 +8,13 @@
  */
 #include <adlb/adlb.h>
 
+/* CMake defines ADLB_HAVE_FC_MANGLING when a Fortran compiler was found
+ * and FortranCInterface generated adlb_fc_mangling.h with the compiler's
+ * true convention (reference CMakeLists.txt:62-68). */
+#ifdef ADLB_HAVE_FC_MANGLING
+#include "adlb_fc_mangling.h"
+#endif
+
 #ifndef ADLB_FC_GLOBAL
 #define ADLB_FC_GLOBAL(lc, UC) lc##_
 #endif

@@ -183,6 +183,16 @@ enum FieldId : uint8_t {
   F_NPARKED = 43,         // i64
   F_ACT = 44,             // list: alternating (rank, activity)
   F_PARKED = 45,          // list: flattened (rank, ntypes, t0..tn)*
+  F_TOKEN_ID = 62,        // i64: exhaustion-token id (lost-token recovery)
+  F_EVENTS = 63,          // i64 (DS_LOG: msgs handled since last log)
+  F_WQ_TARGETED = 64,     // i64 (DS_LOG)
+  F_RESERVES = 65,        // i64 (DS_LOG, since last log)
+  F_RESERVES_IMMED = 66,  // i64 (DS_LOG, since last log)
+  F_RESERVES_PARKED = 67, // i64 (DS_LOG, since last log)
+  F_RFR_FAILED = 68,      // i64 (DS_LOG, since last log)
+  F_SS_MSGS = 69,         // i64 (DS_LOG, since last log)
+  F_BACKLOG = 70,         // i64 (DS_LOG: unhandled inbox frames)
+  F_RSS_KB = 71,          // i64 (DS_LOG: /proc/self/status VmRSS)
   // -- balancer sidecar (shared with codec.py: the sidecar is Python) --
   F_REQ_HOME = 46,        // i64
   F_DEST = 47,            // i64
@@ -453,6 +463,13 @@ class Endpoint {
     *out = std::move(inbox_.front());
     inbox_.pop_front();
     return true;
+  }
+
+  // received-but-unhandled frames: the TCP analogue of the reference's
+  // MPI unexpected-message-queue probe (src/adlb.c:3645-3719)
+  size_t backlog() {
+    std::unique_lock<std::mutex> lk(in_mu_);
+    return inbox_.size();
   }
 
   void close_all() {
@@ -879,6 +896,8 @@ class Server {
 
   // ---- dispatch -----------------------------------------------------------
   void dispatch(const NMsg& m) {
+    events_ctr_ += 1;
+    if (m.tag >= 1101 && m.tag <= 1125) ss_msgs_ctr_ += 1;
     switch (m.tag) {
       case T_FA_PUT: on_put(m); break;
       case T_FA_PUT_COMMON: on_put_common(m); break;
@@ -960,12 +979,46 @@ class Server {
     }
     if (w_.use_debug_server && now >= next_ds_log_) {
       next_ds_log_ = now + cfg_.debug_log_interval;
+      // the reference's 11-counter heartbeat (src/adlb.c:3222-3259); the
+      // iq / unexpected-queue fields map to the inbox backlog
+      int64_t wq_targeted = 0;
+      for (const auto& kv : wq_.units)
+        if (kv.second.target_rank >= 0) wq_targeted += 1;
+      int64_t reserves = int64_t(stats_[K_NUM_RESERVES]);
+      int64_t parked = int64_t(stats_[K_NUM_RESERVES_PUT_ON_RQ]);
       NMsg m = mk(T_DS_LOG);
+      m.seti(F_EVENTS, events_ctr_ - ds_last_.events);
+      m.seti(F_WQ_TARGETED, wq_targeted);
       m.seti(F_WQ_COUNT, wq_.count);
       m.seti(F_RQ_COUNT, int64_t(rq_.size()));
+      m.seti(F_BACKLOG, int64_t(ep_->backlog()));
+      m.seti(F_RESERVES, reserves - ds_last_.reserves);
+      m.seti(F_RESERVES_IMMED, reserve_immed_ctr_ - ds_last_.immed);
+      m.seti(F_RESERVES_PARKED, parked - ds_last_.parked);
+      m.seti(F_RFR_FAILED, rfr_failed_ctr_ - ds_last_.rfr_failed);
+      m.seti(F_SS_MSGS, ss_msgs_ctr_ - ds_last_.ss);
+      m.seti(F_RSS_KB, rss_kb());
       m.seti(F_NBYTES, mem_curr_);
       ep_->send(w_.nranks - 1, m);  // debug server is the last world rank
+      ds_last_.events = events_ctr_;
+      ds_last_.ss = ss_msgs_ctr_;
+      ds_last_.reserves = reserves;
+      ds_last_.immed = reserve_immed_ctr_;
+      ds_last_.parked = parked;
+      ds_last_.rfr_failed = rfr_failed_ctr_;
     }
+  }
+
+  static int64_t rss_kb() {
+    // the reference's /proc/self/status probe (src/adlb.c:3347-3369)
+    FILE* f = fopen("/proc/self/status", "r");
+    if (f == nullptr) return 0;
+    char line[256];
+    int64_t kb = 0;
+    while (fgets(line, sizeof line, f) != nullptr)
+      if (sscanf(line, "VmRSS: %lld", (long long*)&kb) == 1) break;
+    fclose(f);
+    return kb;
   }
 
   // ---- app handlers (reference src/adlb.c:889-1383) -----------------------
@@ -1100,6 +1153,7 @@ class Server {
       int64_t seqno = u->seqno;
       wq_.units[seqno].pin_rank = app;
       activity_ += 1;
+      reserve_immed_ctr_ += 1;
       reserve_resp_ok(app, wq_.units[seqno], meta_[seqno], rank_, e.fetch);
       return;
     }
@@ -1292,6 +1346,7 @@ class Server {
   void on_rfr_resp(const NMsg& m) {
     int app = int(m.geti(F_FOR_RANK));
     rfr_out_.erase(app);
+    if (!m.geti(F_FOUND)) rfr_failed_ctr_ += 1;
     if (m.geti(F_FOUND)) {
       RqEntry* e = rq_find_rank(app);
       int32_t wt = int32_t(m.geti(F_WORK_TYPE));
@@ -1708,7 +1763,15 @@ class Server {
   }
 
   void check_exhaustion(double now) {
-    if (no_more_work_ || done_by_exhaustion_ || exhaust_inflight_) return;
+    if (no_more_work_ || done_by_exhaustion_) return;
+    if (exhaust_inflight_) {
+      // lost-token recovery: a ring pass over S servers takes well under
+      // a second; if the token has not come home in 10 intervals, assume
+      // it died (a peer dropped it mid-restart / message lost) and allow
+      // a fresh vote. The token id makes any late straggler harmless.
+      if (now - exhaust_sent_at_ < 10 * cfg_.exhaust_check_interval) return;
+      exhaust_inflight_ = false;
+    }
     if (!exhaust_vote(nullptr)) { exhaust_held_ = false; return; }
     if (!exhaust_held_) {
       exhaust_held_ = true;
@@ -1717,8 +1780,11 @@ class Server {
     }
     if (now - exhaust_held_since_ < cfg_.exhaust_check_interval) return;
     exhaust_inflight_ = true;
+    exhaust_sent_at_ = now;
+    exhaust_token_id_ += 1;
     NMsg token = mk(T_SS_EXHAUST_CHK_1);
     token.seti(F_ORIGIN, rank_);
+    token.seti(F_TOKEN_ID, exhaust_token_id_);
     token.seti(F_VOTE_OK, 1);
     token.setl(F_ACT, {rank_, activity_});
     token.seti(F_NPARKED, int64_t(rq_.size()));
@@ -1736,6 +1802,8 @@ class Server {
   void on_exhaust_chk(const NMsg& m, bool phase1) {
     NMsg token = m;  // copy; we mutate fields then forward
     if (m.geti(F_COMPLETE) && int(m.geti(F_ORIGIN)) == rank_) {
+      if (m.geti(F_TOKEN_ID) != exhaust_token_id_)
+        return;  // straggler from a token we already gave up on
       const std::vector<int64_t>* parked = m.getl(F_PARKED);
       bool ok = m.geti(F_VOTE_OK) != 0 && m.geti(F_NPARKED) > 0 &&
                 exhaust_vote(parked) &&
@@ -2310,6 +2378,8 @@ class Server {
   bool exhaust_held_ = false;
   double exhaust_held_since_ = 0.0;
   bool exhaust_inflight_ = false;
+  double exhaust_sent_at_ = 0.0;
+  int64_t exhaust_token_id_ = 0;
   int64_t activity_ = 0;
 
   std::vector<double> stats_;
@@ -2318,6 +2388,11 @@ class Server {
   double next_qmstat_ = 0.0, next_exhaust_ = 0.0, next_ds_log_ = 0.0;
   int64_t qm_trips_ = 0;
   int64_t puts_ctr_ = 0, resolved_ctr_ = 0, pstats_seq_ = 0;
+  // since-last-DS_LOG counters (reference src/adlb.c:3222-3259)
+  int64_t events_ctr_ = 0, ss_msgs_ctr_ = 0, reserve_immed_ctr_ = 0,
+          rfr_failed_ctr_ = 0;
+  struct { int64_t events = 0, ss = 0, reserves = 0, immed = 0, parked = 0,
+                   rfr_failed = 0; } ds_last_;
   double next_pstats_ = 0.0;
 };
 

@@ -158,6 +158,18 @@ FIELDS: dict[str, tuple[int, int]] = {
     # wanted-type set, omitted = an any-type requester is parked)
     "hungry": (60, _KIND_I64),
     "grew": (61, _KIND_I64),
+    # 62 = exhaustion token id (native server<->server only; reserved here)
+    # extended DS_LOG heartbeat (the reference's 11 counters,
+    # src/adlb.c:3222-3259): native daemons -> Python debug server
+    "events": (63, _KIND_I64),
+    "wq_targeted": (64, _KIND_I64),
+    "reserves": (65, _KIND_I64),
+    "reserves_immed": (66, _KIND_I64),
+    "reserves_parked": (67, _KIND_I64),
+    "rfr_failed": (68, _KIND_I64),
+    "ss_msgs": (69, _KIND_I64),
+    "backlog": (70, _KIND_I64),
+    "rss_kb": (71, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -72,6 +72,16 @@ def self_diagnosis(server, now: float, stuck_after: float = 5.0) -> list[str]:
         f"rq={len(server.rq)} bytes={server.mem.curr} "
         f"loops={server._loops} activity={server.activity}"
     ]
+    # peer memory picture from the qmstat table: accountant bytes next to
+    # each peer's /proc RSS (the reference prints its memusage probe in
+    # the same diagnostics block, src/adlb.c:3347-3369)
+    peers = getattr(server, "peers", None)
+    if peers:
+        mem = " ".join(
+            f"s{s}:{st.nbytes}B/{st.rss_kb}kB"
+            for s, st in sorted(peers.items())
+        )
+        lines.append(f"SELFDIAG rank {server.rank}: peer mem {mem}")
     stuck = [
         (e.world_rank, round(now - e.time_stamp, 3))
         for e in server.rq.entries()

@@ -28,15 +28,58 @@ class DebugServer:
         self._abort_event = abort_event
         self.aggregates: dict[int, dict] = {}
         self.timed_out = False
+        # per-interval aggregation of the 11-counter heartbeats, printed
+        # the way the reference's debug server does per minute (reference
+        # ``src/adlb.c:2539-2551,2569-2610``)
+        self.printed_lines: list[str] = []
+        self._window: dict[str, float] = {}
+        self._window_n = 0
+
+    # DS_LOG fields aggregated per print window (sums of the since-last-log
+    # counters; averages of the point-in-time depths)
+    _SUM_FIELDS = ("events", "reserves", "reserves_immed", "reserves_parked",
+                   "rfr_failed", "ss_msgs")
+    _AVG_FIELDS = ("wq_targeted", "wq_count", "rq_count", "backlog",
+                   "rss_kb", "nbytes")
+
+    def _print_window(self, span: float) -> None:
+        if not self._window_n:
+            return
+        w = self._window
+        navg = max(self._window_n, 1)
+        line = (
+            f"[adlb debug server] last {span:.1f}s: "
+            f"events={int(w.get('events', 0))} "
+            f"reserves={int(w.get('reserves', 0))} "
+            f"immed={int(w.get('reserves_immed', 0))} "
+            f"parked={int(w.get('reserves_parked', 0))} "
+            f"rfr_failed={int(w.get('rfr_failed', 0))} "
+            f"ss_msgs={int(w.get('ss_msgs', 0))} "
+            f"avg_wq_targeted={w.get('wq_targeted', 0) / navg:.1f} "
+            f"avg_wq={w.get('wq_count', 0) / navg:.1f} "
+            f"avg_rq={w.get('rq_count', 0) / navg:.1f} "
+            f"avg_backlog={w.get('backlog', 0) / navg:.1f} "
+            f"avg_rss_kb={w.get('rss_kb', 0) / navg:.0f} "
+            f"avg_nbytes={w.get('nbytes', 0) / navg:.0f}"
+        )
+        self.printed_lines.append(line)
+        print(line, file=sys.stderr)
+        self._window = {}
+        self._window_n = 0
 
     def run(self) -> None:
         ended: set[int] = set()
         last_msg = time.monotonic()
+        last_print = last_msg
+        print_interval = self.cfg.debug_print_interval
         while len(ended) < self.world.nservers:
             if self._abort_event is not None and self._abort_event.is_set():
                 return
             m = self.ep.recv(timeout=min(self.cfg.debug_server_timeout / 4, 0.25))
             now = time.monotonic()
+            if print_interval > 0 and now - last_print >= print_interval:
+                self._print_window(now - last_print)
+                last_print = now
             if m is None:
                 if now - last_msg > self.cfg.debug_server_timeout:
                     self.timed_out = True
@@ -64,3 +107,8 @@ class DebugServer:
                 agg["rq_count"] = m.rq_count
                 agg["nbytes"] = m.nbytes
                 agg["n"] += 1
+                for f in self._SUM_FIELDS + self._AVG_FIELDS:
+                    v = m.data.get(f)
+                    if v is not None:
+                        self._window[f] = self._window.get(f, 0) + v
+                self._window_n += 1

@@ -96,6 +96,7 @@ class _BalancerWorker(threading.Thread):
             max_malloc_per_server=s.cfg.max_malloc_per_server,
             use_mesh=s.cfg.balancer_mesh == "auto",
             nservers=s.world.nservers,
+            host_threshold_reqs=s.cfg.solver_host_threshold,
         )
         s._solver = engine.solver
         while True:
@@ -153,6 +154,7 @@ class _PeerState:
         self.nbytes = 0
         self.qlen = 0
         self.hi_prio: dict[int, int] = {}
+        self.rss_kb = 0
         self.stamp = 0.0
 
 
@@ -200,6 +202,8 @@ class Server:
         self._ending = False  # shutdown ring underway: peer EOFs are benign
         self._exhaust_held_since: Optional[float] = None
         self._exhaust_inflight = False
+        self._exhaust_sent_at = 0.0
+        self._exhaust_token_id = 0
         self.activity = 0  # puts accepted + reservations handed out
 
         # balancer state (master only, tpu mode)
@@ -246,6 +250,12 @@ class Server:
         self._next_exhaust_check = now + cfg.exhaust_check_interval
         self._next_ds_log = now
         self._ds_counters = {"puts": 0, "reserves": 0, "rfrs": 0, "pushes": 0}
+        # since-last-DS_LOG bookkeeping for the reference's 11-counter
+        # heartbeat payload (reference src/adlb.c:3222-3259)
+        self._ds_last = {"events": 0, "ss": 0, "reserves": 0, "immed": 0,
+                         "parked": 0, "rfr_failed": 0}
+        self._n_reserve_immed = 0
+        self._n_rfr_failed = 0
 
         # periodic cluster-wide stats ring (reference src/adlb.c:712-753)
         self.resolved_reserves = 0
@@ -812,6 +822,7 @@ class Server:
         if unit is not None:
             self.wq.pin(unit.seqno, app)
             self.activity += 1
+            self._n_reserve_immed += 1
             self._reserve_resp(app, ADLB_SUCCESS, unit, fetch=fetch)
             return
         if not m.hang:
@@ -899,6 +910,14 @@ class Server:
             value = float(self.mem.hwm)
         elif key is InfoKey.AVG_TIME_ON_RQ:
             value = self._rq_wait_sum / self._rq_wait_n if self._rq_wait_n else 0.0
+        elif key is InfoKey.RSS_KB:
+            from adlb_tpu.utils.stats import rss_kb
+
+            value = float(rss_kb())
+        elif key is InfoKey.TRANSPORT_BACKLOG:
+            value = float(
+                self.ep.backlog() if hasattr(self.ep, "backlog") else 0
+            )
         else:
             value = float(self.stats.get(key, 0.0))
         self.ep.send(
@@ -1007,6 +1026,8 @@ class Server:
     def _on_rfr_resp(self, m: Msg) -> None:
         app = m.for_rank
         self._rfr_out.discard(app)
+        if not m.found:
+            self._n_rfr_failed += 1
         if m.found:
             entry = None
             for cand in self.rq.entries():
@@ -1208,16 +1229,23 @@ class Server:
     # ------------------------------------------------------- state sync
 
     def _qmstat_entry(self) -> dict:
+        from adlb_tpu.utils.stats import rss_kb
+
         return {
             "nbytes": self.mem.curr,
             "qlen": self.wq.num_unpinned_untargeted(),
             "hi_prio": {t: self.wq.hi_prio_of_type(t) for t in self.world.types},
+            # process-level memory truth alongside the accountant's view
+            # (the reference feeds its /proc probe into diagnostics the
+            # same way, src/adlb.c:3347-3369)
+            "rss_kb": rss_kb(),
         }
 
     def _broadcast_qmstat(self) -> None:
         ent = self._qmstat_entry()
         st = self.peers[self.rank]
         st.nbytes, st.qlen, st.hi_prio = ent["nbytes"], ent["qlen"], ent["hi_prio"]
+        st.rss_kb = ent["rss_kb"]
         st.stamp = time.monotonic()
         if self.cfg.qmstat_mode == "ring":
             # reference-faithful store-and-forward ring token: only the
@@ -1248,6 +1276,7 @@ class Server:
         st.nbytes = ent["nbytes"]
         st.qlen = ent["qlen"]
         st.hi_prio = dict(ent["hi_prio"])
+        st.rss_kb = ent.get("rss_kb", 0)
         st.stamp = time.monotonic()
         # fresh evidence of work at this peer lifts any strike-out, else a
         # requester could permanently ignore a peer that refilled later
@@ -1675,8 +1704,17 @@ class Server:
     def _check_exhaustion(self, now: float) -> None:
         """Master: if every app everywhere might be blocked, run the two-pass
         ring confirmation (reference ``src/adlb.c:754-785,1575-1650``)."""
-        if self.no_more_work or self.done_by_exhaustion or self._exhaust_inflight:
+        if self.no_more_work or self.done_by_exhaustion:
             return
+        if self._exhaust_inflight:
+            # lost-token recovery: if the ring token has not come home in
+            # 10 intervals, assume it died and allow a fresh vote; the
+            # token id makes any late straggler harmless
+            if now - self._exhaust_sent_at < (
+                10 * self.cfg.exhaust_check_interval
+            ):
+                return
+            self._exhaust_inflight = False
         if not self._exhaust_vote():
             self._exhaust_held_since = None
             return
@@ -1686,8 +1724,11 @@ class Server:
         if now - self._exhaust_held_since < self.cfg.exhaust_check_interval:
             return
         self._exhaust_inflight = True
+        self._exhaust_sent_at = now
+        self._exhaust_token_id += 1
         token = {
             "origin": self.rank,
+            "token_id": self._exhaust_token_id,
             "ok": True,
             "act": {self.rank: self.activity},
             "nparked": len(self.rq),
@@ -1706,6 +1747,8 @@ class Server:
         token = m.token
         phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
         if m.data.get("complete") and token["origin"] == self.rank:
+            if token.get("token_id", 0) != self._exhaust_token_id:
+                return  # straggler from a token we already gave up on
             # token made it all the way around; pass 2 validates against the
             # globally-gathered parked list from pass 1
             ok = (
@@ -1721,6 +1764,7 @@ class Server:
             if phase1:
                 token2 = {
                     "origin": self.rank,
+                    "token_id": self._exhaust_token_id,
                     "ok": True,
                     "act": token["act"],
                     "nparked": token["nparked"],
@@ -1873,20 +1917,56 @@ class Server:
         self.done = True
 
     def _send_ds_log(self) -> None:
+        """The reference's 11-counter heartbeat (``log_at_debug_server``,
+        reference ``src/adlb.c:3222-3259``): since-last-log event counts
+        plus point-in-time queue depths. The iq and unexpected-queue
+        fields map to the transport backlog (received-but-unhandled
+        frames); the memory probe is /proc RSS."""
         ds = self.world.debug_server_rank
         if ds is None:
             return
+        events = sum(self.tag_freq.values())
+        ss = sum(
+            n for t, n in self.tag_freq.items() if t.name.startswith("SS_")
+        )
+        wq_targeted = sum(
+            1 for u in self.wq.units() if u.target_rank >= 0
+        )
+        last = self._ds_last
+        from adlb_tpu.utils.stats import rss_kb
+
         self.ep.send(
             ds,
             msg(
                 Tag.DS_LOG,
                 self.rank,
                 counters=dict(self._ds_counters),
+                events=events - last["events"],
+                wq_targeted=wq_targeted,
                 wq_count=self.wq.count,
                 rq_count=len(self.rq),
+                backlog=self.ep.backlog()
+                if hasattr(self.ep, "backlog") else 0,
+                reserves=self.stats[InfoKey.NUM_RESERVES] - last["reserves"],
+                reserves_immed=self._n_reserve_immed - last["immed"],
+                reserves_parked=(
+                    self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ]
+                    - last["parked"]
+                ),
+                rfr_failed=self._n_rfr_failed - last["rfr_failed"],
+                ss_msgs=ss - last["ss"],
+                rss_kb=rss_kb(),
                 nbytes=self.mem.curr,
             ),
         )
+        self._ds_last = {
+            "events": events,
+            "ss": ss,
+            "reserves": self.stats[InfoKey.NUM_RESERVES],
+            "immed": self._n_reserve_immed,
+            "parked": self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ],
+            "rfr_failed": self._n_rfr_failed,
+        }
 
     def _notify_debug_server_end(self) -> None:
         ds = self.world.debug_server_rank
@@ -1896,8 +1976,11 @@ class Server:
     # ------------------------------------------------------- stats surface
 
     def finalize_stats(self) -> dict:
+        from adlb_tpu.utils.stats import rss_kb
+
         s = self.stats
         s[InfoKey.MALLOC_HWM] = float(self.mem.hwm)
+        s[InfoKey.RSS_KB] = float(rss_kb())
         s[InfoKey.AVG_TIME_ON_RQ] = (
             self._rq_wait_sum / self._rq_wait_n if self._rq_wait_n else 0.0
         )

@@ -52,6 +52,12 @@ class InProcEndpoint:
         except queue.Empty:
             return None
 
+    def backlog(self) -> int:
+        """Received-but-unhandled frames — the TCP-era analogue of the
+        reference's MPI unexpected-message-queue depth probe (reference
+        ``src/adlb.c:3645-3719``)."""
+        return self.inbox.qsize()
+
 
 class InProcFabric:
     """All ranks in one process; message passing via thread-safe queues."""

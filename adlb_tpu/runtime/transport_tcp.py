@@ -172,6 +172,12 @@ class TcpEndpoint:
                     self._out[dest] = sock
                 sock.sendall(frame)
 
+    def backlog(self) -> int:
+        """Received-but-unhandled frames — the TCP-era analogue of the
+        reference's MPI unexpected-message-queue depth probe (reference
+        ``src/adlb.c:3645-3719``)."""
+        return self.inbox.qsize()
+
     def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
         try:
             if timeout is None:

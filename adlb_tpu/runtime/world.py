@@ -104,6 +104,9 @@ class Config:
     periodic_log_interval: float = 0.0  # 0 = off
     debug_log_interval: float = 1.0  # DS_LOG cadence (src/adlb.c:842-854)
     debug_server_timeout: float = 30.0
+    # debug server's aggregate-print cadence (the reference prints per
+    # minute, src/adlb.c:2569-2610); 0 disables the prints
+    debug_print_interval: float = 60.0
     put_max_retries: int = 10  # reference retry loop (src/adlb.c:2779-2796)
     put_retry_sleep: float = 0.002
     # Max queued tasks & waiting requesters per server in one balancer
@@ -113,6 +116,11 @@ class Config:
     # device solve implementation: "auto" = Pallas sweep kernel on TPU, XLA
     # scan elsewhere; explicit "xla"/"pallas" force one
     solver_backend: str = "auto"
+    # parked-requester count below which the solve stays on the numpy host
+    # path (a device dispatch round-trip would dominate); None = solver
+    # default. Set very high when the balancer host has no local
+    # accelerator (e.g. a CPU-only sidecar).
+    solver_host_threshold: "Optional[int]" = None
     # "auto" = when more than one accelerator device is visible, shard the
     # balancer's task table over a jax.sharding.Mesh (one shard per device,
     # balancer/distributed.py); "off" = single-device solve

@@ -93,3 +93,31 @@ class RunningStats:
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"<{self.dump()}>"
+
+
+_rss_cache: list = [0.0, 0]  # [stamp, value]
+
+
+def rss_kb(max_age: float = 1.0) -> int:
+    """Resident-set size of this process in KiB, from /proc/self/status —
+    the reference's get_memusage probe (reference ``src/adlb.c:3347-3369``).
+    Cached for ``max_age`` seconds: callers on periodic paths (the qmstat
+    entry at 20 Hz) must not pay a /proc read per tick. Returns 0 where
+    /proc is unavailable (non-Linux)."""
+    import time as _time
+
+    now = _time.monotonic()
+    if now - _rss_cache[0] < max_age and _rss_cache[1]:
+        return _rss_cache[1]
+    val = 0
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    val = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    _rss_cache[0] = now
+    _rss_cache[1] = val
+    return val

@@ -1,0 +1,106 @@
+c     f1: the Fortran face of the A/B/answer work-package economy
+c     (behavioral port of the reference examples/f1.f onto this
+c     framework's TCP-backed client — no MPI; world shape comes from
+c     the ADLB_RENDEZVOUS environment, reference role math unchanged).
+c
+c     Rank 0 emits NAS type-A units; workers expand each A into BPA
+c     type-B units; each B produces one type-ANS answer targeted back
+c     at rank 0 carrying a deterministic value. Rank 0 sums the
+c     answers, checks the closed-form expected total, and prints
+c     "F1 OK total=..." — a self-checking mini-app in the reference's
+c     style (examples/c4.c:495-502 aborts on count mismatch).
+      program f1
+      implicit none
+      include 'adlb/adlbf.h'
+
+      integer NAS, BPA
+      parameter (NAS = 4, BPA = 3)
+      integer TYPEA, TYPEB, TYPEANS
+      parameter (TYPEA = 1, TYPEB = 2, TYPEANS = 3)
+
+      integer typev(3), reqt(4)
+      integer handle(ADLB_HANDLE_SIZE)
+      integer ierr, nserv, usedbg, aprf, amserv, amdbg, napps
+      integer me, wtype, wprio, wlen, arank
+      integer ia, ib, total, expect, nans
+      integer buf(2)
+      character*16 env
+
+      typev(1) = TYPEA
+      typev(2) = TYPEB
+      typev(3) = TYPEANS
+      usedbg = 0
+      aprf = 0
+      nserv = 1
+      call get_environment_variable('ADLB_NUM_SERVERS', env)
+      if (env .ne. ' ') read (env, *) nserv
+
+      call adlb_init(nserv, usedbg, aprf, 3, typev, amserv, amdbg,
+     &               napps, ierr)
+      if (ierr .ne. ADLB_SUCCESS) stop 2
+      call adlb_world_rank(me)
+
+      if (me .eq. 0) then
+c        master: emit the As, then collect every answer
+         do ia = 1, NAS
+            buf(1) = ia
+            buf(2) = 0
+            call adlb_put(buf, 8, -1, -1, TYPEA, 1, ierr)
+            if (ierr .ne. ADLB_SUCCESS) stop 3
+         end do
+         total = 0
+         nans = 0
+         reqt(1) = TYPEANS
+         reqt(2) = ADLB_RESERVE_EOL
+ 100     if (nans .lt. NAS * BPA) then
+            call adlb_reserve(reqt, wtype, wprio, handle, wlen,
+     &                        arank, ierr)
+            if (ierr .ne. ADLB_SUCCESS) stop 4
+            call adlb_get_reserved(buf, handle, ierr)
+            if (ierr .ne. ADLB_SUCCESS) stop 5
+            total = total + buf(1)
+            nans = nans + 1
+            go to 100
+         end if
+c        expected: sum over ia,ib of (ia*100 + ib)
+         expect = 0
+         do ia = 1, NAS
+            do ib = 1, BPA
+               expect = expect + ia * 100 + ib
+            end do
+         end do
+         if (total .ne. expect) then
+            write (6, *) 'F1 FAIL total=', total, ' expect=', expect
+            call adlb_abort(7, ierr)
+            stop 6
+         end if
+         write (6, *) 'F1 OK total=', total
+         call adlb_set_problem_done(ierr)
+      else
+c        worker: expand As into Bs, answer each B back at rank 0
+         reqt(1) = TYPEA
+         reqt(2) = TYPEB
+         reqt(3) = ADLB_RESERVE_EOL
+ 200     continue
+         call adlb_reserve(reqt, wtype, wprio, handle, wlen, arank,
+     &                     ierr)
+         if (ierr .ne. ADLB_SUCCESS) go to 300
+         call adlb_get_reserved(buf, handle, ierr)
+         if (ierr .ne. ADLB_SUCCESS) go to 300
+         if (wtype .eq. TYPEA) then
+            do ib = 1, BPA
+               buf(2) = ib
+               call adlb_put(buf, 8, -1, -1, TYPEB, 2, ierr)
+               if (ierr .ne. ADLB_SUCCESS) stop 8
+            end do
+         else
+            buf(1) = buf(1) * 100 + buf(2)
+            call adlb_put(buf, 8, 0, -1, TYPEANS, 9, ierr)
+            if (ierr .ne. ADLB_SUCCESS) stop 9
+         end if
+         go to 200
+ 300     continue
+      end if
+
+      call adlb_finalize(ierr)
+      end

@@ -1,0 +1,80 @@
+c     fbatcher: file-driven job batcher in Fortran (behavioral port of
+c     the reference examples/fbatcher.f onto this framework's
+c     TCP-backed client). Rank 0 reads shell commands, one per line,
+c     from the file named by ADLB_BATCH_FILE, Puts each as a work
+c     unit, then joins the workers; every rank pops commands and runs
+c     them with system(). The pool drains by exhaustion — the batcher
+c     pattern of the reference's README-batcher.txt.
+      program fbatcher
+      implicit none
+      include 'adlb/adlbf.h'
+
+      integer TYPEJ
+      parameter (TYPEJ = 1)
+
+      integer typev(1), reqt(2)
+      integer handle(ADLB_HANDLE_SIZE)
+      integer ierr, nserv, usedbg, aprf, amserv, amdbg, napps
+      integer me, wtype, wprio, wlen, arank, njobs, nrun, ios
+      character*256 line
+      character*256 fname
+      character*16 env
+
+      typev(1) = TYPEJ
+      usedbg = 0
+      aprf = 0
+      nserv = 1
+      call get_environment_variable('ADLB_NUM_SERVERS', env)
+      if (env .ne. ' ') read (env, *) nserv
+
+      call adlb_init(nserv, usedbg, aprf, 1, typev, amserv, amdbg,
+     &               napps, ierr)
+      if (ierr .ne. ADLB_SUCCESS) stop 2
+      call adlb_world_rank(me)
+
+      njobs = 0
+      if (me .eq. 0) then
+         call get_environment_variable('ADLB_BATCH_FILE', fname)
+         if (fname .eq. ' ') then
+            write (6, *) 'FBATCHER FAIL: ADLB_BATCH_FILE not set'
+            call adlb_abort(7, ierr)
+            stop 3
+         end if
+         open (10, file=fname, status='old', iostat=ios)
+         if (ios .ne. 0) then
+            write (6, *) 'FBATCHER FAIL: cannot open ', fname
+            call adlb_abort(7, ierr)
+            stop 4
+         end if
+ 100     read (10, '(a)', iostat=ios) line
+         if (ios .eq. 0) then
+            if (line .ne. ' ') then
+               call adlb_put(line, len_trim(line), -1, -1, TYPEJ, 1,
+     &                       ierr)
+               if (ierr .ne. ADLB_SUCCESS) stop 5
+               njobs = njobs + 1
+            end if
+            go to 100
+         end if
+         close (10)
+         write (6, *) 'FBATCHER QUEUED', njobs
+      end if
+
+c     every rank (rank 0 included) works the pool until it drains
+      nrun = 0
+      reqt(1) = TYPEJ
+      reqt(2) = ADLB_RESERVE_EOL
+ 200  continue
+      call adlb_reserve(reqt, wtype, wprio, handle, wlen, arank, ierr)
+      if (ierr .ne. ADLB_SUCCESS) go to 300
+      line = ' '
+      call adlb_get_reserved(line, handle, ierr)
+      if (ierr .ne. ADLB_SUCCESS) go to 300
+      call system(line(1:wlen))
+      nrun = nrun + 1
+      go to 200
+ 300  continue
+      write (6, *) 'FBATCHER RAN', nrun
+
+      call adlb_finalize(ierr)
+      end

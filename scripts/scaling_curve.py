@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Hotspot scaling curve: TPU balancer vs upstream-faithful stealing as
+the server count (and with it, the gossip ring length) grows.
+
+Upstream's global load picture is a store-and-forward ring token at a
+fixed interval (reference ``src/adlb.c:165,806-822,1705-1757``): its
+staleness is O(ring hops), so the balancing gap should WIDEN with server
+count. This script measures that on the all-native plane (C clients, C++
+daemons, JAX sidecar — one OS process per rank), printing one row per
+scale and a JSON line at the end.
+
+Usage: python scripts/scaling_curve.py [--quick]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="halve task counts (smoke test)")
+    args = ap.parse_args()
+
+    from adlb_tpu.runtime.world import Config
+    from adlb_tpu.workloads import hotspot_native
+
+    # apps:servers fixed at 4:1; tasks sized for ~1 s of ideal makespan
+    scales = [(16, 4), (32, 8), (64, 16), (128, 32)]
+    work_us = 8000
+    rows = []
+    for apps, servers in scales:
+        n = (apps - 1) * 125 // (2 if args.quick else 1)
+        per = {}
+        for mode in ("steal", "tpu"):
+            if mode == "steal":
+                c = Config(balancer="steal", qmstat_mode="ring",
+                           qmstat_interval=0.1)
+            else:
+                # K=512: the planner only needs the top of each queue to
+                # match + migrate; a 4096-deep snapshot is a fat frame the
+                # Python sidecar pays to decode on every heartbeat.
+                # solver_host_threshold high: this sidecar has no local
+                # accelerator, so every solve belongs on the numpy path.
+                c = Config(balancer="tpu", balancer_max_tasks=512,
+                           balancer_max_requesters=256,
+                           solver_host_threshold=10**6)
+            for attempt in (0, 1):
+                try:
+                    r = hotspot_native.run(
+                        n_tasks=n, work_us=work_us, num_app_ranks=apps,
+                        nservers=servers, cfg=c, timeout=180.0,
+                    )
+                    break
+                except TimeoutError:
+                    if attempt:
+                        raise
+                    print(f"  ({mode}@{servers} timed out; retrying)",
+                          file=sys.stderr)
+            assert r.tasks == n, f"{mode}@{servers}: lost work ({r.tasks})"
+            per[mode] = r
+        ratio = per["tpu"].tasks_per_sec / per["steal"].tasks_per_sec
+        row = {
+            "apps": apps,
+            "servers": servers,
+            "steal_tasks_per_sec": round(per["steal"].tasks_per_sec, 1),
+            "tpu_tasks_per_sec": round(per["tpu"].tasks_per_sec, 1),
+            "ratio": round(ratio, 3),
+            "steal_idle_pct": round(per["steal"].idle_pct, 1),
+            "tpu_idle_pct": round(per["tpu"].idle_pct, 1),
+        }
+        rows.append(row)
+        print(
+            f"{apps:4d} ranks / {servers:2d} servers:  "
+            f"steal {row['steal_tasks_per_sec']:>8.1f}/s "
+            f"(idle {row['steal_idle_pct']:4.1f}%)   "
+            f"tpu {row['tpu_tasks_per_sec']:>8.1f}/s "
+            f"(idle {row['tpu_idle_pct']:4.1f}%)   ratio {row['ratio']:.3f}"
+        )
+    print(json.dumps({"metric": "hotspot_scaling_curve", "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

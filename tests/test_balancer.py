@@ -184,3 +184,20 @@ def test_hungry_gates_put_snapshots(monkeypatch):
     # ungated, this would be >= NTASK/2 snapshots (one per couple of
     # puts); gated it is a few parks + the slow idle heartbeat
     assert calls["n"] < 40, calls["n"]
+
+
+def test_hungry_tracker_drop_arms_shrink():
+    """An ended source's parked types must stop being 'hungry' after the
+    grace period even if no further snapshots arrive (DS_END path)."""
+    from adlb_tpu.balancer.hungry import HungryTracker
+
+    tr = HungryTracker(shrink_grace=0.0)
+    out = tr.update(10, [(0, 1, [T1])])
+    assert out is not None and out[0] is True and out[1] == [T1]
+    tr.drop(10)
+    import time as _t
+
+    flushed = tr.flush(_t.monotonic() + 1.0)
+    assert flushed is not None
+    hungry, req_types, grew = flushed
+    assert hungry is False and not grew

@@ -87,3 +87,72 @@ def test_abort_dumps_flight_recorder(sink):
     dumps = [l for l in sink if l.startswith("FLIGHT_RECORDER")]
     assert dumps, "abort did not dump the flight recorder"
     assert any("abort" in l for l in sink)
+
+
+def test_ds_log_11_counters_and_aggregate_prints(sink):
+    """Debug-server parity with the reference's 11-counter heartbeat and
+    per-interval printed aggregates (reference src/adlb.c:2539-2610,
+    3222-3259): counter totals across a run line up with the work done,
+    and aggregate lines are printed."""
+    T = 1
+    N = 40
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(N):
+                ctx.put(b"x", T, work_prio=i)
+            time.sleep(0.6)  # let a few DS_LOG heartbeats land
+            ctx.set_problem_done()
+            return 0
+        n = 0
+        from adlb_tpu.types import ADLB_SUCCESS
+
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return n
+            ctx.get_reserved(r.handle)
+            n += 1
+
+    res = run_world(
+        3, 2, [T], app,
+        cfg=Config(debug_log_interval=0.1, debug_print_interval=0.25,
+                   exhaust_check_interval=0.2),
+        use_debug_server=True,
+        timeout=120.0,
+    )
+    assert sum(v for k, v in res.app_results.items() if k != 0) == N
+    ds = res.debug_server
+    assert ds is not None and not ds.timed_out
+    printed = ds.printed_lines
+    assert printed, "no aggregate lines printed"
+    assert "events=" in printed[0] and "avg_rq=" in printed[0]
+    # reserves counted across printed windows + the live window are > 0
+    total_reserves = sum(
+        int(ln.split("reserves=")[1].split()[0]) for ln in printed
+    ) + int(ds._window.get("reserves", 0))
+    assert total_reserves > 0
+
+
+def test_info_rss_and_backlog_keys():
+    """L0 parity (reference src/adlb.c:3347-3369,3645-3719): the RSS probe
+    and transport-backlog introspection are live Info keys."""
+    from adlb_tpu.types import ADLB_SUCCESS, InfoKey
+
+    def app(ctx):
+        if ctx.rank == 0:
+            rc, rss = ctx.info_get(InfoKey.RSS_KB)
+            rc2, backlog = ctx.info_get(InfoKey.TRANSPORT_BACKLOG)
+            ctx.set_problem_done()
+            return (rc, rss, rc2, backlog)
+        rc, _ = ctx.reserve([1])
+        return None
+
+    res = run_world(2, 1, [1], app, cfg=Config(exhaust_check_interval=0.2),
+                    timeout=60.0)
+    rc, rss, rc2, backlog = res.app_results[0]
+    assert rc == ADLB_SUCCESS and rc2 == ADLB_SUCCESS
+    assert rss > 1000  # a live CPython process is at least a few MB
+    assert backlog >= 0
+    # and the final stats carry the RSS probe
+    assert res.info_get(InfoKey.RSS_KB) > 1000

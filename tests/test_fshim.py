@@ -63,3 +63,78 @@ def test_fshim_world(mode):
         total += int(out.split("processed=")[1].split()[0])
     assert total == 12
     assert len(stats) == 2
+
+
+def _fortran_compiler():
+    for fc in ("gfortran", "f77", "flang"):
+        if shutil.which(fc):
+            return fc
+    return None
+
+
+@pytest.mark.skipif(_fortran_compiler() is None,
+                    reason="no Fortran compiler in this image")
+@pytest.mark.parametrize("prog", ["f1", "fbatcher"])
+def test_real_fortran_examples(prog, tmp_path):
+    """Compile and run the actual Fortran programs (examples/f1.f,
+    examples/fbatcher.f — the reference treats Fortran as first-class,
+    reference src/adlbf.c:6-103) against native servers."""
+    fc = _fortran_compiler()
+    lib = build_libadlb()
+    libdir = os.path.dirname(lib)
+    exe = str(tmp_path / prog)
+    src = os.path.join(_EXAMPLES, f"{prog}.f")
+    inc = os.path.join(os.path.dirname(_EXAMPLES), "include")
+    subprocess.run(
+        [fc, "-O2", f"-I{inc}", "-o", exe, src,
+         f"-L{libdir}", "-ladlb", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+    env_extra = {}
+    if prog == "fbatcher":
+        batch = tmp_path / "jobs.txt"
+        batch.write_text("".join(f"echo JOB-{i}\n" for i in range(6)))
+        env_extra["ADLB_BATCH_FILE"] = str(batch)
+    results, _ = run_native_world(
+        n_clients=3, nservers=2, types=[1, 2, 3], exe=exe,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.2),
+        env_extra=env_extra, timeout=120.0,
+    )
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+    if prog == "f1":
+        assert "F1 OK" in results[0][1]
+    else:
+        ran = sum(
+            int(out.split("FBATCHER RAN")[1].split()[0])
+            for _, out, _ in results if "FBATCHER RAN" in out
+        )
+        assert ran == 6
+        jobs = "".join(out for _, out, _ in results)
+        assert all(f"JOB-{i}" in jobs for i in range(6))
+
+
+def test_mangling_override_abi(tmp_path):
+    """The ADLB_FC_GLOBAL override path: build the shim with an UPPERCASE
+    no-underscore convention (what FortranCInterface generates for e.g.
+    classic UPPERCASE compilers) and drive it from a caller emitting that
+    convention — validating the macro plumbing against a second ABI
+    besides the GNU default (reference CMakeLists.txt:62-68)."""
+    native = os.path.join(os.path.dirname(_EXAMPLES), "adlb_tpu", "native")
+    inc = os.path.join(os.path.dirname(_EXAMPLES), "include")
+    lib = str(tmp_path / "libadlb_uc.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+         "-DADLB_FC_GLOBAL(lc,UC)=UC", f"-I{inc}", "-o", lib,
+         os.path.join(native, "libadlb.cpp"),
+         os.path.join(native, "adlbf.c")],
+        check=True, capture_output=True, text=True,
+    )
+    syms = subprocess.run(
+        ["nm", "-D", "--defined-only", lib],
+        check=True, capture_output=True, text=True,
+    ).stdout
+    assert " ADLB_INIT\n" in syms.replace("T ", " ").replace("t ", " ") or (
+        "ADLB_INIT" in syms
+    )
+    assert "adlb_init_" not in syms  # the default convention is replaced
