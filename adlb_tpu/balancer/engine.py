@@ -26,8 +26,25 @@ compensated at enactment (holders validate against live state).
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Optional
+
+# Plan-age samples: for every round that produced output, the age of the
+# OLDEST snapshot the plan was computed from (seconds between that
+# state's capture and the plan being handed to the transport). This is
+# the end-to-end staleness the snapshot->solve->enact pipeline delivers —
+# the quantity the reference's design fixes at qmstat_interval x ring
+# hops (reference src/adlb.c:165,1705-1757) and this architecture keeps
+# event-driven. Module-level so benches can read it across whichever
+# engines (in-server threads, sidecar) a world spawned in-process.
+_PLAN_AGES: "collections.deque[float]" = collections.deque(maxlen=4096)
+
+
+def drain_plan_ages() -> list:
+    out = list(_PLAN_AGES)
+    _PLAN_AGES.clear()
+    return out
 
 
 class PlanEngine:
@@ -158,6 +175,19 @@ class PlanEngine:
         migrations = self._plan_migrations(
             snapshots, filtered, planned_away, t_planned
         )
+        if matches or migrations:
+            involved = (
+                {h for h, *_ in matches}
+                | {m[2] for m in matches}  # req_home: the demand side
+                | {src for src, _, _ in migrations}
+            )
+            ages = [
+                t_planned - snapshots[r].get("stamp", t_planned)
+                for r in involved
+                if r in snapshots
+            ]
+            if ages:
+                _PLAN_AGES.append(max(ages))
         # bound the memory of the plan ledgers
         if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
             cutoff = t_planned - 5.0

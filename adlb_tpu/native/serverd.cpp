@@ -1215,6 +1215,20 @@ class Server {
   void on_info_get(const NMsg& m) {
     int key = int(m.geti(F_KEY));
     NMsg r = mk(T_TA_INFO_GET_RESP);
+    // beyond-reference L0 introspection keys (types.py RSS_KB /
+    // TRANSPORT_BACKLOG) live past K_LAST
+    if (key == 13) {  // RSS_KB
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.setd(F_VALUE, double(rss_kb()));
+      ep_->send(m.src, r);
+      return;
+    }
+    if (key == 14) {  // TRANSPORT_BACKLOG
+      r.seti(F_RC, ADLB_SUCCESS);
+      r.setd(F_VALUE, double(ep_->backlog()));
+      ep_->send(m.src, r);
+      return;
+    }
     if (key < 1 || key >= K_LAST) {
       r.seti(F_RC, -1);
       r.setd(F_VALUE, 0.0);

@@ -72,6 +72,15 @@ class DebugServer:
         last_msg = time.monotonic()
         last_print = last_msg
         print_interval = self.cfg.debug_print_interval
+        try:
+            self._run(ended, last_msg, last_print, print_interval)
+        finally:
+            # flush the final partial window so short runs still get
+            # their aggregate line
+            if print_interval > 0:
+                self._print_window(time.monotonic() - last_print)
+
+    def _run(self, ended, last_msg, last_print, print_interval) -> None:
         while len(ended) < self.world.nservers:
             if self._abort_event is not None and self._abort_event.is_set():
                 return

@@ -1929,6 +1929,11 @@ class Server:
         ss = sum(
             n for t, n in self.tag_freq.items() if t.name.startswith("SS_")
         )
+        # self_diagnosis clears tag_freq on its own cadence; a counter
+        # that went backwards means a reset, so the delta restarts from 0
+        if events < self._ds_last["events"] or ss < self._ds_last["ss"]:
+            self._ds_last["events"] = 0
+            self._ds_last["ss"] = 0
         wq_targeted = sum(
             1 for u in self.wq.units() if u.target_rank >= 0
         )

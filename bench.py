@@ -241,7 +241,20 @@ def main() -> None:
 
     tric_steal = tric("steal")
     tric_fast = tric("steal_fast")
+    # plan age = staleness of the snapshot state each enacted plan was
+    # computed from; collected over the tpu trickle run (the pipeline's
+    # end-to-end latency metric, alongside the app-visible dispatch p50)
+    from adlb_tpu.balancer.engine import drain_plan_ages
+
+    drain_plan_ages()
     tric_tpu = tric("tpu")
+    ages = sorted(drain_plan_ages())
+
+    def pct(v, p):
+        return v[min(int(p * len(v)), len(v) - 1)] if v else 0.0
+
+    plan_age_p50_ms = round(pct(ages, 0.50) * 1e3, 2)
+    plan_age_p90_ms = round(pct(ages, 0.90) * 1e3, 2)
 
     # solve scale: end-to-end snapshot->pairs latency of the batched global
     # solve at pool sizes far beyond the reference's feasible scale (its
@@ -323,6 +336,8 @@ def main() -> None:
             "trickle_dispatch_p90_ms_steal": round(
                 tric_steal.dispatch_p90_ms, 2),
             "trickle_dispatch_p90_ms_tpu": round(tric_tpu.dispatch_p90_ms, 2),
+            "plan_age_p50_ms": plan_age_p50_ms,
+            "plan_age_p90_ms": plan_age_p90_ms,
             "dispatch_speedup_vs_upstream": round(
                 tric_steal.dispatch_p50_ms / tric_tpu.dispatch_p50_ms, 2)
             if tric_tpu.dispatch_p50_ms else 0.0,
