@@ -114,6 +114,16 @@ class PlanEngine:
         self.max_malloc_per_server = max_malloc_per_server
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
+        # rank -> plan stamps of migration units en route there; until the
+        # destination ships a FRESH task snapshot (task_stamp past the plan
+        # time) those units are invisible in its inventory, and without
+        # crediting them the planner chains phantom top-ups to a
+        # destination that is already being fed
+        self._planned_in: dict[int, list] = {}
+        # rank -> adaptive per-consumer lookahead window and the time it
+        # last triggered a top-up (see LOOKAHEAD)
+        self._look: dict[int, float] = {}
+        self._look_last: dict[int, float] = {}
 
     def force_host_path(self) -> None:
         """After a device/backend failure: keep planning on numpy — for the
@@ -146,8 +156,26 @@ class PlanEngine:
                 if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
             ]
         have_reqs = any(freqs.values())
-        if not have_reqs and not self._maybe_imbalanced(snapshots):
-            return [], []
+        # The solve's only useful output is CROSS-server pairs: same-server
+        # pairs are dropped below (the data plane's immediate local matching
+        # already covers them), so a round where no parked requester's
+        # wanted type has supply on a *different* server can skip the solve
+        # entirely. In saturated compute-bound worlds (nq/tsp/sudoku) nearly
+        # every round is such a round — workers park only transiently
+        # against local supply — and on a shared core every skipped solve
+        # is cycles handed back to the workers. The gate reads RAW task
+        # lists (no per-task ledger lookups): in-flight planned tasks can
+        # over-admit a solve for one snapshot generation, which the
+        # filtered solve input then corrects.
+        cross = False
+        if have_reqs:
+            sup_ranks: dict[int, set] = {}  # work type -> ranks with supply
+            for rank, snap in snapshots.items():
+                for t in snap["tasks"]:
+                    sup_ranks.setdefault(t[1], set()).add(rank)
+            cross = self._cross_feasible(freqs, sup_ranks)
+        if not cross and not self._maybe_imbalanced(snapshots):
+            return [], []  # nothing plannable: skip the task-ledger walk
         filtered = {}
         for rank, snap in snapshots.items():
             # task eligibility uses the task-side stamp: a reqs-only park
@@ -158,10 +186,10 @@ class PlanEngine:
                 if self._planned_tasks.get((rank, t[0]), -1.0) < tstamp
             ]
             filtered[rank] = {"tasks": tasks, "reqs": freqs[rank]}
-        if have_reqs:
+        if cross:
             pairs = self.solver.solve(filtered, world)
         else:
-            pairs = []  # nobody parked; still consider migrations below
+            pairs = []  # still consider migrations below
         t_planned = time.monotonic()
         matches = []
         planned_away: dict[int, set] = {}
@@ -180,6 +208,7 @@ class PlanEngine:
                 {h for h, *_ in matches}
                 | {m[2] for m in matches}  # req_home: the demand side
                 | {src for src, _, _ in migrations}
+                | {dest for _, dest, _ in migrations}  # deficit side
             )
             ages = [
                 t_planned - snapshots[r].get("stamp", t_planned)
@@ -197,7 +226,73 @@ class PlanEngine:
             self._planned_tasks = {
                 k: v for k, v in self._planned_tasks.items() if v > cutoff
             }
+        if self._planned_in:
+            # inflow credits for ranks that stopped appearing in snapshots
+            # (ended servers) are pruned nowhere else
+            horizon = t_planned - self.INFLOW_TTL
+            self._planned_in = {
+                r: kept
+                for r, lst in self._planned_in.items()
+                if (kept := [ts for ts in lst if ts > horizon])
+            }
         return matches, migrations
+
+    @staticmethod
+    def _cross_feasible(freqs: dict, sup_ranks: dict) -> bool:
+        """True if some parked requester could be served from another
+        server's inventory (the only matches the solve can contribute)."""
+        for r, reqs in freqs.items():
+            for req in reqs:
+                types = req[2]
+                cand = sup_ranks if types is None else types
+                for t in cand:
+                    ranks = sup_ranks.get(t)
+                    if ranks and (len(ranks) > 1 or r not in ranks):
+                        return True
+        return False
+
+    # Per-consumer lookahead window: a server already holding this many
+    # ready units per local consumer is never migration-deficient, no
+    # matter how far below its proportional share it sits. Without the
+    # cap, abundant-but-uneven pools (saturated compute-bound worlds whose
+    # untargeted puts round-robin roughly evenly) churn a steady stream of
+    # proportional-rebalance moves — each one transfer messages plus a
+    # briefly unavailable unit — that no consumer ever needed. Starved
+    # servers (hotspot's empty ones) sit far below the window and still
+    # trigger immediately.
+    #
+    # The window is ADAPTIVE per destination: units are a poor proxy for
+    # time (a fine-grained workload drains 8 units in a millisecond), so a
+    # destination that re-triggers its deficit shortly after the last
+    # top-up has its window doubled — transfer batches grow until one
+    # batch covers the drain rate times the re-plan round trip (batches
+    # are O(1) messages regardless of size, so bigger batches amortize) —
+    # and a destination that stays quiet decays back toward the floor.
+    LOOKAHEAD = 8
+    LOOK_MAX = 512  # per consumer
+    LOOK_GROW_WINDOW = 0.25  # s: re-trigger sooner than this -> double
+    # Credits for in-flight migration batches expire after this long even
+    # if the destination never ships a fresh task snapshot (idle empty
+    # servers suppress repeat empty snapshots, and an enactment may drop
+    # the batch entirely) — a lost batch must delay re-supply, not
+    # suppress it forever.
+    INFLOW_TTL = 2.0
+
+    def _window(self, rank: int) -> float:
+        return self._look.get(rank, float(self.LOOKAHEAD))
+
+    def _need(self, share: int, consumers: int, rank: int) -> int:
+        return min(share, int(self._window(rank)) * consumers)
+
+    def _touch_window(self, rank: int, now: float) -> None:
+        """Called when `rank` triggered a top-up: grow on quick re-trigger,
+        decay otherwise."""
+        look = self._window(rank)
+        if now - self._look_last.get(rank, -1e9) < self.LOOK_GROW_WINDOW:
+            self._look[rank] = min(look * 2.0, float(self.LOOK_MAX))
+        else:
+            self._look[rank] = max(float(self.LOOKAHEAD), look / 2.0)
+        self._look_last[rank] = now
 
     def _maybe_imbalanced(self, snaps: dict) -> bool:
         """Cheap pre-check (raw snapshot counts, no ledger filtering) for
@@ -215,7 +310,8 @@ class PlanEngine:
         if total < total_c:
             return False  # scarcity: matches handle it (see below)
         return any(
-            c > 0 and 2 * raw[r] * total_c < total * c
+            c > 0
+            and 2 * raw[r] < self._need(-(-total * c // total_c), c, r)
             for r, c in consumers.items()
         )
 
@@ -225,12 +321,44 @@ class PlanEngine:
         """Fair-share inventory placement (see module docstring)."""
         inv: dict[int, list] = {}
         consumers: dict[int, int] = {}
+        inflow: dict[int, int] = {}
         for rank, f in filtered.items():
             avail = [
                 t for t in f["tasks"] if t[0] not in planned_away.get(rank, ())
             ]
+            if f["reqs"] and avail:
+                # Withhold one locally-matchable unit per parked requester:
+                # the data plane's local matching hands these over with no
+                # cross-server traffic, and when the solve was gated off
+                # (supply local-only) nothing else protects them from
+                # being migrated out from under their local demander.
+                withheld: set = set()
+                for req in f["reqs"]:
+                    types = req[2]
+                    for t in avail:
+                        if t[0] not in withheld and (
+                            types is None or t[1] in types
+                        ):
+                            withheld.add(t[0])
+                            break
+                if withheld:
+                    avail = [t for t in avail if t[0] not in withheld]
             inv[rank] = avail
             consumers[rank] = snaps.get(rank, {}).get("consumers", 0)
+            snap = snaps.get(rank, {})
+            # stamp-less snapshots (tstamp = now) retry every round rather
+            # than credit forever, matching round()'s stamp fallback
+            tstamp = snap.get("task_stamp", snap.get("stamp", t_planned))
+            horizon = t_planned - self.INFLOW_TTL
+            live = [
+                ts for ts in self._planned_in.get(rank, ())
+                if ts > tstamp and ts > horizon
+            ]
+            if live:
+                self._planned_in[rank] = live
+            else:
+                self._planned_in.pop(rank, None)
+            inflow[rank] = len(live)
         total_consumers = sum(consumers.values())
         if total_consumers == 0:
             return []
@@ -252,17 +380,17 @@ class PlanEngine:
             return -(-total_avail * c // total_consumers) if c else 0
 
         # Hysteresis: only treat a server as deficient when it holds less
-        # than HALF its fair share. Without the band, servers hovering near
-        # their share (e.g. compute-bound workloads whose untargeted puts
-        # already round-robin evenly, like tsp) trigger a constant shuffle
-        # of inventory moves — each one costs transfer messages and makes
-        # the unit briefly unavailable — for no placement benefit. Truly
+        # than HALF its demand-capped need (see LOOKAHEAD). Without the
+        # band, servers hovering near the threshold trigger a constant
+        # shuffle of inventory moves for no placement benefit. Truly
         # starved destinations (hotspot's empty servers) sit far below the
         # band and still trigger immediately.
         deficits = {
-            r: share(r) - len(inv[r])
+            r: self._need(share(r), c, r) - len(inv[r]) - inflow.get(r, 0)
             for r, c in consumers.items()
-            if c > 0 and 2 * len(inv[r]) < share(r)
+            if c > 0
+            and 2 * (len(inv[r]) + inflow.get(r, 0))
+            < self._need(share(r), c, r)
         }
         if not deficits:
             return []
@@ -295,8 +423,18 @@ class PlanEngine:
                     )
                     want -= len(take)
         out = []
+        fed: set = set()
         for (src_rank, dest), seqnos in moves.items():
             for q in seqnos:
                 self._planned_tasks[(src_rank, q)] = t_planned
+            self._planned_in.setdefault(dest, []).extend(
+                [t_planned] * len(seqnos)
+            )
+            fed.add(dest)
             out.append((src_rank, dest, seqnos))
+        # adapt windows only for destinations that were actually SHIPPED a
+        # batch: a deficit no surplus could serve must not inflate the
+        # window (it would silently disable the cap when supply returns)
+        for dest in fed:
+            self._touch_window(dest, t_planned)
         return out

@@ -79,7 +79,12 @@ enum InfoKey {
   K_NUM_RESERVES = 10,
   K_NUM_RESERVES_PUT_ON_RQ = 11,
   K_MAX_WQ_COUNT = 12,
-  K_LAST = 13,
+  K_LAST = 13,  // bound of the stats_[] table; keys below are NOT stats slots
+  // introspection keys answered from live probes, not the stats_[] table
+  // (must match ADLB_INFO_RSS_KB / ADLB_INFO_TRANSPORT_BACKLOG in
+  // include/adlb/adlb.h and types.py InfoKey)
+  K_RSS_KB = 13,
+  K_TRANSPORT_BACKLOG = 14,
 };
 
 // ---- wire tags (codec.py WIRE_TAG) ----------------------------------------
@@ -1215,15 +1220,13 @@ class Server {
   void on_info_get(const NMsg& m) {
     int key = int(m.geti(F_KEY));
     NMsg r = mk(T_TA_INFO_GET_RESP);
-    // beyond-reference L0 introspection keys (types.py RSS_KB /
-    // TRANSPORT_BACKLOG) live past K_LAST
-    if (key == 13) {  // RSS_KB
+    if (key == K_RSS_KB) {
       r.seti(F_RC, ADLB_SUCCESS);
       r.setd(F_VALUE, double(rss_kb()));
       ep_->send(m.src, r);
       return;
     }
-    if (key == 14) {  // TRANSPORT_BACKLOG
+    if (key == K_TRANSPORT_BACKLOG) {
       r.seti(F_RC, ADLB_SUCCESS);
       r.setd(F_VALUE, double(ep_->backlog()));
       ep_->send(m.src, r);

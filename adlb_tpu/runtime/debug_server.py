@@ -70,25 +70,25 @@ class DebugServer:
     def run(self) -> None:
         ended: set[int] = set()
         last_msg = time.monotonic()
-        last_print = last_msg
+        self._last_print = last_msg
         print_interval = self.cfg.debug_print_interval
         try:
-            self._run(ended, last_msg, last_print, print_interval)
+            self._run(ended, last_msg, print_interval)
         finally:
             # flush the final partial window so short runs still get
             # their aggregate line
             if print_interval > 0:
-                self._print_window(time.monotonic() - last_print)
+                self._print_window(time.monotonic() - self._last_print)
 
-    def _run(self, ended, last_msg, last_print, print_interval) -> None:
+    def _run(self, ended, last_msg, print_interval) -> None:
         while len(ended) < self.world.nservers:
             if self._abort_event is not None and self._abort_event.is_set():
                 return
             m = self.ep.recv(timeout=min(self.cfg.debug_server_timeout / 4, 0.25))
             now = time.monotonic()
-            if print_interval > 0 and now - last_print >= print_interval:
-                self._print_window(now - last_print)
-                last_print = now
+            if print_interval > 0 and now - self._last_print >= print_interval:
+                self._print_window(now - self._last_print)
+                self._last_print = now
             if m is None:
                 if now - last_msg > self.cfg.debug_server_timeout:
                     self.timed_out = True

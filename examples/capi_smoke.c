@@ -98,9 +98,9 @@ int main(void) {
     if (rc != ADLB_SUCCESS || hwm <= 0.0) return 14;
     /* beyond-reference L0 introspection: server RSS + transport backlog */
     double rss = -1.0, backlog = -1.0;
-    rc = ADLB_Info_get(13 /* RSS_KB */, &rss);
+    rc = ADLB_Info_get(ADLB_INFO_RSS_KB, &rss);
     if (rc != ADLB_SUCCESS || rss <= 0.0) return 15;
-    rc = ADLB_Info_get(14 /* TRANSPORT_BACKLOG */, &backlog);
+    rc = ADLB_Info_get(ADLB_INFO_TRANSPORT_BACKLOG, &backlog);
     if (rc != ADLB_SUCCESS || backlog < 0.0) return 16;
     ADLB_Set_problem_done();
   }

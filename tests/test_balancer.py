@@ -201,3 +201,109 @@ def test_hungry_tracker_drop_arms_shrink():
     assert flushed is not None
     hungry, req_types, grew = flushed
     assert hungry is False and not grew
+
+
+def test_solve_gated_when_supply_is_local_only():
+    """A parked requester whose wanted type has supply only on its OWN
+    server must not trigger the global solve: the data plane's immediate
+    local matching covers it, and the solve's same-server pairs are
+    dropped anyway. Cross-server supply must still solve."""
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=16, max_requesters=4)
+    calls = []
+    inner = eng.solver.solve
+    eng.solver.solve = lambda *a, **k: (calls.append(1), inner(*a, **k))[1]
+    local_only = {
+        10: {"tasks": [(1, T1, 5, 8)], "reqs": [(0, 1, [T1])],
+             "consumers": 1},
+    }
+    matches, _ = eng.round(local_only, None)
+    assert matches == [] and calls == []
+    cross = {
+        10: {"tasks": [(1, T1, 5, 8)], "reqs": [], "consumers": 1},
+        11: {"tasks": [], "reqs": [(0, 1, [T1])], "consumers": 1},
+    }
+    matches, _ = eng.round(cross, None)
+    assert calls and matches == [(10, 1, 11, 0, 1)]
+
+
+def test_migration_inflow_credited_until_fresh_snapshot():
+    """Units planned toward a destination count as its inventory until the
+    destination ships a FRESH task snapshot — otherwise every round chains
+    another phantom top-up to a server that is already being fed."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=4)
+    t0 = _time.monotonic()
+    snaps = {
+        10: {"tasks": [(i, T1, 1, 8) for i in range(40)], "reqs": [],
+             "consumers": 1, "stamp": t0, "task_stamp": t0},
+        11: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t0,
+             "task_stamp": t0},
+    }
+    _, migs = eng.round(snaps, None)
+    assert migs, "starved server must be supplied"
+    # same stale snapshots again: the in-flight batch covers 11's need
+    _, migs2 = eng.round(snaps, None)
+    assert migs2 == []
+    # fresh snapshot from 11 showing it drained everything -> supply again
+    t1 = _time.monotonic()
+    snaps[11] = {"tasks": [], "reqs": [], "consumers": 1, "stamp": t1,
+                 "task_stamp": t1}
+    snaps[10] = dict(snaps[10], stamp=t1, task_stamp=t1)
+    _, migs3 = eng.round(snaps, None)
+    assert migs3
+
+
+def test_migration_window_grows_on_fast_drain():
+    """A destination that keeps draining its top-ups faster than the
+    re-plan round trip gets a doubling transfer window, so batch sizes
+    converge on the drain rate instead of trickling fixed-size refills
+    (batches are O(1) messages regardless of size)."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=512, max_requesters=4)
+    # the growth criterion is "re-triggered within the window"; pin it so
+    # a slow CI machine cannot flip growth into decay mid-test
+    eng.LOOK_GROW_WINDOW = 1e9
+    sizes = []
+    for i in range(4):
+        t = _time.monotonic()
+        snaps = {
+            10: {"tasks": [(1000 * i + j, T1, 1, 8) for j in range(400)],
+                 "reqs": [], "consumers": 1, "stamp": t, "task_stamp": t},
+            11: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t,
+                 "task_stamp": t},
+        }
+        _, migs = eng.round(snaps, None)
+        assert migs and migs[0][1] == 11
+        sizes.append(sum(len(q) for _, _, q in migs))
+    assert sizes[-1] > sizes[0], sizes
+    assert sizes == sorted(sizes), sizes
+
+
+def test_migration_spares_locally_demanded_unit():
+    """With the solve gated off (supply local-only), migration planning
+    must not ship away the unit a locally parked requester wants."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1, T2), max_tasks=16, max_requesters=4)
+    t0 = _time.monotonic()
+    snaps = {
+        10: {"tasks": [(1, T1, 5, 8), (2, T1, 4, 8), (3, T2, 3, 8)],
+             "reqs": [(0, 1, [T2])], "consumers": 1, "stamp": t0,
+             "task_stamp": t0},
+        11: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t0,
+             "task_stamp": t0},
+    }
+    matches, migs = eng.round(snaps, None)
+    assert matches == []  # T2 supply is local to its demander: no solve
+    moved = {q for _, _, qs in migs for q in qs}
+    assert 3 not in moved, (matches, migs)
