@@ -167,13 +167,7 @@ class PlanEngine:
         # lists (no per-task ledger lookups): in-flight planned tasks can
         # over-admit a solve for one snapshot generation, which the
         # filtered solve input then corrects.
-        cross = False
-        if have_reqs:
-            sup_ranks: dict[int, set] = {}  # work type -> ranks with supply
-            for rank, snap in snapshots.items():
-                for t in snap["tasks"]:
-                    sup_ranks.setdefault(t[1], set()).add(rank)
-            cross = self._cross_feasible(freqs, sup_ranks)
+        cross = have_reqs and self._cross_feasible(freqs, snapshots)
         if not cross and not self._maybe_imbalanced(snapshots):
             return [], []  # nothing plannable: skip the task-ledger walk
         filtered = {}
@@ -238,17 +232,30 @@ class PlanEngine:
         return matches, migrations
 
     @staticmethod
-    def _cross_feasible(freqs: dict, sup_ranks: dict) -> bool:
+    def _cross_feasible(freqs: dict, snapshots: dict) -> bool:
         """True if some parked requester could be served from another
-        server's inventory (the only matches the solve can contribute)."""
+        server's inventory (the only matches the solve can contribute).
+        Demand first (reqs are few), then scan tasks with an early exit —
+        a round that can plan nothing must stay cheap even when queues
+        are deep."""
+        demand: dict[int, set] = {}  # work type -> demander home ranks
+        any_dem: set = set()  # homes of any-type requesters
         for r, reqs in freqs.items():
             for req in reqs:
-                types = req[2]
-                cand = sup_ranks if types is None else types
-                for t in cand:
-                    ranks = sup_ranks.get(t)
-                    if ranks and (len(ranks) > 1 or r not in ranks):
-                        return True
+                if req[2] is None:
+                    any_dem.add(r)
+                else:
+                    for t in req[2]:
+                        demand.setdefault(t, set()).add(r)
+        if not demand and not any_dem:
+            return False
+        for rank, snap in snapshots.items():
+            for t in snap["tasks"]:
+                dem = demand.get(t[1])
+                if dem and (len(dem) > 1 or rank not in dem):
+                    return True
+                if any_dem and (len(any_dem) > 1 or rank not in any_dem):
+                    return True
         return False
 
     # Per-consumer lookahead window: a server already holding this many
@@ -277,6 +284,12 @@ class PlanEngine:
     # the batch entirely) — a lost batch must delay re-supply, not
     # suppress it forever.
     INFLOW_TTL = 2.0
+    # ... and survive at least this long regardless of snapshot stamps: a
+    # destination's snapshot captured after the plan but before the batch
+    # LANDS must not wipe the credit (that would re-create the phantom
+    # top-up chain for destinations that snapshot faster than batch
+    # transit).
+    INFLOW_MIN_AGE = 0.05
 
     def _window(self, rank: int) -> float:
         return self._look.get(rank, float(self.LOOKAHEAD))
@@ -350,9 +363,10 @@ class PlanEngine:
             # than credit forever, matching round()'s stamp fallback
             tstamp = snap.get("task_stamp", snap.get("stamp", t_planned))
             horizon = t_planned - self.INFLOW_TTL
+            young = t_planned - self.INFLOW_MIN_AGE
             live = [
                 ts for ts in self._planned_in.get(rank, ())
-                if ts > tstamp and ts > horizon
+                if (ts > tstamp or ts > young) and ts > horizon
             ]
             if live:
                 self._planned_in[rank] = live
@@ -409,14 +423,14 @@ class PlanEngine:
                 if src_rank == dest or not lst:
                     continue
                 take = []
-                while lst and len(take) < want:
-                    t = lst[0]
+                for t in lst:
+                    if len(take) >= want:
+                        break
                     if cap > 0 and dest_bytes + t[3] > 0.9 * cap:
                         break  # planner-side admission: dest believed full
                     take.append(t)
                     dest_bytes += t[3]
-                    lst = lst[1:]
-                surpluses[src_rank] = lst
+                surpluses[src_rank] = lst = lst[len(take):]
                 if take:
                     moves.setdefault((src_rank, dest), []).extend(
                         t[0] for t in take

@@ -350,6 +350,11 @@ class Server:
         finally:
             if self._balancer is not None:
                 self._balancer.stop()
+                # bounded join: a straggler round finishing after teardown
+                # would otherwise overlap (and contend with) the next world
+                # in back-to-back in-process runs; never wait on a wedged
+                # device solve, though — the thread is a daemon
+                self._balancer.join(timeout=1.0)
             self._notify_debug_server_end()
             aprintf(
                 self.cfg.aprintf_flag, self.rank,

@@ -249,11 +249,21 @@ def test_migration_inflow_credited_until_fresh_snapshot():
     # same stale snapshots again: the in-flight batch covers 11's need
     _, migs2 = eng.round(snaps, None)
     assert migs2 == []
-    # fresh snapshot from 11 showing it drained everything -> supply again
+    # a fresh-but-instant snapshot (captured before the batch could have
+    # LANDED) must not wipe the credit either
     t1 = _time.monotonic()
     snaps[11] = {"tasks": [], "reqs": [], "consumers": 1, "stamp": t1,
                  "task_stamp": t1}
     snaps[10] = dict(snaps[10], stamp=t1, task_stamp=t1)
+    _, migs2b = eng.round(snaps, None)
+    assert migs2b == []
+    # past the transit window, a fresh drained snapshot clears the credit
+    # -> supply again (pin the window instead of sleeping through it)
+    eng.INFLOW_MIN_AGE = 0.0
+    t2 = _time.monotonic()
+    snaps[11] = {"tasks": [], "reqs": [], "consumers": 1, "stamp": t2,
+                 "task_stamp": t2}
+    snaps[10] = dict(snaps[10], stamp=t2, task_stamp=t2)
     _, migs3 = eng.round(snaps, None)
     assert migs3
 
@@ -269,8 +279,11 @@ def test_migration_window_grows_on_fast_drain():
 
     eng = PlanEngine(types=(T1,), max_tasks=512, max_requesters=4)
     # the growth criterion is "re-triggered within the window"; pin it so
-    # a slow CI machine cannot flip growth into decay mid-test
+    # a slow CI machine cannot flip growth into decay mid-test, and drop
+    # the in-flight transit crediting (tested elsewhere) so each fresh
+    # snapshot re-triggers immediately
     eng.LOOK_GROW_WINDOW = 1e9
+    eng.INFLOW_MIN_AGE = 0.0
     sizes = []
     for i in range(4):
         t = _time.monotonic()
