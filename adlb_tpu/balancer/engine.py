@@ -58,6 +58,11 @@ class PlanEngine:
         use_mesh: bool = False,
         nservers: Optional[int] = None,
         host_threshold_reqs: Optional[int] = None,
+        lookahead: Optional[int] = None,
+        look_max: Optional[int] = None,
+        grow_window: Optional[float] = None,
+        inflow_ttl: Optional[float] = None,
+        inflow_min_age: Optional[float] = None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
 
@@ -112,6 +117,19 @@ class PlanEngine:
                 **kw,
             )
         self.max_malloc_per_server = max_malloc_per_server
+        # per-instance overrides of the pump constants (Config knobs)
+        if lookahead is not None:
+            self.LOOKAHEAD = lookahead
+        if look_max is not None:
+            self.LOOK_MAX = look_max
+        if grow_window is not None:
+            self.LOOK_GROW_WINDOW = grow_window
+        if inflow_ttl is not None:
+            self.INFLOW_TTL = inflow_ttl
+        if inflow_min_age is not None:
+            self.INFLOW_MIN_AGE = inflow_min_age
+        if self.INFLOW_MIN_AGE > self.INFLOW_TTL:
+            raise ValueError("inflow_min_age must be <= inflow_ttl")
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
         # rank -> plan stamps of migration units en route there; until the
@@ -432,7 +450,6 @@ class PlanEngine:
                     dest_bytes += t[3]
                 if take:
                     surpluses[src_rank] = lst = lst[len(take):]
-                if take:
                     moves.setdefault((src_rank, dest), []).extend(
                         t[0] for t in take
                     )

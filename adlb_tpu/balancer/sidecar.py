@@ -97,6 +97,11 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         use_mesh=cfg.balancer_mesh == "auto",
         nservers=world.nservers,
         host_threshold_reqs=cfg.solver_host_threshold,
+        lookahead=cfg.balancer_lookahead,
+        look_max=cfg.balancer_look_max,
+        grow_window=cfg.balancer_grow_window,
+        inflow_ttl=cfg.balancer_inflow_ttl,
+        inflow_min_age=cfg.balancer_inflow_min_age,
     )
     snapshots: dict[int, dict] = {}
     ended: set[int] = set()

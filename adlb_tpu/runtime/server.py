@@ -97,6 +97,11 @@ class _BalancerWorker(threading.Thread):
             use_mesh=s.cfg.balancer_mesh == "auto",
             nservers=s.world.nservers,
             host_threshold_reqs=s.cfg.solver_host_threshold,
+            lookahead=s.cfg.balancer_lookahead,
+            look_max=s.cfg.balancer_look_max,
+            grow_window=s.cfg.balancer_grow_window,
+            inflow_ttl=s.cfg.balancer_inflow_ttl,
+            inflow_min_age=s.cfg.balancer_inflow_min_age,
         )
         s._solver = engine.solver
         while True:

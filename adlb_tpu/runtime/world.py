@@ -113,6 +113,18 @@ class Config:
     # snapshot (fixed shapes for the jitted solve).
     balancer_max_tasks: int = 256
     balancer_max_requesters: int = 64
+    # Adaptive migration-pump knobs (balancer/engine.py): a server holding
+    # >= lookahead ready units per local consumer is never
+    # migration-deficient; a destination that re-triggers its deficit
+    # within grow_window seconds of the last shipped batch has its
+    # per-consumer window doubled (capped at look_max); in-flight batch
+    # credits survive at least inflow_min_age seconds and at most
+    # inflow_ttl. None = engine defaults.
+    balancer_lookahead: "Optional[int]" = None
+    balancer_look_max: "Optional[int]" = None
+    balancer_grow_window: "Optional[float]" = None
+    balancer_inflow_ttl: "Optional[float]" = None
+    balancer_inflow_min_age: "Optional[float]" = None
     # device solve implementation: "auto" = Pallas sweep kernel on TPU, XLA
     # scan elsewhere; explicit "xla"/"pallas" force one
     solver_backend: str = "auto"
@@ -162,6 +174,24 @@ class Config:
         # snapshot lists are flattened into binary-codec list fields whose
         # element count is a u16 (4 entries per task, 3+ntypes per
         # requester); keep a wide safety margin under 65535
+        for knob in ("balancer_lookahead", "balancer_look_max",
+                     "balancer_grow_window", "balancer_inflow_ttl",
+                     "balancer_inflow_min_age"):
+            v = getattr(self, knob)
+            if v is not None and v < 0:
+                raise ValueError(f"{knob} must be >= 0")
+        # the engine cannot honor a transit floor above the credit TTL
+        # (TTL expiry would silently override the min-age guarantee);
+        # literals = the engine defaults (balancer/engine.py INFLOW_TTL /
+        # INFLOW_MIN_AGE), not imported here to keep Config import-light
+        ttl = 2.0 if self.balancer_inflow_ttl is None \
+            else self.balancer_inflow_ttl
+        age = 0.05 if self.balancer_inflow_min_age is None \
+            else self.balancer_inflow_min_age
+        if age > ttl:
+            raise ValueError(
+                "balancer_inflow_min_age must be <= balancer_inflow_ttl"
+            )
         if not (0 < self.balancer_max_tasks <= 8192):
             raise ValueError("balancer_max_tasks must be in 1..8192")
         if not (0 < self.balancer_max_requesters <= 2048):

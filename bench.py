@@ -243,9 +243,10 @@ def main() -> None:
         return r
 
     hot_runs = interleaved(hot_one, modes=("steal", "steal_fast", "tpu"))
-    hot_steal = max(hot_runs["steal"], key=lambda r: r.tasks_per_sec)
-    hot_fast = max(hot_runs["steal_fast"], key=lambda r: r.tasks_per_sec)
-    hot_tpu = max(hot_runs["tpu"], key=lambda r: r.tasks_per_sec)
+    hot_steal = median_by(hot_runs["steal"], key=lambda r: r.tasks_per_sec)
+    hot_fast = median_by(hot_runs["steal_fast"],
+                         key=lambda r: r.tasks_per_sec)
+    hot_tpu = median_by(hot_runs["tpu"], key=lambda r: r.tasks_per_sec)
 
     # trickle: steady arrival at one server, consumers elsewhere — isolates
     # dispatch (discovery) latency, the structural gap between gossip-driven
@@ -264,9 +265,11 @@ def main() -> None:
     drain_plan_ages()
     tric_runs = interleaved(tric_one, modes=("steal", "steal_fast", "tpu"))
     ages = sorted(drain_plan_ages())
-    tric_steal = min(tric_runs["steal"], key=lambda r: r.dispatch_p50_ms)
-    tric_fast = min(tric_runs["steal_fast"], key=lambda r: r.dispatch_p50_ms)
-    tric_tpu = min(tric_runs["tpu"], key=lambda r: r.dispatch_p50_ms)
+    tric_steal = median_by(tric_runs["steal"],
+                           key=lambda r: r.dispatch_p50_ms)
+    tric_fast = median_by(tric_runs["steal_fast"],
+                          key=lambda r: r.dispatch_p50_ms)
+    tric_tpu = median_by(tric_runs["tpu"], key=lambda r: r.dispatch_p50_ms)
 
     def pct(v, p):
         return v[min(int(p * len(v)), len(v) - 1)] if v else 0.0

@@ -325,3 +325,21 @@ def test_migration_spares_locally_demanded_unit():
     assert matches == []  # T2 supply is local to its demander: no solve
     moved = {q for _, _, qs in migs for q in qs}
     assert 3 not in moved, (matches, migs)
+
+
+def test_pump_knobs_config_wiring():
+    """The adaptive-pump constants are per-instance Config knobs, not just
+    class constants."""
+    import pytest
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=16, max_requesters=4,
+                     lookahead=3, look_max=64, grow_window=0.5,
+                     inflow_ttl=9.0, inflow_min_age=0.2)
+    assert (eng.LOOKAHEAD, eng.LOOK_MAX, eng.LOOK_GROW_WINDOW,
+            eng.INFLOW_TTL, eng.INFLOW_MIN_AGE) == (3, 64, 0.5, 9.0, 0.2)
+    # class defaults untouched
+    assert PlanEngine.LOOKAHEAD == 8
+    with pytest.raises(ValueError):
+        Config(balancer_lookahead=-1)
