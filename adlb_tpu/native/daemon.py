@@ -40,6 +40,8 @@ def spawn_daemon(world, cfg, rank: int) -> subprocess.Popen:
         f"debug_log_interval {cfg.debug_log_interval}",
         f"periodic_log_interval {cfg.periodic_log_interval}",
     ]
+    if cfg.restore_path:
+        lines.append(f"restore_path {cfg.restore_path}")
     if cfg.balancer == "tpu":
         # the JAX balancer sidecar listens at pseudo-rank world.nranks
         lines += [

@@ -60,6 +60,8 @@ enum WireTag : uint16_t {
   T_TA_INFO_NUM_RESP = 1043,
   T_TA_INFO_GET_RESP = 1044,
   T_TA_ABORT = 1046,
+  T_FA_CHECKPOINT = 1048,
+  T_TA_CHECKPOINT_RESP = 1049,
   T_AM_APP = 1047,
 };
 
@@ -93,6 +95,7 @@ enum Field : uint8_t {
   F_APPTAG = 26,
   F_PUT_ID = 58,
   F_FETCH = 59,
+  F_PATH = 72,
 };
 
 enum Kind : uint8_t { K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3 };
@@ -867,6 +870,21 @@ int ADLBP_Info_get(int key, double *value) {
   return (int)resp.geti(F_RC);
 }
 int ADLB_Info_get(int k, double *v) { return ADLBP_Info_get(k, v); }
+
+int ADLBP_Checkpoint(const char *path_prefix, int *units_captured) {
+  // Snapshot the whole pool to <prefix>.<server>.ckpt shards (this
+  // framework's extension — the reference has no pool serialization;
+  // restore via the daemon's restore_path config). Blocks until every
+  // server has written its shard.
+  if (!g || path_prefix == nullptr) return ADLB_ERROR;
+  Encoder e(T_FA_CHECKPOINT, g->rank);
+  e.bytes(F_PATH, path_prefix, strlen(path_prefix));
+  send_msg(g->home, e);
+  Msg resp = wait_for(T_TA_CHECKPOINT_RESP);
+  if (units_captured) *units_captured = (int)resp.geti(F_COUNT);
+  return (int)resp.geti(F_RC);
+}
+int ADLB_Checkpoint(const char *p, int *n) { return ADLBP_Checkpoint(p, n); }
 
 int ADLBP_Info_num_work_units(int work_type, int *num_units, int *num_bytes,
                               int *max_wq_count) {

@@ -28,6 +28,7 @@
 // balancer="tpu" worlds use the Python server.
 
 #include <arpa/inet.h>
+#include <glob.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -136,6 +137,10 @@ enum WireTag : uint16_t {
   T_SS_MIGRATE_ACK = 1121,
   T_DS_LOG = 1131,
   T_DS_END = 1132,
+  // checkpoint/resume (runtime/checkpoint.py; no reference analogue)
+  T_FA_CHECKPOINT = 1048,
+  T_TA_CHECKPOINT_RESP = 1049,
+  T_SS_CHECKPOINT = 1123,
   T_PEER_EOF = 1999,  // transport-internal synthetic signal (never on wire)
 };
 
@@ -198,6 +203,12 @@ enum FieldId : uint8_t {
   F_SS_MSGS = 69,         // i64 (DS_LOG, since last log)
   F_BACKLOG = 70,         // i64 (DS_LOG: unhandled inbox frames)
   F_RSS_KB = 71,          // i64 (DS_LOG: /proc/self/status VmRSS)
+  // checkpoint ring token (shared with codec.py: the requesting client
+  // may be a Python rank)
+  F_PATH = 72,            // bytes: shard path prefix
+  F_CLIENT = 73,          // i64: requesting client's world rank
+  F_STARTED = 74,         // i64: 0 = fresh request at master, 1 = ring token
+  F_CK_COUNTS = 76,       // list: units captured, one entry per ring hop
   // -- balancer sidecar (shared with codec.py: the sidecar is Python) --
   F_REQ_HOME = 46,        // i64
   F_DEST = 47,            // i64
@@ -615,6 +626,9 @@ struct Cfg {
   double balancer_min_gap = 0.002;
   int64_t balancer_max_tasks = 256;
   int64_t balancer_max_requesters = 64;
+  // reload this rank's <prefix>.<rank>.ckpt shard at startup (same shard
+  // bytes as the Python servers: runtime/checkpoint.py ACK1 format)
+  std::string restore_path;
 };
 
 // ---- server state ---------------------------------------------------------
@@ -665,6 +679,7 @@ class Server {
     for (int s = w_.num_app_ranks(); s < w_.num_app_ranks() + w_.nservers; ++s)
       peers_[s];  // default entries
     stats_.assign(K_LAST, 0.0);
+    if (!cfg_.restore_path.empty()) restore_from(cfg_.restore_path);
   }
 
   void run() {
@@ -916,6 +931,8 @@ class Server {
       case T_FA_ABORT: do_abort(int(m.geti(F_CODE, -1)), true); break;
       case T_FA_INFO_NUM_WORK_UNITS: on_info_num(m); break;
       case T_FA_INFO_GET: on_info_get(m); break;
+      case T_FA_CHECKPOINT: on_fa_checkpoint(m); break;
+      case T_SS_CHECKPOINT: on_ss_checkpoint(m); break;
       case T_SS_QMSTAT: on_qmstat(m); break;
       case T_SS_RFR: on_rfr(m); break;
       case T_SS_RFR_RESP: on_rfr_resp(m); break;
@@ -949,7 +966,13 @@ class Server {
       case T_SS_PLAN_MATCH: on_plan_match(m); break;
       case T_SS_PLAN_MIGRATE: on_plan_migrate(m); break;
       case T_SS_MIGRATE_WORK: on_migrate_work(m); break;
-      case T_SS_MIGRATE_ACK: migrate_unacked_ -= 1; break;
+      case T_SS_MIGRATE_ACK:
+        migrate_unacked_ -= 1;
+        if (migrate_unacked_ == 0 && has_held_ckpt_) {
+          has_held_ckpt_ = false;
+          process_checkpoint(held_ckpt_);
+        }
+        break;
       default: die("no handler for tag %u", m.tag);
     }
   }
@@ -1124,6 +1147,219 @@ class Server {
     if (it == cq_.end()) return;
     it->second.refcnt = m.geti(F_REFCNT);
     cq_maybe_gc(seqno);
+  }
+
+  // ---- checkpoint / resume (runtime/checkpoint.py ACK1 shard format) ------
+  // No reference analogue (SURVEY §5: pool serialization absent upstream).
+  // Same ring protocol and shard bytes as the Python servers, so a shard
+  // written by either plane restores into the other.
+
+  int64_t write_ckpt_shard(const std::string& prefix) {
+    std::string body;
+    int64_t n = 0;
+    auto u32 = [](std::string& out, uint32_t v) {
+      out.append((const char*)&v, 4);
+    };
+    auto i32 = [](std::string& out, int32_t v) {
+      out.append((const char*)&v, 4);
+    };
+    auto i64 = [](std::string& out, int64_t v) {
+      out.append((const char*)&v, 8);
+    };
+    for (const auto& kv : wq_.units) {
+      const adlbwq::Unit& u = kv.second;
+      const Meta& meta = meta_.at(u.seqno);
+      i32(body, u.work_type);
+      i32(body, u.target_rank);
+      i32(body, meta.answer_rank);
+      i64(body, int64_t(u.prio));
+      i64(body, meta.common_server);
+      i64(body, meta.common_seqno);
+      u32(body, uint32_t(meta.common_len));
+      u32(body, uint32_t(meta.payload.size()));
+      body.append(meta.payload);
+      n += 1;
+    }
+    std::string out("ACK1");
+    u32(out, uint32_t(n));
+    out += body;
+    u32(out, uint32_t(cq_.size()));
+    for (const auto& kv : cq_) {
+      i64(out, kv.first);
+      i64(out, kv.second.refcnt);
+      i64(out, kv.second.ngets);
+      u32(out, uint32_t(kv.second.buf.size()));
+      out += kv.second.buf;
+    }
+    std::string path = prefix + "." + std::to_string(rank_) + ".ckpt";
+    std::string tmp = path + "." + std::to_string(getpid()) + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (f == nullptr) die("checkpoint: cannot open %s", tmp.c_str());
+    if (std::fwrite(out.data(), 1, out.size(), f) != out.size())
+      die("checkpoint: short write to %s", tmp.c_str());
+    std::fclose(f);
+    if (std::rename(tmp.c_str(), path.c_str()) != 0)
+      die("checkpoint: rename to %s failed", path.c_str());
+    return n;
+  }
+
+  void restore_from(const std::string& prefix) {
+    // stray-shard guard (mirrors runtime/server.py): shards for server
+    // ranks outside this world mean the checkpoint came from a different
+    // world shape — silently loading only our own shard would lose every
+    // unit the extra shards hold
+    glob_t g;
+    std::string pat = prefix + ".*.ckpt";
+    if (glob(pat.c_str(), 0, nullptr, &g) == 0) {
+      for (size_t i = 0; i < g.gl_pathc; ++i) {
+        const char* p = g.gl_pathv[i];
+        const char* tail = p + prefix.size() + 1;  // past "<prefix>."
+        char* end = nullptr;
+        long r = std::strtol(tail, &end, 10);
+        if (end == tail || std::strcmp(end, ".ckpt") != 0) continue;
+        if (!w_.is_server(int(r)))
+          die("checkpoint %s has a shard for rank %ld outside this world's "
+              "servers; restore with the same world shape", prefix.c_str(),
+              r);
+      }
+    }
+    globfree(&g);
+    std::string path = prefix + "." + std::to_string(rank_) + ".ckpt";
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (f == nullptr)
+      die("checkpoint shard missing: %s (was the checkpoint taken with the "
+          "same world shape?)", path.c_str());
+    std::string data;
+    char buf[1 << 16];
+    size_t got;
+    while ((got = std::fread(buf, 1, sizeof buf, f)) > 0) data.append(buf, got);
+    std::fclose(f);
+    size_t off = 0;
+    auto need = [&](size_t n) {
+      if (off + n > data.size()) die("truncated shard %s", path.c_str());
+    };
+    auto rd_u32 = [&]() {
+      need(4);
+      uint32_t v;
+      std::memcpy(&v, data.data() + off, 4);
+      off += 4;
+      return v;
+    };
+    auto rd_i32 = [&]() {
+      need(4);
+      int32_t v;
+      std::memcpy(&v, data.data() + off, 4);
+      off += 4;
+      return v;
+    };
+    auto rd_i64 = [&]() {
+      need(8);
+      int64_t v;
+      std::memcpy(&v, data.data() + off, 8);
+      off += 8;
+      return v;
+    };
+    need(4);
+    if (data.compare(0, 4, "ACK1") != 0)
+      die("bad shard magic in %s", path.c_str());
+    off = 4;
+    uint32_t n = rd_u32();
+    for (uint32_t i = 0; i < n; ++i) {
+      int32_t wt = rd_i32(), tgt = rd_i32(), ans = rd_i32();
+      int64_t prio = rd_i64(), cserver = rd_i64(), cseqno = rd_i64();
+      uint32_t clen = rd_u32(), plen = rd_u32();
+      need(plen);
+      int64_t seqno = next_seqno_++;
+      adlbwq::Unit u{seqno, wt, int32_t(prio), tgt, -1, int64_t(plen)};
+      wq_.units.emplace(seqno, u);
+      wq_.count += 1;
+      if (wq_.count > wq_.max_count) wq_.max_count = wq_.count;
+      wq_.total_bytes += u.payload_len;
+      wq_.index(u);
+      Meta& meta = meta_[seqno];
+      meta.payload.assign(data.data() + off, plen);
+      off += plen;
+      meta.answer_rank = ans;
+      meta.home_server = rank_;
+      meta.common_len = clen;
+      meta.common_server = cserver;
+      meta.common_seqno = cseqno;
+      meta.time_stamp = monotonic();
+      mem_curr_ += plen;
+      if (mem_curr_ > mem_hwm_) mem_hwm_ = mem_curr_;
+    }
+    uint32_t nc = rd_u32();
+    for (uint32_t i = 0; i < nc; ++i) {
+      int64_t seqno = rd_i64(), refcnt = rd_i64(), ngets = rd_i64();
+      uint32_t blen = rd_u32();
+      need(blen);
+      CommonEntry& e = cq_[seqno];
+      e.buf.assign(data.data() + off, blen);
+      off += blen;
+      e.refcnt = refcnt;
+      e.ngets = ngets;
+      mem_curr_ += blen;
+      if (seqno >= next_common_seqno_) next_common_seqno_ = seqno + 1;
+    }
+    if (mem_curr_ > mem_hwm_) mem_hwm_ = mem_curr_;
+    std::fprintf(stderr,
+                 "[adlb_serverd %d] restored %u units, %u common entries "
+                 "from %s\n", rank_, n, nc, path.c_str());
+  }
+
+  void on_fa_checkpoint(const NMsg& m) {
+    const std::string* p = m.getb(F_PATH);
+    if (p == nullptr) die("FA_CHECKPOINT without path");
+    NMsg fwd = mk(T_SS_CHECKPOINT);
+    fwd.setb(F_PATH, *p);
+    fwd.seti(F_CLIENT, m.src);
+    fwd.seti(F_STARTED, 0);
+    if (master_) on_ss_checkpoint(fwd);
+    else ep_->send(w_.master_server_rank(), fwd);
+  }
+
+  void on_ss_checkpoint(const NMsg& m) {
+    // units inside an unacked SS_MIGRATE_WORK live in no wq anywhere;
+    // holding the token until the ack lands keeps them out of the
+    // lost-update window (runtime/server.py does the same)
+    if (migrate_unacked_ != 0) {
+      held_ckpt_ = m;
+      has_held_ckpt_ = true;
+      return;
+    }
+    process_checkpoint(m);
+  }
+
+  void process_checkpoint(const NMsg& m) {
+    const std::string* p = m.getb(F_PATH);
+    if (p == nullptr) die("SS_CHECKPOINT without path");
+    std::vector<int64_t> counts;
+    if (m.getl(F_CK_COUNTS) != nullptr) counts = *m.getl(F_CK_COUNTS);
+    if (master_ && m.geti(F_STARTED, 0) != 0) {  // token came back around
+      ack_checkpoint(m.geti(F_CLIENT), counts);
+      return;
+    }
+    int64_t nn = write_ckpt_shard(*p);
+    counts.push_back(nn);
+    if (master_ && w_.nservers == 1) {
+      ack_checkpoint(m.geti(F_CLIENT), counts);
+      return;
+    }
+    NMsg fwd = mk(T_SS_CHECKPOINT);
+    fwd.setb(F_PATH, *p);
+    fwd.seti(F_CLIENT, m.geti(F_CLIENT));
+    fwd.seti(F_STARTED, 1);
+    fwd.setl(F_CK_COUNTS, std::move(counts));
+    ep_->send(w_.ring_next(rank_), fwd);
+  }
+
+  void ack_checkpoint(int64_t client, const std::vector<int64_t>& counts) {
+    int64_t total = 0;
+    for (int64_t c : counts) total += c;
+    NMsg r = mk(T_TA_CHECKPOINT_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.seti(F_COUNT, total);
+    ep_->send(int(client), r);
   }
 
   void on_did_put_at_remote(const NMsg& m) {
@@ -2376,6 +2612,8 @@ class Server {
   std::unordered_map<int64_t, int64_t> push_offered_;   // qid -> seqno
   std::unordered_map<int64_t, int64_t> push_reserved_;  // qid -> bytes
   int64_t migrate_unacked_ = 0;
+  NMsg held_ckpt_;  // checkpoint token parked on in-flight migrations
+  bool has_held_ckpt_ = false;
   double last_event_snap_ = 0.0;
   bool hungry_ = false;  // sidecar says: parked requesters exist somewhere
   bool hungry_any_ = false;  // ... and one of them accepts any type
@@ -2449,6 +2687,10 @@ int main() {
     else if (key == "balancer_min_gap") is >> cfg.balancer_min_gap;
     else if (key == "balancer_max_tasks") is >> cfg.balancer_max_tasks;
     else if (key == "balancer_max_requesters") is >> cfg.balancer_max_requesters;
+    else if (key == "restore_path") {
+      is >> std::ws;
+      std::getline(is, cfg.restore_path);  // rest of line: paths may have spaces
+    }
     else if (!key.empty()) die("unknown config key '%s'", key.c_str());
   }
   if (rank < 0 || !w.is_server(rank)) die("bad or missing rank");

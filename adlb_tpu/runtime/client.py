@@ -634,16 +634,21 @@ class Client:
     def checkpoint(self, path_prefix: str) -> tuple[int, int]:
         """Snapshot the whole pool to ``<path_prefix>.<server>.ckpt`` shards
         (no reference analogue — upstream loses all queued work on exit).
-        Returns (rc, units captured). Units pinned mid-handoff are excluded;
+        Returns (rc, units captured). Units pinned mid-handoff are captured
+        too (a restore rolls the pool back to the snapshot, so work consumed
+        after it is re-executed — the standard crash-recovery contract);
         restore with ``Config(restore_path=path_prefix)`` on an identical
         world shape."""
-        if self.cfg.server_impl == "native":
-            raise AdlbError(
-                "checkpoint is not carried by the native server protocol yet"
-            )
+        # native servers take the path over the binary codec (bytes);
+        # Python servers take the str through the pickled frame — both
+        # write the same ACK1 shards, so either plane restores the other's
+        path = (
+            path_prefix.encode()
+            if self.cfg.server_impl == "native" else path_prefix
+        )
         with self._span("adlb:checkpoint"):
             self.ep.send(
-                self.home, msg(Tag.FA_CHECKPOINT, self.rank, path=path_prefix)
+                self.home, msg(Tag.FA_CHECKPOINT, self.rank, path=path)
             )
             resp = self._wait(Tag.TA_CHECKPOINT_RESP)
         return resp.rc, resp.count

@@ -170,6 +170,14 @@ FIELDS: dict[str, tuple[int, int]] = {
     "ss_msgs": (69, _KIND_I64),
     "backlog": (70, _KIND_I64),
     "rss_kb": (71, _KIND_I64),
+    # checkpoint/resume toward native servers (FA_CHECKPOINT carries the
+    # shard path prefix as bytes; the SS ring token's per-rank counts ride
+    # parallel lists — the Python plane's pickled dict token never crosses
+    # this codec)
+    "path": (72, _KIND_BYTES),
+    "client": (73, _KIND_I64),
+    "started": (74, _KIND_I64),
+    "ck_counts": (76, _KIND_LIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -656,7 +656,9 @@ class Server:
                                      self.cq)
 
     def _on_fa_checkpoint(self, m: Msg) -> None:
-        fwd = msg(Tag.SS_CHECKPOINT, self.rank, path=m.path, client=m.src,
+        # native clients carry the path as bytes over the TLV codec
+        path = m.path.decode() if isinstance(m.path, bytes) else m.path
+        fwd = msg(Tag.SS_CHECKPOINT, self.rank, path=path, client=m.src,
                   started=False)
         if self.is_master:
             self._on_ss_checkpoint(fwd)

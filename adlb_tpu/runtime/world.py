@@ -198,11 +198,6 @@ class Config:
             raise ValueError("balancer_max_requesters must be in 1..2048")
         if self.balancer_mesh not in ("off", "auto"):
             raise ValueError(f"unknown balancer_mesh {self.balancer_mesh!r}")
-        if self.restore_path and self.server_impl == "native":
-            raise ValueError(
-                "checkpoint restore is a Python-server feature; native "
-                "daemons do not load shards"
-            )
 
 
 def normalize_req_types(

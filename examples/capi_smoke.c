@@ -102,6 +102,14 @@ int main(void) {
     if (rc != ADLB_SUCCESS || rss <= 0.0) return 15;
     rc = ADLB_Info_get(ADLB_INFO_TRANSPORT_BACKLOG, &backlog);
     if (rc != ADLB_SUCCESS || backlog < 0.0) return 16;
+    /* pool checkpoint over the C API (framework extension): the pool is
+     * drained here, so the shards must report zero captured units */
+    const char *ckpt = getenv("ADLB_CKPT_PREFIX");
+    if (ckpt != NULL) {
+      int captured = -1;
+      rc = ADLB_Checkpoint(ckpt, &captured);
+      if (rc != ADLB_SUCCESS || captured != 0) return 17;
+    }
     ADLB_Set_problem_done();
   }
   printf("smoke rank %d: processed=%d acks=%d OK\n", me, processed, acks_seen);
