@@ -968,9 +968,10 @@ class Server {
       case T_SS_MIGRATE_WORK: on_migrate_work(m); break;
       case T_SS_MIGRATE_ACK:
         migrate_unacked_ -= 1;
-        if (migrate_unacked_ == 0 && has_held_ckpt_) {
-          has_held_ckpt_ = false;
-          process_checkpoint(held_ckpt_);
+        if (migrate_unacked_ == 0 && !held_ckpts_.empty()) {
+          std::vector<NMsg> held;
+          held.swap(held_ckpts_);
+          for (const NMsg& h : held) process_checkpoint(h);
         }
         break;
       default: die("no handler for tag %u", m.tag);
@@ -1269,6 +1270,13 @@ class Server {
       int64_t prio = rd_i64(), cserver = rd_i64(), cseqno = rd_i64();
       uint32_t clen = rd_u32(), plen = rd_u32();
       need(plen);
+      // the shard stores 64-bit priorities (the Python plane accepts
+      // arbitrary ints); silently truncating would invert the dispatch
+      // order of exactly the units marked most/least urgent
+      if (prio > INT32_MAX || prio < INT32_MIN)
+        die("shard %s: unit priority %lld does not fit this plane's "
+            "int32 priorities; restore under Python servers",
+            path.c_str(), (long long)prio);
       int64_t seqno = next_seqno_++;
       adlbwq::Unit u{seqno, wt, int32_t(prio), tgt, -1, int64_t(plen)};
       wq_.units.emplace(seqno, u);
@@ -1321,10 +1329,11 @@ class Server {
   void on_ss_checkpoint(const NMsg& m) {
     // units inside an unacked SS_MIGRATE_WORK live in no wq anywhere;
     // holding the token until the ack lands keeps them out of the
-    // lost-update window (runtime/server.py does the same)
+    // lost-update window (runtime/server.py does the same). A queue, not
+    // a slot: concurrent checkpoints from different clients must all
+    // complete (each client blocks on its own TA_CHECKPOINT_RESP)
     if (migrate_unacked_ != 0) {
-      held_ckpt_ = m;
-      has_held_ckpt_ = true;
+      held_ckpts_.push_back(m);
       return;
     }
     process_checkpoint(m);
@@ -2612,8 +2621,7 @@ class Server {
   std::unordered_map<int64_t, int64_t> push_offered_;   // qid -> seqno
   std::unordered_map<int64_t, int64_t> push_reserved_;  // qid -> bytes
   int64_t migrate_unacked_ = 0;
-  NMsg held_ckpt_;  // checkpoint token parked on in-flight migrations
-  bool has_held_ckpt_ = false;
+  std::vector<NMsg> held_ckpts_;  // tokens parked on in-flight migrations
   double last_event_snap_ = 0.0;
   bool hungry_ = false;  // sidecar says: parked requesters exist somewhere
   bool hungry_any_ = false;  // ... and one of them accepts any type

@@ -671,7 +671,11 @@ class Server:
         # window (they are then in the destination's wq, and the
         # destination is later in the ring or re-sends bounces likewise)
         if self._migrate_unacked != 0:
-            self._held_checkpoint = m
+            # a queue, not a slot: concurrent checkpoints from different
+            # clients must all complete (each blocks on its own resp)
+            if not hasattr(self, "_held_checkpoints"):
+                self._held_checkpoints = []
+            self._held_checkpoints.append(m)
             return
         self._process_checkpoint(m)
 
@@ -1639,10 +1643,11 @@ class Server:
 
     def _on_migrate_ack(self, m: Msg) -> None:
         self._migrate_unacked -= 1
-        held = getattr(self, "_held_checkpoint", None)
-        if held is not None and self._migrate_unacked == 0:
-            self._held_checkpoint = None
-            self._process_checkpoint(held)
+        held = getattr(self, "_held_checkpoints", None)
+        if held and self._migrate_unacked == 0:
+            self._held_checkpoints = []
+            for h in held:
+                self._process_checkpoint(h)
 
     # ------------------------------------------------------- termination
 

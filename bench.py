@@ -152,6 +152,38 @@ def main() -> None:
         # no C toolchain (or daemon spawn failure): report, don't die
         native_rows = {"native_error": repr(e)}
 
+    # trickle on the all-native plane: the dispatch-latency story without
+    # any GIL coupling (C clients + C++ daemons; the in-proc probe's twin)
+    from adlb_tpu.workloads import trickle_native
+
+    def nat_tric_one(mode):
+        if mode == "steal":
+            c = Config(balancer="steal", qmstat_mode="ring",
+                       qmstat_interval=0.1)
+        else:
+            c = Config(balancer="tpu", balancer_max_tasks=512,
+                       balancer_max_requesters=64)
+        return trickle_native.run(
+            n_tasks=240, num_app_ranks=8, nservers=4, cfg=c, timeout=120.0,
+        )
+
+    try:
+        nt_runs = interleaved(nat_tric_one)
+        nt_steal = median_by(nt_runs["steal"],
+                             key=lambda r: r.dispatch_p50_ms)
+        nt_tpu = median_by(nt_runs["tpu"], key=lambda r: r.dispatch_p50_ms)
+        native_rows.update({
+            "native_trickle_p50_ms_steal": round(nt_steal.dispatch_p50_ms, 2),
+            "native_trickle_p50_ms_tpu": round(nt_tpu.dispatch_p50_ms, 2),
+            "native_trickle_p90_ms_steal": round(nt_steal.dispatch_p90_ms, 2),
+            "native_trickle_p90_ms_tpu": round(nt_tpu.dispatch_p90_ms, 2),
+            "native_dispatch_speedup": round(
+                nt_steal.dispatch_p50_ms / nt_tpu.dispatch_p50_ms, 2)
+            if nt_tpu.dispatch_p50_ms else 0.0,
+        })
+    except (RuntimeError, OSError, TimeoutError) as e:
+        native_rows.setdefault("native_error", repr(e))
+
     def nq_one(mode):
         r = nq.run(
             n=N, num_app_ranks=APPS, nservers=SERVERS,

@@ -1,0 +1,100 @@
+/* Trickle at native scale: steady work arrival at ONE server, consumers
+ * parked everywhere else — isolates cross-server dispatch (discovery)
+ * latency, the structural gap between gossip-guided pull stealing and the
+ * event-driven global solve. Native twin of the in-process probe
+ * (adlb_tpu/workloads/trickle.py); scenario lineage: the reference's
+ * steady-state skel.c shape (reference examples/skel.c:10-40).
+ *
+ * Rank 0 puts ADLB_TRICK_NTASKS tokens, ADLB_TRICK_GROUP per tick, one
+ * tick every ADLB_TRICK_INTERVAL_US; each payload is the producer's
+ * CLOCK_MONOTONIC put time (system-wide on Linux). Every consumer prints
+ *
+ *   TRICK n=<k> lat_ms=<l1> <l2> ...
+ *
+ * where each l is (delivery time - put time) in ms for one consumed
+ * token. Termination is by exhaustion.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <adlb/adlb.h>
+
+#define TOKEN 1
+/* parked-on by ranks that share the producer's home server, so they never
+ * consume locally — every measured delivery is a CROSS-server dispatch
+ * (same trick as the in-process probe, adlb_tpu/workloads/trickle.py) */
+#define NEVER 2
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+static int env_int(const char *k, int dflt) {
+  const char *v = getenv(k);
+  return v ? atoi(v) : dflt;
+}
+
+int main(void) {
+  int types[2] = {TOKEN, NEVER};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  int n_tasks = env_int("ADLB_TRICK_NTASKS", 200);
+  int interval_us = env_int("ADLB_TRICK_INTERVAL_US", 10000);
+  int group = env_int("ADLB_TRICK_GROUP", 2);
+  int work_us = env_int("ADLB_TRICK_WORK_US", 2000);
+  int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) {
+    fprintf(stderr, "trickle: init failed rc=%d\n", rc);
+    return 2;
+  }
+  int me = ADLB_World_rank();
+
+  if (me == 0) {
+    for (int i = 0; i < n_tasks; i++) {
+      double t = mono();
+      rc = ADLB_Put(&t, (int)sizeof t, -1, -1, TOKEN, 0);
+      if (rc != ADLB_SUCCESS) {
+        fprintf(stderr, "trickle: put %d failed rc=%d\n", i, rc);
+        return 3;
+      }
+      if (group > 0 && (i + 1) % group == 0)
+        usleep((useconds_t)interval_us);
+    }
+    printf("TRICK n=0 lat_ms=\n");
+    ADLB_Finalize();
+    return 0;
+  }
+
+  /* ranks co-homed with the producer park on NEVER: their home server is
+   * where the tokens land, and a local match there measures nothing */
+  int hot_home = 0 % nservers;
+  int req[2] = {(me % nservers) == hot_home ? NEVER : TOKEN,
+                ADLB_RESERVE_EOL};
+  int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+  double *lat = (double *)malloc((size_t)n_tasks * sizeof(double));
+  int done = 0;
+  for (;;) {
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / DONE_BY_EXHAUSTION */
+    double t_put = 0.0;
+    rc = ADLB_Get_reserved(&t_put, handle);
+    if (rc != ADLB_SUCCESS) break;
+    double now = mono();
+    if (done < n_tasks) lat[done] = (now - t_put) * 1e3;
+    done++;
+    usleep((useconds_t)work_us);
+  }
+  printf("TRICK n=%d lat_ms=", done);
+  for (int i = 0; i < done && i < n_tasks; i++)
+    printf("%s%.3f", i ? " " : "", lat[i]);
+  printf("\n");
+  free(lat);
+  ADLB_Finalize();
+  return 0;
+}

@@ -132,3 +132,25 @@ def test_world_runs_with_mesh_balancer():
                    exhaust_check_interval=0.2),
     )
     assert res.ok, res
+
+
+def test_more_servers_than_devices(mesh):
+    """16 servers on an 8-device mesh: the shard axis packs two servers
+    per device; the matched-requester contract vs the single-device greedy
+    must hold unchanged."""
+    rng = np.random.default_rng(7)
+    dist = DistributedAssignmentSolver(
+        types=(T1, T2), max_tasks_per_server=8, max_requesters=4, mesh=mesh,
+        servers_per_device=2, rounds=64,
+    )
+    single = AssignmentSolver(types=(T1, T2), max_tasks=8, max_requesters=4)
+    for trial in range(3):
+        snaps = _random_snapshots(rng, nservers=16, ntasks=6, nreqs=3)
+        p_dist = dist.solve(snaps, None)
+        p_single = single.solve(snaps, None)
+
+        def by_req(pairs):
+            return {(p[2], p[3]): (p[0], p[1]) for p in pairs}
+
+        assert set(by_req(p_dist)) == set(by_req(p_single)), f"trial {trial}"
+        assert len({(p[0], p[1]) for p in p_dist}) == len(p_dist)

@@ -420,3 +420,22 @@ def test_all_native_hotspot_harness():
     assert r.tasks == 120
     assert r.tasks_per_sec > 0
     assert 0.0 <= r.idle_pct <= 100.0
+
+
+def test_all_native_trickle_harness():
+    """The native trickle probe: timestamped C producer, cross-server-only
+    C consumers (co-homed ranks park on NEVER), dispatch percentiles from
+    the shared monotonic clock."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.workloads import trickle_native
+
+    r = trickle_native.run(
+        n_tasks=60, interval_us=5000, group=2, work_us=1000,
+        num_app_ranks=6, nservers=3,
+        cfg=Config(balancer="tpu", exhaust_check_interval=0.2),
+        timeout=120.0,
+    )
+    assert r.tasks == 60
+    assert r.dispatch_p50_ms > 0
+    assert r.dispatch_p90_ms >= r.dispatch_p50_ms
