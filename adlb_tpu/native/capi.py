@@ -72,6 +72,51 @@ def build_example(src: str, out: Optional[str] = None) -> str:
     return out
 
 
+def run_native_probe(
+    example: str,
+    types,
+    env_extra: dict,
+    num_app_ranks: int,
+    nservers: int,
+    cfg=None,
+    timeout: float = 300.0,
+):
+    """Shared bootstrap for the native benchmark probes
+    (workloads/hotspot_native.py, workloads/trickle_native.py): force
+    native servers, build ``examples/<example>``, run one C client per app
+    rank, and raise on any nonzero client exit. Returns the per-rank
+    (rc, stdout, stderr) list."""
+    import dataclasses
+
+    from adlb_tpu.runtime.world import Config
+
+    base = cfg or Config()
+    cfg = dataclasses.replace(
+        base,
+        server_impl="native",
+        exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
+    )
+    examples = os.path.join(os.path.dirname(os.path.dirname(_DIR)),
+                            "examples")
+    exe = build_example(os.path.join(examples, example))
+    results, _stats = run_native_world(
+        n_clients=num_app_ranks,
+        nservers=nservers,
+        types=list(types),
+        exe=exe,
+        cfg=cfg,
+        env_extra=env_extra,
+        timeout=timeout,
+    )
+    for rank, (rc, out, err) in enumerate(results):
+        if rc != 0:
+            raise RuntimeError(
+                f"{example} rank {rank} exited {rc}\n"
+                f"stdout:{out}\nstderr:{err}"
+            )
+    return results
+
+
 def run_native_world(
     n_clients: int,
     nservers: int,

@@ -29,6 +29,11 @@ benchmark drivers:
 * :mod:`~adlb_tpu.workloads.trickle` — steady single-server work arrival
   with remote-only consumers, isolating dispatch/discovery latency (no
   reference analogue; the steal-to-exec-latency probe of BASELINE.md)
+* :mod:`~adlb_tpu.workloads.hotspot_native` /
+  :mod:`~adlb_tpu.workloads.trickle_native` — the two probes above on the
+  all-native plane (C clients ``examples/hotspot_c.c`` /
+  ``examples/trickle_c.c``, C++ daemons, JAX sidecar), for scale and
+  latency numbers free of interpreter coupling
 * :mod:`~adlb_tpu.workloads.pmcmc` — embarrassingly-parallel MCMC hard-disk
   demo with targeted solution returns (reference ``examples/pmcmc.c``)
 

@@ -12,8 +12,6 @@ skel.c synthetic stress, reference ``examples/skel.c:10-40``).
 
 from __future__ import annotations
 
-import dataclasses
-import os
 from typing import Optional
 
 from adlb_tpu.runtime.world import Config
@@ -28,40 +26,23 @@ def run(
     cfg: Optional[Config] = None,
     timeout: float = 300.0,
 ) -> HotspotResult:
-    from adlb_tpu.native.capi import build_example, run_native_world
+    from adlb_tpu.native.capi import run_native_probe
 
-    base = cfg or Config()
-    cfg = dataclasses.replace(
-        base,
-        server_impl="native",
-        exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
-    )
-    examples = os.path.join(
-        os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        ),
-        "examples",
-    )
-    exe = build_example(os.path.join(examples, "hotspot_c.c"))
-    results, _stats = run_native_world(
-        n_clients=num_app_ranks,
-        nservers=nservers,
+    results = run_native_probe(
+        "hotspot_c.c",
         types=[1],
-        exe=exe,
-        cfg=cfg,
         env_extra={
             "ADLB_PUT_ROUTING": "home",
             "ADLB_HOT_NTASKS": str(n_tasks),
             "ADLB_HOT_WORK_US": str(work_us),
         },
+        num_app_ranks=num_app_ranks,
+        nservers=nservers,
+        cfg=cfg,
         timeout=timeout,
     )
     rows = []
-    for rank, (rc, out, err) in enumerate(results):
-        if rc != 0:
-            raise RuntimeError(
-                f"hotspot_c rank {rank} exited {rc}\nstdout:{out}\nstderr:{err}"
-            )
+    for _rc, out, _err in results:
         line = next(ln for ln in out.splitlines() if ln.startswith("HOT "))
         kv = dict(f.split("=") for f in line.split()[1:])
         rows.append(

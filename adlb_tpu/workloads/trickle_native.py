@@ -12,8 +12,6 @@ skel shape, reference ``examples/skel.c:10-40``).
 
 from __future__ import annotations
 
-import dataclasses
-import os
 from typing import Optional
 
 from adlb_tpu.runtime.world import Config
@@ -30,27 +28,11 @@ def run(
     cfg: Optional[Config] = None,
     timeout: float = 300.0,
 ) -> TrickleResult:
-    from adlb_tpu.native.capi import build_example, run_native_world
+    from adlb_tpu.native.capi import run_native_probe
 
-    base = cfg or Config()
-    cfg = dataclasses.replace(
-        base,
-        server_impl="native",
-        exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
-    )
-    examples = os.path.join(
-        os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        ),
-        "examples",
-    )
-    exe = build_example(os.path.join(examples, "trickle_c.c"))
-    results, _stats = run_native_world(
-        n_clients=num_app_ranks,
-        nservers=nservers,
+    results = run_native_probe(
+        "trickle_c.c",
         types=[1, 2],  # TOKEN + the co-homed ranks' NEVER parking type
-        exe=exe,
-        cfg=cfg,
         env_extra={
             # home routing concentrates the producer's puts on one server,
             # so every delivery to the (remote) consumers is a cross-server
@@ -61,16 +43,14 @@ def run(
             "ADLB_TRICK_GROUP": str(group),
             "ADLB_TRICK_WORK_US": str(work_us),
         },
+        num_app_ranks=num_app_ranks,
+        nservers=nservers,
+        cfg=cfg,
         timeout=timeout,
     )
     lats: list = []
     tasks = 0
-    for rank, (rc, out, err) in enumerate(results):
-        if rc != 0:
-            raise RuntimeError(
-                f"trickle_c rank {rank} exited {rc}\n"
-                f"stdout:{out}\nstderr:{err}"
-            )
+    for _rc, out, _err in results:
         line = next(ln for ln in out.splitlines() if ln.startswith("TRICK "))
         n = int(line.split("n=")[1].split()[0])
         tasks += n

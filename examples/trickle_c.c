@@ -42,7 +42,8 @@ static int env_int(const char *k, int dflt) {
 int main(void) {
   int types[2] = {TOKEN, NEVER};
   int am_server = -1, am_debug = -1, num_apps = 0;
-  int nservers = atoi(getenv("ADLB_NUM_SERVERS"));
+  const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
   int n_tasks = env_int("ADLB_TRICK_NTASKS", 200);
   int interval_us = env_int("ADLB_TRICK_INTERVAL_US", 10000);
   int group = env_int("ADLB_TRICK_GROUP", 2);

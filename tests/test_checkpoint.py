@@ -171,3 +171,25 @@ def test_checkpoint_missing_shard_is_loud(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_shard(str(tmp_path / "nothing"), 3)
+
+
+def test_concurrent_held_checkpoints_all_complete():
+    """Two checkpoint tokens arriving while migrations are unacked must
+    BOTH be processed after the last ack — a single held slot would
+    overwrite the first and leave its client blocked forever."""
+    from adlb_tpu.runtime.messages import Tag, msg
+    from adlb_tpu.runtime.server import Server
+
+    s = Server.__new__(Server)
+    s._migrate_unacked = 2
+    processed = []
+    s._process_checkpoint = lambda m: processed.append(m.path)
+    s._on_ss_checkpoint(msg(Tag.SS_CHECKPOINT, 0, path="a", client=1,
+                            started=False))
+    s._on_ss_checkpoint(msg(Tag.SS_CHECKPOINT, 0, path="b", client=2,
+                            started=False))
+    assert processed == []
+    s._on_migrate_ack(msg(Tag.SS_MIGRATE_ACK, 5))
+    assert processed == []  # one batch still in flight
+    s._on_migrate_ack(msg(Tag.SS_MIGRATE_ACK, 5))
+    assert processed == ["a", "b"]
