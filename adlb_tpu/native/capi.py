@@ -96,9 +96,7 @@ def run_native_probe(
         server_impl="native",
         exhaust_check_interval=min(base.exhaust_check_interval, 0.2),
     )
-    examples = os.path.join(os.path.dirname(os.path.dirname(_DIR)),
-                            "examples")
-    exe = build_example(os.path.join(examples, example))
+    exe = build_example(os.path.join(_REPO, "examples", example))
     results, _stats = run_native_world(
         n_clients=num_app_ranks,
         nservers=nservers,

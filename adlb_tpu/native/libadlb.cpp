@@ -577,6 +577,14 @@ int ADLBP_Init(int num_servers, int use_debug_server, int aprintf_flag,
                int ntypes, int type_vect[], int *am_server,
                int *am_debug_server, int *num_app_ranks) {
   if (g) return ADLB_ERROR;
+  if (num_servers <= 0) {
+    // without this, home_server()'s rank % num_servers dies with an
+    // unexplained SIGFPE (the reference asserts the same way,
+    // src/adlb.c:238)
+    fprintf(stderr, "adlb: num_servers must be positive (got %d)\n",
+            num_servers);
+    return ADLB_ERROR;
+  }
   const char *rv = getenv("ADLB_RENDEZVOUS");
   const char *rk = getenv("ADLB_RANK");
   if (!rv || !rk) {

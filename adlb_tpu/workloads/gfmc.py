@@ -33,6 +33,7 @@ class GfmcResult:
     expected: dict[str, int]
     elapsed: float
     tasks_per_sec: float
+    tasks_processed: int = 0
 
 
 def run(
@@ -144,4 +145,5 @@ def run(
         expected=expected,
         elapsed=elapsed,
         tasks_per_sec=total_tasks / elapsed if elapsed > 0 else 0.0,
+        tasks_processed=total_tasks,
     )

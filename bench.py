@@ -214,11 +214,17 @@ def main() -> None:
         r = tsp.run(n_cities=TSP_N, num_app_ranks=APPS, nservers=SERVERS,
                     seed=3, cfg=cfg(mode), timeout=600.0)
         assert r.best == tsp_want, f"tsp {mode}: {r.best} != {tsp_want}"
-        return r.tasks_per_sec
+        return (r.tasks_processed, r.elapsed)
+
+    def pooled(rows):
+        """Aggregate rate over reps (total tasks / total time): B&B node
+        counts swing per run with search luck in BOTH modes, and pooling
+        averages over far more samples than a median of per-rep rates."""
+        return sum(t for t, _ in rows) / sum(s for _, s in rows)
 
     tsp_runs = interleaved(tsp_one, reps=5)
-    tsp_steal = median_by(tsp_runs["steal"])
-    tsp_tpu = median_by(tsp_runs["tpu"])
+    tsp_steal = pooled(tsp_runs["steal"])
+    tsp_tpu = pooled(tsp_runs["tpu"])
 
     # sudoku + gfmc (the self-checking GFMC mini-app economy, reference
     # examples/c4.c): the remaining reference-named workloads, mode vs mode
@@ -240,25 +246,21 @@ def main() -> None:
         return (r.tasks_processed, r.elapsed)
 
     # first-solution search luck swings node counts per run, so the rate
-    # is aggregated over reps (total tasks / total time), not best-of
+    # is pooled over reps (total tasks / total time), not best-of
     sudoku_runs = interleaved(sudoku_one)
-
-    def agg(rows):
-        return sum(t for t, _ in rows) / sum(s for _, s in rows)
-
-    sudoku_steal = agg(sudoku_runs["steal"])
-    sudoku_tpu = agg(sudoku_runs["tpu"])
+    sudoku_steal = pooled(sudoku_runs["steal"])
+    sudoku_tpu = pooled(sudoku_runs["tpu"])
 
     def gfmc_one(mode):
         r = gfmc.run(num_a=400, bs_per_a=8, cs_per_b=5,
                      num_app_ranks=APPS, nservers=SERVERS,
                      cfg=cfg(mode), timeout=600.0)
         assert r.ok, f"gfmc {mode}: wrong counts {r.counts}"
-        return r.tasks_per_sec
+        return (r.tasks_processed, r.elapsed)
 
     gfmc_runs = interleaved(gfmc_one, reps=5)
-    gfmc_steal = median_by(gfmc_runs["steal"])
-    gfmc_tpu = median_by(gfmc_runs["tpu"])
+    gfmc_steal = pooled(gfmc_runs["steal"])
+    gfmc_tpu = pooled(gfmc_runs["tpu"])
 
     # hotspot: all work enters one server, consumers everywhere — the
     # balancing scenario ADLB exists for; makespan-based, GIL-free work.

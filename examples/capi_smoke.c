@@ -24,7 +24,7 @@ int main(void) {
   int types[2] = {WORK, ACK};
   int am_server = -1, am_debug = -1, num_apps = 0;
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
-  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
   int use_dbg = getenv("ADLB_USE_DEBUG_SERVER") ? 1 : 0;
   int rc = ADLB_Init(nservers, use_dbg, 0, 2, types, &am_server, &am_debug,
                      &num_apps);

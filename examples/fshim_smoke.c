@@ -39,7 +39,7 @@ extern void adlb_world_size_(int *);
 int main(void) {
   int types[2] = {TYPE_A, TYPE_ANS};
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
-  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
   int use_dbg = 0, aflag = 0, ntypes = 2;
   int am_server = -1, am_debug = -1, num_apps = 0, ierr = -42;
 

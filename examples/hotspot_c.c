@@ -35,7 +35,7 @@ int main(void) {
   int types[1] = {TOKEN};
   int am_server = -1, am_debug = -1, num_apps = 0;
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
-  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
   int n_tasks = getenv("ADLB_HOT_NTASKS") ? atoi(getenv("ADLB_HOT_NTASKS")) : 200;
   int work_us = getenv("ADLB_HOT_WORK_US") ? atoi(getenv("ADLB_HOT_WORK_US")) : 2000;
   int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,

@@ -44,7 +44,7 @@ int main(void) {
   int types[2] = {WORK, TALLY};
   int am_server, am_debug, num_apps;
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
-  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
   int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
                      &num_apps);
   if (rc != ADLB_SUCCESS) return 2;

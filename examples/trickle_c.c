@@ -43,7 +43,7 @@ int main(void) {
   int types[2] = {TOKEN, NEVER};
   int am_server = -1, am_debug = -1, num_apps = 0;
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
-  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* 0 -> loud init error */
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
   int n_tasks = env_int("ADLB_TRICK_NTASKS", 200);
   int interval_us = env_int("ADLB_TRICK_INTERVAL_US", 10000);
   int group = env_int("ADLB_TRICK_GROUP", 2);
