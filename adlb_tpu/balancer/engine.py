@@ -130,6 +130,8 @@ class PlanEngine:
             self.INFLOW_MIN_AGE = inflow_min_age
         if self.INFLOW_MIN_AGE > self.INFLOW_TTL:
             raise ValueError("inflow_min_age must be <= inflow_ttl")
+        if self.LOOK_MAX < max(1, self.LOOKAHEAD):
+            raise ValueError("look_max must be >= max(1, lookahead)")
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
         # rank -> plan stamps of migration units en route there; until the
@@ -205,15 +207,20 @@ class PlanEngine:
         t_planned = time.monotonic()
         matches = []
         planned_away: dict[int, set] = {}
+        matched_reqs: set = set()
         for holder, seqno, req_home, for_rank, rqseqno in pairs:
             planned_away.setdefault(holder, set()).add(seqno)
+            # local pairs are dropped (the data plane matches them), but
+            # their unit already sits in planned_away — the requester is
+            # spoken for either way, so withholding must skip it too
+            matched_reqs.add((req_home, for_rank, rqseqno))
             if holder == req_home:
                 continue
             self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
             self._planned_tasks[(holder, seqno)] = t_planned
             matches.append((holder, seqno, req_home, for_rank, rqseqno))
         migrations = self._plan_migrations(
-            snapshots, filtered, planned_away, t_planned
+            snapshots, filtered, planned_away, t_planned, matched_reqs
         )
         if matches or migrations:
             involved = (
@@ -347,7 +354,8 @@ class PlanEngine:
         )
 
     def _plan_migrations(
-        self, snaps: dict, filtered: dict, planned_away: dict, t_planned: float
+        self, snaps: dict, filtered: dict, planned_away: dict,
+        t_planned: float, matched_reqs: Optional[set] = None,
     ):
         """Fair-share inventory placement (see module docstring)."""
         inv: dict[int, list] = {}
@@ -363,8 +371,14 @@ class PlanEngine:
                 # cross-server traffic, and when the solve was gated off
                 # (supply local-only) nothing else protects them from
                 # being migrated out from under their local demander.
+                # Requesters the solve just matched cross-server are
+                # skipped — they are already consumed by the match, and
+                # withholding a second unit for them double-reserves
+                # supply against migration sources.
                 withheld: set = set()
                 for req in f["reqs"]:
+                    if matched_reqs and (rank, req[0], req[1]) in matched_reqs:
+                        continue
                     types = req[2]
                     for t in avail:
                         if t[0] not in withheld and (

@@ -28,12 +28,13 @@
 // balancer="tpu" worlds use the Python server.
 
 #include <arpa/inet.h>
-#include <glob.h>
+#include <dirent.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cmath>
 #include <condition_variable>
 #include <cstdarg>
@@ -1167,8 +1168,16 @@ class Server {
     auto i64 = [](std::string& out, int64_t v) {
       out.append((const char*)&v, 8);
     };
-    for (const auto& kv : wq_.units) {
-      const adlbwq::Unit& u = kv.second;
+    // serialize in seqno order: restore assigns fresh seqnos in shard order,
+    // so hash-map order would scramble FIFO-among-equal-priority dispatch
+    // (the "FIFO by seqno among equals" contract in wqcore.hpp) that the
+    // Python plane's insertion-ordered dict preserves
+    std::vector<int64_t> seqnos;
+    seqnos.reserve(wq_.units.size());
+    for (const auto& kv : wq_.units) seqnos.push_back(kv.first);
+    std::sort(seqnos.begin(), seqnos.end());
+    for (int64_t sq : seqnos) {
+      const adlbwq::Unit& u = wq_.units.at(sq);
       const Meta& meta = meta_.at(u.seqno);
       i32(body, u.work_type);
       i32(body, u.target_rank);
@@ -1209,22 +1218,38 @@ class Server {
     // ranks outside this world mean the checkpoint came from a different
     // world shape — silently loading only our own shard would lose every
     // unit the extra shards hold
-    glob_t g;
-    std::string pat = prefix + ".*.ckpt";
-    if (glob(pat.c_str(), 0, nullptr, &g) == 0) {
-      for (size_t i = 0; i < g.gl_pathc; ++i) {
-        const char* p = g.gl_pathv[i];
-        const char* tail = p + prefix.size() + 1;  // past "<prefix>."
-        char* end = nullptr;
-        long r = std::strtol(tail, &end, 10);
-        if (end == tail || std::strcmp(end, ".ckpt") != 0) continue;
+    // plain directory scan + prefix/suffix comparison rather than glob():
+    // a restore_path containing glob metacharacters (*, ?, [) would make
+    // the pattern match nothing (silently skipping this check) or match
+    // unrelated files — the Python plane avoids the same trap with
+    // re.escape in existing_shard_ranks
+    std::string dir = ".", base = prefix;
+    size_t slash = prefix.find_last_of('/');
+    if (slash != std::string::npos) {
+      dir = prefix.substr(0, slash);
+      base = prefix.substr(slash + 1);
+    }
+    if (DIR* d = opendir(dir.c_str())) {
+      while (struct dirent* ent = readdir(d)) {
+        std::string name = ent->d_name;
+        if (name.size() <= base.size() + 6) continue;  // ".<r>.ckpt" min 7
+        if (name.compare(0, base.size(), base) != 0 ||
+            name[base.size()] != '.')
+          continue;
+        if (name.compare(name.size() - 5, 5, ".ckpt") != 0) continue;
+        std::string mid = name.substr(base.size() + 1,
+                                      name.size() - base.size() - 6);
+        if (mid.empty() ||
+            mid.find_first_not_of("0123456789") != std::string::npos)
+          continue;
+        long r = std::strtol(mid.c_str(), nullptr, 10);
         if (!w_.is_server(int(r)))
           die("checkpoint %s has a shard for rank %ld outside this world's "
               "servers; restore with the same world shape", prefix.c_str(),
               r);
       }
+      closedir(d);
     }
-    globfree(&g);
     std::string path = prefix + "." + std::to_string(rank_) + ".ckpt";
     FILE* f = std::fopen(path.c_str(), "rb");
     if (f == nullptr)

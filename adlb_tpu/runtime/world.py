@@ -184,6 +184,18 @@ class Config:
         # (TTL expiry would silently override the min-age guarantee);
         # literals = the engine defaults (balancer/engine.py INFLOW_TTL /
         # INFLOW_MIN_AGE), not imported here to keep Config import-light
+        # look_max below the lookahead floor would let _touch_window decay
+        # a destination's window under its own floor — with look_max=0 the
+        # window (and thus need) pins to 0 and migrations to that
+        # destination are silently disabled forever
+        look = 8 if self.balancer_lookahead is None \
+            else self.balancer_lookahead
+        lmax = 512 if self.balancer_look_max is None \
+            else self.balancer_look_max
+        if lmax < max(1, look):
+            raise ValueError(
+                "balancer_look_max must be >= max(1, balancer_lookahead)"
+            )
         ttl = 2.0 if self.balancer_inflow_ttl is None \
             else self.balancer_inflow_ttl
         age = 0.05 if self.balancer_inflow_min_age is None \

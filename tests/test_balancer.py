@@ -343,3 +343,64 @@ def test_pump_knobs_config_wiring():
     assert PlanEngine.LOOKAHEAD == 8
     with pytest.raises(ValueError):
         Config(balancer_lookahead=-1)
+    # look_max below the lookahead floor would let window decay pin a
+    # destination's need to 0, silently disabling migrations to it
+    with pytest.raises(ValueError):
+        Config(balancer_look_max=0)
+    with pytest.raises(ValueError):
+        Config(balancer_lookahead=16, balancer_look_max=4)
+    with pytest.raises(ValueError):
+        PlanEngine(types=(T1,), max_tasks=16, max_requesters=4,
+                   lookahead=16, look_max=4)
+
+
+def test_matched_requester_not_double_withheld():
+    """A requester the solve matched cross-server this round is consumed
+    by the match; withholding a second local unit for it would
+    double-reserve supply and starve migration sources."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    t0 = _time.monotonic()
+    snaps = {
+        10: {"tasks": [(1, T1, 1, 8), (2, T1, 1, 8)],
+             "reqs": [(5, 1, [T1])], "consumers": 0, "stamp": t0,
+             "task_stamp": t0},
+        11: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t0,
+             "task_stamp": t0},
+    }
+    filtered = {
+        r: {"tasks": s["tasks"], "reqs": s["reqs"]} for r, s in snaps.items()
+    }
+    # requester (10, 5, 1) was matched cross-server this round: both units
+    # stay eligible for the starved dest
+    eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
+    migs = eng._plan_migrations(snaps, filtered, {}, t0,
+                                matched_reqs={(10, 5, 1)})
+    moved = {q for _, _, qs in migs for q in qs}
+    assert moved == {1, 2}, migs
+    # unmatched, the requester still protects one locally-matchable unit
+    eng2 = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
+    migs2 = eng2._plan_migrations(snaps, filtered, {}, t0)
+    moved2 = {q for _, _, qs in migs2 for q in qs}
+    assert len(moved2) == 1, migs2
+    # LOCAL pairs (dropped from matches, unit in planned_away) consume
+    # their requester too: withholding a second unit for it would starve
+    # the migration path end-to-end through round()
+    eng3 = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
+    snaps3 = {
+        10: {"tasks": [(1, T1, 5, 8), (2, T1, 4, 8), (3, T1, 3, 8)],
+             "reqs": [(9, 7, [T1])], "consumers": 0, "stamp": t0,
+             "task_stamp": t0},
+        11: {"tasks": [], "reqs": [(5, 1, [T1])], "consumers": 0,
+             "stamp": t0, "task_stamp": t0},
+        12: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t0,
+             "task_stamp": t0},
+    }
+    matches3, migs3 = eng3.round(snaps3, None)
+    # one local pair (dropped) + one cross match leave exactly one unit;
+    # it must reach the starved consumer on 12, not be double-withheld
+    assert len(matches3) == 1 and matches3[0][2] == 11, matches3
+    moved3 = {q for _, _, qs in migs3 for q in qs}
+    assert moved3, (matches3, migs3)

@@ -147,6 +147,46 @@ def test_c_client_checkpoint_call(tmp_path):
     assert existing_shard_ranks(prefix) == [3, 4]
 
 
+def test_native_ckpt_preserves_fifo_among_equal_prio(tmp_path):
+    """Restore assigns fresh seqnos in shard order, so the shard must be
+    written seqno-sorted: a hash-ordered dump would scramble FIFO dispatch
+    among equal-priority units (the wqcore.hpp 'FIFO by seqno among
+    equals' contract), which the Python plane's insertion-ordered dict
+    preserves."""
+    prefix = str(tmp_path / "pool")
+    n = 12
+
+    def writer(ctx):
+        for i in range(n):
+            assert ctx.put(struct.pack("<q", i), T,
+                           work_prio=7) == ADLB_SUCCESS
+        rc, count = ctx.checkpoint(prefix)
+        assert rc == ADLB_SUCCESS
+        return count
+
+    res = spawn_world(
+        1, 1, [T], writer, cfg=Config(server_impl="native"), timeout=60.0,
+    )
+    assert res.app_results[0] == n
+
+    def consumer(ctx):
+        got = []
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return got
+            _, buf = ctx.get_reserved(r.handle)
+            got.append(struct.unpack("<q", buf)[0])
+
+    res2 = spawn_world(
+        1, 1, [T], consumer,
+        cfg=Config(server_impl="native", restore_path=prefix,
+                   exhaust_check_interval=0.15),
+        timeout=60.0,
+    )
+    assert res2.app_results[0] == list(range(n))
+
+
 def test_native_restore_rejects_stray_shards(tmp_path):
     """A shard for a server rank outside the restore world means a
     different world shape: the daemon must die loudly, not silently drop
