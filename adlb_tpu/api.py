@@ -69,6 +69,15 @@ class AdlbContext:
         and prefix-free (no reference analogue)."""
         return self._c.get_work(req_types)
 
+    def get_work_batch(
+        self,
+        req_types: Optional[Sequence[int]] = None,
+        max_units: int = 8,
+    ):
+        """Fused reserve+get of up to max_units LOCAL prefix-free units in
+        one round trip (no reference analogue); returns (rc, [GotWork])."""
+        return self._c.get_work_batch(req_types, max_units)
+
     def get_reserved_timed(self, handle: WorkHandle):
         return self._c.get_reserved_timed(handle)
 

@@ -14,9 +14,12 @@ A round takes the latest per-server snapshots
 
 * ``matches`` — ``(holder, seqno, req_home, for_rank, rqseqno)`` tuples:
   cross-server task->requester assignments from the batched solve;
-* ``migrations`` — ``(src, dest, [seqnos])``: fair-share inventory moves so
-  each server holds its consumer-weighted share of the global pool (the
-  global solve's structural advantage over per-unit stealing round trips).
+* ``migrations`` — ``(src, dest, [seqnos], mig_id)``: fair-share inventory
+  moves so each server holds its consumer-weighted share of the global
+  pool (the global solve's structural advantage over per-unit stealing
+  round trips). ``mig_id`` is the planner's batch id; the transport must
+  deliver it with the batch so the destination can acknowledge it in
+  later snapshots (``mig_acks``).
 
 Re-planning storms are suppressed by remembering when each requester/task
 was last planned: both stay ineligible until a *fresh* snapshot (stamp
@@ -134,11 +137,18 @@ class PlanEngine:
             raise ValueError("look_max must be >= max(1, lookahead)")
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
-        # rank -> plan stamps of migration units en route there; until the
-        # destination ships a FRESH task snapshot (task_stamp past the plan
-        # time) those units are invisible in its inventory, and without
-        # crediting them the planner chains phantom top-ups to a
-        # destination that is already being fed
+        # rank -> [(plan time, nunits, mig_id, src)] for migration batches
+        # en route there; until those units land they are invisible in the
+        # destination's inventory, and without crediting them the planner
+        # chains phantom top-ups to a destination that is already being
+        # fed. Clearing is EXACT when snapshots carry "mig_acks" (src ->
+        # highest batch id received from that source): a credit whose id
+        # is acked is visible in that snapshot's inventory, an unacked
+        # one is still in flight — no transit-time heuristics needed.
+        # Snapshots without the field (older planes) fall back to the
+        # stamp/min-age window; the TTL backstop covers lost batches
+        # either way.
+        self._mig_next = 1  # batch-id counter (monotone per dest follows)
         self._planned_in: dict[int, list] = {}
         # rank -> adaptive per-consumer lookahead window and the time it
         # last triggered a top-up (see LOOKAHEAD)
@@ -226,8 +236,8 @@ class PlanEngine:
             involved = (
                 {h for h, *_ in matches}
                 | {m[2] for m in matches}  # req_home: the demand side
-                | {src for src, _, _ in migrations}
-                | {dest for _, dest, _ in migrations}  # deficit side
+                | {mv[0] for mv in migrations}
+                | {mv[1] for mv in migrations}  # deficit side
             )
             ages = [
                 t_planned - snapshots[r].get("stamp", t_planned)
@@ -252,7 +262,7 @@ class PlanEngine:
             self._planned_in = {
                 r: kept
                 for r, lst in self._planned_in.items()
-                if (kept := [ts for ts in lst if ts > horizon])
+                if (kept := [e for e in lst if e[0] > horizon])
             }
         return matches, migrations
 
@@ -394,17 +404,30 @@ class PlanEngine:
             # stamp-less snapshots (tstamp = now) retry every round rather
             # than credit forever, matching round()'s stamp fallback
             tstamp = snap.get("task_stamp", snap.get("stamp", t_planned))
+            # acks are PER SOURCE (src -> highest batch id received from
+            # that src): transport ordering holds per sender pair, but two
+            # sources feeding one destination can interleave, and a single
+            # max-id ack would clear a slower source's in-flight credit
+            # the moment a faster source's later batch lands
+            acks = snap.get("mig_acks")
             horizon = t_planned - self.INFLOW_TTL
             young = t_planned - self.INFLOW_MIN_AGE
-            live = [
-                ts for ts in self._planned_in.get(rank, ())
-                if (ts > tstamp or ts > young) and ts > horizon
-            ]
+            live = []
+            for e in self._planned_in.get(rank, ()):
+                ts, n_units, mid, src = e
+                if ts <= horizon:
+                    continue  # TTL backstop: the batch is lost
+                if acks is not None:
+                    if mid <= acks.get(src, 0):
+                        continue  # landed: counted in this snapshot's tasks
+                elif not (ts > tstamp or ts > young):
+                    continue  # legacy stamp/min-age clearing (no ack field)
+                live.append(e)
             if live:
                 self._planned_in[rank] = live
             else:
                 self._planned_in.pop(rank, None)
-            inflow[rank] = len(live)
+            inflow[rank] = sum(e[1] for e in live)
         total_consumers = sum(consumers.values())
         if total_consumers == 0:
             return []
@@ -428,16 +451,46 @@ class PlanEngine:
         # Hysteresis: only treat a server as deficient when it holds less
         # than HALF its demand-capped need (see LOOKAHEAD). Without the
         # band, servers hovering near the threshold trigger a constant
-        # shuffle of inventory moves for no placement benefit. Truly
-        # starved destinations (hotspot's empty servers) sit far below the
-        # band and still trigger immediately.
-        deficits = {
-            r: self._need(share(r), c, r) - len(inv[r]) - inflow.get(r, 0)
-            for r, c in consumers.items()
-            if c > 0
-            and 2 * (len(inv[r]) + inflow.get(r, 0))
-            < self._need(share(r), c, r)
-        }
+        # shuffle of inventory moves for no placement benefit.
+        #
+        # STARVED destinations (nothing on hand, nothing in flight, a
+        # requester actually parked there, AND supply CONCENTRATED on one
+        # server — the hotspot shape this balancer exists for) bypass
+        # both the band and the window cap: the cap exists to stop churn
+        # on servers NEAR their share, and an empty server with waiting
+        # workers facing a one-server backlog is not that. Ramping the
+        # adaptive window from its floor would trickle window-sized
+        # refills (a fraction of fair share) while whole worker pools sit
+        # idle a re-plan round trip at a time; one full-share batch is
+        # the same O(1) messages and seeds the window at the proven
+        # drain scale. The guards keep balanced economies on the capped
+        # path: transiently-empty servers whose workers are mid-compute
+        # (tsp's fluctuating B&B frontier) fail the parked-requester
+        # condition (RAW reqs, not the ledger-filtered view), and evenly
+        # spread pools (gfmc's round-robin inventory) fail the
+        # concentration test — full-share moves there are churn nobody
+        # is waiting for.
+        concentrated = (
+            2 * max((len(lst) for lst in inv.values()), default=0)
+            > total_avail
+        )
+        starved: set = set()
+        deficits: dict[int, int] = {}
+        for r, c in consumers.items():
+            if c <= 0:
+                continue
+            have = len(inv[r]) + inflow.get(r, 0)
+            sh = share(r)
+            if (
+                have == 0 and sh > 0 and concentrated
+                and snaps.get(r, {}).get("reqs")
+            ):
+                starved.add(r)
+                deficits[r] = sh
+            else:
+                need = self._need(sh, c, r)
+                if 2 * have < need:
+                    deficits[r] = need - have
         if not deficits:
             return []
         surpluses = {
@@ -469,18 +522,31 @@ class PlanEngine:
                     )
                     want -= len(take)
         out = []
-        fed: set = set()
+        got: dict[int, int] = {}
         for (src_rank, dest), seqnos in moves.items():
+            mid = self._mig_next
+            self._mig_next += 1
             for q in seqnos:
                 self._planned_tasks[(src_rank, q)] = t_planned
-            self._planned_in.setdefault(dest, []).extend(
-                [t_planned] * len(seqnos)
+            self._planned_in.setdefault(dest, []).append(
+                (t_planned, len(seqnos), mid, src_rank)
             )
-            fed.add(dest)
-            out.append((src_rank, dest, seqnos))
+            got[dest] = got.get(dest, 0) + len(seqnos)
+            out.append((src_rank, dest, seqnos, mid))
         # adapt windows only for destinations that were actually SHIPPED a
         # batch: a deficit no surplus could serve must not inflate the
         # window (it would silently disable the cap when supply returns)
-        for dest in fed:
-            self._touch_window(dest, t_planned)
+        for dest, n_got in got.items():
+            if dest in starved:
+                # seed the window at the shipped scale so follow-up
+                # top-ups continue at fair-share size instead of
+                # re-ramping from the floor
+                c = consumers.get(dest, 0) or 1
+                self._look[dest] = min(
+                    max(self._window(dest), n_got / c),
+                    float(self.LOOK_MAX),
+                )
+                self._look_last[dest] = t_planned
+            else:
+                self._touch_window(dest, t_planned)
         return out

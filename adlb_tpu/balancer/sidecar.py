@@ -80,6 +80,12 @@ def decode_snapshot(m) -> dict:
         "nbytes": m.data.get("nbytes", 0),
         "consumers": m.data.get("consumers", 0),
         "stamp": time.monotonic(),  # receiver clock: never mix hosts' clocks
+        # flattened (src, highest id) pairs; absent on pre-ack daemons ->
+        # engine falls back to stamp clearing
+        "mig_acks": (
+            {ma[i]: ma[i + 1] for i in range(0, len(ma), 2)}
+            if (ma := m.data.get("mig_acks")) is not None else None
+        ),
     }
 
 
@@ -174,10 +180,11 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                 msg(Tag.SS_PLAN_MATCH, me, seqno=seqno, for_rank=for_rank,
                     req_home=req_home, rqseqno=rqseqno),
             )
-        for src_rank, dest, seqnos in migrations:
+        for src_rank, dest, seqnos, mig_id in migrations:
             ep.send(
                 src_rank,
-                msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos),
+                msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos,
+                    mig_id=mig_id),
             )
         if cfg.balancer_min_gap > 0:
             time.sleep(cfg.balancer_min_gap)

@@ -227,6 +227,12 @@ enum FieldId : uint8_t {
   F_HUNGRY = 60,          // i64: balancer -> servers, parked reqs exist
   F_GREW = 61,            // i64: the hungry wanted-set grew
   F_PSTATS_BLOB = 57,     // bytes: packed periodic-stats ring token entries
+  // migration-batch ack: planner batch id on SS_PLAN_MIGRATE /
+  // SS_MIGRATE_WORK; highest id received PER SOURCE reported in
+  // snapshots (flattened (src, id) pairs) so the planner's in-flight
+  // credits clear exactly when the batch lands
+  F_MIG_ID = 77,          // i64
+  F_MIG_ACKS = 78,        // list
 };
 
 enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
@@ -1226,7 +1232,8 @@ class Server {
     std::string dir = ".", base = prefix;
     size_t slash = prefix.find_last_of('/');
     if (slash != std::string::npos) {
-      dir = prefix.substr(0, slash);
+      // a root-anchored prefix ("/pool") must scan "/", not ""
+      dir = slash == 0 ? "/" : prefix.substr(0, slash);
       base = prefix.substr(slash + 1);
     }
     if (DIR* d = opendir(dir.c_str())) {
@@ -2424,6 +2431,13 @@ class Server {
     m.setl(F_REQS_FLAT, reqs);
     m.seti(F_NBYTES, mem_curr_);
     m.seti(F_CONSUMERS, consumers);
+    std::vector<int64_t> acks;
+    acks.reserve(2 * mig_acks_.size());
+    for (const auto& kv : mig_acks_) {
+      acks.push_back(kv.first);
+      acks.push_back(kv.second);
+    }
+    m.setl(F_MIG_ACKS, std::move(acks));
     ep_->send(cfg_.balancer_rank, m);
   }
 
@@ -2503,10 +2517,19 @@ class Server {
     NMsg wk = mk(T_SS_MIGRATE_WORK);
     wk.setb(F_UNITS_BLOB, std::move(blob));
     wk.seti(F_BOUNCED, 0);
+    wk.seti(F_MIG_ID, m.geti(F_MIG_ID));
     ep_->send(int(m.geti(F_DEST)), wk);
   }
 
   void on_migrate_work(const NMsg& m) {
+    // ack the planner's batch id via the next snapshot, per source —
+    // transport ordering only holds per sender pair (bounced resends
+    // carry id 0: the original sighting already acked it)
+    int64_t mid = m.geti(F_MIG_ID);
+    if (mid > 0) {
+      int64_t& slot = mig_acks_[m.src];
+      slot = std::max(slot, mid);
+    }
     const std::string* blob = m.getb(F_UNITS_BLOB);
     if (blob == nullptr || blob->size() < 4) return;
     bool bounced = m.geti(F_BOUNCED) != 0;
@@ -2573,7 +2596,13 @@ class Server {
       wk.seti(F_BOUNCED, 1);
       ep_->send(m.src, wk);
     }
-    if (any_added) match_rq();
+    if (any_added) {
+      match_rq();
+      // immediate full snapshot: the batch ack and the post-batch
+      // inventory reach the planner now, not a heartbeat later — the
+      // follow-up top-up cadence rides on this
+      if (cfg_.tpu_mode) send_snapshot();
+    }
   }
 
   void on_peer_eof(const NMsg& m) {
@@ -2653,6 +2682,8 @@ class Server {
   std::set<int32_t> hungry_types_;  // the types parked requesters want
   double next_idle_snap_ = 0.0;  // slow snapshot heartbeat when not hungry
   bool last_snap_empty_ = false;
+  // src server -> highest planner migration-batch id received from it
+  std::map<int, int64_t> mig_acks_;
 
   bool no_more_work_ = false;
   bool done_by_exhaustion_ = false;

@@ -376,30 +376,87 @@ class Client:
             resp = self._wait(Tag.TA_RESERVE_RESP)
             if resp.rc != ADLB_SUCCESS:
                 return resp.rc, None
-            if "payload" in resp.data:  # fused: already consumed
-                got = GotWork(
-                    work_type=resp.work_type,
-                    work_prio=resp.prio,
-                    payload=resp.payload,
-                    answer_rank=resp.answer_rank,
-                    time_on_q=resp.data.get("time_on_q", 0.0),
-                )
-                if self.tracer is not None:
-                    self.tracer.got_work(got.work_type)
-                return ADLB_SUCCESS, got
-            handle = WorkHandle.from_ints(resp.handle)
-            rc, buf, t_q = self._get_reserved_timed(handle)
-            if rc != ADLB_SUCCESS:
-                return rc, None
-            if self.tracer is not None:
-                self.tracer.got_work(resp.work_type)
-            return ADLB_SUCCESS, GotWork(
+            return self._decode_single_got(resp)
+
+    def _decode_single_got(self, resp) -> tuple[int, Optional[GotWork]]:
+        """Decode a successful single-unit TA_RESERVE_RESP: fused (payload
+        inline) or handle fallback (remote holder / prefixed unit)."""
+        if "payload" in resp.data:  # fused: already consumed
+            got = GotWork(
                 work_type=resp.work_type,
                 work_prio=resp.prio,
-                payload=buf,
+                payload=resp.payload,
                 answer_rank=resp.answer_rank,
-                time_on_q=t_q,
+                time_on_q=resp.data.get("time_on_q", 0.0),
             )
+            if self.tracer is not None:
+                self.tracer.got_work(got.work_type)
+            return ADLB_SUCCESS, got
+        handle = WorkHandle.from_ints(resp.handle)
+        rc, buf, t_q = self._get_reserved_timed(handle)
+        if rc != ADLB_SUCCESS:
+            return rc, None
+        if self.tracer is not None:
+            self.tracer.got_work(resp.work_type)
+        return ADLB_SUCCESS, GotWork(
+            work_type=resp.work_type,
+            work_prio=resp.prio,
+            payload=buf,
+            answer_rank=resp.answer_rank,
+            time_on_q=t_q,
+        )
+
+    def get_work_batch(
+        self,
+        req_types: Optional[Sequence[int]] = None,
+        max_units: int = 8,
+    ) -> tuple[int, list[GotWork]]:
+        """Blocking fused reserve+get of up to ``max_units`` units in ONE
+        round trip (no reference analogue). The responding server inlines
+        as many LOCAL prefix-free matches as it holds (capped at
+        ``max_units``); remote holders and prefixed units fall back to the
+        single-unit path, so a batch never costs extra round trips — it
+        only amortizes them when the balancer has pre-positioned local
+        inventory. Returns ``(ADLB_SUCCESS, [GotWork, ...])`` (at least
+        one), or ``(rc, [])`` on termination."""
+        if max_units < 1:
+            raise AdlbError("get_work_batch: max_units must be >= 1")
+        with self._span("adlb:get_work_batch"):
+            types = normalize_req_types(req_types, self.world.types)
+            self._rqseqno += 1
+            self.ep.send(
+                self.home,
+                msg(
+                    Tag.FA_RESERVE,
+                    self.rank,
+                    req_types=None if types is None else sorted(types),
+                    hang=True,
+                    rqseqno=self._rqseqno,
+                    fetch=True,
+                    fetch_max=max_units,
+                ),
+            )
+            resp = self._wait(Tag.TA_RESERVE_RESP)
+            if resp.rc != ADLB_SUCCESS:
+                return resp.rc, []
+            if "payloads" in resp.data:  # batch-fused: already consumed
+                out = []
+                d = resp.data
+                for i, payload in enumerate(d["payloads"]):
+                    out.append(GotWork(
+                        work_type=d["work_types"][i],
+                        work_prio=d["prios"][i],
+                        payload=payload,
+                        answer_rank=d["answer_ranks"][i],
+                        time_on_q=d["times_on_q"][i],
+                    ))
+                    if self.tracer is not None:
+                        self.tracer.got_work(d["work_types"][i])
+                return ADLB_SUCCESS, out
+            # single-unit response (a park wake-up, a remote/prefixed
+            # fallback, or a server that ignores fetch_max)
+            rc, got = self._decode_single_got(resp)
+            return rc, [got] if got is not None else []
 
     # -- app <-> app messaging (the reference's app_comm) ---------------------
     #

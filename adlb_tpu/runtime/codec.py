@@ -178,6 +178,22 @@ FIELDS: dict[str, tuple[int, int]] = {
     "client": (73, _KIND_I64),
     "started": (74, _KIND_I64),
     "ck_counts": (76, _KIND_LIST),
+    # migration-batch acknowledgment: the planner stamps each
+    # SS_PLAN_MIGRATE with a batch id (mig_id, forwarded in
+    # SS_MIGRATE_WORK); destinations report, per SOURCE server, the
+    # highest id received (mig_acks: flattened (src, id) pairs) so
+    # in-flight credits clear exactly when the batch becomes visible in
+    # inventory — per source because transport ordering only holds per
+    # sender pair
+    "mig_id": (77, _KIND_I64),
+    "mig_acks": (78, _KIND_LIST),
+    # batched fused fetch (get_work_batch): how many local prefix-free
+    # units one TA_RESERVE_RESP may carry. Servers that predate the field
+    # (or the native daemon) ignore it and answer single-unit fused — the
+    # client handles either shape. The batch RESPONSE fields (payloads,
+    # parallel metadata lists) exist only on the in-proc/pickle paths;
+    # binary peers always get the single-unit shape.
+    "fetch_max": (79, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

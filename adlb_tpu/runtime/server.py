@@ -141,10 +141,11 @@ class _BalancerWorker(threading.Thread):
                     rqseqno=rqseqno,
                 ),
             )
-        for src_rank, dest, seqnos in migrations:
+        for src_rank, dest, seqnos, mig_id in migrations:
             s.ep.send(
                 src_rank,
-                msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos),
+                msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos,
+                    mig_id=mig_id),
             )
         if s.cfg.balancer_min_gap > 0:
             time.sleep(s.cfg.balancer_min_gap)
@@ -196,6 +197,9 @@ class Server:
         # in-flight work the exhaustion vote must see (units inside an
         # unacked SS_MIGRATE_WORK live in no wq anywhere)
         self._migrate_unacked = 0
+        # src server -> highest planner migration-batch id received from
+        # it (per-source: transport ordering only holds per sender pair)
+        self._mig_acks: dict[int, int] = {}
         self._last_event_snap = 0.0
 
         # termination state
@@ -520,6 +524,32 @@ class Server:
             common_server_rank=unit.common_server_rank,
             common_seqno=unit.common_seqno,
         )
+        self._send_reserve_handle(app_rank, unit, handle)
+
+    def _reserve_resp_batch(self, app_rank: int, units: list) -> None:
+        """One TA_RESERVE_RESP carrying several consumed local units
+        (get_work_batch). In-proc/pickle transports only — the binary
+        codec has no parallel-list response fields."""
+        now = time.monotonic()
+        self.resolved_reserves += len(units)
+        for u in units:
+            self.wq.remove(u.seqno)
+            self.mem.free(len(u.payload))
+        self.ep.send(
+            app_rank,
+            msg(
+                Tag.TA_RESERVE_RESP,
+                self.rank,
+                rc=ADLB_SUCCESS,
+                payloads=[u.payload for u in units],
+                work_types=[u.work_type for u in units],
+                prios=[u.prio for u in units],
+                answer_ranks=[u.answer_rank for u in units],
+                times_on_q=[now - u.time_stamp for u in units],
+            ),
+        )
+
+    def _send_reserve_handle(self, app_rank, unit, handle) -> None:
         self.ep.send(
             app_rank,
             msg(
@@ -834,11 +864,33 @@ class Server:
             self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION)
             return
         fetch = bool(m.data.get("fetch", False))
+        fetch_max = int(m.data.get("fetch_max", 1) or 1)
         unit = self.wq.find_match(app, req_types)
         if unit is not None:
             self.wq.pin(unit.seqno, app)
             self.activity += 1
             self._n_reserve_immed += 1
+            if (
+                fetch
+                and fetch_max > 1
+                and unit.common_len == 0
+                and app not in getattr(self.ep, "binary_peers", ())
+            ):
+                # batched fused fetch: pop up to fetch_max local prefix-free
+                # matches into ONE response — the consumer loop's round
+                # trips amortize over the batch, and only locally-positioned
+                # inventory can batch (remote holders and prefixed units
+                # stop the collection), so the mode that pre-positions work
+                # locally is the mode that benefits
+                units = [unit]
+                while len(units) < fetch_max:
+                    extra = self.wq.find_match(app, req_types)
+                    if extra is None or extra.common_len != 0:
+                        break
+                    self.wq.pin(extra.seqno, app)
+                    units.append(extra)
+                self._reserve_resp_batch(app, units)
+                return
             self._reserve_resp(app, ADLB_SUCCESS, unit, fetch=fetch)
             return
         if not m.hang:
@@ -1377,6 +1429,7 @@ class Server:
             "nbytes": self.mem.curr,
             "consumers": len(self.local_apps - self._finalized),
             "stamp": time.monotonic(),
+            "mig_acks": dict(self._mig_acks),
         }
         if self.is_master:
             self._accept_snapshot(self.rank, snap)
@@ -1404,6 +1457,12 @@ class Server:
                 prev.get("task_stamp", prev["stamp"]) if prev is not None
                 else snap["stamp"]
             )
+            # the migration-batch acks must stay consistent with the TASK
+            # view they ride with: acking a landed batch against a stale
+            # task list would clear the credit before the units are
+            # visible, re-creating the phantom-top-up chain
+            if prev is not None:
+                snap["mig_acks"] = prev.get("mig_acks")
         else:
             snap["task_stamp"] = snap["stamp"]
         self._snapshots[src] = snap
@@ -1597,10 +1656,18 @@ class Server:
             self._migrate_unacked += 1
             self.ep.send(
                 m.dest,
-                msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False),
+                msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False,
+                    mig_id=m.data.get("mig_id", 0)),
             )
 
     def _on_migrate_work(self, m: Msg) -> None:
+        # ack the planner's batch id via the next snapshot: credits for
+        # this source's batches up to this id are now visible in our
+        # inventory (bounced resends carry no id — the original sighting
+        # already acked it)
+        mid = m.data.get("mig_id", 0) or 0
+        if mid:
+            self._mig_acks[m.src] = max(self._mig_acks.get(m.src, 0), mid)
         bounced_back = []
         for u in m.units:
             # admission control like every other ingress path; a unit already
@@ -1640,6 +1707,11 @@ class Server:
             )
         if m.units:
             self._match_rq()
+            if self.cfg.balancer == "tpu":
+                # immediate full snapshot: the batch ack and the post-batch
+                # inventory reach the planner now, not a heartbeat later —
+                # the follow-up top-up cadence rides on this
+                self._send_snapshot()
 
     def _on_migrate_ack(self, m: Msg) -> None:
         self._migrate_unacked -= 1

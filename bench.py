@@ -268,15 +268,18 @@ def main() -> None:
     # staleness shows, while staying under the one-interpreter message cap
     HOT_APPS, HOT_SERVERS, HOT_N = 16, 8, 1200
 
-    def hot_one(mode):
+    def hot_one(mode, fused=True):
         r = hotspot.run(
             n_tasks=HOT_N, work_time=0.004, num_app_ranks=HOT_APPS,
-            nservers=HOT_SERVERS, cfg=cfg(mode), timeout=300.0,
+            nservers=HOT_SERVERS, cfg=cfg(mode), timeout=300.0, fused=fused,
         )
         assert r.tasks == HOT_N, f"hotspot {mode}: lost work ({r.tasks})"
         return r
 
-    # the headline row: 5 reps, not 3 — its median sets vs_baseline
+    # the headline row: 5 reps, not 3 — its median sets vs_baseline.
+    # Consumers use the fused get_work call (one round trip when the unit
+    # is local): both modes issue the identical call, so the mode that
+    # pre-positions work locally is paid for the locality it created.
     hot_runs = interleaved(hot_one, modes=("steal", "steal_fast", "tpu"),
                            reps=5)
     hot_steal = median_by(hot_runs["steal"], key=lambda r: r.tasks_per_sec)
@@ -285,6 +288,15 @@ def main() -> None:
     hot_tpu = median_by(hot_runs["tpu"], key=lambda r: r.tasks_per_sec)
     steal_idle_med = median_by([r.idle_pct for r in hot_runs["steal"]])
     tpu_idle_med = median_by([r.idle_pct for r in hot_runs["tpu"]])
+
+    # continuity row: the two-call Reserve+Get consumer loop benchmarked in
+    # rounds 1-2 (the reference's only consumer shape), so the fused-loop
+    # switch above stays auditable against earlier BENCH_r* files
+    hcl_runs = interleaved(lambda m: hot_one(m, fused=False), reps=3)
+    hcl_steal = median_by(hcl_runs["steal"], key=lambda r: r.tasks_per_sec)
+    hcl_tpu = median_by(hcl_runs["tpu"], key=lambda r: r.tasks_per_sec)
+    hcl_steal_idle = median_by([r.idle_pct for r in hcl_runs["steal"]])
+    hcl_tpu_idle = median_by([r.idle_pct for r in hcl_runs["tpu"]])
 
     # trickle: steady arrival at one server, consumers elsewhere — isolates
     # dispatch (discovery) latency, the structural gap between gossip-driven
@@ -395,6 +407,18 @@ def main() -> None:
                 min(r.idle_pct for r in hot_runs["tpu"]), 1),
             "hotspot_steal_idle_pct_best": round(
                 min(r.idle_pct for r in hot_runs["steal"]), 1),
+            # continuity: the rounds-1/2 two-call consumer loop
+            "hotspot_classic_steal_tasks_per_sec": round(
+                hcl_steal.tasks_per_sec, 1),
+            "hotspot_classic_tpu_tasks_per_sec": round(
+                hcl_tpu.tasks_per_sec, 1),
+            "hotspot_classic_ratio": round(
+                hcl_tpu.tasks_per_sec / hcl_steal.tasks_per_sec, 3)
+            if hcl_steal.tasks_per_sec else 0.0,
+            "hotspot_classic_steal_idle_pct": round(hcl_steal_idle, 1),
+            "hotspot_classic_tpu_idle_pct": round(hcl_tpu_idle, 1),
+            "hotspot_classic_idle_ratio": round(
+                hcl_tpu_idle / hcl_steal_idle, 3) if hcl_steal_idle else 0.0,
             "trickle_dispatch_p50_ms_steal": round(
                 tric_steal.dispatch_p50_ms, 2),
             "trickle_dispatch_p50_ms_steal_fast": round(

@@ -293,16 +293,59 @@ def test_migration_window_grows_on_fast_drain():
     for i in range(4):
         t = _time.monotonic()
         snaps = {
+            # the dest keeps a couple of units on hand: fully empty would
+            # hit the starved full-share path, which the next test covers
             10: {"tasks": [(1000 * i + j, T1, 1, 8) for j in range(400)],
                  "reqs": [], "consumers": 1, "stamp": t, "task_stamp": t},
-            11: {"tasks": [], "reqs": [], "consumers": 1, "stamp": t,
-                 "task_stamp": t},
+            11: {"tasks": [(1000 * i + 900 + j, T1, 1, 8) for j in range(2)],
+                 "reqs": [], "consumers": 1, "stamp": t, "task_stamp": t},
         }
         _, migs = eng.round(snaps, None)
         assert migs and migs[0][1] == 11
-        sizes.append(sum(len(q) for _, _, q in migs))
+        sizes.append(sum(len(q) for _, _, q, _ in migs))
     assert sizes[-1] > sizes[0], sizes
     assert sizes == sorted(sizes), sizes
+
+
+def test_starved_destination_gets_full_share_immediately():
+    """A destination with a parked requester, zero inventory, and zero
+    inflow (hotspot's empty servers) must receive its full fair share in
+    ONE batch — not window-sized refills that ramp from the lookahead
+    floor while its workers idle a re-plan round trip at a time (the
+    round-2 hotspot regression)."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=512, max_requesters=8)
+    t = _time.monotonic()
+    snaps = {
+        10: {"tasks": [(j, T1, 1, 8) for j in range(400)], "reqs": [],
+             "consumers": 2, "stamp": t, "task_stamp": t},
+        11: {"tasks": [], "reqs": [(5, 1, [T1])], "consumers": 2,
+             "stamp": t, "task_stamp": t},
+    }
+    matches, migs = eng.round(snaps, None)
+    shipped = sum(len(q) for _, dest, q, _ in migs if dest == 11)
+    # one unit goes via the match; of the remaining 399 the source keeps
+    # its own ceil-share (200) and ships the rest. The old window-capped
+    # first batch was LOOKAHEAD*consumers = 16.
+    assert len(matches) == 1 and shipped == 199, (matches, migs)
+    # the window is seeded at the shipped scale: a follow-up deficit tops
+    # up at fair-share size instead of re-ramping from the floor
+    assert eng._window(11) >= 99, eng._look
+    # an empty server whose workers are all mid-compute (no parked
+    # requester — tsp's transient dips) stays on the capped path
+    eng2 = PlanEngine(types=(T1,), max_tasks=512, max_requesters=8)
+    snaps2 = {
+        10: {"tasks": [(j, T1, 1, 8) for j in range(400)], "reqs": [],
+             "consumers": 2, "stamp": t, "task_stamp": t},
+        11: {"tasks": [], "reqs": [], "consumers": 2, "stamp": t,
+             "task_stamp": t},
+    }
+    _, migs2 = eng2.round(snaps2, None)
+    shipped2 = sum(len(q) for _, dest, q, _ in migs2 if dest == 11)
+    assert 0 < shipped2 <= eng2.LOOKAHEAD * 2, migs2
 
 
 def test_migration_spares_locally_demanded_unit():
@@ -323,7 +366,7 @@ def test_migration_spares_locally_demanded_unit():
     }
     matches, migs = eng.round(snaps, None)
     assert matches == []  # T2 supply is local to its demander: no solve
-    moved = {q for _, _, qs in migs for q in qs}
+    moved = {q for _, _, qs, _ in migs for q in qs}
     assert 3 not in moved, (matches, migs)
 
 
@@ -378,12 +421,12 @@ def test_matched_requester_not_double_withheld():
     eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
     migs = eng._plan_migrations(snaps, filtered, {}, t0,
                                 matched_reqs={(10, 5, 1)})
-    moved = {q for _, _, qs in migs for q in qs}
+    moved = {q for _, _, qs, _ in migs for q in qs}
     assert moved == {1, 2}, migs
     # unmatched, the requester still protects one locally-matchable unit
     eng2 = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
     migs2 = eng2._plan_migrations(snaps, filtered, {}, t0)
-    moved2 = {q for _, _, qs in migs2 for q in qs}
+    moved2 = {q for _, _, qs, _ in migs2 for q in qs}
     assert len(moved2) == 1, migs2
     # LOCAL pairs (dropped from matches, unit in planned_away) consume
     # their requester too: withholding a second unit for it would starve
@@ -402,5 +445,5 @@ def test_matched_requester_not_double_withheld():
     # one local pair (dropped) + one cross match leave exactly one unit;
     # it must reach the starved consumer on 12, not be double-withheld
     assert len(matches3) == 1 and matches3[0][2] == 11, matches3
-    moved3 = {q for _, _, qs in migs3 for q in qs}
+    moved3 = {q for _, _, qs, _ in migs3 for q in qs}
     assert moved3, (matches3, migs3)

@@ -69,6 +69,69 @@ def test_get_work_falls_back_for_common_prefix():
     assert got == list(range(8))
 
 
+def _pc_batch(ctx):
+    if ctx.rank == 0:
+        for i in range(60):
+            ctx.iput(struct.pack("<q", i), T, work_prio=i % 5)
+        ctx.flush_puts()
+    got = []
+    saw_multi = 0
+    while True:
+        rc, ws = ctx.get_work_batch([T], max_units=4)
+        if rc != ADLB_SUCCESS:
+            return got, saw_multi
+        assert 1 <= len(ws) <= 4
+        saw_multi += len(ws) > 1
+        for w in ws:
+            assert w.work_type == T and w.time_on_q >= 0.0
+            got.append(struct.unpack("<q", w.payload)[0])
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_get_work_batch_conservation(mode):
+    """Batched fused fetch: every unit delivered exactly once, batches
+    capped at max_units, and at least one multi-unit batch observed (the
+    producer runs ahead, so local inventory exists)."""
+    cfg = Config(balancer=mode, exhaust_check_interval=0.2,
+                 balancer_max_tasks=128, balancer_max_requesters=16)
+    res = run_world(4, 2, [T], _pc_batch, cfg=cfg)
+    got = sorted(x for v in res.app_results.values() for x in v[0])
+    assert got == list(range(60))
+    assert sum(v[1] for v in res.app_results.values()) > 0
+
+
+def test_get_work_batch_native_servers_single_fallback():
+    """A native daemon ignores fetch_max (no batch response fields in the
+    binary codec) and answers single-unit fused; the client must cope."""
+    cfg = Config(server_impl="native", exhaust_check_interval=0.2)
+    res = spawn_world(4, 2, [T], _pc_batch, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in (v or [[]])[0])
+    assert got == list(range(60))
+
+
+def test_get_work_batch_common_prefix_falls_back():
+    common = b"HDR:"
+
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.begin_batch_put(common)
+            for i in range(8):
+                ctx.put(struct.pack("<q", i), T)
+            ctx.end_batch_put()
+        got = []
+        while True:
+            rc, ws = ctx.get_work_batch([T], max_units=4)
+            if rc != ADLB_SUCCESS:
+                return got
+            for w in ws:
+                assert w.payload.startswith(common)
+                got.append(struct.unpack("<q", w.payload[len(common):])[0])
+
+    res = run_world(3, 2, [T], app, cfg=Config(exhaust_check_interval=0.2))
+    got = sorted(x for v in res.app_results.values() for x in (v or []))
+    assert got == list(range(8))
+
+
 def test_get_work_remote_steal_fallback():
     """A parked get_work satisfied through a cross-server RFR handoff falls
     back to fetching from the remote holder."""
