@@ -137,8 +137,9 @@ class PlanEngine:
             raise ValueError("look_max must be >= max(1, lookahead)")
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
-        # rank -> [(plan time, nunits, mig_id, src)] for migration batches
-        # en route there; until those units land they are invisible in the
+        # rank -> [(plan time, nunits, mig_id, src, frozenset(types))] for
+        # migration batches en route there; until those units land they
+        # are invisible in the
         # destination's inventory, and without crediting them the planner
         # chains phantom top-ups to a destination that is already being
         # fed. Clearing is EXACT when snapshots carry "mig_acks" (src ->
@@ -154,6 +155,7 @@ class PlanEngine:
         # last triggered a top-up (see LOOKAHEAD)
         self._look: dict[int, float] = {}
         self._look_last: dict[int, float] = {}
+        self._last_pump = -1e9
 
     def force_host_path(self) -> None:
         """After a device/backend failure: keep planning on numpy — for the
@@ -170,21 +172,89 @@ class PlanEngine:
                 host_threshold_reqs=10**9,
             )
 
+    def _prune_credits(self, snapshots: dict, now: float) -> None:
+        """Clear in-flight migration credits that this round's snapshots
+        acknowledge (per-source ``mig_acks``), plus the TTL backstop and
+        the legacy stamp/min-age fallback for ack-less planes. Runs once
+        at the top of every round so BOTH the requester-suppression
+        filter and the migration planner see clean credits."""
+        if not self._planned_in:
+            return
+        horizon = now - self.INFLOW_TTL
+        young = now - self.INFLOW_MIN_AGE
+        for rank in list(self._planned_in):
+            snap = snapshots.get(rank)
+            if snap is None:
+                # rank stopped appearing (ended server): TTL-only pruning
+                kept = [e for e in self._planned_in[rank] if e[0] > horizon]
+                if kept:
+                    self._planned_in[rank] = kept
+                else:
+                    del self._planned_in[rank]
+                continue
+            tstamp = snap.get("task_stamp", snap.get("stamp", now))
+            acks = snap.get("mig_acks")
+            live = []
+            for e in self._planned_in[rank]:
+                ts, _n, mid, src, _types = e
+                if ts <= horizon:
+                    continue  # TTL backstop: the batch is lost
+                if acks is not None:
+                    if mid <= acks.get(src, 0):
+                        continue  # landed: visible in this snapshot
+                elif not (ts > tstamp or ts > young):
+                    continue  # legacy stamp/min-age clearing
+                live.append(e)
+            if live:
+                self._planned_in[rank] = live
+            else:
+                del self._planned_in[rank]
+
     def round(self, snapshots: dict, world=None):
         """One planning round; returns (matches, migrations)."""
         if not snapshots:
             return [], []
         now = time.monotonic()
+        self._prune_credits(snapshots, now)
         # requester-side ledger filter first (reqs are few): rounds run at
         # event rate, so a round that can plan nothing must cost O(reqs),
-        # not O(queued tasks)
+        # not O(queued tasks). A requester whose home server has a live
+        # inflow credit covering a type it wants is suppressed outright:
+        # the batch already in flight will match it LOCALLY within
+        # milliseconds, and solving it too would both burn a round's CPU
+        # (2+ ms on wide worlds — pure theft from the workers on a shared
+        # core) and deliver a second unit via the expensive per-unit
+        # remote-fetch path (the round-3 native-64-rank regression: ~3.6k
+        # double-served matches per run).
         freqs = {}
         for rank, snap in snapshots.items():
             stamp = snap.get("stamp", now)
-            freqs[rank] = [
-                r for r in snap["reqs"]
-                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) < stamp
-            ]
+            # suppression budget: only YOUNG credits (a lost batch must
+            # not block per-unit matching for the whole 2 s TTL — it
+            # stops suppressing after SUPPRESS_TTL and the solve takes
+            # over), and at most as many requesters as there are units
+            # in flight (a 1-unit batch must not park a whole pool)
+            fed: Optional[set] = None
+            budget = 0
+            if rank in self._planned_in:
+                fed = set()
+                for e in self._planned_in[rank]:
+                    if e[0] > now - self.SUPPRESS_TTL:
+                        fed |= e[4]
+                        budget += e[1]
+            kept = []
+            for r in snap["reqs"]:
+                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) >= stamp:
+                    continue
+                if (
+                    budget > 0
+                    and fed
+                    and (r[2] is None or not fed.isdisjoint(r[2]))
+                ):
+                    budget -= 1
+                    continue
+                kept.append(r)
+            freqs[rank] = kept
         have_reqs = any(freqs.values())
         # The solve's only useful output is CROSS-server pairs: same-server
         # pairs are dropped below (the data plane's immediate local matching
@@ -198,8 +268,16 @@ class PlanEngine:
         # over-admit a solve for one snapshot generation, which the
         # filtered solve input then corrects.
         cross = have_reqs and self._cross_feasible(freqs, snapshots)
-        if not cross and not self._maybe_imbalanced(snapshots):
+        # The fair-share pump runs at most once per PUMP_INTERVAL: deficits
+        # cannot change faster than batches land, and each pump round
+        # walks every snapshot task (O(servers x K) — milliseconds on wide
+        # worlds, stolen from the workers on a shared core). Match-bearing
+        # rounds (cross demand) are never delayed.
+        pump_due = now - self._last_pump >= self.PUMP_INTERVAL
+        if not cross and not (pump_due and self._maybe_imbalanced(snapshots)):
             return [], []  # nothing plannable: skip the task-ledger walk
+        if pump_due:
+            self._last_pump = now
         filtered = {}
         for rank, snap in snapshots.items():
             # task eligibility uses the task-side stamp: a reqs-only park
@@ -229,9 +307,11 @@ class PlanEngine:
             self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
             self._planned_tasks[(holder, seqno)] = t_planned
             matches.append((holder, seqno, req_home, for_rank, rqseqno))
-        migrations = self._plan_migrations(
-            snapshots, filtered, planned_away, t_planned, matched_reqs
-        )
+        migrations = []
+        if pump_due:
+            migrations = self._plan_migrations(
+                snapshots, filtered, planned_away, t_planned, matched_reqs
+            )
         if matches or migrations:
             involved = (
                 {h for h, *_ in matches}
@@ -254,15 +334,6 @@ class PlanEngine:
             }
             self._planned_tasks = {
                 k: v for k, v in self._planned_tasks.items() if v > cutoff
-            }
-        if self._planned_in:
-            # inflow credits for ranks that stopped appearing in snapshots
-            # (ended servers) are pruned nowhere else
-            horizon = t_planned - self.INFLOW_TTL
-            self._planned_in = {
-                r: kept
-                for r, lst in self._planned_in.items()
-                if (kept := [e for e in lst if e[0] > horizon])
             }
         return matches, migrations
 
@@ -325,6 +396,15 @@ class PlanEngine:
     # top-up chain for destinations that snapshot faster than batch
     # transit).
     INFLOW_MIN_AGE = 0.05
+    # minimum spacing of fair-share pump rounds (see round()); starved
+    # destinations wait at most this long for their first batch, far under
+    # a batch's own transit+enactment time
+    PUMP_INTERVAL = 0.01
+    # in-flight credits older than this stop suppressing the solve for
+    # their destination's requesters (the batch is probably lost; the TTL
+    # keeps it counted as pump inflow a while longer, but workers must
+    # not stay unmatchable for the full TTL)
+    SUPPRESS_TTL = 0.25
 
     def _window(self, rank: int) -> float:
         return self._look.get(rank, float(self.LOOKAHEAD))
@@ -400,34 +480,11 @@ class PlanEngine:
                     avail = [t for t in avail if t[0] not in withheld]
             inv[rank] = avail
             consumers[rank] = snaps.get(rank, {}).get("consumers", 0)
-            snap = snaps.get(rank, {})
-            # stamp-less snapshots (tstamp = now) retry every round rather
-            # than credit forever, matching round()'s stamp fallback
-            tstamp = snap.get("task_stamp", snap.get("stamp", t_planned))
-            # acks are PER SOURCE (src -> highest batch id received from
-            # that src): transport ordering holds per sender pair, but two
-            # sources feeding one destination can interleave, and a single
-            # max-id ack would clear a slower source's in-flight credit
-            # the moment a faster source's later batch lands
-            acks = snap.get("mig_acks")
-            horizon = t_planned - self.INFLOW_TTL
-            young = t_planned - self.INFLOW_MIN_AGE
-            live = []
-            for e in self._planned_in.get(rank, ()):
-                ts, n_units, mid, src = e
-                if ts <= horizon:
-                    continue  # TTL backstop: the batch is lost
-                if acks is not None:
-                    if mid <= acks.get(src, 0):
-                        continue  # landed: counted in this snapshot's tasks
-                elif not (ts > tstamp or ts > young):
-                    continue  # legacy stamp/min-age clearing (no ack field)
-                live.append(e)
-            if live:
-                self._planned_in[rank] = live
-            else:
-                self._planned_in.pop(rank, None)
-            inflow[rank] = sum(e[1] for e in live)
+            # credits were pruned at the top of the round (_prune_credits):
+            # what remains is in flight
+            inflow[rank] = sum(
+                e[1] for e in self._planned_in.get(rank, ())
+            )
         total_consumers = sum(consumers.values())
         if total_consumers == 0:
             return []
@@ -499,7 +556,7 @@ class PlanEngine:
             if len(lst) > share(r)
         }
         cap = self.max_malloc_per_server
-        moves: dict[tuple[int, int], list[int]] = {}
+        moves: dict[tuple[int, int], list] = {}  # (src,dest)->[(seqno,type)]
         for dest, want in sorted(deficits.items(), key=lambda kv: -kv[1]):
             dest_bytes = snaps.get(dest, {}).get("nbytes", 0)
             for src_rank, lst in surpluses.items():
@@ -518,18 +575,20 @@ class PlanEngine:
                 if take:
                     surpluses[src_rank] = lst = lst[len(take):]
                     moves.setdefault((src_rank, dest), []).extend(
-                        t[0] for t in take
+                        (t[0], t[1]) for t in take
                     )
                     want -= len(take)
         out = []
         got: dict[int, int] = {}
-        for (src_rank, dest), seqnos in moves.items():
+        for (src_rank, dest), seqnos_types in moves.items():
+            seqnos = [q for q, _ in seqnos_types]
             mid = self._mig_next
             self._mig_next += 1
             for q in seqnos:
                 self._planned_tasks[(src_rank, q)] = t_planned
             self._planned_in.setdefault(dest, []).append(
-                (t_planned, len(seqnos), mid, src_rank)
+                (t_planned, len(seqnos), mid, src_rank,
+                 frozenset(wt for _, wt in seqnos_types))
             )
             got[dest] = got.get(dest, 0) + len(seqnos)
             out.append((src_rank, dest, seqnos, mid))

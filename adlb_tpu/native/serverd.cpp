@@ -956,6 +956,11 @@ class Server {
       case T_SS_END_1: on_end_1(m); break;
       case T_SS_END_2: on_end_2(m); break;
       case T_SS_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
+      // a client-directed abort frame reaching a server means the world
+      // is already in an abort storm (misdirected fan-out / rank reuse);
+      // treat it as the abort it is rather than dying on "no handler"
+      // and cascading connection-loss aborts through every peer
+      case T_TA_ABORT: do_abort(int(m.geti(F_CODE, -1)), false); break;
       case T_PEER_EOF: on_peer_eof(m); break;
       case T_SS_PERIODIC_STATS: on_periodic_stats(m); break;
       case T_SS_HUNGRY: {

@@ -210,15 +210,64 @@ class TcpEndpoint:
 
 
 def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str, int]]:
-    """Pick nranks free ports on one host (rendezvous for tests/single-host)."""
+    """Pick nranks free ports on one host (rendezvous for tests/single-host).
+
+    Ports come from BELOW the kernel's ephemeral range (see
+    /proc/sys/net/ipv4/ip_local_port_range, typically 32768+): the map is
+    handed to child processes that bind later, and in a 100+-rank spawn
+    storm an OUTBOUND connection's ephemeral port can otherwise land on a
+    rank's not-yet-bound listener port — that rank then dies on bind and
+    the failure-detection abort takes the whole world with it (observed
+    at 64-128 ranks as a few-percent flake). A random start keeps
+    concurrent worlds off each other; the bind check skips ports someone
+    already holds.
+    """
+    import random
+
+    # the actual ephemeral floor is tunable; read it so the guarantee
+    # holds on hosts with a lowered range (fall back to the Linux default)
+    floor = 32768
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            floor = int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        pass
+    if floor < 13000 + 2 * nranks:
+        # no usable static range below the ephemeral floor: fall back to
+        # kernel-assigned ports (the pre-fix behaviour, collision risk
+        # and all — there is nowhere safe to allocate from)
+        addr_map = {}
+        socks = []
+        for r in range(nranks):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, 0))
+            socks.append(s)
+            addr_map[r] = (host, s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return addr_map
+
+    lo = max(1024, floor - 12000)
+    hi = floor - 100
     addr_map = {}
     socks = []
-    for r in range(nranks):
+    port = random.randrange(lo, hi - 2 * nranks)
+    r = 0
+    while r < nranks:
+        port += 1
+        if port >= hi:
+            raise OSError(f"no free rendezvous ports below {hi}")
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((host, 0))
+        try:
+            s.bind((host, port))
+        except OSError:
+            s.close()
+            continue
         socks.append(s)
-        addr_map[r] = (host, s.getsockname()[1])
+        addr_map[r] = (host, port)
+        r += 1
     for s in socks:
         s.close()
     return addr_map

@@ -31,8 +31,12 @@ class HotspotResult:
     tasks: int
     elapsed: float
     tasks_per_sec: float
-    busy_fraction: float  # mean over workers
+    busy_fraction: float  # mean over workers (NOMINAL compute / elapsed)
     idle_pct: float
+    # mean fraction of the makespan workers spent blocked acquiring work
+    # (Reserve+Get) — the steal-to-exec quantity, measured directly;
+    # 0.0 where the workload does not report it
+    wait_pct: float = 0.0
 
 
 def run(
@@ -85,9 +89,11 @@ def run(
             if not fused:
                 rc, buf = ctx.get_reserved(r.handle)
             for _ in range(n_units):
-                t0 = time.monotonic()
                 time.sleep(work_time)  # GIL-free "compute"
-                busy += time.monotonic() - t0
+                # NOMINAL busy (see hotspot_native: wall-clock busy counts
+                # scheduler/GIL delay inside the sleep as utilization,
+                # which inverts idle% against throughput under contention)
+                busy += work_time
                 done += 1
                 t_last = time.monotonic()
 

@@ -47,15 +47,24 @@ def run(
         kv = dict(f.split("=") for f in line.split()[1:])
         rows.append(
             (int(kv["done"]), float(kv["busy"]), float(kv["t0"]),
-             float(kv["t1"]))
+             float(kv["t1"]), float(kv.get("wait", 0.0)))
         )
     workers = rows[1:]
     tasks = sum(r[0] for r in workers)
     t_begin = min(r[2] for r in rows)
     t_end = max(r[3] for r in workers)
     elapsed = max(t_end - t_begin, 1e-9)
+    # busy is NOMINAL compute (done x work_us, computed by the C worker):
+    # utilization = useful worker-seconds / available worker-seconds. A
+    # wall-clock busy measure would count involuntary scheduler delay
+    # inside the compute sleep as "busy", inflating utilization exactly
+    # in the runs where the oversubscribed kernel scheduler is the
+    # bottleneck (the round-2 64-rank idle-vs-throughput contradiction).
     busy = (
         sum(r[1] / elapsed for r in workers) / len(workers) if workers else 0.0
+    )
+    wait = (
+        sum(r[4] / elapsed for r in workers) / len(workers) if workers else 0.0
     )
     return HotspotResult(
         tasks=tasks,
@@ -63,4 +72,5 @@ def run(
         tasks_per_sec=tasks / elapsed,
         busy_fraction=busy,
         idle_pct=100.0 * (1.0 - busy),
+        wait_pct=100.0 * wait,
     )

@@ -242,6 +242,7 @@ def test_migration_inflow_credited_until_fresh_snapshot():
     # credit mid-test
     eng.INFLOW_MIN_AGE = 1e9
     eng.INFLOW_TTL = 1e9
+    eng.PUMP_INTERVAL = 0.0  # credit semantics under test, not pacing
     t0 = _time.monotonic()
     snaps = {
         10: {"tasks": [(i, T1, 1, 8) for i in range(40)], "reqs": [],
@@ -289,6 +290,7 @@ def test_migration_window_grows_on_fast_drain():
     # snapshot re-triggers immediately
     eng.LOOK_GROW_WINDOW = 1e9
     eng.INFLOW_MIN_AGE = 0.0
+    eng.PUMP_INTERVAL = 0.0  # window growth under test, not pacing
     sizes = []
     for i in range(4):
         t = _time.monotonic()
