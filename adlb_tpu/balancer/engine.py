@@ -412,13 +412,25 @@ class PlanEngine:
     def _need(self, share: int, consumers: int, rank: int) -> int:
         return min(share, int(self._window(rank)) * consumers)
 
-    def _touch_window(self, rank: int, now: float) -> None:
+    def _touch_window(self, rank: int, now: float,
+                      grow_ok: bool = True) -> None:
         """Called when `rank` triggered a top-up: grow on quick re-trigger,
-        decay otherwise."""
+        decay otherwise. Growth requires ``grow_ok`` — a destination
+        whose workers were actually PARKED when fed (they outpace their
+        supply; bigger batches pay). Feeding a busy server that merely
+        dipped below the band (sudoku's bursty-but-balanced DFS pools)
+        must not inflate the window: each doubling there just moves more
+        units nobody is waiting for, and the churn compounds."""
         look = self._window(rank)
-        if now - self._look_last.get(rank, -1e9) < self.LOOK_GROW_WINDOW:
+        if grow_ok and now - self._look_last.get(rank, -1e9) \
+                < self.LOOK_GROW_WINDOW:
             self._look[rank] = min(look * 2.0, float(self.LOOK_MAX))
         else:
+            # slow re-trigger OR nobody parked: decay toward the floor.
+            # A gated quick re-trigger must decay too — otherwise a
+            # window inflated during a parked phase would stay pinned at
+            # the inflated batch size for as long as the destination
+            # keeps dipping below the band
             self._look[rank] = max(float(self.LOOKAHEAD), look / 2.0)
         self._look_last[rank] = now
 
@@ -607,5 +619,8 @@ class PlanEngine:
                 )
                 self._look_last[dest] = t_planned
             else:
-                self._touch_window(dest, t_planned)
+                self._touch_window(
+                    dest, t_planned,
+                    grow_ok=bool(snaps.get(dest, {}).get("reqs")),
+                )
         return out

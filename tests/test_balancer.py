@@ -288,25 +288,55 @@ def test_migration_window_grows_on_fast_drain():
     # a slow CI machine cannot flip growth into decay mid-test, and drop
     # the in-flight transit crediting (tested elsewhere) so each fresh
     # snapshot re-triggers immediately
+    sizes = _run_four_topups(eng, dest_parked=True)
+    assert sizes[-1] > sizes[0], sizes
+    assert sizes == sorted(sizes), sizes
+
+
+def _run_four_topups(eng, dest_parked: bool):
+    """Four quick pump rounds against a deep source and a dest holding a
+    couple of units (fully empty would hit the starved full-share path).
+    ``dest_parked`` controls whether the dest has a parked requester —
+    window growth is reserved for destinations whose workers actually
+    outpace their supply. Returns the per-round shipped batch sizes."""
+    import time as _time
+
     eng.LOOK_GROW_WINDOW = 1e9
     eng.INFLOW_MIN_AGE = 0.0
-    eng.PUMP_INTERVAL = 0.0  # window growth under test, not pacing
+    eng.PUMP_INTERVAL = 0.0  # window mechanics under test, not pacing
     sizes = []
     for i in range(4):
         t = _time.monotonic()
         snaps = {
-            # the dest keeps a couple of units on hand: fully empty would
-            # hit the starved full-share path, which the next test covers
             10: {"tasks": [(1000 * i + j, T1, 1, 8) for j in range(400)],
                  "reqs": [], "consumers": 1, "stamp": t, "task_stamp": t},
             11: {"tasks": [(1000 * i + 900 + j, T1, 1, 8) for j in range(2)],
-                 "reqs": [], "consumers": 1, "stamp": t, "task_stamp": t},
+                 "reqs": [(5, i + 1, [T1])] if dest_parked else [],
+                 "consumers": 1, "stamp": t, "task_stamp": t},
         }
         _, migs = eng.round(snaps, None)
         assert migs and migs[0][1] == 11
         sizes.append(sum(len(q) for _, _, q, _ in migs))
-    assert sizes[-1] > sizes[0], sizes
-    assert sizes == sorted(sizes), sizes
+    return sizes
+
+
+def test_window_growth_gated_on_parked_requesters():
+    """A destination fed while its workers are all busy (no parked
+    requesters) keeps its window at the floor: bursty-but-balanced pools
+    must not have their transfer batches inflated. An already-inflated
+    window also DECAYS under gated triggers instead of staying pinned."""
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=512, max_requesters=8)
+    _run_four_topups(eng, dest_parked=False)
+    assert eng._window(11) == float(eng.LOOKAHEAD), eng._look
+    # inflate first (parked phase), then go gated: the window must decay
+    eng2 = PlanEngine(types=(T1,), max_tasks=512, max_requesters=8)
+    _run_four_topups(eng2, dest_parked=True)
+    grown = eng2._window(11)
+    assert grown > eng2.LOOKAHEAD, eng2._look
+    _run_four_topups(eng2, dest_parked=False)
+    assert eng2._window(11) < grown, eng2._look
 
 
 def test_starved_destination_gets_full_share_immediately():
