@@ -405,6 +405,11 @@ class PlanEngine:
     # keeps it counted as pump inflow a while longer, but workers must
     # not stay unmatchable for the full TTL)
     SUPPRESS_TTL = 0.25
+    # supply counts as CONCENTRATED (enabling the starved full-share
+    # bypass) when one server holds more than this fraction of the
+    # available pool; hotspot's single-source backlog holds ~everything,
+    # while balanced economies' transient bursts rarely clear it
+    CONC_FRAC = 0.5
 
     def _window(self, rank: int) -> float:
         return self._look.get(rank, float(self.LOOKAHEAD))
@@ -540,8 +545,8 @@ class PlanEngine:
         # concentration test — full-share moves there are churn nobody
         # is waiting for.
         concentrated = (
-            2 * max((len(lst) for lst in inv.values()), default=0)
-            > total_avail
+            max((len(lst) for lst in inv.values()), default=0)
+            > self.CONC_FRAC * total_avail
         )
         starved: set = set()
         deficits: dict[int, int] = {}

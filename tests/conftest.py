@@ -10,3 +10,7 @@ full env/config/backend-reset dance lives in
 from adlb_tpu.utils.jaxenv import force_cpu_devices
 
 force_cpu_devices(8)
+
+# hang diagnosis lives in pytest.ini (faulthandler_timeout): pytest's
+# built-in plugin dumps to the ORIGINAL stderr fd, surviving --capture,
+# and covers setup/teardown phases a fixture-armed timer would miss
