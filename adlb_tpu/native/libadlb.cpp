@@ -95,10 +95,19 @@ enum Field : uint8_t {
   F_APPTAG = 26,
   F_PUT_ID = 58,
   F_FETCH = 59,
+  F_FETCH_MAX = 79,
+  F_PAYLOADS = 80,
+  F_WORK_TYPES = 81,
+  F_PRIOS = 82,
+  F_ANSWER_RANKS = 83,
   F_PATH = 72,
 };
 
-enum Kind : uint8_t { K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3 };
+enum Kind : uint8_t {
+  K_I64 = 0, K_BYTES = 1, K_LIST = 2, K_F64 = 3,
+  K_BLIST = 4,  // list of byte strings: u16 count, (u32 len + bytes)*
+  K_FLIST = 5,  // list of f64: u16 count, f64*
+};
 
 constexpr uint8_t BINARY_MAGIC = 0x01;
 
@@ -109,6 +118,8 @@ struct Msg {
   std::map<uint8_t, double> dbls;
   std::map<uint8_t, std::string> blobs;
   std::map<uint8_t, std::vector<int64_t>> lists;
+  std::map<uint8_t, std::vector<std::string>> blists;
+  std::map<uint8_t, std::vector<double>> flists;
 
   int64_t geti(uint8_t f, int64_t dflt = 0) const {
     auto it = ints.find(f);
@@ -204,6 +215,28 @@ bool decode(const std::string &body, Msg *out) {
       double v;
       rd(&v, 8);
       out->dbls[fid] = v;
+    } else if (kind == K_BLIST) {
+      if (!need(2)) return false;
+      uint16_t cnt;
+      rd(&cnt, 2);
+      auto &bl = out->blists[fid];
+      bl.reserve(cnt);
+      for (uint16_t j = 0; j < cnt; j++) {
+        if (!need(4)) return false;
+        uint32_t n;
+        rd(&n, 4);
+        if (!need(n)) return false;
+        bl.emplace_back(body.data() + off, n);
+        off += n;
+      }
+    } else if (kind == K_FLIST) {
+      if (!need(2)) return false;
+      uint16_t cnt;
+      rd(&cnt, 2);
+      if (!need((size_t)8 * cnt)) return false;
+      auto &fl = out->flists[fid];
+      fl.resize(cnt);
+      for (uint16_t j = 0; j < cnt; j++) rd(&fl[j], 8);
     } else {
       return false;
     }
@@ -714,7 +747,8 @@ int ADLB_Put(void *b, int l, int t, int a, int w, int p) {
 
 static int reserve_impl(int *req_types, int *work_type, int *work_prio,
                         int *work_handle, int *work_len, int *answer_rank,
-                        int hang, int fetch = 0, Msg *raw = nullptr) {
+                        int hang, int fetch = 0, Msg *raw = nullptr,
+                        int fetch_max = 1) {
   if (!g) return ADLB_ERROR;
   std::vector<int64_t> types;
   bool any = false;
@@ -732,6 +766,7 @@ static int reserve_impl(int *req_types, int *work_type, int *work_prio,
   Encoder e(T_FA_RESERVE, g->rank);
   e.i(F_HANG, hang).i(F_RQSEQNO, g->rqseqno);
   if (fetch) e.i(F_FETCH, 1);
+  if (fetch_max > 1) e.i(F_FETCH_MAX, fetch_max);
   if (!any) e.list(F_REQ_TYPES, types);
   send_msg(g->home, e);
   Msg resp = wait_for(T_TA_RESERVE_RESP);
@@ -1106,31 +1141,91 @@ int ADLB_Flush_puts(void) {
 int ADLBP_Get_work(int *req_types, int *work_type, int *work_prio,
                    void *work_buf, int max_len, int *work_len,
                    int *answer_rank) {
+  // the single-unit call IS a 1-slot batch (scalar out-pointers are
+  // 1-element arrays); one copy of the fused/handle fallback logic
+  int ng = 0, wl = 0;
+  int rc = ADLBP_Get_work_batch(req_types, 1, &ng, work_type, work_prio,
+                                work_buf, max_len, &wl, answer_rank);
+  if (work_len) *work_len = wl;
+  return rc;
+}
+int ADLBP_Get_work_batch(int *req_types, int max_units, int *num_got,
+                         int *work_types, int *work_prios,
+                         void *payload_buf, int max_len_per_unit,
+                         int *work_lens, int *answer_ranks) {
   if (!g) return ADLB_ERROR;
+  if (max_units < 1) die("Get_work_batch: max_units must be >= 1");
+  if (num_got) *num_got = 0;
   Msg resp;
-  int rc = reserve_impl(req_types, work_type, work_prio, nullptr, nullptr,
-                        answer_rank, /*hang=*/1, /*fetch=*/1, &resp);
+  int rc = reserve_impl(req_types, nullptr, nullptr, nullptr, nullptr,
+                        nullptr, /*hang=*/1, /*fetch=*/1, &resp, max_units);
   if (rc != ADLB_SUCCESS) return rc;
-  auto bit = resp.blobs.find(F_PAYLOAD);
-  if (bit != resp.blobs.end()) {  // fused: unit already consumed
-    int n = (int)bit->second.size();
-    if (n > max_len)
-      die("Get_work: payload of %d bytes exceeds buffer of %d", n, max_len);
-    memcpy(work_buf, bit->second.data(), (size_t)n);
-    if (work_len) *work_len = n;
+  char *out = (char *)payload_buf;
+  auto blit = resp.blists.find(F_PAYLOADS);
+  if (blit != resp.blists.end()) {  // batch-fused: all units consumed
+    const std::vector<std::string> &pl = blit->second;
+    if ((int)pl.size() > max_units)
+      die("Get_work_batch: server sent %zu units for a %d-slot buffer",
+          pl.size(), max_units);
+    const std::vector<int64_t> &wt = resp.lists[F_WORK_TYPES];
+    const std::vector<int64_t> &wp = resp.lists[F_PRIOS];
+    const std::vector<int64_t> &ar = resp.lists[F_ANSWER_RANKS];
+    for (size_t i = 0; i < pl.size(); i++) {
+      int n = (int)pl[i].size();
+      if (n > max_len_per_unit)
+        die("Get_work_batch: payload of %d bytes exceeds per-unit buffer "
+            "of %d", n, max_len_per_unit);
+      memcpy(out + (size_t)i * max_len_per_unit, pl[i].data(), (size_t)n);
+      if (work_lens) work_lens[i] = n;
+      if (work_types && i < wt.size()) work_types[i] = (int)wt[i];
+      if (work_prios && i < wp.size()) work_prios[i] = (int)wp[i];
+      if (answer_ranks && i < ar.size()) answer_ranks[i] = (int)ar[i];
+    }
+    trace_last_reserved_wt = wt.empty() ? trace_last_reserved_wt
+                                        : (int)wt[0];
+    if (num_got) *num_got = (int)pl.size();
     return ADLB_SUCCESS;
   }
-  // fallback: remote holder or batch-common unit — handle + Get
-  auto it = resp.lists.find(F_HANDLE);
-  if (it == resp.lists.end() || it->second.size() != ADLB_HANDLE_SIZE)
+  // single-unit shapes (a park wake-up, a remote/prefixed fallback, or a
+  // peer that ignores fetch_max)
+  if (work_types) work_types[0] = (int)resp.geti(F_WORK_TYPE);
+  if (work_prios) work_prios[0] = (int)resp.geti(F_PRIO);
+  if (answer_ranks) answer_ranks[0] = (int)resp.geti(F_ANSWER_RANK, -1);
+  auto bit = resp.blobs.find(F_PAYLOAD);
+  if (bit != resp.blobs.end()) {  // fused single
+    int n = (int)bit->second.size();
+    if (n > max_len_per_unit)
+      die("Get_work_batch: payload of %d bytes exceeds per-unit buffer of "
+          "%d", n, max_len_per_unit);
+    memcpy(out, bit->second.data(), (size_t)n);
+    if (work_lens) work_lens[0] = n;
+    if (num_got) *num_got = 1;
+    return ADLB_SUCCESS;
+  }
+  auto hit = resp.lists.find(F_HANDLE);
+  if (hit == resp.lists.end() || hit->second.size() != ADLB_HANDLE_SIZE)
     die("malformed reserve handle");
   int handle[ADLB_HANDLE_SIZE];
-  for (int i = 0; i < ADLB_HANDLE_SIZE; i++) handle[i] = (int)it->second[i];
+  for (int i = 0; i < ADLB_HANDLE_SIZE; i++)
+    handle[i] = (int)hit->second[i];
   int wl = (int)resp.geti(F_WORK_LEN);
-  if (wl > max_len)
-    die("Get_work: payload of %d bytes exceeds buffer of %d", wl, max_len);
-  if (work_len) *work_len = wl;
-  return ADLBP_Get_reserved_timed(work_buf, handle, nullptr);
+  if (wl > max_len_per_unit)
+    die("Get_work_batch: payload of %d bytes exceeds per-unit buffer of %d",
+        wl, max_len_per_unit);
+  if (work_lens) work_lens[0] = wl;
+  rc = ADLBP_Get_reserved_timed(out, handle, nullptr);
+  if (rc == ADLB_SUCCESS && num_got) *num_got = 1;
+  return rc;
+}
+int ADLB_Get_work_batch(int *rt, int max_units, int *ng, int *wt, int *wp,
+                        void *b, int mlpu, int *wl, int *ar) {
+  if (!trace_on)
+    return ADLBP_Get_work_batch(rt, max_units, ng, wt, wp, b, mlpu, wl, ar);
+  trace_api_entry();
+  double t0 = trace_now();
+  int rc = ADLBP_Get_work_batch(rt, max_units, ng, wt, wp, b, mlpu, wl, ar);
+  trace_call("adlb:get_work_batch", t0);
+  return rc;
 }
 int ADLB_Get_work(int *rt, int *wt, int *wp, void *b, int ml, int *wl,
                   int *ar) {

@@ -233,9 +233,21 @@ enum FieldId : uint8_t {
   // credits clear exactly when the batch lands
   F_MIG_ID = 77,          // i64
   F_MIG_ACKS = 78,        // list
+  // batched fused fetch (get_work_batch): request cap + the batch
+  // response's parallel per-unit fields (codec.py ids 79-84)
+  F_FETCH_MAX = 79,       // i64
+  F_PAYLOADS = 80,        // blist
+  F_WORK_TYPES = 81,      // list
+  F_PRIOS = 82,           // list
+  F_ANSWER_RANKS = 83,    // list
+  F_TIMES_ON_Q = 84,      // flist
 };
 
-enum Kind : uint8_t { KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3 };
+enum Kind : uint8_t {
+  KIND_I64 = 0, KIND_BYTES = 1, KIND_LIST = 2, KIND_F64 = 3,
+  KIND_BLIST = 4,  // list of byte strings: u16 count, (u32 len + bytes)*
+  KIND_FLIST = 5,  // list of f64: u16 count, f64*
+};
 
 struct FieldVal {
   uint8_t kind = KIND_I64;
@@ -243,6 +255,8 @@ struct FieldVal {
   double d = 0.0;
   std::string b;
   std::vector<int64_t> l;
+  std::vector<std::string> bl;
+  std::vector<double> fl;
 };
 
 struct NMsg {
@@ -289,6 +303,18 @@ struct NMsg {
     FieldVal& fv = f[id];
     fv.kind = KIND_LIST;
     fv.l = std::move(v);
+    return *this;
+  }
+  NMsg& setbl(uint8_t id, std::vector<std::string> v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_BLIST;
+    fv.bl = std::move(v);
+    return *this;
+  }
+  NMsg& setfl(uint8_t id, std::vector<double> v) {
+    FieldVal& fv = f[id];
+    fv.kind = KIND_FLIST;
+    fv.fl = std::move(v);
     return *this;
   }
 };
@@ -342,6 +368,21 @@ std::string encode(const NMsg& m) {
         put_u16(out, uint16_t(kv.second.l.size()));
         for (int64_t x : kv.second.l) put_i64(out, x);
         break;
+      case KIND_BLIST:
+        if (kv.second.bl.size() > 65535)
+          die("blist field %u overflows the u16 codec bound", kv.first);
+        put_u16(out, uint16_t(kv.second.bl.size()));
+        for (const std::string& b : kv.second.bl) {
+          put_u32(out, uint32_t(b.size()));
+          out.append(b);
+        }
+        break;
+      case KIND_FLIST:
+        if (kv.second.fl.size() > 65535)
+          die("flist field %u overflows the u16 codec bound", kv.first);
+        put_u16(out, uint16_t(kv.second.fl.size()));
+        for (double x : kv.second.fl) put_f64(out, x);
+        break;
     }
   }
   return out;
@@ -390,6 +431,31 @@ NMsg decode(const std::string& body) {
         fv.l.resize(cnt);
         for (uint16_t j = 0; j < cnt; ++j) {
           std::memcpy(&fv.l[j], body.data() + off, 8); off += 8;
+        }
+        break;
+      }
+      case KIND_BLIST: {
+        need(2);
+        uint16_t cnt;
+        std::memcpy(&cnt, body.data() + off, 2); off += 2;
+        fv.bl.reserve(cnt);
+        for (uint16_t j = 0; j < cnt; ++j) {
+          need(4);
+          uint32_t n;
+          std::memcpy(&n, body.data() + off, 4); off += 4;
+          need(n);
+          fv.bl.emplace_back(body.data() + off, n); off += n;
+        }
+        break;
+      }
+      case KIND_FLIST: {
+        need(2);
+        uint16_t cnt;
+        std::memcpy(&cnt, body.data() + off, 2); off += 2;
+        need(size_t(cnt) * 8);
+        fv.fl.resize(cnt);
+        for (uint16_t j = 0; j < cnt; ++j) {
+          std::memcpy(&fv.fl[j], body.data() + off, 8); off += 8;
         }
         break;
       }
@@ -865,6 +931,32 @@ class Server {
                       meta.common_seqno});
     r.seti(F_WORK_LEN, u.payload_len + meta.common_len);
     r.seti(F_ANSWER_RANK, meta.answer_rank);
+    ep_->send(app, r);
+  }
+
+  void reserve_resp_batch(int app, const std::vector<int64_t>& seqnos) {
+    resolved_ctr_ += int64_t(seqnos.size());
+    double now = monotonic();
+    std::vector<std::string> payloads;
+    std::vector<int64_t> wtypes, prios, answers;
+    std::vector<double> times;
+    payloads.reserve(seqnos.size());
+    for (int64_t sq : seqnos) {
+      const adlbwq::Unit& u = wq_.units.at(sq);
+      wtypes.push_back(u.work_type);
+      prios.push_back(u.prio);
+      Meta m2 = consume_unit(sq);
+      answers.push_back(m2.answer_rank);
+      times.push_back(now - m2.time_stamp);
+      payloads.push_back(std::move(m2.payload));
+    }
+    NMsg r = mk(T_TA_RESERVE_RESP);
+    r.seti(F_RC, ADLB_SUCCESS);
+    r.setbl(F_PAYLOADS, std::move(payloads));
+    r.setl(F_WORK_TYPES, std::move(wtypes));
+    r.setl(F_PRIOS, std::move(prios));
+    r.setl(F_ANSWER_RANKS, std::move(answers));
+    r.setfl(F_TIMES_ON_Q, std::move(times));
     ep_->send(app, r);
   }
 
@@ -1441,6 +1533,27 @@ class Server {
       wq_.units[seqno].pin_rank = app;
       activity_ += 1;
       reserve_immed_ctr_ += 1;
+      // clamp: a batch is bounded by the u16 element counts of the
+      // codec's list kinds — an unclamped client value could push
+      // encode() into its overflow guard and abort the daemon
+      int64_t fetch_max = m.geti(F_FETCH_MAX, 1);
+      if (fetch_max > 4096) fetch_max = 4096;
+      if (e.fetch && fetch_max > 1 && meta_[seqno].common_len == 0) {
+        // batched fused fetch: pop up to fetch_max local prefix-free
+        // matches into ONE response (mirrors the Python server's
+        // _reserve_resp_batch) — only locally pre-positioned inventory
+        // can batch, so the balancer's locality is what amortizes the
+        // consumer's round trips
+        std::vector<int64_t> seqnos{seqno};
+        while (int64_t(seqnos.size()) < fetch_max) {
+          const adlbwq::Unit* extra = wq_find_match(app, e);
+          if (extra == nullptr || meta_[extra->seqno].common_len != 0) break;
+          wq_.units[extra->seqno].pin_rank = app;
+          seqnos.push_back(extra->seqno);
+        }
+        reserve_resp_batch(app, seqnos);
+        return;
+      }
       reserve_resp_ok(app, wq_.units[seqno], meta_[seqno], rank_, e.fetch);
       return;
     }

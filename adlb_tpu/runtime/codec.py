@@ -105,6 +105,8 @@ _KIND_I64 = 0
 _KIND_BYTES = 1
 _KIND_LIST = 2
 _KIND_F64 = 3
+_KIND_BLIST = 4  # list of byte strings: u16 count, (u32 len + bytes)*
+_KIND_FLIST = 5  # list of f64: u16 count, f64*
 
 # field name -> (wire id, kind)
 FIELDS: dict[str, tuple[int, int]] = {
@@ -188,12 +190,16 @@ FIELDS: dict[str, tuple[int, int]] = {
     "mig_id": (77, _KIND_I64),
     "mig_acks": (78, _KIND_LIST),
     # batched fused fetch (get_work_batch): how many local prefix-free
-    # units one TA_RESERVE_RESP may carry. Servers that predate the field
-    # (or the native daemon) ignore it and answer single-unit fused — the
-    # client handles either shape. The batch RESPONSE fields (payloads,
-    # parallel metadata lists) exist only on the in-proc/pickle paths;
-    # binary peers always get the single-unit shape.
+    # units one TA_RESERVE_RESP may carry, plus the batch RESPONSE's
+    # parallel per-unit fields — payloads with the per-unit metadata in
+    # matching order. A server that predates the request field ignores
+    # it and answers single-unit fused; the client handles either shape.
     "fetch_max": (79, _KIND_I64),
+    "payloads": (80, _KIND_BLIST),
+    "work_types": (81, _KIND_LIST),
+    "prios": (82, _KIND_LIST),
+    "answer_ranks": (83, _KIND_LIST),
+    "times_on_q": (84, _KIND_FLIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 
@@ -229,8 +235,24 @@ def encode_binary(m: Msg) -> bytes:
             out.append(b)
         elif kind == _KIND_LIST:
             seq = [int(x) for x in value]
+            if len(seq) > 65535:
+                raise ValueError(f"list field {name} overflows u16 bound")
             out.append(_U16.pack(len(seq)))
             out.extend(_I64.pack(x) for x in seq)
+        elif kind == _KIND_BLIST:
+            if len(value) > 65535:
+                raise ValueError(f"blist field {name} overflows u16 bound")
+            out.append(_U16.pack(len(value)))
+            for item in value:
+                b = bytes(item)
+                out.append(_U32.pack(len(b)))
+                out.append(b)
+        elif kind == _KIND_FLIST:
+            seq = [float(x) for x in value]
+            if len(seq) > 65535:
+                raise ValueError(f"flist field {name} overflows u16 bound")
+            out.append(_U16.pack(len(seq)))
+            out.extend(_F64.pack(x) for x in seq)
         else:
             out.append(_F64.pack(float(value)))
     return b"".join(out)
@@ -264,6 +286,22 @@ def decode_binary(body: bytes) -> Msg:
         elif kind == _KIND_F64:
             (value,) = _F64.unpack_from(body, off)
             off += 8
+        elif kind == _KIND_BLIST:
+            (cnt,) = _U16.unpack_from(body, off)
+            off += 2
+            value = []
+            for _i in range(cnt):
+                (n,) = _U32.unpack_from(body, off)
+                off += 4
+                value.append(body[off:off + n])
+                off += n
+        elif kind == _KIND_FLIST:
+            (cnt,) = _U16.unpack_from(body, off)
+            off += 2
+            value = [
+                _F64.unpack_from(body, off + 8 * i)[0] for i in range(cnt)
+            ]
+            off += 8 * cnt
         else:
             raise ValueError(f"bad field kind {kind}")
         entry = FIELD_FOR_WIRE.get(fid)

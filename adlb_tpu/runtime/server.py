@@ -528,8 +528,8 @@ class Server:
 
     def _reserve_resp_batch(self, app_rank: int, units: list) -> None:
         """One TA_RESERVE_RESP carrying several consumed local units
-        (get_work_batch). In-proc/pickle transports only — the binary
-        codec has no parallel-list response fields."""
+        (get_work_batch); the binary codec carries the parallel per-unit
+        fields as blist/list/flist kinds (codec.py ids 80-84)."""
         now = time.monotonic()
         self.resolved_reserves += len(units)
         for u in units:
@@ -864,18 +864,15 @@ class Server:
             self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION)
             return
         fetch = bool(m.data.get("fetch", False))
-        fetch_max = int(m.data.get("fetch_max", 1) or 1)
+        # clamped: the codec's list element counts are u16, and an
+        # unclamped value would make the batch frame unencodable
+        fetch_max = min(int(m.data.get("fetch_max", 1) or 1), 4096)
         unit = self.wq.find_match(app, req_types)
         if unit is not None:
             self.wq.pin(unit.seqno, app)
             self.activity += 1
             self._n_reserve_immed += 1
-            if (
-                fetch
-                and fetch_max > 1
-                and unit.common_len == 0
-                and app not in getattr(self.ep, "binary_peers", ())
-            ):
+            if fetch and fetch_max > 1 and unit.common_len == 0:
                 # batched fused fetch: pop up to fetch_max local prefix-free
                 # matches into ONE response — the consumer loop's round
                 # trips amortize over the batch, and only locally-positioned

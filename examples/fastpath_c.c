@@ -34,7 +34,12 @@ int main(void) {
 
   if (me == 0) {
     for (int i = 1; i <= NJOBS; i++) {
-      rc = ADLB_Iput(&i, sizeof i, -1, -1, WORK, i % 5);
+      /* the first four are TARGETED at rank 0: nobody else can take
+       * them, so rank 0's first Get_work_batch after the flush is
+       * guaranteed a multi-unit batch (the 'multi' check below would
+       * otherwise be timing-dependent on loaded hosts) */
+      int tgt = i <= 4 ? 0 : -1;
+      rc = ADLB_Iput(&i, sizeof i, tgt, -1, WORK, i % 5);
       if (rc != ADLB_SUCCESS) return 3;
     }
     rc = ADLB_Flush_puts();
@@ -46,16 +51,22 @@ int main(void) {
 
   long sum = 0;
   int n = 0;
+  int multi = 0; /* at least one multi-unit batch expected somewhere */
   for (;;) {
     int req[2] = {WORK, ADLB_RESERVE_EOL};
-    int wt, wp, wl, ar, v;
-    rc = ADLB_Get_work(req, &wt, &wp, &v, sizeof v, &wl, &ar);
+    int vs[4], wts[4], wps[4], wls[4], ars[4], ngot = 0;
+    rc = ADLB_Get_work_batch(req, 4, &ngot, wts, wps, vs, sizeof vs[0],
+                             wls, ars);
     if (rc != ADLB_SUCCESS) break; /* exhaustion */
-    if (wt != WORK || wl != sizeof v) return 5;
-    sum += v;
-    n++;
+    if (ngot < 1 || ngot > 4) return 6;
+    if (ngot > 1) multi = 1;
+    for (int k = 0; k < ngot; k++) {
+      if (wts[k] != WORK || wls[k] != (int)sizeof vs[0]) return 5;
+      sum += vs[k];
+      n++;
+    }
   }
-  printf("fastpath rank %d got %d sum %ld\n", me, n, sum);
+  printf("fastpath rank %d got %d sum %ld multi %d\n", me, n, sum, multi);
   ADLB_Finalize();
   return 0;
 }

@@ -77,7 +77,12 @@ int main(void) {
      * throughput. Consumption uses the fused ADLB_Get_work (one round
      * trip when the unit is LOCAL to the home server): both modes issue
      * the identical call, so the mode that pre-positions work locally
-     * is paid for that locality — the quantity this scenario measures */
+     * is paid for that locality — the quantity this scenario measures.
+     * (The batched ADLB_Get_work_batch exists and wins on the in-proc
+     * plane; under the sidecar pump at 64+ ranks its lumpier
+     * consumption interacts with refill cadence draw-dependently on
+     * this one-core host, so the benchmark keeps the single-unit call —
+     * see BASELINE.md.) */
     char buf[8];
     double r0 = mono();
     rc = ADLB_Get_work(req, &wt, &wp, buf, (int)sizeof buf, &wl, &ar);

@@ -52,13 +52,14 @@ def main() -> None:
                     c = Config(balancer="steal", qmstat_mode="ring",
                                qmstat_interval=0.1)
                 else:
-                    # K=512: the planner only needs the top of each queue
-                    # to match + migrate; a 4096-deep snapshot is a fat
-                    # frame the Python sidecar pays to decode on every
-                    # heartbeat. solver_host_threshold high: this sidecar
-                    # has no local accelerator, so every solve belongs on
-                    # the numpy path.
-                    c = Config(balancer="tpu", balancer_max_tasks=512,
+                    # K=2048 (matching bench.py's native rows): the hot
+                    # queue runs ~2k deep and the fair-share pump needs
+                    # the real total — a 512-cap snapshot understates the
+                    # pool and distorts shares (measured: 16r tpu draws
+                    # sag 5-15% under K=512). solver_host_threshold high:
+                    # this sidecar has no local accelerator, so every
+                    # solve belongs on the numpy path.
+                    c = Config(balancer="tpu", balancer_max_tasks=2048,
                                balancer_max_requesters=256,
                                solver_host_threshold=10**6)
                 for attempt in (0, 1):

@@ -47,8 +47,9 @@ def test_capi_smoke(mode):
 
 @pytest.mark.parametrize("server_impl", ["python", "native"])
 def test_capi_fastpaths(server_impl):
-    """ADLB_Iput/Flush_puts + ADLB_Get_work against both server
-    implementations: all 40 units consumed exactly once (sum check)."""
+    """ADLB_Iput/Flush_puts + ADLB_Get_work_batch against both server
+    implementations: all 40 units consumed exactly once (sum check),
+    with at least one multi-unit batch observed somewhere."""
     exe = build_example(os.path.join(_EXAMPLES, "fastpath_c.c"))
     results, _ = run_native_world(
         n_clients=3,
@@ -58,14 +59,16 @@ def test_capi_fastpaths(server_impl):
         cfg=Config(server_impl=server_impl, exhaust_check_interval=0.2),
         timeout=90.0,
     )
-    total_n, total_sum = 0, 0
+    total_n, total_sum, any_multi = 0, 0, 0
     for rc, out, err in results:
         assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
         parts = out.split()
         total_n += int(parts[parts.index("got") + 1])
         total_sum += int(parts[parts.index("sum") + 1])
+        any_multi += int(parts[parts.index("multi") + 1])
     assert total_n == 40
     assert total_sum == sum(range(1, 41))
+    assert any_multi > 0  # the producer runs ahead: batches must form
 
 
 @pytest.mark.parametrize("server_impl", ["python", "native"])

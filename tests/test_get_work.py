@@ -72,7 +72,12 @@ def test_get_work_falls_back_for_common_prefix():
 def _pc_batch(ctx):
     if ctx.rank == 0:
         for i in range(60):
-            ctx.iput(struct.pack("<q", i), T, work_prio=i % 5)
+            # the first four are TARGETED at rank 0: nobody else can take
+            # them, so rank 0's first post-flush batch is deterministically
+            # multi-unit (the saw_multi check is otherwise timing-dependent)
+            tgt = 0 if i < 4 else -1
+            ctx.iput(struct.pack("<q", i), T, work_prio=i % 5,
+                     target_rank=tgt)
         ctx.flush_puts()
     got = []
     saw_multi = 0
@@ -100,13 +105,15 @@ def test_get_work_batch_conservation(mode):
     assert sum(v[1] for v in res.app_results.values()) > 0
 
 
-def test_get_work_batch_native_servers_single_fallback():
-    """A native daemon ignores fetch_max (no batch response fields in the
-    binary codec) and answers single-unit fused; the client must cope."""
+def test_get_work_batch_native_servers():
+    """Native daemons speak the batch response too (blist/flist TLV
+    kinds): every unit delivered exactly once, with multi-unit batches
+    observed when local inventory runs deep."""
     cfg = Config(server_impl="native", exhaust_check_interval=0.2)
     res = spawn_world(4, 2, [T], _pc_batch, cfg=cfg, timeout=90.0)
     got = sorted(x for v in res.app_results.values() for x in (v or [[]])[0])
     assert got == list(range(60))
+    assert sum(v[1] for v in res.app_results.values() if v) > 0
 
 
 def test_get_work_batch_common_prefix_falls_back():
