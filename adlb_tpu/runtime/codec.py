@@ -274,6 +274,8 @@ def decode_binary(body: bytes) -> Msg:
         elif kind == _KIND_BYTES:
             (n,) = _U32.unpack_from(body, off)
             off += 4
+            if off + n > len(body):
+                raise ValueError("truncated bytes field in binary frame")
             value = body[off:off + n]
             off += n
         elif kind == _KIND_LIST:
@@ -293,6 +295,8 @@ def decode_binary(body: bytes) -> Msg:
             for _i in range(cnt):
                 (n,) = _U32.unpack_from(body, off)
                 off += 4
+                if off + n > len(body):
+                    raise ValueError("truncated blist item in binary frame")
                 value.append(body[off:off + n])
                 off += n
         elif kind == _KIND_FLIST:

@@ -1457,9 +1457,14 @@ class Server:
             # the migration-batch acks must stay consistent with the TASK
             # view they ride with: acking a landed batch against a stale
             # task list would clear the credit before the units are
-            # visible, re-creating the phantom-top-up chain
-            if prev is not None:
-                snap["mig_acks"] = prev.get("mig_acks")
+            # visible, re-creating the phantom-top-up chain. When there
+            # is NO previous task view at all (first-ever snapshot from
+            # this rank is reqs-only), fresh acks would pair with the
+            # empty default view above — drop them so the engine falls
+            # back to stamp-based clearing until a full view arrives.
+            snap["mig_acks"] = (
+                prev.get("mig_acks") if prev is not None else None
+            )
         else:
             snap["task_stamp"] = snap["stamp"]
         self._snapshots[src] = snap

@@ -26,6 +26,10 @@ from adlb_tpu.runtime.messages import Msg, Tag
 
 _HDR = struct.Struct("<I")
 
+# staggers the rendezvous-port probe start for successive worlds created
+# by the same process (see local_addr_map)
+_PORT_PROBE_CALLS = 0
+
 
 class TcpEndpoint:
     """One rank's endpoint: an acceptor thread feeding an inbox, plus lazily
@@ -218,11 +222,13 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
     storm an OUTBOUND connection's ephemeral port can otherwise land on a
     rank's not-yet-bound listener port — that rank then dies on bind and
     the failure-detection abort takes the whole world with it (observed
-    at 64-128 ranks as a few-percent flake). A random start keeps
-    concurrent worlds off each other; the bind check skips ports someone
-    already holds.
+    at 64-128 ranks as a few-percent flake). The probe start is derived
+    from the PID (plus a per-process call counter), so concurrent
+    worlds — distinct processes by construction — probe well-separated
+    subranges instead of relying on lucky random draws; the bind check
+    still skips any port someone else actually holds.
     """
-    import random
+    import os
 
     # the actual ephemeral floor is tunable; read it so the guarantee
     # holds on hosts with a lowered range (fall back to the Linux default)
@@ -252,12 +258,23 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
     hi = floor - 100
     addr_map = {}
     socks = []
-    port = random.randrange(lo, hi - 2 * nranks)
+    span = hi - lo
+    global _PORT_PROBE_CALLS
+    # Knuth-hash the PID so adjacent PIDs (concurrently spawned worlds)
+    # land far apart in the range; successive worlds from the SAME
+    # process are staggered by the call counter
+    start = lo + (os.getpid() * 40503 + _PORT_PROBE_CALLS * 1013) % span
+    _PORT_PROBE_CALLS += 1
+    port = start
+    probed = 0
     r = 0
     while r < nranks:
         port += 1
         if port >= hi:
-            raise OSError(f"no free rendezvous ports below {hi}")
+            port = lo  # wrap: free ports below the start stay usable
+        probed += 1
+        if probed > span:
+            raise OSError(f"no free rendezvous ports in [{lo},{hi})")
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:

@@ -8,8 +8,13 @@ cross-server balancing strategies implemented by this framework:
 * tpu — the periodic batched global assignment solve in JAX (the north-star
   architecture from BASELINE.json).
 
-Prints ONE JSON line: value = TPU-mode nq tasks/sec, vs_baseline = ratio of
-TPU-mode to steal-mode tasks/sec on the identical workload.
+Output contract (round 4): the FULL detail record is printed first for
+human auditing, then a COMPACT headline record is printed as the FINAL
+stdout line. The driver keeps only the last ~2000 chars of output, so
+the final line is guaranteed to fit and parse (round 3's grown detail
+line truncated to garbage — BENCH_r03.json "parsed": null). The compact
+line carries every headline field plus per-rep spreads so the claims
+are auditable from the driver's record alone.
 """
 
 import json
@@ -520,7 +525,79 @@ def main() -> None:
             "tpu_pops_per_sec": round(lat_tpu.pops_per_sec, 1),
         },
     }
+    # full record first (audit trail for humans / in-tree rehearsal logs)
     print(json.dumps(result))
+
+    # ... then the COMPACT headline as the FINAL line: the only line the
+    # driver's 2000-char tail is guaranteed to keep intact. Headline
+    # fields + per-rep spreads; short keys; no whitespace.
+    def rr(vals, nd=0):
+        return [round(v, nd) if nd else int(round(v)) for v in vals]
+
+    rates = lambda runs: [r.tasks_per_sec for r in runs]  # noqa: E731
+    idles = lambda runs: [r.idle_pct for r in runs]  # noqa: E731
+    compact = {
+        "metric": "hotspot_tasks_per_sec_tpu_balancer",
+        "value": round(hot_tpu.tasks_per_sec, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(
+            hot_tpu.tasks_per_sec / hot_steal.tasks_per_sec, 3)
+        if hot_steal.tasks_per_sec else 0.0,
+        "detail": {
+            "idle_steal": round(steal_idle_med, 1),
+            "idle_tpu": round(tpu_idle_med, 1),
+            "idle_ratio": round(tpu_idle_med / steal_idle_med, 3)
+            if steal_idle_med else 0.0,
+            "classic_ratio": round(
+                hcl_tpu.tasks_per_sec / hcl_steal.tasks_per_sec, 3)
+            if hcl_steal.tasks_per_sec else 0.0,
+            "classic_idle_ratio": round(hcl_tpu_idle / hcl_steal_idle, 3)
+            if hcl_steal_idle else 0.0,
+            "nq": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
+            if steal.tasks_per_sec else 0.0,
+            "tsp": round(tsp_tpu / tsp_steal, 3) if tsp_steal else 0.0,
+            "sudoku": round(sudoku_tpu / sudoku_steal, 3)
+            if sudoku_steal else 0.0,
+            "gfmc": round(gfmc_tpu / gfmc_steal, 3) if gfmc_steal else 0.0,
+            "n16_ratio": native_rows.get("native_16r_ratio"),
+            "n64_ratio": native_rows.get("native_64r_ratio"),
+            "n16_wait": [native_rows.get("native_16r_steal_wait_pct"),
+                         native_rows.get("native_16r_tpu_wait_pct")],
+            "n64_wait": [native_rows.get("native_64r_steal_wait_pct"),
+                         native_rows.get("native_64r_tpu_wait_pct")],
+            "disp_p50": [round(tric_steal.dispatch_p50_ms, 2),
+                         round(tric_tpu.dispatch_p50_ms, 2)],
+            "ndisp_p50": [native_rows.get("native_trickle_p50_ms_steal"),
+                          native_rows.get("native_trickle_p50_ms_tpu")],
+            # per-rep spreads: every headline claim auditable from this
+            # record alone (steal first, tpu second in each pair)
+            "reps": {
+                "hot_s": rr(rates(hot_runs["steal"])),
+                "hot_t": rr(rates(hot_runs["tpu"])),
+                "hotidle_s": rr(idles(hot_runs["steal"]), 1),
+                "hotidle_t": rr(idles(hot_runs["tpu"]), 1),
+                "cls_s": rr(rates(hcl_runs["steal"])),
+                "cls_t": rr(rates(hcl_runs["tpu"])),
+                "clsidle_s": rr(idles(hcl_runs["steal"]), 1),
+                "clsidle_t": rr(idles(hcl_runs["tpu"]), 1),
+                "nq_s": rr(rates(nq_runs["steal"])),
+                "nq_t": rr(rates(nq_runs["tpu"])),
+                "tsp_s": rr(t / s for t, s in tsp_runs["steal"]),
+                "tsp_t": rr(t / s for t, s in tsp_runs["tpu"]),
+                "sud_s": rr(t / s for t, s in sudoku_runs["steal"]),
+                "sud_t": rr(t / s for t, s in sudoku_runs["tpu"]),
+                "gfmc_s": rr(t / s for t, s in gfmc_runs["steal"]),
+                "gfmc_t": rr(t / s for t, s in gfmc_runs["tpu"]),
+            },
+        },
+    }
+    if "native_error" in native_rows:
+        compact["detail"]["native_error"] = native_rows["native_error"][:120]
+    line = json.dumps(compact, separators=(",", ":"))
+    if len(line) > 1900:  # belt-and-braces: the tail window is ~2000
+        compact["detail"].pop("reps", None)
+        line = json.dumps(compact, separators=(",", ":"))
+    print(line)
 
 
 if __name__ == "__main__":
