@@ -156,6 +156,11 @@ class PlanEngine:
         self._look: dict[int, float] = {}
         self._look_last: dict[int, float] = {}
         self._last_pump = -1e9
+        # rank -> last time a snapshot showed a requester actually parked
+        # there (RAW reqs, not the ledger-filtered view) — the measured
+        # "workers waited here recently" signal the anticipatory pump is
+        # gated on (see _plan_migrations)
+        self._last_parked: dict[int, float] = {}
 
     def force_host_path(self) -> None:
         """After a device/backend failure: keep planning on numpy — for the
@@ -229,6 +234,12 @@ class PlanEngine:
         freqs = {}
         for rank, snap in snapshots.items():
             stamp = snap.get("stamp", now)
+            if snap["reqs"]:
+                # stamped with the SNAPSHOT's capture time, not now: the
+                # master re-reads the same snapshot every round, and a
+                # satisfied park must age out, not stay forever "recent"
+                if stamp > self._last_parked.get(rank, -1e9):
+                    self._last_parked[rank] = stamp
             # suppression budget: only YOUNG credits (a lost batch must
             # not block per-unit matching for the whole 2 s TTL — it
             # stops suppressing after SUPPRESS_TTL and the solve takes
@@ -274,7 +285,9 @@ class PlanEngine:
         # worlds, stolen from the workers on a shared core). Match-bearing
         # rounds (cross demand) are never delayed.
         pump_due = now - self._last_pump >= self.PUMP_INTERVAL
-        if not cross and not (pump_due and self._maybe_imbalanced(snapshots)):
+        if not cross and not (
+            pump_due and self._maybe_imbalanced(snapshots, now)
+        ):
             return [], []  # nothing plannable: skip the task-ledger walk
         if pump_due:
             self._last_pump = now
@@ -310,7 +323,8 @@ class PlanEngine:
         migrations = []
         if pump_due:
             migrations = self._plan_migrations(
-                snapshots, filtered, planned_away, t_planned, matched_reqs
+                snapshots, filtered, planned_away, t_planned, matched_reqs,
+                now=now,
             )
         if matches or migrations:
             involved = (
@@ -397,9 +411,13 @@ class PlanEngine:
     # transit).
     INFLOW_MIN_AGE = 0.05
     # minimum spacing of fair-share pump rounds (see round()); starved
-    # destinations wait at most this long for their first batch, far under
-    # a batch's own transit+enactment time
-    PUMP_INTERVAL = 0.01
+    # destinations wait at most this long for their first batch, well
+    # under a batch's own transit+enactment time. 3 ms (round 4, down
+    # from 10): the pump walk only runs when the cheap _maybe_imbalanced
+    # pre-check passes, so the spacing is pure latency for destinations
+    # that measurably wait — mid-run drain imbalances parked whole
+    # worker pools for the old interval at a time.
+    PUMP_INTERVAL = 0.003
     # in-flight credits older than this stop suppressing the solve for
     # their destination's requesters (the batch is probably lost; the TTL
     # keeps it counted as pump inflow a while longer, but workers must
@@ -410,6 +428,15 @@ class PlanEngine:
     # available pool; hotspot's single-source backlog holds ~everything,
     # while balanced economies' transient bursts rarely clear it
     CONC_FRAC = 0.5
+    # Anticipatory (non-starved) top-ups are gated on MEASURED recent
+    # waiting: a destination qualifies only if some requester was
+    # actually parked there within this window. Hotspot's destinations
+    # park hard (startup, between-batch dips) and keep their feed;
+    # sudoku's mid-compute queue dips never park a worker, so the
+    # oscillation the pump would pre-position against re-balances
+    # itself and the moves are saved (round-3 instrumentation: ~10% of
+    # the economy migrated in moves nobody waited for).
+    PARK_RECENT = 0.5
 
     def _window(self, rank: int) -> float:
         return self._look.get(rank, float(self.LOOKAHEAD))
@@ -439,11 +466,15 @@ class PlanEngine:
             self._look[rank] = max(float(self.LOOKAHEAD), look / 2.0)
         self._look_last[rank] = now
 
-    def _maybe_imbalanced(self, snaps: dict) -> bool:
+    def _maybe_imbalanced(self, snaps: dict, now: float) -> bool:
         """Cheap pre-check (raw snapshot counts, no ledger filtering) for
         whether fair-share migration planning could possibly trigger; the
         exact check re-runs on filtered inventory. Errs a round late on
-        ledger-heavy edges, which the next fresh snapshot corrects."""
+        ledger-heavy edges, which the next fresh snapshot corrects.
+        Mirrors the PARK_RECENT gate: a destination nobody waited at
+        recently can only qualify through the starved path (empty with a
+        parked requester), so balanced economies whose queues merely
+        oscillate skip the pump's task-ledger walk entirely."""
         consumers = {
             r: snaps[r].get("consumers", 0) for r in snaps
         }
@@ -453,16 +484,30 @@ class PlanEngine:
         raw = {r: len(snaps[r]["tasks"]) for r in snaps}
         total = sum(raw.values())
         if total < total_c:
-            return False  # scarcity: matches handle it (see below)
-        return any(
-            c > 0
-            and 2 * raw[r] < self._need(-(-total * c // total_c), c, r)
-            for r, c in consumers.items()
-        )
+            # scarcity: matches handle it (see below) — unless the
+            # scarce supply is one server's opening burst and starved
+            # parked destinations are waiting on it
+            if total == 0 or max(raw.values()) <= self.CONC_FRAC * total:
+                return False
+            return any(
+                c > 0 and raw[r] == 0 and snaps[r].get("reqs")
+                for r, c in consumers.items()
+            )
+        for r, c in consumers.items():
+            if c <= 0:
+                continue
+            if now - self._last_parked.get(r, -1e9) <= self.PARK_RECENT:
+                sh = -(-total * c // total_c)
+                if 2 * raw[r] < self._need(sh, c, r):
+                    return True
+            elif raw[r] == 0 and snaps[r].get("reqs"):
+                return True  # starved-path candidate
+        return False
 
     def _plan_migrations(
         self, snaps: dict, filtered: dict, planned_away: dict,
         t_planned: float, matched_reqs: Optional[set] = None,
+        now: Optional[float] = None,
     ):
         """Fair-share inventory placement (see module docstring)."""
         inv: dict[int, list] = {}
@@ -512,8 +557,19 @@ class PlanEngine:
         # more directly than a migrate round-trip — and scarce pools are
         # exactly where migrate churn (a unit bouncing between servers,
         # briefly unavailable each hop) hurts most (gfmc's shallow
-        # answer-economy queues).
-        if total_avail < total_consumers:
+        # answer-economy queues). EXCEPT when the scarce supply is
+        # CONCENTRATED on one server (a producer's opening burst): then
+        # every match is a per-unit fetch against the one hot reactor
+        # that is also absorbing the put stream, and distributing what
+        # little is visible starts workers on LOCAL fetches immediately
+        # (the round-4 startup-fill fix). Scarce+concentrated admits only
+        # the starved path below — anticipatory top-ups stay off.
+        scarce = total_avail < total_consumers
+        concentrated = (
+            max((len(lst) for lst in inv.values()), default=0)
+            > self.CONC_FRAC * total_avail
+        )
+        if scarce and not concentrated:
             return []
 
         def share(r: int) -> int:
@@ -543,13 +599,18 @@ class PlanEngine:
         # condition (RAW reqs, not the ledger-filtered view), and evenly
         # spread pools (gfmc's round-robin inventory) fail the
         # concentration test — full-share moves there are churn nobody
-        # is waiting for.
-        concentrated = (
-            max((len(lst) for lst in inv.values()), default=0)
-            > self.CONC_FRAC * total_avail
-        )
+        # is waiting for. (``concentrated`` is computed alongside the
+        # scarcity gate above.)
         starved: set = set()
         deficits: dict[int, int] = {}
+        # recentness is judged at snapshot-READ time (round start), not
+        # t_planned: a slow solve (first compile) between the two must
+        # not age otherwise-fresh parks out of the window
+        t_ref = now if now is not None else t_planned
+        recent: dict[int, bool] = {
+            r: t_ref - self._last_parked.get(r, -1e9) <= self.PARK_RECENT
+            for r in consumers
+        }
         for r, c in consumers.items():
             if c <= 0:
                 continue
@@ -561,7 +622,11 @@ class PlanEngine:
             ):
                 starved.add(r)
                 deficits[r] = sh
-            else:
+            elif recent[r] and not scarce:
+                # anticipatory placement only where workers measurably
+                # waited within PARK_RECENT (see the constant's comment),
+                # and never under scarcity (scarce+concentrated admits
+                # only the starved path above)
                 need = self._need(sh, c, r)
                 if 2 * have < need:
                     deficits[r] = need - have
@@ -624,8 +689,13 @@ class PlanEngine:
                 )
                 self._look_last[dest] = t_planned
             else:
-                self._touch_window(
-                    dest, t_planned,
-                    grow_ok=bool(snaps.get(dest, {}).get("reqs")),
-                )
+                # growth keyed on RECENT parking, not currently-parked:
+                # a well-timed anticipatory top-up prevents the park it
+                # exists to prevent, which under the old
+                # currently-parked test made success decay the window
+                # (smaller batches -> more dips). A destination whose
+                # workers waited within PARK_RECENT keeps earning
+                # growth; one that never waits decays to the floor and
+                # (per the deficit gate above) stops being fed at all.
+                self._touch_window(dest, t_planned, grow_ok=recent[dest])
         return out

@@ -142,15 +142,22 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                 broadcast(tracker.update(m.src, snapshots[m.src]["reqs"]))
                 dirty = True
             elif m.tag is Tag.SS_STATE_DELTA:
-                # O(1) put-event: append one task to the sender's last full
+                # put-event: append task(s) to the sender's last full
                 # snapshot (stamp unchanged — requester re-eligibility only
-                # comes from full snapshots; see the server's merge)
+                # comes from full snapshots; see the server's merge).
+                # Batched shape (parallel lists) since round 4; the
+                # single-unit shape is kept for older daemons.
                 snap = snapshots.get(m.src)
                 if snap is not None:
-                    if len(snap["tasks"]) < cfg.balancer_max_tasks:
-                        snap["tasks"].append(
-                            (m.seqno, m.work_type, m.prio, m.work_len)
-                        )
+                    if m.data.get("seqnos") is not None:
+                        units = zip(m.seqnos, m.work_types, m.prios,
+                                    m.work_lens)
+                    else:
+                        units = [(m.seqno, m.work_type, m.prio, m.work_len)]
+                    for sq, wt, pr, ln in units:
+                        if len(snap["tasks"]) >= cfg.balancer_max_tasks:
+                            break
+                        snap["tasks"].append((sq, wt, pr, ln))
                     snap["nbytes"] = m.data.get("nbytes", snap["nbytes"])
                     dirty = True
             elif m.tag is Tag.DS_END:

@@ -241,6 +241,11 @@ enum FieldId : uint8_t {
   F_PRIOS = 82,           // list
   F_ANSWER_RANKS = 83,    // list
   F_TIMES_ON_Q = 84,      // flist
+  // batched SS_STATE_DELTA (round 4): parallel per-unit lists so a
+  // streaming producer's inventory reaches the balancer within one
+  // rate-limit gap instead of one unit per gap (codec.py id 85;
+  // F_SEQNOS/F_WORK_TYPES/F_PRIOS are shared with other messages)
+  F_WORK_LENS = 85,       // list
 };
 
 enum Kind : uint8_t {
@@ -765,6 +770,10 @@ class Server {
       periodic(now);
       double deadline = next_qmstat_;
       if (master_ && next_exhaust_ < deadline) deadline = next_exhaust_;
+      if (!pend_seqnos_.empty()) {
+        double d = last_event_snap_ + cfg_.balancer_min_gap;
+        if (d < deadline) deadline = d;  // pending delta flush is due
+      }
       NMsg m;
       bool got = ep_->recv(&m, std::max(deadline - monotonic(), 0.0));
       double t0 = monotonic();
@@ -1083,6 +1092,9 @@ class Server {
   }
 
   void periodic(double now) {
+    if (!pend_seqnos_.empty() &&
+        now - last_event_snap_ >= cfg_.balancer_min_gap)
+      flush_event_deltas(now);
     if (now >= next_qmstat_) {
       next_qmstat_ = cfg_.tpu_mode ? now + cfg_.balancer_interval
                                    : now + cfg_.qmstat_interval;
@@ -2486,20 +2498,43 @@ class Server {
   void maybe_event_delta(int64_t seqno, int32_t wtype, int32_t prio,
                          int64_t len) {
     if (!cfg_.tpu_mode || cfg_.balancer_rank < 0) return;
+    // accumulate; flush as ONE batched delta when the rate-limit gap
+    // elapses (round 4): without batching a producer streaming puts was
+    // visible to the balancer at one unit per gap — a lagging inventory
+    // view that kept the fair-share pump's scarcity gate closed while
+    // worker pools idled
+    pend_seqnos_.push_back(seqno);
+    pend_wtypes_.push_back(wtype);
+    pend_prios_.push_back(prio);
+    pend_lens_.push_back(len);
     double now = monotonic();
-    if (now - last_event_snap_ < cfg_.balancer_min_gap) return;
+    if (now - last_event_snap_ >= cfg_.balancer_min_gap)
+      flush_event_deltas(now);
+  }
+
+  void flush_event_deltas(double now) {
+    if (pend_seqnos_.empty()) return;
     last_event_snap_ = now;
     NMsg m = mk(T_SS_STATE_DELTA);
-    m.seti(F_SEQNO, seqno);
-    m.seti(F_WORK_TYPE, wtype);
-    m.seti(F_PRIO, prio);
-    m.seti(F_WORK_LEN, len);
+    m.setl(F_SEQNOS, std::move(pend_seqnos_));
+    m.setl(F_WORK_TYPES, std::move(pend_wtypes_));
+    m.setl(F_PRIOS, std::move(pend_prios_));
+    m.setl(F_WORK_LENS, std::move(pend_lens_));
     m.seti(F_NBYTES, mem_curr_);
     ep_->send(cfg_.balancer_rank, m);
+    pend_seqnos_.clear();
+    pend_wtypes_.clear();
+    pend_prios_.clear();
+    pend_lens_.clear();
   }
 
   void send_snapshot() {
     if (cfg_.balancer_rank < 0) return;
+    // the full walk supersedes pending put deltas (units are in the wq)
+    pend_seqnos_.clear();
+    pend_wtypes_.clear();
+    pend_prios_.clear();
+    pend_lens_.clear();
     // top-K unpinned untargeted by (prio desc, seqno asc)
     std::vector<const adlbwq::Unit*> avail;
     avail.reserve(wq_.units.size());
@@ -2795,6 +2830,8 @@ class Server {
   int64_t migrate_unacked_ = 0;
   std::vector<NMsg> held_ckpts_;  // tokens parked on in-flight migrations
   double last_event_snap_ = 0.0;
+  // put-event deltas pending behind the rate-limit gap (batched flush)
+  std::vector<int64_t> pend_seqnos_, pend_wtypes_, pend_prios_, pend_lens_;
   bool hungry_ = false;  // sidecar says: parked requesters exist somewhere
   bool hungry_any_ = false;  // ... and one of them accepts any type
   std::set<int32_t> hungry_types_;  // the types parked requesters want

@@ -200,6 +200,12 @@ FIELDS: dict[str, tuple[int, int]] = {
     "prios": (82, _KIND_LIST),
     "answer_ranks": (83, _KIND_LIST),
     "times_on_q": (84, _KIND_FLIST),
+    # batched SS_STATE_DELTA (round 4): puts arriving faster than
+    # balancer_min_gap accumulate and flush as ONE delta with parallel
+    # per-unit lists (seqnos/work_types/prios/work_lens), so the
+    # balancer's inventory view tracks a streaming producer within one
+    # gap instead of one unit per gap
+    "work_lens": (85, _KIND_LIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -68,7 +68,8 @@ class Tag(enum.Enum):
 
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()
-    SS_STATE_DELTA = enum.auto()  # one new task appended to last snapshot
+    SS_STATE_DELTA = enum.auto()  # new task(s) appended to last snapshot
+    # (single-unit fields, or batched parallel lists since round 4)
     SS_HUNGRY = enum.auto()  # master -> servers: parked requesters exist
     SS_PLAN_MATCH = enum.auto()
     SS_PLAN_MIGRATE = enum.auto()  # planner: move these units to dest

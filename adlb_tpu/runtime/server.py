@@ -148,7 +148,13 @@ class _BalancerWorker(threading.Thread):
                     mig_id=mig_id),
             )
         if s.cfg.balancer_min_gap > 0:
-            time.sleep(s.cfg.balancer_min_gap)
+            # rate-limit idle churn at the full gap, but keep the cadence
+            # up while plans are actually flowing (startup fill, end-game
+            # drain): a match-bearing round followed by a full-gap sleep
+            # adds the gap to every handoff's latency for nothing — the
+            # ledger suppression already prevents re-planning storms
+            gap = s.cfg.balancer_min_gap
+            time.sleep(gap * 0.25 if (matches or migrations) else gap)
 
 
 class _PeerState:
@@ -201,6 +207,13 @@ class Server:
         # it (per-source: transport ordering only holds per sender pair)
         self._mig_acks: dict[int, int] = {}
         self._last_event_snap = 0.0
+        # put-event task deltas accumulated while the min-gap rate limit
+        # holds; flushed as ONE batched SS_STATE_DELTA (parallel per-unit
+        # lists) the moment the gap elapses, so the balancer's inventory
+        # view tracks a streaming producer within one gap instead of one
+        # unit per gap (round 4 — the round-3 hotspot startup stall)
+        self._pending_delta: list[tuple[int, int, int, int]] = []
+        self._delta_deadline = float("inf")
 
         # termination state
         self.no_more_work = False
@@ -391,6 +404,7 @@ class Server:
             self._periodic(now, interval)
             deadline = min(
                 self._next_state_sync,
+                self._delta_deadline,
                 self._next_exhaust_check if self.is_master else now + 1.0,
                 self._next_ds_log
                 if self.world.use_debug_server
@@ -423,6 +437,8 @@ class Server:
             self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
 
     def _periodic(self, now: float, interval: float) -> None:
+        if self._pending_delta and now >= self._delta_deadline:
+            self._flush_task_deltas(now)
         if now >= self._next_state_sync:
             self._next_state_sync = now + interval
             if self.cfg.balancer == "tpu":
@@ -813,14 +829,13 @@ class Server:
             # ANOTHER server isn't left waiting for the next heartbeat.
             # Only untargeted puts of a type someone is parked for —
             # targeted puts match at the target's home server and never
-            # enter snapshots. An O(1) DELTA (just this unit's metadata),
-            # not the O(wq) snapshot walk: at put rates the walk is a
+            # enter snapshots. An O(1) DELTA (just unit metadata), not
+            # the O(wq) snapshot walk: at put rates the walk is a
             # measurable GIL tax (the full snapshot still flows on parks,
-            # hungry-transitions, and the heartbeat).
-            now = time.monotonic()
-            if now - self._last_event_snap >= self.cfg.balancer_min_gap:
-                self._last_event_snap = now
-                self._send_task_delta(unit)
+            # hungry-transitions, and the heartbeat). Units putting
+            # faster than the rate limit accumulate and flush as one
+            # batched delta (see _send_task_delta).
+            self._send_task_delta(unit)
 
     def _on_put_common(self, m: Msg) -> None:
         if not self.mem.try_alloc(len(m.payload)):
@@ -1394,6 +1409,10 @@ class Server:
         if reqs_only:
             tasks = None
         else:
+            # the full task walk supersedes any pending put deltas (the
+            # pending units are in the wq, so the walk carries them)
+            self._pending_delta.clear()
+            self._delta_deadline = float("inf")
             K = self.cfg.balancer_max_tasks
             snapshot_fast = getattr(self.wq, "snapshot_untargeted", None)
             if snapshot_fast is not None:
@@ -1472,43 +1491,74 @@ class Server:
         self._maybe_wake_balancer(src, snap)
 
     def _send_task_delta(self, unit) -> None:
-        """O(1) event path for new hungry-matched untargeted inventory: ship
-        just this unit's metadata; the receiver appends it to the sender's
-        last full snapshot. Consumed-but-still-listed units are already
-        tolerated (plan entries are hints validated at enactment), so a
-        delta between full refreshes adds no new race class."""
+        """Event path for new hungry-matched untargeted inventory: ship the
+        unit's metadata; the receiver appends it to the sender's last full
+        snapshot. Consumed-but-still-listed units are already tolerated
+        (plan entries are hints validated at enactment), so a delta
+        between full refreshes adds no new race class.
+
+        Units arriving faster than ``balancer_min_gap`` accumulate and
+        flush as ONE batched delta the moment the gap elapses: without
+        batching, a producer streaming puts at thousands/sec was visible
+        to the balancer at one unit per gap — a 30x-lagging inventory
+        view that kept the pump's scarcity gate closed while whole worker
+        pools idled (the round-3 hotspot startup stall)."""
         # len(payload), NOT unit.work_len (payload + common prefix): full
         # snapshots record payload bytes, and the planner's admission math
         # compares against payload-only memory accounting
         nlen = len(unit.payload)
         if self.is_master:
             self._merge_task_delta(
-                self.rank, unit.seqno, unit.work_type, unit.prio,
-                nlen, self.mem.curr,
+                self.rank, [unit.seqno], [unit.work_type], [unit.prio],
+                [nlen], self.mem.curr,
             )
+            return
+        self._pending_delta.append(
+            (unit.seqno, unit.work_type, unit.prio, nlen)
+        )
+        now = time.monotonic()
+        if now - self._last_event_snap >= self.cfg.balancer_min_gap:
+            self._flush_task_deltas(now)
         else:
-            self.ep.send(
-                self.world.master_server_rank,
-                msg(
-                    Tag.SS_STATE_DELTA,
-                    self.rank,
-                    seqno=unit.seqno,
-                    work_type=unit.work_type,
-                    prio=unit.prio,
-                    work_len=nlen,
-                    nbytes=self.mem.curr,
-                ),
+            # schedule the flush for when the gap elapses; the run loop's
+            # poll deadline honors it so a burst that STOPS inside the
+            # gap still reaches the balancer within one gap
+            self._delta_deadline = min(
+                self._delta_deadline,
+                self._last_event_snap + self.cfg.balancer_min_gap,
             )
 
+    def _flush_task_deltas(self, now: float) -> None:
+        self._delta_deadline = float("inf")
+        if not self._pending_delta:
+            return
+        seqnos, wtypes, prios, lens = zip(*self._pending_delta)
+        self._pending_delta.clear()
+        self._last_event_snap = now
+        self.ep.send(
+            self.world.master_server_rank,
+            msg(
+                Tag.SS_STATE_DELTA,
+                self.rank,
+                seqnos=list(seqnos),
+                work_types=list(wtypes),
+                prios=list(prios),
+                work_lens=list(lens),
+                nbytes=self.mem.curr,
+            ),
+        )
+
     def _merge_task_delta(
-        self, src: int, seqno: int, work_type: int, prio: int,
-        work_len: int, nbytes: int,
+        self, src: int, seqnos, work_types, prios, work_lens, nbytes: int,
     ) -> None:
         snap = self._snapshots.get(src)
         if snap is None:
             return  # no baseline yet; the next full snapshot delivers it
-        if len(snap["tasks"]) < self.cfg.balancer_max_tasks:
-            snap["tasks"].append((seqno, work_type, prio, work_len))
+        room = self.cfg.balancer_max_tasks - len(snap["tasks"])
+        for i in range(min(room, len(seqnos))):
+            snap["tasks"].append(
+                (seqnos[i], work_types[i], prios[i], work_lens[i])
+            )
         snap["nbytes"] = nbytes
         # NOTE: snap["stamp"] is NOT bumped — requester (re-)eligibility in
         # the plan ledger must only come from full snapshots that re-observe
@@ -1517,9 +1567,16 @@ class Server:
             self._balancer.wake.set()
 
     def _on_state_delta(self, m: Msg) -> None:
-        self._merge_task_delta(
-            m.src, m.seqno, m.work_type, m.prio, m.work_len, m.nbytes
-        )
+        if m.data.get("seqnos") is not None:  # batched (round 4+)
+            self._merge_task_delta(
+                m.src, m.seqnos, m.work_types, m.prios, m.work_lens,
+                m.nbytes,
+            )
+        else:  # single-unit shape (native daemons predating the batch)
+            self._merge_task_delta(
+                m.src, [m.seqno], [m.work_type], [m.prio], [m.work_len],
+                m.nbytes,
+            )
 
     def _on_state(self, m: Msg) -> None:
         # re-stamp on the master's clock: plan-ledger comparisons must never
