@@ -428,14 +428,15 @@ class PlanEngine:
     # available pool; hotspot's single-source backlog holds ~everything,
     # while balanced economies' transient bursts rarely clear it
     CONC_FRAC = 0.5
-    # Anticipatory (non-starved) top-ups are gated on MEASURED recent
-    # waiting: a destination qualifies only if some requester was
-    # actually parked there within this window. Hotspot's destinations
-    # park hard (startup, between-batch dips) and keep their feed;
-    # sudoku's mid-compute queue dips never park a worker, so the
-    # oscillation the pump would pre-position against re-balances
-    # itself and the moves are saved (round-3 instrumentation: ~10% of
-    # the economy migrated in moves nobody waited for).
+    # WINDOW GROWTH is gated on MEASURED recent waiting: a destination
+    # earns transfer-batch growth only if some requester actually parked
+    # there within this window (or is parked right now). Hotspot's
+    # destinations park hard (startup, between-batch dips) and keep
+    # earning scale; a destination that never waits decays to the floor,
+    # bounding the batch sizes the pump can shuffle in balanced
+    # economies. NOTE: gating the top-ups THEMSELVES on this signal was
+    # measured and reverted (see _plan_migrations) — pre-positioning
+    # ahead of demand is exactly what long steady-state sinks need.
     PARK_RECENT = 0.5
 
     def _window(self, rank: int) -> float:
@@ -470,11 +471,7 @@ class PlanEngine:
         """Cheap pre-check (raw snapshot counts, no ledger filtering) for
         whether fair-share migration planning could possibly trigger; the
         exact check re-runs on filtered inventory. Errs a round late on
-        ledger-heavy edges, which the next fresh snapshot corrects.
-        Mirrors the PARK_RECENT gate: a destination nobody waited at
-        recently can only qualify through the starved path (empty with a
-        parked requester), so balanced economies whose queues merely
-        oscillate skip the pump's task-ledger walk entirely."""
+        ledger-heavy edges, which the next fresh snapshot corrects."""
         consumers = {
             r: snaps[r].get("consumers", 0) for r in snaps
         }
@@ -493,16 +490,11 @@ class PlanEngine:
                 c > 0 and raw[r] == 0 and snaps[r].get("reqs")
                 for r, c in consumers.items()
             )
-        for r, c in consumers.items():
-            if c <= 0:
-                continue
-            if now - self._last_parked.get(r, -1e9) <= self.PARK_RECENT:
-                sh = -(-total * c // total_c)
-                if 2 * raw[r] < self._need(sh, c, r):
-                    return True
-            elif raw[r] == 0 and snaps[r].get("reqs"):
-                return True  # starved-path candidate
-        return False
+        return any(
+            c > 0
+            and 2 * raw[r] < self._need(-(-total * c // total_c), c, r)
+            for r, c in consumers.items()
+        )
 
     def _plan_migrations(
         self, snaps: dict, filtered: dict, planned_away: dict,
@@ -605,10 +597,22 @@ class PlanEngine:
         deficits: dict[int, int] = {}
         # recentness is judged at snapshot-READ time (round start), not
         # t_planned: a slow solve (first compile) between the two must
-        # not age otherwise-fresh parks out of the window
+        # not age otherwise-fresh parks out of the window. A requester
+        # VISIBLE parked in the current snapshot counts as recent no
+        # matter the stamp age: servers suppress repeat-identical
+        # snapshots, so a continuously-parked destination's stamp goes
+        # stale precisely because nothing changed — aging it out of the
+        # window would starve the most-waiting destinations (observed:
+        # native 64-rank wait%% doubled before this clause).
         t_ref = now if now is not None else t_planned
         recent: dict[int, bool] = {
-            r: t_ref - self._last_parked.get(r, -1e9) <= self.PARK_RECENT
+            r: (
+                # LEDGER-FILTERED reqs, not raw: a requester the solve
+                # already satisfied (still listed in a stale/suppressed
+                # snapshot) must not keep earning growth
+                bool(filtered.get(r, {}).get("reqs"))
+                or t_ref - self._last_parked.get(r, -1e9) <= self.PARK_RECENT
+            )
             for r in consumers
         }
         for r, c in consumers.items():
@@ -622,11 +626,20 @@ class PlanEngine:
             ):
                 starved.add(r)
                 deficits[r] = sh
-            elif recent[r] and not scarce:
-                # anticipatory placement only where workers measurably
-                # waited within PARK_RECENT (see the constant's comment),
-                # and never under scarcity (scarce+concentrated admits
-                # only the starved path above)
+            elif not scarce:
+                # anticipatory placement (scarce+concentrated admits only
+                # the starved path above). Round 4 MEASURED a stronger
+                # gate here — feed only destinations whose workers parked
+                # within PARK_RECENT (VERDICT item 6) — and reverted it:
+                # native 64-rank acquisition wait DOUBLED (10.5% -> 22%,
+                # long steady-state runs cycle busy->dry->park instead of
+                # being smoothly pre-positioned), while sudoku did not
+                # improve (disabling anticipatory feeding there measures
+                # 7443 -> 6377 tasks/s — the pump HELPS sudoku; its
+                # residual mode gap is fixed per-message/per-round cost,
+                # see BASELINE.md). The recent-parked signal still gates
+                # WINDOW GROWTH below, which is where the churn bound
+                # belongs.
                 need = self._need(sh, c, r)
                 if 2 * have < need:
                     deficits[r] = need - have
