@@ -286,7 +286,7 @@ class PlanEngine:
         # rounds (cross demand) are never delayed.
         pump_due = now - self._last_pump >= self.PUMP_INTERVAL
         if not cross and not (
-            pump_due and self._maybe_imbalanced(snapshots, now)
+            pump_due and self._maybe_imbalanced(snapshots)
         ):
             return [], []  # nothing plannable: skip the task-ledger walk
         if pump_due:
@@ -467,7 +467,7 @@ class PlanEngine:
             self._look[rank] = max(float(self.LOOKAHEAD), look / 2.0)
         self._look_last[rank] = now
 
-    def _maybe_imbalanced(self, snaps: dict, now: float) -> bool:
+    def _maybe_imbalanced(self, snaps: dict) -> bool:
         """Cheap pre-check (raw snapshot counts, no ledger filtering) for
         whether fair-share migration planning could possibly trigger; the
         exact check re-runs on filtered inventory. Errs a round late on
