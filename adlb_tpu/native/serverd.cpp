@@ -2572,10 +2572,15 @@ class Server {
       nreqs += 1;
     }
     // suppress repeat empty snapshots (an idle server must not wake the
-    // sidecar every tick for nothing)
-    bool empty = tasks.empty() && reqs.empty();
+    // sidecar every tick for nothing) — but an unreported mig_acks
+    // change is NOT empty: the ack clears the planner's in-flight
+    // credit, and swallowing it here would re-open the phantom-credit
+    // stall the empty-batch ack exists to close
+    bool empty = tasks.empty() && reqs.empty() &&
+                 mig_acks_ == last_snap_acks_;
     if (empty && last_snap_empty_) return;
     last_snap_empty_ = empty;
+    last_snap_acks_ = mig_acks_;
     int64_t consumers = 0;
     for (int app : local_apps_)
       if (!finalized_.count(app)) consumers += 1;
@@ -2662,10 +2667,15 @@ class Server {
       blob.append(meta.payload);
       n += 1;
     }
-    if (n == 0) return;
+    // a fully-stale batch is STILL sent, empty, carrying the planner's
+    // batch id: the destination's ack clears the planner's in-flight
+    // credit; silently dropping it left a phantom credit suppressing
+    // solve+pump for that destination until the TTLs expired
     std::memcpy(blob.data(), &n, 4);
-    activity_ += 1;
-    exhaust_held_ = false;
+    if (n > 0) {
+      activity_ += 1;
+      exhaust_held_ = false;
+    }
     migrate_unacked_ += 1;
     NMsg wk = mk(T_SS_MIGRATE_WORK);
     wk.setb(F_UNITS_BLOB, std::move(blob));
@@ -2749,13 +2759,13 @@ class Server {
       wk.seti(F_BOUNCED, 1);
       ep_->send(m.src, wk);
     }
-    if (any_added) {
-      match_rq();
-      // immediate full snapshot: the batch ack and the post-batch
-      // inventory reach the planner now, not a heartbeat later — the
-      // follow-up top-up cadence rides on this
-      if (cfg_.tpu_mode) send_snapshot();
-    }
+    if (any_added) match_rq();
+    // immediate full snapshot: the batch ack and the post-batch
+    // inventory reach the planner now, not a heartbeat later — the
+    // follow-up top-up cadence rides on this. Sent for empty id-bearing
+    // batches too: the ack clearing the phantom credit must not wait
+    // for the next heartbeat.
+    if (cfg_.tpu_mode && (any_added || mid > 0)) send_snapshot();
   }
 
   void on_peer_eof(const NMsg& m) {
@@ -2839,6 +2849,7 @@ class Server {
   bool last_snap_empty_ = false;
   // src server -> highest planner migration-batch id received from it
   std::map<int, int64_t> mig_acks_;
+  std::map<int, int64_t> last_snap_acks_;  // acks as of last sent snapshot
 
   bool no_more_work_ = false;
   bool done_by_exhaustion_ = false;

@@ -1451,11 +1451,25 @@ class Server:
             self._accept_snapshot(self.rank, snap)
         else:
             # suppress repeat empty snapshots: an idle server would otherwise
-            # wake the master every tick for nothing
-            empty = not tasks and not reqs
+            # wake the master every tick for nothing. An unreported
+            # mig_acks change is NOT empty — the ack clears the
+            # planner's in-flight credit, and swallowing it would
+            # re-open the phantom-credit stall the empty-batch ack
+            # exists to close.
+            # (reqs-only snapshots do not DELIVER acks — the master
+            # inherits the previous task view's acks for them — so they
+            # neither satisfy the acks-changed test nor mark the acks
+            # as reported)
+            empty = (
+                not tasks and not reqs
+                and (reqs_only or self._mig_acks
+                     == getattr(self, "_last_snap_acks", {}))
+            )
             if empty and getattr(self, "_last_snap_empty", False):
                 return
             self._last_snap_empty = empty
+            if not reqs_only:
+                self._last_snap_acks = dict(self._mig_acks)
             self.ep.send(
                 self.world.master_server_rank,
                 msg(Tag.SS_STATE, self.rank, snap=snap),
@@ -1712,12 +1726,20 @@ class Server:
         if units:
             self.activity += 1
             self._exhaust_held_since = None
-            self._migrate_unacked += 1
-            self.ep.send(
-                m.dest,
-                msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False,
-                    mig_id=m.data.get("mig_id", 0)),
-            )
+        # A fully-stale batch (every unit consumed locally before
+        # enactment) must STILL be sent, empty, carrying the planner's
+        # batch id: the destination's ack is what clears the planner's
+        # in-flight credit, and a silently dropped batch left a phantom
+        # credit that suppressed both the solve and the pump for that
+        # destination until the TTLs expired — observed as whole worker
+        # pools parked ~180 ms mid-run (round 4) while a neighbor held
+        # hundreds of units.
+        self._migrate_unacked += 1
+        self.ep.send(
+            m.dest,
+            msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False,
+                mig_id=m.data.get("mig_id", 0)),
+        )
 
     def _on_migrate_work(self, m: Msg) -> None:
         # ack the planner's batch id via the next snapshot: credits for
@@ -1766,11 +1788,15 @@ class Server:
             )
         if m.units:
             self._match_rq()
-            if self.cfg.balancer == "tpu":
-                # immediate full snapshot: the batch ack and the post-batch
-                # inventory reach the planner now, not a heartbeat later —
-                # the follow-up top-up cadence rides on this
-                self._send_snapshot()
+        if self.cfg.balancer == "tpu" and (m.units or mid):
+            # immediate full snapshot: the batch ack and the post-batch
+            # inventory reach the planner now, not a heartbeat later —
+            # the follow-up top-up cadence rides on this. Sent for EMPTY
+            # id-bearing batches too: the ack clearing the phantom
+            # credit must not wait for the next heartbeat (and it must
+            # ride a FULL snapshot — reqs-only snapshots deliberately
+            # inherit the previous acks).
+            self._send_snapshot()
 
     def _on_migrate_ack(self, m: Msg) -> None:
         self._migrate_unacked -= 1

@@ -149,11 +149,13 @@ def main() -> None:
         nat16_steal = median_by(nat16["steal"],
                                 key=lambda r: r.tasks_per_sec)
         nat16_tpu = median_by(nat16["tpu"], key=lambda r: r.tasks_per_sec)
-        # 3 interleaved reps + medians: an 81-process world on this
-        # one-core host has multi-second scheduler slow phases that swing
-        # single draws ±30% in BOTH modes (the round-2 64-rank rows were
-        # one draw each — noise)
-        nat64 = interleaved(lambda m: hot_native(m, 64, 16, 7875))
+        # 5 interleaved reps + medians (round 4, up from 3): an
+        # 81-process world on this one-core host has multi-second
+        # scheduler slow phases that swing single draws ±30% in BOTH
+        # modes, and the wait%% medians this row's scale story rests on
+        # need more than a best-of-3 draw
+        nat64 = interleaved(lambda m: hot_native(m, 64, 16, 7875),
+                            reps=5)
         nat64_steal = median_by(nat64["steal"],
                                 key=lambda r: r.tasks_per_sec)
         nat64_tpu = median_by(nat64["tpu"], key=lambda r: r.tasks_per_sec)
@@ -281,9 +283,11 @@ def main() -> None:
         return (r.tasks_processed, r.elapsed)
 
     # first-solution search luck swings node counts per run, so the rate
-    # is the median of per-rep rates (see pooled()); 5 reps (round 3):
-    # single draws swing +-15% in both modes
-    sudoku_runs = interleaved(sudoku_one, reps=5)
+    # is the median of per-rep rates (see pooled()); 7 reps (round 4,
+    # up from 5): recorded draws swing +-40% per rep in BOTH modes
+    # (round-4 dress: steal 4860-8323/s within one run's reps), and a
+    # 5-rep median leaves the pooled ratio a two-bad-draw lottery
+    sudoku_runs = interleaved(sudoku_one, reps=7)
     sudoku_steal = pooled(sudoku_runs["steal"])
     sudoku_tpu = pooled(sudoku_runs["tpu"])
 
