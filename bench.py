@@ -298,10 +298,11 @@ def main() -> None:
         assert r.ok, f"gfmc {mode}: wrong counts {r.counts}"
         return (r.tasks_processed, r.elapsed)
 
-    # 7 reps (round 3): gfmc's pooled ratio swung 0.87-1.00 across 5-rep
-    # draws on this host's hour-scale slow phases; the wider pool tightens
-    # the estimate the ratio row rests on
-    gfmc_runs = interleaved(gfmc_one, reps=7)
+    # 9 reps (round 4, up from 7): gfmc's pooled ratio swung 0.87-1.00
+    # across 5-rep draws on this host's hour-scale slow phases, and a
+    # round-4 rehearsal drew 0.934 when one slow phase crushed two
+    # adjacent reps in both modes; the wider pool tightens the median
+    gfmc_runs = interleaved(gfmc_one, reps=9)
     gfmc_steal = pooled(gfmc_runs["steal"])
     gfmc_tpu = pooled(gfmc_runs["tpu"])
 
@@ -336,9 +337,10 @@ def main() -> None:
     # continuity row: the two-call Reserve+Get consumer loop benchmarked in
     # rounds 1-2 (the reference's only consumer shape), so the fused-loop
     # switch above stays auditable against earlier BENCH_r* files.
-    # 5 reps (round 4): ~1 draw in 3 hits a host slow phase and collapses
-    # the tpu side 20-25%; a 3-rep median is one bad draw from failing
-    hcl_runs = interleaved(lambda m: hot_one(m, fused=False), reps=5)
+    # 7 reps (round 4): ~1 draw in 3 hits a host slow phase and collapses
+    # the tpu side 20-25% (a round-4 rehearsal drew two adjacent
+    # collapsed reps); the median must survive two bad draws
+    hcl_runs = interleaved(lambda m: hot_one(m, fused=False), reps=7)
     hcl_steal = median_by(hcl_runs["steal"], key=lambda r: r.tasks_per_sec)
     hcl_tpu = median_by(hcl_runs["tpu"], key=lambda r: r.tasks_per_sec)
     hcl_steal_idle = median_by([r.idle_pct for r in hcl_runs["steal"]])
