@@ -488,3 +488,69 @@ def test_matched_requester_not_double_withheld():
     assert len(matches3) == 1 and matches3[0][2] == 11, matches3
     moved3 = {q for _, _, qs, _ in migs3 for q in qs}
     assert moved3, (matches3, migs3)
+
+
+def test_fully_stale_migration_batch_still_clears_credit(monkeypatch):
+    """Round-4 regression: a planner migration whose every unit is stale
+    at enactment must STILL result in the destination acking the batch
+    id, clearing the planner's in-flight credit. Before the fix the
+    source silently dropped such batches and the phantom credit made the
+    destination look fed (solve suppressed + pump skipped) until the
+    TTLs expired — whole worker pools parked ~180 ms mid-run.
+
+    The TTL and stamp fallbacks are pinned OFF so only the exact
+    ack-clearing path can clear the forged credit."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    monkeypatch.setattr(PlanEngine, "INFLOW_TTL", 1e9)
+    monkeypatch.setattr(PlanEngine, "INFLOW_MIN_AGE", 1e9)
+
+    holder = {}
+    orig = PlanEngine.round
+
+    def forging(self, snapshots, world=None):
+        holder["eng"] = self
+        matches, migs = orig(self, snapshots, world)
+        servers = sorted(snapshots)
+        if not holder.get("forged") and len(servers) >= 2:
+            src, dest = servers[0], servers[1]
+            mid = self._mig_next
+            self._mig_next += 1
+            # credit exactly as _plan_migrations would record it
+            self._planned_in.setdefault(dest, []).append(
+                (_time.monotonic(), 5, mid, src, frozenset({T1}))
+            )
+            migs = list(migs) + [(src, dest, [987654321], mid)]
+            holder["forged"] = dest
+        return matches, migs
+
+    monkeypatch.setattr(PlanEngine, "round", forging)
+
+    def app(ctx):
+        deadline = _time.monotonic() + 8.0
+        ok = False
+        while _time.monotonic() < deadline:
+            eng = holder.get("eng")
+            dest = holder.get("forged")
+            if dest is not None and eng is not None:
+                live = eng._planned_in.get(dest)
+                if not live:
+                    ok = True  # ack arrived; credit cleared exactly
+                    break
+            _time.sleep(0.05)
+        if ctx.rank == 0:
+            ctx.set_problem_done()
+        return ok
+
+    res = run_world(
+        2, 2, [T1], app,
+        cfg=Config(balancer="tpu", balancer_max_tasks=16,
+                   balancer_max_requesters=4),
+        timeout=60.0,
+    )
+    assert res.app_results[0] or res.app_results[1], (
+        "forged fully-stale migration credit was never cleared by the "
+        "destination's ack"
+    )

@@ -28,6 +28,10 @@ CASES = [
     msg(Tag.TA_INFO_GET_RESP, 6, rc=1, value=3.5),
     msg(Tag.TA_ABORT, 6, code=-2),
     msg(Tag.FA_LOCAL_APP_DONE, 1),
+    # batched put delta (round 4): parallel per-unit lists so streaming
+    # producers reach the balancer within one rate-limit gap
+    msg(Tag.SS_STATE_DELTA, 4, seqnos=[11, 12, 13], work_types=[1, 1, 2],
+        prios=[0, -3, 9], work_lens=[8, 0, 4096], nbytes=4104),
 ]
 
 
