@@ -301,7 +301,15 @@ def run_world(
         ds.run()
 
     threads: list[threading.Thread] = []
-    for rank in range(world.nranks):
+    # servers (and the debug server) start BEFORE app ranks: app threads
+    # begin with protocol round trips, and every server thread still
+    # being spawned is pure startup latency charged to the apps'
+    # makespans (messages would queue correctly either way — this is a
+    # latency ordering, not a correctness one)
+    ordered = [r for r in range(world.nranks) if not world.is_app(r)] + [
+        r for r in range(world.nranks) if world.is_app(r)
+    ]
+    for rank in ordered:
         if world.is_app(rank):
             target = app_main
         elif world.is_server(rank):
