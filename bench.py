@@ -591,6 +591,12 @@ def main() -> None:
                          round(tric_tpu.dispatch_p50_ms, 2)],
             "ndisp_p50": [native_rows.get("native_trickle_p50_ms_steal"),
                           native_rows.get("native_trickle_p50_ms_tpu")],
+            # on-chip solve scale (4096x512 / 16384x2048 pools, device
+            # path forced) + trickle with EVERY round's solve on the
+            # tunneled chip — the TPU-path evidence in the record
+            "solve_ms": [solve_4k_ms, solve_16k_ms],
+            "disp_dev_p50": device_rows.get(
+                "trickle_dispatch_p50_ms_tpu_device_solve"),
             # per-rep spreads: every headline claim auditable from this
             # record alone (steal first, tpu second in each pair)
             "reps": {
@@ -615,6 +621,10 @@ def main() -> None:
     }
     if "native_error" in native_rows:
         compact["detail"]["native_error"] = native_rows["native_error"][:120]
+    if "device_solve_error" in device_rows:
+        compact["detail"]["device_error"] = (
+            device_rows["device_solve_error"][:120]
+        )
     line = json.dumps(compact, separators=(",", ":"))
     if len(line) > 1900:  # belt-and-braces: the tail window is ~2000
         compact["detail"].pop("reps", None)
