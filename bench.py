@@ -558,6 +558,14 @@ def main() -> None:
 
     rates = lambda runs: [r.tasks_per_sec for r in runs]  # noqa: E731
     idles = lambda runs: [r.idle_pct for r in runs]  # noqa: E731
+
+    def pair_ratio(runs):
+        pairs = [
+            t.tasks_per_sec / s.tasks_per_sec
+            for s, t in zip(runs["steal"], runs["tpu"])
+            if s.tasks_per_sec
+        ]
+        return round(median_by(pairs), 3) if pairs else 0.0
     compact = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -575,6 +583,15 @@ def main() -> None:
             if hcl_steal.tasks_per_sec else 0.0,
             "classic_idle_ratio": round(hcl_tpu_idle / hcl_steal_idle, 3)
             if hcl_steal_idle else 0.0,
+            # secondary phase-robust estimators: median of PER-REP-PAIR
+            # ratios. Adjacent interleaved reps share the host's
+            # hour-scale phase, so pairing cancels it; the primary
+            # medians-of-modes above stay the cross-round-comparable
+            # figures (recorded draws: a steal rep landing in a fast
+            # phase swings the primary +-0.05 while the paired median
+            # stays put)
+            "hot_pair_ratio": pair_ratio(hot_runs),
+            "classic_pair_ratio": pair_ratio(hcl_runs),
             "nq": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
             if steal.tasks_per_sec else 0.0,
             "tsp": round(tsp_tpu / tsp_steal, 3) if tsp_steal else 0.0,
