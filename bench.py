@@ -650,9 +650,19 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import faulthandler
+
+    # defense-in-depth for the run of record: every world has its own
+    # timeout (a wedge raises TimeoutError -> the bench_error line), but
+    # if a world/teardown path ever wedges past those, dump all thread
+    # stacks to stderr every 30 min instead of hanging silently. A
+    # healthy full bench finishes in well under one period; the timer
+    # is cancelled the moment main() returns so a clean run never dumps.
+    faulthandler.dump_traceback_later(1800, repeat=True)
     t0 = time.time()
     try:
         main()
+        faulthandler.cancel_dump_traceback_later()
     except Exception as e:  # surface failures as a parseable line
         print(json.dumps({
             "metric": "bench_error",
