@@ -41,10 +41,13 @@ def main() -> None:
     rows = []
     for apps, servers, work_us in scales:
         n = (apps - 1) * 1000000 // work_us // (2 if args.quick else 1)
-        # >= 64 ranks: a 81-161-process world on one core has multi-second
-        # scheduler slow phases that swing single draws +-30% in BOTH
-        # modes; interleaved 3-rep medians keep the row about balancing
-        reps = 1 if (apps < 64 or args.quick) else 3
+        # >= 32 ranks: a 41-161-process world on one core has
+        # multi-second scheduler slow phases that swing single draws
+        # +-30% in BOTH modes (a round-4 confirmatory run drew a 0.68
+        # ratio on a single 32-rank rep whose immediate 3-rep re-draws
+        # measured 1.12-1.15); interleaved 3-rep medians keep the rows
+        # about balancing
+        reps = 1 if (apps < 32 or args.quick) else 3
         runs = {"steal": [], "tpu": []}
         for _ in range(reps):
             for mode in ("steal", "tpu"):
