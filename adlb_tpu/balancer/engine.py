@@ -279,15 +279,20 @@ class PlanEngine:
         # over-admit a solve for one snapshot generation, which the
         # filtered solve input then corrects.
         cross = have_reqs and self._cross_feasible(freqs, snapshots)
-        # The fair-share pump runs at most once per PUMP_INTERVAL: deficits
-        # cannot change faster than batches land, and each pump round
-        # walks every snapshot task (O(servers x K) — milliseconds on wide
-        # worlds, stolen from the workers on a shared core). Match-bearing
-        # rounds (cross demand) are never delayed.
-        pump_due = now - self._last_pump >= self.PUMP_INTERVAL
-        if not cross and not (
-            pump_due and self._maybe_imbalanced(snapshots)
-        ):
+        # The fair-share pump runs at most once per PUMP_INTERVAL AND
+        # only when the cheap pre-check sees a plausible deficit:
+        # deficits cannot change faster than batches land, and each pump
+        # round walks every snapshot task (O(servers x K) — milliseconds
+        # on wide worlds, stolen from the workers on a shared core).
+        # Match-bearing rounds (cross demand) are never delayed, but
+        # since round 4 they no longer walk the pump unconditionally
+        # either — in balanced scarce economies that walk was ~5% of
+        # throughput for moves that never shipped.
+        pump_due = (
+            now - self._last_pump >= self.PUMP_INTERVAL
+            and self._maybe_imbalanced(snapshots)
+        )
+        if not cross and not pump_due:
             return [], []  # nothing plannable: skip the task-ledger walk
         if pump_due:
             self._last_pump = now
@@ -410,13 +415,15 @@ class PlanEngine:
     # top-up chain for destinations that snapshot faster than batch
     # transit).
     INFLOW_MIN_AGE = 0.05
-    # minimum spacing of fair-share pump rounds (see round()); starved
-    # destinations wait at most this long for their first batch, well
-    # under a batch's own transit+enactment time. 3 ms (round 4, down
-    # from 10): the pump walk only runs when the cheap _maybe_imbalanced
-    # pre-check passes, so the spacing is pure latency for destinations
-    # that measurably wait — mid-run drain imbalances parked whole
-    # worker pools for the old interval at a time.
+    # minimum spacing of fair-share pump rounds (see round()); 3 ms
+    # (round 4, down from 10): mid-run drain imbalances parked whole
+    # worker pools for the old interval at a time. The expensive
+    # O(tasks) pump walk is additionally gated on the cheap
+    # _maybe_imbalanced pre-check in EVERY round (round 4: previously
+    # match-bearing rounds walked unconditionally, which taxed
+    # balanced scarce economies ~5% — an adaptive 3/10 ms backoff was
+    # tried instead and reverted: storms are bursts, so the first
+    # response to each fresh imbalance paid the idle interval again).
     PUMP_INTERVAL = 0.003
     # in-flight credits older than this stop suppressing the solve for
     # their destination's requesters (the batch is probably lost; the TTL

@@ -236,7 +236,8 @@ def main() -> None:
     # broadcast bound updates; compute-bound like nq at this scale).
     # n_cities=10 so the run is long enough (~3.5 s) that the 0.2 s
     # exhaustion-termination quantum stays noise (<5%); pooled per-rep
-    # medians like sudoku/gfmc — B&B node counts are nondeterministic run to run in both modes.
+    # medians like sudoku/gfmc — B&B node counts are nondeterministic
+    # run to run in both modes.
     from adlb_tpu.workloads import tsp
 
     TSP_N = 10
