@@ -50,6 +50,15 @@ def drain_plan_ages() -> list:
     return out
 
 
+def round_gap(min_gap: float, matches, migrations) -> float:
+    """Inter-round sleep for a balancer loop (in-proc thread AND sidecar):
+    rate-limit idle churn at the full gap, but keep plan-bearing rounds
+    coming fast (startup fill, end-game drain) — a full-gap sleep after a
+    match round adds the whole gap to every handoff's latency for
+    nothing; the ledger suppression already prevents re-planning storms."""
+    return min_gap * 0.25 if (matches or migrations) else min_gap
+
+
 class PlanEngine:
     def __init__(
         self,
@@ -475,7 +484,8 @@ class PlanEngine:
         self._look_last[rank] = now
 
     def _maybe_imbalanced(self, snaps: dict) -> bool:
-        """Cheap pre-check (raw snapshot counts, no ledger filtering) for
+        """Cheap pre-check (raw snapshot counts; the ledger is consulted
+        only for the handful of req-parked ranks in the scarce branch) for
         whether fair-share migration planning could possibly trigger; the
         exact check re-runs on filtered inventory. Errs a round late on
         ledger-heavy edges, which the next fresh snapshot corrects."""
@@ -494,13 +504,33 @@ class PlanEngine:
             if total == 0 or max(raw.values()) <= self.CONC_FRAC * total:
                 return False
             return any(
-                c > 0 and raw[r] == 0 and snaps[r].get("reqs")
+                c > 0
+                and snaps[r].get("reqs")
+                and (raw[r] == 0 or self._only_planned_away(r, snaps[r]))
                 for r, c in consumers.items()
             )
         return any(
             c > 0
             and 2 * raw[r] < self._need(-(-total * c // total_c), c, r)
             for r, c in consumers.items()
+        )
+
+    def _only_planned_away(self, rank: int, snap: dict) -> bool:
+        """True when every unit a stale snapshot still lists for ``rank``
+        is already spoken for by the plan ledger (matched or migrating
+        away). Such a rank is starved NOW even though its raw count is
+        nonzero — without this the startup-fill pump stays gated a whole
+        snapshot generation after its opening burst is planned out, which
+        is exactly the stall class the round-4 fix targeted. Cost is a
+        dict lookup per listed unit and only runs for req-parked ranks in
+        the scarce branch (few, by construction)."""
+        tasks = snap["tasks"]
+        if not tasks:
+            return True
+        tstamp = snap.get("task_stamp", snap.get("stamp", 0.0))
+        return all(
+            self._planned_tasks.get((rank, t[0]), -1.0) >= tstamp
+            for t in tasks
         )
 
     def _plan_migrations(

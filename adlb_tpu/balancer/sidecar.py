@@ -92,7 +92,7 @@ def decode_snapshot(m) -> dict:
 def run_sidecar(world, cfg, ep, abort_event=None) -> int:
     """Serve balancer rounds until every server says DS_END; returns the
     number of planning rounds executed."""
-    from adlb_tpu.balancer.engine import PlanEngine
+    from adlb_tpu.balancer.engine import PlanEngine, round_gap
 
     engine = PlanEngine(
         types=world.types,
@@ -194,5 +194,6 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                     mig_id=mig_id),
             )
         if cfg.balancer_min_gap > 0:
-            time.sleep(cfg.balancer_min_gap)
+            # shared cadence with the in-proc _BalancerWorker
+            time.sleep(round_gap(cfg.balancer_min_gap, matches, migrations))
     return rounds

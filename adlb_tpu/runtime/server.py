@@ -86,8 +86,9 @@ class _BalancerWorker(threading.Thread):
 
     def run(self) -> None:
         s = self.server
-        from adlb_tpu.balancer.engine import PlanEngine
+        from adlb_tpu.balancer.engine import PlanEngine, round_gap
 
+        self._round_gap = round_gap
         engine = PlanEngine(
             types=s.world.types,
             max_tasks=s.cfg.balancer_max_tasks,
@@ -148,13 +149,9 @@ class _BalancerWorker(threading.Thread):
                     mig_id=mig_id),
             )
         if s.cfg.balancer_min_gap > 0:
-            # rate-limit idle churn at the full gap, but keep the cadence
-            # up while plans are actually flowing (startup fill, end-game
-            # drain): a match-bearing round followed by a full-gap sleep
-            # adds the gap to every handoff's latency for nothing — the
-            # ledger suppression already prevents re-planning storms
-            gap = s.cfg.balancer_min_gap
-            time.sleep(gap * 0.25 if (matches or migrations) else gap)
+            time.sleep(
+                self._round_gap(s.cfg.balancer_min_gap, matches, migrations)
+            )
 
 
 class _PeerState:

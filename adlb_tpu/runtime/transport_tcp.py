@@ -13,6 +13,7 @@ multi-process use, :func:`spawn_world` forks one process per rank.
 
 from __future__ import annotations
 
+import itertools
 import pickle
 import queue
 import socket
@@ -28,7 +29,10 @@ _HDR = struct.Struct("<I")
 
 # staggers the rendezvous-port probe start for successive worlds created
 # by the same process (see local_addr_map)
-_PORT_PROBE_CALLS = 0
+# atomic per-process probe counter (itertools.count.__next__ is a single
+# C-level op, so concurrent world creation from multiple threads cannot
+# read-modify-write the same value and collapse onto one probe start)
+_PORT_PROBE_CALLS = itertools.count()
 
 
 class TcpEndpoint:
@@ -259,12 +263,10 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
     addr_map = {}
     socks = []
     span = hi - lo
-    global _PORT_PROBE_CALLS
     # Knuth-hash the PID so adjacent PIDs (concurrently spawned worlds)
     # land far apart in the range; successive worlds from the SAME
     # process are staggered by the call counter
-    start = lo + (os.getpid() * 40503 + _PORT_PROBE_CALLS * 1013) % span
-    _PORT_PROBE_CALLS += 1
+    start = lo + (os.getpid() * 40503 + next(_PORT_PROBE_CALLS) * 1013) % span
     port = start
     probed = 0
     r = 0

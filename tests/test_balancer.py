@@ -490,6 +490,40 @@ def test_matched_requester_not_double_withheld():
     assert moved3, (matches3, migs3)
 
 
+def test_pump_precheck_admits_rank_with_only_planned_away_inventory():
+    """ADVICE r4: a req-parked destination whose stale snapshot still
+    lists units the plan ledger already moved away must ADMIT the
+    scarce+concentrated pump pre-check — its raw count is nonzero but it
+    is starved NOW. Before the fix the pump stayed gated a whole
+    snapshot generation after the opening burst was planned out."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
+    t0 = _time.monotonic()
+    snaps = {
+        10: {"tasks": [(j, T1, 1, 8) for j in range(3)],
+             "reqs": [], "consumers": 2, "stamp": t0, "task_stamp": t0},
+        # rank 11: one consumer parked; its snapshot still lists unit 99
+        # but the ledger says 99 was planned away AFTER this task view
+        11: {"tasks": [(99, T1, 1, 8)], "reqs": [(5, 1, [T1])],
+             "consumers": 2, "stamp": t0, "task_stamp": t0},
+    }
+    # 4 units < 5 consumers (scarce), 3 of 4 on rank 10 (concentrated)
+    snaps[10]["consumers"] = 3
+    eng._planned_tasks[(11, 99)] = t0 + 1.0  # planned after the view
+    assert eng._maybe_imbalanced(snaps), (
+        "pre-check must admit: rank 11 is req-parked and every listed "
+        "unit is planned away"
+    )
+    # sanity: with the unit genuinely eligible (ledger older than the
+    # view) the same shape is NOT admitted via the planned-away clause
+    eng2 = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
+    eng2._planned_tasks[(11, 99)] = t0 - 1.0
+    assert not eng2._maybe_imbalanced(snaps)
+
+
 def test_fully_stale_migration_batch_still_clears_credit(monkeypatch):
     """Round-4 regression: a planner migration whose every unit is stale
     at enactment must STILL result in the destination acking the batch
