@@ -86,9 +86,8 @@ class _BalancerWorker(threading.Thread):
 
     def run(self) -> None:
         s = self.server
-        from adlb_tpu.balancer.engine import PlanEngine, round_gap
+        from adlb_tpu.balancer.engine import PlanEngine
 
-        self._round_gap = round_gap
         engine = PlanEngine(
             types=s.world.types,
             max_tasks=s.cfg.balancer_max_tasks,
@@ -149,9 +148,11 @@ class _BalancerWorker(threading.Thread):
                     mig_id=mig_id),
             )
         if s.cfg.balancer_min_gap > 0:
-            time.sleep(
-                self._round_gap(s.cfg.balancer_min_gap, matches, migrations)
-            )
+            # module already cached by run()'s deferred import; this stays
+            # a plain lookup, not a fresh module load
+            from adlb_tpu.balancer.engine import round_gap
+
+            time.sleep(round_gap(s.cfg.balancer_min_gap, matches, migrations))
 
 
 class _PeerState:

@@ -503,15 +503,14 @@ def test_pump_precheck_admits_rank_with_only_planned_away_inventory():
     eng = PlanEngine(types=(T1,), max_tasks=64, max_requesters=8)
     t0 = _time.monotonic()
     snaps = {
+        # 4 units < 5 consumers (scarce), 3 of 4 on rank 10 (concentrated)
         10: {"tasks": [(j, T1, 1, 8) for j in range(3)],
-             "reqs": [], "consumers": 2, "stamp": t0, "task_stamp": t0},
+             "reqs": [], "consumers": 3, "stamp": t0, "task_stamp": t0},
         # rank 11: one consumer parked; its snapshot still lists unit 99
         # but the ledger says 99 was planned away AFTER this task view
         11: {"tasks": [(99, T1, 1, 8)], "reqs": [(5, 1, [T1])],
              "consumers": 2, "stamp": t0, "task_stamp": t0},
     }
-    # 4 units < 5 consumers (scarce), 3 of 4 on rank 10 (concentrated)
-    snaps[10]["consumers"] = 3
     eng._planned_tasks[(11, 99)] = t0 + 1.0  # planned after the view
     assert eng._maybe_imbalanced(snaps), (
         "pre-check must admit: rank 11 is req-parked and every listed "
