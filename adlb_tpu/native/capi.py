@@ -115,6 +115,35 @@ def run_native_probe(
     return results
 
 
+def parse_probe_lines(results, prefix: str):
+    """Parse the per-rank ``PREFIX k=v ...`` metric line each native probe
+    client prints (hotspot_c/nq_c/tsp_c/trickle_c share the shape).
+    Returns one dict per rank with ints where the value parses as int,
+    floats otherwise."""
+    rows = []
+    for _rc, out, _err in results:
+        line = next(
+            ln for ln in out.splitlines() if ln.startswith(prefix + " ")
+        )
+        kv = {}
+        for field in line.split()[1:]:
+            k, v = field.split("=")
+            try:
+                kv[k] = int(v)
+            except ValueError:
+                kv[k] = float(v)
+        rows.append(kv)
+    return rows
+
+
+def probe_makespan(rows):
+    """(t_begin, t_end, elapsed) across parsed probe rows, with the
+    division-safe elapsed floor applied in one place."""
+    t_begin = min(r["t0"] for r in rows)
+    t_end = max(r["t1"] for r in rows)
+    return t_begin, t_end, max(t_end - t_begin, 1e-9)
+
+
 def run_native_world(
     n_clients: int,
     nservers: int,

@@ -41,16 +41,17 @@ def run(
         cfg=cfg,
         timeout=timeout,
     )
-    rows = []
-    for _rc, out, _err in results:
-        line = next(ln for ln in out.splitlines() if ln.startswith("HOT "))
-        kv = dict(f.split("=") for f in line.split()[1:])
-        rows.append(
-            (int(kv["done"]), float(kv["busy"]), float(kv["t0"]),
-             float(kv["t1"]), float(kv.get("wait", 0.0)))
-        )
+    from adlb_tpu.native.capi import parse_probe_lines
+
+    rows = [
+        (r["done"], r["busy"], r["t0"], r["t1"], r.get("wait", 0.0))
+        for r in parse_probe_lines(results, "HOT")
+    ]
     workers = rows[1:]
     tasks = sum(r[0] for r in workers)
+    # rank 0 is a pure producer: the makespan starts at its first put but
+    # must END at the last WORKER's finish, so probe_makespan (which maxes
+    # over all rows) is deliberately not used here
     t_begin = min(r[2] for r in rows)
     t_end = max(r[3] for r in workers)
     elapsed = max(t_end - t_begin, 1e-9)

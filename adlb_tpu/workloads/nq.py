@@ -23,7 +23,11 @@ from adlb_tpu.types import ADLB_SUCCESS
 
 WORK = 1
 
-KNOWN_SOLUTIONS = {4: 2, 5: 10, 6: 4, 7: 40, 8: 92, 9: 352, 10: 724}
+KNOWN_SOLUTIONS = {
+    4: 2, 5: 10, 6: 4, 7: 40, 8: 92, 9: 352, 10: 724,
+    # scale rows for the native harness (OEIS A000170)
+    11: 2680, 12: 14200, 13: 73712, 14: 365596,
+}
 
 
 def _safe(col: int, row: int, rows: list[int]) -> bool:

@@ -4,20 +4,36 @@
  * per filled column, -1 = open); workers expand the first open column,
  * re-Putting each safe child with priority = column (depth-first flavor)
  * until CUTOFF, below which they count the subtree locally.  Terminates by
- * exhaustion; rank 0 collects per-rank counts via targeted TALLY units and
- * validates against the known answer.  Exit 0 only on a correct count.
+ * exhaustion; the harness sums the per-rank counts printed on stdout and
+ * validates against the known answer.
+ *
+ * Board size and split depth are env-tunable for the scaling harness
+ * (ADLB_NQ_N, default 7; ADLB_NQ_CUTOFF, default 2); each rank prints one
+ * machine-readable line in the same shape as tsp_c.c/hotspot_c.c:
+ *
+ *   NQ rank=<r> solutions=<n> done=<n> t0=<mono> t1=<mono> wait=<s>
+ *
+ * done counts work units processed; wait is time blocked acquiring work
+ * (the steal-to-exec quantity).
  */
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #include <adlb/adlb.h>
 
 #define WORK 1
-#define TALLY 2
-#define N 7
-#define CUTOFF 2
-#define EXPECTED 40 /* solutions for 7-queens */
+#define MAXN 16
+
+static int N = 7;
+static int CUTOFF = 2;
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
 
 static int safe_at(const int *rows, int col, int row) {
   for (int c = 0; c < col; c++) {
@@ -41,35 +57,43 @@ static long count_subtree(int *rows, int col) {
 }
 
 int main(void) {
-  int types[2] = {WORK, TALLY};
+  int types[1] = {WORK};
   int am_server, am_debug, num_apps;
   const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
   int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 is rejected by ADLB_Init */
-  int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
+  if (getenv("ADLB_NQ_N")) N = atoi(getenv("ADLB_NQ_N"));
+  if (getenv("ADLB_NQ_CUTOFF")) CUTOFF = atoi(getenv("ADLB_NQ_CUTOFF"));
+  if (N < 1 || N > MAXN || CUTOFF < 0) return 2;
+  int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,
                      &num_apps);
   if (rc != ADLB_SUCCESS) return 2;
   int me = ADLB_World_rank();
 
-  int root[N];
+  int root[MAXN];
+  int unit_bytes = N * (int)sizeof(int);
   if (me == 0) {
     for (int i = 0; i < N; i++) root[i] = -1;
-    rc = ADLB_Put(root, sizeof root, -1, -1, WORK, 0);
+    rc = ADLB_Put(root, unit_bytes, -1, -1, WORK, 0);
     if (rc != ADLB_SUCCESS) return 3;
   }
 
-  long solutions = 0;
+  long solutions = 0, done = 0;
+  double wait = 0.0, t0 = mono(), t1 = t0;
   for (;;) {
     /* ANY-type reserve: exercises the omitted-req_types wire path (only
      * WORK units ever exist in this pool, so semantics are unchanged) */
     int req[2] = {ADLB_RESERVE_REQUEST_ANY, ADLB_RESERVE_EOL};
     int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    double r0 = mono();
     rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
     if (rc == ADLB_DONE_BY_EXHAUSTION || rc == ADLB_NO_MORE_WORK) break;
     if (rc != ADLB_SUCCESS) return 4;
-    int rows[N];
-    if (wl != sizeof rows) return 5;
+    int rows[MAXN];
+    if (wl != unit_bytes) return 5;
     rc = ADLB_Get_reserved(rows, handle);
     if (rc != ADLB_SUCCESS) return 6;
+    wait += mono() - r0;
+    done++;
     int col = N;
     for (int i = 0; i < N; i++)
       if (rows[i] < 0) {
@@ -80,7 +104,7 @@ int main(void) {
       for (int row = 0; row < N; row++) {
         if (safe_at(rows, col, row)) {
           rows[col] = row;
-          rc = ADLB_Put(rows, sizeof rows, -1, -1, WORK, col);
+          rc = ADLB_Put(rows, unit_bytes, -1, -1, WORK, col);
           if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) return 7;
           rows[col] = -1;
         }
@@ -88,14 +112,14 @@ int main(void) {
     } else {
       solutions += count_subtree(rows, col);
     }
+    t1 = mono();
   }
 
-  /* funnel per-rank counts to rank 0 — exhaustion already fired, so the
-   * pool is flushing; counts travel out-of-band via stdout for the harness
-   * AND in-band as the exit path for rank 0's total when it can still
-   * collect (after DONE_BY_EXHAUSTION no further Puts are accepted, matching
-   * the reference semantics), so the harness sums the printed values. */
-  printf("nq_c rank %d solutions %ld\n", me, solutions);
+  /* per-rank counts travel out-of-band via stdout: exhaustion already
+   * fired, so no further Puts are accepted (matching the reference
+   * semantics) — the harness sums the printed values */
+  printf("NQ rank=%d solutions=%ld done=%ld t0=%.6f t1=%.6f wait=%.6f\n",
+         me, solutions, done, t0, t1, wait);
   ADLB_Finalize();
   return 0;
 }

@@ -1,0 +1,204 @@
+/* Branch-and-bound TSP over the native C API: the reference's
+ * priority-ordered queue stress (reference examples/tsp.c) rebuilt for
+ * this plane.  Same economy, independent decomposition:
+ *
+ *   - a WORK unit is int32[1 + k]: partial tour length, then the k cities
+ *     visited so far (city 0 is always first); longer partials get higher
+ *     priority (reference tsp.c:239-240's WORK_PRIO+new_len heuristic),
+ *     so the pool drains depth-first and the bound tightens early;
+ *   - every rank keeps a local best-so-far bound seeded by the same
+ *     nearest-neighbour tour; a worker that completes a better tour puts
+ *     a maximum-priority BOUND_UPDT targeted at app rank 0, and every
+ *     rank that accepts an improvement forwards it down a binary tree of
+ *     app ranks (reference tsp.c:17,240-266) — bound propagation
+ *     exercises targeting and priority preemption together;
+ *   - expansion happens inside ADLB_Begin_batch_put/ADLB_End_batch_put
+ *     with no common buffer (children share nothing large), matching the
+ *     reference's ADLB_Begin_batch_put(NULL,0) usage;
+ *   - termination is by exhaustion once the tree is pruned dry.
+ *
+ * The distance matrix comes from ADLB_TSP_DISTS (comma-separated n*n
+ * ints, supplied by the Python harness so C and harness agree exactly)
+ * or, standalone, from a deterministic LCG over ADLB_TSP_SEED.  Each
+ * rank prints one machine-readable line:
+ *
+ *   TSP rank=<r> best=<d> done=<n> nput=<n> t0=<mono> t1=<mono> wait=<s>
+ *
+ * done counts WORK units processed (expansions and prunes); wait is time
+ * blocked acquiring work (the steal-to-exec quantity, as in hotspot_c.c).
+ * The harness validates min(best) against a brute-force optimum.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define BOUND_UPDT 2
+#define BOUND_PRIO 999999999 /* higher than any work priority */
+#define MAXN 16
+
+static int n_cities;
+static int dists[MAXN][MAXN];
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+/* deterministic standalone fallback: LCG coordinates on a 101x101 grid,
+ * rounded Euclidean distances (the Python harness normally supplies the
+ * matrix via ADLB_TSP_DISTS instead, so both sides share one source) */
+static void gen_dists(unsigned seed) {
+  long xs[MAXN], ys[MAXN];
+  unsigned long s = seed * 2654435761UL + 1;
+  for (int i = 0; i < n_cities; i++) {
+    s = (s * 1103515245UL + 12345UL) & 0x7fffffffUL;
+    xs[i] = (long)(s % 101UL);
+    s = (s * 1103515245UL + 12345UL) & 0x7fffffffUL;
+    ys[i] = (long)(s % 101UL);
+  }
+  for (int i = 0; i < n_cities; i++)
+    for (int j = 0; j < n_cities; j++) {
+      double dx = (double)(xs[i] - xs[j]), dy = (double)(ys[i] - ys[j]);
+      double d = dx * dx + dy * dy;
+      int r = 0;
+      while ((double)r * (double)r < d) r++; /* ceil(sqrt), no libm */
+      if ((double)r * (double)r > d &&
+          ((double)(r - 1) + 0.5) * ((double)(r - 1) + 0.5) > d)
+        r--; /* round-to-nearest */
+      dists[i][j] = (i == j) ? 0 : r;
+    }
+}
+
+static int greedy_bound(void) {
+  int used[MAXN] = {0}, tour[MAXN], total = 0;
+  used[0] = 1;
+  tour[0] = 0;
+  for (int k = 1; k < n_cities; k++) {
+    int best = -1, bd = 0;
+    for (int c = 1; c < n_cities; c++)
+      if (!used[c] && (best < 0 || dists[tour[k - 1]][c] < bd)) {
+        best = c;
+        bd = dists[tour[k - 1]][c];
+      }
+    used[best] = 1;
+    tour[k] = best;
+    total += bd;
+  }
+  return total + dists[tour[n_cities - 1]][0];
+}
+
+int main(void) {
+  int types[2] = {WORK, BOUND_UPDT};
+  int am_server, am_debug, num_apps;
+  const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0;
+  n_cities = getenv("ADLB_TSP_N") ? atoi(getenv("ADLB_TSP_N")) : 9;
+  if (n_cities < 3 || n_cities > MAXN) return 2;
+  const char *dist_env = getenv("ADLB_TSP_DISTS");
+  if (dist_env) {
+    const char *p = dist_env;
+    for (int i = 0; i < n_cities * n_cities; i++) {
+      dists[i / n_cities][i % n_cities] = atoi(p);
+      p = strchr(p, ',');
+      if (!p && i + 1 < n_cities * n_cities) return 2;
+      if (p) p++;
+    }
+  } else {
+    unsigned seed =
+        getenv("ADLB_TSP_SEED") ? (unsigned)atoi(getenv("ADLB_TSP_SEED")) : 0;
+    gen_dists(seed);
+  }
+
+  int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) return 3;
+  int me = ADLB_World_rank();
+  int lchild = 2 * me + 1, rchild = 2 * me + 2;
+  if (lchild >= num_apps) lchild = -1;
+  if (rchild >= num_apps) rchild = -1;
+
+  int best = greedy_bound(); /* identical on every rank */
+  long done = 0, nput = 0;
+  int buf[2 + MAXN]; /* [length, path...] or [dist] for BOUND_UPDT */
+
+  if (me == 0) {
+    buf[0] = 0; /* length so far */
+    buf[1] = 0; /* tour starts at city 0 */
+    rc = ADLB_Put(buf, 2 * (int)sizeof(int), -1, -1, WORK, 1);
+    if (rc != ADLB_SUCCESS) return 4;
+  }
+
+  double wait = 0.0, t0 = mono(), t1 = t0;
+  for (;;) {
+    int req[3] = {BOUND_UPDT, WORK, ADLB_RESERVE_EOL};
+    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    double r0 = mono();
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 7; /* real error, not termination */
+    if (wl > (int)sizeof(buf)) return 6;
+    rc = ADLB_Get_reserved(buf, handle);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 8;
+    wait += mono() - r0;
+    t1 = mono();
+    if (wt == BOUND_UPDT) {
+      if (buf[0] < best) {
+        best = buf[0];
+        /* forward the improvement down the binary tree */
+        if (lchild >= 0)
+          ADLB_Put(buf, (int)sizeof(int), lchild, -1, BOUND_UPDT, BOUND_PRIO);
+        if (rchild >= 0)
+          ADLB_Put(buf, (int)sizeof(int), rchild, -1, BOUND_UPDT, BOUND_PRIO);
+      }
+      continue;
+    }
+    done++;
+    int length = buf[0];
+    int *path = &buf[1];
+    int k = wl / (int)sizeof(int) - 1; /* cities in the partial tour */
+    if (length >= best) continue;      /* pruned under a tighter bound */
+    if (k == n_cities) {               /* complete: close the tour */
+      int total = length + dists[path[k - 1]][0];
+      if (total < best) {
+        /* funnel to rank 0, which broadcasts down the tree.  Local
+         * `best` is deliberately NOT set here (reference tsp.c:245-266
+         * semantics): the tightened bound reaches this rank back through
+         * the tree, and pre-setting it would make the `buf[0] < best`
+         * forwarding guard drop the broadcast at the originating rank —
+         * an interior node's children would then never learn the bound. */
+        int msg = total;
+        ADLB_Put(&msg, (int)sizeof(int), 0, -1, BOUND_UPDT, BOUND_PRIO);
+      }
+      continue;
+    }
+    int in_path[MAXN] = {0};
+    for (int i = 0; i < k; i++) in_path[path[i]] = 1;
+    ADLB_Begin_batch_put(NULL, 0);
+    for (int c = 1; c < n_cities; c++) {
+      if (in_path[c]) continue;
+      int nl = length + dists[path[k - 1]][c];
+      if (nl >= best) continue; /* bound prune */
+      buf[0] = nl;
+      path[k] = c;
+      rc = ADLB_Put(buf, (int)((2 + k) * sizeof(int)), -1, -1, WORK, 1 + k);
+      if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) {
+        ADLB_End_batch_put();
+        return 5;
+      }
+      nput++;
+    }
+    ADLB_End_batch_put();
+    buf[0] = length; /* restore (path[k] scribble is beyond k, harmless) */
+  }
+
+  printf("TSP rank=%d best=%d done=%ld nput=%ld t0=%.6f t1=%.6f wait=%.6f\n",
+         me, best, done, nput, t0, t1, wait);
+  ADLB_Finalize();
+  return 0;
+}
