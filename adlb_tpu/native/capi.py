@@ -144,6 +144,19 @@ def probe_makespan(rows):
     return t_begin, t_end, max(t_end - t_begin, 1e-9)
 
 
+def probe_aggregate(rows, tasks=None, done_key="done"):
+    """The aggregation every native probe harness repeats: total units,
+    cross-process makespan, rate, and mean wait fraction.  ``tasks``
+    overrides the default sum of ``done_key`` for probes whose unit count
+    is assembled from several fields.  Returns
+    (tasks, elapsed, tasks_per_sec, wait_pct)."""
+    _t0, _t1, elapsed = probe_makespan(rows)
+    if tasks is None:
+        tasks = sum(r[done_key] for r in rows)
+    wait = sum(r["wait"] / elapsed for r in rows) / len(rows)
+    return tasks, elapsed, tasks / elapsed, 100.0 * wait
+
+
 def run_native_world(
     n_clients: int,
     nservers: int,

@@ -45,18 +45,15 @@ def run(
         cfg=cfg,
         timeout=timeout,
     )
-    from adlb_tpu.native.capi import parse_probe_lines, probe_makespan
+    from adlb_tpu.native.capi import parse_probe_lines, probe_aggregate
 
     rows = parse_probe_lines(results, "NQ")
-    solutions = sum(r["solutions"] for r in rows)
-    tasks = sum(r["done"] for r in rows)
-    _t0, _t1, elapsed = probe_makespan(rows)
-    wait = sum(r["wait"] / elapsed for r in rows) / len(rows)
+    tasks, elapsed, rate, wait_pct = probe_aggregate(rows)
     return NqNativeResult(
-        solutions=solutions,
+        solutions=sum(r["solutions"] for r in rows),
         expected=KNOWN_SOLUTIONS.get(n),
         tasks=tasks,
         elapsed=elapsed,
-        tasks_per_sec=tasks / elapsed,
-        wait_pct=100.0 * wait,
+        tasks_per_sec=rate,
+        wait_pct=wait_pct,
     )

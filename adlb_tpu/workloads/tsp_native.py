@@ -52,18 +52,15 @@ def run(
         cfg=cfg,
         timeout=timeout,
     )
-    from adlb_tpu.native.capi import parse_probe_lines, probe_makespan
+    from adlb_tpu.native.capi import parse_probe_lines, probe_aggregate
 
     rows = parse_probe_lines(results, "TSP")
-    best = min(r["best"] for r in rows)
-    tasks = sum(r["done"] for r in rows)
-    _t0, _t1, elapsed = probe_makespan(rows)
-    wait = sum(r["wait"] / elapsed for r in rows) / len(rows)
+    tasks, elapsed, rate, wait_pct = probe_aggregate(rows)
     return TspNativeResult(
-        best=best,
+        best=min(r["best"] for r in rows),
         optimum=brute_force_optimum(dists) if n_cities <= 10 else None,
         tasks=tasks,
         elapsed=elapsed,
-        tasks_per_sec=tasks / elapsed,
-        wait_pct=100.0 * wait,
+        tasks_per_sec=rate,
+        wait_pct=wait_pct,
     )

@@ -1,0 +1,171 @@
+/* GFMC-style A/B/C/D work-package economy over the native C API with
+ * self-validating counts AND a self-validating checksum (reference
+ * examples/c4.c, the abstraction of the GFMC nuclear Monte Carlo code;
+ * decomposition shared with adlb_tpu/workloads/gfmc.py):
+ *
+ *   - the master (app rank 0) emits NA type-A packages, then collects
+ *     exactly NA*BPA type-D results targeted back at it;
+ *   - workers expand each A into BPA type-B packages; each B spawns CPB
+ *     type-C packages carrying answer_rank = the B owner's rank (the
+ *     reference's answer-economy field, reference c4.c:31-37), and the C
+ *     consumer routes its answer back to that rank, which combines the
+ *     CPB answers into one D for the master;
+ *   - the expected package counts and the expected checksum are
+ *     computable up front; the master exits nonzero on any mismatch
+ *     (reference c4.c:495-502's self-check).
+ *
+ * Shapes via ADLB_GFMC_NA / ADLB_GFMC_BPA / ADLB_GFMC_CPB.  Every rank
+ * prints
+ *
+ *   GFMC rank=<r> a=<n> b=<n> c=<n> ans=<n> d=<n> t0=... t1=... wait=<s>
+ *
+ * where d counts EMISSIONS (worker-side combines) and ans counts
+ * C-answer receptions (units consumed but outside the package-count
+ * check); the master's D receptions are its own exit-code check, not a
+ * stdout row, keeping the harness's sum-over-ranks == expected test
+ * one-sided.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#include <adlb/adlb.h>
+
+#define TYPE_A 1
+#define TYPE_B 2
+#define TYPE_C 3
+#define TYPE_C_ANSWER 4
+#define TYPE_D 5
+#define PRIO_A 1
+#define PRIO_B 2
+#define PRIO_C 3
+#define PRIO_ANSWER 9
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+int main(void) {
+  int types[5] = {TYPE_A, TYPE_B, TYPE_C, TYPE_C_ANSWER, TYPE_D};
+  int am_server, am_debug, num_apps;
+  const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0;
+  int na = getenv("ADLB_GFMC_NA") ? atoi(getenv("ADLB_GFMC_NA")) : 6;
+  int bpa = getenv("ADLB_GFMC_BPA") ? atoi(getenv("ADLB_GFMC_BPA")) : 4;
+  int cpb = getenv("ADLB_GFMC_CPB") ? atoi(getenv("ADLB_GFMC_CPB")) : 3;
+  if (na < 1 || bpa < 1 || cpb < 1) return 2;
+
+  int rc = ADLB_Init(nservers, 0, 0, 5, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) return 3;
+  int me = ADLB_World_rank();
+
+  long ca = 0, cb = 0, cc = 0, cans = 0, cd = 0;
+  double wait = 0.0, t0 = mono(), t1 = t0;
+  int buf[3];
+
+  if (me == 0) {
+    for (int a = 0; a < na; a++) {
+      buf[0] = a;
+      rc = ADLB_Put(buf, (int)sizeof(int), -1, -1, TYPE_A, PRIO_A);
+      if (rc != ADLB_SUCCESS) return 4;
+    }
+    long expected_d = (long)na * bpa, got = 0, total = 0;
+    while (got < expected_d) {
+      int req[2] = {TYPE_D, ADLB_RESERVE_EOL};
+      int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+      double r0 = mono();
+      rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+      if (rc != ADLB_SUCCESS) return 5; /* master must never lose a D */
+      rc = ADLB_Get_reserved(buf, handle);
+      if (rc != ADLB_SUCCESS) return 6;
+      wait += mono() - r0;
+      t1 = mono();
+      total += buf[0];
+      got++;
+    }
+    ADLB_Set_problem_done();
+    /* checksum: sum over (a,b,c) of (a*100+b)+c — the C "physics" */
+    long want = 0;
+    for (int a = 0; a < na; a++)
+      for (int b = 0; b < bpa; b++)
+        want += (long)cpb * (a * 100 + b) + (long)cpb * (cpb - 1) / 2;
+    printf("GFMC rank=0 a=0 b=0 c=0 ans=0 d=0 t0=%.6f t1=%.6f wait=%.6f\n",
+           t0, t1, wait);
+    ADLB_Finalize();
+    return (total == want) ? 0 : 7;
+  }
+
+  /* worker: every B this rank combines gets a slot; a single rank can in
+   * principle process every B in the run */
+  long max_b = (long)na * bpa;
+  int *pend_left = calloc((size_t)max_b, sizeof(int));
+  int *pend_acc = calloc((size_t)max_b, sizeof(int));
+  if (!pend_left || !pend_acc) return 2;
+  int next_b = 0;
+
+  for (;;) {
+    int req[5] = {TYPE_A, TYPE_B, TYPE_C, TYPE_C_ANSWER, ADLB_RESERVE_EOL};
+    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    double r0 = mono();
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 5;
+    rc = ADLB_Get_reserved(buf, handle);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 6;
+    wait += mono() - r0;
+    t1 = mono();
+    if (wt == TYPE_A) {
+      ca++;
+      int a = buf[0];
+      for (int b = 0; b < bpa; b++) {
+        int out[2] = {a, b};
+        /* no answer expected for a B itself — the answer economy runs on
+         * the TYPE_C puts below */
+        rc = ADLB_Put(out, 2 * (int)sizeof(int), -1, -1, TYPE_B, PRIO_B);
+        if (rc != ADLB_SUCCESS) return 8;
+      }
+    } else if (wt == TYPE_B) {
+      cb++;
+      int a = buf[0], b = buf[1];
+      int b_id = (me << 20) + next_b;
+      pend_left[next_b] = cpb;
+      pend_acc[next_b] = 0;
+      next_b++;
+      for (int c = 0; c < cpb; c++) {
+        int out[3] = {b_id, a * 100 + b, c};
+        /* the answer must come back to THIS rank, which owns the
+         * pending-B state (the reference's answer_rank pattern) */
+        rc = ADLB_Put(out, 3 * (int)sizeof(int), -1, me, TYPE_C, PRIO_C);
+        if (rc != ADLB_SUCCESS) return 8;
+      }
+    } else if (wt == TYPE_C) {
+      cc++;
+      int out[2] = {buf[0], buf[1] + buf[2]}; /* b_id, the "physics" */
+      rc = ADLB_Put(out, 2 * (int)sizeof(int), ar, -1, TYPE_C_ANSWER,
+                    PRIO_ANSWER);
+      if (rc != ADLB_SUCCESS) return 8;
+    } else { /* TYPE_C_ANSWER */
+      cans++;
+      int slot = buf[0] & ((1 << 20) - 1);
+      if ((buf[0] >> 20) != me || slot >= next_b) return 9; /* misrouted */
+      pend_acc[slot] += buf[1];
+      if (--pend_left[slot] == 0) {
+        int out[1] = {pend_acc[slot]};
+        rc = ADLB_Put(out, (int)sizeof(int), 0, -1, TYPE_D, PRIO_ANSWER);
+        if (rc != ADLB_SUCCESS) return 8;
+        cd++;
+      }
+    }
+  }
+
+  printf(
+      "GFMC rank=%d a=%ld b=%ld c=%ld ans=%ld d=%ld t0=%.6f t1=%.6f "
+      "wait=%.6f\n",
+      me, ca, cb, cc, cans, cd, t0, t1, wait);
+  ADLB_Finalize();
+  return 0;
+}

@@ -1,0 +1,219 @@
+/* Sudoku DFS over the native C API: multi-type work units with a
+ * collector rank (reference examples/sudoku.c rebuilt for this plane;
+ * decomposition shared with adlb_tpu/workloads/sudoku.py).
+ *
+ *   - a WORK unit is 82 bytes: the 81-cell board (digits, 0 = empty)
+ *     plus a puzzle-id byte, so several digit-relabeled isomorphs run in
+ *     one pool; a worker fills the most-constrained empty cell, putting
+ *     one child per legal digit with priority = filled-cell count
+ *     (nearly-complete boards drain first);
+ *   - a completed board travels to app rank 0 as a max-priority targeted
+ *     SOLUTION unit (reference sudoku.c:283-287 prints it; here rank 0
+ *     validates it against the puzzle and echoes it for the harness);
+ *   - rank 0 declares the problem done once every puzzle has a valid
+ *     solution; workers then unblock with NO_MORE_WORK.
+ *
+ * Puzzles arrive via ADLB_SUDOKU_PUZZLES (comma-separated 81-char digit
+ * strings, supplied by the Python harness).  Every rank prints
+ *
+ *   SUD rank=<r> done=<n> solved=<n> t0=<mono> t1=<mono> wait=<s>
+ *
+ * and rank 0 additionally prints one "SUDSOL pid=<p> board=<81 chars>"
+ * line per solved puzzle; it exits nonzero unless every solution
+ * validates.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define SOLUTION 2
+#define SOL_PRIO 999999999
+#define MAXP 64 /* max puzzles per run */
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+static int candidates(const unsigned char *b, int idx, int *out) {
+  int used[10] = {0};
+  int r = idx / 9, c = idx % 9;
+  for (int i = 0; i < 9; i++) {
+    used[b[r * 9 + i]] = 1;
+    used[b[i * 9 + c]] = 1;
+  }
+  int br = 3 * (r / 3), bc = 3 * (c / 3);
+  for (int i = 0; i < 3; i++)
+    for (int j = 0; j < 3; j++) used[b[(br + i) * 9 + (bc + j)]] = 1;
+  int n = 0;
+  for (int d = 1; d <= 9; d++)
+    if (!used[d]) out[n++] = d;
+  return n;
+}
+
+static int most_constrained(const unsigned char *b, int *cands, int *ncands) {
+  int best = -1;
+  *ncands = 10;
+  int tmp[9];
+  for (int i = 0; i < 81; i++) {
+    if (b[i]) continue;
+    int n = candidates(b, i, tmp);
+    if (n < *ncands) {
+      best = i;
+      *ncands = n;
+      memcpy(cands, tmp, (size_t)n * sizeof(int));
+      if (n <= 1) break;
+    }
+  }
+  return best;
+}
+
+static int check_solution(const unsigned char *b, const char *puzzle) {
+  for (int i = 0; i < 81; i++) {
+    int given = puzzle[i] - '0';
+    if (given && b[i] != given) return 0;
+  }
+  for (int r = 0; r < 9; r++) {
+    int seen[10] = {0};
+    for (int c = 0; c < 9; c++) seen[b[r * 9 + c]]++;
+    for (int d = 1; d <= 9; d++)
+      if (seen[d] != 1) return 0;
+  }
+  for (int c = 0; c < 9; c++) {
+    int seen[10] = {0};
+    for (int r = 0; r < 9; r++) seen[b[r * 9 + c]]++;
+    for (int d = 1; d <= 9; d++)
+      if (seen[d] != 1) return 0;
+  }
+  for (int br = 0; br < 3; br++)
+    for (int bc = 0; bc < 3; bc++) {
+      int seen[10] = {0};
+      for (int i = 0; i < 3; i++)
+        for (int j = 0; j < 3; j++) seen[b[(3 * br + i) * 9 + (3 * bc + j)]]++;
+      for (int d = 1; d <= 9; d++)
+        if (seen[d] != 1) return 0;
+    }
+  return 1;
+}
+
+int main(void) {
+  int types[2] = {WORK, SOLUTION};
+  int am_server, am_debug, num_apps;
+  const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0;
+  const char *penv = getenv("ADLB_SUDOKU_PUZZLES");
+  if (!penv) return 2;
+  static char puzzles[MAXP][82];
+  int np = 0;
+  const char *p = penv;
+  while (*p) {
+    if (np == MAXP) return 2; /* over the cap: error, not a silent drop */
+    if (strlen(p) < 81) return 2;
+    memcpy(puzzles[np], p, 81);
+    puzzles[np][81] = 0;
+    np++;
+    p += 81;
+    if (*p == ',') p++;
+    else if (*p) return 2;
+  }
+  if (np == 0) return 2;
+
+  int rc = ADLB_Init(nservers, 0, 0, 2, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) return 3;
+  int me = ADLB_World_rank();
+
+  long done = 0;
+  int solved = 0;
+  double wait = 0.0, t0 = mono(), t1 = t0;
+  unsigned char buf[82];
+
+  if (me == 0) {
+    for (int pid = 0; pid < np; pid++) {
+      int filled = 0;
+      for (int i = 0; i < 81; i++) {
+        buf[i] = (unsigned char)(puzzles[pid][i] - '0');
+        if (buf[i]) filled++;
+      }
+      buf[81] = (unsigned char)pid;
+      rc = ADLB_Put(buf, 82, -1, -1, WORK, filled);
+      if (rc != ADLB_SUCCESS) return 4;
+    }
+    int got[MAXP] = {0};
+    int bad = 0;
+    while (solved < np) {
+      int req[2] = {SOLUTION, ADLB_RESERVE_EOL};
+      int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+      double r0 = mono();
+      rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+      if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+      if (rc != ADLB_SUCCESS || wl != 82) return 5;
+      rc = ADLB_Get_reserved(buf, handle);
+      if (rc != ADLB_SUCCESS) return 6;
+      wait += mono() - r0;
+      t1 = mono();
+      int pid = buf[81];
+      if (pid >= np || got[pid]) continue; /* duplicate solver finish */
+      got[pid] = 1;
+      solved++;
+      if (!check_solution(buf, puzzles[pid])) {
+        bad++;
+        continue;
+      }
+      printf("SUDSOL pid=%d board=", pid);
+      for (int i = 0; i < 81; i++) putchar('0' + buf[i]);
+      putchar('\n');
+    }
+    ADLB_Set_problem_done();
+    printf("SUD rank=0 done=%ld solved=%d t0=%.6f t1=%.6f wait=%.6f\n",
+           done, solved, t0, t1, wait);
+    ADLB_Finalize();
+    return (bad == 0 && solved == np) ? 0 : 7;
+  }
+
+  for (;;) {
+    int req[2] = {WORK, ADLB_RESERVE_EOL};
+    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    double r0 = mono();
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS || wl != 82) return 5;
+    rc = ADLB_Get_reserved(buf, handle);
+    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+    if (rc != ADLB_SUCCESS) return 6;
+    wait += mono() - r0;
+    done++;
+    t1 = mono();
+    int cands[9], nc;
+    int idx = most_constrained(buf, cands, &nc);
+    if (idx < 0) { /* solved: send to the collector */
+      rc = ADLB_Put(buf, 82, 0, -1, SOLUTION, SOL_PRIO);
+      if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) return 8;
+      continue;
+    }
+    int filled = 0;
+    for (int i = 0; i < 81; i++)
+      if (buf[i]) filled++;
+    ADLB_Begin_batch_put(NULL, 0);
+    for (int k = 0; k < nc; k++) {
+      buf[idx] = (unsigned char)cands[k];
+      rc = ADLB_Put(buf, 82, -1, -1, WORK, filled + 1);
+      if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) {
+        ADLB_End_batch_put();
+        return 8;
+      }
+    }
+    ADLB_End_batch_put();
+    buf[idx] = 0;
+  }
+
+  printf("SUD rank=%d done=%ld solved=0 t0=%.6f t1=%.6f wait=%.6f\n", me,
+         done, t0, t1, wait);
+  ADLB_Finalize();
+  return 0;
+}

@@ -443,6 +443,45 @@ def test_all_native_tsp_known_answer(mode):
     assert r.tasks > 0
 
 
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_all_native_sudoku_known_answer(mode):
+    """Sudoku as C clients against C++ daemons: collector-rank economy
+    (targeted max-priority SOLUTION units), batch-put expansion, problem-
+    done termination; solutions validate in C (exit code) AND in the
+    harness (reference examples/sudoku.c on the native plane)."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.workloads import sudoku_native
+
+    r = sudoku_native.run(
+        n_puzzles=2, num_app_ranks=4, nservers=2,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.2),
+        timeout=120.0,
+    )
+    assert r.valid, r
+    assert r.solved == 2 and r.tasks > 0
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_all_native_gfmc_known_answer(mode):
+    """The A/B/C/D answer economy as C clients: answer_rank routing of C
+    answers back to the B owner, targeted D funnel to the master, count
+    AND checksum self-checks (reference examples/c4.c:31-37,495-502 on
+    the native plane)."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.workloads import gfmc_native
+
+    r = gfmc_native.run(
+        num_a=6, bs_per_a=4, cs_per_b=3, num_app_ranks=4, nservers=2,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.2),
+        timeout=120.0,
+    )
+    assert r.ok, (r.counts, r.expected)
+    # every package plus one C-answer reception per C emission
+    assert r.tasks == sum(r.expected.values()) + r.expected["c"]
+
+
 def test_all_native_hotspot_harness():
     """The native-scale hotspot bench harness: home-routed C producers, C
     worker processes, C++ daemons, tpu balancer sidecar — every token
