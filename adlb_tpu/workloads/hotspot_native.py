@@ -25,17 +25,24 @@ def run(
     nservers: int = 8,
     cfg: Optional[Config] = None,
     timeout: float = 300.0,
+    fetch: str = "single",
 ) -> HotspotResult:
+    """``fetch="batch"`` (or ``"batch:<k>"``) switches the consumers to
+    the batched fused fetch ``ADLB_Get_work_batch`` so the bench can
+    measure the single-vs-batch delta on this plane."""
     from adlb_tpu.native.capi import run_native_probe
 
+    env = {
+        "ADLB_PUT_ROUTING": "home",
+        "ADLB_HOT_NTASKS": str(n_tasks),
+        "ADLB_HOT_WORK_US": str(work_us),
+    }
+    if fetch != "single":
+        env["ADLB_HOT_FETCH"] = fetch
     results = run_native_probe(
         "hotspot_c.c",
         types=[1],
-        env_extra={
-            "ADLB_PUT_ROUTING": "home",
-            "ADLB_HOT_NTASKS": str(n_tasks),
-            "ADLB_HOT_WORK_US": str(work_us),
-        },
+        env_extra=env,
         num_app_ranks=num_app_ranks,
         nservers=nservers,
         cfg=cfg,

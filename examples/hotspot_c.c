@@ -18,6 +18,7 @@
  */
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -67,30 +68,55 @@ int main(void) {
   int done = 0;
   double wait = 0.0;
   double t0 = mono(), t1 = t0;
-  for (;;) {
-    /* wait = time blocked acquiring work, the steal-to-exec quantity;
-     * "busy" is reported as NOMINAL compute (done * work_us) because on
-     * an oversubscribed host the wall time of usleep includes
-     * involuntary scheduler delay — a wall-clock busy measure inflates
-     * utilization in exactly the runs where the kernel scheduler, not
-     * balancing, is the bottleneck, making idle% move against
-     * throughput. Consumption uses the fused ADLB_Get_work (one round
-     * trip when the unit is LOCAL to the home server): both modes issue
-     * the identical call, so the mode that pre-positions work locally
-     * is paid for that locality — the quantity this scenario measures.
-     * (The batched ADLB_Get_work_batch exists and wins on the in-proc
-     * plane; under the sidecar pump at 64+ ranks its lumpier
-     * consumption interacts with refill cadence draw-dependently on
-     * this one-core host, so the benchmark keeps the single-unit call —
-     * see BASELINE.md.) */
-    char buf[8];
-    double r0 = mono();
-    rc = ADLB_Get_work(req, &wt, &wp, buf, (int)sizeof buf, &wl, &ar);
-    if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / DONE_BY_EXHAUSTION */
-    wait += mono() - r0;
-    usleep((useconds_t)work_us);
-    done++;
-    t1 = mono();
+  /* wait = time blocked acquiring work, the steal-to-exec quantity;
+   * "busy" is reported as NOMINAL compute (done * work_us) because on
+   * an oversubscribed host the wall time of usleep includes
+   * involuntary scheduler delay — a wall-clock busy measure inflates
+   * utilization in exactly the runs where the kernel scheduler, not
+   * balancing, is the bottleneck, making idle% move against
+   * throughput. Default consumption uses the fused ADLB_Get_work (one
+   * round trip when the unit is LOCAL to the home server): both modes
+   * issue the identical call, so the mode that pre-positions work
+   * locally is paid for that locality — the quantity this scenario
+   * measures.  ADLB_HOT_FETCH=batch:<k> switches to the batched fused
+   * fetch (up to k local units per round trip) so the bench can state
+   * the measured single-vs-batch delta on this plane (see BASELINE.md
+   * for the cadence-interaction caveat that keeps single-unit the
+   * default). */
+  int batch = 0;
+  const char *fetch_env = getenv("ADLB_HOT_FETCH");
+  if (fetch_env && strncmp(fetch_env, "batch", 5) == 0) {
+    batch = (fetch_env[5] == ':') ? atoi(fetch_env + 6) : 8;
+    if (batch < 1 || batch > 64) return 4; /* reject, never silently remap:
+                                            * the bench records the delta
+                                            * under the REQUESTED k */
+  }
+  if (batch) {
+    int wts[64], wps[64], wls[64], ars[64], ngot;
+    char bufs[64 * 8];
+    for (;;) {
+      double r0 = mono();
+      rc = ADLB_Get_work_batch(req, batch, &ngot, wts, wps, bufs, 8, wls,
+                               ars);
+      if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / EXHAUSTION */
+      wait += mono() - r0;
+      for (int i = 0; i < ngot; i++) {
+        usleep((useconds_t)work_us);
+        done++;
+        t1 = mono();
+      }
+    }
+  } else {
+    for (;;) {
+      char buf[8];
+      double r0 = mono();
+      rc = ADLB_Get_work(req, &wt, &wp, buf, (int)sizeof buf, &wl, &ar);
+      if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / DONE_BY_EXHAUSTION */
+      wait += mono() - r0;
+      usleep((useconds_t)work_us);
+      done++;
+      t1 = mono();
+    }
   }
   double busy = (double)done * (double)work_us * 1e-6;
   printf("HOT done=%d busy=%.6f t0=%.6f t1=%.6f wait=%.6f\n", done, busy,

@@ -498,6 +498,13 @@ def test_all_native_hotspot_harness():
     assert r.tasks == 120
     assert r.tasks_per_sec > 0
     assert 0.0 <= r.idle_pct <= 100.0
+    # batched fused fetch: same scenario, consumers on ADLB_Get_work_batch
+    rb = hotspot_native.run(
+        n_tasks=120, work_us=1000, num_app_ranks=6, nservers=3,
+        cfg=Config(balancer="tpu", exhaust_check_interval=0.2),
+        timeout=120.0, fetch="batch:4",
+    )
+    assert rb.tasks == 120  # no unit lost or double-counted under batching
 
 
 def test_all_native_trickle_harness():
