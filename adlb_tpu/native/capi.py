@@ -131,7 +131,10 @@ def parse_probe_lines(results, prefix: str):
             try:
                 kv[k] = int(v)
             except ValueError:
-                kv[k] = float(v)
+                try:
+                    kv[k] = float(v)
+                except ValueError:
+                    kv[k] = v  # non-numeric marker (e.g. fetch=batch)
         rows.append(kv)
     return rows
 
@@ -144,16 +147,20 @@ def probe_makespan(rows):
     return t_begin, t_end, max(t_end - t_begin, 1e-9)
 
 
-def probe_aggregate(rows, tasks=None, done_key="done"):
+def probe_aggregate(rows, tasks=None, done_key="done", wait_rows=None):
     """The aggregation every native probe harness repeats: total units,
     cross-process makespan, rate, and mean wait fraction.  ``tasks``
     overrides the default sum of ``done_key`` for probes whose unit count
-    is assembled from several fields.  Returns
+    is assembled from several fields; ``wait_rows`` restricts the wait
+    average to the ranks that actually consume (dedicated producers and
+    collectors are blocked by design and would add a ~1/nranks floor
+    that says nothing about balancing).  Returns
     (tasks, elapsed, tasks_per_sec, wait_pct)."""
     _t0, _t1, elapsed = probe_makespan(rows)
     if tasks is None:
         tasks = sum(r[done_key] for r in rows)
-    wait = sum(r["wait"] / elapsed for r in rows) / len(rows)
+    wrows = rows if wait_rows is None else wait_rows
+    wait = sum(r["wait"] / elapsed for r in wrows) / len(wrows)
     return tasks, elapsed, tasks / elapsed, 100.0 * wait
 
 

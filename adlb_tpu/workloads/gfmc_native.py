@@ -73,11 +73,9 @@ def run(
     # its producer), else wait_pct carries a ~1/num_app_ranks floor that
     # says nothing about balancing.
     tasks = sum(counts.values()) + sum(r["ans"] for r in rows)
-    _t, elapsed, rate, _w = probe_aggregate(rows, tasks=tasks)
-    workers = rows[1:]
-    wait_pct = 100.0 * sum(
-        r["wait"] / elapsed for r in workers
-    ) / len(workers)
+    tasks, elapsed, rate, wait_pct = probe_aggregate(
+        rows, tasks=tasks, wait_rows=rows[1:]
+    )
     return GfmcNativeResult(
         ok=all(counts[k] == expected[k] for k in expected),
         counts=counts,

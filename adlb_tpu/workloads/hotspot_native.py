@@ -50,9 +50,22 @@ def run(
     )
     from adlb_tpu.native.capi import parse_probe_lines
 
+    raw = parse_probe_lines(results, "HOT")
+    # the fetch mode must have ENGAGED, not just been requested: a broken
+    # env plumbing falling back to single-unit would silently mislabel
+    # the bench's batch rows (the producer row predates the field)
+    want_mode = "batch" if fetch.startswith("batch") else "single"
+    wrong = [
+        r for r in raw[1:] if r.get("fetch", "single") != want_mode
+    ]
+    if wrong:
+        raise RuntimeError(
+            f"hotspot fetch mode mismatch: requested {fetch!r}, "
+            f"workers report {wrong[:2]}"
+        )
     rows = [
         (r["done"], r["busy"], r["t0"], r["t1"], r.get("wait", 0.0))
-        for r in parse_probe_lines(results, "HOT")
+        for r in raw
     ]
     workers = rows[1:]
     tasks = sum(r[0] for r in workers)

@@ -75,11 +75,9 @@ def run(
     # rank 0 is a dedicated SOLUTION collector (done=0, blocked most of
     # the makespan by design): keep it in the makespan, exclude it from
     # the wait average — same treatment as hotspot_native's producer
-    tasks, elapsed, rate, _w = probe_aggregate(rows)
-    workers = rows[1:]
-    wait_pct = 100.0 * sum(
-        r["wait"] / elapsed for r in workers
-    ) / len(workers)
+    tasks, elapsed, rate, wait_pct = probe_aggregate(
+        rows, wait_rows=rows[1:]
+    )
     return SudokuNativeResult(
         valid=valid,
         solved=rows[0]["solved"],

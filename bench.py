@@ -128,12 +128,18 @@ def main() -> None:
                       balancer_max_requesters=256,
                       solver_host_threshold=10**6)
 
+    # AssertionError included everywhere native worlds are contained: the
+    # workload wrappers fail via known-answer asserts, and a single bad
+    # rep (lost unit, wrong B&B answer) must burn its own row, not the
+    # whole bench record
+    _NATIVE_ERRS = (RuntimeError, OSError, TimeoutError, AssertionError)
+
     def native_retry(run_one, *args, **kw):
         last = None
         for attempt in range(2):  # one retry: OS-level worlds can lose a
             try:                  # process to transient memory pressure
                 return run_one(*args, **kw)
-            except (RuntimeError, OSError, TimeoutError) as e:
+            except _NATIVE_ERRS as e:
                 last = e
         raise last
 
@@ -198,7 +204,7 @@ def main() -> None:
             # item 7; cadence-interaction caveat in BASELINE.md)
             "native_64r_tpu_fetch_mode": "single",
         }
-    except (RuntimeError, OSError, TimeoutError) as e:
+    except _NATIVE_ERRS as e:
         # no C toolchain (or daemon spawn failure): report, don't die
         native_rows = {"native_error": repr(e)}
 
@@ -223,7 +229,7 @@ def main() -> None:
                 100.0 * (nb_batch.tasks_per_sec / nb_one.tasks_per_sec - 1.0),
                 1) if nb_one.tasks_per_sec else 0.0,
         })
-    except (RuntimeError, OSError, TimeoutError) as e:
+    except _NATIVE_ERRS as e:
         native_rows.setdefault("native_batch_error", repr(e))
 
     # THE north-star workloads at native scale (VERDICT r4 item 1:
@@ -262,7 +268,12 @@ def main() -> None:
 
         for apps, servers, tag in ((64, 16, "64r"), (128, 32, "128r")):
             for name, one in (("nq", nq_scale_one), ("tsp", tsp_scale_one)):
-                runs = interleaved(lambda m: one(m, apps, servers))
+                # tsp@64r gets 5 reps: it is the one row whose ratio has
+                # sat below 1.0, and B&B draws swing ±30% — the interval
+                # needs more than a best-of-3 median
+                nreps = 5 if (name == "tsp" and tag == "64r") else 3
+                runs = interleaved(lambda m: one(m, apps, servers),
+                                   reps=nreps)
                 st = median_by(runs["steal"], key=lambda r: r.tasks_per_sec)
                 tp = median_by(runs["tpu"], key=lambda r: r.tasks_per_sec)
                 native_rows.update({
@@ -277,8 +288,14 @@ def main() -> None:
                         st.wait_pct, 1),
                     f"native_{name}_{tag}_tpu_wait_pct": round(
                         tp.wait_pct, 1),
+                    # per-rep spreads (full record only): every scale
+                    # claim auditable from the BENCH file alone
+                    f"native_{name}_{tag}_steal_reps": [
+                        round(r.tasks_per_sec) for r in runs["steal"]],
+                    f"native_{name}_{tag}_tpu_reps": [
+                        round(r.tasks_per_sec) for r in runs["tpu"]],
                 })
-    except (RuntimeError, OSError, TimeoutError) as e:
+    except _NATIVE_ERRS as e:
         native_rows.setdefault("native_scale_error", repr(e))
 
     # trickle on the all-native plane: the dispatch-latency story without
@@ -310,7 +327,7 @@ def main() -> None:
                 nt_steal.dispatch_p50_ms / nt_tpu.dispatch_p50_ms, 2)
             if nt_tpu.dispatch_p50_ms else 0.0,
         })
-    except (RuntimeError, OSError, TimeoutError) as e:
+    except _NATIVE_ERRS as e:
         native_rows.setdefault("native_error", repr(e))
 
     def nq_one(mode):
@@ -728,13 +745,21 @@ def main() -> None:
     rates = lambda runs: [r.tasks_per_sec for r in runs]  # noqa: E731
     idles = lambda runs: [r.idle_pct for r in runs]  # noqa: E731
 
-    def pair_ratio(runs):
+    def pair_ratio(runs, rate=lambda r: r.tasks_per_sec):
+        """Median of per-rep-PAIR tpu/steal ratios: adjacent interleaved
+        reps share the host's hour-scale phase, so the per-pair ratio
+        cancels it (the VERDICT r4 item-4 interval evidence).  ``rate``
+        extracts a rep's rate — result objects by default, or
+        (tasks, elapsed) tuples via pair_ratio_t."""
         pairs = [
-            t.tasks_per_sec / s.tasks_per_sec
+            rate(t) / rate(s)
             for s, t in zip(runs["steal"], runs["tpu"])
-            if s.tasks_per_sec
+            if rate(s)
         ]
         return round(median_by(pairs), 3) if pairs else 0.0
+
+    def pair_ratio_t(runs):
+        return pair_ratio(runs, rate=lambda r: r[0] / r[1])
     compact = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -764,9 +789,12 @@ def main() -> None:
             "nq": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
             if steal.tasks_per_sec else 0.0,
             "tsp": round(tsp_tpu / tsp_steal, 3) if tsp_steal else 0.0,
+            "tsp_pair": pair_ratio_t(tsp_runs),
             "sudoku": round(sudoku_tpu / sudoku_steal, 3)
             if sudoku_steal else 0.0,
+            "sud_pair": pair_ratio_t(sudoku_runs),
             "gfmc": round(gfmc_tpu / gfmc_steal, 3) if gfmc_steal else 0.0,
+            "gfmc_pair": pair_ratio_t(gfmc_runs),
             "n16_ratio": native_rows.get("native_16r_ratio"),
             "n64_ratio": native_rows.get("native_64r_ratio"),
             "n16_wait": [native_rows.get("native_16r_steal_wait_pct"),

@@ -86,11 +86,18 @@ int main(void) {
   int batch = 0;
   const char *fetch_env = getenv("ADLB_HOT_FETCH");
   if (fetch_env && strncmp(fetch_env, "batch", 5) == 0) {
-    batch = (fetch_env[5] == ':') ? atoi(fetch_env + 6) : 8;
-    if (batch < 1 || batch > 64) return 4; /* reject, never silently remap:
-                                            * the bench records the delta
-                                            * under the REQUESTED k */
+    /* only "batch" (default k=8) or "batch:<k>" — anything else is
+     * rejected, never silently remapped: the bench records the delta
+     * under the REQUESTED k */
+    if (fetch_env[5] == ':') batch = atoi(fetch_env + 6);
+    else if (fetch_env[5] == '\0') batch = 8;
+    else return 4;
+    if (batch < 1 || batch > 64) return 4;
+  } else if (fetch_env && strcmp(fetch_env, "single") != 0) {
+    return 4;
   }
+  long rts = 0; /* fetch round trips: under batching, rts < done when any
+                 * batch carried >1 unit — the realized amortization */
   if (batch) {
     int wts[64], wps[64], wls[64], ars[64], ngot;
     char bufs[64 * 8];
@@ -100,6 +107,7 @@ int main(void) {
                                ars);
       if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / EXHAUSTION */
       wait += mono() - r0;
+      rts++;
       for (int i = 0; i < ngot; i++) {
         usleep((useconds_t)work_us);
         done++;
@@ -113,14 +121,15 @@ int main(void) {
       rc = ADLB_Get_work(req, &wt, &wp, buf, (int)sizeof buf, &wl, &ar);
       if (rc != ADLB_SUCCESS) break; /* NO_MORE_WORK / DONE_BY_EXHAUSTION */
       wait += mono() - r0;
+      rts++;
       usleep((useconds_t)work_us);
       done++;
       t1 = mono();
     }
   }
   double busy = (double)done * (double)work_us * 1e-6;
-  printf("HOT done=%d busy=%.6f t0=%.6f t1=%.6f wait=%.6f\n", done, busy,
-         t0, t1, wait);
+  printf("HOT done=%d busy=%.6f t0=%.6f t1=%.6f wait=%.6f fetch=%s rts=%ld\n",
+         done, busy, t0, t1, wait, batch ? "batch" : "single", rts);
   ADLB_Finalize();
   return 0;
 }

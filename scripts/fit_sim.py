@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Fit the shared-core simulator's three host constants to a measured
+scaling curve (scripts/scaling_curve.py output), then report per-cell
+error.  Used each time the engine changes enough to re-measure the
+curve: re-run the curve, re-fit here, paste the winning constants +
+curve into scripts/sim_scale.py, and re-pin tests/test_sim_scale.py.
+
+Usage:
+  python scripts/fit_sim.py '{"4": [0.008, 1632, 1774], ...}'
+  (keys = servers, values = [grain_s, steal_tasks/s, tpu_tasks/s];
+   defaults to sim_scale.MEASURED_CURVE when no argument is given)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sim_scale import MEASURED_CURVE, Sim  # noqa: E402
+
+
+def fit(curve) -> dict:
+    best = None
+    # grid spans: t_serve_shared around the protocol-exchange CPU cost,
+    # wake term around the kernel's per-completion runqueue cost
+    for ts, tw, fl in itertools.product(
+        (24e-6, 28e-6, 32e-6, 36e-6, 40e-6, 48e-6),
+        (0.0, 1.5e-6, 2.25e-6, 3.0e-6, 4.5e-6, 6.0e-6),
+        (4, 8, 16),
+    ):
+        worst = 0.0
+        cells = {}
+        for s, (wt, m_steal, m_tpu) in curve.items():
+            r_s = Sim(nservers=s, mode="steal", shared_core=True,
+                      work_time=wt, t_serve_shared=ts,
+                      t_wake_per_busy=tw, wake_busy_floor=fl).run()
+            r_t = Sim(nservers=s, mode="tpu", shared_core=True,
+                      work_time=wt, t_serve_shared=ts,
+                      t_wake_per_busy=tw, wake_busy_floor=fl).run()
+            es = r_s["tasks_per_sec"] / m_steal - 1.0
+            et = r_t["tasks_per_sec"] / m_tpu - 1.0
+            cells[s] = (round(es, 3), round(et, 3))
+            worst = max(worst, abs(es), abs(et))
+        if best is None or worst < best["worst"]:
+            best = {"t_serve_shared": ts, "t_wake_per_busy": tw,
+                    "wake_busy_floor": fl, "worst": round(worst, 3),
+                    "cells": cells}
+    return best
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        raw = json.loads(sys.argv[1])
+        curve = {int(k): tuple(v) for k, v in raw.items()}
+    else:
+        curve = MEASURED_CURVE
+    best = fit(curve)
+    print(json.dumps({"curve": {str(k): v for k, v in curve.items()},
+                      "best_fit": best}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,15 +47,22 @@ import argparse
 import heapq
 import json
 
-# Measured native curve (scripts/scaling_curve.py, 2026-07-30, round 3):
-# {servers: (grain_s, steal_tasks/s, tpu_tasks/s)}. Single source of
-# truth for the shared-core calibration — main() prints sim/meas against
-# it and tests/test_sim_scale.py pins the fit to it.
+# Measured native curve (scripts/scaling_curve.py, 2026-07-31, round 5 —
+# re-measured with the round-5 engine per the round-4 verdict item 3;
+# the host ran ~25% slower than the round-4 session, which the fitted
+# constants absorb): {servers: (grain_s, steal_tasks/s, tpu_tasks/s)}.
+# Single source of truth for the shared-core calibration — main() prints
+# sim/meas against it, scripts/fit_sim.py re-derives the constants from
+# it, and tests/test_sim_scale.py pins the fit to it.  The 128-rank rate
+# draw inverted (0.938) in this session while the wait%% gap stayed in
+# the balancer's favor (30.2 vs 40.1) — the documented one-core
+# scheduler artifact; the fit reproduces the inversion (see
+# test_shared_core_reproduces_measured_curve_both_columns).
 MEASURED_CURVE = {
-    4: (0.008, 1589.4, 1698.0),
-    8: (0.008, 3014.9, 3353.0),
-    16: (0.008, 4673.6, 4177.0),
-    32: (0.024, 2998.9, 2766.0),
+    4: (0.008, 1572.9, 1685.2),
+    8: (0.008, 2882.2, 3270.5),
+    16: (0.008, 3774.7, 4567.3),
+    32: (0.024, 2462.7, 2309.5),
 }
 
 
@@ -78,7 +85,7 @@ class Sim:
         lookahead: int = 8,
         look_max: int = 512,
         shared_core: bool = False,
-        t_serve_shared: float = 32e-6,  # CPU per protocol exchange
+        t_serve_shared: float = 36e-6,  # CPU per protocol exchange
         t_wake_per_proc: float = 0.0,  # per-process wakeup (fitted ~0)
         # round-4 term (the round-3 model's admitted gap): per task
         # completion the kernel's timer/runqueue work scales with how
@@ -90,7 +97,7 @@ class Sim:
         # scheduling; steal, paced by its own reactor bottleneck,
         # loses ~8).
         t_wake_per_busy: float = 3.0e-6,
-        wake_busy_floor: int = 8,
+        wake_busy_floor: int = 4,
         t_plan_per_server: float = 25e-6,  # balancer round CPU / server
     ) -> None:
         self.S = nservers
@@ -121,10 +128,10 @@ class Sim:
         # round) lands on the same core — the sidecar tax a
         # one-core-per-rank deployment does not pay. The constants
         # (t_serve_shared, t_wake_per_busy, wake_busy_floor) are fitted
-        # to BOTH measured columns of scripts/scaling_curve.py
-        # (16/32/64/128 ranks, 2026-07-30); worst fitted cell 18%, most
-        # within 15% — inside the host's own ±15-30% draw noise. Pinned
-        # by tests/test_sim_scale.py.
+        # (scripts/fit_sim.py grid search) to BOTH measured columns of
+        # scripts/scaling_curve.py (16/32/64/128 ranks, 2026-07-31,
+        # round-5 engine); worst fitted cell 11% — inside the host's own
+        # ±15-30% draw noise. Pinned by tests/test_sim_scale.py.
         self.shared_core = shared_core
         nprocs = self.W + self.S + (1 if mode == "tpu" else 0)
         # scale every reactor cost into shared-CPU units

@@ -42,12 +42,14 @@ def test_pump_beats_pull_at_scale():
 
 def test_shared_core_reproduces_measured_curve_both_columns():
     """The shared-core mode's whole claim is calibration: with the fitted
-    constants (t_serve_shared, t_wake_per_busy, wake_busy_floor — round
-    4 added the occupancy wakeup term, the round-3 model's admitted
-    missing asymmetry) it must keep reproducing BOTH columns of the
-    measured scripts/scaling_curve.py run (2026-07-30, BASELINE.md 'sim
+    constants (t_serve_shared, t_wake_per_busy, wake_busy_floor —
+    re-derived by scripts/fit_sim.py against the round-5 curve per the
+    round-4 verdict item 3) it must keep reproducing BOTH columns of the
+    measured scripts/scaling_curve.py run (2026-07-31, BASELINE.md 'sim
     vs measured') within the host's ±15-30%% draw-noise band. Worst
-    fitted cell is 18%% (steal@128r); the pin catches parameter drift."""
+    fitted cell is 11.1%% (tpu@32r); the pin catches parameter drift —
+    including the measured 128-rank rate inversion (0.938), which the
+    fit reproduces rather than smooths away."""
     from sim_scale import MEASURED_CURVE
 
     for s, (wt, m_steal, m_tpu) in MEASURED_CURVE.items():
