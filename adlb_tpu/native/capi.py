@@ -147,6 +147,21 @@ def probe_makespan(rows):
     return t_begin, t_end, max(t_end - t_begin, 1e-9)
 
 
+def check_fetch_mode(rows, fetch: str, what: str, skip_first: bool = False):
+    """Every consuming rank must report the REQUESTED fetch mode — a
+    broken env plumbing falling back to single-unit would silently
+    mislabel the bench's batch rows.  ``skip_first`` skips a rank-0
+    producer/collector row that predates the field."""
+    want = "batch" if fetch.startswith("batch") else "single"
+    check = rows[1:] if skip_first else rows
+    wrong = [r for r in check if r.get("fetch", "single") != want]
+    if wrong:
+        raise RuntimeError(
+            f"{what} fetch mode mismatch: requested {fetch!r}, "
+            f"ranks report {wrong[:2]}"
+        )
+
+
 def probe_aggregate(rows, tasks=None, done_key="done", wait_rows=None):
     """The aggregation every native probe harness repeats: total units,
     cross-process makespan, rate, and mean wait fraction.  ``tasks``

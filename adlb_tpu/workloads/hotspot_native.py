@@ -48,21 +48,10 @@ def run(
         cfg=cfg,
         timeout=timeout,
     )
-    from adlb_tpu.native.capi import parse_probe_lines
+    from adlb_tpu.native.capi import check_fetch_mode, parse_probe_lines
 
     raw = parse_probe_lines(results, "HOT")
-    # the fetch mode must have ENGAGED, not just been requested: a broken
-    # env plumbing falling back to single-unit would silently mislabel
-    # the bench's batch rows (the producer row predates the field)
-    want_mode = "batch" if fetch.startswith("batch") else "single"
-    wrong = [
-        r for r in raw[1:] if r.get("fetch", "single") != want_mode
-    ]
-    if wrong:
-        raise RuntimeError(
-            f"hotspot fetch mode mismatch: requested {fetch!r}, "
-            f"workers report {wrong[:2]}"
-        )
+    check_fetch_mode(raw, fetch, "hotspot", skip_first=True)
     rows = [
         (r["done"], r["busy"], r["t0"], r["t1"], r.get("wait", 0.0))
         for r in raw

@@ -35,26 +35,38 @@ def run(
     seed: int = 0,
     cfg: Optional[Config] = None,
     timeout: float = 300.0,
+    fetch: str = "single",
 ) -> TspNativeResult:
+    """``fetch="batch"`` / ``"batch:<k>"`` switches the C clients to the
+    batched fused fetch (``ADLB_Get_work_batch``); priority order inside
+    a batch keeps BOUND_UPDT units ahead of WORK."""
     from adlb_tpu.native.capi import run_native_probe
 
     dists = dist_matrix(make_cities(n_cities, seed))
     flat = ",".join(str(d) for row in dists for d in row)
+    env = {
+        "ADLB_TSP_N": str(n_cities),
+        "ADLB_TSP_DISTS": flat,
+    }
+    if fetch != "single":
+        env["ADLB_TSP_FETCH"] = fetch
     results = run_native_probe(
         "tsp_c.c",
         types=[1, 2],
-        env_extra={
-            "ADLB_TSP_N": str(n_cities),
-            "ADLB_TSP_DISTS": flat,
-        },
+        env_extra=env,
         num_app_ranks=num_app_ranks,
         nservers=nservers,
         cfg=cfg,
         timeout=timeout,
     )
-    from adlb_tpu.native.capi import parse_probe_lines, probe_aggregate
+    from adlb_tpu.native.capi import (
+        check_fetch_mode,
+        parse_probe_lines,
+        probe_aggregate,
+    )
 
     rows = parse_probe_lines(results, "TSP")
+    check_fetch_mode(rows, fetch, "tsp")
     tasks, elapsed, rate, wait_pct = probe_aggregate(rows)
     return TspNativeResult(
         best=min(r["best"] for r in rows),

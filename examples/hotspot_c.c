@@ -86,12 +86,19 @@ int main(void) {
   int batch = 0;
   const char *fetch_env = getenv("ADLB_HOT_FETCH");
   if (fetch_env && strncmp(fetch_env, "batch", 5) == 0) {
-    /* only "batch" (default k=8) or "batch:<k>" — anything else is
-     * rejected, never silently remapped: the bench records the delta
-     * under the REQUESTED k */
-    if (fetch_env[5] == ':') batch = atoi(fetch_env + 6);
-    else if (fetch_env[5] == '\0') batch = 8;
-    else return 4;
+    /* only "batch" (default k=8) or "batch:<k>" — anything else,
+     * trailing junk included, is rejected, never silently remapped:
+     * the bench records the delta under the REQUESTED k */
+    if (fetch_env[5] == ':') {
+      char *end = NULL;
+      long k = strtol(fetch_env + 6, &end, 10);
+      if (!end || *end != '\0' || end == fetch_env + 6) return 4;
+      batch = (int)k;
+    } else if (fetch_env[5] == '\0') {
+      batch = 8;
+    } else {
+      return 4;
+    }
     if (batch < 1 || batch > 64) return 4;
   } else if (fetch_env && strcmp(fetch_env, "single") != 0) {
     return 4;

@@ -42,6 +42,9 @@
 
 static int n_cities;
 static int dists[MAXN][MAXN];
+static int best;                  /* local bound (nearest-neighbour seed) */
+static int lchild = -1, rchild = -1; /* bound-broadcast tree */
+static long done_units, nput;
 
 static double mono(void) {
   struct timespec ts;
@@ -92,6 +95,61 @@ static int greedy_bound(void) {
   return total + dists[tour[n_cities - 1]][0];
 }
 
+/* One consumed unit (either type), shared by the single-unit and batched
+ * loops; returns 0 or a nonzero exit code. */
+static int process_unit(int *u, int wl, int wt) {
+  int rc;
+  if (wt == BOUND_UPDT) {
+    if (u[0] < best) {
+      best = u[0];
+      /* forward the improvement down the binary tree */
+      if (lchild >= 0)
+        ADLB_Put(u, (int)sizeof(int), lchild, -1, BOUND_UPDT, BOUND_PRIO);
+      if (rchild >= 0)
+        ADLB_Put(u, (int)sizeof(int), rchild, -1, BOUND_UPDT, BOUND_PRIO);
+    }
+    return 0;
+  }
+  done_units++;
+  int length = u[0];
+  int *path = &u[1];
+  int k = wl / (int)sizeof(int) - 1; /* cities in the partial tour */
+  if (length >= best) return 0;      /* pruned under a tighter bound */
+  if (k == n_cities) {               /* complete: close the tour */
+    int total = length + dists[path[k - 1]][0];
+    if (total < best) {
+      /* funnel to rank 0, which broadcasts down the tree.  Local
+       * `best` is deliberately NOT set here (reference tsp.c:245-266
+       * semantics): the tightened bound reaches this rank back through
+       * the tree, and pre-setting it would make the `u[0] < best`
+       * forwarding guard drop the broadcast at the originating rank —
+       * an interior node's children would then never learn the bound. */
+      int msg = total;
+      ADLB_Put(&msg, (int)sizeof(int), 0, -1, BOUND_UPDT, BOUND_PRIO);
+    }
+    return 0;
+  }
+  int in_path[MAXN] = {0};
+  for (int i = 0; i < k; i++) in_path[path[i]] = 1;
+  ADLB_Begin_batch_put(NULL, 0);
+  for (int c = 1; c < n_cities; c++) {
+    if (in_path[c]) continue;
+    int nl = length + dists[path[k - 1]][c];
+    if (nl >= best) continue; /* bound prune */
+    u[0] = nl;
+    path[k] = c;
+    rc = ADLB_Put(u, (int)((2 + k) * sizeof(int)), -1, -1, WORK, 1 + k);
+    if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) {
+      ADLB_End_batch_put();
+      return 5;
+    }
+    nput++;
+  }
+  ADLB_End_batch_put();
+  u[0] = length; /* restore (path[k] scribble is beyond k, harmless) */
+  return 0;
+}
+
 int main(void) {
   int types[2] = {WORK, BOUND_UPDT};
   int am_server, am_debug, num_apps;
@@ -118,12 +176,12 @@ int main(void) {
                      &num_apps);
   if (rc != ADLB_SUCCESS || am_server || am_debug) return 3;
   int me = ADLB_World_rank();
-  int lchild = 2 * me + 1, rchild = 2 * me + 2;
+  lchild = 2 * me + 1;
+  rchild = 2 * me + 2;
   if (lchild >= num_apps) lchild = -1;
   if (rchild >= num_apps) rchild = -1;
 
-  int best = greedy_bound(); /* identical on every rank */
-  long done = 0, nput = 0;
+  best = greedy_bound(); /* identical on every rank */
   int buf[2 + MAXN]; /* [length, path...] or [dist] for BOUND_UPDT */
 
   if (me == 0) {
@@ -134,71 +192,77 @@ int main(void) {
   }
 
   double wait = 0.0, t0 = mono(), t1 = t0;
-  for (;;) {
+  /* ADLB_TSP_FETCH=batch:<k> switches consumption to the batched fused
+   * fetch (mirrors hotspot_c.c): up to k local units per round trip.
+   * Priority order is preserved inside a batch, so a queued BOUND_UPDT
+   * still arrives ahead of WORK units; the bound is applied the moment
+   * its unit is processed, at most k-1 expansions later than the
+   * single-unit loop would. Malformed values (trailing junk included)
+   * are rejected with exit 9; k is capped at 32 here (each slot carries
+   * a full (2+MAXN)-int tour payload, vs hotspot's 8-byte tokens and
+   * cap 64). */
+  int batch = 0;
+  const char *fetch_env = getenv("ADLB_TSP_FETCH");
+  if (fetch_env && strncmp(fetch_env, "batch", 5) == 0) {
+    if (fetch_env[5] == ':') {
+      char *end = NULL;
+      long k = strtol(fetch_env + 6, &end, 10);
+      if (!end || *end != '\0' || end == fetch_env + 6) return 9;
+      batch = (int)k;
+    } else if (fetch_env[5] == '\0') {
+      batch = 8;
+    } else {
+      return 9;
+    }
+    if (batch < 1 || batch > 32) return 9;
+  } else if (fetch_env && strcmp(fetch_env, "single") != 0) {
+    return 9;
+  }
+  long rts = 0;
+  if (batch) {
     int req[3] = {BOUND_UPDT, WORK, ADLB_RESERVE_EOL};
-    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
-    double r0 = mono();
-    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
-    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
-    if (rc != ADLB_SUCCESS) return 7; /* real error, not termination */
-    if (wl > (int)sizeof(buf)) return 6;
-    rc = ADLB_Get_reserved(buf, handle);
-    if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
-    if (rc != ADLB_SUCCESS) return 8;
-    wait += mono() - r0;
-    t1 = mono();
-    if (wt == BOUND_UPDT) {
-      if (buf[0] < best) {
-        best = buf[0];
-        /* forward the improvement down the binary tree */
-        if (lchild >= 0)
-          ADLB_Put(buf, (int)sizeof(int), lchild, -1, BOUND_UPDT, BOUND_PRIO);
-        if (rchild >= 0)
-          ADLB_Put(buf, (int)sizeof(int), rchild, -1, BOUND_UPDT, BOUND_PRIO);
+    enum { STRIDE = (2 + MAXN) * (int)sizeof(int) };
+    static int wts[32], wps[32], wls[32], ars[32];
+    static char payloads[32 * STRIDE];
+    for (;;) {
+      int ngot;
+      double r0 = mono();
+      rc = ADLB_Get_work_batch(req, batch, &ngot, wts, wps, payloads,
+                               STRIDE, wls, ars);
+      if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+      if (rc != ADLB_SUCCESS) return 7;
+      wait += mono() - r0;
+      rts++;
+      for (int i = 0; i < ngot; i++) {
+        t1 = mono();
+        rc = process_unit((int *)(payloads + i * STRIDE), wls[i], wts[i]);
+        if (rc) return rc;
       }
-      continue;
     }
-    done++;
-    int length = buf[0];
-    int *path = &buf[1];
-    int k = wl / (int)sizeof(int) - 1; /* cities in the partial tour */
-    if (length >= best) continue;      /* pruned under a tighter bound */
-    if (k == n_cities) {               /* complete: close the tour */
-      int total = length + dists[path[k - 1]][0];
-      if (total < best) {
-        /* funnel to rank 0, which broadcasts down the tree.  Local
-         * `best` is deliberately NOT set here (reference tsp.c:245-266
-         * semantics): the tightened bound reaches this rank back through
-         * the tree, and pre-setting it would make the `buf[0] < best`
-         * forwarding guard drop the broadcast at the originating rank —
-         * an interior node's children would then never learn the bound. */
-        int msg = total;
-        ADLB_Put(&msg, (int)sizeof(int), 0, -1, BOUND_UPDT, BOUND_PRIO);
-      }
-      continue;
+  } else {
+    for (;;) {
+      int req[3] = {BOUND_UPDT, WORK, ADLB_RESERVE_EOL};
+      int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+      double r0 = mono();
+      rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+      if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+      if (rc != ADLB_SUCCESS) return 7; /* real error, not termination */
+      if (wl > (int)sizeof(buf)) return 6;
+      rc = ADLB_Get_reserved(buf, handle);
+      if (rc == ADLB_NO_MORE_WORK || rc == ADLB_DONE_BY_EXHAUSTION) break;
+      if (rc != ADLB_SUCCESS) return 8;
+      wait += mono() - r0;
+      rts++;
+      t1 = mono();
+      rc = process_unit(buf, wl, wt);
+      if (rc) return rc;
     }
-    int in_path[MAXN] = {0};
-    for (int i = 0; i < k; i++) in_path[path[i]] = 1;
-    ADLB_Begin_batch_put(NULL, 0);
-    for (int c = 1; c < n_cities; c++) {
-      if (in_path[c]) continue;
-      int nl = length + dists[path[k - 1]][c];
-      if (nl >= best) continue; /* bound prune */
-      buf[0] = nl;
-      path[k] = c;
-      rc = ADLB_Put(buf, (int)((2 + k) * sizeof(int)), -1, -1, WORK, 1 + k);
-      if (rc != ADLB_SUCCESS && rc != ADLB_NO_MORE_WORK) {
-        ADLB_End_batch_put();
-        return 5;
-      }
-      nput++;
-    }
-    ADLB_End_batch_put();
-    buf[0] = length; /* restore (path[k] scribble is beyond k, harmless) */
   }
 
-  printf("TSP rank=%d best=%d done=%ld nput=%ld t0=%.6f t1=%.6f wait=%.6f\n",
-         me, best, done, nput, t0, t1, wait);
+  printf("TSP rank=%d best=%d done=%ld nput=%ld t0=%.6f t1=%.6f wait=%.6f "
+         "fetch=%s rts=%ld\n",
+         me, best, done_units, nput, t0, t1, wait,
+         batch ? "batch" : "single", rts);
   ADLB_Finalize();
   return 0;
 }

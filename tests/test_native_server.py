@@ -441,6 +441,15 @@ def test_all_native_tsp_known_answer(mode):
     assert r.optimum is not None
     assert r.best == r.optimum, (r.best, r.optimum)
     assert r.tasks > 0
+    # batched fused fetch: same answer, B&B pruning correct with up-to-k
+    # units in hand per round trip (bound updates still preempt inside
+    # the batch by priority)
+    rb = tsp_native.run(
+        n_cities=8, num_app_ranks=4, nservers=2,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.2),
+        timeout=120.0, fetch="batch:4",
+    )
+    assert rb.best == rb.optimum, (rb.best, rb.optimum)
 
 
 @pytest.mark.parametrize("mode", ["steal", "tpu"])
