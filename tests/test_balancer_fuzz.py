@@ -305,10 +305,32 @@ class CreditFuzzSim:
         assert qd == q, ("inventory/state divergence", qd, q)
 
     # -------------------------------------------------------------- step
+    def kill(self, rank: int) -> None:
+        """Server death mid-run: its snapshots stop (the master pops the
+        entry on DS_END / connection loss), its queued inventory and
+        everything in transit TO it die with the process.  step() then
+        guards every pump on membership in ``servers`` — plans and
+        channels referencing the dead rank are dropped, and credits to
+        it can only retire via _prune_credits' snapshot-is-None TTL
+        branch."""
+        self.master._snapshots.pop(rank, None)
+        victim = self.servers.pop(rank)
+        for uid in victim["inv"]:
+            self.unit_state[uid] = "lost"
+            self.lost += 1
+        for (_src, dst), q in self.chan.items():
+            if dst == rank:
+                for batch in q:
+                    for uid in batch["uids"]:
+                        self.unit_state[uid] = "lost"
+                        self.lost += 1
+                q.clear()
+        self.snap_q.pop(rank, None)
+
     def step(self, produce: bool = True) -> int:
         self.it += 1
         rng = self.rng
-        if produce and rng.random() < 0.5:
+        if produce and 0 in self.servers and rng.random() < 0.5:
             for _ in range(rng.randrange(1, 9)):
                 uid = self.next_uid
                 self.next_uid += 1
@@ -322,11 +344,21 @@ class CreditFuzzSim:
             if m["due"] > self.it:
                 remaining.append(m)
             elif m["kind"] == "mig":
-                self._enact_migration(m)
+                # a plan touching a dead rank is dropped: a live source
+                # simply keeps its units queued, a dead source's units
+                # are already lost
+                if m["src"] in self.servers and m["dest"] in self.servers:
+                    self._enact_migration(m)
             else:
-                self._enact_match(m)
+                if (
+                    m["holder"] in self.servers
+                    and m["req_home"] in self.servers
+                ):
+                    self._enact_match(m)
         self.msgs = remaining
         for (src, dest), q in self.chan.items():
+            if dest not in self.servers:
+                continue  # cleared by kill(); nothing can arrive
             while q and q[0]["due"] <= self.it:
                 self._arrive(src, dest, q.pop(0))
         for s, sv in self.servers.items():
@@ -342,7 +374,7 @@ class CreditFuzzSim:
                         w["parked"] = (w["wrank"], sv["rqseq"], types)
                 else:
                     self._local_fetch(s, w)
-        for s in range(self.nservers):
+        for s in list(self.servers):
             r = rng.random()
             if r < 0.55:
                 self._send_snap(s, reqs_only=False)
@@ -367,7 +399,7 @@ class CreditFuzzSim:
             if not self.in_flight_empty() or planned:
                 settled = 0
                 continue
-            for s in range(self.nservers):
+            for s in list(self.servers):
                 self._send_snap(s, reqs_only=False, immediate=True)
             if self._round():
                 settled = 0
@@ -451,14 +483,62 @@ def test_fuzz_ttl_backstop_clears_lost_batches():
         time.sleep(0.25)  # > inflow_ttl: the backstop horizon passes
         for s in range(sim.nservers):
             sim._send_snap(s, reqs_only=False, immediate=True)
+        # age against a PRE-round timestamp: the engine prunes with its
+        # own (slightly later) clock, so any credit it keeps is strictly
+        # younger than TTL relative to t_round — judging with a fresh
+        # post-round clock would flag credits that merely aged a few ms
+        # between the prune and the assertion (observed flake)
+        t_round = time.monotonic()
         sim._round()
         # the final round prunes everything past the TTL but may itself
         # plan fresh migrations (leftover inventory, parked reqs) — the
         # invariant is that no credit OLDER than the TTL survives a round
-        now = time.monotonic()
         old = [
             (d, e) for d, e in _outstanding_credits(sim.eng)
-            if now - e[0] > sim.eng.INFLOW_TTL
+            if t_round - e[0] > sim.eng.INFLOW_TTL
         ]
         assert not old, ("credits outlived the TTL backstop", old)
         assert sim.lost > 0, "drop schedule never lost a batch"
+
+
+def test_fuzz_dead_destination_credits_ttl_pruned():
+    """A destination that STOPS appearing in snapshots (server ended /
+    died — the master pops its snapshot on DS_END) can never ack its
+    in-flight credits; _prune_credits' snapshot-is-None branch must
+    still retire them by TTL, and the planner must keep functioning for
+    the survivors (the conservation oracle stays armed throughout)."""
+    exercised = 0
+    for seed in (11, 12, 13):
+        sim = CreditFuzzSim(
+            seed, engine_kw={"inflow_ttl": 0.2, "inflow_min_age": 0.01},
+        )
+        # run until some non-master rank holds live credits (cap the
+        # search so a pathological seed fails loudly, not forever)
+        dead = None
+        for _ in range(400):
+            sim.step()
+            cand = [r for r in sim.eng._planned_in if r != 0]
+            if cand:
+                dead = max(cand, key=lambda r: len(sim.eng._planned_in[r]))
+                break
+        if dead is None:
+            continue  # this seed never migrated off-master; try the next
+        assert sim.eng._planned_in.get(dead), "vacuous kill target"
+        exercised += 1
+        sim.kill(dead)
+        # survivors keep running; the dead rank's credits age out via
+        # the TTL-only branch (no snapshot can ever ack them again)
+        deadline = time.monotonic() + 0.35  # > inflow_ttl
+        while time.monotonic() < deadline:
+            sim.step(produce=False)
+        t_round = time.monotonic()  # pre-round clock (see TTL test note)
+        sim.step(produce=False)
+        leftover = [
+            (d, e) for d, e in _outstanding_credits(sim.eng) if d == dead
+        ]
+        old = [e for _, e in leftover if t_round - e[0] > sim.eng.INFLOW_TTL]
+        assert not old, (
+            "dead destination's credits outlived the TTL-only pruning",
+            leftover,
+        )
+    assert exercised > 0, "no seed ever produced off-master credits"
