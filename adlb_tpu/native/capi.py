@@ -63,7 +63,7 @@ def build_example(src: str, out: Optional[str] = None) -> str:
         return out
     cmd = [
         "gcc", "-O2", f"-I{_INCLUDE}", "-o", out, src,
-        f"-L{_DIR}", "-ladlb", f"-Wl,-rpath,{_DIR}",
+        f"-L{_DIR}", "-ladlb", f"-Wl,-rpath,{_DIR}", "-lm",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)

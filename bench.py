@@ -371,6 +371,31 @@ def main() -> None:
     except _NATIVE_ERRS as e:
         native_rows.setdefault("native_error", repr(e))
 
+    # coinop on the all-native plane: the fork's own pop-latency probe
+    # (reference examples/coinop.cpp) — flooded pool, so p50/p95 measure
+    # pure pop service latency through the C client + C++ daemon path
+    from adlb_tpu.workloads import coinop_native
+
+    def nat_coin_one(mode):
+        return coinop_native.run(
+            n_tokens=400, num_app_ranks=8, nservers=4,
+            cfg=native_cfg(mode), timeout=120.0,
+        )
+
+    try:
+        nc_runs = interleaved(nat_coin_one)
+        nc_steal = median_by(nc_runs["steal"],
+                             key=lambda r: r.latency_p50_ms)
+        nc_tpu = median_by(nc_runs["tpu"], key=lambda r: r.latency_p50_ms)
+        native_rows.update({
+            "native_coinop_p50_ms_steal": round(nc_steal.latency_p50_ms, 3),
+            "native_coinop_p50_ms_tpu": round(nc_tpu.latency_p50_ms, 3),
+            "native_coinop_p95_ms_steal": round(nc_steal.latency_p95_ms, 3),
+            "native_coinop_p95_ms_tpu": round(nc_tpu.latency_p95_ms, 3),
+        })
+    except _NATIVE_ERRS as e:
+        native_rows.setdefault("native_coinop_error", repr(e))
+
     def nq_one(mode):
         r = nq.run(
             n=N, num_app_ranks=APPS, nservers=SERVERS,

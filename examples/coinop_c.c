@@ -1,0 +1,130 @@
+/* coinop at native scale: the pop-latency microbenchmark as C clients
+ * against the C++ server daemons — the native twin of the in-process
+ * probe (adlb_tpu/workloads/coinop.py).  Scenario lineage is the fork's
+ * own addition (reference examples/coinop.cpp:79-126,190-213): one
+ * producer floods N tokens through the pool; every worker times each
+ * Reserve+Get pop and accumulates a streaming mean/stddev (the
+ * reference gathers those per-worker moments to the producer with
+ * MPI_Gather; here each rank prints its own and the harness gathers).
+ *
+ * Per-rank machine-readable output, same k=v shape as nq_c.c/tsp_c.c:
+ *
+ *   COIN rank=<r> pops=<n> mean_ms=<m> stddev_ms=<s> t0=<mono> t1=<mono> wait=<s>
+ *   COINLAT <l1> <l2> ...          (raw per-pop latencies, ms)
+ *
+ * wait duplicates sum(latency) in seconds so probe_aggregate() can
+ * compute the usual wait%% column.  Env knobs: ADLB_COIN_NTOKENS
+ * (default 400), ADLB_COIN_BYTES (payload size, default 64),
+ * ADLB_COIN_WORK_US (per-pop compute sleep, default 0).  Terminates by
+ * exhaustion, as the in-process probe does.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <adlb/adlb.h>
+
+#define TOKEN 1
+
+static double mono(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+static int env_int(const char *k, int dflt) {
+  const char *v = getenv(k);
+  return v ? atoi(v) : dflt;
+}
+
+int main(void) {
+  int types[1] = {TOKEN};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  const char *nsrv_env = getenv("ADLB_NUM_SERVERS");
+  int nservers = nsrv_env ? atoi(nsrv_env) : 0; /* <= 0 rejected by Init */
+  int n_tokens = env_int("ADLB_COIN_NTOKENS", 400);
+  int token_bytes = env_int("ADLB_COIN_BYTES", 64);
+  int work_us = env_int("ADLB_COIN_WORK_US", 0);
+  if (n_tokens < 1 || token_bytes < 1) return 2;
+  int rc = ADLB_Init(nservers, 0, 0, 1, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) {
+    fprintf(stderr, "coinop: init failed rc=%d\n", rc);
+    return 2;
+  }
+  int me = ADLB_World_rank();
+
+  if (me == 0) {
+    char *payload = (char *)malloc((size_t)token_bytes);
+    if (!payload) {
+      fprintf(stderr, "coinop: payload malloc(%d) failed\n", token_bytes);
+      return 2;
+    }
+    memset(payload, 'c', (size_t)token_bytes);
+    double t0 = mono();
+    for (int i = 0; i < n_tokens; i++) {
+      rc = ADLB_Put(payload, token_bytes, -1, -1, TOKEN, 0);
+      if (rc != ADLB_SUCCESS) {
+        fprintf(stderr, "coinop: put %d failed rc=%d\n", i, rc);
+        return 3;
+      }
+    }
+    free(payload);
+    printf("COIN rank=0 pops=0 mean_ms=0 stddev_ms=0 t0=%.6f t1=%.6f "
+           "wait=0\nCOINLAT\n",
+           t0, mono());
+    ADLB_Finalize();
+    return 0;
+  }
+
+  /* Welford's streaming moments — per-worker mean/stddev, matching the
+   * moments the reference gathers back to its producer */
+  long pops = 0;
+  double mean = 0.0, m2 = 0.0, wait = 0.0;
+  double *lat = (double *)malloc((size_t)n_tokens * sizeof(double));
+  if (!lat) {
+    fprintf(stderr, "coinop: lat malloc(%d) failed\n", n_tokens);
+    return 2;
+  }
+  double t0 = mono(), t1 = t0;
+  for (;;) {
+    int req[2] = {TOKEN, ADLB_RESERVE_EOL};
+    int wt, wp, wl, ar, handle[ADLB_HANDLE_SIZE];
+    double r0 = mono();
+    rc = ADLB_Reserve(req, &wt, &wp, handle, &wl, &ar);
+    if (rc == ADLB_DONE_BY_EXHAUSTION || rc == ADLB_NO_MORE_WORK) break;
+    if (rc != ADLB_SUCCESS) return 4;
+    if (wl != token_bytes) return 5;
+    char buf[65536];
+    if (wl > (int)sizeof buf) {
+      fprintf(stderr, "coinop: token_bytes %d exceeds the %zu-byte cap\n",
+              wl, sizeof buf);
+      return 5;
+    }
+    rc = ADLB_Get_reserved(buf, handle);
+    if (rc != ADLB_SUCCESS) return 6;
+    double dt = mono() - r0;
+    wait += dt;
+    if (pops < n_tokens) lat[pops] = dt * 1e3;
+    pops++;
+    double delta = dt * 1e3 - mean;
+    mean += delta / (double)pops;
+    m2 += delta * (dt * 1e3 - mean);
+    if (work_us > 0) usleep((useconds_t)work_us);
+    t1 = mono();
+  }
+  double stddev = pops > 1 ? sqrt(m2 / (double)(pops - 1)) : 0.0;
+  printf("COIN rank=%d pops=%ld mean_ms=%.4f stddev_ms=%.4f t0=%.6f "
+         "t1=%.6f wait=%.6f\n",
+         me, pops, mean, stddev, t0, t1, wait);
+  printf("COINLAT");
+  for (long i = 0; i < pops && i < n_tokens; i++)
+    printf(" %.3f", lat[i]);
+  printf("\n");
+  free(lat);
+  ADLB_Finalize();
+  return 0;
+}

@@ -533,3 +533,30 @@ def test_all_native_trickle_harness():
     assert r.tasks == 60
     assert r.dispatch_p50_ms > 0
     assert r.dispatch_p90_ms >= r.dispatch_p50_ms
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_all_native_coinop_latency_probe(mode):
+    """The fork's own pop-latency microbenchmark as C clients: producer
+    floods the pool, workers time every Reserve+Get and report Welford
+    mean/stddev per rank plus raw latencies; no token lost, moments
+    consistent with the gathered raw values (reference
+    examples/coinop.cpp:79-126,190-213 on the native plane)."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    from adlb_tpu.workloads import coinop_native
+
+    r = coinop_native.run(
+        n_tokens=150, num_app_ranks=4, nservers=2,
+        cfg=Config(balancer=mode, exhaust_check_interval=0.2),
+        timeout=120.0,
+    )
+    assert r.pops == 150
+    assert r.latency_p50_ms > 0
+    assert r.latency_p95_ms >= r.latency_p50_ms
+    assert r.per_worker  # at least one consuming rank reported moments
+    # the C-side Welford mean of every reporting worker must sit inside
+    # the raw latency envelope the same rank shipped
+    assert all(
+        0.0 < m <= r.latency_p95_ms * 20 for m, _s in r.per_worker.values()
+    )
