@@ -241,6 +241,13 @@ bool decode(const std::string &body, Msg *out) {
       return false;
     }
   }
+  // exact-frame check: every legitimate encoder emits no trailing bytes,
+  // so leftovers mean garbage that decoded by luck
+  if (off != body.size()) return false;
+  // client-bound wire tags live in the 1001-1049 block; anything else is
+  // crafted or version-skewed and must not reach the dispatch paths,
+  // whose unexpected-tag arms are fatal
+  if (out->tag < 1001 || out->tag > 1049) return false;
   return true;
 }
 
@@ -296,6 +303,22 @@ bool read_exact(int fd, void *p, size_t n) {
   return true;
 }
 
+// Body reads grow with the bytes actually received instead of
+// pre-allocating the advertised length: a connection that sends only a
+// large length prefix (then stalls) must not pin that memory in recv.
+bool read_body(int fd, uint32_t n, std::string *body) {
+  body->clear();
+  char chunk[65536];
+  while (body->size() < n) {
+    size_t want = n - body->size();
+    if (want > sizeof chunk) want = sizeof chunk;
+    ssize_t r = recv(fd, chunk, want, 0);
+    if (r <= 0) return false;
+    body->append(chunk, (size_t)r);
+  }
+  return true;
+}
+
 bool write_all(int fd, const void *p, size_t n) {
   const char *c = (const char *)p;
   while (n > 0) {
@@ -308,20 +331,65 @@ bool write_all(int fd, const void *p, size_t n) {
 }
 
 void reader_loop(int fd) {
+  // Robustness policy (mirrors serverd.cpp): a connection that has never
+  // delivered a decodable frame is untrusted — garbage on it closes the
+  // connection without touching the world (a stray scanner must not kill
+  // a rank, and rank death kills the whole world). Once a frame has
+  // decoded, the peer is a real rank: corruption on an ESTABLISHED
+  // stream is a protocol error and fails fast — dropping it instead
+  // could discard the response a blocking caller is parked on, turning
+  // a diagnosable failure into a silent distributed hang.
+  static const uint32_t kMaxFrame = 1u << 28;  // 256 MB
+  bool established = false;
   for (;;) {
     uint32_t len;
     if (!read_exact(fd, &len, 4)) break;
-    std::string body(len, '\0');
-    if (!read_exact(fd, &body[0], len)) break;
+    if (len > kMaxFrame) {
+      // cap before resize(): a hostile 4 GB prefix must not become the
+      // allocation that kills this rank
+      if (established)
+        die("frame length %u exceeds %u cap on an established connection",
+            len, kMaxFrame);
+      std::fprintf(stderr,
+                   "[libadlb] frame length %u exceeds %u cap; closing "
+                   "connection\n", len, kMaxFrame);
+      break;
+    }
+    std::string body;
+    if (!read_body(fd, len, &body)) break;
     Msg m;
-    if ((uint8_t)body[0] != BINARY_MAGIC) {
-      // A pickled frame can only reach a native client as an unsolicited
-      // server->client message, and the only unsolicited message is
-      // TA_ABORT: treat it as one.
-      m.tag = T_TA_ABORT;
-      m.ints[F_CODE] = ADLB_ERROR;
+    if (len == 0 || (uint8_t)body[0] != BINARY_MAGIC) {
+      if (len > 0 && (uint8_t)body[0] == 0x80 &&
+          body.find("adlb_tpu") != std::string::npos) {
+        // pickle protocol-2+ magic AND the pickled Msg's embedded module
+        // path: a Python server that has not yet learned this rank is a
+        // binary peer pickles its frames, and the only unsolicited
+        // pickled client-bound message is the TA_ABORT fan-out — honor
+        // it. (The module-path check keeps 0x80-prefixed line noise from
+        // synthesizing a fatal abort; test_codec.py pins the invariant.)
+        m.tag = T_TA_ABORT;
+        m.ints[F_CODE] = ADLB_ERROR;
+      } else if (!established) {
+        std::fprintf(stderr,
+                     "[libadlb] closing connection after non-binary "
+                     "frame (%u B)\n", len);
+        break;
+      } else {
+        die("non-binary frame (%u bytes) on an established connection",
+            len);
+      }
     } else if (!decode(body, &m)) {
-      die("undecodable binary frame (%u bytes)", len);
+      if (!established) {
+        std::fprintf(stderr,
+                     "[libadlb] closing connection after undecodable "
+                     "first frame (%u B) — stray connection, or a "
+                     "version-skewed peer (if a caller now hangs, "
+                     "rebuild both sides from one tree)\n", len);
+        break;
+      }
+      die("undecodable binary frame (%u bytes) from a live peer", len);
+    } else {
+      established = true;
     }
     {
       std::lock_guard<std::mutex> lk(g->mu);

@@ -49,6 +49,7 @@
 #include <mutex>
 #include <set>
 #include <sstream>
+#include <stdexcept>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -393,8 +394,15 @@ std::string encode(const NMsg& m) {
   return out;
 }
 
+// Malformed frames throw (the reader drops them and keeps serving, like
+// the Python TcpEndpoint) rather than die(): one garbage connection must
+// not take down a server that other ranks depend on.
+struct FrameError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
 NMsg decode(const std::string& body) {
-  if (body.size() < 9 || body[0] != 0x01) die("bad frame magic");
+  if (body.size() < 9 || body[0] != 0x01) throw FrameError("bad frame magic");
   NMsg m;
   size_t off = 1;
   std::memcpy(&m.tag, body.data() + off, 2); off += 2;
@@ -402,7 +410,8 @@ NMsg decode(const std::string& body) {
   uint16_t nfields;
   std::memcpy(&nfields, body.data() + off, 2); off += 2;
   auto need = [&](size_t n) {
-    if (off + n > body.size()) die("truncated frame (tag %u)", m.tag);
+    if (off + n > body.size())
+      throw FrameError("truncated frame (tag " + std::to_string(m.tag) + ")");
   };
   for (uint16_t i = 0; i < nfields; ++i) {
     need(2);
@@ -464,10 +473,22 @@ NMsg decode(const std::string& body) {
         }
         break;
       }
-      default: die("bad field kind %u", kind);
+      default:
+        throw FrameError("bad field kind " + std::to_string(kind));
     }
     m.f.emplace(fid, std::move(fv));
   }
+  // every legitimate encoder (codec.py, libadlb, this file) emits exact
+  // frames; trailing bytes mean garbage that decoded by luck
+  if (off != body.size())
+    throw FrameError("trailing bytes after field " +
+                     std::to_string(nfields));
+  // tag outside the wire ranges (client block 1001-1049, server/debug
+  // block 1101-1132): a crafted or version-skewed frame — it must not
+  // reach the dispatch switch, whose unhandled-tag arm is fatal
+  if (!((m.tag >= 1001 && m.tag <= 1049) ||
+        (m.tag >= 1101 && m.tag <= 1132)))
+    throw FrameError("unknown wire tag " + std::to_string(m.tag));
   return m;
 }
 
@@ -584,20 +605,60 @@ class Endpoint {
   }
 
   void reader(int conn) {
+    // Robustness policy (mirrors libadlb.cpp's reader): garbage on a
+    // connection that has never delivered a decodable frame closes that
+    // connection and nothing else — a stray scanner must not kill a
+    // server other ranks depend on. Corruption on an ESTABLISHED stream
+    // is a protocol error between real ranks and fails fast: silently
+    // dropping a request would leave its sender parked forever.
+    // The length cap guards resize(): a hostile 4 GB prefix must not
+    // become the allocation that kills the daemon.
+    static constexpr uint32_t kMaxFrame = 1u << 28;  // 256 MB
     int32_t last_src = -1;
+    bool established = false;
     for (;;) {
       uint32_t n;
       if (!read_exact(conn, (char*)&n, 4)) break;
-      std::string body(n, '\0');
-      if (!read_exact(conn, body.data(), n)) break;
-      if (n > 0 && body[0] != 0x01) {
-        // pickle frame: only possible from a misconfigured Python peer —
-        // worlds with native servers declare them binary peers upfront
+      if (n > kMaxFrame) {
+        if (established)
+          die("frame length %u from rank %d exceeds %u cap", n, last_src,
+              kMaxFrame);
         std::fprintf(stderr,
-                     "[adlb_serverd] dropping non-binary frame (%u B)\n", n);
-        continue;
+                     "[adlb_serverd] frame length %u exceeds %u cap; "
+                     "closing connection\n", n, kMaxFrame);
+        break;
       }
-      NMsg m = decode(body);
+      std::string body;
+      if (!read_body(conn, n, &body)) break;
+      if (n == 0 || body[0] != 0x01) {
+        if (established)
+          // never legitimate: Python peers raise rather than pickle to a
+          // declared-binary destination, so mid-stream non-TLV is
+          // corruption (or a misconfigured peer), and dropping it could
+          // park its sender forever
+          die("non-binary frame (%u bytes) from rank %d", n, last_src);
+        std::fprintf(stderr,
+                     "[adlb_serverd] closing connection after non-binary "
+                     "frame (%u B)\n", n);
+        break;
+      }
+      NMsg m;
+      try {
+        m = decode(body);
+      } catch (const FrameError& e) {
+        if (!established) {
+          std::fprintf(stderr,
+                       "[adlb_serverd] closing connection after "
+                       "undecodable first frame (%u B): %s — stray "
+                       "connection, or a version-skewed peer (if a rank "
+                       "now hangs, rebuild both sides from one tree)\n",
+                       n, e.what());
+          break;
+        }
+        die("undecodable frame (%u bytes) from rank %d: %s", n, last_src,
+            e.what());
+      }
+      established = true;
       last_src = m.src;
       {
         std::lock_guard<std::mutex> lk(in_mu_);
@@ -627,6 +688,22 @@ class Endpoint {
       ssize_t r = ::recv(fd, buf + got, n - got, 0);
       if (r <= 0) return false;
       got += size_t(r);
+    }
+    return true;
+  }
+
+  // Body reads grow with the bytes actually received instead of
+  // pre-allocating the advertised length: a connection that sends only a
+  // large length prefix (and then stalls) must not pin the whole frame's
+  // memory while blocked in recv.
+  static bool read_body(int fd, uint32_t n, std::string* body) {
+    body->clear();
+    char chunk[65536];
+    while (body->size() < n) {
+      size_t want = std::min(sizeof chunk, size_t(n) - body->size());
+      ssize_t r = ::recv(fd, chunk, want, 0);
+      if (r <= 0) return false;
+      body->append(chunk, size_t(r));
     }
     return true;
   }

@@ -59,3 +59,17 @@ def test_wire_ids_total_and_unique():
     assert len(set(WIRE_TAG.values())) == len(WIRE_TAG)
     ids = [fid for fid, _ in FIELDS.values()]
     assert len(set(ids)) == len(ids)
+
+
+def test_pickled_abort_carries_module_path():
+    """The C client (libadlb.cpp reader_loop) honors a pickled frame as
+    the TA_ABORT fan-out only when the body contains the pickled Msg's
+    module path — this pins the invariant that heuristic depends on, so
+    a module rename fails here instead of silently breaking abort
+    delivery to native clients that a Python server hasn't learned are
+    binary peers."""
+    body = pickle.dumps(
+        msg(Tag.TA_ABORT, 4, code=-2), protocol=pickle.HIGHEST_PROTOCOL
+    )
+    assert body[0] == 0x80
+    assert b"adlb_tpu" in body

@@ -535,6 +535,71 @@ def test_all_native_trickle_harness():
     assert r.dispatch_p90_ms >= r.dispatch_p50_ms
 
 
+def _garbage_then_work(ctx):
+    """Rank 0 sprays malformed frames straight at its home server's TCP
+    port (each on a fresh, never-established connection), then runs a
+    normal put/reserve cycle: the daemon must close each garbage
+    connection and keep serving — one stray connection must not kill a
+    server other ranks depend on."""
+    import socket
+    import time as _t
+
+    T = 1
+    if ctx.rank == 0:
+        host, port = ctx._c.ep.addr_map[ctx.world.home_server(0)]
+        garbage = [
+            # (a) valid length prefix, binary magic, garbage TLV body
+            struct.pack("<I", 41) + b"\x01" + os.urandom(40),
+            # (b) non-binary frame (neither TLV magic nor pickle magic)
+            struct.pack("<I", 8) + b"\x99" * 8,
+            # (c) truncated-inside-TLV frame: magic + tag + src +
+            # nfields=1, then a bytes field pointing past the body
+            struct.pack("<I", 15) + b"\x01" + struct.pack("<Hi", 1, 0)
+            + struct.pack("<H", 1) + b"\x05\x02"
+            + struct.pack("<I", 10_000),
+            # (d) hostile length prefix: closed before allocating
+            struct.pack("<I", 0x7FFFFFFF),
+            # (e) zero-length frame
+            struct.pack("<I", 0),
+            # (f) pickle-magic line noise (no pickled-Msg module path)
+            struct.pack("<I", 12) + b"\x80" + os.urandom(11),
+            # (g) syntactically valid TLV but an unknown wire tag
+            # (nfields=0): must not reach the fatal dispatch arm
+            struct.pack("<I", 9) + b"\x01"
+            + struct.pack("<HiH", 4242, 0, 0),
+        ]
+        for frame in garbage:
+            s = socket.create_connection((host, port), timeout=5.0)
+            s.sendall(frame)
+            _t.sleep(0.05)
+            s.close()
+        _t.sleep(0.2)
+        for i in range(6):
+            assert ctx.put(b"x%d" % i, T) == ADLB_SUCCESS
+        return 0  # exhaustion terminates once workers drain all 6
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return n
+        rc, _buf = ctx.get_reserved(r.handle)
+        assert rc == ADLB_SUCCESS
+        n += 1
+
+
+def test_native_daemon_survives_malformed_frames():
+    """Frame-decoder robustness: garbage connections (random TLV bodies,
+    wrong magic, truncated fields, hostile length prefixes, empty frames)
+    are closed with a diagnostic while the daemon keeps serving real
+    clients; only corruption on an ESTABLISHED peer stream is fatal."""
+    res = spawn_world(
+        3, 2, [1], _garbage_then_work,
+        cfg=Config(server_impl="native", exhaust_check_interval=0.2),
+        timeout=60.0,
+    )
+    assert sum(v for k, v in res.app_results.items() if k != 0) == 6
+
+
 @pytest.mark.parametrize("mode", ["steal", "tpu"])
 def test_all_native_coinop_latency_probe(mode):
     """The fork's own pop-latency microbenchmark as C clients: producer
