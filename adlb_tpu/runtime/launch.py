@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import socket
 import subprocess
 import sys
 import threading
@@ -43,15 +42,6 @@ def _parse_ranks(spec: str) -> list[int]:
         else:
             out.append(int(part))
     return sorted(set(out))
-
-
-def _free_port(host: str) -> int:
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    s.bind((host, 0))
-    port = s.getsockname()[1]
-    s.close()  # released; the rank rebinds it (same pattern the single-host
-    return port  # harness uses; the window is narrow and loud on collision)
 
 
 def _publish(dirpath: str, rank: int, host: str, port: int) -> None:
@@ -84,6 +74,27 @@ def _await_all(dirpath: str, nranks: int, timeout: float) -> dict:
         if len(addr_map) < nranks:
             time.sleep(0.05)
     return addr_map
+
+
+def _check_port_clash(addr_map: dict) -> None:
+    """Fail fast if two ranks published the same (host, port).
+
+    Concurrent same-host launchers probe with closed sockets and then sit
+    in the rendezvous for up to --timeout, so overlapping probe subranges
+    can (rarely) hand two ranks one port; the second bind would die
+    mid-world and the failure-detection abort would take everything with
+    it, minutes later and with a misleading message. Every launcher sees
+    the full map here, so they all fail loudly and immediately instead —
+    a relaunch redraws the PID-staggered ranges."""
+    owners: dict[tuple, list] = {}
+    for r, a in sorted(addr_map.items()):
+        owners.setdefault(tuple(a), []).append(r)
+    clash = {a: rs for a, rs in owners.items() if len(rs) > 1}
+    if clash:
+        raise RuntimeError(
+            f"rendezvous published duplicate addresses {clash}; "
+            f"relaunch the world"
+        )
 
 
 def write_rendezvous_file(path: str, addr_map: dict) -> None:
@@ -156,10 +167,17 @@ def main(argv=None) -> int:
         sidecar = start_sidecar(world, cfg, None, host=host)
         _publish(rdv, world.nranks, host, sidecar[0].port)
 
-    # 2. app ranks publish pre-allocated ports
-    for rank in my_ranks:
-        if world.is_app(rank):
-            _publish(rdv, rank, host, _free_port(host))
+    # 2. app ranks publish pre-allocated ports — from the staggered
+    # below-ephemeral range (probe_free_ports), NOT per-rank bind(0):
+    # an ephemeral-range port released here can be re-issued by the
+    # kernel as some outbound connection's source port before the app
+    # process rebinds it, which killed the rank on bind (the same flake
+    # the single-host harness fixed for 100-rank spawn storms)
+    from adlb_tpu.runtime.transport_tcp import probe_free_ports
+
+    app_ranks = [r for r in my_ranks if world.is_app(r)]
+    for rank, port in zip(app_ranks, probe_free_ports(len(app_ranks), host)):
+        _publish(rdv, rank, host, port)
 
     # 3. global rendezvous
     addr_map = _await_all(rdv, world.nranks, args.timeout)
@@ -169,6 +187,7 @@ def main(argv=None) -> int:
         addr_map[world.nranks] = (h, int(p))
     except OSError:
         pass
+    _check_port_clash(addr_map)
     merged = os.path.join(rdv, "world.addr")
     write_rendezvous_file(
         merged, {r: a for r, a in addr_map.items() if r < world.nranks}

@@ -217,20 +217,22 @@ class TcpEndpoint:
             pass
 
 
-def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str, int]]:
-    """Pick nranks free ports on one host (rendezvous for tests/single-host).
+def probe_free_ports(count: int, host: str = "127.0.0.1") -> list[int]:
+    """Pick ``count`` free ports on one host for ranks that bind later.
 
     Ports come from BELOW the kernel's ephemeral range (see
-    /proc/sys/net/ipv4/ip_local_port_range, typically 32768+): the map is
-    handed to child processes that bind later, and in a 100+-rank spawn
-    storm an OUTBOUND connection's ephemeral port can otherwise land on a
-    rank's not-yet-bound listener port — that rank then dies on bind and
-    the failure-detection abort takes the whole world with it (observed
-    at 64-128 ranks as a few-percent flake). The probe start is derived
-    from the PID (plus a per-process call counter), so concurrent
-    worlds — distinct processes by construction — probe well-separated
-    subranges instead of relying on lucky random draws; the bind check
-    still skips any port someone else actually holds.
+    /proc/sys/net/ipv4/ip_local_port_range, typically 32768+): the ports
+    are handed to child processes that bind later, and in a 100+-rank
+    spawn storm an OUTBOUND connection's ephemeral port can otherwise
+    land on a rank's not-yet-bound listener port — that rank then dies on
+    bind and the failure-detection abort takes the whole world with it
+    (observed at 64-128 ranks as a few-percent flake; the multi-host
+    launcher had the same flake from per-rank ephemeral bind(0) probes).
+    The probe start is derived from the PID (plus a per-process call
+    counter), so concurrent worlds — distinct processes by
+    construction — probe well-separated subranges instead of relying on
+    lucky random draws; the bind check still skips any port someone else
+    actually holds.
     """
     import os
 
@@ -242,25 +244,25 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
             floor = int(f.read().split()[0])
     except (OSError, ValueError, IndexError):
         pass
-    if floor < 13000 + 2 * nranks:
+    if floor < 13000 + 2 * count:
         # no usable static range below the ephemeral floor: fall back to
         # kernel-assigned ports (the pre-fix behaviour, collision risk
         # and all — there is nowhere safe to allocate from)
-        addr_map = {}
+        ports = []
         socks = []
-        for r in range(nranks):
+        for _r in range(count):
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             s.bind((host, 0))
             socks.append(s)
-            addr_map[r] = (host, s.getsockname()[1])
+            ports.append(s.getsockname()[1])
         for s in socks:
             s.close()
-        return addr_map
+        return ports
 
     lo = max(1024, floor - 12000)
     hi = floor - 100
-    addr_map = {}
+    ports = []
     socks = []
     span = hi - lo
     # Knuth-hash the PID so adjacent PIDs (concurrently spawned worlds)
@@ -269,8 +271,7 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
     start = lo + (os.getpid() * 40503 + next(_PORT_PROBE_CALLS) * 1013) % span
     port = start
     probed = 0
-    r = 0
-    while r < nranks:
+    while len(ports) < count:
         port += 1
         if port >= hi:
             port = lo  # wrap: free ports below the start stay usable
@@ -285,11 +286,18 @@ def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str,
             s.close()
             continue
         socks.append(s)
-        addr_map[r] = (host, port)
-        r += 1
+        ports.append(port)
     for s in socks:
         s.close()
-    return addr_map
+    return ports
+
+
+def local_addr_map(nranks: int, host: str = "127.0.0.1") -> dict[int, tuple[str, int]]:
+    """Pick nranks free ports on one host (rendezvous for tests/single-host);
+    see :func:`probe_free_ports` for the ephemeral-range rationale."""
+    return {
+        r: (host, p) for r, p in enumerate(probe_free_ports(nranks, host))
+    }
 
 
 # --------------------------------------------------------------- spawn_world

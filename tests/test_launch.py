@@ -102,3 +102,14 @@ def test_two_launchers_one_world(tmp_path, server_impl):
             if line.startswith("APP "):
                 got.extend(eval(line.split("GOT", 1)[1]))
     assert sorted(got) == list(range(40)), sorted(got)
+
+
+def test_port_clash_check():
+    """Two ranks published on one (host, port) — possible when concurrent
+    launchers' closed-socket probe subranges overlap — must fail the
+    rendezvous loudly instead of dying on EADDRINUSE mid-world."""
+    from adlb_tpu.runtime.launch import _check_port_clash
+
+    _check_port_clash({0: ("h", 1), 1: ("h", 2), 2: ("h2", 1)})  # ok
+    with pytest.raises(RuntimeError, match="duplicate addresses"):
+        _check_port_clash({0: ("h", 1), 1: ("h", 2), 2: ("h", 1)})
