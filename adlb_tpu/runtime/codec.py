@@ -28,12 +28,58 @@ in sync.
 
 from __future__ import annotations
 
+import io
+import pickle
 import struct
 
 from adlb_tpu.runtime.messages import Msg, Tag
 
 BINARY_MAGIC = 0x01
 PICKLE_MAGIC = 0x80  # pickle protocol >= 2 PROTO opcode
+
+# Globals the transport's unpickler will resolve. Plain data (dict, list,
+# str, bytes, int, ...) needs no globals at all; what DOES is the Msg
+# envelope itself, its Tag enum, and a few container builtins. Everything
+# else — os.system, subprocess.*, arbitrary constructors — is refused, so
+# a stray or hostile connection cannot turn the Python transport's pickle
+# path into code execution (the C planes got the matching frame-decoder
+# hardening; this is the Python plane's half).
+_SAFE_PICKLE_GLOBALS: set[tuple[str, str]] = {
+    ("adlb_tpu.runtime.messages", "Msg"),
+    ("adlb_tpu.runtime.messages", "Tag"),
+    ("builtins", "complex"),
+    ("builtins", "bytearray"),
+    ("builtins", "set"),
+    ("builtins", "frozenset"),
+}
+
+
+def register_safe_pickle(module: str, *names: str) -> None:
+    """Allow app-message payloads to carry instances of the named classes.
+
+    App-to-app messages (``ctx.app_send``) may hold arbitrary picklable
+    Python objects between Python ranks; custom classes must be declared
+    here (on the RECEIVING process, before the world starts) or the
+    transport refuses the frame."""
+    for n in names:
+        _SAFE_PICKLE_GLOBALS.add((module, n))
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_PICKLE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"pickle global {module}.{name} is not a protocol type; if an "
+            f"app message legitimately carries it, declare it with "
+            f"adlb_tpu.runtime.codec.register_safe_pickle({module!r}, "
+            f"{name!r}) on the receiving rank"
+        )
+
+
+def loads_restricted(body: bytes):
+    """Unpickle a transport frame, refusing non-protocol globals."""
+    return _RestrictedUnpickler(io.BytesIO(body)).load()
 
 # Wire ids: client-facing tags keep the reference's numbers where one exists
 # (reference src/adlb.c:44-83); the rest are assigned in the 11xx block.

@@ -22,7 +22,12 @@ import threading
 import time
 from typing import Optional
 
-from adlb_tpu.runtime.codec import decode_binary, encodable, encode_binary
+from adlb_tpu.runtime.codec import (
+    decode_binary,
+    encodable,
+    encode_binary,
+    loads_restricted,
+)
 from adlb_tpu.runtime.messages import Msg, Tag
 
 _HDR = struct.Struct("<I")
@@ -111,7 +116,28 @@ class TcpEndpoint:
                         continue
                     self.binary_peers.add(m.src)
                 else:
-                    m = pickle.loads(body)
+                    try:
+                        m = loads_restricted(body)
+                        if not isinstance(m, Msg):
+                            raise pickle.UnpicklingError(
+                                f"frame unpickled to "
+                                f"{type(m).__name__}, not Msg"
+                            )
+                    except Exception as e:  # noqa: BLE001 — hostile bytes
+                        import sys
+
+                        print(
+                            f"[adlb tcp rank {self.rank}] refusing "
+                            f"unpicklable frame ({len(body)}B): {e!r}",
+                            file=sys.stderr,
+                        )
+                        # close the connection either way: for a
+                        # never-established stray connection (last_src is
+                        # None) nothing else happens; for an established
+                        # peer stream the finally below synthesizes
+                        # PEER_EOF — the rank-death fail-fast — rather
+                        # than silently dropping a frame someone awaits
+                        return
                 last_src = m.src
                 self.inbox.put(m)
         except OSError:

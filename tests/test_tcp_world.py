@@ -48,6 +48,87 @@ def _producer_consumer(ctx):
     return made, got
 
 
+def test_hostile_pickle_refused():
+    """A crafted pickle whose globals reach outside the protocol types
+    (the os.system class of payload) must be refused at the transport —
+    not executed, not delivered — while legitimate pickled Msg traffic
+    keeps flowing on a fresh connection."""
+    import pickle
+    import socket
+    import struct
+    import time
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("echo pwned > /tmp/adlb_pwned",))
+
+    import os
+
+    if os.path.exists("/tmp/adlb_pwned"):
+        os.remove("/tmp/adlb_pwned")
+    b = TcpEndpoint(1, {1: ("127.0.0.1", 0)})
+    try:
+        host, port = b.addr_map[1]
+        body = pickle.dumps(Evil(), protocol=pickle.HIGHEST_PROTOCOL)
+        s = socket.create_connection((host, port), timeout=5.0)
+        s.sendall(struct.pack("<I", len(body)) + body)
+        time.sleep(0.3)
+        s.close()
+        # globals-free plain data (unpickles fine but is not a Msg) must
+        # take the same clean refusal path, not crash the reader
+        plain = pickle.dumps({"not": "a msg"}, protocol=pickle.HIGHEST_PROTOCOL)
+        s = socket.create_connection((host, port), timeout=5.0)
+        s.sendall(struct.pack("<I", len(plain)) + plain)
+        time.sleep(0.2)
+        s.close()
+        assert not os.path.exists("/tmp/adlb_pwned"), "pickle executed!"
+        assert b.recv(timeout=0.2) is None  # nothing delivered
+        # legitimate pickled traffic still flows afterwards
+        a = TcpEndpoint(0, {0: ("127.0.0.1", 0)})
+        a.addr_map[1] = b.addr_map[1]
+        try:
+            a.send(1, msg(Tag.FA_PUT, 0, payload=b"ok", work_type=1))
+            m = b.recv(timeout=5.0)
+            assert m is not None and m.payload == b"ok"
+        finally:
+            a.close()
+    finally:
+        b.close()
+
+
+def test_unregistered_app_payload_class_refused():
+    """An app-message payload whose class is not registered via
+    register_safe_pickle is refused (loads_restricted raises), and
+    registration makes the same bytes load."""
+    import pickle
+
+    from adlb_tpu.runtime.codec import (
+        loads_restricted,
+        register_safe_pickle,
+    )
+
+    body = pickle.dumps(
+        msg(Tag.AM_APP, 2, payload=Config(), apptag=1),
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    with pytest.raises(pickle.UnpicklingError, match="register_safe_pickle"):
+        loads_restricted(body)
+    from adlb_tpu.runtime import codec as _codec
+
+    register_safe_pickle("adlb_tpu.runtime.world", "Config")
+    try:
+        m = loads_restricted(body)
+        assert isinstance(m.data["payload"], Config)
+    finally:
+        # don't leak the registration into other tests' default-deny
+        # assertions
+        _codec._SAFE_PICKLE_GLOBALS.discard(
+            ("adlb_tpu.runtime.world", "Config")
+        )
+
+
 @pytest.mark.parametrize("mode", ["steal", "tpu"])
 def test_spawn_world_exhaustion(mode):
     r = spawn_world(
