@@ -121,12 +121,33 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
     tracker = HungryTracker()
     me = world.nranks  # pseudo-rank
 
+    def safe_send(dest: int, m) -> None:
+        """Send, treating an unreachable server as ended.
+
+        At end-of-world a server can close its listener between sending
+        DS_END and the sidecar draining its inbox (or while a broadcast
+        is mid-flight); connection refusal there is the normal teardown
+        race, not an error — marking the rank ended lets the loop drain
+        out instead of dying with an unhandled thread exception.
+        connect_grace is short because every peer here snapshots only
+        AFTER binding its listener, so a refusal never means "still
+        coming up" — without it each dead destination would stall the
+        loop for the transport's 15 s startup grace. A rank wrongly
+        ended by a transient error is resurrected by its next
+        SS_STATE."""
+        try:
+            ep.send(dest, m, connect_grace=0.25)
+        except OSError:
+            ended.add(dest)
+            snapshots.pop(dest, None)
+            tracker.drop(dest)
+
     def broadcast(payload) -> None:
         if payload is None:
             return
         is_hungry, req_types, grew = payload
-        for s in servers - ended:
-            ep.send(
+        for s in sorted(servers - ended):
+            safe_send(
                 s,
                 msg(Tag.SS_HUNGRY, me, hungry=int(is_hungry),
                     req_types=req_types, grew=int(grew)),
@@ -138,6 +159,11 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         m = ep.recv(timeout=0.25)
         while m is not None:
             if m.tag is Tag.SS_STATE:
+                # a fresh snapshot proves the server is alive: resurrect
+                # it if a transient send error wrongly marked it ended
+                # (DS_END is final — an ended-by-DS_END server never
+                # snapshots again, so this cannot resurrect those)
+                ended.discard(m.src)
                 snapshots[m.src] = decode_snapshot(m)
                 broadcast(tracker.update(m.src, snapshots[m.src]["reqs"]))
                 dirty = True
@@ -182,13 +208,17 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
             continue
         rounds += 1
         for holder, seqno, req_home, for_rank, rqseqno in matches:
-            ep.send(
+            if holder in ended:  # died earlier in this very plan loop
+                continue
+            safe_send(
                 holder,
                 msg(Tag.SS_PLAN_MATCH, me, seqno=seqno, for_rank=for_rank,
                     req_home=req_home, rqseqno=rqseqno),
             )
         for src_rank, dest, seqnos, mig_id in migrations:
-            ep.send(
+            if src_rank in ended or dest in ended:
+                continue
+            safe_send(
                 src_rank,
                 msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos,
                     mig_id=mig_id),

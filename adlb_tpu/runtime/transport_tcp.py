@@ -161,10 +161,14 @@ class TcpEndpoint:
             buf.extend(chunk)
         return bytes(buf)
 
-    def _connect(self, dest: int) -> socket.socket:
+    def _connect(self, dest: int, grace: float = 15.0) -> socket.socket:
         """Connect to a peer, tolerating a listener that is still coming up
-        (ranks bind at different times in thread/process worlds)."""
-        deadline = time.monotonic() + 15.0
+        (ranks bind at different times in thread/process worlds); ``grace``
+        bounds how long refusals are retried — senders that know their
+        peers are already up (e.g. the balancer sidecar, whose peers
+        snapshot only after binding) pass a short grace so a dead peer
+        fails fast instead of stalling the loop 15 s."""
+        deadline = time.monotonic() + grace
         while True:
             try:
                 sock = socket.create_connection(self.addr_map[dest], timeout=30)
@@ -175,7 +179,7 @@ class TcpEndpoint:
                     raise
                 time.sleep(0.05)
 
-    def send(self, dest: int, m: Msg) -> None:
+    def send(self, dest: int, m: Msg, connect_grace: float = 15.0) -> None:
         if dest in self.binary_peers:
             if not encodable(m):
                 raise ValueError(
@@ -194,14 +198,14 @@ class TcpEndpoint:
             with self._out_lock:
                 sock = self._out.get(dest)
             if sock is None:
-                sock = self._connect(dest)
+                sock = self._connect(dest, connect_grace)
                 with self._out_lock:
                     self._out[dest] = sock
             try:
                 sock.sendall(frame)
             except OSError:
                 # one reconnect attempt; beyond that the watchdog handles it
-                sock = self._connect(dest)
+                sock = self._connect(dest, connect_grace)
                 with self._out_lock:
                     self._out[dest] = sock
                 sock.sendall(frame)

@@ -587,3 +587,93 @@ def test_fully_stale_migration_batch_still_clears_credit(monkeypatch):
         "forged fully-stale migration credit was never cleared by the "
         "destination's ack"
     )
+
+
+def test_sidecar_survives_dead_destination():
+    """End-of-world race: a server closes its listener before the sidecar
+    finishes broadcasting/planning to it. The sidecar must mark the
+    destination ended and drain out — not die with an unhandled thread
+    exception (observed as BrokenPipe->ConnectionRefused tracebacks in
+    bench teardown)."""
+    from adlb_tpu.balancer.sidecar import run_sidecar
+    from adlb_tpu.runtime.messages import Tag, msg
+
+    world = _world(ns=2)
+    s0, s1 = world.server_ranks
+
+    class DeadEp:
+        """One SS_STATE with a parked requester (forces a HUNGRY
+        broadcast), then silence; every send is refused."""
+
+        def __init__(self):
+            self.frames = [
+                msg(Tag.SS_STATE, s0, tasks_flat=[100, T1, 5, 8],
+                    reqs_flat=[0, 1, 1, T1], nbytes=8, consumers=1),
+            ]
+            self.sends = 0
+
+        def recv(self, timeout=None):
+            return self.frames.pop(0) if self.frames else None
+
+        def send(self, dest, m, **kw):
+            self.sends += 1
+            raise ConnectionRefusedError(111, "refused")
+
+    ep = DeadEp()
+    cfg = Config(balancer="tpu", balancer_min_gap=0.0)
+    rounds = run_sidecar(world, cfg, ep)  # must return, not raise
+    assert ep.sends >= 1  # it really tried the dead destinations
+    # the refused broadcast popped the only snapshot, so no solve ran
+    assert rounds == 0
+
+
+def test_sidecar_survives_plan_frame_to_dead_holder():
+    """Same teardown race on the PLAN paths: the HUNGRY broadcast goes
+    through, the solve plans a match, and THEN the holder's listener is
+    gone — the plan-frame send must mark it ended (skipping its other
+    plan frames) and drain, not raise."""
+    from adlb_tpu.balancer.sidecar import run_sidecar
+    from adlb_tpu.runtime.messages import Tag, msg
+
+    world = _world(ns=2)
+    s0, s1 = world.server_ranks
+
+    class PlanDeadEp:
+        def __init__(self):
+            # Batch 1: holder s0 has two units; requester home s1 has two
+            # parked requesters -> the solve emits two matches for holder
+            # s0 (the None ends the batch so the solve runs). Batch 2:
+            # s1 finishes normally via DS_END, letting the loop drain.
+            self.script = [
+                msg(Tag.SS_STATE, s0,
+                    tasks_flat=[100, T1, 5, 8, 101, T1, 4, 8],
+                    reqs_flat=[], nbytes=16, consumers=1),
+                msg(Tag.SS_STATE, s1, tasks_flat=[],
+                    reqs_flat=[0, 1, 1, T1, 1, 2, 1, T1],
+                    nbytes=0, consumers=2),
+                None,
+                msg(Tag.DS_END, s1),
+            ]
+            self.plan_sends = 0
+            self.hungry_sends = 0
+
+        def recv(self, timeout=None):
+            return self.script.pop(0) if self.script else None
+
+        def send(self, dest, m, **kw):
+            if m.tag is Tag.SS_PLAN_MATCH or m.tag is Tag.SS_PLAN_MIGRATE:
+                self.plan_sends += 1
+                raise ConnectionRefusedError(111, "refused")
+            self.hungry_sends += 1  # HUNGRY broadcasts still deliver
+
+        def close(self):
+            pass
+
+    ep = PlanDeadEp()
+    cfg = Config(balancer="tpu", balancer_min_gap=0.0)
+    rounds = run_sidecar(world, cfg, ep)  # must return, not raise
+    assert rounds >= 1  # the solve really ran
+    assert ep.hungry_sends >= 1
+    # first plan frame to the dead holder ends it; its second match is
+    # skipped rather than re-attempted
+    assert ep.plan_sends == 1, ep.plan_sends
