@@ -689,6 +689,52 @@ def main() -> None:
             best = min(best, time.perf_counter() - t0)
         return round(max(best - null_s, 0.0) * 1e3, 1)
 
+    def solve_chained(nt, nr, k1=10, k2=50, reps=3):
+        """Per-solve time via the two-K difference: two jitted chains of
+        10 and 50 data-DEPENDENT kernel calls, (T50-T10)/40. The tunnel
+        RTT (and any fixed dispatch cost) cancels exactly, which the
+        single-dispatch null-subtraction above cannot guarantee — the
+        tunnel's RTT varies by tens of ms between samples, and round-5
+        re-measurement showed the subtraction overstating the 65k x 8k
+        kernel ~4x. The dependency (out[0] & 1 perturbs priorities) stops
+        XLA hoisting the loop-invariant solve (out[0] * 0 folds away and
+        runs ONE kernel for any K). The K spread must put the signal,
+        (k2-k1) x per-solve, well above the tunnel's tens-of-ms RTT
+        jitter — the 4k x 512 shape (~0.3 ms/solve) needs a few hundred
+        extra solves or the difference drowns (a first draw at 10/50
+        measured -0.55 ms)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        from adlb_tpu.balancer.pallas_solve import pallas_greedy_assign
+
+        rng = np.random.default_rng(0)
+        prio = jnp.asarray(rng.integers(0, 100, nt), jnp.int32)
+        ttype = jnp.asarray(rng.integers(0, 8, nt), jnp.int32)
+        mask = jnp.asarray(rng.random((nr, 8)) < 0.5)
+        valid = jnp.ones((nr,), bool)
+
+        def chain(K):
+            @_jax.jit
+            def chained(p):
+                def step(p, _):
+                    out = pallas_greedy_assign(p, ttype, mask, valid)
+                    return p + (out[0] & 1).astype(p.dtype), out[0]
+                _c, outs = _jax.lax.scan(step, p, None, length=K)
+                return outs
+
+            int(chained(prio).sum())  # compile + full sync
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                int(chained(prio).sum())
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        return round((chain(k2) - chain(k1)) / (k2 - k1) * 1e3, 2)
+
+    onchip_4k = onchip_65k = null_rtt_ms = None
+    chain_4k = chain_65k = None
     if on_tpu:
         try:
             null_s = null_rtt()
@@ -696,10 +742,14 @@ def main() -> None:
             onchip_4k = solve_onchip(8, 512, 64, null_s)
             onchip_65k = solve_onchip(16, 4096, 512, null_s, reps=3)
         except Exception as e:  # noqa: BLE001 — tunnel wedge must not kill
-            onchip_4k = onchip_65k = null_rtt_ms = None
             device_rows.setdefault("device_solve_error", repr(e))
-    else:
-        onchip_4k = onchip_65k = null_rtt_ms = None
+        # separate containment: a failure here must not discard the
+        # legacy rows measured above
+        try:
+            chain_4k = solve_chained(4096, 512, k1=10, k2=410)
+            chain_65k = solve_chained(65536, 8192)
+        except Exception as e:  # noqa: BLE001
+            device_rows.setdefault("device_chain_error", repr(e))
 
     lat_steal = coinop.run(
         n_tokens=400, num_app_ranks=APPS, nservers=SERVERS, cfg=cfg("steal"),
@@ -773,6 +823,11 @@ def main() -> None:
             "solve_onchip_4096x512_ms": onchip_4k,
             "solve_onchip_65536x8192_ms": onchip_65k,
             "device_null_rtt_ms": null_rtt_ms,
+            # two-K chained per-solve times: RTT cancels exactly (the
+            # robust on-chip numbers; the rows above keep the legacy
+            # single-dispatch method for cross-round continuity)
+            "solve_chain_4096x512_ms": chain_4k,
+            "solve_chain_65536x8192_ms": chain_65k,
             "hotspot_app_ranks": HOT_APPS,
             "hotspot_servers": HOT_SERVERS,
             "nq_n": N,

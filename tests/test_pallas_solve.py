@@ -99,3 +99,41 @@ def test_pallas_multiblock_sweep_matches_host(monkeypatch):
                  jnp.asarray(rv))
         )
         np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_int8_upcast_path_matches_host(monkeypatch):
+    """The size-gated int8-streaming + per-block-upcast layout (taken for
+    compat matrices >= _BIG_ELEMS) must be bit-exact with the host twin;
+    the gate is monkeypatched down so the branch runs at test shapes.
+    A distinctive shape avoids a stale jit-cache entry traced with the
+    real gate."""
+    import jax.numpy as jnp
+
+    from adlb_tpu.balancer import pallas_solve
+    from adlb_tpu.balancer.pallas_solve import make_pallas_assign
+
+    monkeypatch.setattr(pallas_solve, "_BIG_ELEMS", 1)
+    # shrink the slab too, so the upcast path also runs MULTI-block:
+    # stale upcast scratch on grid step i>0, counter persistence, and
+    # the exhaustion-skip branch are all upcast-specific states a
+    # single-block sweep would never exercise
+    monkeypatch.setattr(pallas_solve, "_SLAB_BYTES", 16 * 128)
+    kern = make_pallas_assign()
+    rng = np.random.default_rng(8)
+    for nt, nr, t in ((37, 19, 3), (211, 77, 5)):
+        tp, tt, rm, rv = _random_instance(rng, nt, nr, t)
+        want = _host_greedy(tp, tt, rm, rv)
+        got = np.asarray(
+            kern(jnp.asarray(tp), jnp.asarray(tt), jnp.asarray(rm),
+                 jnp.asarray(rv))
+        )
+        np.testing.assert_array_equal(got, want)
+    # few requesters vs many tasks: exhaustion fires early, so most
+    # blocks of this multi-block upcast sweep take the skip branch
+    tp, tt, rm, rv = _random_instance(rng, 1024, 9, 2)
+    want = _host_greedy(tp, tt, rm, rv)
+    got = np.asarray(
+        kern(jnp.asarray(tp), jnp.asarray(tt), jnp.asarray(rm),
+             jnp.asarray(rv))
+    )
+    np.testing.assert_array_equal(got, want)
