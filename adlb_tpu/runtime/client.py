@@ -40,6 +40,7 @@ from adlb_tpu.types import (
     AdlbAborted,
     AdlbError,
     GotWork,
+    HomeServerLostError,
     ReserveResult,
     WorkHandle,
 )
@@ -555,7 +556,7 @@ class Client:
                 # the lifeline is gone: error out instead of hanging in the
                 # next blocking wait (reference: rank failure kills the job)
                 self.aborted = True
-                raise AdlbError(
+                raise HomeServerLostError(
                     f"rank {self.rank}: home server {m.src} connection lost"
                 )
             return  # other peers closing is normal at termination

@@ -445,10 +445,16 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
             report("debug", None)
     except BaseException as e:  # noqa: BLE001 — surfaced to the parent
         try:
-            from adlb_tpu.types import AdlbAborted
+            from adlb_tpu.types import AdlbAborted, HomeServerLostError
 
             if isinstance(e, AdlbAborted):
                 report("aborted", e.code)
+            elif isinstance(e, HomeServerLostError):
+                # distinct kind: the parent decides whether this is abort
+                # collateral (server closed before the TA_ABORT landed)
+                # or a genuine server crash
+                abort_event.set()
+                report("conn_lost", repr(e))
             else:
                 abort_event.set()
                 report("error", repr(e))
@@ -565,7 +571,9 @@ def spawn_world(
 
     app_results, server_stats = {}, {}
     errors: list[str] = []
+    conn_lost: list[str] = []
     aborted_code = None
+    real_abort = False
     reported: set[int] = set()
     while len(reported) < world.nranks:
         remaining = deadline - time.monotonic()
@@ -591,8 +599,19 @@ def spawn_world(
             server_stats[rank] = value
         elif kind == "error":
             errors.append(f"rank {rank}: {value}")
+        elif kind == "conn_lost":
+            conn_lost.append(f"rank {rank}: {value}")
         elif kind == "aborted":
             aborted_code = value
+            # -1 is the abort_event sentinel (AdlbAborted(-1) raised when
+            # a sibling set the event), NOT proof a rank called Abort:
+            # a conn_lost child sets the event too, so collateral -1
+            # reports must not launder a genuine server failure into a
+            # clean abort. A real abort always yields a non-sentinel
+            # report — Client.abort raises AdlbAborted(code) in the
+            # aborting rank itself.
+            if value != -1:
+                real_abort = True
 
     for p in procs.values():
         p.join(timeout=max(deadline - time.monotonic(), 1.0))
@@ -604,6 +623,12 @@ def spawn_world(
 
         stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
 
+    # a rank losing its home server is abort COLLATERAL when some rank
+    # REALLY aborted the world (the server may close its listener before
+    # every TA_ABORT frame lands) — but a genuine failure when the only
+    # "aborts" are abort_event echoes of the conn_lost itself
+    if conn_lost and not real_abort:
+        errors.extend(conn_lost)
     if errors:
         raise RuntimeError("; ".join(errors))
     return WorldResult(

@@ -107,6 +107,18 @@ class AdlbError(RuntimeError):
     """Raised for API misuse (invalid type, invalid handle, ...)."""
 
 
+class HomeServerLostError(AdlbError):
+    """The client's home server closed its connection mid-run.
+
+    Under the rank-death fault model this ends the world either way, but
+    the HARNESS needs the distinction: when some rank aborted the world,
+    a server tearing down can close its clients' connections before
+    their TA_ABORT frames arrive — those clients die with this error as
+    abort COLLATERAL, and spawn_world classifies the world as aborted
+    rather than failed. Without an abort in flight it is a genuine
+    failure (server crash) and surfaces as an error."""
+
+
 class AdlbAborted(RuntimeError):
     """Raised in every rank when some rank called Abort."""
 

@@ -129,6 +129,43 @@ def test_unregistered_app_payload_class_refused():
         )
 
 
+def _abort_mid_economy(ctx):
+    import struct as _s
+
+    T_AB, T_C = 1, 2
+    if ctx.rank == 0:
+        for a in range(12):
+            ctx.put(_s.pack("<qq", a, a), T_AB, answer_rank=0)
+        for i in range(3):
+            rc, r = ctx.reserve([T_C])
+            ctx.get_reserved(r.handle)
+        ctx.abort(7)
+        return "aborted"
+    while True:
+        rc, r = ctx.reserve([T_AB])
+        if rc != ADLB_SUCCESS:
+            return None
+        rc, buf = ctx.get_reserved(r.handle)
+        a, b = _s.unpack("<qq", buf)
+        ctx.put(_s.pack("<q", a + b), T_C, target_rank=r.answer_rank)
+
+
+def test_abort_classification_survives_teardown_race():
+    """A mid-run abort must ALWAYS surface as res.aborted, even when a
+    tearing-down server closes its clients' connections before their
+    TA_ABORT frames land — that home-server EOF is abort collateral
+    (HomeServerLostError -> 'conn_lost'), not a world failure. The race
+    is timing-dependent, so the world is repeated; pre-fix, a batch of
+    this size reproduced the misclassification reliably (found by a
+    randomized chaos soak)."""
+    for i in range(8):
+        res = spawn_world(
+            4, 2, [1, 2], _abort_mid_economy,
+            cfg=Config(exhaust_check_interval=0.2), timeout=60.0,
+        )
+        assert res.aborted, f"iteration {i} lost the abort classification"
+
+
 @pytest.mark.parametrize("mode", ["steal", "tpu"])
 def test_spawn_world_exhaustion(mode):
     r = spawn_world(
