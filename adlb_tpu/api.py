@@ -292,6 +292,12 @@ def run_world(
             with lock:
                 errors.append(e)
             fabric.abort_event.set()
+        finally:
+            if server.tracer is not None:
+                # server handler/balancer spans join the same merged
+                # Chrome-trace stream as client API calls (pid = role)
+                with lock:
+                    trace_events.extend(server.tracer.events)
 
     debug_servers: list[DebugServer] = []
 

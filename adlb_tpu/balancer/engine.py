@@ -75,8 +75,14 @@ class PlanEngine:
         grow_window: Optional[float] = None,
         inflow_ttl: Optional[float] = None,
         inflow_min_age: Optional[float] = None,
+        metrics=None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
+
+        # optional obs registry (adlb_tpu/obs/metrics.py): round duration,
+        # plan age, and pairs/migrations emitted — attached by the
+        # in-server balancer thread (and the sidecar, which owns its own)
+        self.metrics = metrics
 
         self.solver = None
         if use_mesh:
@@ -354,6 +360,23 @@ class PlanEngine:
             ]
             if ages:
                 _PLAN_AGES.append(max(ages))
+                if self.metrics is not None:
+                    self.metrics.histogram("balancer_plan_age_s").observe(
+                        max(ages)
+                    )
+        if self.metrics is not None:
+            self.metrics.histogram("balancer_round_s").observe(
+                time.monotonic() - now
+            )
+            if matches:
+                self.metrics.counter("balancer_pairs").inc(len(matches))
+            if migrations:
+                self.metrics.counter("balancer_migrations").inc(
+                    len(migrations)
+                )
+                self.metrics.counter("balancer_migrated_units").inc(
+                    sum(len(mv[2]) for mv in migrations)
+                )
         # bound the memory of the plan ledgers
         if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
             cutoff = t_planned - 5.0

@@ -93,9 +93,16 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
     """Serve balancer rounds until every server says DS_END; returns the
     number of planning rounds executed."""
     from adlb_tpu.balancer.engine import PlanEngine, round_gap
+    from adlb_tpu.obs.metrics import Registry, attach
 
+    # the sidecar is its own process/thread: it owns its registry (round
+    # duration, plan ages, pairs) and instruments its endpoint's per-tag
+    # traffic like any server
+    metrics = Registry(rank=world.nranks)
+    attach(ep, metrics)
     engine = PlanEngine(
         types=world.types,
+        metrics=metrics,
         max_tasks=cfg.balancer_max_tasks,
         max_requesters=cfg.balancer_max_requesters,
         backend=cfg.solver_backend,
@@ -153,77 +160,98 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                     req_types=req_types, grew=int(grew)),
             )
 
-    while ended < servers:
-        if abort_event is not None and abort_event.is_set():
-            break
-        m = ep.recv(timeout=0.25)
-        while m is not None:
-            if m.tag is Tag.SS_STATE:
-                # a fresh snapshot proves the server is alive: resurrect
-                # it if a transient send error wrongly marked it ended
-                # (DS_END is final — an ended-by-DS_END server never
-                # snapshots again, so this cannot resurrect those)
-                ended.discard(m.src)
-                snapshots[m.src] = decode_snapshot(m)
-                broadcast(tracker.update(m.src, snapshots[m.src]["reqs"]))
-                dirty = True
-            elif m.tag is Tag.SS_STATE_DELTA:
-                # put-event: append task(s) to the sender's last full
-                # snapshot (stamp unchanged — requester re-eligibility only
-                # comes from full snapshots; see the server's merge).
-                # Batched shape (parallel lists) since round 4; the
-                # single-unit shape is kept for older daemons.
-                snap = snapshots.get(m.src)
-                if snap is not None:
-                    if m.data.get("seqnos") is not None:
-                        units = zip(m.seqnos, m.work_types, m.prios,
-                                    m.work_lens)
-                    else:
-                        units = [(m.seqno, m.work_type, m.prio, m.work_len)]
-                    for sq, wt, pr, ln in units:
-                        if len(snap["tasks"]) >= cfg.balancer_max_tasks:
-                            break
-                        snap["tasks"].append((sq, wt, pr, ln))
-                    snap["nbytes"] = m.data.get("nbytes", snap["nbytes"])
+    try:
+        while ended < servers:
+            if abort_event is not None and abort_event.is_set():
+                break
+            m = ep.recv(timeout=0.25)
+            while m is not None:
+                if m.tag is Tag.SS_STATE:
+                    # a fresh snapshot proves the server is alive: resurrect
+                    # it if a transient send error wrongly marked it ended
+                    # (DS_END is final — an ended-by-DS_END server never
+                    # snapshots again, so this cannot resurrect those)
+                    ended.discard(m.src)
+                    snapshots[m.src] = decode_snapshot(m)
+                    broadcast(tracker.update(m.src, snapshots[m.src]["reqs"]))
                     dirty = True
-            elif m.tag is Tag.DS_END:
-                ended.add(m.src)
-                snapshots.pop(m.src, None)
-                tracker.drop(m.src)
-            m = ep.recv(timeout=0.0)
-        broadcast(tracker.flush(time.monotonic()))
-        if not dirty or not snapshots:
-            continue
-        dirty = False
-        try:
-            matches, migrations = engine.round(snapshots, world)
-        except Exception as e:  # noqa: BLE001 — must keep serving
-            import sys
+                elif m.tag is Tag.SS_STATE_DELTA:
+                    # put-event: append task(s) to the sender's last full
+                    # snapshot (stamp unchanged — requester re-eligibility only
+                    # comes from full snapshots; see the server's merge).
+                    # Batched shape (parallel lists) since round 4; the
+                    # single-unit shape is kept for older daemons.
+                    snap = snapshots.get(m.src)
+                    if snap is not None:
+                        if m.data.get("seqnos") is not None:
+                            units = zip(m.seqnos, m.work_types, m.prios,
+                                        m.work_lens)
+                        else:
+                            units = [(m.seqno, m.work_type, m.prio, m.work_len)]
+                        for sq, wt, pr, ln in units:
+                            if len(snap["tasks"]) >= cfg.balancer_max_tasks:
+                                break
+                            snap["tasks"].append((sq, wt, pr, ln))
+                        snap["nbytes"] = m.data.get("nbytes", snap["nbytes"])
+                        dirty = True
+                elif m.tag is Tag.DS_END:
+                    ended.add(m.src)
+                    snapshots.pop(m.src, None)
+                    tracker.drop(m.src)
+                m = ep.recv(timeout=0.0)
+            broadcast(tracker.flush(time.monotonic()))
+            if not dirty or not snapshots:
+                continue
+            dirty = False
+            try:
+                matches, migrations = engine.round(snapshots, world)
+            except Exception as e:  # noqa: BLE001 — must keep serving
+                import sys
 
-            print(
-                f"[adlb sidecar] solve failed ({e!r}); forcing host path",
-                file=sys.stderr,
-            )
-            engine.force_host_path()
-            continue
-        rounds += 1
-        for holder, seqno, req_home, for_rank, rqseqno in matches:
-            if holder in ended:  # died earlier in this very plan loop
+                print(
+                    f"[adlb sidecar] solve failed ({e!r}); forcing host path",
+                    file=sys.stderr,
+                )
+                engine.force_host_path()
                 continue
-            safe_send(
-                holder,
-                msg(Tag.SS_PLAN_MATCH, me, seqno=seqno, for_rank=for_rank,
-                    req_home=req_home, rqseqno=rqseqno),
-            )
-        for src_rank, dest, seqnos, mig_id in migrations:
-            if src_rank in ended or dest in ended:
-                continue
-            safe_send(
-                src_rank,
-                msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos,
-                    mig_id=mig_id),
-            )
-        if cfg.balancer_min_gap > 0:
-            # shared cadence with the in-proc _BalancerWorker
-            time.sleep(round_gap(cfg.balancer_min_gap, matches, migrations))
+            rounds += 1
+            for holder, seqno, req_home, for_rank, rqseqno in matches:
+                if holder in ended:  # died earlier in this very plan loop
+                    continue
+                safe_send(
+                    holder,
+                    msg(Tag.SS_PLAN_MATCH, me, seqno=seqno, for_rank=for_rank,
+                        req_home=req_home, rqseqno=rqseqno),
+                )
+            for src_rank, dest, seqnos, mig_id in migrations:
+                if src_rank in ended or dest in ended:
+                    continue
+                safe_send(
+                    src_rank,
+                    msg(Tag.SS_PLAN_MIGRATE, me, dest=dest, seqnos=seqnos,
+                        mig_id=mig_id),
+                )
+            if cfg.balancer_min_gap > 0:
+                # shared cadence with the in-proc _BalancerWorker
+                time.sleep(round_gap(cfg.balancer_min_gap, matches, migrations))
+    finally:
+        # the registry's round/plan-age/traffic numbers become reachable
+        # as a flight artifact when the world opted in — written in a
+        # finally so a serve-loop crash (the one case a post-mortem is
+        # FOR) still leaves one; the sidecar is the one balancer brain a
+        # server post-mortem cannot see into otherwise
+        from adlb_tpu.obs.flight import write_artifact
+
+        write_artifact(
+            cfg.flight_dir,
+            "sidecar",
+            {
+                "role": "sidecar",
+                "rank": me,
+                "reason": "aborted" if (abort_event is not None
+                                        and abort_event.is_set()) else "exit",
+                "rounds": rounds,
+                "metrics": metrics.snapshot(),
+            },
+        )
     return rounds

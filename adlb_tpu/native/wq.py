@@ -180,3 +180,9 @@ class NativeWorkQueue:
     @property
     def total_bytes(self) -> int:
         return self._lib.adlb_wq_total_bytes(self._h)
+
+    def depth_sample(self) -> tuple[int, int, int]:
+        """(count, unpinned-untargeted, bytes) — the periodic
+        observability tick's queue-depth gauges (twin of the Python
+        WorkQueue's depth_sample; three cheap C calls)."""
+        return self.count, self.untargeted_avail, self.total_bytes

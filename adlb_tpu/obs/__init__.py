@@ -1,0 +1,24 @@
+"""Unified observability: metrics registry, flight recorder, ops endpoint.
+
+The reference scatters its observability across compile-time layers — MPE
+spans (``src/adlb_prof.c``), the STAT_APS periodic ring, the debug server's
+11-counter heartbeat, and the cblog circular buffer. The rebuild reproduced
+each piece in isolation; this package unifies them around one per-rank
+:class:`~adlb_tpu.obs.metrics.Registry` that every layer (transport, server
+reactor, balancer engine, client) writes into, one JSON
+:class:`~adlb_tpu.obs.flight.FlightRecorder` artifact emitted when a world
+dies, and one live HTTP surface
+(:class:`~adlb_tpu.obs.ops_server.OpsServer`) on the master server.
+"""
+
+from adlb_tpu.obs.flight import FlightRecorder, resolve_flight_dir
+from adlb_tpu.obs.metrics import Counter, Gauge, Histogram, Registry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "FlightRecorder",
+    "resolve_flight_dir",
+]

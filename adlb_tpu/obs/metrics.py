@@ -1,0 +1,335 @@
+"""Per-rank metrics registry: counters, gauges, log-bucket histograms.
+
+One :class:`Registry` per rank, written by every layer that has something
+to count — the transport (per-tag message/byte counters, send/recv
+latency), the server reactor (puts/reserves/rfrs/pushes, queue-depth
+gauges), the balancer engine (round duration, plan age, pairs emitted)
+and the client. Reads happen from other threads (the ops endpoint, the
+flight recorder), so the design rules are:
+
+* **instrument creation** is locked (get-or-create may race between the
+  reactor and transport reader threads);
+* **updates** are plain attribute writes/adds — unlocked. CPython's GIL
+  makes each individual ``+=`` on the hot path cheap; a torn read by a
+  scraper costs at most one sample of skew. A few instruments have two
+  writer threads (the reactor and the in-server balancer thread both
+  send on one endpoint, so they share per-tag tx counters and the
+  ``send_s`` histogram) — an interleaved ``+=`` can drop an increment
+  there. That bounded undercount is accepted by design: metrics must
+  never serialize the data plane behind a lock.
+
+Histograms use **fixed log buckets** (geometric bounds precomputed at
+creation, reference STAT_TIME_ON_Q-style fixed tables) so observation is
+one bisect + one integer add, and merging across ranks is elementwise.
+
+A bounded :class:`Timeseries` (ring of ``(t, value)`` samples) backs the
+queue-depth timelines the flight recorder dumps — the per-server
+wq/rq-depth history that diagnosing a hung or flat-wait world needs.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from collections import deque
+from typing import Iterable, Optional
+
+# default latency bucket geometry: 1 us .. ~17 min in x4 steps
+_DEF_BASE = 1e-6
+_DEF_MULT = 4.0
+_DEF_NBUCKETS = 16
+
+
+class Counter:
+    """Monotone counter. ``inc`` is a plain add — see module docstring."""
+
+    __slots__ = ("v",)
+
+    def __init__(self) -> None:
+        self.v = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.v += n
+
+
+class Gauge:
+    """Point-in-time value (queue depth, backlog, bytes held)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self) -> None:
+        self.v = 0.0
+
+    def set(self, v: float) -> None:
+        self.v = v
+
+
+class Histogram:
+    """Fixed-log-bucket histogram: counts[i] = observations <= bounds[i],
+    with one overflow bucket; plus sum/count for rate math."""
+
+    __slots__ = ("bounds", "counts", "sum", "n")
+
+    def __init__(
+        self,
+        base: float = _DEF_BASE,
+        mult: float = _DEF_MULT,
+        nbuckets: int = _DEF_NBUCKETS,
+    ) -> None:
+        self.bounds = tuple(base * mult**i for i in range(nbuckets))
+        self.counts = [0] * (nbuckets + 1)
+        self.sum = 0.0
+        self.n = 0
+
+    def observe(self, x: float) -> None:
+        # bisect_left: an observation EQUAL to a bound belongs in that
+        # bound's bucket (le = <=, Prometheus semantics)
+        self.counts[bisect_left(self.bounds, x)] += 1
+        self.sum += x
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket bound at quantile ``q`` (0..1) — coarse by design
+        (log buckets), good enough for p50/p95 health lines."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else float("inf")
+        return float("inf")
+
+
+class Timeseries:
+    """Bounded ring of (t, value) samples — the queue-depth timeline."""
+
+    __slots__ = ("_ring",)
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._ring: deque[tuple[float, float]] = deque(maxlen=capacity)
+
+    def append(self, t: float, v: float) -> None:
+        self._ring.append((t, v))
+
+    def samples(self) -> list[tuple[float, float]]:
+        return safe_copy(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+def safe_copy(seq) -> list:
+    """Copy a deque/list whose owner thread may be appending concurrently:
+    appends are atomic, but iterating a mutating deque raises — retry.
+    Shared by the timeline samplers and the flight recorder's ring copy."""
+    for _ in range(8):
+        try:
+            return list(seq)
+        except RuntimeError:
+            continue
+    return []
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+class Registry:
+    """One rank's metric store. Instruments are created on first use and
+    cached by (name, labels); hot paths should hold the returned object
+    instead of re-looking it up per event."""
+
+    def __init__(self, rank: int = -1) -> None:
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, Counter] = {}
+        self._gauges: dict[tuple, Gauge] = {}
+        self._hists: dict[tuple, Histogram] = {}
+        self._series: dict[str, Timeseries] = {}
+
+    # -- get-or-create ------------------------------------------------------
+
+    def counter(self, name: str, **labels) -> Counter:
+        k = _key(name, labels)
+        c = self._counters.get(k)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(k, Counter())
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        k = _key(name, labels)
+        g = self._gauges.get(k)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(k, Gauge())
+        return g
+
+    def histogram(
+        self,
+        name: str,
+        base: float = _DEF_BASE,
+        mult: float = _DEF_MULT,
+        nbuckets: int = _DEF_NBUCKETS,
+        **labels,
+    ) -> Histogram:
+        k = _key(name, labels)
+        h = self._hists.get(k)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(k, Histogram(base, mult, nbuckets))
+        return h
+
+    def timeseries(self, name: str, capacity: int = 2048) -> Timeseries:
+        s = self._series.get(name)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(name, Timeseries(capacity))
+        return s
+
+    # -- reads ---------------------------------------------------------------
+
+    def value(self, name: str, **labels) -> float:
+        """Current counter (or gauge) value; 0 when never touched."""
+        k = _key(name, labels)
+        c = self._counters.get(k)
+        if c is not None:
+            return c.v
+        g = self._gauges.get(k)
+        return g.v if g is not None else 0
+
+    def sum_counter(self, name: str) -> float:
+        """Sum of a counter over all its label sets (e.g. all tags)."""
+        with self._lock:  # creation may resize the dict mid-iteration
+            items = list(self._counters.items())
+        return sum(c.v for (n, _), c in items if n == name)
+
+    def _stable_items(self) -> tuple[list, list, list, list]:
+        """Consistent item lists for cross-thread readers (the ops scrape
+        / flight dump): instrument *creation* holds the lock, so copying
+        under it guarantees the dicts don't resize mid-iteration. Values
+        keep updating — a scrape sees each metric within one update of
+        live, which is the contract."""
+        with self._lock:
+            return (
+                list(self._counters.items()),
+                list(self._gauges.items()),
+                list(self._hists.items()),
+                list(self._series.items()),
+            )
+
+    def snapshot(self) -> dict:
+        """JSON-able dump of everything — the flight recorder's metrics
+        section and the cross-rank merge input."""
+
+        def lk(k: tuple) -> str:
+            name, labels = k
+            if not labels:
+                return name
+            return name + "{" + ",".join(f"{a}={b}" for a, b in labels) + "}"
+
+        counters, gauges, hists, series = self._stable_items()
+        return {
+            "rank": self.rank,
+            "counters": {lk(k): c.v for k, c in sorted(counters)},
+            "gauges": {lk(k): g.v for k, g in sorted(gauges)},
+            "histograms": {
+                lk(k): {
+                    "bounds": list(h.bounds),
+                    "counts": list(h.counts),
+                    "sum": h.sum,
+                    "count": h.n,
+                }
+                for k, h in sorted(hists)
+            },
+            "series": {
+                name: [[round(t, 6), v] for t, v in s.samples()]
+                for name, s in sorted(series)
+            },
+        }
+
+    @staticmethod
+    def merge(snapshots: Iterable[dict]) -> dict:
+        """Elementwise merge of :meth:`snapshot` dicts from many ranks:
+        counters and histogram cells sum; gauges keep per-rank identity by
+        gaining a ``rank=`` label (a summed queue depth across ranks is a
+        different metric than each rank's depth)."""
+        counters: dict[str, float] = {}
+        gauges: dict[str, float] = {}
+        hists: dict[str, dict] = {}
+        for snap in snapshots:
+            r = snap.get("rank", -1)
+            for k, v in snap.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                sep = "," if k.endswith("}") else "{"
+                base = k[:-1] if k.endswith("}") else k
+                gauges[f"{base}{sep}rank={r}}}"] = v
+            for k, h in snap.get("histograms", {}).items():
+                agg = hists.get(k)
+                if agg is None or len(agg["counts"]) != len(h["counts"]):
+                    hists[k] = {
+                        "bounds": list(h["bounds"]),
+                        "counts": list(h["counts"]),
+                        "sum": h["sum"],
+                        "count": h["count"],
+                    }
+                else:
+                    agg["counts"] = [
+                        a + b for a, b in zip(agg["counts"], h["counts"])
+                    ]
+                    agg["sum"] += h["sum"]
+                    agg["count"] += h["count"]
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    # -- text exposition -----------------------------------------------------
+
+    def expose(self, prefix: str = "adlb_") -> str:
+        """Prometheus-style text exposition of this registry (the ops
+        endpoint's ``/metrics`` body; aggregates are appended by the
+        caller). Counter names gain ``_total``; every sample carries a
+        ``rank`` label."""
+        out: list[str] = []
+        base_labels = {"rank": str(self.rank)} if self.rank >= 0 else {}
+
+        def fmt(name: str, labels: dict, v) -> str:
+            lab = {**base_labels, **labels}
+            ls = ",".join(f'{a}="{b}"' for a, b in sorted(lab.items()))
+            return f"{prefix}{name}{{{ls}}} {v}" if ls else f"{prefix}{name} {v}"
+
+        seen_types: set[str] = set()
+
+        def typ(name: str, t: str) -> None:
+            if name not in seen_types:
+                seen_types.add(name)
+                out.append(f"# TYPE {prefix}{name} {t}")
+
+        counters, gauges, hists, _ = self._stable_items()
+        for (name, labels), c in sorted(counters):
+            typ(name + "_total", "counter")
+            out.append(fmt(name + "_total", dict(labels), c.v))
+        for (name, labels), g in sorted(gauges):
+            typ(name, "gauge")
+            out.append(fmt(name, dict(labels), g.v))
+        for (name, labels), h in sorted(hists):
+            typ(name, "histogram")
+            lab = dict(labels)
+            cum = 0
+            for i, c in enumerate(h.counts):
+                cum += c
+                le = f"{h.bounds[i]:.9g}" if i < len(h.bounds) else "+Inf"
+                out.append(fmt(name + "_bucket", {**lab, "le": le}, cum))
+            out.append(fmt(name + "_sum", dict(labels), round(h.sum, 9)))
+            out.append(fmt(name + "_count", dict(labels), h.n))
+        return "\n".join(out) + "\n"
+
+
+def attach(ep, registry: Optional[Registry]) -> None:
+    """Point an endpoint's transport instrumentation at ``registry``
+    (both the TCP and in-proc endpoints check ``self.metrics``). First
+    attachment wins — a Server and a Client never share an endpoint, so
+    this only guards double-init."""
+    if registry is not None and getattr(ep, "metrics", None) is None:
+        ep.metrics = registry

@@ -1,0 +1,188 @@
+"""Live ops endpoint on the master server.
+
+A localhost HTTP surface (``Config(ops_port=...)``) so an operator — or a
+scraper — can interrogate a running world without touching the protocol
+plane:
+
+* ``GET /healthz`` — liveness + role summary (uptime, wq/rq depth,
+  done/aborted flags); JSON.
+* ``GET /metrics`` — Prometheus-style text exposition of the master's
+  registry (per-tag message counters, queue-depth gauges, latency
+  histograms), followed by the **world aggregate**: the most recent
+  STAT_APS record the periodic-stats ring delivered (enable with
+  ``Config(periodic_log_interval=...)``), exposed as
+  ``adlb_world_*``/``adlb_server_*`` samples stamped with the ring
+  sequence number so a scrape can be matched to the exact tick.
+* ``GET /dump`` — trigger a flight-record snapshot: returns the JSON doc
+  inline and writes the artifact when a flight directory is configured.
+
+The handler only reads plain attributes of the live ``Server`` object
+(GIL-consistent snapshots, same discipline as the metrics registry), so
+it never blocks the reactor. Binding is 127.0.0.1-only by design: this
+is an operator surface, not a public one.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def _world_agg_lines(agg: dict) -> list[str]:
+    """STAT_APS aggregate -> exposition lines (the 'world-aggregated via
+    the existing stats ring' half of /metrics)."""
+    out = [
+        "# world aggregate from the periodic stats ring (STAT_APS)",
+        f"adlb_stat_aps_seq {agg['seq']}",
+        f"adlb_stat_aps_trip_seconds {agg['trip_s']}",
+        f"adlb_world_nservers {agg['nservers']}",
+    ]
+    total = agg["total"]
+    for k in ("wq", "rq", "puts", "resolved", "nbytes"):
+        out.append(f"adlb_world_{k}_total {total[k]}")
+    for t, cell in agg["by_type"].items():
+        out.append(
+            f'adlb_world_wq_depth_by_type{{type="{t}",kind="untargeted"}} '
+            f"{cell['untargeted']}"
+        )
+        out.append(
+            f'adlb_world_wq_depth_by_type{{type="{t}",kind="targeted"}} '
+            f"{cell['targeted']}"
+        )
+    for r, e in agg["per_server"].items():
+        out.append(f'adlb_server_wq_depth{{rank="{r}"}} {e["wq"]}')
+        out.append(f'adlb_server_rq_depth{{rank="{r}"}} {e["rq"]}')
+        out.append(f'adlb_server_nbytes{{rank="{r}"}} {e["nbytes"]}')
+    return out
+
+
+class OpsServer:
+    """Threaded HTTP listener owned by the master server's process.
+
+    Started by ``Server.run()`` (master only) when ``cfg.ops_port`` is
+    set; stopped in its ``finally``. ``port`` holds the actual bound port
+    (``ops_port=0`` binds ephemeral — useful for tests on one host).
+    """
+
+    def __init__(self, server, port: int, host: str = "127.0.0.1") -> None:
+        self.server = server
+        self._t0 = None
+        srv = self.server
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # the reactor's stderr is not a
+                pass  # request log
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server contract
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        body = json.dumps(ops._healthz()).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/metrics":
+                        self._send(
+                            200, ops._metrics().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/dump":
+                        body = json.dumps(ops._dump()).encode()
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception as e:  # noqa: BLE001 — a scrape must
+                    # never kill the listener thread
+                    self._send(500, repr(e).encode(), "text/plain")
+
+            do_POST = do_GET  # /dump is idempotent either way
+
+        ops = self
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            daemon=True,
+            name=f"adlb-ops-{srv.rank}",
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "OpsServer":
+        import time
+
+        self._t0 = time.monotonic()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+    # -- views ---------------------------------------------------------------
+
+    def _healthz(self) -> dict:
+        import time
+
+        s = self.server
+        return {
+            "ok": not s._aborted,
+            "rank": s.rank,
+            "role": "master" if s.is_master else "server",
+            "uptime_s": round(time.monotonic() - (self._t0 or 0.0), 3),
+            "wq": s.wq.count,
+            "rq": len(s.rq),
+            "nbytes": s.mem.curr,
+            "done": s.done,
+            "aborted": s._aborted,
+            "no_more_work": s.no_more_work,
+            "done_by_exhaustion": s.done_by_exhaustion,
+            "nservers": s.world.nservers,
+        }
+
+    def _metrics(self) -> str:
+        s = self.server
+        body = s.metrics.expose()
+        agg = getattr(s, "last_aggregate", None)
+        if agg is not None:
+            body += "\n".join(_world_agg_lines(agg)) + "\n"
+        return body
+
+    def _dump(self) -> dict:
+        s = self.server
+        s.flight.record("ops /dump requested")
+        doc = s.flight.snapshot_doc(reason="ops")
+        path = s.flight.dump_json(reason="ops")
+        return {"artifact": path, "record": doc}
+
+
+def maybe_start(server, cfg) -> Optional[OpsServer]:
+    """Start the ops endpoint iff this server is the master and a port is
+    configured. Bind failures degrade to a warning — observability must
+    never take the data plane down with it."""
+    if not server.is_master or cfg.ops_port is None:
+        return None
+    try:
+        return OpsServer(server, cfg.ops_port).start()
+    except OSError as e:
+        import sys
+
+        print(
+            f"[adlb ops] could not bind ops endpoint on port "
+            f"{cfg.ops_port}: {e!r}; continuing without it",
+            file=sys.stderr,
+        )
+        return None

@@ -28,8 +28,10 @@ from typing import Optional, Sequence
 
 from contextlib import nullcontext
 
+from adlb_tpu.obs.flight import FlightRecorder
+from adlb_tpu.obs.metrics import Registry, attach
 from adlb_tpu.runtime.messages import Msg, Tag, msg
-from adlb_tpu.runtime.trace import Tracer
+from adlb_tpu.runtime.trace import PID_APP, Tracer
 from adlb_tpu.runtime.transport import Endpoint
 from adlb_tpu.runtime.world import Config, WorldSpec, normalize_req_types
 from adlb_tpu.types import (
@@ -70,7 +72,21 @@ class Client:
         self.aborted = False
         # MPE-equivalent event tracing (reference src/adlb_prof.c:46-74),
         # a run-time flag here instead of a compile-time one
-        self.tracer: Optional[Tracer] = Tracer(self.rank) if cfg.trace else None
+        self.tracer: Optional[Tracer] = (
+            Tracer(self.rank, pid=PID_APP, process_name="apps")
+            if cfg.trace
+            else None
+        )
+        # observability: per-rank metrics registry wired into the
+        # transport (per-tag msgs/bytes, send/recv latency) + a flight
+        # recorder dumped when this rank dies (abort, lost home server)
+        self.metrics = Registry(self.rank)
+        attach(ep, self.metrics)
+        self.flight = FlightRecorder(
+            self.rank, out_dir=cfg.flight_dir, role="app"
+        )
+        self.flight.metrics = self.metrics
+        self.flight.context = {"home": self.home}
         self._reserved_types: dict[tuple[int, int], int] = {}  # (holder, seqno) -> type
         # app<->app messages that arrived while waiting for a protocol
         # response (the reference's app_comm traffic is a separate MPI
@@ -115,6 +131,8 @@ class Client:
         while True:
             if self._abort_event is not None and self._abort_event.is_set():
                 self.aborted = True
+                self.flight.record(f"abort event observed waiting {want}")
+                self.flight.dump_json("abort_event")
                 raise AdlbAborted(-1)
             m = self.ep.recv(timeout=0.5)
             if m is None:
@@ -541,7 +559,10 @@ class Client:
         settled, anything else is a protocol error."""
         if m.tag is Tag.TA_ABORT:
             self.aborted = True
-            raise AdlbAborted(m.data.get("code", -1))
+            code = m.data.get("code", -1)
+            self.flight.record(f"TA_ABORT code={code} from {m.src}")
+            self.flight.dump_json("abort")
+            raise AdlbAborted(code)
         if m.tag is Tag.AM_APP:
             self._app_inbox.append(m)
             return
@@ -556,6 +577,8 @@ class Client:
                 # the lifeline is gone: error out instead of hanging in the
                 # next blocking wait (reference: rank failure kills the job)
                 self.aborted = True
+                self.flight.record(f"home server {m.src} connection lost")
+                self.flight.dump_json("home_server_lost")
                 raise HomeServerLostError(
                     f"rank {self.rank}: home server {m.src} connection lost"
                 )
@@ -752,6 +775,8 @@ class Client:
         """Bring the whole world down (reference ADLB_Abort,
         ``src/adlb.c:3165-3176``)."""
         self.aborted = True
+        self.flight.record(f"this rank called abort({code})")
+        self.flight.dump_json("abort_initiated")
         self.ep.send(self.home, msg(Tag.FA_ABORT, self.rank, code=code))
         if self._abort_event is not None:
             self._abort_event.set()

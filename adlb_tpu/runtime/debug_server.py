@@ -97,6 +97,25 @@ class DebugServer:
                         f"{self.cfg.debug_server_timeout:.1f}s — aborting world",
                         file=sys.stderr,
                     )
+                    # post-mortem artifact: the watchdog's last-known
+                    # per-server aggregates (the servers dump their own
+                    # flight records when the SS_ABORT below lands)
+                    from adlb_tpu.obs.flight import write_artifact
+
+                    write_artifact(
+                        self.cfg.flight_dir,
+                        "watchdog-timeout",
+                        {
+                            "role": "debug_server",
+                            "reason": "watchdog timeout",
+                            "timeout_s": self.cfg.debug_server_timeout,
+                            "aggregates": {
+                                str(r): dict(a)
+                                for r, a in self.aggregates.items()
+                            },
+                            "recent_lines": self.printed_lines[-20:],
+                        },
+                    )
                     for s in self.world.server_ranks:
                         self.ep.send(s, msg(Tag.SS_ABORT, self.ep.rank, code=-2))
                     for a in self.world.app_ranks:

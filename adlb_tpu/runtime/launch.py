@@ -124,6 +124,14 @@ def main(argv=None) -> int:
                     choices=["python", "native"])
     ap.add_argument("--balancer", default="steal", choices=["steal", "tpu"])
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory for per-rank flight-record JSON "
+                         "artifacts on abort/timeout (exported to app "
+                         "programs as ADLB_FLIGHT_DIR)")
+    ap.add_argument("--ops-port", type=int, default=None,
+                    help="serve /metrics, /healthz, /dump on "
+                         "127.0.0.1:<port> of the master server's host "
+                         "(0 = ephemeral)")
     ap.add_argument("prog", nargs="*",
                     help="app program (exec'd per app rank with "
                          "ADLB_RENDEZVOUS/ADLB_RANK set)")
@@ -134,7 +142,8 @@ def main(argv=None) -> int:
     types = [int(t) for t in args.types.split(",")]
     world = WorldSpec(nranks=args.nranks, nservers=args.nservers,
                       types=tuple(types))
-    cfg = Config(balancer=args.balancer, server_impl=args.server_impl)
+    cfg = Config(balancer=args.balancer, server_impl=args.server_impl,
+                 flight_dir=args.flight_dir, ops_port=args.ops_port)
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
     rdv = args.rendezvous
@@ -240,6 +249,10 @@ def main(argv=None) -> int:
             env["ADLB_RENDEZVOUS"] = merged
             env["ADLB_RANK"] = str(rank)
             env["ADLB_NUM_SERVERS"] = str(world.nservers)
+            if args.flight_dir:
+                # app programs (Python join_world or C clients' Python
+                # wrappers) opt into flight artifacts via the env contract
+                env["ADLB_FLIGHT_DIR"] = args.flight_dir
             if args.server_impl == "native":
                 env["ADLB_SERVER_IMPL"] = "native"
             procs.append(subprocess.Popen(args.prog, env=env))

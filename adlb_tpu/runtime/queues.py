@@ -262,6 +262,12 @@ class WorkQueue:
     def units(self) -> Iterable[WorkUnit]:
         return self._units.values()
 
+    def depth_sample(self) -> tuple[int, int, int]:
+        """(count, unpinned-untargeted, bytes) at O(1) — the periodic
+        observability tick's queue-depth gauges (the native core has an
+        identical twin, adlb_tpu/native/wq.py)."""
+        return self.count, self.untargeted_avail, self.total_bytes
+
 
 @dataclasses.dataclass
 class RqEntry:
@@ -305,6 +311,14 @@ class ReserveQueue:
 
     def waiting_ranks(self) -> list[int]:
         return list(self._entries)
+
+    def oldest_age(self, now: float) -> float:
+        """Age of the longest-parked requester (0 when none) — the
+        observability tick's park-age gauge, the direct signal behind a
+        'flat wait' shape (every tick shows someone parked this long)."""
+        if not self._entries:
+            return 0.0
+        return max(now - e.time_stamp for e in self._entries.values())
 
     def entries(self) -> list[RqEntry]:
         return list(self._entries.values())

@@ -32,8 +32,11 @@ import threading
 import time
 from typing import Optional
 
-from adlb_tpu.runtime.debug import FlightRecorder, aprintf, self_diagnosis
+from adlb_tpu.obs.flight import FlightRecorder
+from adlb_tpu.obs.metrics import Registry, attach
+from adlb_tpu.runtime.debug import aprintf, self_diagnosis
 from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.trace import PID_SERVER, Tracer
 from adlb_tpu.runtime.queues import (
     CommonStore,
     MemoryAccountant,
@@ -102,6 +105,7 @@ class _BalancerWorker(threading.Thread):
             grow_window=s.cfg.balancer_grow_window,
             inflow_ttl=s.cfg.balancer_inflow_ttl,
             inflow_min_age=s.cfg.balancer_inflow_min_age,
+            metrics=s.metrics,
         )
         s._solver = engine.solver
         while True:
@@ -128,7 +132,11 @@ class _BalancerWorker(threading.Thread):
 
     def _one_round(self, engine) -> None:
         s = self.server
-        matches, migrations = engine.round(dict(s._snapshots), s.world)
+        if s.tracer is not None:
+            with s.tracer.span("balancer:round"):
+                matches, migrations = engine.round(dict(s._snapshots), s.world)
+        else:
+            matches, migrations = engine.round(dict(s._snapshots), s.world)
         for holder, seqno, req_home, for_rank, rqseqno in matches:
             s.ep.send(
                 holder,
@@ -264,12 +272,42 @@ class Server:
         self._abort_event = abort_event
         self._aborted = False
 
+        # unified metrics registry (adlb_tpu/obs/metrics.py): the event
+        # counters the old ad-hoc _ds_counters dict held, plus queue-depth
+        # gauges/timelines sampled on the periodic tick, plus whatever the
+        # transport (per-tag msgs/bytes, send/recv latency) and the
+        # balancer engine (round duration, plan age, pairs) record into
+        # the same store. DS_LOG, STAT_APS contributions, the ops
+        # endpoint's /metrics, and flight-record artifacts all read it.
+        self.metrics = Registry(self.rank)
+        attach(self.ep, self.metrics)
+        self._m_puts = self.metrics.counter("puts")
+        self._m_reserves = self.metrics.counter("reserves")
+        self._m_rfrs = self.metrics.counter("rfrs")
+        self._m_pushes = self.metrics.counter("pushes")
+        self._g_wq = self.metrics.gauge("wq_depth")
+        self._g_rq = self.metrics.gauge("rq_depth")
+        self._ts_wq = self.metrics.timeseries("wq_depth")
+        self._ts_rq = self.metrics.timeseries("rq_depth")
+        # last STAT_APS world aggregate seen at the master (served by the
+        # ops endpoint's /metrics as the world-aggregated rows)
+        self.last_aggregate = None
+        self.ops = None
+
+        # server-side tracing: handler + balancer-round spans into the
+        # same Chrome-trace stream as client API calls (pid = role)
+        self.tracer = (
+            Tracer(self.rank, pid=PID_SERVER, process_name="servers")
+            if cfg.trace
+            else None
+        )
+        self._span_names: dict[Tag, str] = {}
+
         # timers
         now = time.monotonic()
         self._next_state_sync = now
         self._next_exhaust_check = now + cfg.exhaust_check_interval
         self._next_ds_log = now
-        self._ds_counters = {"puts": 0, "reserves": 0, "rfrs": 0, "pushes": 0}
         # since-last-DS_LOG bookkeeping for the reference's 11-counter
         # heartbeat payload (reference src/adlb.c:3222-3259)
         self._ds_last = {"events": 0, "ss": 0, "reserves": 0, "immed": 0,
@@ -286,8 +324,19 @@ class Server:
             else float("inf")
         )
 
-        # debug plumbing (reference src/adlb.c:176-179,558-710)
-        self.flight = FlightRecorder(self.rank)
+        # debug plumbing (reference src/adlb.c:176-179,558-710); the obs
+        # recorder adds JSON post-mortem artifacts on top of the text ring
+        self.flight = FlightRecorder(
+            self.rank, out_dir=cfg.flight_dir, role="server"
+        )
+        self.flight.metrics = self.metrics
+        self.flight.context = {
+            "is_master": self.is_master,
+            "balancer": cfg.balancer,
+            "nservers": world.nservers,
+            "num_app_ranks": world.num_app_ranks,
+            "local_apps": sorted(self.local_apps),
+        }
         self.tag_freq: dict[Tag, int] = {}
         self._next_selfdiag = (
             now + cfg.selfdiag_interval
@@ -364,10 +413,21 @@ class Server:
             f"apps={sorted(self.local_apps)}, balancer={self.cfg.balancer})",
         )
         try:
+            if self.cfg.ops_port is not None and self.is_master:
+                from adlb_tpu.obs.ops_server import maybe_start
+
+                self.ops = maybe_start(self, self.cfg)
+                if self.ops is not None:
+                    aprintf(
+                        self.cfg.aprintf_flag, self.rank,
+                        f"ops endpoint on 127.0.0.1:{self.ops.port}",
+                    )
             if self._balancer is not None:
                 self._balancer.start()
             self._run_loop()
         finally:
+            if self.ops is not None:
+                self.ops.stop()
             if self._balancer is not None:
                 self._balancer.stop()
                 # bounded join: a straggler round finishing after teardown
@@ -412,11 +472,7 @@ class Server:
             m = self.ep.recv(timeout=max(deadline - time.monotonic(), 0.0))
             t0 = time.monotonic()
             if m is not None:
-                handler = self._handlers.get(m.tag)
-                if handler is None:
-                    raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
-                self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
-                handler(m)
+                self._handle(m)
                 # drain whatever else is queued before paying the poll
                 # timeout — but bounded, so periodic duties (state sync,
                 # watchdog heartbeat, exhaustion checks) still run under
@@ -427,18 +483,45 @@ class Server:
                     m2 = self.ep.recv(timeout=0.0)
                     if m2 is None:
                         break
-                    h2 = self._handlers.get(m2.tag)
-                    if h2 is None:
-                        raise AdlbError(f"server {self.rank}: no handler for {m2.tag}")
-                    self.tag_freq[m2.tag] = self.tag_freq.get(m2.tag, 0) + 1
-                    h2(m2)
+                    self._handle(m2)
             self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
+
+    def _handle(self, m: Msg) -> None:
+        """Dispatch one message; when tracing, the handler runs inside a
+        ``srv:<TAG>`` span on the server tracer so the merged Chrome
+        trace shows the server side of every client round trip."""
+        handler = self._handlers.get(m.tag)
+        if handler is None:
+            raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
+        self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
+        tr = self.tracer
+        if tr is None:
+            handler(m)
+            return
+        name = self._span_names.get(m.tag)
+        if name is None:
+            name = self._span_names[m.tag] = f"srv:{m.tag.name}"
+        with tr.span(name, src=m.src):
+            handler(m)
 
     def _periodic(self, now: float, interval: float) -> None:
         if self._pending_delta and now >= self._delta_deadline:
             self._flush_task_deltas(now)
         if now >= self._next_state_sync:
             self._next_state_sync = now + interval
+            # queue-depth gauges + bounded timelines, sampled on the tick:
+            # the per-server depth history a post-mortem needs (VERDICT
+            # item 3's flat-wait diagnosis) at O(1) per tick
+            wq_d, wq_avail, wq_bytes = self.wq.depth_sample()
+            rq_d = len(self.rq)
+            self._g_wq.set(wq_d)
+            self._g_rq.set(rq_d)
+            self._ts_wq.append(now, wq_d)
+            self._ts_rq.append(now, rq_d)
+            m = self.metrics
+            m.gauge("wq_untargeted_avail").set(wq_avail)
+            m.gauge("wq_bytes").set(wq_bytes)
+            m.gauge("rq_oldest_age_s").set(self.rq.oldest_age(now))
             if self.cfg.balancer == "tpu":
                 # The snapshot walk is O(wq); at the fast balancer cadence
                 # it is a real GIL tax on compute-bound workloads. Walk it
@@ -594,7 +677,8 @@ class Server:
             "entries": {self.rank: pstats.contribution(self)},
         }
         if self.world.nservers == 1:
-            pstats.emit_stat_aps(pstats.aggregate(token, time.monotonic()))
+            self.last_aggregate = pstats.aggregate(token, time.monotonic())
+            pstats.emit_stat_aps(self.last_aggregate)
             return
         self._forward_pstats(token)
 
@@ -614,7 +698,10 @@ class Server:
 
         token = m.token
         if self.is_master:
-            pstats.emit_stat_aps(pstats.aggregate(token, time.monotonic()))
+            # kept for the ops endpoint: /metrics serves this aggregate
+            # (stamped with its ring seq) as the world-level rows
+            self.last_aggregate = pstats.aggregate(token, time.monotonic())
+            pstats.emit_stat_aps(self.last_aggregate)
             return
         token["entries"][self.rank] = pstats.contribution(self)
         self._forward_pstats(token)
@@ -759,7 +846,7 @@ class Server:
     # ------------------------------------------------------- app handlers
 
     def _on_put(self, m: Msg) -> None:
-        self._ds_counters["puts"] += 1
+        self._m_puts.inc()
         # pipelined puts (iput) tag each request; the id is echoed so the
         # client can match out-of-band responses
         put_id = m.data.get("put_id")
@@ -864,7 +951,7 @@ class Server:
                 break
 
     def _on_reserve(self, m: Msg) -> None:
-        self._ds_counters["reserves"] += 1
+        self._m_reserves.inc()
         self.stats[InfoKey.NUM_RESERVES] += 1
         app = m.src
         # binary-codec clients encode "any type" by omitting the field
@@ -1039,7 +1126,7 @@ class Server:
         self, entry: RqEntry, server: int, targeted_lookup: bool, lookup_type: int
     ) -> None:
         self._rfr_out.add(entry.world_rank)
-        self._ds_counters["rfrs"] += 1
+        self._m_rfrs.inc()
         self.flight.record(
             f"rfr -> server {server} for rank {entry.world_rank} "
             f"(targeted={targeted_lookup})"
@@ -1198,7 +1285,7 @@ class Server:
         self._push_seq += 1
         qid = (self.rank << 20) | self._push_seq
         self._push_offered[qid] = unit.seqno
-        self._ds_counters["pushes"] += 1
+        self._m_pushes.inc()
         self.ep.send(
             target,
             msg(
@@ -2117,7 +2204,8 @@ class Server:
             msg(
                 Tag.DS_LOG,
                 self.rank,
-                counters=dict(self._ds_counters),
+                counters={"puts": self._m_puts.v, "reserves": self._m_reserves.v,
+                          "rfrs": self._m_rfrs.v, "pushes": self._m_pushes.v},
                 events=events - last["events"],
                 wq_targeted=wq_targeted,
                 wq_count=self.wq.count,

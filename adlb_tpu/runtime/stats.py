@@ -43,7 +43,7 @@ def contribution(server) -> dict:
         "wq": [[t, tgt, n] for (t, tgt), n in sorted(hist.items())],
         "wq_count": server.wq.count,
         "rq": len(server.rq),
-        "puts": server._ds_counters["puts"],
+        "puts": int(server.metrics.value("puts")),
         "resolved": server.resolved_reserves,
         "nbytes": server.mem.curr,
     }

@@ -36,12 +36,32 @@ class InProcEndpoint:
         self.inbox: "queue.SimpleQueue[Msg]" = queue.SimpleQueue()
         self.bytes_sent = 0
         self.msgs_sent = 0
+        # observability: owning role attaches its metrics Registry
+        # (adlb_tpu.obs.metrics.attach). In-proc delivery is one queue
+        # put — there is no wire/decode layer — so only the tx side is
+        # instrumented (a rank's rx IS its peers' tx, readable from
+        # their registries); rx_*/send_s/recv_wait_s exist on the TCP
+        # endpoint where they measure something real
+        self.metrics = None
+        self._tx_stats: dict = {}
 
     def send(self, dest: int, m: Msg) -> None:
         self.msgs_sent += 1
         payload = m.data.get("payload")
-        if isinstance(payload, (bytes, bytearray)):
-            self.bytes_sent += len(payload)
+        nbytes = (
+            len(payload) if isinstance(payload, (bytes, bytearray)) else 0
+        )
+        self.bytes_sent += nbytes
+        reg = self.metrics
+        if reg is not None:
+            st = self._tx_stats.get(m.tag)
+            if st is None:
+                st = self._tx_stats[m.tag] = (
+                    reg.counter("tx_msgs", tag=m.tag.name),
+                    reg.counter("tx_bytes", tag=m.tag.name),
+                )
+            st[0].inc()
+            st[1].inc(nbytes)
         self._fabric.endpoints[dest].inbox.put(m)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:

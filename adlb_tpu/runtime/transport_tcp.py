@@ -61,6 +61,15 @@ class TcpEndpoint:
         # Learned automatically from inbound frames — clients always send
         # first (FA_*) — or declared upfront via the rendezvous.
         self.binary_peers: set[int] = set(binary_peers or ())
+        # observability: the owning role (Server/Client) attaches its
+        # metrics Registry here (adlb_tpu.obs.metrics.attach); per-tag
+        # counter objects are cached so the per-message cost is one
+        # None-check when detached and two dict hits when attached
+        self.metrics = None
+        self._tx_stats: dict = {}
+        self._rx_stats: dict = {}
+        self._h_send = None  # send_s / recv_wait_s histograms, cached on
+        self._h_recv = None  # first use (hot path: no per-message lookup)
 
         host, port = self.addr_map[rank]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -139,6 +148,18 @@ class TcpEndpoint:
                         # than silently dropping a frame someone awaits
                         return
                 last_src = m.src
+                reg = self.metrics
+                if reg is not None:
+                    st = self._rx_stats.get(m.tag)
+                    if st is None:
+                        st = self._rx_stats[m.tag] = (
+                            reg.counter("rx_msgs", tag=m.tag.name),
+                            reg.counter("rx_bytes", tag=m.tag.name),
+                        )
+                    st[0].inc()
+                    # header included, so a rank's rx_bytes reconciles
+                    # with its peers' tx_bytes (which count the frame)
+                    st[1].inc(_HDR.size + len(body))
                 self.inbox.put(m)
         except OSError:
             return
@@ -190,6 +211,8 @@ class TcpEndpoint:
         else:
             body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
         frame = _HDR.pack(len(body)) + body
+        reg = self.metrics
+        t0 = time.monotonic() if reg is not None else 0.0
         # per-destination serialization: a slow/dead peer (15 s connect
         # retry) must not stall sends to every other rank
         with self._out_lock:
@@ -209,6 +232,21 @@ class TcpEndpoint:
                 with self._out_lock:
                     self._out[dest] = sock
                 sock.sendall(frame)
+        if reg is not None:
+            st = self._tx_stats.get(m.tag)
+            if st is None:
+                st = self._tx_stats[m.tag] = (
+                    reg.counter("tx_msgs", tag=m.tag.name),
+                    reg.counter("tx_bytes", tag=m.tag.name),
+                )
+            st[0].inc()
+            st[1].inc(len(frame))
+            # whole-path send latency: serialization wait + (re)connect +
+            # kernel buffer admission — the "how backed up is this peer"
+            # signal the reference reads off MPI's unexpected queue
+            if self._h_send is None:
+                self._h_send = reg.histogram("send_s")
+            self._h_send.observe(time.monotonic() - t0)
 
     def backlog(self) -> int:
         """Received-but-unhandled frames — the TCP-era analogue of the
@@ -217,12 +255,23 @@ class TcpEndpoint:
         return self.inbox.qsize()
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
+        reg = self.metrics
+        t0 = time.monotonic() if reg is not None else 0.0
         try:
             if timeout is None:
-                return self.inbox.get()
-            return self.inbox.get(timeout=max(timeout, 0.0))
+                m = self.inbox.get()
+            else:
+                m = self.inbox.get(timeout=max(timeout, 0.0))
         except queue.Empty:
             return None
+        if reg is not None:
+            # wait-for-message latency (observed only when a message
+            # arrived: empty timeouts measure the poll deadline, not
+            # the transport)
+            if self._h_recv is None:
+                self._h_recv = reg.histogram("recv_wait_s")
+            self._h_recv.observe(time.monotonic() - t0)
+        return m
 
     def close(self) -> None:
         self._closed = True

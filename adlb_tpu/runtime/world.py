@@ -137,7 +137,22 @@ class Config:
     # balancer's task table over a jax.sharding.Mesh (one shard per device,
     # balancer/distributed.py); "off" = single-device solve
     balancer_mesh: str = "off"
-    trace: bool = False  # event tracing hooks (reference MPE shims)
+    trace: bool = False  # event tracing hooks (reference MPE shims);
+    # since the obs unification this traces BOTH sides: client API spans
+    # (pid 0) and server handler / balancer-round spans (pid 1) into one
+    # merged Chrome-trace stream
+    # Flight-recorder JSON artifacts: directory for per-rank post-mortem
+    # dumps on abort / watchdog timeout / lost home server. None defers
+    # to the ADLB_FLIGHT_DIR env var; unset = text dumps only
+    # (adlb_tpu/obs/flight.py; summarize with scripts/obs_report.py).
+    flight_dir: Optional[str] = None
+    # Live ops endpoint on the MASTER server: serves /metrics (registry
+    # exposition + last STAT_APS world aggregate), /healthz, and /dump
+    # (flight-record snapshot) on 127.0.0.1:<ops_port>. None = off;
+    # 0 = ephemeral port (the bound port is aprintf-logged and exposed
+    # as Server.ops.port). Enable periodic_log_interval for the
+    # world-aggregated rows.
+    ops_port: Optional[int] = None
     # restore pool state from checkpoint shards written by ctx.checkpoint()
     # (no reference analogue — SURVEY §5: checkpoint/resume absent there);
     # requires the same world shape the checkpoint was taken with
@@ -171,6 +186,8 @@ class Config:
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
         if self.qmstat_mode not in ("broadcast", "ring"):
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
+        if self.ops_port is not None and not (0 <= self.ops_port <= 65535):
+            raise ValueError("ops_port must be None or in 0..65535")
         # snapshot lists are flattened into binary-codec list fields whose
         # element count is a u16 (4 entries per task, 3+ntypes per
         # requester); keep a wide safety margin under 65535

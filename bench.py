@@ -15,6 +15,14 @@ the final line is guaranteed to fit and parse (round 3's grown detail
 line truncated to garbage — BENCH_r03.json "parsed": null). The compact
 line carries every headline field plus per-rep spreads so the claims
 are auditable from the driver's record alone.
+
+Estimator contract (round 6, VERDICT r5 items 2/5): the BAR metrics —
+``vs_baseline`` and the per-workload keys (``nq``/``tsp``/``sudoku``/
+``gfmc``/``classic_ratio``) — are the PAIRED per-rep-pair ratio medians
+(phase-robust: adjacent interleaved reps share the host's hour-scale
+phase, so the per-pair ratio cancels it); the pooled medians remain as
+``*_pooled``. The HEADLINE scale rows are the both-modes batch:8
+consumer rows ``n64b``/``n128b``; single-fetch scale rows are secondary.
 """
 
 import json
@@ -231,6 +239,40 @@ def main() -> None:
         })
     except _NATIVE_ERRS as e:
         native_rows.setdefault("native_batch_error", repr(e))
+
+    # 64 ranks, BOTH modes on the batched fused fetch — the HEADLINE
+    # 64-rank scale row since round 6 (VERDICT r5 item 2: the batched
+    # consumer is the framework's own best path and the measured scale
+    # story; the single-fetch rows above stay as secondary continuity
+    # metrics). Identical call in both modes; batching only pays for
+    # units the balancer pre-positioned locally — that asymmetry IS the
+    # balancing advantage being measured.
+    try:
+        nb64 = interleaved(
+            lambda m: hot_native(m, 64, 16, 7875, fetch="batch:8"),
+        )
+        nb64_steal = median_by(nb64["steal"],
+                               key=lambda r: r.tasks_per_sec)
+        nb64_tpu = median_by(nb64["tpu"], key=lambda r: r.tasks_per_sec)
+        native_rows.update({
+            "native_64r_batch8_steal_tasks_per_sec": round(
+                nb64_steal.tasks_per_sec, 1),
+            "native_64r_batch8_tpu_tasks_per_sec": round(
+                nb64_tpu.tasks_per_sec, 1),
+            "native_64r_batch8_ratio": round(
+                nb64_tpu.tasks_per_sec / nb64_steal.tasks_per_sec, 3)
+            if nb64_steal.tasks_per_sec else 0.0,
+            "native_64r_batch8_steal_wait_pct": round(
+                nb64_steal.wait_pct, 1),
+            "native_64r_batch8_tpu_wait_pct": round(
+                nb64_tpu.wait_pct, 1),
+            "native_64r_batch8_steal_reps": [
+                round(r.tasks_per_sec) for r in nb64["steal"]],
+            "native_64r_batch8_tpu_reps": [
+                round(r.tasks_per_sec) for r in nb64["tpu"]],
+        })
+    except _NATIVE_ERRS as e:
+        native_rows.setdefault("native_64r_batch_error", repr(e))
 
     # 128 ranks on the framework's own best consumer path: BOTH modes on
     # the batched fused fetch (identical call; batching only pays for
@@ -885,45 +927,61 @@ def main() -> None:
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
         "unit": "tasks/s",
-        "vs_baseline": round(
-            hot_tpu.tasks_per_sec / hot_steal.tasks_per_sec, 3)
-        if hot_steal.tasks_per_sec else 0.0,
+        # BAR METRIC = the PAIRED estimator (round 6, VERDICT r5 items
+        # 2/5): median of per-rep-PAIR tpu/steal ratios. Adjacent
+        # interleaved reps share the host's hour-scale phase, so pairing
+        # cancels it — five rounds of "rehearsals cleared it, the record
+        # drew a slow phase" is the pooled median's phase vulnerability.
+        # The pooled medians stay as *_pooled for cross-round continuity.
+        "vs_baseline": pair_ratio(hot_runs),
         "detail": {
+            "hot_pooled": round(
+                hot_tpu.tasks_per_sec / hot_steal.tasks_per_sec, 3)
+            if hot_steal.tasks_per_sec else 0.0,
             "idle_steal": round(steal_idle_med, 1),
             "idle_tpu": round(tpu_idle_med, 1),
             "idle_ratio": round(tpu_idle_med / steal_idle_med, 3)
             if steal_idle_med else 0.0,
-            "classic_ratio": round(
+            "classic_ratio": pair_ratio(hcl_runs),
+            "classic_pooled": round(
                 hcl_tpu.tasks_per_sec / hcl_steal.tasks_per_sec, 3)
             if hcl_steal.tasks_per_sec else 0.0,
             "classic_idle_ratio": round(hcl_tpu_idle / hcl_steal_idle, 3)
             if hcl_steal_idle else 0.0,
-            # secondary phase-robust estimators: median of PER-REP-PAIR
-            # ratios. Adjacent interleaved reps share the host's
-            # hour-scale phase, so pairing cancels it; the primary
-            # medians-of-modes above stay the cross-round-comparable
-            # figures (recorded draws: a steal rep landing in a fast
-            # phase swings the primary +-0.05 while the paired median
-            # stays put)
-            "hot_pair_ratio": pair_ratio(hot_runs),
-            "classic_pair_ratio": pair_ratio(hcl_runs),
-            "nq": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
+            # workload bars: paired first (the bar), pooled second
+            "nq": pair_ratio(nq_runs),
+            "nq_pooled": round(tpu.tasks_per_sec / steal.tasks_per_sec, 3)
             if steal.tasks_per_sec else 0.0,
-            "tsp": round(tsp_tpu / tsp_steal, 3) if tsp_steal else 0.0,
-            "tsp_pair": pair_ratio_t(tsp_runs),
-            "sudoku": round(sudoku_tpu / sudoku_steal, 3)
+            "tsp": pair_ratio_t(tsp_runs),
+            "tsp_pooled": round(tsp_tpu / tsp_steal, 3)
+            if tsp_steal else 0.0,
+            "sudoku": pair_ratio_t(sudoku_runs),
+            "sud_pooled": round(sudoku_tpu / sudoku_steal, 3)
             if sudoku_steal else 0.0,
-            "sud_pair": pair_ratio_t(sudoku_runs),
-            "gfmc": round(gfmc_tpu / gfmc_steal, 3) if gfmc_steal else 0.0,
-            "gfmc_pair": pair_ratio_t(gfmc_runs),
+            "gfmc": pair_ratio_t(gfmc_runs),
+            "gfmc_pooled": round(gfmc_tpu / gfmc_steal, 3)
+            if gfmc_steal else 0.0,
+            # HEADLINE scale rows (round 6): both modes on the batched
+            # (batch:8) consumer at 64 and 128 ranks —
+            # [ratio, steal_wait%, tpu_wait%]. The framework's own best
+            # consumer path carries the scale flag; single-fetch rows
+            # below are secondary continuity metrics.
+            "n64b": [native_rows.get("native_64r_batch8_ratio"),
+                     native_rows.get("native_64r_batch8_steal_wait_pct"),
+                     native_rows.get("native_64r_batch8_tpu_wait_pct")],
+            "n128b": [native_rows.get("native_128r_batch8_ratio"),
+                      native_rows.get("native_128r_batch8_steal_wait_pct"),
+                      native_rows.get("native_128r_batch8_tpu_wait_pct")],
+            # secondary: single-fetch hotspot rows (host-ceiling-bound,
+            # kept for cross-round comparison)
             "n16_ratio": native_rows.get("native_16r_ratio"),
             "n64_ratio": native_rows.get("native_64r_ratio"),
             "n16_wait": [native_rows.get("native_16r_steal_wait_pct"),
                          native_rows.get("native_16r_tpu_wait_pct")],
             "n64_wait": [native_rows.get("native_64r_steal_wait_pct"),
                          native_rows.get("native_64r_tpu_wait_pct")],
-            # the NAMED north-star workloads at native scale (r5):
-            # [ratio, steal_wait%, tpu_wait%] per scale
+            # the NAMED north-star workloads at native scale (secondary,
+            # single-fetch): [ratio, steal_wait%, tpu_wait%] per scale
             "nq64": [native_rows.get("native_nq_64r_ratio"),
                      native_rows.get("native_nq_64r_steal_wait_pct"),
                      native_rows.get("native_nq_64r_tpu_wait_pct")],
@@ -938,11 +996,6 @@ def main() -> None:
                        native_rows.get("native_tsp_128r_tpu_wait_pct")],
             "batch_fetch_delta_pct": native_rows.get(
                 "native_batch_fetch_delta_pct"),
-            # both modes on the batched consumer at 128 ranks:
-            # [ratio, steal_wait%, tpu_wait%]
-            "n128b": [native_rows.get("native_128r_batch8_ratio"),
-                      native_rows.get("native_128r_batch8_steal_wait_pct"),
-                      native_rows.get("native_128r_batch8_tpu_wait_pct")],
             "disp_p50": [round(tric_steal.dispatch_p50_ms, 2),
                          round(tric_tpu.dispatch_p50_ms, 2)],
             "ndisp_p50": [native_rows.get("native_trickle_p50_ms_steal"),

@@ -164,6 +164,14 @@ def one_iter(seed):
 def main():
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
     seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    # every world in the soak writes flight-record post-mortems, so a
+    # failure is diagnosable from artifacts instead of demanding a
+    # replay (summarize with scripts/obs_report.py <dir>)
+    if "ADLB_FLIGHT_DIR" not in os.environ:
+        os.environ["ADLB_FLIGHT_DIR"] = __import__("tempfile").mkdtemp(
+            prefix="chaos-flight-"
+        )
+    flight = os.environ["ADLB_FLIGHT_DIR"]
     deadline = time.monotonic() + minutes * 60
     i = 0
     while time.monotonic() < deadline:
@@ -172,6 +180,8 @@ def main():
             desc = one_iter(seed)
         except BaseException as e:
             print(f"CHAOS FAIL seed={seed}: {e!r}", flush=True)
+            print(f"flight records in {flight} "
+                  f"(python scripts/obs_report.py {flight})", flush=True)
             raise
         i += 1
         if i % 10 == 0:

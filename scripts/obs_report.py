@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Offline summarizer for flight-record JSON artifacts.
+
+A dead world (abort, watchdog timeout, lost home server) leaves one
+``flight-rank<R>-<reason>.json`` per rank in the flight directory
+(``Config(flight_dir=...)`` / ``ADLB_FLIGHT_DIR``). This tool turns a
+directory (or an explicit file list) of them into a post-mortem:
+
+* per rank: role, dump reason, and the tail of its recent-event ring;
+* counter totals (puts/reserves/rfrs/pushes and per-tag message counts)
+  summed across ranks, with the top talkers broken out;
+* per-server wq/rq queue-depth timelines (min/max/last + a coarse
+  sparkline) — the depth history that explains a hang or a flat wait.
+
+Usage:  python scripts/obs_report.py <flight-dir | flight-*.json ...>
+        python scripts/obs_report.py --json <...>   (merged record as JSON)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from adlb_tpu.obs.metrics import Registry  # noqa: E402
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 32) -> str:
+    if not values:
+        return ""
+    if len(values) > width:  # resample by bucket max (spikes must show)
+        step = len(values) / width
+        values = [
+            max(values[int(i * step): max(int((i + 1) * step), int(i * step) + 1)])
+            for i in range(width)
+        ]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1)), len(_SPARK) - 1)]
+        for v in values
+    )
+
+
+def load(paths: list[str]) -> list[dict]:
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.glob("flight-*.json")))
+        else:
+            files.append(pp)
+    docs = []
+    for f in files:
+        try:
+            doc = json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+            continue
+        doc["_file"] = str(f)
+        docs.append(doc)
+    return docs
+
+
+def _dedup_by_process(docs: list[dict]) -> list[dict]:
+    """One artifact per (rank, pid) — a rank can dump several artifacts
+    (abort_initiated then abort_event, plus ops /dump), all carrying the
+    SAME cumulative counters and timelines; merging every copy would
+    double-count. Keep the latest snapshot per process."""
+    best: dict[tuple, dict] = {}
+    for d in docs:
+        if "metrics" not in d:
+            continue
+        key = (d.get("rank"), d.get("pid"))
+        cur = best.get(key)
+        if cur is None or d.get("monotonic", 0) >= cur.get("monotonic", 0):
+            best[key] = d
+    return sorted(best.values(), key=lambda d: d.get("rank", 1 << 30))
+
+
+def _dedup_metrics(docs: list[dict]) -> list[dict]:
+    return [d["metrics"] for d in _dedup_by_process(docs)]
+
+
+def report(docs: list[dict], tail: int = 8) -> list[str]:
+    out: list[str] = []
+    ranked = sorted(docs, key=lambda d: d.get("rank", 1 << 30))
+    out.append(f"flight artifacts: {len(ranked)}")
+
+    # -- per-rank last events ------------------------------------------------
+    for d in ranked:
+        rank, role = d.get("rank", "?"), d.get("role", "?")
+        reason = d.get("reason", "")
+        events = d.get("events", [])
+        out.append(
+            f"\nrank {rank} [{role}] reason={reason!r} "
+            f"({len(events)} ring entries, {d['_file']})"
+        )
+        for ts, text in events[-tail:]:
+            out.append(f"  [{ts:.6f}] {text}")
+
+    # -- counter totals across ranks ----------------------------------------
+    merged = Registry.merge(_dedup_metrics(ranked))
+    if merged["counters"]:
+        out.append("\ncounter totals (all ranks):")
+        plain = {
+            k: v for k, v in merged["counters"].items() if "{" not in k
+        }
+        for k, v in sorted(plain.items()):
+            out.append(f"  {k:<28} {int(v)}")
+        tags: dict[str, float] = {}
+        for k, v in merged["counters"].items():
+            if k.startswith("rx_msgs{") or k.startswith("tx_msgs{"):
+                tags[k] = tags.get(k, 0) + v
+        if tags:
+            out.append("  top message flows:")
+            for k, v in sorted(tags.items(), key=lambda kv: -kv[1])[:12]:
+                out.append(f"    {k:<40} {int(v)}")
+
+    # -- queue-depth timelines (one per server process) ----------------------
+    any_series = False
+    for d in _dedup_by_process(ranked):
+        series = d.get("metrics", {}).get("series", {})
+        for name in ("wq_depth", "rq_depth"):
+            samples = series.get(name)
+            if not samples:
+                continue
+            if not any_series:
+                out.append("\nqueue-depth timelines (per server rank):")
+                any_series = True
+            vals = [v for _, v in samples]
+            t0, t1 = samples[0][0], samples[-1][0]
+            out.append(
+                f"  rank {d.get('rank', '?'):>3} {name:<8} "
+                f"n={len(vals):<5} min={min(vals):<6g} max={max(vals):<6g} "
+                f"last={vals[-1]:<6g} span={t1 - t0:>7.2f}s "
+                f"{sparkline(vals)}"
+            )
+    return out
+
+
+def main(argv: list[str]) -> int:
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
+    docs = load(paths)
+    if not docs:
+        print("no flight artifacts found", file=sys.stderr)
+        return 1
+    if as_json:
+        merged = Registry.merge(_dedup_metrics(docs))
+        print(json.dumps({"artifacts": docs, "merged_counters": merged}))
+        return 0
+    print("\n".join(report(docs)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

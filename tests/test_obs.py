@@ -1,0 +1,381 @@
+"""Unified observability layer: metrics registry semantics, flight-record
+JSON artifacts on abort, the master's live ops endpoint, and the merged
+client+server trace stream (adlb_tpu/obs/, ISSUE 1 tentpole)."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.obs.flight import FlightRecorder, resolve_flight_dir
+from adlb_tpu.obs.metrics import Registry
+from adlb_tpu.runtime.trace import PID_APP, PID_SERVER, span_names
+from adlb_tpu.runtime.transport_tcp import probe_free_ports, spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+# --------------------------------------------------------------- registry
+
+
+def test_counter_semantics():
+    reg = Registry(rank=3)
+    c = reg.counter("puts")
+    c.inc()
+    c.inc(4)
+    assert reg.value("puts") == 5
+    # labeled counters are distinct instruments; get-or-create returns
+    # the same object for the same (name, labels)
+    a = reg.counter("tx_msgs", tag="FA_PUT")
+    b = reg.counter("tx_msgs", tag="FA_RESERVE")
+    assert a is not b
+    a.inc(2)
+    b.inc(3)
+    assert reg.counter("tx_msgs", tag="FA_PUT") is a
+    assert reg.value("tx_msgs", tag="FA_PUT") == 2
+    assert reg.sum_counter("tx_msgs") == 5
+
+
+def test_gauge_and_timeseries():
+    reg = Registry(rank=0)
+    g = reg.gauge("wq_depth")
+    g.set(17)
+    g.set(4)
+    assert reg.value("wq_depth") == 4
+    ts = reg.timeseries("wq_depth", capacity=4)
+    for i in range(10):
+        ts.append(float(i), i * 10)
+    assert len(ts) == 4  # bounded ring
+    assert ts.samples() == [(6.0, 60), (7.0, 70), (8.0, 80), (9.0, 90)]
+
+
+def test_histogram_log_buckets():
+    reg = Registry(rank=0)
+    h = reg.histogram("send_s", base=1e-6, mult=10.0, nbuckets=4)
+    # bounds: 1e-6, 1e-5, 1e-4, 1e-3 (+ overflow)
+    assert h.bounds == pytest.approx((1e-6, 1e-5, 1e-4, 1e-3), rel=1e-9)
+    for x in (5e-7, 5e-6, 5e-6, 5e-4, 1.0):
+        h.observe(x)
+    assert h.counts == [1, 2, 0, 1, 1]
+    assert h.n == 5
+    assert h.sum == pytest.approx(5e-7 + 1e-5 + 5e-4 + 1.0, rel=1e-6)
+    # coarse quantiles land on bucket upper bounds
+    assert h.quantile(0.5) == pytest.approx(1e-5, rel=1e-9)
+    assert h.quantile(1.0) == float("inf")
+
+
+def test_exposition_format():
+    reg = Registry(rank=8)
+    reg.counter("puts").inc(12)
+    reg.counter("tx_msgs", tag="FA_PUT").inc(3)
+    reg.gauge("wq_depth").set(7)
+    reg.histogram("send_s", nbuckets=2).observe(0.5)
+    text = reg.expose()
+    assert 'adlb_puts_total{rank="8"} 12' in text
+    assert 'adlb_tx_msgs_total{rank="8",tag="FA_PUT"} 3' in text
+    assert 'adlb_wq_depth{rank="8"} 7' in text
+    assert '# TYPE adlb_send_s histogram' in text
+    assert 'adlb_send_s_bucket{le="+Inf",rank="8"} 1' in text
+    assert 'adlb_send_s_count{rank="8"} 1' in text
+
+
+def test_merge_across_ranks():
+    a, b = Registry(rank=1), Registry(rank=2)
+    a.counter("puts").inc(3)
+    b.counter("puts").inc(4)
+    a.gauge("wq_depth").set(10)
+    b.gauge("wq_depth").set(20)
+    for reg, x in ((a, 1e-6), (b, 1e-2)):
+        reg.histogram("send_s").observe(x)
+    merged = Registry.merge([a.snapshot(), b.snapshot()])
+    assert merged["counters"]["puts"] == 7
+    # gauges keep per-rank identity
+    assert merged["gauges"]["wq_depth{rank=1}"] == 10
+    assert merged["gauges"]["wq_depth{rank=2}"] == 20
+    assert merged["histograms"]["send_s"]["count"] == 2
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_artifact_roundtrip(tmp_path):
+    fr = FlightRecorder(5, capacity=4, out_dir=str(tmp_path), role="server")
+    reg = Registry(rank=5)
+    reg.counter("puts").inc(9)
+    reg.timeseries("wq_depth").append(1.0, 3)
+    fr.metrics = reg
+    fr.context = {"is_master": True}
+    for i in range(6):
+        fr.record(f"event {i}")
+    path = fr.dump_json("unit test")
+    assert path is not None and os.path.exists(path)
+    doc = json.loads(open(path).read())
+    assert doc["rank"] == 5 and doc["role"] == "server"
+    assert doc["reason"] == "unit test"
+    # the ring is circular: only the last `capacity` events survive
+    assert [t for _, t in doc["events"]] == [
+        "event 2", "event 3", "event 4", "event 5"
+    ]
+    assert doc["metrics"]["counters"]["puts"] == 9
+    assert doc["metrics"]["series"]["wq_depth"] == [[1.0, 3]]
+    assert doc["context"]["is_master"] is True
+
+
+def test_flight_recorder_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("ADLB_FLIGHT_DIR", raising=False)
+    fr = FlightRecorder(1)
+    fr.record("x")
+    assert fr.dump_json("nope") is None
+    # env contract: ADLB_FLIGHT_DIR enables artifacts worlds didn't config
+    monkeypatch.setenv("ADLB_FLIGHT_DIR", str(tmp_path))
+    assert resolve_flight_dir(None) == str(tmp_path)
+    fr2 = FlightRecorder(2)
+    assert fr2.dump_json("env") is not None
+
+
+def _flight_files(d):
+    return sorted(f for f in os.listdir(d) if f.startswith("flight-"))
+
+
+def test_flight_dump_on_injected_abort(tmp_path):
+    """A chaos-style world — garbage sprayed at live server ports plus a
+    mid-run abort — must leave per-rank JSON post-mortems that
+    scripts/obs_report.py can summarize (reuses the chaos-soak helpers)."""
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import chaos_soak
+    finally:
+        sys.path.remove(SCRIPTS)
+    cfg = Config(exhaust_check_interval=0.2, flight_dir=str(tmp_path))
+    res = spawn_world(
+        4, 2, [1, 2],
+        chaos_soak.answer_economy(20, do_abort=True, do_spray=True),
+        cfg=cfg, timeout=90.0,
+    )
+    assert res.aborted, "injected abort did not propagate"
+    arts = _flight_files(tmp_path)
+    # every server dumps; the aborting rank and at least some collateral
+    # app ranks dump too
+    server_arts = [a for a in arts if a.startswith(("flight-rank4", "flight-rank5"))]
+    assert len(server_arts) == 2, arts
+    assert any("abort_initiated" in a or "abort" in a for a in arts)
+    doc = json.loads((tmp_path / server_arts[0]).read_text())
+    assert doc["role"] == "server"
+    assert any("abort" in text for _, text in doc["events"])
+    # queue-depth timeline captured on the periodic tick
+    assert doc["metrics"]["series"]["wq_depth"], "no wq timeline sampled"
+    # offline summary: per-rank last events + counters + timelines
+    out = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "obs_report.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "counter totals" in out.stdout
+    assert "wq_depth" in out.stdout
+    assert "abort" in out.stdout
+
+
+# ------------------------------------------------------------ ops endpoint
+
+
+def test_ops_endpoint_round_trip(tmp_path):
+    """8-rank TCP world with the master serving /metrics, /healthz and
+    /dump on localhost: per-tag message counters and wq/rq depth gauges
+    must be scrapeable live, with the world aggregate rows carrying the
+    STAT_APS ring's seq (the issue's acceptance criterion)."""
+    port = probe_free_ports(1)[0]
+    T = 1
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for a in range(30):
+                ctx.put(struct.pack("<q", a), T)
+            time.sleep(0.6)  # let consumers run + the stats ring tick
+            out = {}
+            for route in ("healthz", "metrics", "dump"):
+                out[route] = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/{route}", timeout=10
+                ).read().decode()
+            ctx.set_problem_done()
+            return out
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return n
+            ctx.get_reserved(r.handle)
+            time.sleep(0.02)
+            n += 1
+
+    cfg = Config(ops_port=port, periodic_log_interval=0.1,
+                 flight_dir=str(tmp_path))
+    res = spawn_world(6, 2, [T], app, cfg=cfg, timeout=90.0)
+    got = res.app_results[0]
+
+    health = json.loads(got["healthz"])
+    assert health["ok"] is True
+    assert health["role"] == "master"
+    assert health["nservers"] == 2
+
+    m = got["metrics"]
+    # per-tag transport counters from the master's own registry
+    assert 'adlb_rx_msgs_total{rank="6",tag="FA_PUT"}' in m
+    assert 'adlb_tx_msgs_total{rank="6",tag="TA_PUT_RESP"}' in m
+    # queue-depth gauges sampled on the periodic tick
+    assert 'adlb_wq_depth{rank="6"}' in m
+    assert 'adlb_rq_depth{rank="6"}' in m
+    # latency histograms
+    assert "adlb_send_s_bucket" in m and "adlb_recv_wait_s_count" in m
+    # world aggregate via the existing stats ring, stamped with its seq;
+    # the per-server depth rows must cover every server rank
+    assert "adlb_stat_aps_seq" in m
+    assert "adlb_world_wq_total" in m
+    assert 'adlb_server_wq_depth{rank="6"}' in m
+    assert 'adlb_server_wq_depth{rank="7"}' in m
+    # .. and the exposed aggregate is self-consistent: world totals are
+    # the sum of the per-server rows from the SAME STAT_APS record
+    per_server = {
+        line.split()[0]: float(line.split()[1])
+        for line in m.splitlines()
+        if line.startswith("adlb_server_wq_depth")
+    }
+    world_wq = next(
+        float(line.split()[1]) for line in m.splitlines()
+        if line.startswith("adlb_world_wq_total")
+    )
+    assert sum(per_server.values()) == world_wq
+
+    dump = json.loads(got["dump"])
+    assert dump["record"]["role"] == "server"
+    assert dump["record"]["metrics"]["series"]["wq_depth"]
+    assert dump["artifact"] and dump["artifact"].endswith(".json")
+
+    assert sum(v for k, v in res.app_results.items() if k != 0) == 30
+
+
+def test_ops_port_validation():
+    with pytest.raises(ValueError):
+        Config(ops_port=70000)
+    Config(ops_port=None)
+    Config(ops_port=0)
+
+
+# ------------------------------------------------------------ merged trace
+
+
+def test_merged_trace_client_and_server_share_timeline(tmp_path):
+    """Client API spans (pid 0) and server handler / balancer-round spans
+    (pid 1) land in ONE Chrome-trace stream on a shared clock, so a
+    merged Perfetto file shows both sides of every reserve."""
+    T = 1
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(10):
+                ctx.put(b"w" * 16, T, work_prio=i)
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc < 0:
+                break
+            ctx.get_reserved(r.handle)
+            time.sleep(0.005)
+            n += 1
+        if ctx.rank == 0:
+            ctx.set_problem_done()
+        return n
+
+    res = run_world(2, 1, [T], app, cfg=Config(trace=True, balancer="tpu"),
+                    timeout=60.0)
+    assert sum(res.app_results.values()) == 10
+    ev = res.trace_events
+    names = span_names(ev)
+    # both sides of the put/reserve/get round trips
+    assert {"adlb:put", "adlb:reserve", "adlb:get_reserved"} <= names
+    assert {"srv:FA_PUT", "srv:FA_RESERVE", "srv:FA_GET_RESERVED"} <= names
+    # the balancer thread's rounds trace into the same stream
+    assert "balancer:round" in names
+    # pid = role; process_name metadata labels both lanes
+    pids = {e["pid"] for e in ev if e["ph"] != "M"}
+    assert pids == {PID_APP, PID_SERVER}
+    meta = {
+        e["args"]["name"] for e in ev
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert meta == {"apps", "servers"}
+    # one timeline: globally time-sorted, and the server span for a put
+    # overlaps the interval in which SOME client-side put span ran
+    ts = [e["ts"] for e in ev]
+    assert ts == sorted(ts)
+    cli_puts = [e for e in ev if e["name"] == "adlb:put"]
+    srv_puts = [e for e in ev if e["name"] == "srv:FA_PUT"]
+    assert cli_puts and srv_puts
+    lo = min(e["ts"] for e in cli_puts)
+    hi = max(e["ts"] + e["dur"] for e in cli_puts)
+    assert any(lo <= e["ts"] <= hi for e in srv_puts), (
+        "server put handling does not overlap client put spans — "
+        "clocks not shared?"
+    )
+    # the dump loads as one valid chrome trace
+    out = tmp_path / "merged.json"
+    res.save_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+
+
+def test_tracer_bounded_memory():
+    from adlb_tpu.runtime.trace import Tracer
+
+    tr = Tracer(0, max_events=3)
+    for i in range(10):
+        tr.instant(f"e{i}")
+    assert len(tr.events) == 3
+    assert tr.dropped == 7
+
+
+# ------------------------------------------------- registry in the reactor
+
+
+def test_server_counters_feed_stats_ring_and_ds_log():
+    """The registry replaces the ad-hoc _ds_counters dict: the periodic
+    stats ring and the debug-server heartbeat read the same counters the
+    reactor increments."""
+    T = 1
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(5):
+                ctx.put(b"x", T)
+            time.sleep(0.3)  # let the stats ring tick while work drains
+            ctx.set_problem_done()
+            return 0
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc < 0:
+                return n
+            ctx.get_reserved(r.handle)
+            time.sleep(0.02)
+            n += 1
+
+    from adlb_tpu.runtime import stats as pstats
+
+    lines = []
+    pstats.set_sink(lines.append)
+    try:
+        run_world(2, 1, [T], app,
+                  cfg=Config(periodic_log_interval=0.05), timeout=60.0)
+    finally:
+        pstats.set_sink(None)
+    records = pstats.parse_stat_lines(lines)
+    assert records, "no STAT_APS records emitted"
+    assert records[-1]["total"]["puts"] == 5

@@ -60,8 +60,14 @@ def test_world_trace_collection(tmp_path):
     assert len(user) == 6
     assert all(e["dur"] >= 1_500 for e in user)
     assert all(e["args"]["work_type"] == T for e in user)
-    # both app ranks traced
-    assert {e["tid"] for e in res.trace_events} == {0, 1}
+    # both app ranks traced (pid 0 = apps); the server traces too (pid 1)
+    app_tids = {e["tid"] for e in res.trace_events
+                if e["pid"] == 0 and e["ph"] != "M"}
+    assert app_tids == {0, 1}
+    srv_tids = {e["tid"] for e in res.trace_events
+                if e["pid"] == 1 and e["ph"] != "M"}
+    assert srv_tids == {2}, "server rank 2 should trace its handlers"
+    assert {"srv:FA_PUT", "srv:FA_RESERVE", "srv:FA_GET_RESERVED"} <= names
     # events arrive time-sorted and the file is valid chrome trace JSON
     ts = [e["ts"] for e in res.trace_events]
     assert ts == sorted(ts)
