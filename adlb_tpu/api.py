@@ -149,6 +149,10 @@ class WorldResult:
     # the watchdog instance when use_debug_server=True (its aggregates and
     # printed per-interval summary lines are inspectable post-run)
     debug_server: Optional[Any] = None
+    # app ranks that died mid-run and were absorbed by
+    # Config(on_worker_failure="reclaim") — the world completed around
+    # them, so they have no entry in app_results
+    casualties: list[int] = dataclasses.field(default_factory=list)
 
     def save_trace(self, path: str) -> None:
         from adlb_tpu.runtime.trace import save_chrome_trace
@@ -226,9 +230,19 @@ def join_world(
         for line in f:
             r, h, p = line.split()
             addr_map[int(r)] = (h, int(p))
-    cfg = cfg or Config(
-        server_impl=os.environ.get("ADLB_SERVER_IMPL", "python")
-    )
+    if cfg is None:
+        fault_spec = None
+        if os.environ.get("ADLB_FAULT_SPEC"):
+            import json
+
+            fault_spec = json.loads(os.environ["ADLB_FAULT_SPEC"])
+        cfg = Config(
+            server_impl=os.environ.get("ADLB_SERVER_IMPL", "python"),
+            on_worker_failure=os.environ.get(
+                "ADLB_ON_WORKER_FAILURE", "abort"
+            ),
+            fault_spec=fault_spec,
+        )
     world = WorldSpec(
         nranks=len(addr_map), nservers=nservers, types=tuple(types)
     )
@@ -236,6 +250,10 @@ def join_world(
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
     ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers)
+    if cfg.fault_spec:
+        from adlb_tpu.runtime.faults import maybe_wrap
+
+        ep = maybe_wrap(ep, cfg)
     return JoinedWorld(AdlbContext(Client(world, cfg, ep)), ep)
 
 
@@ -261,10 +279,15 @@ def run_world(
     server_stats: dict[int, dict[int, float]] = {}
     trace_events: list[dict] = []
     errors: list[BaseException] = []
+    casualties: list[int] = []
     lock = threading.Lock()
 
+    from adlb_tpu.runtime.faults import maybe_wrap
+    from adlb_tpu.types import HomeServerLostError
+
     def app_main(rank: int) -> None:
-        client = Client(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        client = Client(world, cfg, maybe_wrap(fabric.endpoint(rank), cfg),
+                        fabric.abort_event)
         ctx = AdlbContext(client)
         try:
             result = app_fn(ctx)
@@ -273,17 +296,33 @@ def run_world(
         except AdlbAborted:
             pass
         except BaseException as e:  # noqa: BLE001 — surfaced via WorldResult
-            with lock:
-                errors.append(e)
-            fabric.abort_event.set()
+            if cfg.on_worker_failure == "reclaim" and isinstance(
+                e, HomeServerLostError
+            ):
+                # a fault-injected disconnect (or real connectivity loss —
+                # the client raises HomeServerLostError for ANY peer that
+                # stays unreachable) is a CASUALTY under the reclaim
+                # policy: the world keeps running without this rank.
+                # Application errors (including the app's own OSErrors)
+                # still surface as world failures.
+                with lock:
+                    casualties.append(rank)
+            else:
+                with lock:
+                    errors.append(e)
+                fabric.abort_event.set()
         finally:
-            client.finalize()
+            try:
+                client.finalize()
+            except Exception:  # dead endpoint at teardown: benign
+                pass
             if client.tracer is not None:
                 with lock:
                     trace_events.extend(client.tracer.events)
 
     def server_main(rank: int) -> None:
-        server = Server(world, cfg, fabric.endpoint(rank), fabric.abort_event)
+        server = Server(world, cfg, maybe_wrap(fabric.endpoint(rank), cfg),
+                        fabric.abort_event)
         try:
             server.run()
             with lock:
@@ -348,6 +387,7 @@ def run_world(
         exception=errors[0] if errors else None,
         trace_events=trace_events,
         debug_server=debug_servers[0] if debug_servers else None,
+        casualties=sorted(casualties),
     )
     if errors:
         raise errors[0]

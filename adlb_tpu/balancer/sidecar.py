@@ -198,6 +198,23 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                     ended.add(m.src)
                     snapshots.pop(m.src, None)
                     tracker.drop(m.src)
+                elif m.tag is Tag.SS_RANK_DEAD:
+                    # a worker died under on_worker_failure="reclaim":
+                    # retire its parked requests from every held snapshot
+                    # so the next plan stops matching/migrating toward it
+                    # (stale entries would only cost an UNRESERVE bounce,
+                    # but the dead rank must not keep attracting work).
+                    # Forward-compat: today reclaim requires python
+                    # servers (whose master patches its own snapshots),
+                    # so this only fires if a future native plane or an
+                    # operator tool relays the death here.
+                    dead = m.rank
+                    for src, snap in snapshots.items():
+                        kept = [r for r in snap["reqs"] if r[0] != dead]
+                        if len(kept) != len(snap["reqs"]):
+                            snap["reqs"] = kept
+                            dirty = True
+                            broadcast(tracker.update(src, kept))
                 m = ep.recv(timeout=0.0)
             broadcast(tracker.flush(time.monotonic()))
             if not dirty or not snapshots:

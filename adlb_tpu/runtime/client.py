@@ -23,6 +23,7 @@ Behavioral contract kept from the reference:
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Optional, Sequence
 
@@ -38,6 +39,7 @@ from adlb_tpu.types import (
     ADLB_NO_CURRENT_WORK,
     ADLB_NO_MORE_WORK,
     ADLB_PUT_REJECTED,
+    ADLB_RETRY,
     ADLB_SUCCESS,
     AdlbAborted,
     AdlbError,
@@ -99,6 +101,15 @@ class Client:
         self._pending_puts: dict[int, dict] = {}
         self._failed_puts = 0
         self._failed_nmw = False
+        # retry/backoff state: capped exponential backoff with
+        # decorrelated jitter (sleep_k ~ U(base, 3*sleep_{k-1}), capped)
+        # replaces the fixed put_retry_sleep spin — under contention the
+        # fixed interval synchronized whole worker pools into retry
+        # convoys. Seeded per rank: reproducible, and ranks decorrelate.
+        self._retry_rng = random.Random(0xADB0 + 7919 * self.rank)
+        self._m_put_retries = self.metrics.counter("put_retries")
+        self._m_reserve_retries = self.metrics.counter("reserve_retries")
+        self._m_reconnects = self.metrics.counter("reconnects")
 
     def _span(self, name: str, **args):
         """API-call trace span + user-state inference boundary."""
@@ -126,6 +137,77 @@ class Client:
         """Where a rejected put retries: the rejecting server's least-loaded
         hint, else round-robin (reference src/adlb.c:2779-2796)."""
         return hint if hint is not None and hint >= 0 else self._next_server()
+
+    def _backoff_sleep(self, prev: float, cap: Optional[float] = None) -> float:
+        """Sleep one capped decorrelated-jitter step and return it (feed it
+        back in as ``prev`` for the next attempt). ``cap`` overrides
+        ``put_retry_cap`` for paths that must stay short."""
+        base = self.cfg.put_retry_sleep
+        s = min(
+            self.cfg.put_retry_cap if cap is None else cap,
+            self._retry_rng.uniform(base, max(base, prev * 3.0)),
+        )
+        time.sleep(s)
+        return s
+
+    def _send_retry(self, dest: int, m: Msg) -> None:
+        """Protocol send that survives peer-connection churn: the endpoint
+        already retries the socket once; past that the client backs off
+        and re-sends up to ``cfg.reconnect_attempts`` times instead of
+        dying on the first OSError. A home server that stays unreachable
+        is still terminal — there is nothing to fail over to."""
+        attempts = self.cfg.reconnect_attempts
+        if dest in getattr(self.ep, "binary_peers", ()):
+            # native servers implement none of the duplicate-request
+            # dedup (put ids, rqseqno, at-most-once get cache) the
+            # re-send protocol relies on — fail fast rather than risk a
+            # double-stored put or a double-consumed fetch
+            attempts = 0
+        sleep = 0.0
+        for attempt in range(attempts + 1):
+            try:
+                self.ep.send(dest, m)
+                return
+            except OSError as e:
+                if attempt >= attempts:
+                    # any permanently unreachable protocol peer ends this
+                    # client — there is no request it can route around a
+                    # dead server — so both cases raise the conn-lost
+                    # error the harnesses classify (abort collateral /
+                    # casualty), never a bare OSError that would read as
+                    # an application bug
+                    self.aborted = True
+                    self.flight.record(
+                        f"peer {dest} unreachable after "
+                        f"{attempt + 1} send attempts: {e!r}"
+                    )
+                    self.flight.dump_json("home_server_lost")
+                    raise HomeServerLostError(
+                        f"rank {self.rank}: protocol peer {dest} "
+                        f"unreachable ({e!r})"
+                    ) from e
+                self._m_reconnects.inc()
+                self.flight.record(
+                    f"reconnect dest={dest} attempt={attempt + 1} ({e!r})"
+                )
+                sleep = self._backoff_sleep(max(sleep, 0.01))
+
+    def _wait_put(self, put_id: int) -> Msg:
+        """Wait for THIS put's response, matched by id: a frame re-sent
+        after a send error can be acked twice, and the stale duplicate
+        ack must not be mistaken for a later put's answer."""
+        while True:
+            if self._abort_event is not None and self._abort_event.is_set():
+                self.aborted = True
+                self.flight.record("abort event observed waiting put resp")
+                self.flight.dump_json("abort_event")
+                raise AdlbAborted(-1)
+            m = self.ep.recv(timeout=0.5)
+            if m is None:
+                continue
+            if m.tag is Tag.TA_PUT_RESP and m.data.get("put_id") == put_id:
+                return m
+            self._dispatch_passive(m, waiting=Tag.TA_PUT_RESP)
 
     def _wait(self, want: Tag) -> Msg:
         while True:
@@ -181,8 +263,15 @@ class Client:
 
         server = self._route_put(target_rank)
         attempts = 0
+        sleep = 0.0
+        # synchronous puts carry an id too (same counter as iput): a
+        # send retried across an OSError may have been delivered the
+        # first time, and the server's per-sender dedup window turns the
+        # re-send into an idempotent ack instead of a duplicated unit
+        put_id = self._next_put_id
+        self._next_put_id += 1
         while True:
-            self.ep.send(
+            self._send_retry(
                 server,
                 msg(
                     Tag.FA_PUT,
@@ -195,19 +284,26 @@ class Client:
                     common_len=common.common_len if common else 0,
                     common_server=common.common_server if common else -1,
                     common_seqno=common.common_seqno if common else -1,
+                    put_id=put_id,
                 ),
             )
-            resp = self._wait(Tag.TA_PUT_RESP)
+            resp = self._wait_put(put_id)
             rc = resp.rc
-            if rc != ADLB_PUT_REJECTED:
+            if rc not in (ADLB_PUT_REJECTED, ADLB_RETRY):
                 break
             attempts += 1
             if attempts > self.cfg.put_max_retries:
                 if common is not None:
                     common.refcnt -= 1
+                # the documented contract for retries-exhausted puts is
+                # ADLB_PUT_REJECTED, whatever the last transient rc was
                 return ADLB_PUT_REJECTED
-            server = self._retry_server(resp.data.get("hint"))
-            time.sleep(self.cfg.put_retry_sleep)
+            if rc == ADLB_PUT_REJECTED:
+                # capacity: try the hinted (least-loaded) server;
+                # ADLB_RETRY is transient at THIS server — same target
+                server = self._retry_server(resp.data.get("hint"))
+            self._m_put_retries.inc()
+            sleep = self._backoff_sleep(sleep)
         if rc != ADLB_SUCCESS and common is not None:
             common.refcnt -= 1  # unit never stored; keep prefix GC reachable
         if (
@@ -215,7 +311,7 @@ class Client:
             and target_rank >= 0
             and server != self.world.home_server(target_rank)
         ):
-            self.ep.send(
+            self._send_retry(
                 self.world.home_server(target_rank),
                 msg(
                     Tag.FA_DID_PUT_AT_REMOTE,
@@ -281,22 +377,33 @@ class Client:
 
     # -- Reserve / Get family ------------------------------------------------
 
+    def _reserve_rpc(self, **fields) -> Msg:
+        """One FA_RESERVE round trip, retried with backoff on ADLB_RETRY
+        (a transient server-side condition, e.g. this rank reconnecting
+        while its rank-death fan-out settles). Every retry is a fresh
+        rqseqno — the previous request is dead at the server."""
+        sleep = 0.0
+        while True:
+            self._rqseqno += 1
+            self._send_retry(
+                self.home,
+                msg(Tag.FA_RESERVE, self.rank, rqseqno=self._rqseqno,
+                    **fields),
+            )
+            resp = self._wait(Tag.TA_RESERVE_RESP)
+            if resp.rc != ADLB_RETRY:
+                return resp
+            self._m_reserve_retries.inc()
+            sleep = self._backoff_sleep(sleep)
+
     def _reserve(
         self, req_types: Optional[Sequence[int]], hang: bool
     ) -> tuple[int, Optional[ReserveResult]]:
         types = normalize_req_types(req_types, self.world.types)
-        self._rqseqno += 1
-        self.ep.send(
-            self.home,
-            msg(
-                Tag.FA_RESERVE,
-                self.rank,
-                req_types=None if types is None else sorted(types),
-                hang=hang,
-                rqseqno=self._rqseqno,
-            ),
+        resp = self._reserve_rpc(
+            req_types=None if types is None else sorted(types),
+            hang=hang,
         )
-        resp = self._wait(Tag.TA_RESERVE_RESP)
         if resp.rc != ADLB_SUCCESS:
             return resp.rc, None
         result = ReserveResult(
@@ -349,13 +456,23 @@ class Client:
     ) -> tuple[int, Optional[bytes], float]:
         prefix = b""
         if handle.common_len > 0:
-            self.ep.send(
+            # get_id (same per-client counter as put ids) lets the server
+            # tell a re-sent duplicate from a legitimate second fetch of
+            # the same prefix (one fetch per batch member is normal)
+            get_id = self._next_put_id
+            self._next_put_id += 1
+            self._send_retry(
                 handle.common_server_rank,
-                msg(Tag.FA_GET_COMMON, self.rank, common_seqno=handle.common_seqno),
+                msg(Tag.FA_GET_COMMON, self.rank,
+                    common_seqno=handle.common_seqno, get_id=get_id),
             )
             resp = self._wait(Tag.TA_GET_COMMON_RESP)
+            if resp.rc != ADLB_SUCCESS:
+                # prefix no longer exists (reclaim edge): surface the
+                # error; a truncated payload must never look like success
+                return resp.rc, None, 0.0
             prefix = resp.payload
-        self.ep.send(
+        self._send_retry(
             handle.server_rank,
             msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno),
         )
@@ -380,19 +497,11 @@ class Client:
         units)."""
         with self._span("adlb:get_work"):
             types = normalize_req_types(req_types, self.world.types)
-            self._rqseqno += 1
-            self.ep.send(
-                self.home,
-                msg(
-                    Tag.FA_RESERVE,
-                    self.rank,
-                    req_types=None if types is None else sorted(types),
-                    hang=True,
-                    rqseqno=self._rqseqno,
-                    fetch=True,
-                ),
+            resp = self._reserve_rpc(
+                req_types=None if types is None else sorted(types),
+                hang=True,
+                fetch=True,
             )
-            resp = self._wait(Tag.TA_RESERVE_RESP)
             if resp.rc != ADLB_SUCCESS:
                 return resp.rc, None
             return self._decode_single_got(resp)
@@ -442,20 +551,12 @@ class Client:
             raise AdlbError("get_work_batch: max_units must be >= 1")
         with self._span("adlb:get_work_batch"):
             types = normalize_req_types(req_types, self.world.types)
-            self._rqseqno += 1
-            self.ep.send(
-                self.home,
-                msg(
-                    Tag.FA_RESERVE,
-                    self.rank,
-                    req_types=None if types is None else sorted(types),
-                    hang=True,
-                    rqseqno=self._rqseqno,
-                    fetch=True,
-                    fetch_max=max_units,
-                ),
+            resp = self._reserve_rpc(
+                req_types=None if types is None else sorted(types),
+                hang=True,
+                fetch=True,
+                fetch_max=max_units,
             )
-            resp = self._wait(Tag.TA_RESERVE_RESP)
             if resp.rc != ADLB_SUCCESS:
                 return resp.rc, []
             if "payloads" in resp.data:  # batch-fused: already consumed
@@ -572,6 +673,19 @@ class Client:
         ):
             self._settle_put(m)
             return
+        if m.tag is Tag.TA_PUT_RESP and m.data.get("put_id") is not None:
+            # stale duplicate ack of an already-settled re-sent put
+            return
+        if m.tag in (
+            Tag.TA_RESERVE_RESP,
+            Tag.TA_GET_RESERVED_RESP,
+            Tag.TA_GET_COMMON_RESP,
+        ):
+            # stray replay: a request re-sent across connection churn can
+            # be answered twice (the server replays its at-most-once
+            # cache); the first response already settled the call
+            self.flight.record(f"dropped stray {m.tag.name} from {m.src}")
+            return
         if m.tag is Tag.PEER_EOF:
             if m.src == self.home:
                 # the lifeline is gone: error out instead of hanging in the
@@ -633,7 +747,7 @@ class Client:
         return ADLB_SUCCESS
 
     def _send_iput(self, put_id: int, req: dict) -> None:
-        self.ep.send(
+        self._send_retry(
             req["server"],
             msg(
                 Tag.FA_PUT,
@@ -654,14 +768,21 @@ class Client:
         put_id = m.put_id
         req = self._pending_puts[put_id]
         rc = m.rc
-        if rc == ADLB_PUT_REJECTED:
+        if rc in (ADLB_PUT_REJECTED, ADLB_RETRY):
             req["attempts"] += 1
             if req["attempts"] <= self.cfg.put_max_retries:
-                req["server"] = self._retry_server(m.data.get("hint"))
-                # same pacing as the synchronous retry loop: without it all
-                # retries burn in a few RTTs while consumers are still
-                # draining the full servers
-                time.sleep(self.cfg.put_retry_sleep)
+                if rc == ADLB_PUT_REJECTED:
+                    req["server"] = self._retry_server(m.data.get("hint"))
+                # pacing like the synchronous retry loop (backoff +
+                # jitter): without it all retries burn in a few RTTs while
+                # consumers are still draining the full servers. Tightly
+                # capped: settles run inline in whatever recv loop the
+                # client is blocked in (a reserve must not stall 250 ms
+                # because an unrelated pipelined put got rejected).
+                self._m_put_retries.inc()
+                req["sleep"] = self._backoff_sleep(
+                    req.get("sleep", 0.0), cap=0.02
+                )
                 self._send_iput(put_id, req)
                 return
         del self._pending_puts[put_id]
@@ -673,7 +794,7 @@ class Client:
             return
         target = req["target_rank"]
         if target >= 0 and req["server"] != self.world.home_server(target):
-            self.ep.send(
+            self._send_retry(
                 self.world.home_server(target),
                 msg(
                     Tag.FA_DID_PUT_AT_REMOTE,

@@ -141,6 +141,10 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_CHECKPOINT: 1123,
     Tag.DS_LOG: 1131,
     Tag.DS_END: 1132,
+    # worker-death reclaim (on_worker_failure="reclaim"; python servers
+    # only today — ids reserved so a native plane can join the protocol)
+    Tag.SS_RANK_DEAD: 1133,
+    Tag.SS_COMMON_FORFEIT: 1134,
     # transport-internal synthetic signal (never actually on the wire; the
     # id exists only so the codec table stays total)
     Tag.PEER_EOF: 1999,
@@ -252,6 +256,16 @@ FIELDS: dict[str, tuple[int, int]] = {
     # balancer's inventory view tracks a streaming producer within one
     # gap instead of one unit per gap
     "work_lens": (85, _KIND_LIST),
+    # worker-death reclaim: the dead world rank (SS_RANK_DEAD) and the
+    # batch-common fixup op (SS_COMMON_FORFEIT; "forfeit" | "credit",
+    # as bytes over the wire like "path")
+    "rank": (86, _KIND_I64),
+    "op": (87, _KIND_BYTES),
+    # per-client FA_GET_COMMON request id: consecutive fetches of the
+    # SAME prefix are legitimate (one per batch member), so duplicate
+    # re-sends can only be told apart by id (native daemons parse-and-
+    # ignore unknown ids, so this is plane-compatible)
+    "get_id": (88, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

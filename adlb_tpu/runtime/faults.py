@@ -1,0 +1,264 @@
+"""Deterministic fault-injection transport shim.
+
+The chaos soak finds failure modes with wall-clock randomness; this module
+makes every failure path *reproducible*. A :class:`FaultPlan` is seeded
+plain data (picklable, so it crosses ``spawn_world``'s process boundary
+inside ``Config``); a :class:`FaultyEndpoint` wraps any transport endpoint
+(the in-proc fabric or the TCP fabric — both expose ``send``/``recv``) and
+injects faults on the **send side**, where decisions can be a pure
+function of ``(seed, rank, outbound frame number)``:
+
+* ``drop`` — the frame silently never leaves this rank;
+* ``delay`` — the frame is held ``delay_s`` seconds before leaving;
+* ``duplicate`` — the frame is sent twice back-to-back;
+* ``disconnect_at`` — at outbound frame N this rank's connectivity dies:
+  further sends raise ``OSError`` and peers observe EOF (the TCP wrapper
+  closes the real endpoint; the in-proc wrapper synthesizes ``PEER_EOF``
+  frames, which the in-proc fabric otherwise never produces);
+* ``kill_at_frame`` — at outbound frame N the whole process dies with
+  SIGKILL (``os._exit`` fallback) — the byte-deterministic analogue of a
+  preempted worker, pinned to an exact protocol point;
+* ``kill_at`` — the wall-clock variant (seconds after the endpoint is
+  wrapped), for soak-style adversities where determinism is not the goal.
+
+Probabilistic faults (drop/delay/duplicate) draw from a per-rank
+``random.Random`` in frame order, so the injected-event log — a list of
+``(frame, action, tag, dest)`` tuples — is identical across runs whenever
+the rank's outbound frame sequence is (tests drive a scripted sequence;
+live worlds get per-frame determinism relative to each rank's own send
+order). The log is exposed at :attr:`FaultPlan.events` and optionally
+written as JSON per rank (``ADLB_FAULT_LOG_DIR`` or ``spec["log_dir"]``)
+so multi-process runs can be compared offline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from adlb_tpu.runtime.messages import Msg, Tag
+
+# actions recorded in the injected-event log
+DROP = "drop"
+DELAY = "delay"
+DUP = "duplicate"
+DISCONNECT = "disconnect"
+KILL = "kill"
+
+
+def _mix(seed: int, rank: int) -> int:
+    """Stable per-rank stream seed (splitmix-style constant; must not
+    depend on PYTHONHASHSEED, so no hash())."""
+    return (seed * 0x9E3779B97F4A7C15 + rank * 0xBF58476D1CE4E5B9) & (
+        (1 << 63) - 1
+    )
+
+
+class FaultPlan:
+    """One rank's seeded fault schedule + injected-event log."""
+
+    def __init__(self, spec: dict, rank: int) -> None:
+        self.spec = dict(spec)
+        self.rank = rank
+        self.seed = int(spec.get("seed", 0))
+        self.p_drop = float(spec.get("drop", 0.0))
+        self.p_delay = float(spec.get("delay", 0.0))
+        self.delay_s = float(spec.get("delay_s", 0.001))
+        self.p_dup = float(spec.get("duplicate", 0.0))
+        ranks = spec.get("ranks")
+        self.active = ranks is None or rank in set(ranks)
+        self.disconnect_at = int(
+            dict(spec.get("disconnect_at") or {}).get(rank, 0) or 0
+        )
+        self.kill_at_frame = int(
+            dict(spec.get("kill_at_frame") or {}).get(rank, 0) or 0
+        )
+        self.kill_at = float(dict(spec.get("kill_at") or {}).get(rank, 0.0)
+                             or 0.0)
+        self.log_dir = spec.get("log_dir") or os.environ.get(
+            "ADLB_FAULT_LOG_DIR"
+        )
+        self._rng = random.Random(_mix(self.seed, rank))
+        self._lock = threading.Lock()
+        self.frame = 0  # outbound frames observed (post-increment)
+        self.events: list[tuple[int, str, str, int]] = []
+        self.disconnected = False
+
+    # -- decisions -----------------------------------------------------------
+
+    def on_send(self, m: Msg, dest: int) -> str:
+        """Account one outbound frame and decide its fate. Returns one of
+        the action constants or "" (pass through). Called under the lock
+        so the frame counter, the RNG draw order, and the event log stay
+        mutually consistent even with multiple sender threads."""
+        with self._lock:
+            self.frame += 1
+            n = self.frame
+            if self.disconnected:
+                return DISCONNECT
+            if self.kill_at_frame and n >= self.kill_at_frame:
+                self.events.append((n, KILL, m.tag.name, dest))
+                self._flush_log()
+                return KILL
+            if self.disconnect_at and n >= self.disconnect_at:
+                self.disconnected = True
+                self.events.append((n, DISCONNECT, m.tag.name, dest))
+                self._flush_log()
+                return DISCONNECT
+            if not self.active:
+                return ""
+            # one draw per probabilistic knob per frame, in fixed order:
+            # the decision stream is then a pure function of (seed, rank,
+            # frame), independent of which knobs are enabled downstream
+            r_drop = self._rng.random()
+            r_delay = self._rng.random()
+            r_dup = self._rng.random()
+            if self.p_drop and r_drop < self.p_drop:
+                self.events.append((n, DROP, m.tag.name, dest))
+                return DROP
+            if self.p_delay and r_delay < self.p_delay:
+                self.events.append((n, DELAY, m.tag.name, dest))
+                return DELAY
+            if self.p_dup and r_dup < self.p_dup:
+                self.events.append((n, DUP, m.tag.name, dest))
+                return DUP
+            return ""
+
+    # -- log -----------------------------------------------------------------
+
+    def event_log(self) -> list[tuple[int, str, str, int]]:
+        with self._lock:
+            return list(self.events)
+
+    def _flush_log(self) -> None:
+        """Best-effort durable log (called before a kill/disconnect — the
+        process may be about to die, so write NOW, atomically)."""
+        if not self.log_dir:
+            return
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(self.log_dir, f"faults-rank{self.rank}.json")
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"rank": self.rank, "seed": self.seed,
+                           "events": self.events}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_log()
+
+
+class FaultyEndpoint:
+    """Endpoint wrapper applying a :class:`FaultPlan` to outbound frames.
+
+    Everything except ``send``/``recv`` (attribute reads AND writes —
+    ``attach()`` assigns ``ep.metrics``) is forwarded to the wrapped
+    endpoint, so roles and harnesses cannot tell the difference.
+    """
+
+    _OWN = ("_ep", "plan", "rank", "_contacted", "_killer")
+
+    def __init__(self, ep, plan: FaultPlan) -> None:
+        object.__setattr__(self, "_ep", ep)
+        object.__setattr__(self, "plan", plan)
+        object.__setattr__(self, "rank", ep.rank)
+        object.__setattr__(self, "_contacted", set())
+        object.__setattr__(self, "_killer", None)
+        if plan.kill_at > 0:
+            t = threading.Timer(plan.kill_at, self._kill_now)
+            t.daemon = True
+            object.__setattr__(self, "_killer", t)
+            t.start()
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_ep"), name)
+
+    def __setattr__(self, name, value):
+        if name in FaultyEndpoint._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(object.__getattribute__(self, "_ep"), name, value)
+
+    # -- fault enactment -----------------------------------------------------
+
+    def _kill_now(self) -> None:
+        with self.plan._lock:
+            self.plan.events.append((self.plan.frame, KILL, "<timer>", -1))
+            self.plan._flush_log()
+        try:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+        os._exit(137)
+
+    def _enact_disconnect(self) -> None:
+        """Make the death observable: TCP peers see real EOFs when the
+        endpoint closes; in-proc peers get synthetic PEER_EOF frames
+        (the in-proc fabric has no connections to EOF)."""
+        fabric = getattr(self._ep, "_fabric", None)
+        if fabric is not None:
+            # every rank, not just contacted ones: a TCP death closes all
+            # listeners at once, and the home server must learn even about
+            # a rank that died before its first frame reached it
+            for peer in fabric.endpoints:
+                if peer.rank == self.rank:
+                    continue
+                try:
+                    peer.inbox.put(Msg(tag=Tag.PEER_EOF, src=self.rank))
+                except AttributeError:
+                    pass
+        else:
+            try:
+                self._ep.close()
+            except OSError:
+                pass
+
+    def send(self, dest: int, m: Msg, **kw) -> None:
+        act = self.plan.on_send(m, dest)
+        if act == KILL:
+            self._kill_now()
+            return  # unreachable except under test monkeypatching
+        if act == DISCONNECT:
+            if not self.plan.disconnected:
+                self.plan.disconnected = True
+            self._enact_disconnect()
+            raise OSError(
+                f"fault injection: rank {self.rank} disconnected at frame "
+                f"{self.plan.frame}"
+            )
+        if act == DROP:
+            return
+        if act == DELAY:
+            time.sleep(self.plan.delay_s)
+        self._contacted.add(dest)
+        self._ep.send(dest, m, **kw)
+        if act == DUP:
+            self._ep.send(dest, m, **kw)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
+        if self.plan.disconnected:
+            # a dead rank hears nothing further; burn the poll budget so
+            # reactors don't spin
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
+        return self._ep.recv(timeout=timeout)
+
+
+def maybe_wrap(ep, cfg):
+    """Wrap ``ep`` when ``cfg.fault_spec`` is set (else return it
+    unchanged) — the single hook every world harness (run_world,
+    spawn_world, launch.py, join_world) calls."""
+    spec = getattr(cfg, "fault_spec", None)
+    if not spec:
+        return ep
+    return FaultyEndpoint(ep, FaultPlan(spec, ep.rank))

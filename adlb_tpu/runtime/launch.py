@@ -132,6 +132,18 @@ def main(argv=None) -> int:
                     help="serve /metrics, /healthz, /dump on "
                          "127.0.0.1:<port> of the master server's host "
                          "(0 = ephemeral)")
+    ap.add_argument("--on-worker-failure", default="abort",
+                    choices=["abort", "reclaim"],
+                    help="worker (app rank) death policy: 'abort' kills "
+                         "the world (reference semantics); 'reclaim' "
+                         "re-enqueues the dead rank's leased work and the "
+                         "world keeps running")
+    ap.add_argument("--fault-spec", default=None,
+                    help="JSON fault-injection spec "
+                         "(adlb_tpu/runtime/faults.py), e.g. "
+                         '\'{"seed": 7, "delay": 0.01}\'; applied to the '
+                         "server endpoints this launcher runs and exported "
+                         "to app programs as ADLB_FAULT_SPEC")
     ap.add_argument("prog", nargs="*",
                     help="app program (exec'd per app rank with "
                          "ADLB_RENDEZVOUS/ADLB_RANK set)")
@@ -142,8 +154,15 @@ def main(argv=None) -> int:
     types = [int(t) for t in args.types.split(",")]
     world = WorldSpec(nranks=args.nranks, nservers=args.nservers,
                       types=tuple(types))
+    fault_spec = None
+    if args.fault_spec:
+        import json
+
+        fault_spec = json.loads(args.fault_spec)
     cfg = Config(balancer=args.balancer, server_impl=args.server_impl,
-                 flight_dir=args.flight_dir, ops_port=args.ops_port)
+                 flight_dir=args.flight_dir, ops_port=args.ops_port,
+                 on_worker_failure=args.on_worker_failure,
+                 fault_spec=fault_spec)
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
     rdv = args.rendezvous
@@ -164,9 +183,10 @@ def main(argv=None) -> int:
             daemons[rank] = proc
             _publish(rdv, rank, host, daemon.read_hello(proc, rank))
         else:
+            from adlb_tpu.runtime.faults import maybe_wrap
             from adlb_tpu.runtime.transport_tcp import TcpEndpoint
 
-            ep = TcpEndpoint(rank, {rank: (host, 0)})
+            ep = maybe_wrap(TcpEndpoint(rank, {rank: (host, 0)}), cfg)
             server_eps[rank] = ep
             _publish(rdv, rank, host, ep.port)
     if (args.server_impl == "native" and args.balancer == "tpu"
@@ -253,6 +273,10 @@ def main(argv=None) -> int:
                 # app programs (Python join_world or C clients' Python
                 # wrappers) opt into flight artifacts via the env contract
                 env["ADLB_FLIGHT_DIR"] = args.flight_dir
+            if args.fault_spec:
+                env["ADLB_FAULT_SPEC"] = args.fault_spec
+            if args.on_worker_failure != "abort":
+                env["ADLB_ON_WORKER_FAILURE"] = args.on_worker_failure
             if args.server_impl == "native":
                 env["ADLB_SERVER_IMPL"] = "native"
             procs.append(subprocess.Popen(args.prog, env=env))

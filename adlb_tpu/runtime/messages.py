@@ -65,6 +65,15 @@ class Tag(enum.Enum):
     SS_END_2 = enum.auto()
     SS_ABORT = enum.auto()
     SS_PERIODIC_STATS = enum.auto()  # stats ring token (src/adlb.c:2391-2465)
+    # failure policy "reclaim" (no reference analogue — upstream's model is
+    # rank-death-kills-job): the dead rank's home server fans this out so
+    # every server reclaims leases, drops rq/targeted state, and excludes
+    # the rank from termination counting
+    SS_RANK_DEAD = enum.auto()
+    # refcount-correct release of a batch-common prefix whose member unit
+    # was dropped (targeted at a dead rank): the common server accounts a
+    # forfeited get so the prefix still GCs when live members fetch
+    SS_COMMON_FORFEIT = enum.auto()
 
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()

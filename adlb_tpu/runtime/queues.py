@@ -368,6 +368,65 @@ class TargetedDirectory:
                 return server_rank, wt
         return None
 
+    def drop_rank(self, app_rank: int) -> None:
+        """Forget every directory entry for a dead target: the remote units
+        themselves are dropped by their holders on SS_RANK_DEAD, so a
+        surviving entry would only misdirect future RFRs."""
+        self._d.pop(app_rank, None)
+
+
+@dataclasses.dataclass
+class Lease:
+    """Ownership record for a reserved/pinned unit: which rank holds the
+    reservation, when it was granted, and a per-server lease id (for the
+    failure-timeline events). No reference analogue — upstream's pins are
+    anonymous because a dead owner kills the whole job anyway; under
+    ``on_worker_failure="reclaim"`` the owner matters: its death turns
+    every lease it holds back into queued work."""
+
+    seqno: int
+    owner: int
+    lease_id: int
+    granted_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class LeaseTable:
+    """seqno -> :class:`Lease` for every currently pinned unit, with an
+    owner index so reclaiming a dead rank is O(its leases), not O(wq)."""
+
+    def __init__(self) -> None:
+        self._by_seqno: dict[int, Lease] = {}
+        self._by_owner: dict[int, set[int]] = {}
+        self._next_id = 1
+
+    def grant(self, seqno: int, owner: int) -> Lease:
+        lease = Lease(seqno=seqno, owner=owner, lease_id=self._next_id)
+        self._next_id += 1
+        self._by_seqno[seqno] = lease
+        self._by_owner.setdefault(owner, set()).add(seqno)
+        return lease
+
+    def release(self, seqno: int) -> Optional[Lease]:
+        lease = self._by_seqno.pop(seqno, None)
+        if lease is not None:
+            owned = self._by_owner.get(lease.owner)
+            if owned is not None:
+                owned.discard(seqno)
+                if not owned:
+                    del self._by_owner[lease.owner]
+        return lease
+
+    def owned_by(self, owner: int) -> list[Lease]:
+        return [
+            self._by_seqno[s] for s in sorted(self._by_owner.get(owner, ()))
+        ]
+
+    def get(self, seqno: int) -> Optional[Lease]:
+        return self._by_seqno.get(seqno)
+
+    def __len__(self) -> int:
+        return len(self._by_seqno)
+
 
 class CommonStore:
     """Batch-put common-prefix store (the reference's ``cq``,
@@ -381,6 +440,7 @@ class CommonStore:
         buf: bytes
         refcnt: int = -1  # -1 until End_batch_put ships the final count
         ngets: int = 0
+        credits: int = 0  # extra expected gets granted before refcnt known
 
     def __init__(self, on_gc=None) -> None:
         self._entries: dict[int, CommonStore.Entry] = {}
@@ -406,15 +466,55 @@ class CommonStore:
         e = self._entries.get(seqno)
         if e is None:
             return
-        e.refcnt = refcnt
+        e.refcnt = refcnt + e.credits
+        e.credits = 0
         self._maybe_gc(e)
 
-    def get(self, seqno: int) -> bytes:
-        e = self._entries[seqno]
+    def get(self, seqno: int) -> Optional[bytes]:
+        """Prefix bytes, or None when the entry is gone — callers must
+        surface an error rather than KeyError the server reactor (a
+        reclaim double-get race can outrun a credit; see credit())."""
+        e = self._entries.get(seqno)
+        if e is None:
+            return None
         buf = e.buf
         e.ngets += 1
         self._maybe_gc(e)
         return buf
+
+    def peek(self, seqno: int) -> Optional[bytes]:
+        """Prefix bytes without counting a get — for re-serving a
+        duplicate (re-sent) fetch that was already accounted."""
+        e = self._entries.get(seqno)
+        return e.buf if e is not None else None
+
+    def credit(self, seqno: int) -> None:
+        """Expect one additional get: a leased member unit was reclaimed
+        from a dead owner who may already have fetched the prefix, so its
+        re-consumption can fetch it a second time. Without the credit
+        that second get could push ngets past refcnt early and GC the
+        prefix out from under surviving members; with it, the worst case
+        is a prefix that outlives its batch until world teardown (the
+        dead owner never actually fetched) — a bounded leak, not a
+        crash."""
+        e = self._entries.get(seqno)
+        if e is None:
+            return  # already GC'd: the defensive get() covers the rest
+        if e.refcnt >= 0:
+            e.refcnt += 1
+        else:
+            e.credits += 1
+
+    def forfeit(self, seqno: int) -> None:
+        """Account a get that will never happen: a batch member referencing
+        this prefix was dropped (targeted at a dead rank). Without this the
+        refcount never reaches ngets and the prefix bytes leak for the rest
+        of the run."""
+        e = self._entries.get(seqno)
+        if e is None:
+            return  # already GC'd (every live member fetched first)
+        e.ngets += 1
+        self._maybe_gc(e)
 
     def _maybe_gc(self, e: "CommonStore.Entry") -> None:
         if e.refcnt >= 0 and e.ngets >= e.refcnt:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from adlb_tpu.obs.flight import FlightRecorder
@@ -39,6 +40,7 @@ from adlb_tpu.runtime.messages import Msg, Tag, msg
 from adlb_tpu.runtime.trace import PID_SERVER, Tracer
 from adlb_tpu.runtime.queues import (
     CommonStore,
+    LeaseTable,
     MemoryAccountant,
     ReserveQueue,
     RqEntry,
@@ -54,6 +56,7 @@ from adlb_tpu.types import (
     ADLB_NO_CURRENT_WORK,
     ADLB_NO_MORE_WORK,
     ADLB_PUT_REJECTED,
+    ADLB_RETRY,
     ADLB_SUCCESS,
     AdlbError,
     InfoKey,
@@ -192,6 +195,27 @@ class Server:
         self.tq = TargetedDirectory()
         self.mem = MemoryAccountant(cfg.max_malloc_per_server)
         self.cq = CommonStore(on_gc=lambda e: self.mem.free(len(e.buf)))
+        # lease per pinned unit (owner rank, lease id, grant time): under
+        # on_worker_failure="reclaim" a dead owner's leases turn back into
+        # queued work instead of blocking exhaustion forever
+        self.leases = LeaseTable()
+        # app ranks whose connection died before finalize (reclaim policy);
+        # a rank that reconnects (network churn, not death) is resurrected
+        self._dead_ranks: set[int] = set()
+        self._resurrected: set[int] = set()
+        # Duplicate-request tolerance: the transport's reconnect (and the
+        # client's _send_retry above it) can deliver a request twice — the
+        # frame may have been delivered before the socket error. Each
+        # destructive RPC dedups its own way:
+        #   puts    — per-sender window of accepted ids (idempotent ack);
+        #   reserve — echoed rqseqno (a dup re-park would double-pin);
+        #   get     — at-most-once cache of the last consumed response
+        #             per sender (the consume is unrepeatable);
+        #   common  — last fetched prefix seqno (re-serve w/o recount).
+        self._seen_puts: dict[int, tuple[set, deque]] = {}
+        self._last_rqseqno: dict[int, int] = {}
+        self._last_get_resp: dict[int, tuple[int, Msg]] = {}
+        self._last_common: dict[int, int] = {}
 
         self._next_seqno = 1
         self.peers: dict[int, _PeerState] = {
@@ -285,6 +309,11 @@ class Server:
         self._m_reserves = self.metrics.counter("reserves")
         self._m_rfrs = self.metrics.counter("rfrs")
         self._m_pushes = self.metrics.counter("pushes")
+        # failure/reclaim surface (on_worker_failure="reclaim")
+        self._m_rank_dead = self.metrics.counter("rank_dead")
+        self._m_leases_reclaimed = self.metrics.counter("leases_reclaimed")
+        self._m_targeted_dropped = self.metrics.counter("targeted_dropped")
+        self._m_reconnects = self.metrics.counter("rank_reconnects")
         self._g_wq = self.metrics.gauge("wq_depth")
         self._g_rq = self.metrics.gauge("rq_depth")
         self._ts_wq = self.metrics.timeseries("wq_depth")
@@ -387,6 +416,8 @@ class Server:
             Tag.SS_PLAN_MIGRATE: self._on_plan_migrate,
             Tag.SS_MIGRATE_WORK: self._on_migrate_work,
             Tag.SS_MIGRATE_ACK: self._on_migrate_ack,
+            Tag.SS_RANK_DEAD: self._on_rank_dead,
+            Tag.SS_COMMON_FORFEIT: self._on_common_forfeit,
         }
 
     @staticmethod
@@ -494,6 +525,30 @@ class Server:
         if handler is None:
             raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
         self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
+        if self._dead_ranks and m.src in self._dead_ranks and (
+            m.tag.name.startswith("FA_")
+        ):
+            # a rank we declared dead is talking again: the EOF was
+            # connection churn, not process death. Resurrect it — but its
+            # reserve/put gets a retriable code so the request re-arrives
+            # after this server's reclaim fan-out has settled (its old
+            # leases/rq entries are gone either way; see USERGUIDE §7).
+            self._resurrect(m.src)
+            if m.tag in (Tag.FA_RESERVE, Tag.FA_PUT):
+                resp_tag = (
+                    Tag.TA_RESERVE_RESP
+                    if m.tag is Tag.FA_RESERVE
+                    else Tag.TA_PUT_RESP
+                )
+                # _send_app, not a raw send: these could be trailing
+                # buffered frames from a rank that really IS dead, whose
+                # connection refuses — that must not crash the reactor
+                self._send_app(
+                    m.src,
+                    msg(resp_tag, self.rank, rc=ADLB_RETRY,
+                        put_id=m.data.get("put_id")),
+                )
+                return
         tr = self.tracer
         if tr is None:
             handler(m)
@@ -563,6 +618,67 @@ class Server:
 
     # ------------------------------------------------------- helpers
 
+    def _pin(self, seqno: int, rank: int) -> None:
+        """Pin + lease: every reservation handed out is owned, so a dead
+        owner's pins are findable in O(its leases) at reclaim time."""
+        self.wq.pin(seqno, rank)
+        self.leases.grant(seqno, rank)
+
+    def _consume(self, unit) -> None:
+        """Remove a fetched/inlined unit and settle its lease + memory."""
+        self.wq.remove(unit.seqno)
+        self.leases.release(unit.seqno)
+        self.mem.free(len(unit.payload))
+
+    def _send_app(self, app: int, m: Msg) -> bool:
+        """Protocol response to an app rank. Under the reclaim policy a
+        dead destination (already marked, or its connection refuses) is
+        absorbed — returns False so the caller can requeue anything it
+        consumed — instead of crashing the reactor; the EOF-driven
+        reclaim owns the rest of the cleanup."""
+        if self.cfg.on_worker_failure == "reclaim" and app in self._dead_ranks:
+            return False
+        try:
+            self.ep.send(app, m)
+            return True
+        except OSError:
+            if self.cfg.on_worker_failure != "reclaim":
+                raise
+            self.flight.record(
+                f"send to rank {app} failed mid-death ({m.tag.name})"
+            )
+            return False
+
+    def _requeue_consumed(self, unit) -> None:
+        """Put a consumed-but-undeliverable unit back on the queue (its
+        requester died between match and delivery)."""
+        if unit.target_rank >= 0 and unit.target_rank in self._dead_ranks:
+            # targeted at the dead requester itself: dropping IS the
+            # reclaim outcome (no other rank may take targeted work), and
+            # the rank-dead sweep already ran, so nobody else will drop
+            # it. NO common forfeit here: this path is an undeliverable
+            # Get_reserved response, and Get_reserved orders common-first
+            # — the dead requester's prefix get already accounted this
+            # member's share.
+            self._m_targeted_dropped.inc()
+            self.flight.record(
+                f"targeted_dropped rank={unit.target_rank} "
+                f"seqno={unit.seqno} (undelivered)"
+            )
+            return
+        self.mem.alloc(len(unit.payload))
+        unit.pinned = False
+        unit.pin_rank = -1
+        self.wq.add(unit)
+        if unit.common_seqno >= 0:
+            # the dead requester fetched the prefix before this fetch
+            # (Get_reserved orders common-first); the re-consumption
+            # fetches it again
+            self._forfeit_common(unit.common_seqno, unit.common_server_rank,
+                                 op="credit")
+        self.flight.record(f"lease_reclaimed seqno={unit.seqno} (undelivered)")
+        self._m_leases_reclaimed.inc()
+
     def _least_loaded_peer(self, nbytes_needed: int = 0) -> int:
         """Least-loaded peer believed to have room for nbytes_needed, else
         least-loaded overall, else -1."""
@@ -585,7 +701,9 @@ class Server:
         holder: Optional[int] = None, fetch: bool = False,
     ) -> None:
         if rc != ADLB_SUCCESS:
-            self.ep.send(app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc))
+            self._send_app(
+                app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc)
+            )
             return
         self.resolved_reserves += 1
         if (
@@ -597,9 +715,8 @@ class Server:
             # pays a second round trip, src/adlb.c:2976-3025): the unit is
             # local and prefix-free, so consume it now and inline the
             # payload in the reservation response
-            self.wq.remove(unit.seqno)
-            self.mem.free(len(unit.payload))
-            self.ep.send(
+            self._consume(unit)
+            delivered = self._send_app(
                 app_rank,
                 msg(
                     Tag.TA_RESERVE_RESP,
@@ -613,6 +730,8 @@ class Server:
                     time_on_q=time.monotonic() - unit.time_stamp,
                 ),
             )
+            if not delivered:
+                self._requeue_consumed(unit)
             return
         handle = WorkHandle(
             seqno=unit.seqno,
@@ -630,9 +749,8 @@ class Server:
         now = time.monotonic()
         self.resolved_reserves += len(units)
         for u in units:
-            self.wq.remove(u.seqno)
-            self.mem.free(len(u.payload))
-        self.ep.send(
+            self._consume(u)
+        delivered = self._send_app(
             app_rank,
             msg(
                 Tag.TA_RESERVE_RESP,
@@ -645,9 +763,15 @@ class Server:
                 times_on_q=[now - u.time_stamp for u in units],
             ),
         )
+        if not delivered:
+            for u in units:
+                self._requeue_consumed(u)
 
     def _send_reserve_handle(self, app_rank, unit, handle) -> None:
-        self.ep.send(
+        # an undeliverable handle needs no requeue here: the unit stays
+        # pinned under the dead rank's lease, which the EOF-driven
+        # reclaim releases
+        self._send_app(
             app_rank,
             msg(
                 Tag.TA_RESERVE_RESP,
@@ -735,7 +859,7 @@ class Server:
             for entry in self.rq.entries():
                 unit = self.wq.find_match(entry.world_rank, entry.req_types)
                 if unit is not None:
-                    self.wq.pin(unit.seqno, entry.world_rank)
+                    self._pin(unit.seqno, entry.world_rank)
                     # _match_rq runs after cross-server deliveries
                     # (push/migrate arrivals, unreserve compensation)
                     self._satisfy_parked(entry, unit, local=False)
@@ -845,15 +969,58 @@ class Server:
 
     # ------------------------------------------------------- app handlers
 
+    def _put_seen(self, src: int, put_id) -> bool:
+        entry = self._seen_puts.get(src)
+        return entry is not None and put_id in entry[0]
+
+    def _put_record(self, src: int, put_id) -> None:
+        if put_id is None:
+            return
+        entry = self._seen_puts.get(src)
+        if entry is None:
+            entry = self._seen_puts[src] = (set(), deque())
+        ids, order = entry
+        ids.add(put_id)
+        order.append(put_id)
+        if len(order) > 512:
+            ids.discard(order.popleft())
+
     def _on_put(self, m: Msg) -> None:
         self._m_puts.inc()
-        # pipelined puts (iput) tag each request; the id is echoed so the
-        # client can match out-of-band responses
+        # every put tags its request with a per-client id, echoed in the
+        # response (pipelined puts match out-of-band responses by it; all
+        # puts get re-send dedup from it)
         put_id = m.data.get("put_id")
+        if put_id is not None and self._put_seen(m.src, put_id):
+            # duplicate of an already-accepted put (the client re-sent
+            # after a send error): idempotent ack, nothing stored twice
+            self._send_app(
+                m.src,
+                msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS,
+                    put_id=put_id),
+            )
+            return
         if self.no_more_work or self.done_by_exhaustion:
             self.ep.send(
                 m.src, msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_NO_MORE_WORK,
                            put_id=put_id)
+            )
+            return
+        if m.target_rank >= 0 and m.target_rank in self._dead_ranks:
+            # targeted at a dead rank: accept-and-drop (at-most-once — the
+            # unit could never be fetched), keeping the batch-common
+            # refcount correct so the prefix still GCs
+            self._m_targeted_dropped.inc()
+            self.flight.record(
+                f"targeted_dropped rank={m.target_rank} src={m.src} "
+                f"(put to dead target)"
+            )
+            self._forfeit_common(m.common_seqno, m.common_server)
+            self._put_record(m.src, put_id)
+            self._send_app(
+                m.src,
+                msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS,
+                    put_id=put_id),
             )
             return
         payload: bytes = m.payload
@@ -897,9 +1064,10 @@ class Server:
         # rq_find_rank_queued_for_type on FA_PUT_HDR, src/adlb.c:988-1042)
         entry = self.rq.find_for_type(unit.work_type, unit.target_rank)
         if entry is not None:
-            self.wq.pin(unit.seqno, entry.world_rank)
+            self._pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
-        self.ep.send(
+        self._put_record(m.src, put_id)
+        self._send_app(
             m.src,
             msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS, put_id=put_id),
         )
@@ -951,9 +1119,18 @@ class Server:
                 break
 
     def _on_reserve(self, m: Msg) -> None:
+        app = m.src
+        rq_id = m.data.get("rqseqno")
+        if rq_id is not None and self._last_rqseqno.get(app) == rq_id:
+            # duplicate frame (re-sent across connection churn; per-peer
+            # FIFO puts it right behind the original): the first copy
+            # already responded or parked — processing it again would pin
+            # a second unit for the same request
+            return
+        if rq_id is not None:
+            self._last_rqseqno[app] = rq_id
         self._m_reserves.inc()
         self.stats[InfoKey.NUM_RESERVES] += 1
-        app = m.src
         # binary-codec clients encode "any type" by omitting the field
         raw_types = m.data.get("req_types")
         req_types = None if raw_types is None else frozenset(raw_types)
@@ -969,7 +1146,7 @@ class Server:
         fetch_max = min(int(m.data.get("fetch_max", 1) or 1), 4096)
         unit = self.wq.find_match(app, req_types)
         if unit is not None:
-            self.wq.pin(unit.seqno, app)
+            self._pin(unit.seqno, app)
             self.activity += 1
             self._n_reserve_immed += 1
             if fetch and fetch_max > 1 and unit.common_len == 0:
@@ -984,7 +1161,7 @@ class Server:
                     extra = self.wq.find_match(app, req_types)
                     if extra is None or extra.common_len != 0:
                         break
-                    self.wq.pin(extra.seqno, app)
+                    self._pin(extra.seqno, app)
                     units.append(extra)
                 self._reserve_resp_batch(app, units)
                 return
@@ -1021,28 +1198,79 @@ class Server:
     def _on_get_reserved(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned or unit.pin_rank != m.src:
+            cached = self._last_get_resp.get(m.src)
+            if cached is not None and cached[0] == m.seqno:
+                # duplicate of the fetch we just served (request re-sent
+                # across connection churn): the consume is unrepeatable,
+                # so replay the cached response instead of raising
+                self._send_app(m.src, cached[1])
+                return
+            if (
+                self.cfg.on_worker_failure == "reclaim"
+                and m.src in self._resurrected
+            ):
+                # the requester was declared dead and came back: its
+                # pre-death lease was reclaimed (the unit re-enqueued or
+                # already consumed elsewhere), so the handle is void —
+                # a retriable code tells it to re-reserve, not to die
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_GET_RESERVED_RESP, self.rank, rc=ADLB_RETRY),
+                )
+                return
             # invalid handle — the reference aborts the job here
             # (src/adlb.c:1349-1357)
             raise AdlbError(
                 f"server {self.rank}: invalid GET_RESERVED seqno {m.seqno} "
                 f"from rank {m.src}"
             )
-        self.wq.remove(unit.seqno)
-        self.mem.free(len(unit.payload))
-        self.ep.send(
-            m.src,
-            msg(
-                Tag.TA_GET_RESERVED_RESP,
-                self.rank,
-                rc=ADLB_SUCCESS,
-                payload=unit.payload,
-                time_on_q=time.monotonic() - unit.time_stamp,
-            ),
+        self._consume(unit)
+        resp = msg(
+            Tag.TA_GET_RESERVED_RESP,
+            self.rank,
+            rc=ADLB_SUCCESS,
+            payload=unit.payload,
+            time_on_q=time.monotonic() - unit.time_stamp,
         )
+        # at-most-once cache (one response per sender, replaced by its
+        # next fetch): a re-sent request replays this instead of raising
+        self._last_get_resp[m.src] = (m.seqno, resp)
+        delivered = self._send_app(m.src, resp)
+        if not delivered:
+            self._requeue_consumed(unit)
 
     def _on_get_common(self, m: Msg) -> None:
+        get_id = m.data.get("get_id")
+        if get_id is not None and self._last_common.get(m.src) == get_id:
+            # duplicate of the fetch we just served (matched by request
+            # id — the same SEQNO repeats legitimately, one fetch per
+            # batch member): re-serve without counting a second get
+            # against the refcount; silently drop if GC'd (the original
+            # response was already delivered)
+            buf = self.cq.peek(m.common_seqno)
+            if buf is not None:
+                self._send_app(
+                    m.src, msg(Tag.TA_GET_COMMON_RESP, self.rank,
+                               rc=ADLB_SUCCESS, payload=buf),
+                )
+            return
+        if get_id is not None:
+            self._last_common[m.src] = get_id
         buf = self.cq.get(m.common_seqno)
-        self.ep.send(
+        if buf is None:
+            # gone: a reclaim double-get outran its credit (narrow race)
+            # or an invalid handle — an error response, not a dead server
+            from adlb_tpu.types import ADLB_ERROR
+
+            self.flight.record(
+                f"get_common miss seqno={m.common_seqno} from {m.src}"
+            )
+            self._send_app(
+                m.src, msg(Tag.TA_GET_COMMON_RESP, self.rank,
+                           rc=ADLB_ERROR, payload=b""),
+            )
+            return
+        self._send_app(
             m.src, msg(Tag.TA_GET_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
                        payload=buf)
         )
@@ -1149,7 +1377,7 @@ class Server:
         req_types = None if m.req_types is None else frozenset(m.req_types)
         unit = self.wq.find_match(m.for_rank, req_types)
         if unit is not None:
-            self.wq.pin(unit.seqno, m.for_rank)
+            self._pin(unit.seqno, m.for_rank)
             # a handoff is in flight: counts as activity so the exhaustion
             # double-pass cannot declare done around it
             self.activity += 1
@@ -1206,8 +1434,14 @@ class Server:
             ):
                 # requester got satisfied (and possibly re-parked with a new
                 # request) while the RFR was in flight — compensate
-                # (reference SS_UNRESERVE, src/adlb.c:1949-1963)
-                self.ep.send(m.src, msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno))
+                # (reference SS_UNRESERVE, src/adlb.c:1949-1963). for_rank
+                # lets the holder ignore this if the pin already has a new
+                # owner (rank-dead reclaim re-matched it)
+                self.ep.send(
+                    m.src,
+                    msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno,
+                        for_rank=app),
+                )
                 return
             if m.target_rank >= 0 and app == m.target_rank:
                 self.tq.remove(app, m.work_type, m.src)
@@ -1225,7 +1459,10 @@ class Server:
                 common_server_rank=m.common_server,
                 common_seqno=m.common_seqno,
             )
-            self.ep.send(
+            # undeliverable = the requester died since the RFR went out:
+            # the remote unit stays pinned under its lease, which the
+            # holder's own SS_RANK_DEAD sweep reclaims
+            self._send_app(
                 app,
                 msg(
                     Tag.TA_RESERVE_RESP,
@@ -1260,9 +1497,17 @@ class Server:
 
     def _on_unreserve(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
-        if unit is not None and unit.pinned:
-            self.wq.unpin(m.seqno)
-            self._match_rq()
+        if unit is None or not unit.pinned:
+            return
+        want = m.data.get("for_rank")
+        if want is not None and unit.pin_rank != want:
+            # the pin has a NEW owner: the rank-dead sweep already
+            # reclaimed and re-matched this unit, so this compensation is
+            # stale — honoring it would steal a live rank's reservation
+            return
+        self.wq.unpin(m.seqno)
+        self.leases.release(m.seqno)
+        self._match_rq()
 
     # ------------------------------------------------------- push (memory)
 
@@ -1759,7 +2004,7 @@ class Server:
         unit = self.wq.get(m.seqno)
         if unit is None or unit.pinned or unit.target_rank >= 0:
             return  # stale plan entry; next round will re-plan
-        self.wq.pin(unit.seqno, m.for_rank)
+        self._pin(unit.seqno, m.for_rank)
         self.activity += 1
         self._exhaust_held_since = None
         self.ep.send(
@@ -2063,13 +2308,23 @@ class Server:
 
     def _on_local_app_done(self, m: Msg) -> None:
         self._finalized.add(m.src)
-        if self._finalized >= self.local_apps:
-            if self.is_master and not self._end1_pending:
-                self._end1_pending = True
-                self._forward_end1({"origin": self.rank})
-            elif self._end1_pending:
-                self._end1_pending = False
-                self._forward_end1(self._held_end1)
+        self._maybe_complete_finalize()
+
+    def _maybe_complete_finalize(self) -> None:
+        """Kick or release the END_1 ring once every ACTIVE local app is
+        accounted for — by finalizing, or (reclaim policy) by dying.
+        Shared by FA_LOCAL_APP_DONE and the rank-death path so a world
+        whose last straggler was a casualty still ends cleanly."""
+        if not (self._finalized >= self.local_apps):
+            return
+        held = getattr(self, "_held_end1", None)
+        if self._end1_pending and held is not None:
+            self._end1_pending = False
+            self._held_end1 = None
+            self._forward_end1(held)
+        elif self.is_master and not self._end1_pending:
+            self._end1_pending = True
+            self._forward_end1({"origin": self.rank})
 
     def _forward_end1(self, token: dict) -> None:
         nxt = self.world.ring_next(self.rank)
@@ -2122,19 +2377,34 @@ class Server:
         ever sending a frame leaves no connection to EOF, and only the
         launch harness's timeout (or the watchdog, for servers) catches
         it."""
-        if (
-            self.done or self.no_more_work or self.done_by_exhaustion
-            or self._aborted or self._ending
-        ):
-            return
-        if (
+        lost_local_app = (
             self.world.is_app(m.src)
             and self.world.home_server(m.src) == self.rank
             and m.src not in self._finalized
-        ):
+        )
+        if self.done or self._aborted:
+            return
+        if self.no_more_work or self.done_by_exhaustion or self._ending:
+            # termination underway: peer EOFs are normally benign — but a
+            # LOCAL app dying unfinalized would hold the END_1 ring
+            # forever. Under "reclaim" the death accounting releases it;
+            # under "abort" this stays the reference's behaviour (the
+            # harness timeout catches it).
+            if lost_local_app and self.cfg.on_worker_failure == "reclaim":
+                self._declare_rank_dead(m.src)
+            return
+        if lost_local_app:
             # only the HOME server judges an app EOF: finalize knowledge is
             # home-local, and a finished app legitimately EOFs at every
             # other server it ever fetched from
+            if self.cfg.on_worker_failure == "reclaim":
+                aprintf(
+                    True, self.rank,
+                    f"app rank {m.src} connection lost before finalize; "
+                    f"reclaiming its work (on_worker_failure=reclaim)",
+                )
+                self._declare_rank_dead(m.src)
+                return
             aprintf(
                 True, self.rank,
                 f"app rank {m.src} connection lost before finalize; "
@@ -2147,6 +2417,156 @@ class Server:
                 f"server rank {m.src} connection lost mid-run; aborting",
             )
             self._do_abort(-3, broadcast=True)
+
+    # ------------------------------------------------- worker-death reclaim
+    # No reference analogue (upstream: any rank failure kills the job,
+    # src/adlb.c:2508-2526). Under Config(on_worker_failure="reclaim") an
+    # app rank's death is absorbed: its home server fans out SS_RANK_DEAD
+    # and every server (a) re-enqueues the rank's leased-but-unfetched
+    # units, (b) drops its rq/steal state and targeted work (with a
+    # refcount-correct batch-common release), (c) excludes it from
+    # termination counting, and (d) — master — patches the balancer's
+    # requester snapshots so the dead rank stops attracting matches and
+    # migrations. Server death still aborts under both policies.
+
+    def _declare_rank_dead(self, rank: int) -> None:
+        """Home server: fan out the death and reclaim locally."""
+        if rank in self._dead_ranks:
+            return
+        for s in self.world.server_ranks:
+            if s != self.rank:
+                try:
+                    self.ep.send(
+                        s, msg(Tag.SS_RANK_DEAD, self.rank, rank=rank)
+                    )
+                except OSError:
+                    pass  # peer already ended: no state left to clean there
+        self._on_rank_dead(msg(Tag.SS_RANK_DEAD, self.rank, rank=rank))
+
+    def _on_rank_dead(self, m: Msg) -> None:
+        rank = m.rank
+        if rank in self._dead_ranks:
+            return
+        self._dead_ranks.add(rank)
+        self._m_rank_dead.inc()
+        self.flight.record(f"rank_dead rank={rank} declared_by={m.src}")
+        # 1) the dead requester's park/steal state
+        self.rq.remove(rank)
+        self._rfr_out.discard(rank)
+        self._rfr_excluded.pop(rank, None)
+        self._park_res_local.pop(rank, None)
+        # 2) reclaim leases: pinned-but-unfetched units return to the queue
+        reclaimed = 0
+        for lease in self.leases.owned_by(rank):
+            self.leases.release(lease.seqno)
+            unit = self.wq.get(lease.seqno)
+            if unit is not None and unit.pinned and unit.pin_rank == rank:
+                self.wq.unpin(lease.seqno)
+                if unit.common_seqno >= 0:
+                    # the dead owner may have fetched the batch-common
+                    # prefix already; the re-consumption will fetch it
+                    # again, so grant the prefix one extra expected get
+                    self._forfeit_common(
+                        unit.common_seqno, unit.common_server_rank,
+                        op="credit",
+                    )
+                reclaimed += 1
+                self.flight.record(
+                    f"lease_reclaimed seqno={lease.seqno} "
+                    f"lease_id={lease.lease_id} rank={rank}"
+                )
+        if reclaimed:
+            self._m_leases_reclaimed.inc(reclaimed)
+            # reclaim is activity: an in-flight exhaustion vote must not
+            # conclude around work that just became available again
+            self.activity += 1
+            self._exhaust_held_since = None
+        # 3) drop units targeted at the dead rank (nobody else may take
+        # them), releasing their batch-common refcounts
+        doomed = [u for u in self.wq.units() if u.target_rank == rank]
+        for u in doomed:
+            self.wq.remove(u.seqno)
+            self.leases.release(u.seqno)
+            self.mem.free(len(u.payload))
+            self._m_targeted_dropped.inc()
+            self._forfeit_common(u.common_seqno, u.common_server_rank)
+            self.flight.record(
+                f"targeted_dropped rank={rank} seqno={u.seqno}"
+            )
+        self.tq.drop_rank(rank)
+        # 4) termination counting: the rank will never send LOCAL_APP_DONE
+        if rank in self.local_apps:
+            self._finalized.add(rank)
+            self._maybe_complete_finalize()
+        # 5) balancer view (master, tpu mode): retire the dead requester
+        # from every held snapshot so plans stop targeting it
+        if self.is_master and self.cfg.balancer == "tpu":
+            self._patch_snapshots_for_dead(rank)
+        # reclaimed inventory may satisfy surviving parked requesters
+        if reclaimed:
+            self._match_rq()
+        # a survived death still leaves a post-mortem artifact (when a
+        # flight dir is configured): the world lives on, but the operator
+        # needs the who-died/what-was-reclaimed timeline
+        # (scripts/obs_report.py merges these across ranks)
+        self.flight.dump_json(f"rank_dead_{rank}")
+
+    def _patch_snapshots_for_dead(self, rank: int) -> None:
+        for src, snap in self._snapshots.items():
+            reqs = snap.get("reqs") or []
+            kept = [r for r in reqs if r[0] != rank]
+            if len(kept) != len(reqs):
+                snap["reqs"] = kept
+                self._req_sigs[src] = tuple(
+                    sorted((r[0], r[1]) for r in kept)
+                )
+                self._broadcast_hungry(
+                    self._hungry_tracker.update(src, kept)
+                )
+        if self._balancer is not None:
+            self._balancer.wake.set()
+
+    def _forfeit_common(self, common_seqno, common_server,
+                        op: str = "forfeit") -> None:
+        """Fix up a batch-common refcount for a reclaimed member unit:
+        ``forfeit`` accounts a get that will never happen (unit dropped),
+        ``credit`` expects one extra get (unit re-enqueued; its dead
+        owner may already have fetched the prefix). Local when this
+        server stores the prefix, else via SS_COMMON_FORFEIT."""
+        if common_seqno is None or common_seqno < 0:
+            return
+        if common_server is None or common_server == self.rank:
+            self._apply_common_op(common_seqno, op)
+        else:
+            self.ep.send(
+                common_server,
+                msg(Tag.SS_COMMON_FORFEIT, self.rank,
+                    common_seqno=common_seqno, op=op),
+            )
+
+    def _apply_common_op(self, common_seqno: int, op: str) -> None:
+        if op == "credit":
+            self.cq.credit(common_seqno)
+        else:
+            self.cq.forfeit(common_seqno)
+
+    def _on_common_forfeit(self, m: Msg) -> None:
+        op = m.data.get("op", "forfeit")
+        if isinstance(op, bytes):  # binary-codec peers carry it as bytes
+            op = op.decode()
+        self._apply_common_op(m.common_seqno, op)
+
+    def _resurrect(self, rank: int) -> None:
+        """A rank we declared dead is talking again: the EOF was network
+        churn. Its reclaimed state stays reclaimed (at-most-once for its
+        old leases/targeted units), but the rank itself rejoins the
+        world's accounting and is served again."""
+        self._dead_ranks.discard(rank)
+        self._resurrected.add(rank)
+        self._m_reconnects.inc()
+        self.flight.record(f"reconnect rank={rank} (was declared dead)")
+        if rank in self.local_apps:
+            self._finalized.discard(rank)
 
     # ------------------------------------------------------- abort / watchdog
 
@@ -2170,7 +2590,12 @@ class Server:
                 if s != self.rank:
                     self.ep.send(s, msg(Tag.SS_ABORT, self.rank, code=code))
         for app in self.local_apps:
-            self.ep.send(app, msg(Tag.TA_ABORT, self.rank, code=code))
+            if app in self._dead_ranks:
+                continue  # no listener left; a connect-retry would stall
+            try:
+                self.ep.send(app, msg(Tag.TA_ABORT, self.rank, code=code))
+            except OSError:
+                pass  # already-dead client: the abort_event reaches it
         if self._abort_event is not None:
             self._abort_event.set()
         self.done = True

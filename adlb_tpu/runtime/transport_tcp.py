@@ -466,6 +466,10 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
     ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)}, binary_peers=binary_peers)
+    if cfg.fault_spec:
+        from adlb_tpu.runtime.faults import maybe_wrap
+
+        ep = maybe_wrap(ep, cfg)
     try:
         port_q.put((rank, ep.port))
         ep.addr_map.update(conn.recv())  # full rank -> (host, port) map
@@ -500,9 +504,12 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
                 report("aborted", e.code)
             elif isinstance(e, HomeServerLostError):
                 # distinct kind: the parent decides whether this is abort
-                # collateral (server closed before the TA_ABORT landed)
-                # or a genuine server crash
-                abort_event.set()
+                # collateral (server closed before the TA_ABORT landed),
+                # a reclaim casualty, or a genuine server crash. Under
+                # "reclaim" the rest of the world must keep running, so
+                # only the abort policy escalates to the shared event.
+                if cfg.on_worker_failure != "reclaim":
+                    abort_event.set()
                 report("conn_lost", repr(e))
             else:
                 abort_event.set()
@@ -621,6 +628,7 @@ def spawn_world(
     app_results, server_stats = {}, {}
     errors: list[str] = []
     conn_lost: list[str] = []
+    casualties: list[int] = []
     aborted_code = None
     real_abort = False
     reported: set[int] = set()
@@ -635,6 +643,15 @@ def spawn_world(
         except queue.Empty:
             if all(not p.is_alive() for p in procs.values()):
                 missing = sorted(set(procs) - reported)
+                if cfg.on_worker_failure == "reclaim":
+                    # app ranks that died without reporting are the
+                    # casualties the reclaim policy absorbed; the world
+                    # completing around them is the success criterion.
+                    # A missing SERVER is still fatal under both policies.
+                    casualties.extend(
+                        r for r in missing if world.is_app(r)
+                    )
+                    missing = [r for r in missing if not world.is_app(r)]
                 if missing:
                     errors.append(
                         f"rank(s) {missing} died without reporting a result"
@@ -649,7 +666,7 @@ def spawn_world(
         elif kind == "error":
             errors.append(f"rank {rank}: {value}")
         elif kind == "conn_lost":
-            conn_lost.append(f"rank {rank}: {value}")
+            conn_lost.append((rank, f"rank {rank}: {value}"))
         elif kind == "aborted":
             aborted_code = value
             # -1 is the abort_event sentinel (AdlbAborted(-1) raised when
@@ -674,10 +691,17 @@ def spawn_world(
 
     # a rank losing its home server is abort COLLATERAL when some rank
     # REALLY aborted the world (the server may close its listener before
-    # every TA_ABORT frame lands) — but a genuine failure when the only
-    # "aborts" are abort_event echoes of the conn_lost itself
+    # every TA_ABORT frame lands); under the reclaim policy an app rank's
+    # lost connectivity is a CASUALTY the world completed around (e.g. a
+    # fault-injected disconnect — the client process survives to report
+    # conn_lost, the servers reclaim its work); otherwise it is a genuine
+    # failure
     if conn_lost and not real_abort:
-        errors.extend(conn_lost)
+        if cfg.on_worker_failure == "reclaim":
+            casualties.extend(r for r, _ in conn_lost if world.is_app(r))
+            errors.extend(s for r, s in conn_lost if not world.is_app(r))
+        else:
+            errors.extend(s for _, s in conn_lost)
     if errors:
         raise RuntimeError("; ".join(errors))
     return WorldResult(
@@ -685,4 +709,5 @@ def spawn_world(
         server_stats=server_stats,
         aborted=abort_event.is_set() or aborted_code is not None,
         exception=None,
+        casualties=sorted(casualties),
     )

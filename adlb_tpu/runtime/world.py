@@ -108,7 +108,29 @@ class Config:
     # minute, src/adlb.c:2569-2610); 0 disables the prints
     debug_print_interval: float = 60.0
     put_max_retries: int = 10  # reference retry loop (src/adlb.c:2779-2796)
-    put_retry_sleep: float = 0.002
+    # retry pacing: capped exponential backoff with decorrelated jitter
+    # (replacing the reference's fixed-interval spin, src/adlb.c:2779-2796):
+    # sleep_k ~ U(put_retry_sleep, 3*sleep_{k-1}), capped at put_retry_cap
+    put_retry_sleep: float = 0.002  # backoff base (first retry's floor)
+    put_retry_cap: float = 0.25  # backoff ceiling per attempt
+    # bounded client-side send retries when a peer connection breaks
+    # mid-run (network churn): the endpoint already retries once; beyond
+    # that the client backs off and re-sends instead of dying on the
+    # first OSError. 0 = fail fast (pre-reclaim behaviour).
+    reconnect_attempts: int = 4
+    # worker (app rank) failure policy: "abort" preserves the reference's
+    # rank-death-kills-job semantics (MPI_Abort paths, src/adlb.c:2508-2526);
+    # "reclaim" survives it — the home server fans out SS_RANK_DEAD, every
+    # server re-enqueues the dead rank's leased-but-unfetched units, drops
+    # its rq entries and targeted work (refcount-correct common release),
+    # and termination counting excludes the rank. Server death aborts
+    # under both policies (checkpoint/restore is the recovery path).
+    on_worker_failure: str = "abort"
+    # seeded deterministic fault injection (adlb_tpu/runtime/faults.py):
+    # a plain-data spec dict {seed, drop, delay, delay_s, duplicate,
+    # disconnect_at: {rank: frame}, kill_at_frame: {rank: frame},
+    # kill_at: {rank: seconds}, ranks: [..], log_dir}. None = off.
+    fault_spec: Optional[dict] = None
     # Max queued tasks & waiting requesters per server in one balancer
     # snapshot (fixed shapes for the jitted solve).
     balancer_max_tasks: int = 256
@@ -186,6 +208,20 @@ class Config:
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
         if self.qmstat_mode not in ("broadcast", "ring"):
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
+        if self.on_worker_failure not in ("abort", "reclaim"):
+            raise ValueError(
+                f"unknown on_worker_failure {self.on_worker_failure!r}"
+            )
+        if self.on_worker_failure == "reclaim" and self.server_impl == "native":
+            # the C++ daemon implements the reference fault model only;
+            # failing here beats a world that silently aborts anyway
+            raise ValueError(
+                "on_worker_failure='reclaim' requires server_impl='python'"
+            )
+        if self.put_retry_cap < self.put_retry_sleep:
+            raise ValueError("put_retry_cap must be >= put_retry_sleep")
+        if self.reconnect_attempts < 0:
+            raise ValueError("reconnect_attempts must be >= 0")
         if self.ops_port is not None and not (0 <= self.ops_port <= 65535):
             raise ValueError("ops_port must be None or in 0..65535")
         # snapshot lists are flattened into binary-codec list fields whose

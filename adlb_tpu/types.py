@@ -15,6 +15,11 @@ ADLB_NO_MORE_WORK = -999999999
 ADLB_DONE_BY_EXHAUSTION = -999999998
 ADLB_NO_CURRENT_WORK = -999999997
 ADLB_PUT_REJECTED = -999999996
+# Retriable transient failure (no reference analogue): the server could
+# not serve the request *right now* but the condition clears on its own
+# (e.g. the requester reconnected while its rank-death fan-out was still
+# settling). Clients retry with capped exponential backoff + jitter.
+ADLB_RETRY = -999999995
 ADLB_LOWEST_PRIO = -999999999
 
 ADLB_RESERVE_REQUEST_ANY = -1
@@ -108,7 +113,8 @@ class AdlbError(RuntimeError):
 
 
 class HomeServerLostError(AdlbError):
-    """The client's home server closed its connection mid-run.
+    """A protocol peer (home server, or any server this client must
+    reach) became permanently unreachable mid-run.
 
     Under the rank-death fault model this ends the world either way, but
     the HARNESS needs the distinction: when some rank aborted the world,

@@ -5,9 +5,14 @@ plane, memory cap), runs a self-validating workload (answer economy
 with targeted answers, or known-answer nq), and randomly layers on
 adversities: garbage sprayed at the servers' live ports from inside
 the world (rank 0 knows the real addresses), a mid-run abort
-(validated to unblock the world), or exhaustion vs explicit
-termination. Any wrong answer, hang (timeout), or unexpected exception
-stops the soak with the seed for replay.
+(validated to unblock the world), a random worker SIGKILLed mid-run
+(exercised under BOTH failure policies — `abort` must classify
+cleanly without hanging, `reclaim` must still produce the complete
+answer set), seeded fault-injection delays on every endpoint
+(adlb_tpu/runtime/faults.py — protocol-invisible, timing-hostile),
+or exhaustion vs explicit termination. Any wrong answer, hang
+(timeout), or unexpected exception stops the soak with the seed for
+replay.
 
 Usage: python scripts/chaos_soak.py <minutes> [seed0]
 
@@ -44,9 +49,35 @@ GARBAGE = [
 ]
 
 
-def answer_economy(n_pairs, do_abort, do_spray):
+def answer_economy(n_pairs, do_abort, do_spray, victim=None, kill_after=0,
+                   kill_at_end=False):
     def app(ctx):
         T_AB, T_C = 1, 2
+        if ctx.rank == victim:
+            # the kill adversity: SIGKILL myself (uncatchable, a real
+            # preemption) at a work-cycle boundary after kill_after
+            # answers — or, if the pool drains first and kill_at_end is
+            # set (reclaim iterations), right before finalize (the
+            # END-ring-held death). Cycle boundaries keep the oracle
+            # exact: no consumed-but-unanswered unit is lost, while a
+            # death holding an unfetched reservation still exercises
+            # lease reclaim.
+            import signal as _signal
+
+            n = 0
+            while True:
+                rc, r = ctx.reserve([T_AB])
+                if rc != ADLB_SUCCESS:
+                    if kill_at_end:
+                        os.kill(os.getpid(), _signal.SIGKILL)
+                    return n
+                if n >= kill_after:
+                    os.kill(os.getpid(), _signal.SIGKILL)
+                rc, buf = ctx.get_reserved(r.handle)
+                a, b = struct.unpack("<qq", buf)
+                ctx.put(struct.pack("<q", a + b), T_C,
+                        target_rank=r.answer_rank)
+                n += 1
         if ctx.rank == 0 and do_spray:
             # spray from INSIDE the world: clients know every rank's real
             # address (spawn_world binds ephemeral ports, so an outside
@@ -124,33 +155,77 @@ def one_iter(seed):
     workload = rng.choice(["economy", "nq"])
     do_spray = workload == "economy" and rng.random() < 0.5
     do_abort = workload == "economy" and rng.random() < 0.25
+    # kill adversity: SIGKILL a random worker mid-run, under a randomly
+    # chosen failure policy (mutually exclusive with do_abort — a world
+    # cannot validate two terminal outcomes at once)
+    do_kill = workload == "economy" and not do_abort and rng.random() < 0.35
+    policy = rng.choice(["abort", "reclaim"]) if do_kill else "abort"
+    # seeded delay faults: protocol-invisible, timing-hostile; applied to
+    # every endpoint via Config so replays of this seed shake the same
+    # interleavings
+    do_faults = rng.random() < 0.3
     if workload == "nq":
         # nq runs through run_world — the in-process thread fabric — so
         # there is no native plane or TCP port surface there; keep the
         # descriptor honest (the spawn-plane/native coverage comes from
         # the economy iterations)
         native = False
+    if policy == "reclaim" or do_faults:
+        # the C++ daemon implements neither the reclaim protocol nor the
+        # (Python-side) fault shim
+        native = False
 
-    kw = dict(balancer=mode, exhaust_check_interval=0.2)
+    kw = dict(balancer=mode, exhaust_check_interval=0.2,
+              on_worker_failure=policy)
     if native:
         kw["server_impl"] = "native"
     if cap:
         kw["max_malloc_per_server"] = cap
+    if do_faults:
+        kw["fault_spec"] = {"seed": seed, "delay": 0.03, "delay_s": 0.002}
     cfg = Config(**kw)
 
     if workload == "economy":
         n_pairs = rng.randint(8, 40)
-        res = spawn_world(apps, servers, [1, 2],
-                          answer_economy(n_pairs, do_abort, do_spray),
+        victim = rng.randrange(1, apps) if do_kill else None
+        kill_after = rng.randint(0, 3)
+        app_fn = answer_economy(n_pairs, do_abort, do_spray,
+                                victim=victim, kill_after=kill_after,
+                                kill_at_end=policy == "reclaim")
+        want = sum(a + a * 3 for a in range(n_pairs))
+        if do_kill and policy == "abort":
+            # either the EOF-driven abort classified cleanly (RuntimeError,
+            # well before the harness timeout) or the victim finished its
+            # share before reaching the kill point and the world completed
+            t0 = time.monotonic()
+            try:
+                res = spawn_world(apps, servers, [1, 2], app_fn,
+                                  cfg=cfg, timeout=90.0)
+                assert victim in res.app_results, "victim vanished quietly"
+                assert res.app_results[0] == want, (res.app_results, want)
+            except RuntimeError:
+                elapsed = time.monotonic() - t0
+                assert elapsed < 60.0, f"abort classification hung {elapsed:.0f}s"
+            return dict(apps=apps, servers=servers, mode=mode,
+                        native=native, cap=cap, workload=workload,
+                        spray=do_spray, abort=do_abort, kill=do_kill,
+                        policy=policy, faults=do_faults)
+        res = spawn_world(apps, servers, [1, 2], app_fn,
                           cfg=cfg, timeout=90.0)
         if do_abort:
             assert res.aborted, "abort did not propagate"
         else:
-            want = sum(a + a * 3 for a in range(n_pairs))
             assert res.app_results[0] == want, (res.app_results, want)
-            consumed = sum(
-                v for k, v in res.app_results.items() if k != 0)
-            assert consumed == n_pairs, res.app_results
+            if do_kill:
+                # reclaim: the answer set is complete even though the
+                # victim died (its leased work was re-enqueued); the
+                # victim is a casualty, never an error
+                assert res.casualties == [victim], res.casualties
+                assert not res.aborted
+            else:
+                consumed = sum(
+                    v for k, v in res.app_results.items() if k != 0)
+                assert consumed == n_pairs, res.app_results
     else:
         n = rng.choice([6, 7])
         r = nq.run(n=n, num_app_ranks=apps, nservers=servers,
@@ -158,7 +233,8 @@ def one_iter(seed):
         assert r.solutions == nq.KNOWN_SOLUTIONS[n], r.solutions
     return dict(apps=apps, servers=servers, mode=mode, native=native,
                 cap=cap, workload=workload, spray=do_spray,
-                abort=do_abort)
+                abort=do_abort, kill=do_kill, policy=policy,
+                faults=do_faults)
 
 
 def main():

@@ -7,6 +7,10 @@ A dead world (abort, watchdog timeout, lost home server) leaves one
 directory (or an explicit file list) of them into a post-mortem:
 
 * per rank: role, dump reason, and the tail of its recent-event ring;
+* a merged cross-rank **failure timeline**: structured rank_dead /
+  lease_reclaimed / targeted_dropped / reconnect / abort events, ordered
+  on reconstructed wall-clock time — the post-mortem narrative of who
+  died, what was reclaimed where, and who reconnected;
 * counter totals (puts/reserves/rfrs/pushes and per-tag message counts)
   summed across ranks, with the top talkers broken out;
 * per-server wq/rq queue-depth timelines (min/max/last + a coarse
@@ -86,6 +90,35 @@ def _dedup_metrics(docs: list[dict]) -> list[dict]:
     return [d["metrics"] for d in _dedup_by_process(docs)]
 
 
+# structured failure events the runtime records with a fixed leading
+# keyword (server._on_rank_dead / _resurrect, client._send_retry)
+_FAILURE_PREFIXES = (
+    "rank_dead", "lease_reclaimed", "targeted_dropped", "reconnect",
+    "abort", "home server", "send to rank",
+)
+
+
+def failure_timeline(docs: list[dict]) -> list[tuple]:
+    """Merge every rank's structured failure events onto one clock.
+
+    Ring entries are stamped with each process's *monotonic* clock;
+    ``wall_time - monotonic`` per artifact gives that process's boot
+    epoch, so ``epoch + entry_ts`` puts all ranks on comparable wall
+    time (skewed only by the clocks themselves). Returns
+    ``[(wall_ts, rank, role, text), ...]`` sorted by time."""
+    events: list[tuple] = []
+    for d in _dedup_by_process(docs) or docs:
+        epoch = d.get("wall_time", 0.0) - d.get("monotonic", 0.0)
+        for ts, text in d.get("events", []):
+            if text.startswith(_FAILURE_PREFIXES):
+                events.append(
+                    (epoch + ts, d.get("rank", -1), d.get("role", "?"),
+                     text)
+                )
+    events.sort()
+    return events
+
+
 def report(docs: list[dict], tail: int = 8) -> list[str]:
     out: list[str] = []
     ranked = sorted(docs, key=lambda d: d.get("rank", 1 << 30))
@@ -102,6 +135,13 @@ def report(docs: list[dict], tail: int = 8) -> list[str]:
         )
         for ts, text in events[-tail:]:
             out.append(f"  [{ts:.6f}] {text}")
+
+    # -- failure timeline (merged across ranks) ------------------------------
+    timeline = failure_timeline(ranked)
+    if timeline:
+        out.append("\nfailure timeline (reconstructed wall clock):")
+        for wall, rank, role, text in timeline:
+            out.append(f"  [{wall:.3f}] rank {rank:>3} [{role}] {text}")
 
     # -- counter totals across ranks ----------------------------------------
     merged = Registry.merge(_dedup_metrics(ranked))

@@ -1,0 +1,435 @@
+"""Worker-death survival (on_worker_failure="reclaim") and the seeded
+fault-injection transport (adlb_tpu/runtime/faults.py).
+
+Three layers of coverage:
+
+* **FaultPlan determinism** — the same seed produces byte-identical
+  injected-event logs on both fabrics (the in-proc queue fabric and the
+  real TCP fabric), run twice each; the tentpole's requirement that every
+  failure path has a deterministic reproduction.
+* **Reclaim race lattice** — Server instances driven handler-by-handler
+  (no reactor threads), pinning the exact interleavings: a worker dying
+  while its leased unit's RFR handoff is in flight (UNRESERVE
+  compensation on one side, lease reclaim on the other), and
+  targeted-to-dead-rank units sharing a batch-common prefix (the
+  refcount must not leak).
+* **End-to-end policy acceptance** — a TCP world running the
+  self-validating answer economy with 2 of 8 workers SIGKILLed mid-run:
+  completes with the correct answer set under "reclaim", aborts cleanly
+  (no hang, correct classification) under the default "abort".
+"""
+
+import os
+import signal
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.faults import FaultPlan, FaultyEndpoint
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import TcpEndpoint, spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_RETRY, ADLB_SUCCESS
+
+T_AB, T_C = 1, 2
+
+
+# --------------------------------------------------------------- determinism
+
+
+_SCRIPT_TAGS = [Tag.FA_PUT, Tag.FA_RESERVE, Tag.SS_QMSTAT, Tag.TA_PUT_RESP]
+
+
+def _drive_scripted(ep, spec, n=200):
+    """Send a fixed frame sequence through a fault-wrapped endpoint and
+    return the injected-event log."""
+    plan = FaultPlan(spec, ep.rank)
+    fep = FaultyEndpoint(ep, plan)
+    for i in range(n):
+        fep.send(
+            1,
+            msg(_SCRIPT_TAGS[i % len(_SCRIPT_TAGS)], 0, payload=b"x" * 10,
+                work_type=1),
+        )
+    return plan.event_log()
+
+
+def test_fault_plan_deterministic_both_fabrics():
+    spec = dict(seed=42, drop=0.15, delay=0.1, delay_s=0.0, duplicate=0.1)
+    logs = []
+    for _ in range(2):  # two independent in-proc runs
+        fabric = InProcFabric(2)
+        logs.append(_drive_scripted(fabric.endpoints[0], spec))
+    for _ in range(2):  # two independent TCP runs
+        a = TcpEndpoint(0, {0: ("127.0.0.1", 0)})
+        b = TcpEndpoint(1, {1: ("127.0.0.1", 0)})
+        a.addr_map[1] = b.addr_map[1]
+        try:
+            logs.append(_drive_scripted(a, spec))
+        finally:
+            a.close()
+            b.close()
+    assert logs[0], "seeded plan injected nothing — test is vacuous"
+    # identical within a fabric AND across fabrics: decisions are a pure
+    # function of (seed, rank, frame), never of transport or wall clock
+    assert logs[0] == logs[1] == logs[2] == logs[3]
+    # different seed => different schedule (no accidental constants)
+    fabric = InProcFabric(2)
+    other = _drive_scripted(fabric.endpoints[0], dict(spec, seed=43))
+    assert other != logs[0]
+
+
+def test_fault_plan_disconnect_at_frame_synthesizes_eof():
+    fabric = InProcFabric(3)
+    plan = FaultPlan({"disconnect_at": {0: 3}}, 0)
+    fep = FaultyEndpoint(fabric.endpoints[0], plan)
+    fep.send(1, msg(Tag.FA_PUT, 0, payload=b"a"))
+    fep.send(1, msg(Tag.FA_PUT, 0, payload=b"b"))
+    with pytest.raises(OSError):
+        fep.send(1, msg(Tag.FA_PUT, 0, payload=b"c"))  # frame 3: dies
+    with pytest.raises(OSError):
+        fep.send(2, msg(Tag.FA_PUT, 0, payload=b"d"))  # stays dead
+    assert plan.event_log() == [(3, "disconnect", "FA_PUT", 1)]
+    # both frames delivered before death, then one synthetic PEER_EOF at
+    # EVERY other rank (a home server must learn even if never contacted)
+    got = [fabric.endpoints[1].recv(timeout=1.0) for _ in range(3)]
+    assert [m.tag for m in got] == [Tag.FA_PUT, Tag.FA_PUT, Tag.PEER_EOF]
+    eof2 = fabric.endpoints[2].recv(timeout=1.0)
+    assert eof2.tag is Tag.PEER_EOF and eof2.src == 0
+
+
+# ------------------------------------------------------- reclaim race lattice
+
+
+def _mini_server(rank=2, on_worker_failure="reclaim", nranks=4, nservers=2):
+    """A Server on an in-proc fabric, driven handler-by-handler (its
+    reactor loop never runs). world: apps 0..1, servers 2..3."""
+    world = WorldSpec(nranks=nranks, nservers=nservers, types=(T_AB, T_C))
+    fabric = InProcFabric(nranks)
+    cfg = Config(on_worker_failure=on_worker_failure)
+    return Server(world, cfg, fabric.endpoint(rank)), fabric
+
+
+def _drain(fabric, rank):
+    out = []
+    while True:
+        m = fabric.endpoints[rank].recv(timeout=0.0)
+        if m is None:
+            return out
+        out.append(m)
+
+
+def test_reclaim_reenqueues_leased_unit_and_rematches():
+    """Rank 0 reserves (lease granted), dies before fetching; the unit
+    must return to the queue and satisfy the next parked requester."""
+    srv, fabric = _mini_server()
+    srv._handle(msg(Tag.FA_PUT, 0, payload=b"unit", work_type=T_AB, prio=0,
+                    target_rank=-1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1))
+    srv._handle(msg(Tag.FA_RESERVE, 0, req_types=[T_AB], hang=True,
+                    rqseqno=1))
+    assert len(srv.leases) == 1
+    [unit] = list(srv.wq.units())
+    assert unit.pinned and unit.pin_rank == 0
+    # rank 1 parks behind the pinned unit
+    srv._handle(msg(Tag.FA_RESERVE, 1, req_types=[T_AB], hang=True,
+                    rqseqno=1))
+    assert 1 in srv.rq
+    _drain(fabric, 0), _drain(fabric, 1)
+    # rank 0 dies: EOF at its home server (this one)
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=0))
+    assert 0 in srv._dead_ranks and 0 in srv._finalized
+    # the dead rank holds nothing; the reclaimed unit went straight to
+    # the surviving parked requester (who now holds the fresh lease)
+    assert not srv.leases.owned_by(0)
+    [lease] = srv.leases.owned_by(1)
+    resp = [m for m in _drain(fabric, 1) if m.tag is Tag.TA_RESERVE_RESP]
+    assert resp and resp[0].rc == ADLB_SUCCESS
+    # structured failure-timeline events are in the flight ring
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("rank_dead rank=0") for t in texts)
+    assert any(t.startswith("lease_reclaimed") for t in texts)
+    # the fan-out reached the peer server
+    fan = [m for m in _drain(fabric, 3) if m.tag is Tag.SS_RANK_DEAD]
+    assert fan and fan[0].rank == 0
+
+
+def test_reclaim_rfr_in_flight_compensates_with_unreserve():
+    """Home side of the mid-migration race: the requester dies while an
+    RFR is in flight; the late found=True response must be compensated
+    with SS_UNRESERVE so the remote holder re-enqueues the unit."""
+    srv, fabric = _mini_server()
+    srv._handle(msg(Tag.FA_RESERVE, 0, req_types=[T_AB], hang=True,
+                    rqseqno=7))
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=0))  # dies while parked
+    assert 0 not in srv.rq
+    srv._handle(msg(Tag.SS_RFR_RESP, 3, found=True, for_rank=0, rqseqno=7,
+                    seqno=77, work_type=T_AB, prio=0, target_rank=-1,
+                    work_len=4, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1))
+    unres = [m for m in _drain(fabric, 3) if m.tag is Tag.SS_UNRESERVE]
+    assert unres and unres[0].seqno == 77
+
+
+def test_reclaim_holder_side_unpins_on_rank_dead():
+    """Holder side of the same race: a unit pinned for a remote requester
+    (via RFR) is unpinned when SS_RANK_DEAD arrives, and becomes
+    matchable again."""
+    srv, fabric = _mini_server(rank=3)
+    srv._handle(msg(Tag.FA_PUT, 1, payload=b"unit", work_type=T_AB, prio=0,
+                    target_rank=-1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1))
+    srv._handle(msg(Tag.SS_RFR, 2, for_rank=0, rqseqno=1, req_types=[T_AB],
+                    targeted_lookup=False, lookup_type=-1))
+    [unit] = list(srv.wq.units())
+    assert unit.pinned and unit.pin_rank == 0 and len(srv.leases) == 1
+    srv._handle(msg(Tag.SS_RANK_DEAD, 2, rank=0))
+    [unit] = list(srv.wq.units())
+    assert not unit.pinned and len(srv.leases) == 0
+    assert srv.wq.find_match(1, frozenset([T_AB])) is not None
+
+
+def test_reclaim_drops_targeted_units_without_leaking_common_refcount():
+    """Two targeted units share a batch-common prefix (refcnt 2); the
+    target of one dies. Its unit is dropped with a forfeited get, so the
+    prefix still GCs when the surviving member is fetched."""
+    srv, fabric = _mini_server()
+    srv._handle(msg(Tag.FA_PUT_COMMON, 0, payload=b"PREFIX"))
+    common_seqno = _drain(fabric, 0)[-1].common_seqno
+    for target in (0, 1):
+        srv._handle(msg(Tag.FA_PUT, 0, payload=b"u%d" % target,
+                        work_type=T_AB, prio=0, target_rank=target,
+                        answer_rank=-1, common_len=6,
+                        common_server=srv.rank, common_seqno=common_seqno))
+    srv._handle(msg(Tag.FA_BATCH_DONE, 0, common_seqno=common_seqno,
+                    refcnt=2))
+    mem_before = srv.mem.curr
+    srv._handle(msg(Tag.SS_RANK_DEAD, 3, rank=1))  # rank 1 dies remotely
+    assert srv.wq.count == 1  # rank 1's unit dropped
+    assert len(srv.cq) == 1  # prefix still alive for the survivor
+    assert srv.mem.curr == mem_before - 2  # b"u1" freed
+    # survivor fetches its unit + the prefix: the forfeited get must make
+    # this final fetch the one that GCs the entry
+    srv._handle(msg(Tag.FA_RESERVE, 0, req_types=None, hang=True, rqseqno=1))
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_RESERVE_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS
+    handle = resp.handle
+    srv._handle(msg(Tag.FA_GET_COMMON, 0, common_seqno=common_seqno))
+    srv._handle(msg(Tag.FA_GET_RESERVED, 0, seqno=handle[0]))
+    assert len(srv.cq) == 0, "common prefix leaked after forfeit"
+    assert srv.mem.curr == 0
+    assert srv.metrics.value("targeted_dropped") == 1
+
+
+def test_put_targeted_at_dead_rank_is_dropped_with_forfeit():
+    """A put that arrives FOR a dead rank after the death is accepted and
+    dropped (at-most-once), including its common-prefix share."""
+    srv, fabric = _mini_server()
+    srv._handle(msg(Tag.SS_RANK_DEAD, 3, rank=1))
+    srv._handle(msg(Tag.FA_PUT_COMMON, 0, payload=b"PFX"))
+    common_seqno = _drain(fabric, 0)[-1].common_seqno
+    srv._handle(msg(Tag.FA_PUT, 0, payload=b"late", work_type=T_AB, prio=0,
+                    target_rank=1, answer_rank=-1, common_len=3,
+                    common_server=srv.rank, common_seqno=common_seqno))
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_PUT_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS  # accepted-and-dropped, putter moves on
+    assert srv.wq.count == 0
+    srv._handle(msg(Tag.FA_BATCH_DONE, 0, common_seqno=common_seqno,
+                    refcnt=1))
+    assert len(srv.cq) == 0, "dropped member's prefix share leaked"
+
+
+def test_dead_rank_resurrects_with_retriable_code():
+    """An EOF that was connection churn, not death: the rank's next
+    FA_RESERVE gets ADLB_RETRY, it is un-finalized, and a reconnect
+    event lands in the flight ring."""
+    srv, fabric = _mini_server()
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=0))
+    assert 0 in srv._dead_ranks and 0 in srv._finalized
+    srv._handle(msg(Tag.FA_RESERVE, 0, req_types=None, hang=True, rqseqno=9))
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_RESERVE_RESP]
+    assert resp and resp[0].rc == ADLB_RETRY
+    assert 0 not in srv._dead_ranks and 0 not in srv._finalized
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("reconnect rank=0") for t in texts)
+    # the retried reserve (fresh rqseqno) is then served normally
+    srv._handle(msg(Tag.FA_RESERVE, 0, req_types=None, hang=True,
+                    rqseqno=10))
+    assert 0 in srv.rq
+
+
+def test_abort_policy_unchanged_on_eof():
+    """Default policy: the reference's rank-death-kills-job semantics."""
+    srv, fabric = _mini_server(on_worker_failure="abort")
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=0))
+    assert srv._aborted and srv.done
+    aborts = [m for m in _drain(fabric, 3) if m.tag is Tag.SS_ABORT]
+    assert aborts, "abort did not broadcast"
+
+
+# -------------------------------------------- deterministic in-proc reclaim
+
+
+def _fault_economy(n_pairs):
+    def app(ctx):
+        if ctx.rank == 0:
+            for a in range(n_pairs):
+                assert ctx.put(struct.pack("<qq", a, 3 * a), T_AB,
+                               answer_rank=0) == ADLB_SUCCESS
+            total = 0
+            for _ in range(n_pairs):
+                rc, r = ctx.reserve([T_C])
+                assert rc == ADLB_SUCCESS, rc
+                rc, buf = ctx.get_reserved(r.handle)
+                total += struct.unpack("<q", buf)[0]
+            ctx.set_problem_done()
+            return total
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T_AB])
+            if rc != ADLB_SUCCESS:
+                return n
+            rc, buf = ctx.get_reserved(r.handle)
+            a, b = struct.unpack("<qq", buf)
+            ctx.put(struct.pack("<q", a + b), T_C, target_rank=0)
+            n += 1
+            time.sleep(0.002)
+
+    return app
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_inproc_fault_disconnect_reclaimed(mode):
+    """Byte-deterministic worker death: rank 1's connectivity dies at its
+    4th protocol frame (reserve, get, put-answer, then the fatal 2nd
+    reserve) — it contributes exactly one answer, and the reclaim policy
+    completes the world with the full answer set anyway."""
+    n_pairs = 24
+    res = run_world(
+        4, 2, [T_AB, T_C], _fault_economy(n_pairs),
+        cfg=Config(
+            balancer=mode,
+            on_worker_failure="reclaim",
+            exhaust_check_interval=0.2,
+            fault_spec={"seed": 5, "disconnect_at": {1: 4}},
+        ),
+        timeout=60.0,
+    )
+    assert res.app_results[0] == sum(a + 3 * a for a in range(n_pairs))
+    assert res.casualties == [1]
+    assert 1 not in res.app_results
+
+
+# ------------------------------------------------- end-to-end TCP acceptance
+
+
+N_PAIRS_TCP = 40
+VICTIMS = (1, 2)
+
+
+def _sigkill_economy(ctx):
+    """Answer economy with 8 workers; ranks 1 and 2 SIGKILL themselves
+    mid-run — rank 1 while holding an unfetched reservation (the lease
+    reclaim case), rank 2 between work units (plain death)."""
+    if ctx.rank == 0:
+        for a in range(N_PAIRS_TCP):
+            assert ctx.put(struct.pack("<qq", a, 3 * a), T_AB,
+                           answer_rank=0) == ADLB_SUCCESS
+        total = 0
+        for _ in range(N_PAIRS_TCP):
+            rc, r = ctx.reserve([T_C])
+            assert rc == ADLB_SUCCESS, rc
+            rc, buf = ctx.get_reserved(r.handle)
+            total += struct.unpack("<q", buf)[0]
+        ctx.set_problem_done()
+        return total
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T_AB])
+        if rc != ADLB_SUCCESS:
+            return n
+        if ctx.rank == VICTIMS[0] and n >= 1:
+            os.kill(os.getpid(), signal.SIGKILL)  # dies holding the lease
+        rc, buf = ctx.get_reserved(r.handle)
+        a, b = struct.unpack("<qq", buf)
+        ctx.put(struct.pack("<q", a + b), T_C, target_rank=0)
+        n += 1
+        if ctx.rank == VICTIMS[1] and n >= 2:
+            os.kill(os.getpid(), signal.SIGKILL)  # dies between units
+        time.sleep(0.005)
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_tcp_sigkill_workers_reclaim_completes(mode):
+    res = spawn_world(
+        9, 2, [T_AB, T_C], _sigkill_economy,
+        cfg=Config(balancer=mode, on_worker_failure="reclaim",
+                   exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    assert res.app_results[0] == sum(a + 3 * a for a in range(N_PAIRS_TCP))
+    assert res.casualties == list(VICTIMS)
+    assert not res.aborted
+    # conservation: the victims answered exactly 3 units before dying
+    # (rank 1: one, rank 2: two) and rank 1's reserved-but-unfetched unit
+    # was reclaimed, so the survivors account for the other 37
+    consumed = sum(v for k, v in res.app_results.items() if k != 0)
+    assert consumed == N_PAIRS_TCP - 3, res.app_results
+
+
+def _die_instead_of_finalize(ctx):
+    """A worker preempted between its last unit and finalize: the EOF
+    lands while the termination machinery (no-more-work flush / END
+    ring) is already underway — the reclaim accounting must release the
+    held END_1 token or the world hangs."""
+    if ctx.rank == 0:
+        for a in range(8):
+            ctx.put(struct.pack("<qq", a, a), T_AB, answer_rank=0)
+        total = 0
+        for _ in range(8):
+            rc, r = ctx.reserve([T_C])
+            rc, buf = ctx.get_reserved(r.handle)
+            total += struct.unpack("<q", buf)[0]
+        ctx.set_problem_done()
+        return total
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T_AB])
+        if rc != ADLB_SUCCESS:
+            if ctx.rank == 1:
+                os.kill(os.getpid(), signal.SIGKILL)  # dies pre-finalize
+            return n
+        rc, buf = ctx.get_reserved(r.handle)
+        a, b = struct.unpack("<qq", buf)
+        ctx.put(struct.pack("<q", a + b), T_C, target_rank=0)
+        n += 1
+
+
+def test_tcp_death_during_termination_reclaimed():
+    t0 = time.monotonic()
+    res = spawn_world(
+        4, 2, [T_AB, T_C], _die_instead_of_finalize,
+        cfg=Config(on_worker_failure="reclaim",
+                   exhaust_check_interval=0.2),
+        timeout=60.0,
+    )
+    assert time.monotonic() - t0 < 45.0, "END ring hung on the casualty"
+    assert res.app_results[0] == sum(a + a for a in range(8))
+    assert res.casualties == [1]
+
+
+def test_tcp_sigkill_workers_abort_classifies_cleanly():
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        spawn_world(
+            9, 2, [T_AB, T_C], _sigkill_economy,
+            cfg=Config(on_worker_failure="abort",
+                       exhaust_check_interval=0.2),
+            timeout=60.0,
+        )
+    assert time.monotonic() - t0 < 45.0, "abort path hung"
