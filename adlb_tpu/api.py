@@ -78,6 +78,22 @@ class AdlbContext:
         one round trip (no reference analogue); returns (rc, [GotWork])."""
         return self._c.get_work_batch(req_types, max_units)
 
+    def get_work_stream(
+        self, req_types: Optional[Sequence[int]] = None, depth: int = 2
+    ):
+        """Pipelined consumer: an iterator of GotWork keeping up to
+        ``depth`` fused reserves in flight, so the next unit's delivery
+        overlaps the current unit's compute (no reference analogue).
+        Ends at NO_MORE_WORK / DONE_BY_EXHAUSTION (code in ``.rc``);
+        use as a context manager or call ``.close()`` if abandoning the
+        stream early::
+
+            with ctx.get_work_stream([TYPE], depth=4) as stream:
+                for work in stream:
+                    process(work.payload)
+        """
+        return self._c.get_work_stream(req_types, depth)
+
     def get_reserved_timed(self, handle: WorkHandle):
         return self._c.get_reserved_timed(handle)
 

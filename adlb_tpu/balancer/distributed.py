@@ -230,7 +230,10 @@ class DistributedAssignmentSolver:
                 task_prio[si, ki] = prio
                 task_type[si, ki] = self.type_index.get(wtype, -1)
                 task_ref[si][ki] = (s, seqno)
-            for ri, (rank, rqseqno, req_types) in enumerate(snap["reqs"][:R]):
+            # req tuples may carry a 4th (fused-reserve) element since the
+            # remote-fused-fetch change; index, don't unpack
+            for ri, req in enumerate(snap["reqs"][:R]):
+                rank, rqseqno, req_types = req[0], req[1], req[2]
                 i = si * R + ri
                 req_valid[i] = True
                 if req_types is None:

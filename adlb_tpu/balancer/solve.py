@@ -186,9 +186,11 @@ class AssignmentSolver:
         req_valid = np.zeros((S * R,), dtype=bool)
         req_ref: list = [None] * (S * R)
         for si, s in enumerate(servers):
-            for ri, (rank, rqseqno, req_types) in enumerate(
-                snapshots[s]["reqs"][:R]
-            ):
+            # req tuples are (rank, rqseqno, types) — a 4th element
+            # (fused-reserve flag, consumed by the plan-match sender)
+            # may ride along since the remote-fused-fetch change
+            for ri, req in enumerate(snapshots[s]["reqs"][:R]):
+                rank, rqseqno, req_types = req[0], req[1], req[2]
                 i = si * R + ri
                 req_valid[i] = True
                 if req_types is None:

@@ -880,6 +880,27 @@ int ADLB_Ireserve(int *rt, int *wt, int *wp, int *wh, int *wl, int *ar) {
   return rc;
 }
 
+// Fetch a batch-common prefix into *out; advances *out past the prefix.
+// Shared by the Get_reserved handle path and the fused suffix+common
+// reservation response (the Python server inlines only the SUFFIX of a
+// prefixed unit since the remote-fused-fetch change — the client
+// assembles prefix + suffix itself). Returns the server's rc: a GC'd
+// prefix (reclaim edge) must surface as an error, never as a silently
+// truncated payload.
+static int fetch_common_prefix(int common_server, int64_t common_seqno,
+                               char **out) {
+  Encoder e(T_FA_GET_COMMON, g->rank);
+  e.i(F_COMMON_SEQNO, common_seqno);
+  send_msg(common_server, e);
+  Msg resp = wait_for(T_TA_GET_COMMON_RESP);
+  int rc = (int)resp.geti(F_RC, ADLB_SUCCESS);
+  if (rc != ADLB_SUCCESS) return rc;
+  const std::string &prefix = resp.blobs[F_PAYLOAD];
+  memcpy(*out, prefix.data(), prefix.size());
+  *out += prefix.size();
+  return ADLB_SUCCESS;
+}
+
 int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
                              double *time_on_queue) {
   if (!g) return ADLB_ERROR;
@@ -892,13 +913,8 @@ int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
   int64_t common_seqno = work_handle[4];
   char *out = (char *)work_buf;
   if (common_len > 0) {
-    Encoder e(T_FA_GET_COMMON, g->rank);
-    e.i(F_COMMON_SEQNO, common_seqno);
-    send_msg(common_server, e);
-    Msg resp = wait_for(T_TA_GET_COMMON_RESP);
-    const std::string &prefix = resp.blobs[F_PAYLOAD];
-    memcpy(out, prefix.data(), prefix.size());
-    out += prefix.size();
+    int rc = fetch_common_prefix(common_server, common_seqno, &out);
+    if (rc != ADLB_SUCCESS) return rc;
   }
   Encoder e(T_FA_GET_RESERVED, g->rank);
   e.i(F_SEQNO, seqno);
@@ -1261,11 +1277,21 @@ int ADLBP_Get_work_batch(int *req_types, int max_units, int *num_got,
   if (answer_ranks) answer_ranks[0] = (int)resp.geti(F_ANSWER_RANK, -1);
   auto bit = resp.blobs.find(F_PAYLOAD);
   if (bit != resp.blobs.end()) {  // fused single
-    int n = (int)bit->second.size();
+    // a batch-common unit inlines only its SUFFIX + the prefix handle;
+    // assemble prefix + suffix here (one extra fetch per unit — the
+    // Python client amortizes it through its prefix cache)
+    int common_len = (int)resp.geti(F_COMMON_LEN, 0);
+    int n = (int)bit->second.size() + common_len;
     if (n > max_len_per_unit)
       die("Get_work_batch: payload of %d bytes exceeds per-unit buffer of "
           "%d", n, max_len_per_unit);
-    memcpy(out, bit->second.data(), (size_t)n);
+    char *w = out;
+    if (common_len > 0) {
+      int prc = fetch_common_prefix((int)resp.geti(F_COMMON_SERVER, -1),
+                                    resp.geti(F_COMMON_SEQNO, -1), &w);
+      if (prc != ADLB_SUCCESS) return prc;
+    }
+    memcpy(w, bit->second.data(), bit->second.size());
     if (work_lens) work_lens[0] = n;
     if (num_got) *num_got = 1;
     return ADLB_SUCCESS;

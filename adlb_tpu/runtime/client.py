@@ -25,7 +25,8 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
-from typing import Optional, Sequence
+from collections import OrderedDict, deque
+from typing import Iterator, Optional, Sequence
 
 from contextlib import nullcontext
 
@@ -110,6 +111,21 @@ class Client:
         self._m_put_retries = self.metrics.counter("put_retries")
         self._m_reserve_retries = self.metrics.counter("reserve_retries")
         self._m_reconnects = self.metrics.counter("reconnects")
+        # client-side batch-common prefix cache (bounded LRU keyed by
+        # (common_server, common_seqno)): members of a batch inline only
+        # their suffix; the prefix is fetched once per client and cache
+        # hits ship an SS_COMMON_FORFEIT accounting note instead of
+        # bytes, keeping server refcounts (and prefix GC) exact
+        self._prefix_cache: Optional[OrderedDict[tuple[int, int], bytes]] = (
+            OrderedDict() if cfg.prefix_cache_bytes > 0 else None
+        )
+        self._prefix_cache_bytes = 0
+        self._m_prefix_hits = self.metrics.counter("prefix_cache_hits")
+        self._m_prefix_misses = self.metrics.counter("prefix_cache_misses")
+        # at most one get_work_stream at a time: a concurrent blocking
+        # reserve's _wait would race the stream's passive routing for
+        # the same response tag
+        self._active_stream: Optional[WorkStream] = None
 
     def _span(self, name: str, **args):
         """API-call trace span + user-state inference boundary."""
@@ -382,6 +398,13 @@ class Client:
         (a transient server-side condition, e.g. this rank reconnecting
         while its rank-death fan-out settles). Every retry is a fresh
         rqseqno — the previous request is dead at the server."""
+        if self._active_stream is not None:
+            # reservation responses carry no request id, so a blocking
+            # reserve could not tell its answer from a stream delivery
+            raise AdlbError(
+                "reserve/get_work while a get_work_stream is open; close "
+                "the stream first"
+            )
         sleep = 0.0
         while True:
             self._rqseqno += 1
@@ -451,27 +474,74 @@ class Client:
                 self.tracer.got_work(wt)
         return rc, buf, t
 
+    def _fetch_prefix(
+        self, common_server: int, common_seqno: int
+    ) -> tuple[int, bytes]:
+        """Batch-common prefix bytes, through the client LRU cache.
+
+        A hit serves locally and ships an SS_COMMON_FORFEIT accounting
+        note (``op="forfeit"`` = count one get without re-sending bytes)
+        so the server's refcount — and thus prefix GC — stays exact: one
+        accounting event per batch member, fetched or cached. Native
+        common servers bypass the cache entirely (their frame decoder
+        rejects the forfeit tag), paying the fetch as before."""
+        key = (common_server, common_seqno)
+        cache = self._prefix_cache
+        if common_server in getattr(self.ep, "binary_peers", ()):
+            cache = None
+        if cache is not None:
+            buf = cache.get(key)
+            if buf is not None:
+                cache.move_to_end(key)
+                self._m_prefix_hits.inc()
+                # get_id (same counter as put ids): a forfeit re-sent
+                # across connection churn must not be applied twice —
+                # an over-forfeit would GC the prefix one get early and
+                # drop a live member
+                fid = self._next_put_id
+                self._next_put_id += 1
+                self._send_retry(
+                    common_server,
+                    msg(Tag.SS_COMMON_FORFEIT, self.rank,
+                        common_seqno=common_seqno, op="forfeit",
+                        get_id=fid),
+                )
+                return ADLB_SUCCESS, buf
+        # get_id (same per-client counter as put ids) lets the server
+        # tell a re-sent duplicate from a legitimate second fetch of
+        # the same prefix (one fetch per batch member is normal)
+        get_id = self._next_put_id
+        self._next_put_id += 1
+        self._send_retry(
+            common_server,
+            msg(Tag.FA_GET_COMMON, self.rank,
+                common_seqno=common_seqno, get_id=get_id),
+        )
+        resp = self._wait(Tag.TA_GET_COMMON_RESP)
+        if resp.rc != ADLB_SUCCESS:
+            return resp.rc, b""
+        self._m_prefix_misses.inc()
+        buf = resp.payload
+        if cache is not None and len(buf) <= self.cfg.prefix_cache_bytes:
+            cache[key] = buf
+            self._prefix_cache_bytes += len(buf)
+            while self._prefix_cache_bytes > self.cfg.prefix_cache_bytes:
+                _, old = cache.popitem(last=False)
+                self._prefix_cache_bytes -= len(old)
+        return ADLB_SUCCESS, buf
+
     def _get_reserved_timed(
         self, handle: WorkHandle
     ) -> tuple[int, Optional[bytes], float]:
         prefix = b""
         if handle.common_len > 0:
-            # get_id (same per-client counter as put ids) lets the server
-            # tell a re-sent duplicate from a legitimate second fetch of
-            # the same prefix (one fetch per batch member is normal)
-            get_id = self._next_put_id
-            self._next_put_id += 1
-            self._send_retry(
-                handle.common_server_rank,
-                msg(Tag.FA_GET_COMMON, self.rank,
-                    common_seqno=handle.common_seqno, get_id=get_id),
+            rc, prefix = self._fetch_prefix(
+                handle.common_server_rank, handle.common_seqno
             )
-            resp = self._wait(Tag.TA_GET_COMMON_RESP)
-            if resp.rc != ADLB_SUCCESS:
+            if rc != ADLB_SUCCESS:
                 # prefix no longer exists (reclaim edge): surface the
                 # error; a truncated payload must never look like success
-                return resp.rc, None, 0.0
-            prefix = resp.payload
+                return rc, None, 0.0
         self._send_retry(
             handle.server_rank,
             msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno),
@@ -508,12 +578,24 @@ class Client:
 
     def _decode_single_got(self, resp) -> tuple[int, Optional[GotWork]]:
         """Decode a successful single-unit TA_RESERVE_RESP: fused (payload
-        inline) or handle fallback (remote holder / prefixed unit)."""
+        inline — whole for prefix-free units, suffix + common handle for
+        batch-common ones) or handle fallback (e.g. a native server that
+        predates the remote fuse)."""
         if "payload" in resp.data:  # fused: already consumed
+            payload = resp.payload
+            if resp.data.get("common_len", 0) > 0:
+                rc, prefix = self._fetch_prefix(
+                    resp.common_server, resp.common_seqno
+                )
+                if rc != ADLB_SUCCESS:
+                    # prefix gone (reclaim edge): a truncated payload
+                    # must never look like success
+                    return rc, None
+                payload = prefix + payload
             got = GotWork(
                 work_type=resp.work_type,
                 work_prio=resp.prio,
-                payload=resp.payload,
+                payload=payload,
                 answer_rank=resp.answer_rank,
                 time_on_q=resp.data.get("time_on_q", 0.0),
             )
@@ -577,6 +659,30 @@ class Client:
             # fallback, or a server that ignores fetch_max)
             rc, got = self._decode_single_got(resp)
             return rc, [got] if got is not None else []
+
+    # -- prefetch pipeline (get_work_stream) ----------------------------------
+
+    def get_work_stream(
+        self, req_types: Optional[Sequence[int]] = None, depth: int = 2
+    ) -> "WorkStream":
+        """Iterator of :class:`GotWork` that keeps up to ``depth`` fused
+        reserves in flight so the next unit's delivery overlaps the
+        current unit's compute (no reference analogue — upstream's
+        consumer loop serializes Reserve and Get_reserved round trips
+        against the work itself). Ends cleanly at NO_MORE_WORK /
+        DONE_BY_EXHAUSTION (the termination code is left in ``.rc``);
+        ADLB_RETRY deliveries (reclaim-mode resurrection) re-arm the
+        slot with backoff. Toward a native home server — which has no
+        multi-entry reserve queue — the stream degrades to repeated
+        fused ``get_work`` calls."""
+        types = normalize_req_types(req_types, self.world.types)
+        if self.home in getattr(self.ep, "binary_peers", ()):
+            return _SerialStream(self, req_types)
+        if self._active_stream is not None:
+            raise AdlbError("only one get_work_stream may be open at a time")
+        stream = WorkStream(self, types, depth)
+        self._active_stream = stream
+        return stream
 
     # -- app <-> app messaging (the reference's app_comm) ---------------------
     #
@@ -676,10 +782,23 @@ class Client:
         if m.tag is Tag.TA_PUT_RESP and m.data.get("put_id") is not None:
             # stale duplicate ack of an already-settled re-sent put
             return
+        if (
+            m.tag is Tag.TA_RESERVE_RESP
+            and self._active_stream is not None
+        ):
+            # a stream delivery arriving while the client is inside some
+            # other wait (a put settle, a prefix fetch, an app_recv):
+            # banked raw — decode (which may itself do nested RPCs)
+            # happens in stream context, never here
+            self._active_stream._on_resp(m)
+            return
         if m.tag in (
             Tag.TA_RESERVE_RESP,
             Tag.TA_GET_RESERVED_RESP,
             Tag.TA_GET_COMMON_RESP,
+            # a late/duplicate stream-cancel ack (the close() drain
+            # already settled, or a re-sent cancel was acked twice)
+            Tag.TA_STREAM_CANCEL_RESP,
         ):
             # stray replay: a request re-sent across connection churn can
             # be answered twice (the server replays its at-most-once
@@ -876,6 +995,15 @@ class Client:
             self.tracer.api_entry()  # close any open inferred user span
         rc = ADLB_SUCCESS
         if not self.aborted:
+            if self._active_stream is not None:
+                # an abandoned stream's parked reserves must be cancelled
+                # (and any banked deliveries handed back to the pool)
+                # before LOCAL_APP_DONE, or the server would keep
+                # matching work to a rank that will never read it
+                try:
+                    self._active_stream.close()
+                except Exception:  # teardown races: cancel best-effort
+                    self._active_stream = None
             if self._pending_puts:
                 # un-settled pipelined puts must land before LOCAL_APP_DONE
                 # or the shutdown ring could outrun them; a terminal failure
@@ -902,3 +1030,262 @@ class Client:
         if self._abort_event is not None:
             self._abort_event.set()
         raise AdlbAborted(code)
+
+
+class WorkStream:
+    """Client half of the prefetch pipeline (``get_work_stream``).
+
+    Keeps up to ``depth`` fused prefetch reserves in flight at the home
+    server; deliveries are banked raw (:class:`Msg`) by whatever recv
+    loop sees them and decoded — including prefix-cache assembly and the
+    handle fallback's fetch — only in stream context, so no nested RPC
+    ever runs inside a passive dispatch. Exhaustion safety: prefetch
+    parks only count as idle after this client reports an empty bank
+    (FA_STREAM_IDLE), so work banked here can still put descendants
+    before the world is allowed to declare exhaustion.
+    """
+
+    def __init__(self, client: Client, types, depth: int) -> None:
+        self._c = client
+        self._types = types  # normalized frozenset or None
+        self._depth = max(1, int(depth))
+        self._bank: deque[Msg] = deque()
+        # outstanding reserve ids: responses echo rqseqno, so matching
+        # by id both accounts the slots exactly and dedups duplicated
+        # responses (a frame re-sent across reconnect) for free
+        self._outstanding: set[int] = set()
+        self._retry = 0
+        self._retry_sleep = 0.0
+        self._idle_sent = False
+        self._idle_sent_at = 0.0
+        self._closed = False
+        self.rc: Optional[int] = None  # termination code once observed
+
+    # re-announce idleness at this cadence while blocked: a note lost to
+    # churn (or voided server-side — count mismatch, reclaim sweep) must
+    # not wedge the exhaustion vote forever, and the swept-stream re-arm
+    # (ADLB_RETRY per phantom slot) is triggered by exactly this re-send
+    IDLE_REANNOUNCE_S = 1.0
+
+    def __iter__(self) -> Iterator[GotWork]:
+        return self
+
+    # -- wiring --------------------------------------------------------------
+
+    def _send_one(self) -> None:
+        c = self._c
+        c._rqseqno += 1
+        self._outstanding.add(c._rqseqno)
+        c._send_retry(
+            c.home,
+            msg(
+                Tag.FA_RESERVE,
+                c.rank,
+                rqseqno=c._rqseqno,
+                req_types=None if self._types is None
+                else sorted(self._types),
+                hang=True,
+                fetch=True,
+                prefetch=True,
+            ),
+        )
+
+    def _pump(self) -> None:
+        if self.rc is not None or self._closed:
+            return
+        while len(self._outstanding) + len(self._bank) < self._depth:
+            self._send_one()
+
+    def _on_resp(self, m: Msg) -> None:
+        """Bank one reservation response (called from the client's
+        dispatch — NO decoding, no nested RPCs here). Matched by the
+        echoed rqseqno: a response whose id is not outstanding is a
+        duplicate (re-sent across reconnect) or a stray — processing it
+        would run a unit twice, so it is dropped."""
+        rid = m.data.get("rqseqno")
+        if rid is None or rid not in self._outstanding:
+            self._c.flight.record(
+                f"stream: dropped stray/duplicate delivery (rqseqno={rid})"
+            )
+            return
+        self._outstanding.discard(rid)
+        rc = m.rc
+        if rc == ADLB_SUCCESS:
+            self._bank.append(m)
+            # the delivery un-idled us server-side; re-announce next
+            # time the bank runs dry
+            self._idle_sent = False
+        elif rc == ADLB_RETRY:
+            # reclaim-mode resurrection: this rank reconnected while its
+            # death fan-out settled — re-arm the slot (with backoff, in
+            # stream context). Re-announce idleness afterwards: a note
+            # voided server-side (count mismatch) would otherwise never
+            # be re-sent, and the exhaustion vote could wait forever.
+            self._retry += 1
+            self._idle_sent = False
+        else:
+            self.rc = rc  # NO_MORE_WORK / DONE_BY_EXHAUSTION
+
+    def _decode(self, m: Msg) -> Optional[GotWork]:
+        """Decode a banked delivery in stream context: prefix-cache
+        assembly for suffix-only payloads, Get_reserved for the handle
+        fallback (native servers). Returns None when the unit vanished
+        in a reclaim race (recorded, stream continues)."""
+        c = self._c
+        if "payload" not in m.data and "handle" not in m.data:
+            c.flight.record("stream: malformed delivery dropped")
+            return None
+        rc, got = c._decode_single_got(m)
+        if rc != ADLB_SUCCESS or got is None:
+            c.flight.record(f"stream: delivery decode failed rc={rc}")
+            return None
+        return got
+
+    # -- iteration -----------------------------------------------------------
+
+    def __next__(self) -> GotWork:
+        c = self._c
+        self._pump()
+        while True:
+            if self._closed and not self._bank:
+                # close() cancelled the parked reserves WITHOUT answering
+                # them, so the outstanding set never drains — iterating
+                # past a close must stop here, not spin on a recv forever
+                if c._active_stream is self:
+                    c._active_stream = None
+                raise StopIteration
+            if self._bank:
+                m = self._bank.popleft()
+                self._pump()
+                got = self._decode(m)
+                if got is None:
+                    continue
+                return got
+            if self._retry and self.rc is None:
+                self._retry -= 1
+                c._m_reserve_retries.inc()
+                self._retry_sleep = c._backoff_sleep(self._retry_sleep)
+                self._send_one()
+                continue
+            if not self._outstanding:
+                # nothing banked, nothing in flight: terminated (or
+                # closed mid-iteration)
+                if c._active_stream is self:
+                    c._active_stream = None
+                self._closed = True
+                raise StopIteration
+            if c._abort_event is not None and c._abort_event.is_set():
+                c.aborted = True
+                c.flight.record("abort event observed in get_work_stream")
+                c.flight.dump_json("abort_event")
+                raise AdlbAborted(-1)
+            now = time.monotonic()
+            if self.rc is None and (
+                not self._idle_sent
+                or now - self._idle_sent_at >= self.IDLE_REANNOUNCE_S
+            ):
+                # the bank is dry and we are (still) blocked: tell the
+                # home server this rank is genuinely idle, making its
+                # prefetch parks eligible for the exhaustion vote. The
+                # in-flight count lets the server void a note that
+                # crossed a delivery on the wire (see _on_stream_idle);
+                # the periodic re-announce repairs voided/lost notes and
+                # triggers the swept-stream re-arm after reclaim churn.
+                c._send_retry(
+                    c.home,
+                    msg(Tag.FA_STREAM_IDLE, c.rank,
+                        slots=sorted(self._outstanding)),
+                )
+                self._idle_sent = True
+                self._idle_sent_at = now
+            m = c.ep.recv(timeout=0.5)
+            if m is not None:
+                c._dispatch_passive(m)
+
+    # -- teardown ------------------------------------------------------------
+
+    def close(self) -> None:
+        """End the stream early: cancel parked prefetch reserves at the
+        server, then hand back anything already matched to us —
+        handle-shaped deliveries are UNRESERVEd at their holder (the
+        unit unpins and re-matches, targeting intact), fused payloads
+        are re-put untargeted (their unit was already consumed). Safe to
+        call after normal exhaustion too (no-op then)."""
+        if self._closed:
+            if self._c._active_stream is self:
+                self._c._active_stream = None
+            return
+        self._closed = True
+        c = self._c
+        try:
+            if self.rc is None and self._outstanding:
+                c._send_retry(c.home, msg(Tag.FA_STREAM_CANCEL, c.rank))
+                # deliveries that raced the cancel arrive BEFORE the ack
+                # (per-peer FIFO with the home server)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    m = c.ep.recv(timeout=0.2)
+                    if m is None:
+                        continue
+                    if m.tag is Tag.TA_STREAM_CANCEL_RESP:
+                        break
+                    c._dispatch_passive(m)
+            while self._bank:
+                m = self._bank.popleft()
+                if "handle" in m.data and "payload" not in m.data:
+                    h = WorkHandle.from_ints(m.handle)
+                    c._send_retry(
+                        h.server_rank,
+                        msg(Tag.SS_UNRESERVE, c.rank, seqno=h.seqno,
+                            for_rank=c.rank),
+                    )
+                    continue
+                got = self._decode(m)
+                if got is not None:
+                    # fused responses carry the unit's target_rank (if
+                    # any) precisely so this re-put can preserve the
+                    # only-the-target-may-run-it contract
+                    c._put(got.payload, got.work_type, got.work_prio,
+                           int(m.data.get("target_rank", -1)),
+                           got.answer_rank)
+        finally:
+            if c._active_stream is self:
+                c._active_stream = None
+
+    def __enter__(self) -> "WorkStream":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+class _SerialStream:
+    """Degraded stream toward a native home server (no multi-entry
+    reserve queue there): repeated fused ``get_work`` calls — still one
+    round trip per unit, just no overlap."""
+
+    def __init__(self, client: Client, req_types) -> None:
+        self._c = client
+        self._types = req_types
+        self.rc: Optional[int] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> GotWork:
+        if self.rc is not None:
+            raise StopIteration
+        rc, got = self._c.get_work(self._types)
+        if rc != ADLB_SUCCESS or got is None:
+            self.rc = rc
+            raise StopIteration
+        return got
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "_SerialStream":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -108,6 +108,12 @@ WIRE_TAG: dict[Tag, int] = {
     # client refuses it toward native servers)
     Tag.FA_CHECKPOINT: 1048,
     Tag.TA_CHECKPOINT_RESP: 1049,
+    # prefetch pipeline (get_work_stream; Python servers only — native
+    # daemons reject tags outside their known ranges, so the client
+    # degrades the stream to repeated fused get_work toward them)
+    Tag.FA_STREAM_IDLE: 1051,
+    Tag.FA_STREAM_CANCEL: 1052,
+    Tag.TA_STREAM_CANCEL_RESP: 1053,
     # app<->app point-to-point (the reference's app_comm traffic; native
     # clients receive it via ADLB_App_recv — bytes payloads only, enforced
     # by encodable())
@@ -145,6 +151,8 @@ WIRE_TAG: dict[Tag, int] = {
     # only today — ids reserved so a native plane can join the protocol)
     Tag.SS_RANK_DEAD: 1133,
     Tag.SS_COMMON_FORFEIT: 1134,
+    # remote fused fetch delivery confirmation (home -> holder)
+    Tag.SS_DELIVERED: 1135,
     # transport-internal synthetic signal (never actually on the wire; the
     # id exists only so the codec table stays total)
     Tag.PEER_EOF: 1999,
@@ -266,6 +274,20 @@ FIELDS: dict[str, tuple[int, int]] = {
     # re-sends can only be told apart by id (native daemons parse-and-
     # ignore unknown ids, so this is plane-compatible)
     "get_id": (88, _KIND_I64),
+    # prefetch pipeline: FA_RESERVE sent by a get_work_stream slot — the
+    # rank may still be computing, so the park only counts as idle for
+    # exhaustion voting after FA_STREAM_IDLE (native daemons parse-and-
+    # ignore unknown ids)
+    "prefetch": (89, _KIND_I64),
+    # FA_STREAM_IDLE: the stream's in-flight reserve count — the server
+    # honors the idle note only when that many entries are still parked,
+    # voiding notes that crossed a delivery on the wire (legacy
+    # count-only form; current clients send the slot list below)
+    "inflight": (90, _KIND_I64),
+    # FA_STREAM_IDLE: the outstanding reserve rqseqnos themselves — the
+    # server reconciles them against its parked entries exactly (idle
+    # mark on equality; swept-stream phantom slots re-armed by id)
+    "slots": (91, _KIND_LIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

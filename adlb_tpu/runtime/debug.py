@@ -82,10 +82,15 @@ def self_diagnosis(server, now: float, stuck_after: float = 5.0) -> list[str]:
             for s, st in sorted(peers.items())
         )
         lines.append(f"SELFDIAG rank {server.rank}: peer mem {mem}")
+    # prefetch (get_work_stream) parks of a BUSY rank are long-lived by
+    # design — the consumer is computing while its slots wait — so only
+    # blocking reserves and idle-reported streams count as "stuck"
+    idle = getattr(server, "_stream_idle", ())
     stuck = [
         (e.world_rank, round(now - e.time_stamp, 3))
         for e in server.rq.entries()
         if now - e.time_stamp > stuck_after
+        and (not e.prefetch or e.world_rank in idle)
     ]
     if stuck:
         lines.append(

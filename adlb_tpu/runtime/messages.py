@@ -36,6 +36,14 @@ class Tag(enum.Enum):
     FA_ABORT = enum.auto()
     FA_INFO_NUM_WORK_UNITS = enum.auto()
     FA_INFO_GET = enum.auto()
+    # prefetch pipeline (get_work_stream; no reference analogue): the
+    # client's bank ran dry and it is now genuinely blocked — its
+    # prefetch-flagged reserves become park-eligible for exhaustion
+    # voting (a delivery clears the mark server-side)
+    FA_STREAM_IDLE = enum.auto()
+    # drop this rank's prefetch reserves (stream close); acked so the
+    # client can drain deliveries that raced the cancel
+    FA_STREAM_CANCEL = enum.auto()
 
     # server -> client
     TA_PUT_RESP = enum.auto()
@@ -45,6 +53,7 @@ class Tag(enum.Enum):
     TA_GET_COMMON_RESP = enum.auto()
     TA_INFO_NUM_RESP = enum.auto()
     TA_INFO_GET_RESP = enum.auto()
+    TA_STREAM_CANCEL_RESP = enum.auto()
     TA_ABORT = enum.auto()
 
     # server <-> server
@@ -52,6 +61,14 @@ class Tag(enum.Enum):
     SS_RFR = enum.auto()
     SS_RFR_RESP = enum.auto()
     SS_UNRESERVE = enum.auto()
+    # remote fused fetch (no reference analogue — upstream always pays a
+    # GET_RESERVED round trip to the holder, src/adlb.c:2976-3025): the
+    # requester's home server confirms a payload-carrying SS_RFR_RESP
+    # landed at the requester, so the holder consumes the pinned unit.
+    # Until then the unit stays pinned under its lease — an UNRESERVE
+    # race unpins it and a requester death reclaims it, both through the
+    # existing paths.
+    SS_DELIVERED = enum.auto()
     SS_PUSH_QUERY = enum.auto()
     SS_PUSH_QUERY_RESP = enum.auto()
     SS_PUSH_WORK = enum.auto()

@@ -274,60 +274,166 @@ class RqEntry:
     """A parked (blocking) Reserve waiting for work (reference
     ``src/xq.h:58-64``). ``fetch`` marks a fused reserve+get (this
     framework's extension): when the match is local and prefix-free the
-    payload rides the response."""
+    payload rides the response. ``prefetch`` marks a pipelined
+    ``get_work_stream`` reserve: the rank may still be computing while
+    this entry is parked, so it only counts as idle for exhaustion
+    voting once the client sends FA_STREAM_IDLE."""
 
     world_rank: int
     rqseqno: int
     req_types: Optional[frozenset[int]]  # None = any
     time_stamp: float = dataclasses.field(default_factory=time.monotonic)
     fetch: bool = False
+    prefetch: bool = False
 
     def wants(self, work_type: int) -> bool:
         return self.req_types is None or work_type in self.req_types
 
 
 class ReserveQueue:
-    """Waiting requesters, FIFO within compatibility (the reference's ``rq``)."""
+    """Waiting requesters, FIFO within compatibility (the reference's ``rq``).
+
+    Since the prefetch pipeline, one rank may park SEVERAL entries at once
+    (up to its stream depth); matching stays globally FIFO across entries.
+    The global order is an insertion-ordered dict keyed ``(rank, rqseqno)``
+    so the per-delivery hot path (remove one entry, demote the rank's
+    siblings) costs O(1)/O(depth), not a full-list scan — this runs on
+    the GIL-holding reactor thread for every satisfied reserve.
+    """
 
     def __init__(self) -> None:
-        self._entries: dict[int, RqEntry] = {}  # world_rank -> entry, insert-ordered
+        # (world_rank, rqseqno) -> entry, in global park order
+        self._order: "dict[tuple[int, int], RqEntry]" = {}
+        self._by_rank: dict[int, list[RqEntry]] = {}
+
+    @staticmethod
+    def _key(entry: RqEntry) -> tuple[int, int]:
+        return (entry.world_rank, entry.rqseqno)
 
     def add(self, entry: RqEntry) -> None:
-        self._entries[entry.world_rank] = entry
+        self._order[self._key(entry)] = entry
+        self._by_rank.setdefault(entry.world_rank, []).append(entry)
+
+    def remove_entry(self, entry: RqEntry) -> Optional[RqEntry]:
+        """Remove one specific parked entry (multi-entry ranks must not
+        drop a sibling pipeline slot)."""
+        key = self._key(entry)
+        if key not in self._order:
+            return None
+        del self._order[key]
+        own = self._by_rank.get(entry.world_rank)
+        if own is not None:
+            try:
+                own.remove(entry)  # O(depth): pipeline lists are short
+            except ValueError:
+                pass
+            if not own:
+                del self._by_rank[entry.world_rank]
+        return entry
 
     def remove(self, world_rank: int) -> Optional[RqEntry]:
-        return self._entries.pop(world_rank, None)
+        """Remove and return the rank's OLDEST entry (legacy single-entry
+        call shape)."""
+        own = self._by_rank.get(world_rank)
+        if not own:
+            return None
+        return self.remove_entry(own[0])
+
+    def remove_rank(self, world_rank: int) -> list[RqEntry]:
+        """Remove every entry a rank holds (rank death / finalize)."""
+        removed = []
+        while world_rank in self._by_rank:
+            removed.append(self.remove_entry(self._by_rank[world_rank][0]))
+        return removed
+
+    def remove_prefetch(self, world_rank: int) -> list[RqEntry]:
+        """Remove the rank's prefetch (stream) entries only — stream
+        cancel must not cancel a concurrent blocking reserve."""
+        doomed = [e for e in self._by_rank.get(world_rank, ()) if e.prefetch]
+        for e in doomed:
+            self.remove_entry(e)
+        return doomed
 
     def find_for_type(self, work_type: int, target_rank: int = -1) -> Optional[RqEntry]:
         """First waiting requester a fresh unit could satisfy (reference
         ``src/xq.c:352-444`` via ``rq_find_rank_queued_for_type``)."""
         if target_rank >= 0:
-            e = self._entries.get(target_rank)
-            return e if e is not None and e.wants(work_type) else None
-        for e in self._entries.values():
+            own = self._by_rank.get(target_rank)
+            if not own:
+                return None
+            for e in own:
+                if e.wants(work_type):
+                    return e
+            return None
+        for e in self._order.values():
             if e.wants(work_type):
                 return e
         return None
 
-    def waiting_ranks(self) -> list[int]:
-        return list(self._entries)
+    def find_entry(self, world_rank: int, rqseqno: int) -> Optional[RqEntry]:
+        for e in self._by_rank.get(world_rank, ()):
+            if e.rqseqno == rqseqno:
+                return e
+        return None
 
-    def oldest_age(self, now: float) -> float:
+    def demote_rank(self, world_rank: int) -> None:
+        """Move the rank's remaining entries to the back of the global
+        park order (relative order kept). Called after delivering to the
+        rank: its sibling pipeline slots are adjacent in FIFO order, and
+        without the demotion a scarce trickle of units piles onto one
+        streaming consumer's bank (serialized behind its compute) while
+        other consumers idle. O(rank's depth): re-inserting a key moves
+        it to the tail of the insertion order."""
+        own = self._by_rank.get(world_rank)
+        if not own or len(self._order) == len(own):
+            return
+        for e in own:
+            key = self._key(e)
+            del self._order[key]
+            self._order[key] = e
+
+    def count_for(self, world_rank: int) -> int:
+        """Number of entries a rank currently has parked."""
+        return len(self._by_rank.get(world_rank, ()))
+
+    def ids_for(self, world_rank: int) -> set[int]:
+        """The rank's parked rqseqnos — the idle-note reconciliation
+        reads these per rank, not via a global scan."""
+        return {e.rqseqno for e in self._by_rank.get(world_rank, ())}
+
+    def has_blocking(self, world_rank: int) -> bool:
+        """True when the rank holds at least one NON-prefetch entry —
+        i.e. the app is synchronously blocked in reserve/get_work."""
+        return any(
+            not e.prefetch for e in self._by_rank.get(world_rank, ())
+        )
+
+    def waiting_ranks(self) -> list[int]:
+        return list(self._by_rank)
+
+    def oldest_age(self, now: float, stream_idle=None) -> float:
         """Age of the longest-parked requester (0 when none) — the
         observability tick's park-age gauge, the direct signal behind a
-        'flat wait' shape (every tick shows someone parked this long)."""
-        if not self._entries:
-            return 0.0
-        return max(now - e.time_stamp for e in self._entries.values())
+        'flat wait' shape (every tick shows someone parked this long).
+        Prefetch (stream) parks of a rank NOT in ``stream_idle`` are
+        excluded: the consumer is computing while its slots wait, which
+        is the pipeline working as designed, not a wait."""
+        ages = [
+            now - e.time_stamp
+            for e in self._order.values()
+            if not e.prefetch
+            or (stream_idle is not None and e.world_rank in stream_idle)
+        ]
+        return max(ages, default=0.0)
 
     def entries(self) -> list[RqEntry]:
-        return list(self._entries.values())
+        return list(self._order.values())
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return len(self._order)
 
     def __contains__(self, world_rank: int) -> bool:
-        return world_rank in self._entries
+        return world_rank in self._by_rank
 
 
 class TargetedDirectory:

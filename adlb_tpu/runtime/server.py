@@ -135,11 +135,26 @@ class _BalancerWorker(threading.Thread):
 
     def _one_round(self, engine) -> None:
         s = self.server
+        snaps = dict(s._snapshots)  # one copy: the round AND the fetch
+        # lookup below must see the same view, or a reactor-thread
+        # snapshot swap mid-round could silently drop a match's flag
         if s.tracer is not None:
             with s.tracer.span("balancer:round"):
-                matches, migrations = engine.round(dict(s._snapshots), s.world)
+                matches, migrations = engine.round(snaps, s.world)
         else:
-            matches, migrations = engine.round(dict(s._snapshots), s.world)
+            matches, migrations = engine.round(snaps, s.world)
+        if matches:
+            # whether each planned requester's park is a fused reserve
+            # (get_work/stream): snapshot req tuples carry it as a 4th
+            # element (3-tuples from native planes default to False), and
+            # the holder uses it to ship the payload in the RFR response
+            # instead of a handle (remote fused fetch)
+            fetch_by_req: dict[tuple, bool] = {}
+            for src, snap in snaps.items():
+                for r in snap.get("reqs") or ():
+                    fetch_by_req[(src, r[0], r[1])] = (
+                        bool(r[3]) if len(r) > 3 else False
+                    )
         for holder, seqno, req_home, for_rank, rqseqno in matches:
             s.ep.send(
                 holder,
@@ -150,6 +165,9 @@ class _BalancerWorker(threading.Thread):
                     for_rank=for_rank,
                     req_home=req_home,
                     rqseqno=rqseqno,
+                    fetch=int(
+                        fetch_by_req.get((req_home, for_rank, rqseqno), False)
+                    ),
                 ),
             )
         for src_rank, dest, seqnos, mig_id in migrations:
@@ -213,9 +231,10 @@ class Server:
         #             per sender (the consume is unrepeatable);
         #   common  — last fetched prefix seqno (re-serve w/o recount).
         self._seen_puts: dict[int, tuple[set, deque]] = {}
-        self._last_rqseqno: dict[int, int] = {}
+        self._seen_rqseqnos: dict[int, tuple[set, deque]] = {}
         self._last_get_resp: dict[int, tuple[int, Msg]] = {}
         self._last_common: dict[int, int] = {}
+        self._seen_forfeits: dict[int, tuple[set, deque]] = {}
 
         self._next_seqno = 1
         self.peers: dict[int, _PeerState] = {
@@ -225,6 +244,25 @@ class Server:
         # stealing state
         self._rfr_out: set[int] = set()  # ranks with an outstanding RFR
         self._rfr_excluded: dict[int, set[int]] = {}  # rank -> servers struck out
+        # remote fused fetch: units whose payload left in a
+        # payload-carrying SS_RFR_RESP but whose SS_DELIVERED/UNRESERVE
+        # resolution has not arrived. They stay pinned under their lease;
+        # a rank-death sweep treats them as delivered (the payload may
+        # already be at the requester — re-enqueueing could run it twice)
+        self._relay_inflight: dict[int, int] = {}  # seqno -> for_rank
+        # ranks whose get_work_stream reported an empty bank (FA_STREAM_IDLE):
+        # only then do their prefetch-flagged reserves count as parked for
+        # exhaustion voting; any delivery to the rank clears the mark
+        self._stream_idle: set[int] = set()
+        # ranks whose prefetch entries were swept by a rank-death reclaim:
+        # if the rank resurrects (the EOF was churn), its stream still
+        # counts those reserves as in flight, so the next idle note is
+        # answered with enough ADLB_RETRY responses to re-arm the
+        # phantom slots instead of hanging the stream forever
+        self._swept_streams: set[int] = set()
+        # steal/broadcast event qmstat: rate limiter for the
+        # empty->nonempty immediate broadcasts
+        self._last_qmstat_event = 0.0
         # push state: query_id -> seqno offered; receiver side: query_id -> reserved bytes
         self._push_seq = 0
         self._push_offered: dict[int, int] = {}
@@ -385,6 +423,8 @@ class Server:
             Tag.FA_BATCH_DONE: self._on_batch_done,
             Tag.FA_DID_PUT_AT_REMOTE: self._on_did_put_at_remote,
             Tag.FA_RESERVE: self._on_reserve,
+            Tag.FA_STREAM_IDLE: self._on_stream_idle,
+            Tag.FA_STREAM_CANCEL: self._on_stream_cancel,
             Tag.FA_GET_RESERVED: self._on_get_reserved,
             Tag.FA_GET_COMMON: self._on_get_common,
             Tag.FA_NO_MORE_WORK: self._on_fa_no_more_work,
@@ -396,6 +436,7 @@ class Server:
             Tag.SS_RFR: self._on_rfr,
             Tag.SS_RFR_RESP: self._on_rfr_resp,
             Tag.SS_UNRESERVE: self._on_unreserve,
+            Tag.SS_DELIVERED: self._on_delivered,
             Tag.SS_PUSH_QUERY: self._on_push_query,
             Tag.SS_PUSH_QUERY_RESP: self._on_push_query_resp,
             Tag.SS_PUSH_WORK: self._on_push_work,
@@ -546,7 +587,8 @@ class Server:
                 self._send_app(
                     m.src,
                     msg(resp_tag, self.rank, rc=ADLB_RETRY,
-                        put_id=m.data.get("put_id")),
+                        put_id=m.data.get("put_id"),
+                        rqseqno=m.data.get("rqseqno")),
                 )
                 return
         tr = self.tracer
@@ -576,7 +618,9 @@ class Server:
             m = self.metrics
             m.gauge("wq_untargeted_avail").set(wq_avail)
             m.gauge("wq_bytes").set(wq_bytes)
-            m.gauge("rq_oldest_age_s").set(self.rq.oldest_age(now))
+            m.gauge("rq_oldest_age_s").set(
+                self.rq.oldest_age(now, stream_idle=self._stream_idle)
+            )
             if self.cfg.balancer == "tpu":
                 # The snapshot walk is O(wq); at the fast balancer cadence
                 # it is a real GIL tax on compute-bound workloads. Walk it
@@ -649,17 +693,24 @@ class Server:
             )
             return False
 
-    def _requeue_consumed(self, unit) -> None:
+    def _requeue_consumed(self, unit, prefix_fetched: bool = True) -> None:
         """Put a consumed-but-undeliverable unit back on the queue (its
-        requester died between match and delivery)."""
+        requester died between match and delivery). ``prefix_fetched``:
+        whether the dead requester already accounted a prefix get for
+        this member (True on the Get_reserved path, which orders
+        common-first; False on the fused path, whose response carries
+        only the suffix)."""
         if unit.target_rank >= 0 and unit.target_rank in self._dead_ranks:
             # targeted at the dead requester itself: dropping IS the
             # reclaim outcome (no other rank may take targeted work), and
             # the rank-dead sweep already ran, so nobody else will drop
-            # it. NO common forfeit here: this path is an undeliverable
-            # Get_reserved response, and Get_reserved orders common-first
-            # — the dead requester's prefix get already accounted this
-            # member's share.
+            # it. A fused (suffix-only) drop must still forfeit the
+            # member's prefix share — no get will ever account it; the
+            # Get_reserved path's share was accounted by the dead
+            # requester's common-first fetch.
+            if not prefix_fetched:
+                self._forfeit_common(unit.common_seqno,
+                                     unit.common_server_rank)
             self._m_targeted_dropped.inc()
             self.flight.record(
                 f"targeted_dropped rank={unit.target_rank} "
@@ -670,7 +721,7 @@ class Server:
         unit.pinned = False
         unit.pin_rank = -1
         self.wq.add(unit)
-        if unit.common_seqno >= 0:
+        if unit.common_seqno >= 0 and prefix_fetched:
             # the dead requester fetched the prefix before this fetch
             # (Get_reserved orders common-first); the re-consumption
             # fetches it again
@@ -699,39 +750,66 @@ class Server:
     def _reserve_resp(
         self, app_rank: int, rc: int, unit: Optional[WorkUnit] = None,
         holder: Optional[int] = None, fetch: bool = False,
+        rqseqno: Optional[int] = None,
     ) -> None:
+        # ``rqseqno`` echoes the request id being answered: reservation
+        # responses are otherwise indistinguishable, and the prefetch
+        # pipeline needs to match (and dedup re-sent duplicates of)
+        # responses against its outstanding slots by id
         if rc != ADLB_SUCCESS:
             self._send_app(
-                app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc)
+                app_rank,
+                msg(Tag.TA_RESERVE_RESP, self.rank, rc=rc, rqseqno=rqseqno),
             )
             return
         self.resolved_reserves += 1
-        if (
-            fetch
-            and (holder is None or holder == self.rank)
-            and unit.common_len == 0
-        ):
+        if fetch and (holder is None or holder == self.rank):
             # fused reserve+get (no reference analogue — upstream always
             # pays a second round trip, src/adlb.c:2976-3025): the unit is
-            # local and prefix-free, so consume it now and inline the
-            # payload in the reservation response
+            # local, so consume it now and inline the payload in the
+            # reservation response. A batch-common unit inlines only its
+            # SUFFIX plus the prefix handle: the client assembles from
+            # its prefix cache (one fetch per client per prefix, hits
+            # accounted via SS_COMMON_FORFEIT so server refcounts stay
+            # exact).
             self._consume(unit)
+            fields = dict(
+                rc=ADLB_SUCCESS,
+                rqseqno=rqseqno,
+                work_type=unit.work_type,
+                prio=unit.prio,
+                work_len=unit.work_len,
+                answer_rank=unit.answer_rank,
+                payload=unit.payload,
+                time_on_q=time.monotonic() - unit.time_stamp,
+            )
+            if unit.target_rank >= 0:
+                # a stream closing early re-puts banked units; carrying
+                # the targeting lets it preserve the only-R-may-run-it
+                # contract instead of re-pooling the unit untargeted
+                fields["target_rank"] = unit.target_rank
+            if unit.common_len > 0:
+                # The member's prefix share is accounted by the CLIENT
+                # (fetch on miss, forfeit note on cache hit) — it cannot
+                # be accounted here at consume time, because the prefix
+                # must outlive the GC until every member's client has
+                # actually read the bytes. A client that dies between
+                # this delivery and its accounting therefore leaks the
+                # prefix for the rest of the run — the same bounded-leak
+                # trade-off the reclaim credit path documents
+                # (CommonStore.credit), never a lost unit.
+                fields.update(
+                    common_len=unit.common_len,
+                    common_server=unit.common_server_rank,
+                    common_seqno=unit.common_seqno,
+                )
             delivered = self._send_app(
-                app_rank,
-                msg(
-                    Tag.TA_RESERVE_RESP,
-                    self.rank,
-                    rc=ADLB_SUCCESS,
-                    work_type=unit.work_type,
-                    prio=unit.prio,
-                    work_len=unit.work_len,
-                    answer_rank=unit.answer_rank,
-                    payload=unit.payload,
-                    time_on_q=time.monotonic() - unit.time_stamp,
-                ),
+                app_rank, msg(Tag.TA_RESERVE_RESP, self.rank, **fields)
             )
             if not delivered:
-                self._requeue_consumed(unit)
+                # the dead requester never fetched the prefix (fused
+                # responses carry only the suffix), so no common credit
+                self._requeue_consumed(unit, prefix_fetched=False)
             return
         handle = WorkHandle(
             seqno=unit.seqno,
@@ -740,9 +818,11 @@ class Server:
             common_server_rank=unit.common_server_rank,
             common_seqno=unit.common_seqno,
         )
-        self._send_reserve_handle(app_rank, unit, handle)
+        self._send_reserve_handle(app_rank, unit, handle, rqseqno)
 
-    def _reserve_resp_batch(self, app_rank: int, units: list) -> None:
+    def _reserve_resp_batch(
+        self, app_rank: int, units: list, rqseqno: Optional[int] = None,
+    ) -> None:
         """One TA_RESERVE_RESP carrying several consumed local units
         (get_work_batch); the binary codec carries the parallel per-unit
         fields as blist/list/flist kinds (codec.py ids 80-84)."""
@@ -756,6 +836,7 @@ class Server:
                 Tag.TA_RESERVE_RESP,
                 self.rank,
                 rc=ADLB_SUCCESS,
+                rqseqno=rqseqno,
                 payloads=[u.payload for u in units],
                 work_types=[u.work_type for u in units],
                 prios=[u.prio for u in units],
@@ -767,7 +848,8 @@ class Server:
             for u in units:
                 self._requeue_consumed(u)
 
-    def _send_reserve_handle(self, app_rank, unit, handle) -> None:
+    def _send_reserve_handle(self, app_rank, unit, handle,
+                             rqseqno=None) -> None:
         # an undeliverable handle needs no requeue here: the unit stays
         # pinned under the dead rank's lease, which the EOF-driven
         # reclaim releases
@@ -777,6 +859,7 @@ class Server:
                 Tag.TA_RESERVE_RESP,
                 self.rank,
                 rc=ADLB_SUCCESS,
+                rqseqno=rqseqno,
                 work_type=unit.work_type,
                 prio=unit.prio,
                 handle=handle.to_ints(),
@@ -839,7 +922,13 @@ class Server:
         put (True) or by cross-server delivery (push/migrate/unreserve
         re-match, False) — which drives the adaptive park-event gating in
         ``_on_reserve``."""
-        self.rq.remove(entry.world_rank)
+        self.rq.remove_entry(entry)
+        # a delivery un-idles a streaming rank (it has work to chew on)
+        # and demotes its sibling pipeline slots behind other ranks'
+        # entries, so scarce inventory spreads instead of piling onto
+        # one consumer's bank
+        self._stream_idle.discard(entry.world_rank)
+        self.rq.demote_rank(entry.world_rank)
         self._park_res_local[entry.world_rank] = local
         self._rfr_excluded.pop(entry.world_rank, None)
         wait = time.monotonic() - entry.time_stamp
@@ -847,7 +936,8 @@ class Server:
         self._rq_wait_n += 1
         self.activity += 1
         self._reserve_resp(entry.world_rank, ADLB_SUCCESS, unit,
-                           holder=holder, fetch=entry.fetch)
+                           holder=holder, fetch=entry.fetch,
+                           rqseqno=entry.rqseqno)
 
     def _match_rq(self) -> None:
         """Re-scan parked requesters against the local queue — run after any
@@ -969,6 +1059,25 @@ class Server:
 
     # ------------------------------------------------------- app handlers
 
+    @staticmethod
+    def _window_seen(store: dict, src: int, req_id) -> bool:
+        """Per-sender bounded replay window: True when req_id was already
+        recorded (a duplicate re-sent across connection churn — possibly
+        REORDERED behind newer ids by the per-connection reader threads,
+        so a high-water mark or last-id check would misclassify), else
+        records it."""
+        entry = store.get(src)
+        if entry is None:
+            entry = store[src] = (set(), deque())
+        ids, order = entry
+        if req_id in ids:
+            return True
+        ids.add(req_id)
+        order.append(req_id)
+        if len(order) > 512:
+            ids.discard(order.popleft())
+        return False
+
     def _put_seen(self, src: int, put_id) -> bool:
         entry = self._seen_puts.get(src)
         return entry is not None and put_id in entry[0]
@@ -1023,6 +1132,16 @@ class Server:
                     put_id=put_id),
             )
             return
+        # empty->nonempty observation must happen BEFORE the unit lands:
+        # it drives the steal-mode event qmstat below (peers whose view
+        # dates from the last drain believe this type has nothing)
+        type_was_empty = (
+            self.cfg.balancer == "steal"
+            and self.cfg.qmstat_mode == "broadcast"
+            and self.cfg.qmstat_event_gap > 0
+            and m.target_rank < 0
+            and self.wq.hi_prio_of_type(m.work_type) <= ADLB_LOWEST_PRIO
+        )
         payload: bytes = m.payload
         if not self.mem.try_alloc(len(payload)):
             self.stats[InfoKey.NREJECTED_PUTS] += 1
@@ -1089,6 +1208,20 @@ class Server:
             # faster than the rate limit accumulate and flush as one
             # batched delta (see _send_task_delta).
             self._send_task_delta(unit)
+        elif entry is None and type_was_empty:
+            # steal-mode dispatch latency: this put flipped a type's
+            # advertised inventory from empty to nonempty, and a
+            # requester parked on ANOTHER server can only discover it
+            # through qmstat — broadcasting now (rate-limited) instead
+            # of waiting out the periodic tick turns the trickle p50
+            # from gossip-cadence wait into one delivery leg. Peers
+            # re-run _try_rfr on every fresh qmstat, so the broadcast
+            # alone re-arms their parked entries. Ring mode stays
+            # upstream-faithful (interval-only).
+            now = time.monotonic()
+            if now - self._last_qmstat_event >= self.cfg.qmstat_event_gap:
+                self._last_qmstat_event = now
+                self._broadcast_qmstat()
 
     def _on_put_common(self, m: Msg) -> None:
         if not self.mem.try_alloc(len(m.payload)):
@@ -1121,24 +1254,27 @@ class Server:
     def _on_reserve(self, m: Msg) -> None:
         app = m.src
         rq_id = m.data.get("rqseqno")
-        if rq_id is not None and self._last_rqseqno.get(app) == rq_id:
-            # duplicate frame (re-sent across connection churn; per-peer
-            # FIFO puts it right behind the original): the first copy
-            # already responded or parked — processing it again would pin
-            # a second unit for the same request
-            return
         if rq_id is not None:
-            self._last_rqseqno[app] = rq_id
+            # duplicate frame (re-sent across connection churn):
+            # processing it again would pin a second unit for the same
+            # request. A windowed SEEN-SET, not a monotone high-water
+            # mark: with the prefetch pipeline several reserves are in
+            # flight, and a reconnect re-send on a NEW connection can be
+            # processed before an older frame still queued from the old
+            # connection's reader — a max-based filter would discard
+            # that never-processed older reserve and leak a stream slot.
+            if self._window_seen(self._seen_rqseqnos, app, rq_id):
+                return
         self._m_reserves.inc()
         self.stats[InfoKey.NUM_RESERVES] += 1
         # binary-codec clients encode "any type" by omitting the field
         raw_types = m.data.get("req_types")
         req_types = None if raw_types is None else frozenset(raw_types)
         if self.no_more_work:
-            self._reserve_resp(app, ADLB_NO_MORE_WORK)
+            self._reserve_resp(app, ADLB_NO_MORE_WORK, rqseqno=rq_id)
             return
         if self.done_by_exhaustion:
-            self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION)
+            self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION, rqseqno=rq_id)
             return
         fetch = bool(m.data.get("fetch", False))
         # clamped: the codec's list element counts are u16, and an
@@ -1163,16 +1299,18 @@ class Server:
                         break
                     self._pin(extra.seqno, app)
                     units.append(extra)
-                self._reserve_resp_batch(app, units)
+                self._reserve_resp_batch(app, units, rqseqno=rq_id)
                 return
-            self._reserve_resp(app, ADLB_SUCCESS, unit, fetch=fetch)
+            self._reserve_resp(app, ADLB_SUCCESS, unit, fetch=fetch,
+                               rqseqno=rq_id)
             return
         if not m.hang:
-            self._reserve_resp(app, ADLB_NO_CURRENT_WORK)
+            self._reserve_resp(app, ADLB_NO_CURRENT_WORK, rqseqno=rq_id)
             return
         self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ] += 1
         entry = RqEntry(world_rank=app, rqseqno=m.rqseqno,
-                        req_types=req_types, fetch=fetch)
+                        req_types=req_types, fetch=fetch,
+                        prefetch=bool(m.data.get("prefetch", False)))
         self.rq.add(entry)
         self._rfr_excluded.pop(app, None)
         self._try_rfr(entry)
@@ -1194,6 +1332,62 @@ class Server:
             if now - self._last_event_snap >= self.cfg.balancer_min_gap:
                 self._last_event_snap = now
                 self._send_snapshot(reqs_only=True)
+
+    def _on_stream_idle(self, m: Msg) -> None:
+        """The rank's get_work_stream bank ran dry: it is genuinely
+        blocked now, so its prefetch reserves become park-eligible for
+        exhaustion voting. Any delivery to the rank clears the mark.
+
+        The note carries the client's outstanding reserve ids (slots):
+        honoring it only when they exactly match what is parked here
+        voids a note that CROSSED a delivery on the wire — the client is
+        about to find work in its bank (and may put descendants), so
+        marking it idle would open a premature-exhaustion window. The
+        client re-announces (1 s cadence) while it stays blocked.
+
+        A rank whose reserves were swept by the rank-death reclaim and
+        then resurrected still counts phantom slots no response will
+        ever resolve. Those are the claimed ids that are neither parked
+        nor in the post-death request window (the window is reset at the
+        sweep, so ids the server answered BEFORE the death — responses
+        possibly lost with the connection — read as phantom too): each
+        is answered with ADLB_RETRY so the stream re-arms it under a
+        fresh rqseqno. Claimed ids the server processed after the
+        resurrection are deliveries in flight, never re-armed."""
+        slots = m.data.get("slots")
+        parked_ids = self.rq.ids_for(m.src)
+        if m.src in self._swept_streams and slots is not None:
+            self._swept_streams.discard(m.src)
+            seen = self._seen_rqseqnos.get(m.src)
+            seen_ids = seen[0] if seen is not None else ()
+            phantom = [i for i in slots
+                       if i not in parked_ids and i not in seen_ids]
+            for i in phantom:
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_RESERVE_RESP, self.rank, rc=ADLB_RETRY,
+                        rqseqno=i),
+                )
+            if phantom:
+                return  # the re-arms will park; idle re-announces then
+        if slots is not None:
+            if parked_ids and set(slots) == parked_ids:
+                self._stream_idle.add(m.src)
+            return
+        # legacy count-only note (no slot list): match on count alone
+        inflight = m.data.get("inflight")
+        if parked_ids and (inflight is None or inflight == len(parked_ids)):
+            self._stream_idle.add(m.src)
+
+    def _on_stream_cancel(self, m: Msg) -> None:
+        """Drop the rank's prefetch reserves (stream close / finalize).
+        Acked so the client can drain deliveries that raced the cancel —
+        per-peer FIFO puts any such delivery ahead of this response."""
+        self.rq.remove_prefetch(m.src)
+        self._stream_idle.discard(m.src)
+        self._send_app(
+            m.src, msg(Tag.TA_STREAM_CANCEL_RESP, self.rank, rc=ADLB_SUCCESS)
+        )
 
     def _on_get_reserved(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
@@ -1224,6 +1418,10 @@ class Server:
                 f"server {self.rank}: invalid GET_RESERVED seqno {m.seqno} "
                 f"from rank {m.src}"
             )
+        # only an HONORED fetch clears a relay marker: a stale replay
+        # from a resurrected rank must not erase the at-most-once
+        # protection of a live relay to the unit's NEW owner
+        self._relay_inflight.pop(m.seqno, None)
         self._consume(unit)
         resp = msg(
             Tag.TA_GET_RESERVED_RESP,
@@ -1370,36 +1568,59 @@ class Server:
                 else sorted(entry.req_types),
                 targeted_lookup=targeted_lookup,
                 lookup_type=lookup_type,
+                # fused reserve parked here: ask the holder to ship the
+                # payload in the RFR response (remote fused fetch) so the
+                # requester never pays a GET_RESERVED round trip
+                fetch=int(entry.fetch),
             ),
         )
+
+    def _rfr_found_resp(
+        self, dest: int, for_rank: int, rqseqno: int, unit, fetch: bool
+    ) -> None:
+        """Pin a matched unit and answer an RFR/plan match toward the
+        requester's home server. With ``fetch`` (the park is a fused
+        reserve) the payload rides along — remote fused fetch: the home
+        server forwards it straight into the TA_RESERVE_RESP and no
+        GET_RESERVED leg ever happens. The unit stays PINNED under its
+        lease until the home confirms delivery (SS_DELIVERED) or
+        compensates (SS_UNRESERVE), so the exhaustion vote and the
+        rank-death reclaim see the handoff exactly like a classic pinned
+        handoff."""
+        self._pin(unit.seqno, for_rank)
+        # a handoff is in flight: counts as activity so the exhaustion
+        # double-pass cannot declare done around it
+        self.activity += 1
+        self._exhaust_held_since = None
+        fields = dict(
+            found=True,
+            for_rank=for_rank,
+            rqseqno=rqseqno,
+            seqno=unit.seqno,
+            work_type=unit.work_type,
+            prio=unit.prio,
+            target_rank=unit.target_rank,
+            work_len=unit.work_len,
+            answer_rank=unit.answer_rank,
+            common_len=unit.common_len,
+            common_server=unit.common_server_rank,
+            common_seqno=unit.common_seqno,
+        )
+        if fetch:
+            self._relay_inflight[unit.seqno] = for_rank
+            fields.update(
+                payload=unit.payload,
+                time_on_q=time.monotonic() - unit.time_stamp,
+            )
+        self.ep.send(dest, msg(Tag.SS_RFR_RESP, self.rank, **fields))
 
     def _on_rfr(self, m: Msg) -> None:
         req_types = None if m.req_types is None else frozenset(m.req_types)
         unit = self.wq.find_match(m.for_rank, req_types)
         if unit is not None:
-            self._pin(unit.seqno, m.for_rank)
-            # a handoff is in flight: counts as activity so the exhaustion
-            # double-pass cannot declare done around it
-            self.activity += 1
-            self._exhaust_held_since = None
-            self.ep.send(
-                m.src,
-                msg(
-                    Tag.SS_RFR_RESP,
-                    self.rank,
-                    found=True,
-                    for_rank=m.for_rank,
-                    rqseqno=m.rqseqno,
-                    seqno=unit.seqno,
-                    work_type=unit.work_type,
-                    prio=unit.prio,
-                    target_rank=unit.target_rank,
-                    work_len=unit.work_len,
-                    answer_rank=unit.answer_rank,
-                    common_len=unit.common_len,
-                    common_server=unit.common_server_rank,
-                    common_seqno=unit.common_seqno,
-                ),
+            self._rfr_found_resp(
+                m.src, m.for_rank, m.rqseqno, unit,
+                fetch=bool(m.data.get("fetch", False)),
             )
         else:
             self.ep.send(
@@ -1422,21 +1643,15 @@ class Server:
         if not m.found:
             self._n_rfr_failed += 1
         if m.found:
-            entry = None
-            for cand in self.rq.entries():
-                if cand.world_rank == app:
-                    entry = cand
-                    break
-            if (
-                entry is None
-                or entry.rqseqno != m.rqseqno
-                or not entry.wants(m.work_type)
-            ):
+            entry = self.rq.find_entry(app, m.rqseqno)
+            if entry is None or not entry.wants(m.work_type):
                 # requester got satisfied (and possibly re-parked with a new
                 # request) while the RFR was in flight — compensate
                 # (reference SS_UNRESERVE, src/adlb.c:1949-1963). for_rank
                 # lets the holder ignore this if the pin already has a new
-                # owner (rank-dead reclaim re-matched it)
+                # owner (rank-dead reclaim re-matched it). A payload that
+                # rode along is simply discarded: the unit is still pinned
+                # at the holder, and the UNRESERVE unpins it for re-match.
                 self.ep.send(
                     m.src,
                     msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno,
@@ -1445,13 +1660,53 @@ class Server:
                 return
             if m.target_rank >= 0 and app == m.target_rank:
                 self.tq.remove(app, m.work_type, m.src)
-            self.rq.remove(app)
+            self.rq.remove_entry(entry)
+            self._stream_idle.discard(app)
+            self.rq.demote_rank(app)  # spread scarce inventory (see
+            # _satisfy_parked)
             self._park_res_local[app] = False  # RFR/plan = remote delivery
             self._rfr_excluded.pop(app, None)
             wait = time.monotonic() - entry.time_stamp
             self._rq_wait_sum += wait
             self._rq_wait_n += 1
             self.activity += 1
+            if "payload" in m.data and entry.fetch:
+                # remote fused fetch: the holder shipped the payload in
+                # the RFR response — forward it straight into the
+                # reservation response (ONE client-visible round trip, no
+                # GET_RESERVED leg) and confirm so the holder consumes
+                # the pinned unit. Prefixed units carry only their
+                # suffix; the client assembles from its prefix cache.
+                fields = dict(
+                    rc=ADLB_SUCCESS,
+                    rqseqno=m.rqseqno,
+                    work_type=m.work_type,
+                    prio=m.prio,
+                    work_len=m.work_len,
+                    answer_rank=m.answer_rank,
+                    payload=m.payload,
+                    time_on_q=m.data.get("time_on_q", 0.0),
+                )
+                if m.target_rank >= 0:
+                    fields["target_rank"] = m.target_rank
+                if m.common_len > 0:
+                    fields.update(
+                        common_len=m.common_len,
+                        common_server=m.common_server,
+                        common_seqno=m.common_seqno,
+                    )
+                delivered = self._send_app(
+                    app, msg(Tag.TA_RESERVE_RESP, self.rank, **fields)
+                )
+                self.ep.send(
+                    m.src,
+                    msg(Tag.SS_DELIVERED, self.rank, seqno=m.seqno,
+                        for_rank=app)
+                    if delivered
+                    else msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno,
+                             for_rank=app),
+                )
+                return
             handle = WorkHandle(
                 seqno=m.seqno,
                 server_rank=m.src,
@@ -1468,6 +1723,7 @@ class Server:
                     Tag.TA_RESERVE_RESP,
                     self.rank,
                     rc=ADLB_SUCCESS,
+                    rqseqno=m.rqseqno,
                     work_type=m.work_type,
                     prio=m.prio,
                     handle=handle.to_ints(),
@@ -1498,6 +1754,7 @@ class Server:
     def _on_unreserve(self, m: Msg) -> None:
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned:
+            self._relay_inflight.pop(m.seqno, None)
             return
         want = m.data.get("for_rank")
         if want is not None and unit.pin_rank != want:
@@ -1505,9 +1762,20 @@ class Server:
             # reclaimed and re-matched this unit, so this compensation is
             # stale — honoring it would steal a live rank's reservation
             return
+        self._relay_inflight.pop(m.seqno, None)
         self.wq.unpin(m.seqno)
         self.leases.release(m.seqno)
         self._match_rq()
+
+    def _on_delivered(self, m: Msg) -> None:
+        """Remote fused fetch confirmation: the home server forwarded our
+        payload-carrying RFR response to the requester, so the pinned
+        unit is now consumed (the delivery IS the fetch)."""
+        self._relay_inflight.pop(m.seqno, None)
+        unit = self.wq.get(m.seqno)
+        if unit is None or not unit.pinned or unit.pin_rank != m.for_rank:
+            return  # already resolved (reclaim re-match / stale confirm)
+        self._consume(unit)
 
     # ------------------------------------------------------- push (memory)
 
@@ -1765,6 +2033,10 @@ class Server:
                 e.world_rank,
                 e.rqseqno,
                 None if e.req_types is None else sorted(e.req_types),
+                # 4th element: fused reserve? drives remote fused fetch
+                # on the plan path (3-tuples from native planes read as
+                # False — handle delivery, as before)
+                bool(e.fetch),
             )
             for e in self.rq.entries()
             if e.world_rank not in self._rfr_out
@@ -2004,27 +2276,9 @@ class Server:
         unit = self.wq.get(m.seqno)
         if unit is None or unit.pinned or unit.target_rank >= 0:
             return  # stale plan entry; next round will re-plan
-        self._pin(unit.seqno, m.for_rank)
-        self.activity += 1
-        self._exhaust_held_since = None
-        self.ep.send(
-            m.req_home,
-            msg(
-                Tag.SS_RFR_RESP,
-                self.rank,
-                found=True,
-                for_rank=m.for_rank,
-                rqseqno=m.rqseqno,
-                seqno=unit.seqno,
-                work_type=unit.work_type,
-                prio=unit.prio,
-                target_rank=unit.target_rank,
-                work_len=unit.work_len,
-                answer_rank=unit.answer_rank,
-                common_len=unit.common_len,
-                common_server=unit.common_server_rank,
-                common_seqno=unit.common_seqno,
-            ),
+        self._rfr_found_resp(
+            m.req_home, m.for_rank, m.rqseqno, unit,
+            fetch=bool(m.data.get("fetch", False)),
         )
 
     def _on_plan_migrate(self, m: Msg) -> None:
@@ -2139,9 +2393,13 @@ class Server:
     # ------------------------------------------------------- termination
 
     def _flush_rq(self, rc: int) -> None:
+        # every parked entry — including each slot of a prefetch
+        # pipeline — gets its own termination response, so a streaming
+        # client can account all its in-flight reserves and drain
         for entry in self.rq.entries():
-            self.rq.remove(entry.world_rank)
-            self._reserve_resp(entry.world_rank, rc)
+            self.rq.remove_entry(entry)
+            self._reserve_resp(entry.world_rank, rc, rqseqno=entry.rqseqno)
+        self._stream_idle.clear()
 
     def _on_fa_no_more_work(self, m: Msg) -> None:
         if self.no_more_work:
@@ -2166,9 +2424,16 @@ class Server:
     def _all_local_apps_parked(self) -> bool:
         """True when no active local app is off the rq — vacuously true for a
         server with no (remaining) local apps, so worlds where some server
-        homes zero apps can still exhaust."""
+        homes zero apps can still exhaust. A rank whose only parked entries
+        are prefetch slots (get_work_stream) counts as parked only once it
+        reported FA_STREAM_IDLE: until then the app may be computing a
+        banked unit whose descendants could still be put."""
         active = self.local_apps - self._finalized
-        return all(r in self.rq for r in active)
+        return all(
+            r in self.rq
+            and (self.rq.has_blocking(r) or r in self._stream_idle)
+            for r in active
+        )
 
     def _exhaust_vote(self, parked: Optional[list] = None) -> bool:
         """This server's contribution to the exhaustion ring pass.
@@ -2308,6 +2573,11 @@ class Server:
 
     def _on_local_app_done(self, m: Msg) -> None:
         self._finalized.add(m.src)
+        # a finalizing rank can never consume again: any leftover parked
+        # entries (an abandoned stream's prefetch slots) must not attract
+        # deliveries that would then be consumed into a closed endpoint
+        self.rq.remove_rank(m.src)
+        self._stream_idle.discard(m.src)
         self._maybe_complete_finalize()
 
     def _maybe_complete_finalize(self) -> None:
@@ -2450,8 +2720,22 @@ class Server:
         self._dead_ranks.add(rank)
         self._m_rank_dead.inc()
         self.flight.record(f"rank_dead rank={rank} declared_by={m.src}")
-        # 1) the dead requester's park/steal state
-        self.rq.remove(rank)
+        # 1) the dead requester's park/steal state (every entry — a
+        # streaming rank may hold several prefetch slots). Flag the rank
+        # unconditionally: if it was streaming, ANY of its in-flight
+        # slots may now be phantom — including ones whose entries were
+        # already matched but whose responses died with the connection
+        # (remove_rank returns [] then) — and a resurrected stream's
+        # next idle note re-arms them (see _on_stream_idle). For a
+        # non-streaming rank the flag is inert (it never sends idle).
+        self.rq.remove_rank(rank)
+        self._swept_streams.add(rank)
+        # reset the request-id window: the swept-stream re-arm reads
+        # "claimed id not in the window" as "request or response died
+        # with the connection" — ids must only accumulate again from
+        # post-death (post-resurrection) traffic
+        self._seen_rqseqnos.pop(rank, None)
+        self._stream_idle.discard(rank)
         self._rfr_out.discard(rank)
         self._rfr_excluded.pop(rank, None)
         self._park_res_local.pop(rank, None)
@@ -2461,6 +2745,29 @@ class Server:
             self.leases.release(lease.seqno)
             unit = self.wq.get(lease.seqno)
             if unit is not None and unit.pinned and unit.pin_rank == rank:
+                if self._relay_inflight.get(lease.seqno) == rank:
+                    # remote fused fetch in flight to the dead rank: the
+                    # payload may already have LANDED there (the home
+                    # forwards before confirming), so re-enqueueing could
+                    # run the unit twice if the EOF was churn and the
+                    # rank resurrects. At-most-once delivery wins: treat
+                    # it as delivered-at-death and drop it — the same
+                    # outcome as a unit fetched via GET_RESERVED just
+                    # before the owner died. NO common forfeit here: the
+                    # dead client may already have accounted its prefix
+                    # share (it fetches at decode time, before death was
+                    # observed), and an over-forfeit would GC the prefix
+                    # under a live member — the bounded-leak direction
+                    # (prefix outlives the batch if the client never
+                    # accounted) is the acceptable one, as everywhere
+                    # else in the common accounting.
+                    self._relay_inflight.pop(lease.seqno, None)
+                    self._consume(unit)
+                    self.flight.record(
+                        f"relay_consumed_on_death seqno={lease.seqno} "
+                        f"rank={rank}"
+                    )
+                    continue
                 self.wq.unpin(lease.seqno)
                 if unit.common_seqno >= 0:
                     # the dead owner may have fetched the batch-common
@@ -2551,6 +2858,18 @@ class Server:
             self.cq.forfeit(common_seqno)
 
     def _on_common_forfeit(self, m: Msg) -> None:
+        fid = m.data.get("get_id")
+        if fid is not None:
+            # client cache-hit accounting notes carry an id: a note
+            # re-sent across connection churn must not be applied twice
+            # (an over-forfeit would GC the prefix one get early, under
+            # a live member). A windowed seen-set like the reserve
+            # dedup — a re-send on a new connection can be processed
+            # before an older note still queued from the old one, so a
+            # last-id equality check is not enough. Server-to-server
+            # fixups carry no id.
+            if self._window_seen(self._seen_forfeits, m.src, fid):
+                return
         op = m.data.get("op", "forfeit")
         if isinstance(op, bytes):  # binary-codec peers carry it as bytes
             op = op.decode()

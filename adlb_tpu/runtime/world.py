@@ -92,6 +92,13 @@ class Config:
     # k-th hop sees k-hop-stale state. Use "ring" + 0.1 s to reproduce
     # upstream's behavior as a baseline.
     qmstat_mode: str = "broadcast"
+    # steal/broadcast mode only: when an untargeted put makes a type's
+    # advertised inventory go empty->nonempty, broadcast a fresh qmstat
+    # immediately (rate-limited to one event broadcast per this many
+    # seconds) instead of waiting out the periodic tick — the trickle
+    # dispatch-latency fix. 0 disables the event path. Ring mode stays
+    # upstream-faithful (interval-only) regardless.
+    qmstat_event_gap: float = 0.005
     balancer_interval: float = 0.02  # TPU-mode snapshot->solve->plan period
     # min gap between event-driven solves (a park triggers an immediate
     # snapshot+solve; this bounds solve rate under churn)
@@ -118,6 +125,13 @@ class Config:
     # that the client backs off and re-sends instead of dying on the
     # first OSError. 0 = fail fast (pre-reclaim behaviour).
     reconnect_attempts: int = 4
+    # client-side batch-common prefix cache (LRU over (common_server,
+    # common_seqno) -> bytes): members of a batch inline only their
+    # suffix and the prefix is fetched once per client instead of once
+    # per unit; cache hits send an SS_COMMON_FORFEIT accounting note so
+    # server refcounts (and prefix GC) stay exact. 0 disables caching
+    # (every prefixed unit pays the fetch, as the reference does).
+    prefix_cache_bytes: int = 16 << 20
     # worker (app rank) failure policy: "abort" preserves the reference's
     # rank-death-kills-job semantics (MPI_Abort paths, src/adlb.c:2508-2526);
     # "reclaim" survives it — the home server fans out SS_RANK_DEAD, every
@@ -222,6 +236,10 @@ class Config:
             raise ValueError("put_retry_cap must be >= put_retry_sleep")
         if self.reconnect_attempts < 0:
             raise ValueError("reconnect_attempts must be >= 0")
+        if self.prefix_cache_bytes < 0:
+            raise ValueError("prefix_cache_bytes must be >= 0")
+        if self.qmstat_event_gap < 0:
+            raise ValueError("qmstat_event_gap must be >= 0")
         if self.ops_port is not None and not (0 <= self.ops_port <= 65535):
             raise ValueError("ops_port must be None or in 0..65535")
         # snapshot lists are flattened into binary-codec list fields whose

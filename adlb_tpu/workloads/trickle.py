@@ -49,7 +49,21 @@ def run(
     nservers: int = 4,
     cfg: Optional[Config] = None,
     timeout: float = 300.0,
+    consumer: str = "classic",
+    stream_depth: int = 4,
 ) -> TrickleResult:
+    """``consumer`` picks the consumer loop shape:
+
+    * ``"classic"`` — the reference's two-call Reserve + Get_reserved loop
+      (the continuity baseline);
+    * ``"fused"`` — blocking ``get_work`` (one client-visible round trip
+      per unit since the remote fused fetch);
+    * ``"stream"`` — the pipelined ``get_work_stream(depth=stream_depth)``
+      consumer: reserves stay parked across the compute, so a trickling
+      unit never waits out a re-park round trip.
+    """
+    if consumer not in ("classic", "fused", "stream"):
+        raise ValueError(f"unknown consumer {consumer!r}")
     base = cfg or Config()
     cfg = dataclasses.replace(
         base,
@@ -79,11 +93,25 @@ def run(
         lats = []
         t0 = time.monotonic()
         t_last = t0
+        if consumer == "stream":
+            with ctx.get_work_stream([TOKEN], depth=stream_depth) as ws:
+                for w in ws:
+                    (t_put,) = struct.unpack("<d", w.payload)
+                    lats.append(time.monotonic() - t_put)
+                    time.sleep(work_time)
+                    t_last = time.monotonic()
+            return (lats, t0, t_last)
         while True:
-            rc, r = ctx.reserve([TOKEN])
-            if rc != ADLB_SUCCESS:
-                return (lats, t0, t_last)
-            rc, buf = ctx.get_reserved(r.handle)
+            if consumer == "fused":
+                rc, w = ctx.get_work([TOKEN])
+                if rc != ADLB_SUCCESS:
+                    return (lats, t0, t_last)
+                buf = w.payload
+            else:
+                rc, r = ctx.reserve([TOKEN])
+                if rc != ADLB_SUCCESS:
+                    return (lats, t0, t_last)
+                rc, buf = ctx.get_reserved(r.handle)
             (t_put,) = struct.unpack("<d", buf)
             lats.append(time.monotonic() - t_put)
             time.sleep(work_time)

@@ -1,0 +1,70 @@
+/* Batch-common + fused fetch round trip for the native client: since
+ * the remote-fused-fetch change the Python server inlines a prefixed
+ * unit's SUFFIX plus the prefix handle in the reservation response, and
+ * the C client must assemble prefix + suffix itself (libadlb.cpp
+ * fetch_common_prefix).
+ *
+ * Rank 0 stores a shared prefix and NJOBS numbered members; everyone
+ * drains with ADLB_Get_work and validates prefix + payload per unit.
+ * Exit 0 = every consumed unit carried the intact prefix.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <adlb/adlb.h>
+
+#define WORK 1
+#define NJOBS 24
+#define PREFIX "PFX-HEADER:"
+
+int main(void) {
+  int types[1] = {WORK};
+  int am_server = -1, am_debug = -1, num_apps = 0;
+  const char *ns = getenv("ADLB_NUM_SERVERS");
+  if (!ns) {
+    fprintf(stderr, "%s: ADLB_NUM_SERVERS not set\n", __FILE__);
+    return 2;
+  }
+  int rc = ADLB_Init(atoi(ns), 0, 0, 1, types, &am_server, &am_debug,
+                     &num_apps);
+  if (rc != ADLB_SUCCESS || am_server || am_debug) return 2;
+  int me = ADLB_World_rank();
+  const int plen = (int)strlen(PREFIX);
+
+  if (me == 0) {
+    rc = ADLB_Begin_batch_put((void *)PREFIX, plen);
+    if (rc != ADLB_SUCCESS) return 3;
+    for (int i = 1; i <= NJOBS; i++) {
+      rc = ADLB_Put(&i, sizeof i, -1, -1, WORK, 0);
+      if (rc != ADLB_SUCCESS) return 3;
+    }
+    rc = ADLB_End_batch_put();
+    if (rc != ADLB_SUCCESS) return 3;
+  }
+
+  long sum = 0;
+  int n = 0;
+  for (;;) {
+    int req[2] = {WORK, ADLB_RESERVE_EOL};
+    char buf[64];
+    int wt, wp, wl, ar;
+    rc = ADLB_Get_work(req, &wt, &wp, buf, sizeof buf, &wl, &ar);
+    if (rc != ADLB_SUCCESS) break; /* exhaustion */
+    if (wl != plen + (int)sizeof(int)) {
+      fprintf(stderr, "rank %d: bad work_len %d\n", me, wl);
+      return 5;
+    }
+    if (memcmp(buf, PREFIX, (size_t)plen) != 0) {
+      fprintf(stderr, "rank %d: prefix missing/corrupt\n", me);
+      return 6;
+    }
+    int v;
+    memcpy(&v, buf + plen, sizeof v);
+    sum += v;
+    n++;
+  }
+  printf("OK processed=%d sum=%ld\n", n, sum);
+  ADLB_Finalize();
+  return 0;
+}

@@ -71,6 +71,31 @@ def test_capi_fastpaths(server_impl):
     assert any_multi > 0  # the producer runs ahead: batches must form
 
 
+def test_capi_prefix_fuse():
+    """Batch-common + ADLB_Get_work against Python servers: fused
+    responses carry only the SUFFIX plus the prefix handle since the
+    remote-fused-fetch change, and the native client must fetch the
+    prefix and assemble (libadlb.cpp fetch_common_prefix) — the
+    codec/libadlb sync check for the new response shape."""
+    exe = build_example(os.path.join(_EXAMPLES, "prefix_fuse_c.c"))
+    results, _ = run_native_world(
+        n_clients=3,
+        nservers=2,
+        types=[1],
+        exe=exe,
+        cfg=Config(exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    total_n, total_sum = 0, 0
+    for rc, out, err in results:
+        assert rc == 0, f"exit {rc}\nstdout:{out}\nstderr:{err}"
+        assert "OK" in out
+        total_n += int(out.split("processed=")[1].split()[0])
+        total_sum += int(out.split("sum=")[1].split()[0])
+    assert total_n == 24
+    assert total_sum == sum(range(1, 25))
+
+
 @pytest.mark.parametrize("server_impl", ["python", "native"])
 def test_capi_app_messaging(server_impl):
     """The c1.c pattern in C: answers as direct app-to-app messages

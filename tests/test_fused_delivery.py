@@ -1,0 +1,555 @@
+"""Remote fused fetch + client prefix cache + get_work_stream.
+
+Three layers of coverage:
+
+* end-to-end conservation on BOTH fabrics (in-proc threads, TCP
+  processes) with the client-side metrics proving the round trips are
+  gone (no FA_GET_RESERVED on the RFR path, one FA_GET_COMMON per
+  prefix per client);
+* the race lattice driven directly against a Server instance with a
+  recording endpoint (UNRESERVE crossing a payload-carrying RFR
+  response, SS_DELIVERED after the pin moved, rank death with a relay
+  in flight, duplicate reserve frames across reconnect);
+* prefix-cache refcount exactness after forfeit notifications.
+"""
+
+import struct
+import threading
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.messages import Tag, msg
+from adlb_tpu.runtime.queues import RqEntry
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import (
+    ADLB_DONE_BY_EXHAUSTION,
+    ADLB_NO_MORE_WORK,
+    ADLB_SUCCESS,
+)
+
+T = 1
+
+
+def _spawn_retry(*args, **kw):
+    """spawn_world with one retry: process worlds on this class of host
+    occasionally wedge at startup for reasons unrelated to the protocol
+    (the seed tree reproduces the same rate) — one retry keeps a known
+    environmental flake from failing a correctness assertion."""
+    try:
+        return spawn_world(*args, **kw)
+    except RuntimeError:
+        return spawn_world(*args, **kw)
+
+
+# --------------------------------------------------------------- end-to-end
+
+
+def _remote_consumer(ctx):
+    """Producer home-routes its puts; every other rank consumes via the
+    fused get_work and reports its GET_RESERVED send count."""
+    if ctx.rank == 0:
+        for i in range(40):
+            ctx.put(struct.pack("<q", i), T)
+    got = []
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got, ctx._c.metrics.value("tx_msgs", tag="FA_GET_RESERVED")
+        got.append(struct.unpack("<q", w.payload)[0])
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_remote_fused_fetch_no_get_leg(mode):
+    """Cross-server delivery (work pre-positioned at the producer's home
+    server, consumers homed elsewhere) completes with ZERO client
+    GET_RESERVED round trips in both balancer modes."""
+    cfg = Config(balancer=mode, put_routing="home",
+                 exhaust_check_interval=0.2)
+    res = run_world(4, 2, [T], _remote_consumer, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v[0])
+    assert got == list(range(40))
+    assert all(v[1] == 0 for v in res.app_results.values()), {
+        r: v[1] for r, v in res.app_results.items()
+    }
+
+
+def test_remote_fused_fetch_tcp():
+    """Same contract over the TCP fabric (real processes)."""
+    cfg = Config(balancer="steal", put_routing="home",
+                 exhaust_check_interval=0.2)
+    res = _spawn_retry(4, 2, [T], _remote_consumer, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v[0])
+    assert got == list(range(40))
+    assert all(v[1] == 0 for v in res.app_results.values())
+
+
+def _prefix_consumer(ctx):
+    if ctx.rank == 0:
+        ctx.begin_batch_put(b"PREFIX:")
+        for i in range(24):
+            ctx.put(struct.pack("<q", i), T)
+        ctx.end_batch_put()
+    got = []
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            m = ctx._c.metrics
+            return (got, m.value("tx_msgs", tag="FA_GET_COMMON"),
+                    m.value("prefix_cache_hits"))
+        assert w.payload.startswith(b"PREFIX:")
+        got.append(struct.unpack("<q", w.payload[7:])[0])
+
+
+def test_prefix_cache_one_fetch_per_client():
+    """Batch-common units fuse as suffix + prefix handle: each client
+    fetches the prefix at most once; every further member is served from
+    the LRU with a forfeit accounting note (hits + the one miss account
+    every consumed member, so the server's refcount stays exact)."""
+    res = run_world(3, 2, [T], _prefix_consumer,
+                    cfg=Config(exhaust_check_interval=0.2), timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v[0])
+    assert got == list(range(24))
+    for rank, (units, gets, hits) in res.app_results.items():
+        assert gets <= 1, (rank, gets)
+        assert not units or gets + hits == len(units), (rank, gets, hits)
+
+
+def test_prefix_cache_disabled_falls_back():
+    """prefix_cache_bytes=0: every member pays the fetch (reference
+    behaviour), and conservation still holds."""
+    res = run_world(3, 2, [T], _prefix_consumer,
+                    cfg=Config(exhaust_check_interval=0.2,
+                               prefix_cache_bytes=0), timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v[0])
+    assert got == list(range(24))
+    for rank, (units, gets, hits) in res.app_results.items():
+        assert hits == 0
+        assert gets == len(units), (rank, gets, len(units))
+
+
+# ----------------------------------------------------------- stream worlds
+
+
+def _stream_consumer(ctx):
+    if ctx.rank == 0:
+        for i in range(60):
+            ctx.iput(struct.pack("<q", i), T)
+        ctx.flush_puts()
+    got = []
+    with ctx.get_work_stream([T], depth=4) as ws:
+        for w in ws:
+            got.append(struct.unpack("<q", w.payload)[0])
+        rc = ws.rc
+    assert rc in (ADLB_NO_MORE_WORK, ADLB_DONE_BY_EXHAUSTION), rc
+    return got
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_stream_drain_at_exhaustion(mode):
+    """get_work_stream consumes everything exactly once and every slot
+    drains cleanly when the world exhausts, in both balancer modes (the
+    producer mixes iput into the same endpoint, exercising the passive
+    routing of stream deliveries)."""
+    cfg = Config(balancer=mode, exhaust_check_interval=0.2)
+    res = run_world(4, 2, [T], _stream_consumer, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(60))
+
+
+@pytest.mark.slow
+def test_stream_drain_tcp():
+    """TCP-fabric stream drain. Marked slow: process worlds on this
+    class of single-core host wedge at startup under load at a rate the
+    seed tree reproduces (no protocol involvement) — the in-proc drain
+    tests above carry the tier-1 signal; CI's fault-matrix job runs the
+    full file."""
+    res = _spawn_retry(4, 2, [T], _stream_consumer,
+                      cfg=Config(exhaust_check_interval=0.2), timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(60))
+
+
+def _stream_early_close(ctx):
+    if ctx.rank == 0:
+        for i in range(30):
+            ctx.put(struct.pack("<q", i), T)
+    got = []
+    ws = ctx.get_work_stream([T], depth=3)
+    for w in ws:
+        got.append(struct.unpack("<q", w.payload)[0])
+        if ctx.rank == 1 and len(got) >= 2:
+            ws.close()  # abandon mid-stream: banked units must re-pool
+            break
+    return got
+
+
+def test_stream_early_close_repools():
+    """A consumer abandoning its stream hands banked work back (re-put /
+    unreserve), so the world still conserves every unit."""
+    res = run_world(3, 2, [T], _stream_early_close,
+                    cfg=Config(exhaust_check_interval=0.2), timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(30))
+
+
+def test_stream_with_prefixed_units():
+    """Streamed batch-common units assemble through the prefix cache."""
+    def app(ctx):
+        if ctx.rank == 0:
+            ctx.begin_batch_put(b"HD:")
+            for i in range(16):
+                ctx.put(struct.pack("<q", i), T)
+            ctx.end_batch_put()
+        got = []
+        with ctx.get_work_stream([T], depth=3) as ws:
+            for w in ws:
+                assert w.payload.startswith(b"HD:")
+                got.append(struct.unpack("<q", w.payload[3:])[0])
+        return got
+
+    res = run_world(3, 2, [T], app, cfg=Config(exhaust_check_interval=0.2),
+                    timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(16))
+
+
+@pytest.mark.slow
+def test_stream_survives_worker_death_reclaim():
+    """Prefetch + worker-death reclaim together (the CI fault-matrix
+    world): a consumer killed mid-stream is absorbed; survivors drain
+    and no unit is consumed twice. The killed rank may take delivered
+    (at-most-once) units with it, so the check is duplicates + world
+    completion, not exact conservation."""
+    fault_spec = {"seed": 7, "ranks": [2], "kill_at_frame": {2: 12}}
+    cfg = Config(balancer="steal", exhaust_check_interval=0.2,
+                 on_worker_failure="reclaim", fault_spec=fault_spec)
+    res = _spawn_retry(4, 2, [T], _stream_consumer, cfg=cfg, timeout=120.0)
+    got = sorted(x for v in res.app_results.values() for x in (v or []))
+    assert len(got) == len(set(got)), "unit consumed twice"
+    assert set(got) <= set(range(60))
+    # the producer survived, so at least its locally-matched units flowed
+    assert got, "no survivor consumed anything"
+
+
+def test_stream_conserves_under_duplicate_frames():
+    """Duplicate frames (re-sends across reconnect) must not double-pin
+    or double-deliver: the monotone rqseqno dedup absorbs them."""
+    fault_spec = {"seed": 11, "duplicate": 0.2, "ranks": [0, 1, 2, 3]}
+    cfg = Config(balancer="steal", exhaust_check_interval=0.2,
+                 on_worker_failure="reclaim", fault_spec=fault_spec)
+    res = run_world(4, 2, [T], _stream_consumer, cfg=cfg, timeout=90.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(60))
+
+
+# ------------------------------------------------- direct race-lattice
+
+
+class _RecEp:
+    """Recording endpoint: send() appends, recv() never delivers."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.sent = []
+
+    def send(self, dest, m):
+        self.sent.append((dest, m))
+
+    def recv(self, timeout=None):
+        return None
+
+    def of(self, tag):
+        return [(d, m) for d, m in self.sent if m.tag is tag]
+
+
+def _mk_server(rank=2, nranks=4, nservers=2, **cfg_kw):
+    world = WorldSpec(nranks=nranks, nservers=nservers, types=(T,))
+    cfg = Config(balancer="steal", native_queues="off", **cfg_kw)
+    ep = _RecEp(rank)
+    return Server(world, cfg, ep), ep
+
+
+def _put(server, seqno_payload, src=0, target=-1):
+    server._handle(msg(Tag.FA_PUT, src, payload=seqno_payload, work_type=T,
+                       prio=0, target_rank=target, answer_rank=-1,
+                       common_len=0, common_server=-1, common_seqno=-1,
+                       put_id=None))
+
+
+def test_rfr_fetch_pins_and_ships_payload():
+    """A fetch-flagged RFR answers with the payload riding the RFR_RESP
+    while the unit stays PINNED (lease intact) until SS_DELIVERED."""
+    holder, ep = _mk_server(rank=2)
+    _put(holder, b"unit0")
+    holder._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=5, req_types=[T],
+                       targeted_lookup=False, lookup_type=-1, fetch=1))
+    (dest, resp), = ep.of(Tag.SS_RFR_RESP)
+    assert dest == 3 and resp.found and resp.payload == b"unit0"
+    unit = holder.wq.get(resp.seqno)
+    assert unit is not None and unit.pinned and unit.pin_rank == 1
+    assert holder.leases.get(resp.seqno) is not None
+    assert holder._relay_inflight[resp.seqno] == 1
+    # confirmation consumes it
+    holder._handle(msg(Tag.SS_DELIVERED, 3, seqno=resp.seqno, for_rank=1))
+    assert holder.wq.get(resp.seqno) is None
+    assert holder.leases.get(resp.seqno) is None
+    assert not holder._relay_inflight
+
+
+def test_unreserve_race_unpins_relay():
+    """UNRESERVE crossing a payload-carrying RFR_RESP (the requester got
+    satisfied locally meanwhile): the holder unpins and the unit
+    re-matches; a LATE SS_DELIVERED for the old pin is ignored."""
+    holder, ep = _mk_server(rank=2)
+    _put(holder, b"unit0")
+    holder._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=5, req_types=[T],
+                       targeted_lookup=False, lookup_type=-1, fetch=1))
+    (_, resp), = ep.of(Tag.SS_RFR_RESP)
+    holder._handle(msg(Tag.SS_UNRESERVE, 3, seqno=resp.seqno, for_rank=1))
+    unit = holder.wq.get(resp.seqno)
+    assert unit is not None and not unit.pinned
+    assert not holder._relay_inflight
+    # late stale confirm: unit is unpinned -> must NOT consume
+    holder._handle(msg(Tag.SS_DELIVERED, 3, seqno=resp.seqno, for_rank=1))
+    assert holder.wq.get(resp.seqno) is not None
+
+
+def test_home_compensates_when_entry_is_stale():
+    """Home side: a payload-carrying RFR_RESP for an entry that no longer
+    matches (satisfied + re-parked with a new rqseqno) sends UNRESERVE and
+    does NOT forward a second reservation response."""
+    home, ep = _mk_server(rank=2)
+    home.rq.add(RqEntry(world_rank=0, rqseqno=9, req_types=frozenset([T]),
+                        fetch=True))
+    home._handle(msg(Tag.SS_RFR_RESP, 3, found=True, for_rank=0, rqseqno=8,
+                     seqno=77, work_type=T, prio=0, target_rank=-1,
+                     work_len=5, answer_rank=-1, common_len=0,
+                     common_server=-1, common_seqno=-1, payload=b"stale",
+                     time_on_q=0.0))
+    assert ep.of(Tag.SS_UNRESERVE)
+    assert not ep.of(Tag.TA_RESERVE_RESP)
+    assert 0 in home.rq  # the live entry is untouched
+
+
+def test_home_forwards_fused_and_confirms():
+    home, ep = _mk_server(rank=2)
+    home.rq.add(RqEntry(world_rank=0, rqseqno=9, req_types=frozenset([T]),
+                        fetch=True))
+    home._rfr_out.add(0)
+    home._handle(msg(Tag.SS_RFR_RESP, 3, found=True, for_rank=0, rqseqno=9,
+                     seqno=77, work_type=T, prio=0, target_rank=-1,
+                     work_len=5, answer_rank=-1, common_len=0,
+                     common_server=-1, common_seqno=-1, payload=b"fused",
+                     time_on_q=0.0))
+    (dest, r), = ep.of(Tag.TA_RESERVE_RESP)
+    assert dest == 0 and r.rc == ADLB_SUCCESS and r.payload == b"fused"
+    (dest, d), = ep.of(Tag.SS_DELIVERED)
+    assert dest == 3 and d.seqno == 77 and d.for_rank == 0
+    assert 0 not in home.rq
+
+
+def test_rank_death_consumes_relay_inflight():
+    """Requester dies with a remote fused delivery in flight: the holder
+    treats the unit as delivered (at-most-once — the payload may already
+    have landed) instead of re-enqueueing it."""
+    holder, ep = _mk_server(rank=2, on_worker_failure="reclaim")
+    _put(holder, b"unit0")
+    holder._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=5, req_types=[T],
+                       targeted_lookup=False, lookup_type=-1, fetch=1))
+    (_, resp), = ep.of(Tag.SS_RFR_RESP)
+    holder._handle(msg(Tag.SS_RANK_DEAD, 3, rank=1))
+    assert holder.wq.get(resp.seqno) is None  # consumed, not re-queued
+    assert not holder._relay_inflight
+    assert holder.mem.curr == 0
+
+
+def test_rank_death_reclaims_plain_pins():
+    """Contrast: a classic (non-relay) pin owned by the dead rank IS
+    re-enqueued — the PR-2 reclaim path is untouched."""
+    holder, ep = _mk_server(rank=2, on_worker_failure="reclaim")
+    _put(holder, b"unit0")
+    holder._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=5, req_types=[T],
+                       targeted_lookup=False, lookup_type=-1, fetch=0))
+    (_, resp), = ep.of(Tag.SS_RFR_RESP)
+    assert "payload" not in resp.data
+    holder._handle(msg(Tag.SS_RANK_DEAD, 3, rank=1))
+    unit = holder.wq.get(resp.seqno)
+    assert unit is not None and not unit.pinned
+
+
+def test_duplicate_reserve_frames_dropped():
+    """Windowed rqseqno dedup: a replayed frame never pins a second
+    unit, fresh rqseqnos (pipeline slots) all park — and an OLDER frame
+    that was never processed (cross-connection reorder after a
+    reconnect re-send) still parks rather than being mistaken for a
+    replay."""
+    server, ep = _mk_server(rank=2)
+    for rq_id in (1, 2, 2, 1, 3):
+        server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=rq_id, req_types=[T],
+                           hang=True, fetch=True, prefetch=True))
+    assert server.rq.count_for(0) == 3  # rqseqnos 1, 2, 3 each once
+    # reorder: rank 1's re-sent frame 2 overtakes its in-flight frame 1
+    for rq_id in (2, 1):
+        server._handle(msg(Tag.FA_RESERVE, 1, rqseqno=rq_id, req_types=[T],
+                           hang=True, fetch=True, prefetch=True))
+    assert server.rq.count_for(1) == 2  # both were genuinely unprocessed
+
+
+def test_stream_idle_note_voided_by_crossing_delivery():
+    """An FA_STREAM_IDLE whose in-flight count disagrees with the parked
+    entry count (a delivery crossed it on the wire) must NOT mark the
+    rank idle — the exhaustion vote would otherwise race the bank."""
+    server, ep = _mk_server(rank=2)
+    for rq_id in (1, 2):
+        server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=rq_id, req_types=[T],
+                           hang=True, fetch=True, prefetch=True))
+    server._handle(msg(Tag.FA_STREAM_IDLE, 0, slots=[1, 2, 3]))
+    assert 0 not in server._stream_idle  # crossed: {1,2} parked, 3 claimed
+    server._handle(msg(Tag.FA_STREAM_IDLE, 0, slots=[1, 2]))
+    assert 0 in server._stream_idle
+    assert server._all_local_apps_parked()
+    # a delivery clears the mark
+    _put(server, b"unit0")
+    assert 0 not in server._stream_idle
+    assert not server._all_local_apps_parked()
+
+
+def test_prefetch_parks_not_idle_block_exhaustion():
+    """A rank whose only parked entries are prefetch slots does NOT count
+    as parked until it reports idle (it may be computing a banked unit
+    whose descendants still need the pool open)."""
+    server, ep = _mk_server(rank=2)
+    server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=1, req_types=[T],
+                       hang=True, fetch=True, prefetch=True))
+    assert not server._all_local_apps_parked()
+    server._handle(msg(Tag.FA_STREAM_IDLE, 0, slots=[1]))
+    assert server._all_local_apps_parked()
+
+
+def test_common_refcount_exact_after_forfeits():
+    """One real get + (refcnt-1) forfeit notes GC the prefix exactly."""
+    server, ep = _mk_server(rank=2)
+    server._handle(msg(Tag.FA_PUT_COMMON, 0, payload=b"PFX"))
+    (_, r), = ep.of(Tag.TA_PUT_COMMON_RESP)
+    seqno = r.common_seqno
+    server._handle(msg(Tag.FA_BATCH_DONE, 0, common_seqno=seqno, refcnt=3))
+    assert len(server.cq) == 1
+    server._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=seqno, get_id=1))
+    server._handle(msg(Tag.SS_COMMON_FORFEIT, 1, common_seqno=seqno,
+                       op="forfeit"))
+    assert len(server.cq) == 1
+    server._handle(msg(Tag.SS_COMMON_FORFEIT, 2, common_seqno=seqno,
+                       op="forfeit"))
+    assert len(server.cq) == 0  # 1 get + 2 forfeits == refcnt 3 -> GC'd
+    assert server.mem.curr == 0
+
+
+def test_swept_stream_rearmed_on_idle():
+    """Reclaim churn: a rank declared dead has its prefetch entries swept
+    with no response; when it resurrects and reports idle, the server
+    answers the phantom in-flight slots with ADLB_RETRY so the stream
+    re-arms instead of hanging forever."""
+    server, ep = _mk_server(rank=2, on_worker_failure="reclaim")
+    for rq_id in (1, 2, 3):
+        server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=rq_id, req_types=[T],
+                           hang=True, fetch=True, prefetch=True))
+    server._handle(msg(Tag.SS_RANK_DEAD, 3, rank=0))
+    assert server.rq.count_for(0) == 0 and 0 in server._swept_streams
+    # the rank talks again (resurrection) and reports its stale view
+    server._handle(msg(Tag.FA_STREAM_IDLE, 0, slots=[1, 2, 3]))
+    from adlb_tpu.types import ADLB_RETRY
+    retries = [m for _, m in ep.of(Tag.TA_RESERVE_RESP)
+               if m.rc == ADLB_RETRY]
+    assert len(retries) == 3
+    assert sorted(m.rqseqno for _, m in ep.of(Tag.TA_RESERVE_RESP)
+                  if m.rc == ADLB_RETRY) == [1, 2, 3]
+    assert 0 not in server._stream_idle  # re-arms park first, then idle
+
+
+def _targeted_close(ctx):
+    if ctx.rank == 0:
+        for i in range(10):
+            ctx.put(struct.pack("<q", i), T, target_rank=1)
+        for i in range(10, 20):
+            ctx.put(struct.pack("<q", i), T)
+    got = []
+    ws = ctx.get_work_stream([T], depth=3)
+    for w in ws:
+        got.append(struct.unpack("<q", w.payload)[0])
+        if ctx.rank == 1 and len(got) >= 1:
+            ws.close()  # banked targeted units must re-pool TARGETED
+            break
+    if ctx.rank != 1:
+        return got
+    with ctx.get_work_stream([T], depth=3) as ws2:
+        for w in ws2:
+            got.append(struct.unpack("<q", w.payload)[0])
+    return got
+
+
+def test_stream_close_preserves_targeting():
+    """Fused responses carry target_rank, so a stream closing early
+    re-puts banked targeted units still targeted — no other rank may
+    ever run them."""
+    res = run_world(3, 2, [T], _targeted_close,
+                    cfg=Config(exhaust_check_interval=0.2), timeout=90.0)
+    per_rank = dict(res.app_results)
+    all_units = sorted(x for v in per_rank.values() for x in v)
+    assert all_units == list(range(20))
+    # units 0..9 were targeted at rank 1: nobody else may have run them
+    assert sorted(x for x in per_rank[1] if x < 10) == list(range(10))
+    assert all(x >= 10 for r in (0, 2) for x in per_rank[r])
+
+
+def test_stream_iterate_after_close_stops():
+    """Iterating past close() must raise StopIteration, not spin: the
+    cancel dropped the parked reserves unanswered, so inflight never
+    drains on its own."""
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(12):
+                ctx.put(struct.pack("<q", i), T)
+        got = []
+        ws = ctx.get_work_stream([T], depth=3)
+        for w in ws:  # NO break after close: the loop itself must end
+            got.append(struct.unpack("<q", w.payload)[0])
+            if ctx.rank == 1:
+                ws.close()
+        return got
+
+    res = run_world(3, 2, [T], app, cfg=Config(exhaust_check_interval=0.2),
+                    timeout=60.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == list(range(12))
+
+
+def test_swept_stream_rearmed_even_with_no_parked_entries():
+    """Rank death can catch a stream whose slots were all already
+    matched (responses lost with the connection): remove_rank returns
+    nothing, but the phantom re-arm must still fire on the resurrected
+    rank's idle note."""
+    server, ep = _mk_server(rank=2, on_worker_failure="reclaim")
+    server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=1, req_types=[T],
+                       hang=True, fetch=True, prefetch=True))
+    _put(server, b"unit0")  # satisfies the entry; response "lost"
+    assert server.rq.count_for(0) == 0
+    server._handle(msg(Tag.SS_RANK_DEAD, 3, rank=0))
+    server._handle(msg(Tag.FA_STREAM_IDLE, 0, slots=[1]))
+    from adlb_tpu.types import ADLB_RETRY
+    retries = [m for _, m in ep.of(Tag.TA_RESERVE_RESP)
+               if m.rc == ADLB_RETRY]
+    assert len(retries) == 1
+
+
+def test_stream_cancel_drops_prefetch_entries():
+    server, ep = _mk_server(rank=2)
+    server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=1, req_types=[T],
+                       hang=True, fetch=True, prefetch=True))
+    server._handle(msg(Tag.FA_RESERVE, 0, rqseqno=2, req_types=[T],
+                       hang=True, fetch=True, prefetch=True))
+    server._handle(msg(Tag.FA_STREAM_CANCEL, 0))
+    assert server.rq.count_for(0) == 0
+    assert ep.of(Tag.TA_STREAM_CANCEL_RESP)
