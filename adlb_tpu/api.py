@@ -169,6 +169,10 @@ class WorldResult:
     # Config(on_worker_failure="reclaim") — the world completed around
     # them, so they have no entry in app_results
     casualties: list[int] = dataclasses.field(default_factory=list)
+    # server ranks that died mid-run and were absorbed by
+    # Config(on_server_failure="failover"): their pool shard replayed at
+    # the ring-successor buddy, which also took over their app ranks
+    server_casualties: list[int] = dataclasses.field(default_factory=list)
 
     def save_trace(self, path: str) -> None:
         from adlb_tpu.runtime.trace import save_chrome_trace
@@ -257,6 +261,9 @@ def join_world(
             on_worker_failure=os.environ.get(
                 "ADLB_ON_WORKER_FAILURE", "abort"
             ),
+            on_server_failure=os.environ.get(
+                "ADLB_ON_SERVER_FAILURE", "abort"
+            ),
             fault_spec=fault_spec,
         )
     world = WorldSpec(
@@ -269,7 +276,7 @@ def join_world(
     if cfg.fault_spec:
         from adlb_tpu.runtime.faults import maybe_wrap
 
-        ep = maybe_wrap(ep, cfg)
+        ep = maybe_wrap(ep, cfg, world)
     return JoinedWorld(AdlbContext(Client(world, cfg, ep)), ep)
 
 
@@ -296,13 +303,15 @@ def run_world(
     trace_events: list[dict] = []
     errors: list[BaseException] = []
     casualties: list[int] = []
+    server_casualties: list[int] = []
     lock = threading.Lock()
 
     from adlb_tpu.runtime.faults import maybe_wrap
     from adlb_tpu.types import HomeServerLostError
 
     def app_main(rank: int) -> None:
-        client = Client(world, cfg, maybe_wrap(fabric.endpoint(rank), cfg),
+        client = Client(world, cfg,
+                        maybe_wrap(fabric.endpoint(rank), cfg, world),
                         fabric.abort_event)
         ctx = AdlbContext(client)
         try:
@@ -337,12 +346,19 @@ def run_world(
                     trace_events.extend(client.tracer.events)
 
     def server_main(rank: int) -> None:
-        server = Server(world, cfg, maybe_wrap(fabric.endpoint(rank), cfg),
+        server = Server(world, cfg,
+                        maybe_wrap(fabric.endpoint(rank), cfg, world),
                         fabric.abort_event)
         try:
             server.run()
             with lock:
-                server_stats[rank] = server.finalize_stats()
+                if server.died:
+                    # fault-injected server death absorbed by
+                    # on_server_failure="failover": the buddy took over;
+                    # this thread exits as the casualty, not an error
+                    server_casualties.append(rank)
+                else:
+                    server_stats[rank] = server.finalize_stats()
         except BaseException as e:  # noqa: BLE001
             with lock:
                 errors.append(e)
@@ -404,6 +420,7 @@ def run_world(
         trace_events=trace_events,
         debug_server=debug_servers[0] if debug_servers else None,
         casualties=sorted(casualties),
+        server_casualties=sorted(server_casualties),
     )
     if errors:
         raise errors[0]

@@ -198,6 +198,19 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                     ended.add(m.src)
                     snapshots.pop(m.src, None)
                     tracker.drop(m.src)
+                elif m.tag is Tag.SS_SERVER_DEAD:
+                    # defensive only: TODAY this never fires — the sidecar
+                    # plane drives NATIVE daemons, which Config rejects for
+                    # on_server_failure="failover", and the Python-plane
+                    # fan-out targets only world server ranks. Kept so a
+                    # future native failover protocol that does relay the
+                    # fan-out retires the dead server's snapshot/tracker
+                    # state (like a DS_END) instead of planning onto it.
+                    dead_srv = m.rank
+                    snapshots.pop(dead_srv, None)
+                    tracker.drop(dead_srv)
+                    ended.add(dead_srv)
+                    dirty = True
                 elif m.tag is Tag.SS_RANK_DEAD:
                     # a worker died under on_worker_failure="reclaim":
                     # retire its parked requests from every held snapshot

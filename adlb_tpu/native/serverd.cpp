@@ -1382,7 +1382,13 @@ class Server {
       body.append(meta.payload);
       n += 1;
     }
-    std::string out("ACK1");
+    // ACK2 header: format version + world shape (nranks/nservers) so a
+    // restore into a different shape fails loudly instead of silently
+    // misrouting targeted units (ACK1 stays read-compatible below)
+    std::string out("ACK2");
+    u32(out, 2u);
+    u32(out, uint32_t(w_.nranks));
+    u32(out, uint32_t(w_.nservers));
     u32(out, uint32_t(n));
     out += body;
     u32(out, uint32_t(cq_.size()));
@@ -1479,9 +1485,21 @@ class Server {
       return v;
     };
     need(4);
-    if (data.compare(0, 4, "ACK1") != 0)
+    bool v2 = data.compare(0, 4, "ACK2") == 0;
+    if (!v2 && data.compare(0, 4, "ACK1") != 0)
       die("bad shard magic in %s", path.c_str());
     off = 4;
+    if (v2) {
+      uint32_t ver = rd_u32(), nranks = rd_u32(), nservers = rd_u32();
+      if (ver > 2)
+        die("shard %s: format version %u is newer than this build (2)",
+            path.c_str(), ver);
+      if (nranks != 0 && (int(nranks) != w_.nranks ||
+                          int(nservers) != w_.nservers))
+        die("shard %s: checkpoint world shape nranks=%u/nservers=%u does "
+            "not match this world (%d/%d); restore with the same shape",
+            path.c_str(), nranks, nservers, w_.nranks, w_.nservers);
+    }
     uint32_t n = rd_u32();
     for (uint32_t i = 0; i < n; ++i) {
       int32_t wt = rd_i32(), tgt = rd_i32(), ans = rd_i32();

@@ -18,31 +18,49 @@ migrates INTO an already-checkpointed server while the token is still
 circulating is live in the world but absent from the checkpoint — take
 checkpoints at quiescent points (e.g. between phases) for exact capture.
 
-Shard format (little-endian): magic ``ACK1``, u32 unit count, per unit
+Shard format (little-endian): magic ``ACK2``, then a header ``<III``
+(format version, world nranks, world nservers), u32 unit count, per unit
 ``<iiiqqq`` (work_type, target_rank, answer_rank, prio as q, common_server,
 common_seqno) + u32 common_len + u32 payload_len + payload bytes; then u32
 common-entry count, per entry ``<qqq`` (seqno, refcnt, ngets) + u32 len +
 buf.
+
+Restores validate the header's world shape **loudly**: targeted units and
+batch-common references name ranks, so loading a shard into a different
+shape would silently misroute them. ``ACK1`` shards (pre-header, written
+by earlier builds and by older native daemons) still load — they carry no
+shape to check, so only the shard-set check in the server applies.
 """
 
 from __future__ import annotations
 
 import os
 import struct
-from typing import Iterable
+from typing import Iterable, Optional
 
-_MAGIC = b"ACK1"
+_MAGIC = b"ACK2"
+_MAGIC_V1 = b"ACK1"
+_VERSION = 2
+_SHAPE = struct.Struct("<III")  # version, nranks, nservers
 _UNIT = struct.Struct("<iiiqqq")
 _U32 = struct.Struct("<I")
 _CQE = struct.Struct("<qqq")
+
+
+class ShardShapeError(ValueError):
+    """Restore-time world shape (or format version) mismatch — failing
+    loudly beats silently misrouting every targeted unit."""
 
 
 def shard_path(prefix: str, server_rank: int) -> str:
     return f"{prefix}.{server_rank}.ckpt"
 
 
-def save_shard(prefix: str, server_rank: int, units: Iterable, cq) -> int:
-    """Write one server's shard; returns the number of units captured."""
+def save_shard(prefix: str, server_rank: int, units: Iterable, cq,
+               world=None) -> int:
+    """Write one server's shard; returns the number of units captured.
+    ``world`` (a WorldSpec, optional for bare callers) stamps the shape
+    header so a mismatched restore fails loudly."""
     n = 0
     body = []
     for u in units:
@@ -55,7 +73,9 @@ def save_shard(prefix: str, server_rank: int, units: Iterable, cq) -> int:
         body.append(u.payload)
         n += 1
     centries = list(cq.entries()) if cq is not None else []
-    out = [_MAGIC, _U32.pack(n)]
+    nranks = world.nranks if world is not None else 0
+    nservers = world.nservers if world is not None else 0
+    out = [_MAGIC, _SHAPE.pack(_VERSION, nranks, nservers), _U32.pack(n)]
     out.extend(body)
     out.append(_U32.pack(len(centries)))
     for e in centries:
@@ -82,11 +102,14 @@ def existing_shard_ranks(prefix: str) -> list[int]:
     return sorted(out)
 
 
-def load_shard(prefix: str, server_rank: int):
+def load_shard(prefix: str, server_rank: int, world=None):
     """Read one server's shard; returns (units, common_entries) where units
     are dicts of constructor fields (seqnos are assigned by the server) and
     common_entries are (seqno, refcnt, ngets, buf) tuples. Missing shard =
-    loud (a server with no queued work writes one anyway)."""
+    loud (a server with no queued work writes one anyway). With ``world``
+    given, an ACK2 header naming a different world shape raises
+    :class:`ShardShapeError` instead of silently misrouting targeted
+    units; ACK1 shards carry no shape and load as before."""
     path = shard_path(prefix, server_rank)
     if not os.path.exists(path):
         raise FileNotFoundError(
@@ -95,9 +118,27 @@ def load_shard(prefix: str, server_rank: int):
         )
     with open(path, "rb") as f:
         data = f.read()
-    if data[:4] != _MAGIC:
-        raise ValueError(f"{path}: bad shard magic")
+    magic = data[:4]
     off = 4
+    if magic == _MAGIC:
+        version, nranks, nservers = _SHAPE.unpack_from(data, off)
+        off += _SHAPE.size
+        if version > _VERSION:
+            raise ShardShapeError(
+                f"{path}: shard format version {version} is newer than this "
+                f"build understands ({_VERSION})"
+            )
+        if world is not None and nranks and (
+            nranks != world.nranks or nservers != world.nservers
+        ):
+            raise ShardShapeError(
+                f"{path}: checkpoint was taken with nranks={nranks}/"
+                f"nservers={nservers} but this world is "
+                f"nranks={world.nranks}/nservers={world.nservers}; restore "
+                f"with the same world shape"
+            )
+    elif magic != _MAGIC_V1:  # ACK1: no shape header to validate
+        raise ValueError(f"{path}: bad shard magic")
     (n,) = _U32.unpack_from(data, off)
     off += 4
     units = []

@@ -126,6 +126,28 @@ class Client:
         # reserve's _wait would race the stream's passive routing for
         # the same response tag
         self._active_stream: Optional[WorkStream] = None
+        # server-failover routing (Config(on_server_failure="failover")):
+        # dead server -> buddy, learned from epoch-stamped
+        # TA_HOME_TAKEOVER notes; every server-bound send resolves
+        # through it (stamping fo_from so content-addressed seqnos
+        # translate at the buddy). _lost_at tracks when a server's
+        # connection was observed gone, bounding how long a blocked wait
+        # holds out for the takeover note.
+        self._srv_route: dict[int, int] = {}
+        self._fo_epoch = 0
+        self._lost_at: dict[int, float] = {}
+        self._m_failovers = self.metrics.counter("home_takeovers")
+        # frames _await_takeover pulled off the endpoint that belong to
+        # an OUTER blocking wait (that wait can run nested inside _wait
+        # via _apply_takeover's re-sends): queued here and consumed by
+        # _recv before the endpoint, never dropped
+        self._redeliver: deque = deque()
+
+    def _recv(self, timeout):
+        """Endpoint recv that drains takeover-deferred frames first."""
+        if self._redeliver:
+            return self._redeliver.popleft()
+        return self.ep.recv(timeout=timeout)
 
     def _span(self, name: str, **args):
         """API-call trace span + user-state inference boundary."""
@@ -166,12 +188,27 @@ class Client:
         time.sleep(s)
         return s
 
+    def _route(self, dest: int) -> int:
+        """Resolve a server destination through the failover map (chains
+        of takeovers resolve to the final live buddy)."""
+        seen = set()
+        while dest in self._srv_route and dest not in seen:
+            seen.add(dest)
+            dest = self._srv_route[dest]
+        return dest
+
+    def _failover_policy(self) -> bool:
+        return self.cfg.on_server_failure == "failover"
+
     def _send_retry(self, dest: int, m: Msg) -> None:
         """Protocol send that survives peer-connection churn: the endpoint
         already retries the socket once; past that the client backs off
         and re-sends up to ``cfg.reconnect_attempts`` times instead of
-        dying on the first OSError. A home server that stays unreachable
-        is still terminal — there is nothing to fail over to."""
+        dying on the first OSError. Under ``on_server_failure="failover"``
+        a server destination additionally resolves through the takeover
+        map (stamped ``fo_from`` so the buddy translates content
+        addresses), and exhausted retries wait out one takeover window
+        before giving up; otherwise an unreachable peer is terminal."""
         attempts = self.cfg.reconnect_attempts
         if dest in getattr(self.ep, "binary_peers", ()):
             # native servers implement none of the duplicate-request
@@ -179,61 +216,161 @@ class Client:
             # re-send protocol relies on — fail fast rather than risk a
             # double-stored put or a double-consumed fetch
             attempts = 0
+        waited_takeover = False
         sleep = 0.0
-        for attempt in range(attempts + 1):
+        attempt = 0
+        while True:
+            routed = self._route(dest)
+            if routed != dest and self.world.is_server(dest):
+                m.data["fo_from"] = dest
             try:
-                self.ep.send(dest, m)
+                self.ep.send(routed, m)
                 return
             except OSError as e:
-                if attempt >= attempts:
-                    # any permanently unreachable protocol peer ends this
-                    # client — there is no request it can route around a
-                    # dead server — so both cases raise the conn-lost
-                    # error the harnesses classify (abort collateral /
-                    # casualty), never a bare OSError that would read as
-                    # an application bug
+                attempt += 1
+                if attempt > attempts:
+                    if (
+                        self._failover_policy()
+                        and self.world.is_server(routed)
+                        and not waited_takeover
+                        and self._await_takeover(routed)
+                    ):
+                        # buddy announced itself: restart the retry
+                        # budget toward the new destination
+                        waited_takeover = True
+                        attempt = 0
+                        continue
+                    # a permanently unreachable protocol peer ends this
+                    # client — raise the conn-lost error the harnesses
+                    # classify (abort collateral / casualty), never a
+                    # bare OSError that would read as an application bug
                     self.aborted = True
                     self.flight.record(
-                        f"peer {dest} unreachable after "
-                        f"{attempt + 1} send attempts: {e!r}"
+                        f"peer {routed} unreachable after "
+                        f"{attempt} send attempts: {e!r}"
                     )
                     self.flight.dump_json("home_server_lost")
                     raise HomeServerLostError(
-                        f"rank {self.rank}: protocol peer {dest} "
+                        f"rank {self.rank}: protocol peer {routed} "
                         f"unreachable ({e!r})"
                     ) from e
                 self._m_reconnects.inc()
                 self.flight.record(
-                    f"reconnect dest={dest} attempt={attempt + 1} ({e!r})"
+                    f"reconnect dest={routed} attempt={attempt} ({e!r})"
                 )
                 sleep = self._backoff_sleep(max(sleep, 0.01))
 
-    def _wait_put(self, put_id: int) -> Msg:
+    def _await_takeover(self, lost: int) -> bool:
+        """Block (reading only control frames; everything else stays in
+        the endpoint queue order via a bounded drain-and-redeliver) until
+        a TA_HOME_TAKEOVER covers ``lost`` or the failover window
+        expires. Returns True when the route changed."""
+        self._lost_at.setdefault(lost, time.monotonic())
+        deadline = (
+            self._lost_at[lost] + self.cfg.failover_client_wait
+        )
+        while time.monotonic() < deadline:
+            if self._abort_event is not None and self._abort_event.is_set():
+                self.aborted = True
+                raise AdlbAborted(-1)
+            if self._route(lost) != lost:
+                return True
+            m = self.ep.recv(timeout=0.2)
+            if m is None:
+                continue
+            if m.tag is Tag.TA_HOME_TAKEOVER:
+                self._apply_takeover(m)
+                continue
+            if m.tag is Tag.TA_ABORT:
+                self._dispatch_passive(m)  # raises AdlbAborted
+            if (
+                m.tag in (Tag.AM_APP, Tag.PEER_EOF)
+                or (m.tag is Tag.TA_PUT_RESP
+                    and m.data.get("put_id") in self._pending_puts)
+                or (m.tag is Tag.TA_RESERVE_RESP
+                    and self._active_stream is not None)
+            ):
+                # stash / settle / bank through the normal passive
+                # dispatch — these have a home regardless of context
+                try:
+                    self._dispatch_passive(m)
+                except AdlbError:
+                    # an unexpected-for-this-context frame must not turn
+                    # the takeover wait into a protocol error
+                    self.flight.record(
+                        f"frame {m.tag.name} deferred during takeover wait"
+                    )
+                continue
+            # anything else may be the very response an OUTER wait is
+            # parked on (this wait can run nested inside _wait via
+            # _apply_takeover's re-sends): dispatching here would DROP it
+            # as a stray and deadlock the outer wait against a healthy
+            # server — queue it for redelivery to the next _recv instead
+            self._redeliver.append(m)
+        return self._route(lost) != lost
+
+    def _check_failover_resend(self, sent_to, dest, m_req):
+        """While blocked on a response from ``sent_to``: a takeover that
+        remapped the destination re-sends the request to the buddy (same
+        ids — the replicated dedup windows and fo_from translation make
+        that safe); a destination lost past the failover window (or
+        under the abort policy) is terminal."""
+        if dest is None or not self._failover_policy():
+            return sent_to
+        routed = self._route(dest)
+        if routed != sent_to:
+            self.flight.record(
+                f"re-sending {m_req.tag.name} to {routed} after takeover"
+            )
+            self._send_retry(dest, m_req)  # resolves + stamps fo_from
+            return routed
+        lost = self._lost_at.get(sent_to)
+        if (
+            lost is not None
+            and time.monotonic() - lost > self.cfg.failover_client_wait
+        ):
+            self.aborted = True
+            self.flight.record(
+                f"server {sent_to} lost and no takeover within "
+                f"{self.cfg.failover_client_wait}s"
+            )
+            self.flight.dump_json("home_server_lost")
+            raise HomeServerLostError(
+                f"rank {self.rank}: server {sent_to} lost; no takeover"
+            )
+        return sent_to
+
+    def _wait_put(self, put_id: int, dest=None, m_req=None) -> Msg:
         """Wait for THIS put's response, matched by id: a frame re-sent
         after a send error can be acked twice, and the stale duplicate
         ack must not be mistaken for a later put's answer."""
+        sent_to = self._route(dest) if dest is not None else None
         while True:
             if self._abort_event is not None and self._abort_event.is_set():
                 self.aborted = True
                 self.flight.record("abort event observed waiting put resp")
                 self.flight.dump_json("abort_event")
                 raise AdlbAborted(-1)
-            m = self.ep.recv(timeout=0.5)
+            m = self._recv(timeout=0.5)
             if m is None:
+                sent_to = self._check_failover_resend(sent_to, dest, m_req)
                 continue
             if m.tag is Tag.TA_PUT_RESP and m.data.get("put_id") == put_id:
                 return m
             self._dispatch_passive(m, waiting=Tag.TA_PUT_RESP)
+            sent_to = self._check_failover_resend(sent_to, dest, m_req)
 
-    def _wait(self, want: Tag) -> Msg:
+    def _wait(self, want: Tag, dest=None, m_req=None) -> Msg:
+        sent_to = self._route(dest) if dest is not None else None
         while True:
             if self._abort_event is not None and self._abort_event.is_set():
                 self.aborted = True
                 self.flight.record(f"abort event observed waiting {want}")
                 self.flight.dump_json("abort_event")
                 raise AdlbAborted(-1)
-            m = self.ep.recv(timeout=0.5)
+            m = self._recv(timeout=0.5)
             if m is None:
+                sent_to = self._check_failover_resend(sent_to, dest, m_req)
                 continue
             if m.tag is want and not (
                 m.tag is Tag.TA_PUT_RESP
@@ -245,6 +382,7 @@ class Client:
             # A late RESERVE_RESP can cross a termination flush only if the
             # origin server double-responded, which the rq discipline forbids.
             self._dispatch_passive(m, waiting=want)
+            sent_to = self._check_failover_resend(sent_to, dest, m_req)
 
     # -- Put family ----------------------------------------------------------
 
@@ -287,23 +425,21 @@ class Client:
         put_id = self._next_put_id
         self._next_put_id += 1
         while True:
-            self._send_retry(
-                server,
-                msg(
-                    Tag.FA_PUT,
-                    self.rank,
-                    payload=bytes(payload),
-                    work_type=work_type,
-                    prio=work_prio,
-                    target_rank=target_rank,
-                    answer_rank=answer_rank,
-                    common_len=common.common_len if common else 0,
-                    common_server=common.common_server if common else -1,
-                    common_seqno=common.common_seqno if common else -1,
-                    put_id=put_id,
-                ),
+            pm = msg(
+                Tag.FA_PUT,
+                self.rank,
+                payload=bytes(payload),
+                work_type=work_type,
+                prio=work_prio,
+                target_rank=target_rank,
+                answer_rank=answer_rank,
+                common_len=common.common_len if common else 0,
+                common_server=common.common_server if common else -1,
+                common_seqno=common.common_seqno if common else -1,
+                put_id=put_id,
             )
-            resp = self._wait_put(put_id)
+            self._send_retry(server, pm)
+            resp = self._wait_put(put_id, dest=server, m_req=pm)
             rc = resp.rc
             if rc not in (ADLB_PUT_REJECTED, ADLB_RETRY):
                 break
@@ -357,10 +493,9 @@ class Client:
                                       common_len=0)
             return ADLB_SUCCESS
         server = self._next_server()
-        self.ep.send(
-            server, msg(Tag.FA_PUT_COMMON, self.rank, payload=bytes(common_buf))
-        )
-        resp = self._wait(Tag.TA_PUT_COMMON_RESP)
+        pm = msg(Tag.FA_PUT_COMMON, self.rank, payload=bytes(common_buf))
+        self._send_retry(server, pm)
+        resp = self._wait(Tag.TA_PUT_COMMON_RESP, dest=server, m_req=pm)
         if resp.rc != ADLB_SUCCESS:
             return resp.rc
         self._batch = _BatchState(
@@ -380,7 +515,7 @@ class Client:
         if b.common_server < 0:  # empty-prefix batch: nothing stored
             return ADLB_SUCCESS
         with self._span("adlb:end_batch_put"):
-            self.ep.send(
+            self._send_retry(
                 b.common_server,
                 msg(
                     Tag.FA_BATCH_DONE,
@@ -408,12 +543,10 @@ class Client:
         sleep = 0.0
         while True:
             self._rqseqno += 1
-            self._send_retry(
-                self.home,
-                msg(Tag.FA_RESERVE, self.rank, rqseqno=self._rqseqno,
-                    **fields),
-            )
-            resp = self._wait(Tag.TA_RESERVE_RESP)
+            pm = msg(Tag.FA_RESERVE, self.rank, rqseqno=self._rqseqno,
+                     **fields)
+            self._send_retry(self.home, pm)
+            resp = self._wait(Tag.TA_RESERVE_RESP, dest=self.home, m_req=pm)
             if resp.rc != ADLB_RETRY:
                 return resp
             self._m_reserve_retries.inc()
@@ -512,12 +645,11 @@ class Client:
         # the same prefix (one fetch per batch member is normal)
         get_id = self._next_put_id
         self._next_put_id += 1
-        self._send_retry(
-            common_server,
-            msg(Tag.FA_GET_COMMON, self.rank,
-                common_seqno=common_seqno, get_id=get_id),
-        )
-        resp = self._wait(Tag.TA_GET_COMMON_RESP)
+        pm = msg(Tag.FA_GET_COMMON, self.rank,
+                 common_seqno=common_seqno, get_id=get_id)
+        self._send_retry(common_server, pm)
+        resp = self._wait(Tag.TA_GET_COMMON_RESP, dest=common_server,
+                          m_req=pm)
         if resp.rc != ADLB_SUCCESS:
             return resp.rc, b""
         self._m_prefix_misses.inc()
@@ -538,15 +670,26 @@ class Client:
             rc, prefix = self._fetch_prefix(
                 handle.common_server_rank, handle.common_seqno
             )
+            if rc == ADLB_RETRY:
+                # prefix lost to a server failover (a counted loss): the
+                # suffix alone is not the unit, but the reservation must
+                # still drain — consume and discard it, then let the
+                # caller re-reserve. Returning without the fetch would
+                # leak the pin and hang exhaustion on a unit nobody can
+                # ever complete.
+                pm = msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno)
+                self._send_retry(handle.server_rank, pm)
+                self._wait(Tag.TA_GET_RESERVED_RESP,
+                           dest=handle.server_rank, m_req=pm)
+                return ADLB_RETRY, None, 0.0
             if rc != ADLB_SUCCESS:
                 # prefix no longer exists (reclaim edge): surface the
                 # error; a truncated payload must never look like success
                 return rc, None, 0.0
-        self._send_retry(
-            handle.server_rank,
-            msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno),
-        )
-        resp = self._wait(Tag.TA_GET_RESERVED_RESP)
+        pm = msg(Tag.FA_GET_RESERVED, self.rank, seqno=handle.seqno)
+        self._send_retry(handle.server_rank, pm)
+        resp = self._wait(Tag.TA_GET_RESERVED_RESP, dest=handle.server_rank,
+                          m_req=pm)
         if resp.rc != ADLB_SUCCESS:
             return resp.rc, None, 0.0
         return ADLB_SUCCESS, prefix + resp.payload, resp.time_on_q
@@ -567,14 +710,23 @@ class Client:
         units)."""
         with self._span("adlb:get_work"):
             types = normalize_req_types(req_types, self.world.types)
-            resp = self._reserve_rpc(
-                req_types=None if types is None else sorted(types),
-                hang=True,
-                fetch=True,
-            )
-            if resp.rc != ADLB_SUCCESS:
-                return resp.rc, None
-            return self._decode_single_got(resp)
+            sleep = 0.0
+            while True:
+                resp = self._reserve_rpc(
+                    req_types=None if types is None else sorted(types),
+                    hang=True,
+                    fetch=True,
+                )
+                if resp.rc != ADLB_SUCCESS:
+                    return resp.rc, None
+                rc, got = self._decode_single_got(resp)
+                if rc != ADLB_RETRY:
+                    return rc, got
+                # void handle (failover tombstone / reclaim resurrect):
+                # the unit is gone — re-reserve rather than surface a
+                # transient code as termination
+                self._m_reserve_retries.inc()
+                sleep = self._backoff_sleep(sleep)
 
     def _decode_single_got(self, resp) -> tuple[int, Optional[GotWork]]:
         """Decode a successful single-unit TA_RESERVE_RESP: fused (payload
@@ -633,32 +785,39 @@ class Client:
             raise AdlbError("get_work_batch: max_units must be >= 1")
         with self._span("adlb:get_work_batch"):
             types = normalize_req_types(req_types, self.world.types)
-            resp = self._reserve_rpc(
-                req_types=None if types is None else sorted(types),
-                hang=True,
-                fetch=True,
-                fetch_max=max_units,
-            )
-            if resp.rc != ADLB_SUCCESS:
-                return resp.rc, []
-            if "payloads" in resp.data:  # batch-fused: already consumed
-                out = []
-                d = resp.data
-                for i, payload in enumerate(d["payloads"]):
-                    out.append(GotWork(
-                        work_type=d["work_types"][i],
-                        work_prio=d["prios"][i],
-                        payload=payload,
-                        answer_rank=d["answer_ranks"][i],
-                        time_on_q=d["times_on_q"][i],
-                    ))
-                    if self.tracer is not None:
-                        self.tracer.got_work(d["work_types"][i])
-                return ADLB_SUCCESS, out
-            # single-unit response (a park wake-up, a remote/prefixed
-            # fallback, or a server that ignores fetch_max)
-            rc, got = self._decode_single_got(resp)
-            return rc, [got] if got is not None else []
+            sleep = 0.0
+            while True:
+                resp = self._reserve_rpc(
+                    req_types=None if types is None else sorted(types),
+                    hang=True,
+                    fetch=True,
+                    fetch_max=max_units,
+                )
+                if resp.rc != ADLB_SUCCESS:
+                    return resp.rc, []
+                if "payloads" in resp.data:  # batch-fused: already consumed
+                    out = []
+                    d = resp.data
+                    for i, payload in enumerate(d["payloads"]):
+                        out.append(GotWork(
+                            work_type=d["work_types"][i],
+                            work_prio=d["prios"][i],
+                            payload=payload,
+                            answer_rank=d["answer_ranks"][i],
+                            time_on_q=d["times_on_q"][i],
+                        ))
+                        if self.tracer is not None:
+                            self.tracer.got_work(d["work_types"][i])
+                    return ADLB_SUCCESS, out
+                # single-unit response (a park wake-up, a remote/prefixed
+                # fallback, or a server that ignores fetch_max)
+                rc, got = self._decode_single_got(resp)
+                if rc != ADLB_RETRY:
+                    return rc, [got] if got is not None else []
+                # void handle (failover tombstone / reclaim resurrect):
+                # re-reserve with backoff, as get_work does
+                self._m_reserve_retries.inc()
+                sleep = self._backoff_sleep(sleep)
 
     # -- prefetch pipeline (get_work_stream) ----------------------------------
 
@@ -747,7 +906,7 @@ class Client:
                 remaining = min(remaining, deadline - time.monotonic())
                 if remaining <= 0:
                     return None
-            m = self.ep.recv(timeout=remaining)
+            m = self._recv(timeout=remaining)
             if m is None:
                 continue
             self._dispatch_passive(m)
@@ -755,7 +914,7 @@ class Client:
     def _drain_inbox(self) -> None:
         """Pull everything already delivered without blocking."""
         while True:
-            m = self.ep.recv(timeout=0.0)
+            m = self._recv(timeout=0.0)
             if m is None:
                 return
             self._dispatch_passive(m)
@@ -772,6 +931,9 @@ class Client:
             raise AdlbAborted(code)
         if m.tag is Tag.AM_APP:
             self._app_inbox.append(m)
+            return
+        if m.tag is Tag.TA_HOME_TAKEOVER:
+            self._apply_takeover(m)
             return
         if (
             m.tag is Tag.TA_PUT_RESP
@@ -806,7 +968,17 @@ class Client:
             self.flight.record(f"dropped stray {m.tag.name} from {m.src}")
             return
         if m.tag is Tag.PEER_EOF:
-            if m.src == self.home:
+            if self._failover_policy() and self.world.is_server(m.src):
+                # a server died but the world may survive it: note the
+                # loss (bounding the takeover wait) and keep going — the
+                # buddy's TA_HOME_TAKEOVER remaps us, and the blocking
+                # waits re-send toward it (see _wait)
+                self._lost_at.setdefault(m.src, time.monotonic())
+                self.flight.record(
+                    f"server {m.src} connection lost; awaiting takeover"
+                )
+                return
+            if m.src == self._route(self.home):
                 # the lifeline is gone: error out instead of hanging in the
                 # next blocking wait (reference: rank failure kills the job)
                 self.aborted = True
@@ -818,6 +990,58 @@ class Client:
             return  # other peers closing is normal at termination
         ctx = f" while waiting {waiting}" if waiting is not None else ""
         raise AdlbError(f"rank {self.rank}: unexpected {m.tag}{ctx}")
+
+    # -- server failover ------------------------------------------------------
+
+    def _apply_takeover(self, m: Msg) -> None:
+        """An epoch-stamped TA_HOME_TAKEOVER from the buddy that adopted
+        a dead server: install the remap, re-point home if it was the
+        casualty, re-send pipelined puts that were awaiting the dead
+        server's ack (the buddy's replicated dedup window absorbs
+        duplicates), and re-arm an open stream's in-flight reserves."""
+        dead, buddy, epoch = m.dead, m.src, m.data.get("epoch", 0)
+        if self._srv_route.get(dead) == buddy:
+            return  # duplicate note
+        old_home = self._route(self.home)
+        self._fo_epoch = max(self._fo_epoch, epoch)
+        self._srv_route[dead] = buddy
+        self._lost_at.pop(dead, None)
+        self._m_failovers.inc()
+        self.flight.record(
+            f"home_takeover dead={dead} buddy={buddy} epoch={epoch}"
+        )
+        home_moved = self._route(self.home) != old_home
+        # pipelined puts parked on the dead server's ack: re-send (same
+        # put_id — the replicated per-sender window makes this idempotent
+        # when the original was accepted before the death)
+        for put_id, req in list(self._pending_puts.items()):
+            if self._route(req["server"]) != req["server"]:
+                req["server"] = self._route(req["server"])
+                self._send_iput(put_id, req)
+        if home_moved and self._active_stream is not None:
+            self._active_stream._on_takeover()
+
+    def _check_lost_servers(self) -> None:
+        """Raise when a lost server's takeover window expired with no
+        buddy announcement (double failure / master death): blocked
+        loops must not wait forever."""
+        if not self._lost_at:
+            return
+        now = time.monotonic()
+        for srv, t0 in list(self._lost_at.items()):
+            if self._route(srv) != srv:
+                self._lost_at.pop(srv, None)
+                continue
+            if now - t0 > self.cfg.failover_client_wait:
+                self.aborted = True
+                self.flight.record(
+                    f"server {srv} lost; no takeover within "
+                    f"{self.cfg.failover_client_wait}s"
+                )
+                self.flight.dump_json("home_server_lost")
+                raise HomeServerLostError(
+                    f"rank {self.rank}: server {srv} lost; no takeover"
+                )
 
     # -- pipelined puts -------------------------------------------------------
     #
@@ -849,7 +1073,7 @@ class Client:
         # producer loop's pending map (payload copies!) and the transport
         # queue stay bounded by in-flight work, not the whole stream
         while True:
-            m = self.ep.recv(timeout=0.0)
+            m = self._recv(timeout=0.0)
             if m is None:
                 break
             self._dispatch_passive(m)
@@ -933,7 +1157,8 @@ class Client:
             if self._abort_event is not None and self._abort_event.is_set():
                 self.aborted = True
                 raise AdlbAborted(-1)
-            m = self.ep.recv(timeout=0.5)
+            self._check_lost_servers()
+            m = self._recv(timeout=0.5)
             if m is None:
                 continue
             self._dispatch_passive(m)
@@ -949,7 +1174,7 @@ class Client:
         """Explicit termination (reference ADLB_Set_problem_done,
         ``src/adlb.c:3054-3062``)."""
         with self._span("adlb:set_problem_done"):
-            self.ep.send(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
+            self._send_retry(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
         return ADLB_SUCCESS
 
     def checkpoint(self, path_prefix: str) -> tuple[int, int]:
@@ -968,26 +1193,27 @@ class Client:
             if self.cfg.server_impl == "native" else path_prefix
         )
         with self._span("adlb:checkpoint"):
-            self.ep.send(
-                self.home, msg(Tag.FA_CHECKPOINT, self.rank, path=path)
-            )
-            resp = self._wait(Tag.TA_CHECKPOINT_RESP)
+            pm = msg(Tag.FA_CHECKPOINT, self.rank, path=path)
+            self._send_retry(self.home, pm)
+            resp = self._wait(Tag.TA_CHECKPOINT_RESP, dest=self.home,
+                              m_req=pm)
         return resp.rc, resp.count
 
     def info_get(self, key: int) -> tuple[int, float]:
         """One live stats value from this rank's home server (reference
         ADLB_Info_get, ``src/adlb.c:3072-3141``)."""
-        self.ep.send(self.home, msg(Tag.FA_INFO_GET, self.rank, key=int(key)))
-        resp = self._wait(Tag.TA_INFO_GET_RESP)
+        pm = msg(Tag.FA_INFO_GET, self.rank, key=int(key))
+        self._send_retry(self.home, pm)
+        resp = self._wait(Tag.TA_INFO_GET_RESP, dest=self.home, m_req=pm)
         return resp.rc, resp.value
 
     def info_num_work_units(self, work_type: int) -> tuple[int, int, int, int]:
         """(rc, count, total bytes, max wq count) at the home server
         (reference ``src/adlb.c:3027-3046``)."""
-        self.ep.send(
-            self.home, msg(Tag.FA_INFO_NUM_WORK_UNITS, self.rank, work_type=work_type)
-        )
-        resp = self._wait(Tag.TA_INFO_NUM_RESP)
+        pm = msg(Tag.FA_INFO_NUM_WORK_UNITS, self.rank,
+                 work_type=work_type)
+        self._send_retry(self.home, pm)
+        resp = self._wait(Tag.TA_INFO_NUM_RESP, dest=self.home, m_req=pm)
         return resp.rc, resp.count, resp.nbytes, resp.max_wq
 
     def finalize(self) -> int:
@@ -1017,7 +1243,8 @@ class Client:
                         f"terminally rejected (rc={rc})",
                         file=sys.stderr,
                     )
-            self.ep.send(self.home, msg(Tag.FA_LOCAL_APP_DONE, self.rank))
+            self._send_retry(self.home, msg(Tag.FA_LOCAL_APP_DONE,
+                                            self.rank))
         return rc
 
     def abort(self, code: int) -> None:
@@ -1026,7 +1253,11 @@ class Client:
         self.aborted = True
         self.flight.record(f"this rank called abort({code})")
         self.flight.dump_json("abort_initiated")
-        self.ep.send(self.home, msg(Tag.FA_ABORT, self.rank, code=code))
+        try:
+            self.ep.send(self._route(self.home),
+                         msg(Tag.FA_ABORT, self.rank, code=code))
+        except OSError:
+            pass  # the abort_event still propagates in-harness
         if self._abort_event is not None:
             self._abort_event.set()
         raise AdlbAborted(code)
@@ -1095,6 +1326,20 @@ class WorkStream:
             return
         while len(self._outstanding) + len(self._bank) < self._depth:
             self._send_one()
+
+    def _on_takeover(self) -> None:
+        """The home server failed over: every reserve parked at the dead
+        server is void — re-arm each slot toward the buddy (the retry
+        path sends fresh rqseqnos with backoff, in stream context)."""
+        n = len(self._outstanding)
+        if n == 0:
+            return
+        self._c.flight.record(
+            f"stream: re-arming {n} in-flight reserves after takeover"
+        )
+        self._outstanding.clear()
+        self._retry += n
+        self._idle_sent = False
 
     def _on_resp(self, m: Msg) -> None:
         """Bank one reservation response (called from the client's
@@ -1198,7 +1443,8 @@ class WorkStream:
                 )
                 self._idle_sent = True
                 self._idle_sent_at = now
-            m = c.ep.recv(timeout=0.5)
+            c._check_lost_servers()
+            m = c._recv(timeout=0.5)
             if m is not None:
                 c._dispatch_passive(m)
 
@@ -1224,7 +1470,7 @@ class WorkStream:
                 # (per-peer FIFO with the home server)
                 deadline = time.monotonic() + 10.0
                 while time.monotonic() < deadline:
-                    m = c.ep.recv(timeout=0.2)
+                    m = c._recv(timeout=0.2)
                     if m is None:
                         continue
                     if m.tag is Tag.TA_STREAM_CANCEL_RESP:

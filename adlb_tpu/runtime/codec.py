@@ -153,6 +153,12 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_COMMON_FORFEIT: 1134,
     # remote fused fetch delivery confirmation (home -> holder)
     Tag.SS_DELIVERED: 1135,
+    # server failover (on_server_failure="failover"; python servers only —
+    # the policy is rejected toward native planes, so these never cross
+    # the codec; ids exist so the table stays total)
+    Tag.SS_REPL: 1136,
+    Tag.SS_SERVER_DEAD: 1137,
+    Tag.TA_HOME_TAKEOVER: 1138,
     # transport-internal synthetic signal (never actually on the wire; the
     # id exists only so the codec table stays total)
     Tag.PEER_EOF: 1999,

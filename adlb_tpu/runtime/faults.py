@@ -254,11 +254,48 @@ class FaultyEndpoint:
         return self._ep.recv(timeout=timeout)
 
 
-def maybe_wrap(ep, cfg):
+def resolve_spec(spec: dict, world) -> dict:
+    """Expand server-targeted kill specs into world-rank form.
+
+    ``kill_server_at_frame`` / ``kill_server_at`` / ``disconnect_server_at``
+    are keyed by SERVER INDEX (0 = the master, i = the i-th server rank)
+    so a spec need not hard-code the world shape; with a ``world`` they
+    translate into the corresponding ``kill_at_frame`` / ``kill_at`` /
+    ``disconnect_at`` world-rank entries. Idempotent and copy-on-write —
+    the input spec is never mutated."""
+    if world is None or not spec:
+        return spec
+    pairs = (
+        ("kill_server_at_frame", "kill_at_frame"),
+        ("kill_server_at", "kill_at"),
+        ("disconnect_server_at", "disconnect_at"),
+    )
+    if not any(spec.get(sk) for sk, _ in pairs):
+        return spec
+    out = dict(spec)
+    for srv_key, rank_key in pairs:
+        by_idx = out.pop(srv_key, None)
+        if not by_idx:
+            continue
+        merged = dict(out.get(rank_key) or {})
+        for idx, v in dict(by_idx).items():
+            i = int(idx)
+            if not (0 <= i < world.nservers):
+                raise ValueError(
+                    f"{srv_key}: server index {i} outside 0.."
+                    f"{world.nservers - 1}"
+                )
+            merged[world.num_app_ranks + i] = v
+        out[rank_key] = merged
+    return out
+
+
+def maybe_wrap(ep, cfg, world=None):
     """Wrap ``ep`` when ``cfg.fault_spec`` is set (else return it
     unchanged) — the single hook every world harness (run_world,
-    spawn_world, launch.py, join_world) calls."""
+    spawn_world, launch.py, join_world) calls. ``world`` enables
+    server-index kill specs (kill-server-at-frame / -at-time)."""
     spec = getattr(cfg, "fault_spec", None)
     if not spec:
         return ep
-    return FaultyEndpoint(ep, FaultPlan(spec, ep.rank))
+    return FaultyEndpoint(ep, FaultPlan(resolve_spec(spec, world), ep.rank))

@@ -138,6 +138,13 @@ def main(argv=None) -> int:
                          "the world (reference semantics); 'reclaim' "
                          "re-enqueues the dead rank's leased work and the "
                          "world keeps running")
+    ap.add_argument("--on-server-failure", default="abort",
+                    choices=["abort", "failover"],
+                    help="server death policy: 'abort' kills the world "
+                         "(reference semantics); 'failover' replays the "
+                         "dead server's replicated pool shard at its "
+                         "ring-successor buddy, which takes over its app "
+                         "ranks (python servers only)")
     ap.add_argument("--fault-spec", default=None,
                     help="JSON fault-injection spec "
                          "(adlb_tpu/runtime/faults.py), e.g. "
@@ -162,6 +169,7 @@ def main(argv=None) -> int:
     cfg = Config(balancer=args.balancer, server_impl=args.server_impl,
                  flight_dir=args.flight_dir, ops_port=args.ops_port,
                  on_worker_failure=args.on_worker_failure,
+                 on_server_failure=args.on_server_failure,
                  fault_spec=fault_spec)
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
@@ -186,7 +194,8 @@ def main(argv=None) -> int:
             from adlb_tpu.runtime.faults import maybe_wrap
             from adlb_tpu.runtime.transport_tcp import TcpEndpoint
 
-            ep = maybe_wrap(TcpEndpoint(rank, {rank: (host, 0)}), cfg)
+            ep = maybe_wrap(TcpEndpoint(rank, {rank: (host, 0)}), cfg,
+                            world)
             server_eps[rank] = ep
             _publish(rdv, rank, host, ep.port)
     if (args.server_impl == "native" and args.balancer == "tpu"
@@ -277,6 +286,8 @@ def main(argv=None) -> int:
                 env["ADLB_FAULT_SPEC"] = args.fault_spec
             if args.on_worker_failure != "abort":
                 env["ADLB_ON_WORKER_FAILURE"] = args.on_worker_failure
+            if args.on_server_failure != "abort":
+                env["ADLB_ON_SERVER_FAILURE"] = args.on_server_failure
             if args.server_impl == "native":
                 env["ADLB_SERVER_IMPL"] = "native"
             procs.append(subprocess.Popen(args.prog, env=env))

@@ -92,6 +92,22 @@ class Tag(enum.Enum):
     # forfeited get so the prefix still GCs when live members fetch
     SS_COMMON_FORFEIT = enum.auto()
 
+    # server failover (Config(on_server_failure="failover"); no reference
+    # analogue — upstream's servers ARE the pool and a server death kills
+    # the job, SURVEY §5):
+    # SS_REPL — a server's asynchronous replication-log flush to its
+    # ring-successor buddy: packed pool-mutation entries in the
+    # checkpoint.py unit wire format (adlb_tpu/runtime/replica.py)
+    SS_REPL = enum.auto()
+    # SS_SERVER_DEAD — fan-out when a server's connection EOFs mid-run:
+    # survivors prune the dead server from rings/gossip/plans, and its
+    # buddy replays the replication log and takes over home-server duty
+    SS_SERVER_DEAD = enum.auto()
+    # TA_HOME_TAKEOVER — buddy -> app ranks: epoch-stamped remap (dead
+    # server -> this server); clients reroute handles, common fetches,
+    # round-robin puts, and their home-server traffic
+    TA_HOME_TAKEOVER = enum.auto()
+
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()
     SS_STATE_DELTA = enum.auto()  # new task(s) appended to last snapshot

@@ -480,6 +480,17 @@ class TargetedDirectory:
         surviving entry would only misdirect future RFRs."""
         self._d.pop(app_rank, None)
 
+    def repoint(self, old_server: int, new_server: int) -> None:
+        """Server failover: units believed held at ``old_server`` now live
+        at its buddy (the replica replay re-enqueued them), so every
+        directory count moves. Off-by-replication-lag entries are
+        harmless — an RFR miss patches them like any stale belief."""
+        for by_type in self._d.values():
+            for by_server in by_type.values():
+                n = by_server.pop(old_server, 0)
+                if n:
+                    by_server[new_server] = by_server.get(new_server, 0) + n
+
 
 @dataclasses.dataclass
 class Lease:
@@ -567,6 +578,20 @@ class CommonStore:
         and queued units reference it by number)."""
         self._entries[seqno] = CommonStore.Entry(seqno, buf, refcnt, ngets)
         self._next_seqno = max(self._next_seqno, seqno + 1)
+
+    def adopt(self, buf: bytes, refcnt: int, ngets: int,
+              credits: int = 0) -> int:
+        """Install a prefix taken over from a dead server's replica under
+        a FRESH seqno (its original seqno may collide with this store's);
+        the caller records the (dead server, old seqno) -> new seqno
+        translation. Returns the new seqno — possibly already GC'd when
+        the replayed refcount state was already satisfied."""
+        seqno = self._next_seqno
+        self._next_seqno += 1
+        e = CommonStore.Entry(seqno, buf, refcnt, ngets, credits)
+        self._entries[seqno] = e
+        self._maybe_gc(e)
+        return seqno
 
     def set_refcnt(self, seqno: int, refcnt: int) -> None:
         e = self._entries.get(seqno)

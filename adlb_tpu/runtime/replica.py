@@ -1,0 +1,333 @@
+"""Server failover: replicated pool shards and home-server takeover.
+
+The reference has no server fault tolerance at all — the servers *are*
+the work pool, there is no pool serialization, and a dead rank kills the
+job (SURVEY §5; ``MPI_Abort`` paths, reference ``src/adlb.c:2508-2526``).
+PR 2 made *worker* death a policy; this module does the same for server
+death, composing ingredients that already exist in-tree:
+
+* every server **asynchronously streams a replication log** of its pool
+  mutations (put, fetch/delivery consume, pin/unpin, batch-common
+  refcount ops, app finalize/death) to its **ring-successor buddy**
+  server, as ``SS_REPL`` frames of packed entries reusing the
+  ``checkpoint.py`` unit wire format (:data:`_UNIT`);
+* the buddy maintains a passive :class:`ReplicaMirror` — the
+  predecessor's wq/cq shard reconstructed entry by entry;
+* on the predecessor's death (EOF / ``SS_SERVER_DEAD`` fan-out) the
+  buddy **replays the mirror into its own queues and takes over
+  home-server duty** for the dead server's app ranks: pinned units stay
+  pinned under their original leases (live clients fetch them through a
+  seqno translation), unpinned units re-enqueue, batch-common prefixes
+  re-home with their refcount state, and clients learn the new mapping
+  via an epoch-stamped ``TA_HOME_TAKEOVER`` remap.
+
+Loss model: replication is asynchronous, so mutations the dead server
+made after its last flushed ``SS_REPL`` frame are gone. The lag is
+bounded (flush on every reactor pass and at ``MAX_BUFFER`` entries),
+observable (``repl_lag`` gauge at the primary), and the losses are
+counted where they become observable: a client fetching a handle whose
+unit's consume tombstone replayed (the response died with the server)
+gets ``ADLB_RETRY`` and the buddy counts ``failover_lost``. At-most-once
+execution is preserved exactly as in PR 2/PR 3 — a consume in the log
+means the payload may already have landed, so the unit is never
+re-enqueued (the delivered-at-death rule).
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from typing import Optional
+
+from adlb_tpu.runtime.checkpoint import _UNIT  # unit metadata wire format
+
+# entry opcodes (1 byte on the wire)
+OP_PUT = 1        # unit added to the wq
+OP_PIN = 2        # unit pinned (lease granted)
+OP_UNPIN = 3      # unit unpinned (lease released, still queued)
+OP_CONSUME = 4    # unit fetched/delivered (removed; tombstoned)
+OP_REMOVE = 5     # unit removed without delivery (migrate/push/drop)
+OP_COMMON_PUT = 6     # batch-common prefix stored
+OP_COMMON_REFCNT = 7  # End_batch_put shipped the final refcount
+OP_COMMON_GET = 8     # one get accounted against the prefix
+OP_COMMON_FORFEIT = 9
+OP_COMMON_CREDIT = 10
+OP_COMMON_GC = 11     # prefix GC'd (refcount satisfied)
+OP_APP_DONE = 12      # local app finalized
+OP_RANK_DEAD = 13     # app rank declared dead (reclaim policy)
+OP_COMMON_STATE = 14  # full refcount state (re-bootstrap after buddy death)
+OP_SEEN_PUTS = 15     # a sender's accepted-put dedup window (re-bootstrap)
+
+_HDR = struct.Struct("<BI")       # op, body length
+_SEQ = struct.Struct("<q")        # one seqno
+_SEQ2 = struct.Struct("<qq")      # seqno + arg (pin rank, refcnt, ...)
+_SEQ3 = struct.Struct("<qqq")     # seqno + src + request id (common ops)
+_PUTHDR = struct.Struct("<qqqii")  # seqno, src, put_id, pinned(pin_rank|-1), pad
+
+# flush the buffered log at this many entries even mid-pass
+MAX_BUFFER = 256
+# bounded tombstone memory at the mirror (consumed seqnos kept so a
+# post-takeover fetch of a consumed unit is distinguishable from an
+# invalid handle)
+MAX_TOMBSTONES = 65536
+
+
+def _pack_unit(u) -> bytes:
+    """Unit metadata + payload in the checkpoint shard layout
+    (``_UNIT`` + common_len + payload_len + payload)."""
+    return b"".join((
+        _UNIT.pack(u.work_type, u.target_rank, u.answer_rank, u.prio,
+                   u.common_server_rank, u.common_seqno),
+        struct.pack("<II", u.common_len, len(u.payload)),
+        u.payload,
+    ))
+
+
+def _unpack_unit(body: bytes, off: int) -> tuple[dict, int]:
+    wt, target, answer, prio, cserver, cseqno = _UNIT.unpack_from(body, off)
+    off += _UNIT.size
+    clen, plen = struct.unpack_from("<II", body, off)
+    off += 8
+    payload = body[off:off + plen]
+    off += plen
+    return dict(work_type=wt, target_rank=target, answer_rank=answer,
+                prio=prio, common_server_rank=cserver, common_seqno=cseqno,
+                common_len=clen, payload=payload), off
+
+
+class ReplicationLog:
+    """Primary side: buffer mutation entries, flush them to the buddy as
+    ``SS_REPL`` frames. Append is O(entry); the flush is one endpoint
+    send (fire-and-forget — the buddy never acks; TCP's per-pair FIFO is
+    the ordering guarantee)."""
+
+    def __init__(self, buddy: int) -> None:
+        self.buddy = buddy
+        self._buf: list[bytes] = []
+        self.seq = 0          # frames flushed
+        self.entries_total = 0
+
+    # -- appends -------------------------------------------------------------
+
+    def _append(self, op: int, body: bytes) -> None:
+        self._buf.append(_HDR.pack(op, len(body)) + body)
+        self.entries_total += 1
+
+    def log_put(self, unit, src: int, put_id) -> None:
+        pid = -1 if put_id is None else int(put_id)
+        body = _PUTHDR.pack(unit.seqno, src, pid,
+                            unit.pin_rank if unit.pinned else -1, 0)
+        self._append(OP_PUT, body + _pack_unit(unit))
+
+    def log_pin(self, seqno: int, rank: int) -> None:
+        self._append(OP_PIN, _SEQ2.pack(seqno, rank))
+
+    def log_unpin(self, seqno: int) -> None:
+        self._append(OP_UNPIN, _SEQ.pack(seqno))
+
+    def log_consume(self, seqno: int) -> None:
+        self._append(OP_CONSUME, _SEQ.pack(seqno))
+
+    def log_remove(self, seqno: int) -> None:
+        self._append(OP_REMOVE, _SEQ.pack(seqno))
+
+    def log_common_put(self, seqno: int, buf: bytes) -> None:
+        self._append(OP_COMMON_PUT, _SEQ.pack(seqno) + buf)
+
+    def log_common_refcnt(self, seqno: int, refcnt: int) -> None:
+        self._append(OP_COMMON_REFCNT, _SEQ2.pack(seqno, refcnt))
+
+    def log_common_op(self, seqno: int, op: str, src: int = -1,
+                      op_id: int = -1) -> None:
+        """``src``/``op_id`` carry the requester's dedup identity for
+        client-driven gets/forfeits, so the buddy's replay windows absorb
+        a request re-sent across the takeover (seqno=-1 with src>=0 is a
+        pure window entry — the re-bootstrap path — with no accounting)."""
+        code = {"get": OP_COMMON_GET, "forfeit": OP_COMMON_FORFEIT,
+                "credit": OP_COMMON_CREDIT, "gc": OP_COMMON_GC}[op]
+        self._append(code, _SEQ3.pack(seqno, src, op_id))
+
+    def log_common_state(self, seqno: int, refcnt: int, ngets: int,
+                         credits: int) -> None:
+        self._append(OP_COMMON_STATE,
+                     struct.pack("<qqqq", seqno, refcnt, ngets, credits))
+
+    def log_app_done(self, rank: int) -> None:
+        self._append(OP_APP_DONE, _SEQ.pack(rank))
+
+    def log_rank_dead(self, rank: int) -> None:
+        self._append(OP_RANK_DEAD, _SEQ.pack(rank))
+
+    def log_seen_puts(self, src: int, put_ids) -> None:
+        """Re-bootstrap: ship a sender's whole accepted-put window so a
+        put acked by THIS server and re-sent after its death is answered
+        idempotently by the new buddy (without this, a buddy-death-then-
+        primary-death chain would admit the duplicate and run it twice)."""
+        ids = list(put_ids)
+        self._append(OP_SEEN_PUTS,
+                     _SEQ2.pack(src, len(ids))
+                     + struct.pack(f"<{len(ids)}q", *ids))
+
+    # -- flush ---------------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+    def take(self) -> Optional[bytes]:
+        """Drain the buffer into one frame body, or None when empty."""
+        if not self._buf:
+            return None
+        blob = b"".join(self._buf)
+        self._buf.clear()
+        self.seq += 1
+        return blob
+
+
+class ReplicaMirror:
+    """Buddy side: the predecessor's pool shard, reconstructed from its
+    replication stream. Plain dicts — the mirror is passive until a
+    takeover replays it into the buddy's live queues."""
+
+    def __init__(self, primary: int) -> None:
+        self.primary = primary
+        self.units: dict[int, dict] = {}       # seqno -> unit fields
+        self.pins: dict[int, int] = {}         # seqno -> pin_rank
+        self.commons: dict[int, list] = {}     # seqno -> [buf, refcnt, ngets,
+        #                                        credits]
+        self.tombstones: set[int] = set()      # consumed seqnos
+        self._tomb_order: deque[int] = deque()
+        self.seen_puts: dict[int, list[int]] = {}  # src -> put ids (ordered)
+        # per-requester dedup identities for the common-prefix control
+        # plane: the last fetch id per src (the primary's _last_common)
+        # and the forfeit-note window — merged at promotion so a request
+        # the dead server already accounted is absorbed, not re-counted
+        self.last_common: dict[int, int] = {}      # src -> last get_id
+        self.forfeit_ids: dict[int, list[int]] = {}  # src -> note ids
+        self.finalized: set[int] = set()
+        self.dead_ranks: set[int] = set()
+        self.entries_applied = 0
+        self.frames_applied = 0
+        self.sealed = False
+
+    def _tombstone(self, seqno: int) -> None:
+        self.tombstones.add(seqno)
+        self._tomb_order.append(seqno)
+        if len(self._tomb_order) > MAX_TOMBSTONES:
+            self.tombstones.discard(self._tomb_order.popleft())
+
+    def apply(self, blob: bytes) -> None:
+        if self.sealed:
+            return  # late frame after promotion: the shard already replayed
+        off = 0
+        n = len(blob)
+        while off < n:
+            op, blen = _HDR.unpack_from(blob, off)
+            off += _HDR.size
+            body = blob[off:off + blen]
+            off += blen
+            self._apply_one(op, body)
+            self.entries_applied += 1
+        self.frames_applied += 1
+
+    def _apply_one(self, op: int, body: bytes) -> None:
+        if op == OP_PUT:
+            seqno, src, pid, pin_rank, _pad = _PUTHDR.unpack_from(body, 0)
+            fields, _ = _unpack_unit(body, _PUTHDR.size)
+            self.units[seqno] = fields
+            if pin_rank >= 0:
+                self.pins[seqno] = pin_rank
+            if pid >= 0:
+                ids = self.seen_puts.setdefault(src, [])
+                ids.append(pid)
+                if len(ids) > 512:
+                    del ids[0]
+        elif op == OP_PIN:
+            seqno, rank = _SEQ2.unpack(body)
+            if seqno in self.units:
+                self.pins[seqno] = rank
+        elif op == OP_UNPIN:
+            (seqno,) = _SEQ.unpack(body)
+            self.pins.pop(seqno, None)
+        elif op == OP_CONSUME:
+            (seqno,) = _SEQ.unpack(body)
+            self.units.pop(seqno, None)
+            self.pins.pop(seqno, None)
+            self._tombstone(seqno)
+        elif op == OP_REMOVE:
+            (seqno,) = _SEQ.unpack(body)
+            self.units.pop(seqno, None)
+            self.pins.pop(seqno, None)
+        elif op == OP_COMMON_PUT:
+            (seqno,) = _SEQ.unpack_from(body, 0)
+            self.commons[seqno] = [body[_SEQ.size:], -1, 0, 0]
+        elif op == OP_COMMON_REFCNT:
+            seqno, refcnt = _SEQ2.unpack(body)
+            e = self.commons.get(seqno)
+            if e is not None:
+                e[1] = refcnt + e[3]
+                e[3] = 0
+        elif op in (OP_COMMON_GET, OP_COMMON_FORFEIT):
+            seqno, src, op_id = _SEQ3.unpack(body)
+            if src >= 0 and op_id >= 0:
+                if op == OP_COMMON_GET:
+                    self.last_common[src] = max(
+                        self.last_common.get(src, -1), op_id
+                    )
+                else:
+                    ids = self.forfeit_ids.setdefault(src, [])
+                    ids.append(op_id)
+                    if len(ids) > 512:
+                        del ids[0]
+            e = self.commons.get(seqno)
+            if e is not None:
+                e[2] += 1
+        elif op == OP_COMMON_CREDIT:
+            seqno, _src, _id = _SEQ3.unpack(body)
+            e = self.commons.get(seqno)
+            if e is not None:
+                if e[1] >= 0:
+                    e[1] += 1
+                else:
+                    e[3] += 1
+        elif op == OP_COMMON_GC:
+            seqno, _src, _id = _SEQ3.unpack(body)
+            self.commons.pop(seqno, None)
+        elif op == OP_COMMON_STATE:
+            seqno, refcnt, ngets, credits = struct.unpack("<qqqq", body)
+            e = self.commons.get(seqno)
+            if e is not None:
+                e[1], e[2], e[3] = refcnt, ngets, credits
+        elif op == OP_APP_DONE:
+            (rank,) = _SEQ.unpack(body)
+            self.finalized.add(rank)
+        elif op == OP_RANK_DEAD:
+            (rank,) = _SEQ.unpack(body)
+            self.dead_ranks.add(rank)
+            self.finalized.add(rank)
+        elif op == OP_SEEN_PUTS:
+            src, n = _SEQ2.unpack_from(body, 0)
+            new = struct.unpack_from(f"<{n}q", body, _SEQ2.size)
+            ids = self.seen_puts.setdefault(src, [])
+            ids.extend(new)
+            if len(ids) > 512:
+                del ids[:len(ids) - 512]
+        # unknown ops are skipped by construction (op byte + length frame)
+
+    def seal(self) -> None:
+        self.sealed = True
+
+
+def buddy_of(world, dead: int, dead_servers=()) -> int:
+    """The server expected to hold ``dead``'s replica: its next LIVE ring
+    successor. With no intermediate deaths that is the original
+    ``ring_next`` the replication stream targeted; after an intermediate
+    death the primary re-bootstrapped its stream to the next live
+    successor (see ``Server._rebootstrap_repl``). If the walk comes back
+    to ``dead`` there is no live peer at all. The buddy may still hold no
+    mirror (the double failure: primary and its buddy died back to back,
+    before any re-bootstrap) — promotion detects that and aborts."""
+    b = world.ring_next(dead)
+    while b != dead and b in dead_servers:
+        b = world.ring_next(b)
+    return b

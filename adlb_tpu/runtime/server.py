@@ -155,27 +155,40 @@ class _BalancerWorker(threading.Thread):
                     fetch_by_req[(src, r[0], r[1])] = (
                         bool(r[3]) if len(r) > 3 else False
                     )
+        dead = s._dead_servers
         for holder, seqno, req_home, for_rank, rqseqno in matches:
-            s.ep.send(
-                holder,
-                msg(
-                    Tag.SS_PLAN_MATCH,
-                    s.rank,
-                    seqno=seqno,
-                    for_rank=for_rank,
-                    req_home=req_home,
-                    rqseqno=rqseqno,
-                    fetch=int(
-                        fetch_by_req.get((req_home, for_rank, rqseqno), False)
+            if holder in dead or req_home in dead:
+                continue  # racing failover: the next round re-plans
+            try:
+                s.ep.send(
+                    holder,
+                    msg(
+                        Tag.SS_PLAN_MATCH,
+                        s.rank,
+                        seqno=seqno,
+                        for_rank=for_rank,
+                        req_home=req_home,
+                        rqseqno=rqseqno,
+                        fetch=int(
+                            fetch_by_req.get(
+                                (req_home, for_rank, rqseqno), False
+                            )
+                        ),
                     ),
-                ),
-            )
+                )
+            except OSError:
+                continue  # the reactor's own evidence declares the death
         for src_rank, dest, seqnos, mig_id in migrations:
-            s.ep.send(
-                src_rank,
-                msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos,
-                    mig_id=mig_id),
-            )
+            if src_rank in dead or dest in dead:
+                continue
+            try:
+                s.ep.send(
+                    src_rank,
+                    msg(Tag.SS_PLAN_MIGRATE, s.rank, dest=dest, seqnos=seqnos,
+                        mig_id=mig_id),
+                )
+            except OSError:
+                continue
         if s.cfg.balancer_min_gap > 0:
             # module already cached by run()'s deferred import; this stays
             # a plain lookup, not a fresh module load
@@ -212,11 +225,74 @@ class Server:
         self.rq = ReserveQueue()
         self.tq = TargetedDirectory()
         self.mem = MemoryAccountant(cfg.max_malloc_per_server)
-        self.cq = CommonStore(on_gc=lambda e: self.mem.free(len(e.buf)))
+        self.cq = CommonStore(on_gc=self._on_common_gc)
         # lease per pinned unit (owner rank, lease id, grant time): under
         # on_worker_failure="reclaim" a dead owner's leases turn back into
         # queued work instead of blocking exhaustion forever
         self.leases = LeaseTable()
+
+        # ---- server failover (Config(on_server_failure="failover")) ----
+        # Each server streams a replication log of its pool mutations to
+        # its ring-successor buddy (adlb_tpu/runtime/replica.py) and
+        # passively mirrors its ring predecessor's stream; on a server's
+        # death the survivors prune it and the buddy replays the mirror
+        # into its own queues, taking over home-server duty.
+        self._failover = (
+            cfg.on_server_failure == "failover" and world.nservers > 1
+        )
+        self._dead_servers: set[int] = set()
+        self._srv_route: dict[int, int] = {}  # dead server -> its buddy
+        self._fo_epoch = 0
+        self.repl = None  # ReplicationLog toward the current buddy
+        # primary rank -> ReplicaMirror (normally just the ring
+        # predecessor; re-bootstraps after intermediate deaths can add
+        # more — see _rebootstrap_repl)
+        self.mirrors: dict[int, "object"] = {}
+        if self._failover:
+            from adlb_tpu.runtime import replica
+
+            self.repl = replica.ReplicationLog(world.ring_next(self.rank))
+        # when each server's death was first observed here (MTTR t0)
+        self._server_eof_at: dict[int, float] = {}
+        # servers whose inbound connection EOF was HANDLED by this
+        # reactor: the reader enqueues PEER_EOF behind the connection's
+        # last frame, so handling it proves the replication tail drained.
+        # A failed SEND proves nothing of the sort (frames may still be
+        # queued inbound) — promotion must key on THIS set, not on
+        # _server_eof_at, or a buddy that merely failed a send to the
+        # dying server would seal the mirror over unapplied SS_REPL
+        # frames and drop an acked put uncountably
+        self._server_tail_drained: set[int] = set()
+        # (dead server, old seqno) pairs already counted in
+        # failover_lost: the owner's (possibly re-sent) fetch of the
+        # same lost unit must not count it again
+        self._counted_lost: set[tuple[int, int]] = set()
+        # SS_SERVER_DEAD arrived before the dead server's own EOF: hold
+        # the promotion until the EOF drains the replication tail (or the
+        # deadline passes — the death may predate any connection to us)
+        self._pending_promotion: dict[int, float] = {}
+        # server EOF observed during termination: ambiguous (a finished
+        # peer exits, closing connections) — suspected dead, declared
+        # only if the world has not completed by the deadline
+        self._suspect_servers: dict[int, float] = {}
+        # dead server -> wall-clock until which the TA_HOME_TAKEOVER
+        # remap is periodically re-announced: the promote-time fan-out is
+        # one-shot best-effort, and a connect refused under load would
+        # otherwise leave a client waiting out its whole failover window
+        self._takeover_renotify: dict[int, float] = {}
+        self._next_renotify = 0.0
+        # takeover translations: clients and servers keep addressing
+        # adopted state by the DEAD server's numbering (stamped fo_from
+        # by the reroute), translated here to the buddy's fresh ids
+        self._adopted_units: dict[tuple[int, int], int] = {}
+        self._adopted_commons: dict[tuple[int, int], int] = {}
+        self._adopted_tombs: set[tuple[int, int]] = set()
+        # in-flight migration batches by (routed dest -> token -> units):
+        # a destination dying mid-transit would otherwise lose the units
+        # serialized inside the unacked SS_MIGRATE_WORK
+        self._mig_token = 0
+        self._migrate_pending: dict[int, dict[int, list]] = {}
+        self.died = False  # this server's own (injected) connectivity death
         # app ranks whose connection died before finalize (reclaim policy);
         # a rank that reconnects (network churn, not death) is resurrected
         self._dead_ranks: set[int] = set()
@@ -352,6 +428,12 @@ class Server:
         self._m_leases_reclaimed = self.metrics.counter("leases_reclaimed")
         self._m_targeted_dropped = self.metrics.counter("targeted_dropped")
         self._m_reconnects = self.metrics.counter("rank_reconnects")
+        # failover surface (on_server_failure="failover")
+        self._m_server_dead = self.metrics.counter("server_dead")
+        self._m_failover_promoted = self.metrics.counter("failover_promoted")
+        self._m_failover_lost = self.metrics.counter("failover_lost")
+        self._g_repl_lag = self.metrics.gauge("repl_lag")
+        self._g_fo_mttr = self.metrics.gauge("failover_mttr_ms")
         self._g_wq = self.metrics.gauge("wq_depth")
         self._g_rq = self.metrics.gauge("rq_depth")
         self._ts_wq = self.metrics.timeseries("wq_depth")
@@ -459,6 +541,8 @@ class Server:
             Tag.SS_MIGRATE_ACK: self._on_migrate_ack,
             Tag.SS_RANK_DEAD: self._on_rank_dead,
             Tag.SS_COMMON_FORFEIT: self._on_common_forfeit,
+            Tag.SS_REPL: self._on_repl,
+            Tag.SS_SERVER_DEAD: self._on_server_dead,
         }
 
     @staticmethod
@@ -515,6 +599,29 @@ class Server:
             )
 
     def _run_loop(self) -> None:
+        try:
+            self._run_loop_inner()
+        except OSError as e:
+            # this server's own connectivity died (fault-injected
+            # disconnect): under the failover policy that is the simulated
+            # server death — exit quietly as the casualty (the buddy is
+            # taking over), never as a world error
+            plan = getattr(self.ep, "plan", None)
+            if (
+                self.cfg.on_server_failure == "failover"
+                and plan is not None
+                and getattr(plan, "disconnected", False)
+            ):
+                self.flight.record(
+                    f"own connectivity lost ({e!r}); exiting as failover "
+                    f"casualty"
+                )
+                self.died = True
+                self.done = True
+                return
+            raise
+
+    def _run_loop_inner(self) -> None:
         interval = (
             self.cfg.balancer_interval
             if self.cfg.balancer == "tpu"
@@ -556,6 +663,7 @@ class Server:
                     if m2 is None:
                         break
                     self._handle(m2)
+            self._flush_repl()
             self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
 
     def _handle(self, m: Msg) -> None:
@@ -602,6 +710,42 @@ class Server:
             handler(m)
 
     def _periodic(self, now: float, interval: float) -> None:
+        if self._pending_promotion:
+            # SS_SERVER_DEAD arrived but the dead server's own EOF has
+            # not: promote at the deadline anyway (the death may predate
+            # any connection from it to us)
+            for dead, deadline in list(self._pending_promotion.items()):
+                if now >= deadline:
+                    del self._pending_promotion[dead]
+                    self._promote(dead)
+        if self._suspect_servers:
+            # server EOF during termination: a finished peer's normal
+            # exit if the world completes promptly, a real death if not
+            for srv, deadline in list(self._suspect_servers.items()):
+                if now >= deadline:
+                    del self._suspect_servers[srv]
+                    if not self.done and srv not in self._dead_servers:
+                        self._declare_server_dead(srv)
+        if self._takeover_renotify and now >= self._next_renotify:
+            # repair lost TA_HOME_TAKEOVER notes (the promote-time fan-out
+            # is one connect attempt per rank): re-announce ~1/s to every
+            # live, unfinalized app until the client windows close
+            self._next_renotify = now + 1.0
+            for dead, until in list(self._takeover_renotify.items()):
+                if now >= until:
+                    del self._takeover_renotify[dead]
+                    continue
+                for r in self.world.app_ranks:
+                    if r in self._dead_ranks or r in self._finalized:
+                        continue
+                    try:
+                        self.ep.send(
+                            r, msg(Tag.TA_HOME_TAKEOVER, self.rank,
+                                   dead=dead, epoch=self._fo_epoch),
+                            connect_grace=0.25,
+                        )
+                    except OSError:
+                        pass
         if self._pending_delta and now >= self._delta_deadline:
             self._flush_task_deltas(now)
         if now >= self._next_state_sync:
@@ -667,12 +811,16 @@ class Server:
         owner's pins are findable in O(its leases) at reclaim time."""
         self.wq.pin(seqno, rank)
         self.leases.grant(seqno, rank)
+        if self.repl is not None:
+            self.repl.log_pin(seqno, rank)
 
     def _consume(self, unit) -> None:
         """Remove a fetched/inlined unit and settle its lease + memory."""
         self.wq.remove(unit.seqno)
         self.leases.release(unit.seqno)
         self.mem.free(len(unit.payload))
+        if self.repl is not None:
+            self.repl.log_consume(unit.seqno)
 
     def _send_app(self, app: int, m: Msg) -> bool:
         """Protocol response to an app rank. Under the reclaim policy a
@@ -721,6 +869,8 @@ class Server:
         unit.pinned = False
         unit.pin_rank = -1
         self.wq.add(unit)
+        if self.repl is not None:
+            self.repl.log_put(unit, -1, None)
         if unit.common_seqno >= 0 and prefix_fetched:
             # the dead requester fetched the prefix before this fetch
             # (Get_reserved orders common-first); the re-consumption
@@ -894,7 +1044,7 @@ class Server:
         # sender — stats tokens are droppable, the protocol ring is not
         try:
             self.ep.send(
-                self.world.ring_next(self.rank),
+                self._ring_next_live(),
                 msg(Tag.SS_PERIODIC_STATS, self.rank, token=token),
             )
         except OSError:
@@ -978,16 +1128,22 @@ class Server:
                 f"({list(self.world.server_ranks)}); restore with the same "
                 f"world shape"
             )
-        units, centries = checkpoint.load_shard(prefix, self.rank)
+        units, centries = checkpoint.load_shard(prefix, self.rank, self.world)
         for u in units:
             payload = u.pop("payload")
             self.mem.alloc(len(payload))
-            self.wq.add(WorkUnit(seqno=self._next_seqno, payload=payload,
-                                 home_server=self.rank, **u))
+            unit = WorkUnit(seqno=self._next_seqno, payload=payload,
+                            home_server=self.rank, **u)
+            self.wq.add(unit)
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
             self._next_seqno += 1
         for seqno, refcnt, ngets, buf in centries:
             self.mem.alloc(len(buf))
             self.cq.restore(seqno, refcnt, ngets, buf)
+            if self.repl is not None:
+                self.repl.log_common_put(seqno, buf)
+                self.repl.log_common_state(seqno, refcnt, ngets, 0)
         aprintf(
             self.cfg.aprintf_flag, self.rank,
             f"restored {len(units)} units, {len(centries)} common entries "
@@ -998,7 +1154,7 @@ class Server:
         from adlb_tpu.runtime import checkpoint
 
         return checkpoint.save_shard(prefix, self.rank, self.wq.units(),
-                                     self.cq)
+                                     self.cq, world=self.world)
 
     def _on_fa_checkpoint(self, m: Msg) -> None:
         # native clients carry the path as bytes over the TLV codec
@@ -1032,10 +1188,9 @@ class Server:
             if self.world.nservers == 1:
                 self._ack_checkpoint(token)
             else:
-                self.ep.send(
-                    self.world.ring_next(self.rank),
-                    msg(Tag.SS_CHECKPOINT, self.rank, started=True,
-                        token=token),
+                self._ring_forward(
+                    lambda nxt: msg(Tag.SS_CHECKPOINT, self.rank,
+                                    started=True, token=token)
                 )
             return
         token = m.token
@@ -1045,9 +1200,9 @@ class Server:
         token["counts"][self.rank] = self._write_checkpoint_shard(
             token["path"]
         )
-        self.ep.send(
-            self.world.ring_next(self.rank),
-            msg(Tag.SS_CHECKPOINT, self.rank, started=True, token=token),
+        self._ring_forward(
+            lambda nxt: msg(Tag.SS_CHECKPOINT, self.rank, started=True,
+                            token=token)
         )
 
     def _ack_checkpoint(self, token: dict) -> None:
@@ -1174,6 +1329,8 @@ class Server:
         )
         self._next_seqno += 1
         self.wq.add(unit)
+        if self.repl is not None:
+            self.repl.log_put(unit, m.src, put_id)
         self.stats[InfoKey.MAX_WQ_COUNT] = max(
             self.stats[InfoKey.MAX_WQ_COUNT], self.wq.count
         )
@@ -1186,6 +1343,12 @@ class Server:
             self._pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
         self._put_record(m.src, put_id)
+        # write-ahead replication: the unit's log entry must be on the
+        # wire BEFORE the accept ack, or a server death in between loses
+        # an acked put uncountably (the client, once acked, never
+        # re-sends). One extra one-way frame per accepted put, failover
+        # mode only.
+        self._flush_repl()
         self._send_app(
             m.src,
             msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS, put_id=put_id),
@@ -1232,6 +1395,9 @@ class Server:
             )
             return
         seqno = self.cq.put(m.payload)
+        if self.repl is not None:
+            self.repl.log_common_put(seqno, m.payload)
+        self._flush_repl()  # write-ahead, like the put ack
         self.ep.send(
             m.src,
             msg(Tag.TA_PUT_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
@@ -1239,7 +1405,19 @@ class Server:
         )
 
     def _on_batch_done(self, m: Msg) -> None:
-        self.cq.set_refcnt(m.common_seqno, m.refcnt)
+        cseq = m.common_seqno
+        fo = m.data.get("fo_from")
+        if fo is not None:
+            # rerouted from a failed-over server: translate to the adopted
+            # prefix — applying the dead server's seqno untranslated could
+            # finalize an UNRELATED local prefix's refcount
+            cseq = self._adopted_common_for(fo, cseq)
+            if cseq is None:
+                return  # prefix lost to replication lag; members' fetches
+                #         are counted at _on_get_common
+        if self.repl is not None:
+            self.repl.log_common_refcnt(cseq, m.refcnt)
+        self.cq.set_refcnt(cseq, m.refcnt)
 
     def _on_did_put_at_remote(self, m: Msg) -> None:
         """A targeted put landed off the target's home server; record it and,
@@ -1390,6 +1568,31 @@ class Server:
         )
 
     def _on_get_reserved(self, m: Msg) -> None:
+        fo = m.data.get("fo_from")
+        if fo is not None:
+            # fetch rerouted from a failed-over server: the adopted pin
+            # serves under its translated seqno; a consumed-at-death unit
+            # (tombstone — its response died with the server) or one lost
+            # to replication lag answers ADLB_RETRY (re-reserve), counted
+            new = self._adopted_units.get((fo, m.seqno))
+            if new is None:
+                # once per (dead server, seqno): the promote pass may
+                # already have counted it (lost prefix), and a re-sent
+                # fetch must not count it twice
+                if (fo, m.seqno) not in self._counted_lost:
+                    self._counted_lost.add((fo, m.seqno))
+                    self._m_failover_lost.inc()
+                    self.flight.record(
+                        f"failover_lost fetch seqno={m.seqno} from={fo} "
+                        f"rank={m.src} "
+                        f"tombstoned={(fo, m.seqno) in self._adopted_tombs}"
+                    )
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_GET_RESERVED_RESP, self.rank, rc=ADLB_RETRY),
+                )
+                return
+            m.data["seqno"] = new
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned or unit.pin_rank != m.src:
             cached = self._last_get_resp.get(m.src)
@@ -1407,6 +1610,19 @@ class Server:
                 # pre-death lease was reclaimed (the unit re-enqueued or
                 # already consumed elsewhere), so the handle is void —
                 # a retriable code tells it to re-reserve, not to die
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_GET_RESERVED_RESP, self.rank, rc=ADLB_RETRY),
+                )
+                return
+            if self._failover:
+                # a failover sweep may have unpinned/re-matched this unit
+                # (its handoff was routed via a dead home server): the
+                # handle is void, not a protocol error — re-reserve
+                self.flight.record(
+                    f"void handle seqno={m.seqno} rank={m.src} "
+                    f"(failover sweep); answering ADLB_RETRY"
+                )
                 self._send_app(
                     m.src,
                     msg(Tag.TA_GET_RESERVED_RESP, self.rank, rc=ADLB_RETRY),
@@ -1438,6 +1654,34 @@ class Server:
             self._requeue_consumed(unit)
 
     def _on_get_common(self, m: Msg) -> None:
+        fo = m.data.get("fo_from")
+        if fo is not None:
+            # fetch rerouted from a failed-over server: translate to the
+            # adopted prefix
+            new = self._adopted_common_for(fo, m.common_seqno)
+            if new is None:
+                # prefix lost to replication lag: a counted loss answered
+                # with ADLB_RETRY — the consumer discards this member and
+                # re-reserves (ADLB_ERROR would read as terminal and the
+                # unit would vanish UNcounted, breaking the conservation
+                # contract of USERGUIDE §9). Idempotent under re-sends:
+                # the same request replayed across churn answers RETRY
+                # again without a second count.
+                gid = m.data.get("get_id")
+                if gid is None or self._last_common.get(m.src) != gid:
+                    if gid is not None:
+                        self._last_common[m.src] = gid
+                    self._m_failover_lost.inc()
+                    self.flight.record(
+                        f"failover_lost common fo_from={fo} "
+                        f"seqno={m.common_seqno} from {m.src}"
+                    )
+                self._send_app(
+                    m.src, msg(Tag.TA_GET_COMMON_RESP, self.rank,
+                               rc=ADLB_RETRY, payload=b""),
+                )
+                return
+            m.data["common_seqno"] = new
         get_id = m.data.get("get_id")
         if get_id is not None and self._last_common.get(m.src) == get_id:
             # duplicate of the fetch we just served (matched by request
@@ -1454,6 +1698,11 @@ class Server:
             return
         if get_id is not None:
             self._last_common[m.src] = get_id
+        if self.repl is not None:
+            self.repl.log_common_op(
+                m.common_seqno, "get", m.src,
+                get_id if get_id is not None else -1,
+            )
         buf = self.cq.get(m.common_seqno)
         if buf is None:
             # gone: a reclaim double-get outran its credit (narrow race)
@@ -1557,7 +1806,7 @@ class Server:
             f"rfr -> server {server} for rank {entry.world_rank} "
             f"(targeted={targeted_lookup})"
         )
-        self.ep.send(
+        self._send_srv(
             server,
             msg(
                 Tag.SS_RFR,
@@ -1612,7 +1861,16 @@ class Server:
                 payload=unit.payload,
                 time_on_q=time.monotonic() - unit.time_stamp,
             )
-        self.ep.send(dest, msg(Tag.SS_RFR_RESP, self.rank, **fields))
+        if self._send_srv(
+            dest, msg(Tag.SS_RFR_RESP, self.rank, **fields)
+        ) is None:
+            # requester's home died before the response left: undo the
+            # pin so the unit stays matchable (like an UNRESERVE)
+            self._relay_inflight.pop(unit.seqno, None)
+            self.wq.unpin(unit.seqno)
+            self.leases.release(unit.seqno)
+            if self.repl is not None:
+                self.repl.log_unpin(unit.seqno)
 
     def _on_rfr(self, m: Msg) -> None:
         req_types = None if m.req_types is None else frozenset(m.req_types)
@@ -1623,7 +1881,7 @@ class Server:
                 fetch=bool(m.data.get("fetch", False)),
             )
         else:
-            self.ep.send(
+            self._send_srv(
                 m.src,
                 msg(
                     Tag.SS_RFR_RESP,
@@ -1652,7 +1910,7 @@ class Server:
                 # owner (rank-dead reclaim re-matched it). A payload that
                 # rode along is simply discarded: the unit is still pinned
                 # at the holder, and the UNRESERVE unpins it for re-match.
-                self.ep.send(
+                self._send_srv(
                     m.src,
                     msg(Tag.SS_UNRESERVE, self.rank, seqno=m.seqno,
                         for_rank=app),
@@ -1698,7 +1956,7 @@ class Server:
                 delivered = self._send_app(
                     app, msg(Tag.TA_RESERVE_RESP, self.rank, **fields)
                 )
-                self.ep.send(
+                self._send_srv(
                     m.src,
                     msg(Tag.SS_DELIVERED, self.rank, seqno=m.seqno,
                         for_rank=app)
@@ -1752,6 +2010,11 @@ class Server:
                     break
 
     def _on_unreserve(self, m: Msg) -> None:
+        if m.data.get("fo_from") is not None:
+            new = self._adopted_unit_for(m)
+            if new is None:
+                return  # the pin did not survive the takeover
+            m.data["seqno"] = new
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned:
             self._relay_inflight.pop(m.seqno, None)
@@ -1765,12 +2028,19 @@ class Server:
         self._relay_inflight.pop(m.seqno, None)
         self.wq.unpin(m.seqno)
         self.leases.release(m.seqno)
+        if self.repl is not None:
+            self.repl.log_unpin(m.seqno)
         self._match_rq()
 
     def _on_delivered(self, m: Msg) -> None:
         """Remote fused fetch confirmation: the home server forwarded our
         payload-carrying RFR response to the requester, so the pinned
         unit is now consumed (the delivery IS the fetch)."""
+        if m.data.get("fo_from") is not None:
+            new = self._adopted_unit_for(m)
+            if new is None:
+                return
+            m.data["seqno"] = new
         self._relay_inflight.pop(m.seqno, None)
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned or unit.pin_rank != m.for_rank:
@@ -1799,7 +2069,7 @@ class Server:
         qid = (self.rank << 20) | self._push_seq
         self._push_offered[qid] = unit.seqno
         self._m_pushes.inc()
-        self.ep.send(
+        if self._send_srv(
             target,
             msg(
                 Tag.SS_PUSH_QUERY,
@@ -1807,16 +2077,18 @@ class Server:
                 query_id=qid,
                 nbytes=len(unit.payload),
             ),
-        )
+        ) is None:
+            self._push_offered.pop(qid, None)
 
     def _on_push_query(self, m: Msg) -> None:
         ok = self.mem.has_room(m.nbytes)
         if ok:
             self.mem.alloc(m.nbytes)  # budget reserved until WORK or DEL
             self._push_reserved[m.query_id] = m.nbytes
-        self.ep.send(
+        self._send_srv(
             m.src,
-            msg(Tag.SS_PUSH_QUERY_RESP, self.rank, query_id=m.query_id, accept=ok),
+            msg(Tag.SS_PUSH_QUERY_RESP, self.rank, query_id=m.query_id,
+                accept=ok),
         )
 
     def _on_push_query_resp(self, m: Msg) -> None:
@@ -1829,14 +2101,18 @@ class Server:
         if unit is None or unit.pinned:
             # got reserved while the query was in flight — cancel (reference
             # SS_PUSH_DEL, src/adlb.c:2182-2192)
-            self.ep.send(m.src, msg(Tag.SS_PUSH_DEL, self.rank, query_id=m.query_id))
+            self._send_srv(
+                m.src, msg(Tag.SS_PUSH_DEL, self.rank, query_id=m.query_id)
+            )
             return
         self.wq.remove(seqno)
         self.mem.free(len(unit.payload))
+        if self.repl is not None:
+            self.repl.log_remove(seqno)
         self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
         if unit.target_rank >= 0:
             home = self.world.home_server(unit.target_rank)
-            self.ep.send(
+            self._send_srv(
                 home,
                 msg(
                     Tag.SS_MOVING_TARGETED_WORK,
@@ -1847,7 +2123,7 @@ class Server:
                     to_server=m.src,
                 ),
             )
-        self.ep.send(
+        sent_to = self._send_srv(
             m.src,
             msg(
                 Tag.SS_PUSH_WORK,
@@ -1865,6 +2141,14 @@ class Server:
                 time_stamp=unit.time_stamp,
             ),
         )
+        if sent_to is None:
+            # the accepting peer died before the payload left: a unit
+            # already admitted to the system is never dropped — keep it
+            self.mem.alloc(len(unit.payload))
+            self.wq.add(unit)
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
+            self.stats[InfoKey.NPUSHED_FROM_HERE] -= 1
 
     def _on_push_work(self, m: Msg) -> None:
         self._push_reserved.pop(m.query_id, None)  # budget now owned by the unit
@@ -1883,6 +2167,8 @@ class Server:
         )
         self._next_seqno += 1
         self.wq.add(unit)
+        if self.repl is not None:
+            self.repl.log_put(unit, -1, None)
         self.stats[InfoKey.NPUSHED_TO_HERE] += 1
         self._match_rq()
 
@@ -1894,10 +2180,11 @@ class Server:
     def _on_moving_targeted(self, m: Msg) -> None:
         """Home-server directory fixup when targeted work migrates
         (reference ``src/adlb.c:2071-2108``)."""
+        n = int(m.data.get("count", 1) or 1)
         if m.from_server != self.rank:
-            self.tq.remove(m.app_rank, m.work_type, m.from_server)
+            self.tq.remove(m.app_rank, m.work_type, m.from_server, n)
         if m.to_server != self.rank:
-            self.tq.add(m.app_rank, m.work_type, m.to_server)
+            self.tq.add(m.app_rank, m.work_type, m.to_server, n)
         # the target may be parked here and able to use it now
         for cand in self.rq.entries():
             if cand.world_rank == m.app_rank and cand.wants(m.work_type):
@@ -1938,16 +2225,23 @@ class Server:
                     for s, p in self.peers.items()
                 }
                 table[self.rank] = ent
-                self.ep.send(
-                    self.world.ring_next(self.rank),
-                    msg(Tag.SS_QMSTAT, self.rank,
-                        table=table, origin=self.rank,
-                        t0=time.monotonic()),
-                )
+                try:
+                    self.ep.send(
+                        self._ring_next_live(),
+                        msg(Tag.SS_QMSTAT, self.rank,
+                            table=table, origin=self.rank,
+                            t0=time.monotonic()),
+                    )
+                except OSError:
+                    pass  # droppable token; next interval kicks a fresh one
             return
-        for s in self.world.server_ranks:
-            if s != self.rank:
-                self.ep.send(s, msg(Tag.SS_QMSTAT, self.rank, entry=ent))
+        for srv in self._live_servers():
+            try:
+                self.ep.send(srv, msg(Tag.SS_QMSTAT, self.rank, entry=ent))
+            except OSError:
+                if not self._failover:
+                    raise
+                self._note_server_unreachable(srv)
 
     def _apply_qmstat_entry(self, src: int, ent: dict) -> None:
         st = self.peers[src]
@@ -1985,11 +2279,14 @@ class Server:
                     self.stats[InfoKey.NUM_QMS_EXCEED_INT] += 1
             else:
                 m.table[self.rank] = self._qmstat_entry()
-                self.ep.send(
-                    self.world.ring_next(self.rank),
-                    msg(Tag.SS_QMSTAT, self.rank, table=m.table,
-                        origin=m.origin, t0=m.t0),
-                )
+                try:
+                    self.ep.send(
+                        self._ring_next_live(),
+                        msg(Tag.SS_QMSTAT, self.rank, table=m.table,
+                            origin=m.origin, t0=m.t0),
+                    )
+                except OSError:
+                    pass  # droppable token
         else:
             self._apply_qmstat_entry(m.src, m.entry)
         # fresh knowledge may unblock parked requesters (reference
@@ -2072,10 +2369,15 @@ class Server:
             self._last_snap_empty = empty
             if not reqs_only:
                 self._last_snap_acks = dict(self._mig_acks)
-            self.ep.send(
-                self.world.master_server_rank,
-                msg(Tag.SS_STATE, self.rank, snap=snap),
-            )
+            try:
+                self.ep.send(
+                    self.world.master_server_rank,
+                    msg(Tag.SS_STATE, self.rank, snap=snap),
+                )
+            except OSError:
+                if not self._failover:
+                    raise
+                self._note_server_unreachable(self.world.master_server_rank)
 
     def _accept_snapshot(self, src: int, snap: dict) -> None:
         """Master-side snapshot intake, shared by the local and remote
@@ -2151,18 +2453,23 @@ class Server:
         seqnos, wtypes, prios, lens = zip(*self._pending_delta)
         self._pending_delta.clear()
         self._last_event_snap = now
-        self.ep.send(
-            self.world.master_server_rank,
-            msg(
-                Tag.SS_STATE_DELTA,
-                self.rank,
-                seqnos=list(seqnos),
-                work_types=list(wtypes),
-                prios=list(prios),
-                work_lens=list(lens),
-                nbytes=self.mem.curr,
-            ),
-        )
+        try:
+            self.ep.send(
+                self.world.master_server_rank,
+                msg(
+                    Tag.SS_STATE_DELTA,
+                    self.rank,
+                    seqnos=list(seqnos),
+                    work_types=list(wtypes),
+                    prios=list(prios),
+                    work_lens=list(lens),
+                    nbytes=self.mem.curr,
+                ),
+            )
+        except OSError:
+            if not self._failover:
+                raise
+            self._note_server_unreachable(self.world.master_server_rank)
 
     def _merge_task_delta(
         self, src: int, seqnos, work_types, prios, work_lens, nbytes: int,
@@ -2240,10 +2547,10 @@ class Server:
         self._hungry = hungry
         self._hungry_any = hungry and req_types is None
         self._hungry_types = frozenset(req_types or ())
-        for s in self.world.server_ranks:
-            if s != self.rank:
+        for srv in self._live_servers():
+            try:
                 self.ep.send(
-                    s,
+                    srv,
                     msg(
                         Tag.SS_HUNGRY,
                         self.rank,
@@ -2253,6 +2560,10 @@ class Server:
                         grew=int(grew),
                     ),
                 )
+            except OSError:
+                if not self._failover:
+                    raise
+                self._note_server_unreachable(srv)
 
     def _hungry_for(self, work_type: int) -> bool:
         return self._hungry and (
@@ -2273,6 +2584,9 @@ class Server:
         """Enact one plan entry: validate against live state, pin, and hand
         off through the RFR response path (plan staleness compensated exactly
         like RFR races)."""
+        if m.data.get("fo_from") is not None:
+            return  # plan named the dead server's inventory: stale by
+            # construction (the master re-plans from the buddy's snapshot)
         unit = self.wq.get(m.seqno)
         if unit is None or unit.pinned or unit.target_rank >= 0:
             return  # stale plan entry; next round will re-plan
@@ -2286,6 +2600,8 @@ class Server:
         unpinned, untargeted) units to `dest` so consumers there match
         locally. Demand-driven placement — the planner's generalization of
         the reference's memory-pressure-only push (``src/adlb.c:509-556``)."""
+        if m.data.get("fo_from") is not None:
+            return  # plan named the dead server's inventory: stale
         units = []
         for seqno in m.seqnos:
             unit = self.wq.get(seqno)
@@ -2293,6 +2609,8 @@ class Server:
                 continue  # stale plan entry
             self.wq.remove(seqno)
             self.mem.free(len(unit.payload))
+            if self.repl is not None:
+                self.repl.log_remove(seqno)
             self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
             units.append(
                 {
@@ -2318,12 +2636,31 @@ class Server:
         # destination until the TTLs expired — observed as whole worker
         # pools parked ~180 ms mid-run (round 4) while a neighbor held
         # hundreds of units.
-        self._migrate_unacked += 1
-        self.ep.send(
-            m.dest,
-            msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=False,
-                mig_id=m.data.get("mig_id", 0)),
+        self._send_migrate_batch(
+            m.dest, units, bounced=False, mig_id=m.data.get("mig_id", 0)
         )
+
+    def _send_migrate_batch(self, dest: int, units: list, bounced: bool,
+                            mig_id: int = 0) -> None:
+        """Ship one migration batch, tracked until acked: the units live
+        in no wq while serialized in the frame, and a destination dying
+        mid-transit must hand them back (see _on_server_dead) instead of
+        losing them."""
+        self._migrate_unacked += 1
+        self._mig_token += 1
+        tok = self._mig_token
+        sent_to = self._send_srv(
+            dest,
+            msg(Tag.SS_MIGRATE_WORK, self.rank, units=units, bounced=bounced,
+                mig_id=mig_id, mig_tok=tok),
+        )
+        if sent_to is None:
+            # destination (and any buddy route) gone: keep the units
+            self._migrate_unacked -= 1
+            for u in units:
+                self._admit_migrated_unit(u, bounced=bounced)
+            return
+        self._migrate_pending.setdefault(sent_to, {})[tok] = units
 
     def _on_migrate_work(self, m: Msg) -> None:
         # ack the planner's batch id via the next snapshot: credits for
@@ -2361,15 +2698,16 @@ class Server:
             )
             self._next_seqno += 1
             self.wq.add(unit)
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
             self.stats[InfoKey.NPUSHED_TO_HERE] += 1
-        self.ep.send(m.src, msg(Tag.SS_MIGRATE_ACK, self.rank))
+        self._send_srv(
+            m.src,
+            msg(Tag.SS_MIGRATE_ACK, self.rank,
+                mig_tok=m.data.get("mig_tok", 0)),
+        )
         if bounced_back:
-            self._migrate_unacked += 1
-            self.ep.send(
-                m.src,
-                msg(Tag.SS_MIGRATE_WORK, self.rank, units=bounced_back,
-                    bounced=True),
-            )
+            self._send_migrate_batch(m.src, bounced_back, bounced=True)
         if m.units:
             self._match_rq()
         if self.cfg.balancer == "tpu" and (m.units or mid):
@@ -2383,6 +2721,12 @@ class Server:
             self._send_snapshot()
 
     def _on_migrate_ack(self, m: Msg) -> None:
+        tok = m.data.get("mig_tok", 0)
+        if tok and self._migrate_pending.get(m.src, {}).pop(tok, None) is None:
+            # already settled by the dead-destination requeue (the ack
+            # raced the death fan-out): decrementing again would wedge
+            # the exhaustion vote on a negative unacked count
+            return
         self._migrate_unacked -= 1
         held = getattr(self, "_held_checkpoints", None)
         if held and self._migrate_unacked == 0:
@@ -2416,9 +2760,13 @@ class Server:
             return
         self.no_more_work = True
         if self.is_master:
-            for s in self.world.server_ranks:
-                if s != self.rank:
-                    self.ep.send(s, msg(Tag.SS_NO_MORE_WORK, self.rank))
+            for srv in self._live_servers():
+                try:
+                    self.ep.send(srv, msg(Tag.SS_NO_MORE_WORK, self.rank))
+                except OSError:
+                    if not self._failover:
+                        raise
+                    self._note_server_unreachable(srv)
         self._flush_rq(ADLB_NO_MORE_WORK)
 
     def _all_local_apps_parked(self) -> bool:
@@ -2506,11 +2854,10 @@ class Server:
         self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
 
     def _forward_exhaust(self, tag: Tag, token: dict) -> None:
-        nxt = self.world.ring_next(self.rank)
-        if nxt == token["origin"]:
-            self.ep.send(nxt, msg(tag, self.rank, token=token, complete=True))
-        else:
-            self.ep.send(nxt, msg(tag, self.rank, token=token, complete=False))
+        self._ring_forward(
+            lambda nxt: msg(tag, self.rank, token=token,
+                            complete=nxt == token["origin"])
+        )
 
     def _on_exhaust_chk(self, m: Msg) -> None:
         token = m.token
@@ -2559,9 +2906,13 @@ class Server:
         self._forward_exhaust(m.tag, token)
 
     def _declare_exhaustion(self) -> None:
-        for s in self.world.server_ranks:
-            if s != self.rank:
-                self.ep.send(s, msg(Tag.SS_DONE_BY_EXHAUSTION, self.rank))
+        for srv in self._live_servers():
+            try:
+                self.ep.send(srv, msg(Tag.SS_DONE_BY_EXHAUSTION, self.rank))
+            except OSError:
+                if not self._failover:
+                    raise
+                self._note_server_unreachable(srv)
         self._on_done_by_exhaustion(msg(Tag.SS_DONE_BY_EXHAUSTION, self.rank))
 
     def _on_done_by_exhaustion(self, m: Msg) -> None:
@@ -2573,6 +2924,8 @@ class Server:
 
     def _on_local_app_done(self, m: Msg) -> None:
         self._finalized.add(m.src)
+        if self.repl is not None:
+            self.repl.log_app_done(m.src)
         # a finalizing rank can never consume again: any leftover parked
         # entries (an abandoned stream's prefetch slots) must not attract
         # deliveries that would then be consumed into a closed endpoint
@@ -2597,11 +2950,9 @@ class Server:
             self._forward_end1({"origin": self.rank})
 
     def _forward_end1(self, token: dict) -> None:
-        nxt = self.world.ring_next(self.rank)
-        self.ep.send(
-            nxt,
-            msg(Tag.SS_END_1, self.rank, token=token,
-                complete=(nxt == token["origin"])),
+        self._ring_forward(
+            lambda nxt: msg(Tag.SS_END_1, self.rank, token=token,
+                            complete=(nxt == token["origin"]))
         )
 
     def _on_end_1(self, m: Msg) -> None:
@@ -2609,13 +2960,11 @@ class Server:
         token = m.token
         if m.data.get("complete") and token["origin"] == self.rank:
             # every server's local apps have finalized: circulate phase 2
-            nxt = self.world.ring_next(self.rank)
-            self.ep.send(
-                nxt,
-                msg(Tag.SS_END_2, self.rank, token=token,
-                    complete=(nxt == token["origin"])),
+            self._ring_forward(
+                lambda nxt: msg(Tag.SS_END_2, self.rank, token=token,
+                                complete=(nxt == token["origin"]))
             )
-            if self.world.nservers == 1:
+            if self._ring_next_live() == self.rank:
                 self.done = True
             return
         if self._finalized >= self.local_apps:
@@ -2631,11 +2980,9 @@ class Server:
         token = m.token
         self.done = True
         if not m.data.get("complete"):
-            nxt = self.world.ring_next(self.rank)
-            self.ep.send(
-                nxt,
-                msg(Tag.SS_END_2, self.rank, token=token,
-                    complete=(nxt == token["origin"])),
+            self._ring_forward(
+                lambda nxt: msg(Tag.SS_END_2, self.rank, token=token,
+                                complete=(nxt == token["origin"]))
             )
 
     def _on_peer_eof(self, m: Msg) -> None:
@@ -2649,10 +2996,17 @@ class Server:
         it."""
         lost_local_app = (
             self.world.is_app(m.src)
-            and self.world.home_server(m.src) == self.rank
+            and m.src in self.local_apps
             and m.src not in self._finalized
         )
         if self.done or self._aborted:
+            return
+        if self.world.is_server(m.src):
+            # server peers get the dedicated path: abort (reference
+            # semantics), or failover when the policy allows — including
+            # mid-termination, where the death is suspected first (a
+            # finished peer's exit also EOFs)
+            self._on_server_eof(m.src)
             return
         if self.no_more_work or self.done_by_exhaustion or self._ending:
             # termination underway: peer EOFs are normally benign — but a
@@ -2681,12 +3035,6 @@ class Server:
                 f"aborting the world (reference rank-failure semantics)",
             )
             self._do_abort(-3, broadcast=True)
-        elif self.world.is_server(m.src):
-            aprintf(
-                True, self.rank,
-                f"server rank {m.src} connection lost mid-run; aborting",
-            )
-            self._do_abort(-3, broadcast=True)
 
     # ------------------------------------------------- worker-death reclaim
     # No reference analogue (upstream: any rank failure kills the job,
@@ -2703,14 +3051,11 @@ class Server:
         """Home server: fan out the death and reclaim locally."""
         if rank in self._dead_ranks:
             return
-        for s in self.world.server_ranks:
-            if s != self.rank:
-                try:
-                    self.ep.send(
-                        s, msg(Tag.SS_RANK_DEAD, self.rank, rank=rank)
-                    )
-                except OSError:
-                    pass  # peer already ended: no state left to clean there
+        for srv in self._live_servers():
+            try:
+                self.ep.send(srv, msg(Tag.SS_RANK_DEAD, self.rank, rank=rank))
+            except OSError:
+                pass  # peer already ended: no state left to clean there
         self._on_rank_dead(msg(Tag.SS_RANK_DEAD, self.rank, rank=rank))
 
     def _on_rank_dead(self, m: Msg) -> None:
@@ -2719,6 +3064,8 @@ class Server:
             return
         self._dead_ranks.add(rank)
         self._m_rank_dead.inc()
+        if self.repl is not None:
+            self.repl.log_rank_dead(rank)
         self.flight.record(f"rank_dead rank={rank} declared_by={m.src}")
         # 1) the dead requester's park/steal state (every entry — a
         # streaming rank may hold several prefetch slots). Flag the rank
@@ -2769,6 +3116,8 @@ class Server:
                     )
                     continue
                 self.wq.unpin(lease.seqno)
+                if self.repl is not None:
+                    self.repl.log_unpin(lease.seqno)
                 if unit.common_seqno >= 0:
                     # the dead owner may have fetched the batch-common
                     # prefix already; the re-consumption will fetch it
@@ -2795,6 +3144,8 @@ class Server:
             self.wq.remove(u.seqno)
             self.leases.release(u.seqno)
             self.mem.free(len(u.payload))
+            if self.repl is not None:
+                self.repl.log_remove(u.seqno)
             self._m_targeted_dropped.inc()
             self._forfeit_common(u.common_seqno, u.common_server_rank)
             self.flight.record(
@@ -2845,19 +3196,31 @@ class Server:
         if common_server is None or common_server == self.rank:
             self._apply_common_op(common_seqno, op)
         else:
-            self.ep.send(
+            self._send_srv(
                 common_server,
                 msg(Tag.SS_COMMON_FORFEIT, self.rank,
                     common_seqno=common_seqno, op=op),
             )
 
-    def _apply_common_op(self, common_seqno: int, op: str) -> None:
+    def _apply_common_op(self, common_seqno: int, op: str,
+                         src: int = -1, op_id: int = -1) -> None:
+        if self.repl is not None:
+            self.repl.log_common_op(
+                common_seqno, "credit" if op == "credit" else "forfeit",
+                src, op_id,
+            )
         if op == "credit":
             self.cq.credit(common_seqno)
         else:
             self.cq.forfeit(common_seqno)
 
     def _on_common_forfeit(self, m: Msg) -> None:
+        fo = m.data.get("fo_from")
+        if fo is not None:
+            new = self._adopted_common_for(fo, m.common_seqno)
+            if new is None:
+                return  # prefix did not survive the takeover
+            m.data["common_seqno"] = new
         fid = m.data.get("get_id")
         if fid is not None:
             # client cache-hit accounting notes carry an id: a note
@@ -2873,7 +3236,8 @@ class Server:
         op = m.data.get("op", "forfeit")
         if isinstance(op, bytes):  # binary-codec peers carry it as bytes
             op = op.decode()
-        self._apply_common_op(m.common_seqno, op)
+        self._apply_common_op(m.common_seqno, op, m.src,
+                              fid if fid is not None else -1)
 
     def _resurrect(self, rank: int) -> None:
         """A rank we declared dead is talking again: the EOF was network
@@ -2886,6 +3250,601 @@ class Server:
         self.flight.record(f"reconnect rank={rank} (was declared dead)")
         if rank in self.local_apps:
             self._finalized.discard(rank)
+
+    # ------------------------------------------------- server failover
+    # Config(on_server_failure="failover"); no reference analogue — the
+    # reference's servers ARE the pool and any server death kills the job
+    # (SURVEY §5). Every server streams a replication log of its pool
+    # mutations to its ring-successor buddy (adlb_tpu/runtime/replica.py,
+    # SS_REPL frames reusing the checkpoint.py unit wire format) and
+    # passively mirrors its ring predecessor. On a server's EOF the first
+    # observer fans out SS_SERVER_DEAD; every survivor prunes the dead
+    # server from rings/gossip/plans and reroutes through its buddy; the
+    # buddy replays the mirror into its own queues — pinned units stay
+    # pinned under their leases behind a seqno translation, unpinned
+    # units re-enqueue — adopts the dead server's app ranks, and remaps
+    # clients via epoch-stamped TA_HOME_TAKEOVER.
+
+    def _live_servers(self) -> list:
+        return [
+            s for s in self.world.server_ranks
+            if s != self.rank and s not in self._dead_servers
+        ]
+
+    def _ring_next_live(self) -> int:
+        nxt = self.world.ring_next(self.rank)
+        while nxt != self.rank and nxt in self._dead_servers:
+            nxt = self.world.ring_next(nxt)
+        return nxt
+
+    def _ring_forward(self, make_msg) -> None:
+        """Forward a ring token to the next live successor; a peer that
+        turns out unreachable is noted (death evidence under failover)
+        and the recomputed successor tried instead. When this server is
+        the only live one the token self-delivers — exactly the
+        single-server ring shape the termination protocols already
+        handle."""
+        for _ in range(self.world.nservers):
+            nxt = self._ring_next_live()
+            try:
+                self.ep.send(nxt, make_msg(nxt))
+                return
+            except OSError:
+                if not self._failover or nxt == self.rank:
+                    raise
+                self._note_server_unreachable(nxt)
+
+    def _send_srv(self, dest: int, m: Msg):
+        """Server->server send that survives failover: a dead destination
+        reroutes to its buddy — stamped ``fo_from`` so content-addressed
+        seqnos translate through the takeover maps — and an unreachable
+        one becomes death evidence instead of a reactor crash. Returns
+        the rank actually sent to, or None when the send was absorbed."""
+        routed = dest
+        seen = set()
+        while routed in self._dead_servers:
+            nxt = self._srv_route.get(routed)
+            if nxt is None or nxt in seen:
+                return None
+            seen.add(nxt)
+            routed = nxt
+        if routed != dest:
+            m.data.setdefault("fo_from", dest)
+        try:
+            self.ep.send(routed, m)
+            return routed
+        except OSError:
+            if not self._failover:
+                raise
+            self.flight.record(
+                f"send to server {routed} failed ({m.tag.name})"
+            )
+            self._note_server_unreachable(routed)
+            return None
+
+    def _note_server_unreachable(self, srv: int) -> None:
+        """A send to a supposedly-live server failed: treat it as death
+        evidence (the EOF may simply not have reached us yet)."""
+        plan = getattr(self.ep, "plan", None)
+        if plan is not None and getattr(plan, "disconnected", False):
+            # OUR endpoint is the dead one (fault-injected server death):
+            # every send fails, and blaming the peers would abort the
+            # world this policy exists to save — die quietly instead
+            # (_run_loop classifies the casualty)
+            raise OSError(
+                f"server {self.rank}: own connectivity lost"
+            )
+        if (
+            srv in self._dead_servers
+            or not self.world.is_server(srv)
+            or srv == self.rank
+            or self.done
+        ):
+            return
+        self._server_eof_at.setdefault(srv, time.monotonic())
+        if self._failover and self._can_failover(srv):
+            self._declare_server_dead(srv)
+        else:
+            self._do_abort(-3, broadcast=True)
+
+    # -- replication (primary side) -----------------------------------------
+
+    def _on_common_gc(self, e) -> None:
+        self.mem.free(len(e.buf))
+        if self.repl is not None:
+            self.repl.log_common_op(e.seqno, "gc")
+
+    def _flush_repl(self) -> None:
+        r = self.repl
+        if r is None:
+            return
+        self._g_repl_lag.set(r.pending)
+        blob = r.take()
+        if blob is None:
+            return
+        try:
+            self.ep.send(
+                r.buddy, msg(Tag.SS_REPL, self.rank, blob=blob, seq=r.seq)
+            )
+        except OSError:
+            self.flight.record("replication flush failed (buddy gone?)")
+            self._note_server_unreachable(r.buddy)
+
+    def _rebootstrap_repl(self, new_buddy: int) -> None:
+        """Our buddy died: re-target the replication stream at the next
+        live successor, seeding it with a full-state bootstrap (the
+        mirror there starts empty)."""
+        from adlb_tpu.runtime import replica
+
+        if new_buddy == self.rank:
+            self.repl = None  # no live peer left to replicate to
+            return
+        r = replica.ReplicationLog(new_buddy)
+        for u in self.wq.units():
+            r.log_put(u, -1, None)  # carries the pin state
+        for e in self.cq.entries():
+            r.log_common_put(e.seqno, e.buf)
+            r.log_common_state(e.seqno, e.refcnt, e.ngets, e.credits)
+        for rank in self._finalized:
+            r.log_app_done(rank)
+        for rank in self._dead_ranks:
+            r.log_rank_dead(rank)
+        # dedup windows: without these, a put this server acked (or a
+        # get/forfeit it accounted) re-sent after a later death of THIS
+        # server would be applied twice by the new buddy
+        for src, (_ids, order) in self._seen_puts.items():
+            r.log_seen_puts(src, order)
+        for src, gid in self._last_common.items():
+            r.log_common_op(-1, "get", src, gid)
+        for src, (_ids, order) in self._seen_forfeits.items():
+            for fid in order:
+                r.log_common_op(-1, "forfeit", src, fid)
+        self.repl = r
+        self.flight.record(
+            f"replication re-bootstrapped to server {new_buddy} "
+            f"({len(list(self.wq.units()))} units)"
+        )
+
+    def _on_repl(self, m: Msg) -> None:
+        if not self._failover:
+            return  # a misconfigured peer's stream is ignorable
+        from adlb_tpu.runtime import replica
+
+        self.mirrors.setdefault(
+            m.src, replica.ReplicaMirror(m.src)
+        ).apply(m.blob)
+
+    # -- death detection & fan-out ------------------------------------------
+
+    def _can_failover(self, dead: int) -> bool:
+        """Only a NON-master server with a live buddy candidate can fail
+        over; the master (balancer brain, exhaustion/END initiator) and
+        the no-live-peer case still abort."""
+        if not self._failover:
+            return False
+        if dead == self.world.master_server_rank:
+            return False
+        from adlb_tpu.runtime import replica
+
+        return replica.buddy_of(self.world, dead, self._dead_servers) != dead
+
+    def _on_server_eof(self, src: int) -> None:
+        """A server peer's connection closed mid-run (before this server
+        is done): death, unless termination is underway — a finished peer
+        exits normally then, so during termination the death is only
+        *suspected* and declared if the world has not completed shortly."""
+        self._server_eof_at.setdefault(src, time.monotonic())
+        # genuine inbound EOF: handled in queue order, so every SS_REPL
+        # frame this connection carried has already been applied
+        self._server_tail_drained.add(src)
+        if src in self._pending_promotion:
+            # the fan-out beat the EOF here; the EOF closes the tail
+            # window — every replication frame from src has now drained
+            del self._pending_promotion[src]
+            self._promote(src)
+            return
+        if src in self._dead_servers:
+            return
+        if self.no_more_work or self.done_by_exhaustion or self._ending:
+            if self._failover and self._can_failover(src):
+                self._suspect_servers.setdefault(
+                    src, time.monotonic() + 2.0
+                )
+            return  # abort policy: benign, as in the reference teardown
+        if self._failover and self._can_failover(src):
+            aprintf(
+                True, self.rank,
+                f"server rank {src} connection lost mid-run; failing over "
+                f"(on_server_failure=failover)",
+            )
+            self._declare_server_dead(src)
+            return
+        aprintf(
+            True, self.rank,
+            f"server rank {src} connection lost mid-run; aborting",
+        )
+        self._do_abort(-3, broadcast=True)
+
+    def _declare_server_dead(self, dead: int) -> None:
+        if dead in self._dead_servers or self.done:
+            return
+        epoch = self._fo_epoch + 1
+        for s in self._live_servers():
+            if s == dead:
+                continue
+            try:
+                self.ep.send(
+                    s, msg(Tag.SS_SERVER_DEAD, self.rank, rank=dead,
+                           epoch=epoch)
+                )
+            except OSError:
+                pass  # its own EOF/evidence will catch up
+        self._on_server_dead(
+            msg(Tag.SS_SERVER_DEAD, self.rank, rank=dead, epoch=epoch)
+        )
+
+    def _on_server_dead(self, m: Msg) -> None:
+        dead = m.rank
+        if dead in self._dead_servers or dead == self.rank:
+            return
+        from adlb_tpu.runtime import replica
+
+        if not self._can_failover(dead):
+            # master death, or no live buddy left: unrecoverable
+            aprintf(
+                True, self.rank,
+                f"server rank {dead} died and cannot fail over "
+                f"(master={dead == self.world.master_server_rank}); "
+                f"aborting",
+            )
+            self._do_abort(-3, broadcast=True)
+            return
+        self._dead_servers.add(dead)
+        self._suspect_servers.pop(dead, None)
+        self._fo_epoch = max(self._fo_epoch, m.data.get("epoch", 0) or 0)
+        buddy = replica.buddy_of(self.world, dead, self._dead_servers)
+        self._srv_route[dead] = buddy
+        self._m_server_dead.inc()
+        self.flight.record(
+            f"server_dead rank={dead} declared_by={m.src} buddy={buddy} "
+            f"epoch={self._fo_epoch}"
+        )
+        # 1) gossip/steal state: forget the dead peer, repoint targeted
+        # directory entries at its buddy, release RFR/push state that
+        # would otherwise block forever on a response that never comes
+        self.peers.pop(dead, None)
+        self.tq.repoint(dead, buddy)
+        self._rfr_out.clear()
+        for excluded in self._rfr_excluded.values():
+            excluded.discard(dead)
+        self._push_offered.clear()
+        for qid in [q for q in self._push_reserved if (q >> 20) == dead]:
+            self.mem.free(self._push_reserved.pop(qid))
+        # 2) migration batches in transit TO the dead server: the units
+        # serialized inside unacked SS_MIGRATE_WORK frames live in no wq
+        # anywhere — take them back
+        for tok, units in self._migrate_pending.pop(dead, {}).items():
+            self._migrate_unacked -= 1
+            for u in units:
+                self._admit_migrated_unit(u, bounced=False)
+            self.flight.record(
+                f"migrate batch tok={tok} to dead server {dead} "
+                f"requeued ({len(units)} units)"
+            )
+        held = getattr(self, "_held_checkpoints", None)
+        if held and self._migrate_unacked == 0:
+            self._held_checkpoints = []
+            for h in held:
+                self._process_checkpoint(h)
+        # 3) our own replication stream: if the dead server was our
+        # buddy, re-bootstrap toward the next live successor
+        if self.repl is not None and self.repl.buddy == dead:
+            self._rebootstrap_repl(
+                replica.buddy_of(self.world, self.rank, self._dead_servers)
+            )
+        # 4) master: retire the dead server's snapshot so plans stop
+        # naming it, and re-kick a possibly-lost END_1 token
+        if self.is_master:
+            if self.cfg.balancer == "tpu":
+                self._snapshots.pop(dead, None)
+                self._req_sigs.pop(dead, None)
+                self._broadcast_hungry(self._hungry_tracker.update(dead, []))
+                if self._balancer is not None:
+                    self._balancer.wake.set()
+            if not self.done and (self._ending or self._end1_pending) and (
+                self._finalized >= self.local_apps
+            ):
+                self._end1_pending = True
+                self._forward_end1({"origin": self.rank})
+        # the topology change is activity: an exhaustion vote must not
+        # conclude across it
+        self.activity += 1
+        self._exhaust_held_since = None
+        # 5) off-home targeted inventory for ranks the buddy adopts: the
+        # buddy's directory starts empty, so re-announce what WE hold
+        if buddy != self.rank:
+            # one pass over the wq (this runs inside the latency-critical
+            # failover window; a rescan per announced pair would be
+            # O(units x pairs))
+            counts: dict[tuple[int, int], int] = {}
+            for u in self.wq.units():
+                if (
+                    u.target_rank >= 0
+                    and self.world.home_server(u.target_rank) == dead
+                ):
+                    key = (u.target_rank, u.work_type)
+                    counts[key] = counts.get(key, 0) + 1
+            for (t_rank, wtype), n in counts.items():
+                try:
+                    self.ep.send(
+                        buddy,
+                        msg(Tag.SS_MOVING_TARGETED_WORK, self.rank,
+                            app_rank=t_rank, work_type=wtype,
+                            from_server=dead, to_server=self.rank,
+                            count=n),
+                    )
+                except OSError:
+                    pass
+        # 6) handoffs routed THROUGH the dead home server: units pinned
+        # here for its app ranks went out as RFR/plan responses via the
+        # dead home, so their resolution (SS_DELIVERED / UNRESERVE / the
+        # client's fetch after an undelivered handle) may have died with
+        # it. A fused relay's payload may already have been forwarded —
+        # at-most-once wins (delivered-at-death, as in the rank-death
+        # sweep); a handle-shaped handoff unpins so the unit re-matches
+        # (an owner that DID receive the handle gets ADLB_RETRY on its
+        # fetch and re-reserves).
+        swept = 0
+        for r in self.world.local_apps(dead):
+            if r in self._dead_ranks:
+                continue
+            for lease in self.leases.owned_by(r):
+                unit = self.wq.get(lease.seqno)
+                if unit is None or not unit.pinned or unit.pin_rank != r:
+                    continue
+                if self._relay_inflight.get(lease.seqno) == r:
+                    self._relay_inflight.pop(lease.seqno, None)
+                    self._consume(unit)
+                    self.flight.record(
+                        f"relay_consumed_on_failover seqno={lease.seqno} "
+                        f"rank={r} via={dead}"
+                    )
+                    continue
+                self.leases.release(lease.seqno)
+                self.wq.unpin(lease.seqno)
+                if self.repl is not None:
+                    self.repl.log_unpin(lease.seqno)
+                if unit.common_seqno >= 0:
+                    # the owner may have fetched the prefix already (the
+                    # handle path orders common-first); the re-match
+                    # fetches again — bounded-leak direction, as in the
+                    # reclaim sweep
+                    self._forfeit_common(
+                        unit.common_seqno, unit.common_server_rank,
+                        op="credit",
+                    )
+                swept += 1
+        if swept:
+            self.flight.record(
+                f"unpinned {swept} handoffs routed via dead server {dead}"
+            )
+            self._match_rq()
+        # 7) the buddy replays the mirror and takes over; held until the
+        # dead server's own EOF drains its replication tail (bounded —
+        # the death may predate any connection from it to us)
+        if buddy == self.rank:
+            if dead in self._server_tail_drained:
+                self._promote(dead)
+            else:
+                self._pending_promotion[dead] = time.monotonic() + 2.0
+        # parked requesters whose RFRs died with the server re-arm
+        for entry in self.rq.entries():
+            if entry.world_rank not in self._rfr_out:
+                self._try_rfr(entry)
+
+    def _admit_migrated_unit(self, u: dict, bounced: bool) -> None:
+        """Install one migrated-unit record into the local wq (shared by
+        the normal SS_MIGRATE_WORK intake and the dead-destination
+        requeue). Admission control only on first sight; a unit already
+        admitted to the system is never dropped."""
+        self.mem.alloc(len(u["payload"]))
+        unit = WorkUnit(
+            seqno=self._next_seqno,
+            work_type=u["work_type"],
+            prio=u["prio"],
+            target_rank=-1,
+            answer_rank=u["answer_rank"],
+            payload=u["payload"],
+            home_server=u["home_server"],
+            common_len=u["common_len"],
+            common_server_rank=u["common_server"],
+            common_seqno=u["common_seqno"],
+            time_stamp=u["time_stamp"],
+        )
+        self._next_seqno += 1
+        self.wq.add(unit)
+        if self.repl is not None:
+            self.repl.log_put(unit, -1, None)
+        self.stats[InfoKey.NPUSHED_TO_HERE] += 1
+
+    # -- takeover (buddy side) ----------------------------------------------
+
+    def _promote(self, dead: int) -> None:
+        """Replay the dead predecessor's mirrored shard into this
+        server's live queues and take over home-server duty for its app
+        ranks."""
+        if self.done:
+            return
+        mirror = self.mirrors.pop(dead, None)
+        if mirror is None:
+            # double failure: the shard died with its buddy before any
+            # replication frame reached us — unrecoverable
+            aprintf(
+                True, self.rank,
+                f"server rank {dead} died but no replica of its shard "
+                f"exists here (buddy died before promotion?); aborting",
+            )
+            self._do_abort(-3, broadcast=True)
+            return
+        mirror.seal()
+        t0 = self._server_eof_at.get(dead, time.monotonic())
+        # 1) batch-common prefixes first (units reference them)
+        for old_cseq, (buf, refcnt, ngets, credits) in sorted(
+            mirror.commons.items()
+        ):
+            self.mem.alloc(len(buf))
+            new_cseq = self.cq.adopt(buf, refcnt, ngets, credits)
+            self._adopted_commons[(dead, old_cseq)] = new_cseq
+            if self.repl is not None:
+                self.repl.log_common_put(new_cseq, buf)
+                self.repl.log_common_state(new_cseq, refcnt, ngets, credits)
+        # 2) units: pinned-to-a-live-client survive PINNED under their
+        # lease behind a seqno translation (the client's in-flight fetch
+        # lands here via the fo_from reroute); everything else re-enqueues
+        adopted = pinned_kept = lost = 0
+        for old_seqno in sorted(mirror.units):
+            f = mirror.units[old_seqno]
+            pin_rank = mirror.pins.get(old_seqno, -1)
+            target = f["target_rank"]
+            cs, cseq = f["common_server_rank"], f["common_seqno"]
+            clen = f["common_len"]
+            if cseq >= 0 and cs == dead:
+                new_c = self._adopted_commons.get((dead, cseq))
+                if new_c is None:
+                    # prefix lost to replication lag: the suffix alone is
+                    # not the unit — counted ONCE here (registered so the
+                    # pin owner's later fetch answers RETRY uncounted)
+                    lost += 1
+                    self._counted_lost.add((dead, old_seqno))
+                    self._m_failover_lost.inc()
+                    self.flight.record(
+                        f"failover_lost unit={old_seqno} (prefix gone)"
+                    )
+                    continue
+                cs, cseq = self.rank, new_c
+            if target >= 0 and (
+                target in self._dead_ranks or target in mirror.dead_ranks
+            ):
+                self._m_targeted_dropped.inc()
+                self._forfeit_common(cseq, cs)
+                continue
+            if pin_rank >= 0 and pin_rank in self._dead_ranks:
+                # owner died before its home server did: reclaim rules
+                pin_rank = -1
+                if cseq >= 0:
+                    self._forfeit_common(cseq, cs, op="credit")
+            unit = WorkUnit(
+                seqno=self._next_seqno,
+                work_type=f["work_type"],
+                prio=f["prio"],
+                target_rank=target,
+                answer_rank=f["answer_rank"],
+                payload=f["payload"],
+                home_server=self.rank,
+                common_len=clen,
+                common_server_rank=cs,
+                common_seqno=cseq,
+                pinned=pin_rank >= 0,
+                pin_rank=pin_rank if pin_rank >= 0 else -1,
+            )
+            self._next_seqno += 1
+            self.mem.alloc(len(unit.payload))
+            self.wq.add(unit)
+            if pin_rank >= 0:
+                self.leases.grant(unit.seqno, pin_rank)
+                self._adopted_units[(dead, old_seqno)] = unit.seqno
+                pinned_kept += 1
+            adopted += 1
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
+        # 3) tombstones: a post-takeover fetch of a consumed unit is a
+        # counted loss (the response died with the server), not an
+        # invalid-handle abort
+        self._adopted_tombs.update((dead, s) for s in mirror.tombstones)
+        # 4) duplicate-put protection survives the failover: the dead
+        # server's accepted-put windows merge, so a client re-sending an
+        # acked-but-unanswered put gets the idempotent ack, not a dup unit
+        for src, ids in mirror.seen_puts.items():
+            for pid in ids:
+                self._put_record(src, pid)
+        # ... and the common-prefix dedup identities: a get/forfeit the
+        # dead server already accounted (and replicated) re-sent toward
+        # this buddy must be absorbed, not double-accounted against the
+        # adopted refcount state. Ids are per-client monotonic, so the
+        # newest wins for the last-get check.
+        for src, gid in mirror.last_common.items():
+            if gid > self._last_common.get(src, -1):
+                self._last_common[src] = gid
+        for src, fids in mirror.forfeit_ids.items():
+            for fid in fids:
+                self._window_seen(self._seen_forfeits, src, fid)
+        # 5) home-server duty: adopt the dead server's app ranks (with
+        # their finalize/death accounting)
+        newly = set(self.world.local_apps(dead))
+        self.local_apps |= newly
+        self._finalized |= mirror.finalized & newly
+        for r in mirror.dead_ranks:
+            self._dead_ranks.add(r)
+            self._swept_streams.add(r)
+            if r in self.local_apps:
+                self._finalized.add(r)
+        # adopted ranks' streams may hold phantom slots (reserves parked
+        # at the dead server): their next idle note re-arms them
+        self._swept_streams |= newly
+        self._m_failover_promoted.inc()
+        mttr_ms = (time.monotonic() - t0) * 1e3
+        self._g_fo_mttr.set(mttr_ms)
+        self.activity += 1
+        self._exhaust_held_since = None
+        self.flight.record(
+            f"failover_promoted dead={dead} adopted_units={adopted} "
+            f"pinned_kept={pinned_kept} lost={lost} "
+            f"commons={len(mirror.commons)} ranks={sorted(newly)} "
+            f"mttr_ms={mttr_ms:.1f}"
+        )
+        aprintf(
+            True, self.rank,
+            f"took over server {dead}: {adopted} units "
+            f"({pinned_kept} pinned), {len(mirror.commons)} common "
+            f"prefixes, app ranks {sorted(newly)}, mttr {mttr_ms:.1f} ms",
+        )
+        # 6) epoch-stamped remap: every live app learns the new home /
+        # routing (finished apps' listeners may be gone — best-effort,
+        # short connect grace)
+        note = dict(dead=dead, epoch=self._fo_epoch)
+        for r in self.world.app_ranks:
+            if r in self._dead_ranks:
+                continue
+            try:
+                self.ep.send(
+                    r, msg(Tag.TA_HOME_TAKEOVER, self.rank, **note),
+                    connect_grace=1.0,
+                )
+            except OSError:
+                pass
+        # the one-shot fan-out above is best-effort; re-announce from the
+        # periodic tick until every client's failover window has closed
+        # (the client-side apply is idempotent — duplicate notes no-op)
+        self._takeover_renotify[dead] = (
+            time.monotonic() + self.cfg.failover_client_wait
+        )
+        self.flight.dump_json(f"failover_{dead}")
+        # the adopted shard may satisfy parked requesters right now; and
+        # if every adopted rank already finalized, termination proceeds
+        self._match_rq()
+        self._maybe_complete_finalize()
+        if self.cfg.balancer == "tpu":
+            self._send_snapshot()
+
+    # -- takeover translation (content-addressed messages) --------------------
+
+    def _adopted_unit_for(self, m: Msg):
+        """Resolve a rerouted message's (dead server, old seqno) to the
+        adopted local seqno; None when the unit did not survive."""
+        return self._adopted_units.get((m.data["fo_from"], m.seqno))
+
+    def _adopted_common_for(self, fo_from: int, cseq: int):
+        return self._adopted_commons.get((fo_from, cseq))
 
     # ------------------------------------------------------- abort / watchdog
 
@@ -2905,9 +3864,13 @@ class Server:
         self.flight.record(f"abort code={code} broadcast={broadcast}")
         self.flight.dump(reason=f"abort {code}")
         if broadcast:
-            for s in self.world.server_ranks:
-                if s != self.rank:
-                    self.ep.send(s, msg(Tag.SS_ABORT, self.rank, code=code))
+            for srv in self.world.server_ranks:
+                if srv == self.rank or srv in self._dead_servers:
+                    continue
+                try:
+                    self.ep.send(srv, msg(Tag.SS_ABORT, self.rank, code=code))
+                except OSError:
+                    pass  # already-dead peer must not block the abort
         for app in self.local_apps:
             if app in self._dead_ranks:
                 continue  # no listener left; a connect-retry would stall
@@ -2990,6 +3953,11 @@ class Server:
         s = self.stats
         s[InfoKey.MALLOC_HWM] = float(self.mem.hwm)
         s[InfoKey.RSS_KB] = float(rss_kb())
+        s[InfoKey.NUM_FAILOVERS] = float(
+            self.metrics.value("failover_promoted")
+        )
+        s[InfoKey.FAILOVER_LOST] = float(self.metrics.value("failover_lost"))
+        s[InfoKey.FAILOVER_MTTR_MS] = float(self._g_fo_mttr.v)
         s[InfoKey.AVG_TIME_ON_RQ] = (
             self._rq_wait_sum / self._rq_wait_n if self._rq_wait_n else 0.0
         )

@@ -45,7 +45,9 @@ class InProcEndpoint:
         self.metrics = None
         self._tx_stats: dict = {}
 
-    def send(self, dest: int, m: Msg) -> None:
+    def send(self, dest: int, m: Msg, connect_grace: float = 0.0) -> None:
+        # connect_grace is a TCP-endpoint knob; accepted (and ignored)
+        # here so role code can pass it transport-agnostically
         self.msgs_sent += 1
         payload = m.data.get("payload")
         nbytes = (
@@ -68,7 +70,14 @@ class InProcEndpoint:
         try:
             if timeout is None:
                 return self.inbox.get()
-            return self.inbox.get(timeout=max(timeout, 0.0))
+            if timeout <= 0.0:
+                # never SimpleQueue.get(timeout=0.0): on this host class a
+                # freshly forked child's zero-timeout timed get can park
+                # forever in the lock (kernel-level; ~1/10 TCP worlds
+                # wedged in the client's first recv). get_nowait() checks
+                # the list without touching the lock and cannot hang.
+                return self.inbox.get_nowait()
+            return self.inbox.get(timeout=timeout)
         except queue.Empty:
             return None
 

@@ -284,8 +284,17 @@ class TcpEndpoint:
         try:
             if timeout is None:
                 m = self.inbox.get()
+            elif timeout <= 0.0:
+                # never SimpleQueue.get(timeout=0.0): on this host class a
+                # freshly forked child's zero-timeout timed get can park
+                # forever in the lock (kernel-level; ~1/10 TCP worlds
+                # wedged in the client's first recv — minimal repro is
+                # fork + fresh SimpleQueue + get(timeout=0.0); nonblocking
+                # gets and positive timeouts are unaffected). get_nowait()
+                # checks the list without touching the lock.
+                m = self.inbox.get_nowait()
             else:
-                m = self.inbox.get(timeout=max(timeout, 0.0))
+                m = self.inbox.get(timeout=timeout)
         except queue.Empty:
             return None
         if reg is not None:
@@ -493,7 +502,7 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
     if cfg.fault_spec:
         from adlb_tpu.runtime.faults import maybe_wrap
 
-        ep = maybe_wrap(ep, cfg)
+        ep = maybe_wrap(ep, cfg, world)
     try:
         port_q.put((rank, ep.port))
         ep.addr_map.update(conn.recv())  # full rank -> (host, port) map
@@ -514,7 +523,13 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
 
             server = Server(world, cfg, ep, abort_event)
             server.run()
-            report("server", server.finalize_stats())
+            if server.died:
+                # fault-injected connectivity death absorbed by
+                # on_server_failure="failover" (a SIGKILLed server never
+                # reports at all; the parent classifies that case)
+                report("server_dead", None)
+            else:
+                report("server", server.finalize_stats())
         else:
             from adlb_tpu.runtime.debug_server import DebugServer
 
@@ -653,6 +668,7 @@ def spawn_world(
     errors: list[str] = []
     conn_lost: list[str] = []
     casualties: list[int] = []
+    server_casualties: list[int] = []
     aborted_code = None
     real_abort = False
     reported: set[int] = set()
@@ -671,11 +687,24 @@ def spawn_world(
                     # app ranks that died without reporting are the
                     # casualties the reclaim policy absorbed; the world
                     # completing around them is the success criterion.
-                    # A missing SERVER is still fatal under both policies.
                     casualties.extend(
                         r for r in missing if world.is_app(r)
                     )
                     missing = [r for r in missing if not world.is_app(r)]
+                if cfg.on_server_failure == "failover":
+                    # non-master servers that died without reporting are
+                    # the failover casualties (SIGKILLed mid-run); their
+                    # buddies completed the world around them. The master
+                    # is still fatal.
+                    server_casualties.extend(
+                        r for r in missing
+                        if world.is_server(r) and r != world.master_server_rank
+                    )
+                    missing = [
+                        r for r in missing
+                        if not (world.is_server(r)
+                                and r != world.master_server_rank)
+                    ]
                 if missing:
                     errors.append(
                         f"rank(s) {missing} died without reporting a result"
@@ -687,6 +716,8 @@ def spawn_world(
             app_results[rank] = value
         elif kind == "server":
             server_stats[rank] = value
+        elif kind == "server_dead":
+            server_casualties.append(rank)
         elif kind == "error":
             errors.append(f"rank {rank}: {value}")
         elif kind == "conn_lost":
@@ -734,4 +765,5 @@ def spawn_world(
         aborted=abort_event.is_set() or aborted_code is not None,
         exception=None,
         casualties=sorted(casualties),
+        server_casualties=sorted(server_casualties),
     )

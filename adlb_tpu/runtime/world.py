@@ -64,7 +64,9 @@ class WorldSpec:
 
     def ring_next(self, server_rank: int) -> int:
         """Server ring successor (reference rhs_rank, ``src/adlb.c:272-283``),
-        used by the termination/exhaustion token passes."""
+        used by the termination/exhaustion token passes — and, under
+        ``on_server_failure="failover"``, the replication **buddy**: each
+        server streams its pool-mutation log to its ring successor."""
         i = server_rank - self.num_app_ranks
         return self.num_app_ranks + (i + 1) % self.nservers
 
@@ -140,6 +142,24 @@ class Config:
     # and termination counting excludes the rank. Server death aborts
     # under both policies (checkpoint/restore is the recovery path).
     on_worker_failure: str = "abort"
+    # server failure policy: "abort" preserves the reference's
+    # server-death-kills-world semantics; "failover" survives the death of
+    # a NON-master server — every server asynchronously streams a
+    # replication log of its pool mutations to its ring-successor buddy
+    # (adlb_tpu/runtime/replica.py, SS_REPL frames in the checkpoint.py
+    # unit wire format); on a server's EOF the survivors fan out
+    # SS_SERVER_DEAD, the buddy replays the log into its own queues and
+    # takes over home-server duty for the dead server's app ranks, and
+    # clients learn the epoch-stamped remap via TA_HOME_TAKEOVER.
+    # Replication-lag losses are bounded and counted (failover_lost /
+    # InfoKey.FAILOVER_LOST). Master death (and a buddy dying before its
+    # promotion completes — the double failure) still aborts. Requires
+    # server_impl="python"; inert when nservers == 1.
+    on_server_failure: str = "abort"
+    # how long a client waits for the buddy's TA_HOME_TAKEOVER after
+    # losing a server connection before declaring the world dead
+    # (failover policy only)
+    failover_client_wait: float = 15.0
     # seeded deterministic fault injection (adlb_tpu/runtime/faults.py):
     # a plain-data spec dict {seed, drop, delay, delay_s, duplicate,
     # disconnect_at: {rank: frame}, kill_at_frame: {rank: frame},
@@ -226,12 +246,23 @@ class Config:
             raise ValueError(
                 f"unknown on_worker_failure {self.on_worker_failure!r}"
             )
+        if self.on_server_failure not in ("abort", "failover"):
+            raise ValueError(
+                f"unknown on_server_failure {self.on_server_failure!r}"
+            )
         if self.on_worker_failure == "reclaim" and self.server_impl == "native":
             # the C++ daemon implements the reference fault model only;
             # failing here beats a world that silently aborts anyway
             raise ValueError(
                 "on_worker_failure='reclaim' requires server_impl='python'"
             )
+        if self.on_server_failure == "failover" and self.server_impl == "native":
+            # the C++ daemon has no replication stream or takeover protocol
+            raise ValueError(
+                "on_server_failure='failover' requires server_impl='python'"
+            )
+        if self.failover_client_wait <= 0:
+            raise ValueError("failover_client_wait must be > 0")
         if self.put_retry_cap < self.put_retry_sleep:
             raise ValueError("put_retry_cap must be >= put_retry_sleep")
         if self.reconnect_attempts < 0:

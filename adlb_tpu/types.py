@@ -52,6 +52,14 @@ class InfoKey(enum.IntEnum):
     # TCP analogue is the endpoint's received-but-unhandled frame backlog
     RSS_KB = 13
     TRANSPORT_BACKLOG = 14
+    # server-failover surface (Config(on_server_failure="failover")): how
+    # many takeovers this server performed, units counted lost to
+    # replication lag at takeover, and the last promotion's
+    # detection->promoted time in ms (the recovery-cost row bench.py
+    # records as failover_mttr_ms)
+    NUM_FAILOVERS = 15
+    FAILOVER_LOST = 16
+    FAILOVER_MTTR_MS = 17
 
 
 @dataclasses.dataclass(frozen=True)

@@ -142,15 +142,6 @@ def main() -> None:
     # whole bench record
     _NATIVE_ERRS = (RuntimeError, OSError, TimeoutError, AssertionError)
 
-    def native_retry(run_one, *args, **kw):
-        last = None
-        for attempt in range(2):  # one retry: OS-level worlds can lose a
-            try:                  # process to transient memory pressure
-                return run_one(*args, **kw)
-            except _NATIVE_ERRS as e:
-                last = e
-        raise last
-
     def hot_native(mode: str, apps: int, servers: int, n: int,
                    fetch: str = "single", work_us: int = 8000):
         def one():
@@ -164,7 +155,7 @@ def main() -> None:
             )
             return r
 
-        return native_retry(one)
+        return one()
 
     try:
         # task counts follow scripts/scaling_curve.py's sizing formula
@@ -328,7 +319,7 @@ def main() -> None:
                 )
                 return r
 
-            return native_retry(one)
+            return one()
 
         def tsp_scale_one(mode, apps, servers):
             def one():
@@ -341,7 +332,7 @@ def main() -> None:
                 )
                 return r
 
-            return native_retry(one)
+            return one()
 
         for apps, servers, tag in ((64, 16, "64r"), (128, 32, "128r")):
             for name, one in (("nq", nq_scale_one), ("tsp", tsp_scale_one)):
@@ -830,6 +821,65 @@ def main() -> None:
                           key=lambda r: r.latency_p50_ms)
     lat_tpu = median_by(coin_runs["tpu"], key=lambda r: r.latency_p50_ms)
 
+    # server-failover recovery cost (on_server_failure="failover"): an
+    # 8-rank TCP world (6 apps + 2 servers, real processes) with the
+    # NON-master server SIGKILLed mid-workload — records the buddy's
+    # detection->promotion MTTR plus the units lost (counted replication
+    # lag) / re-executed accounting, so the policy's recovery cost lands
+    # in BENCH_*.json instead of folklore. Own containment: a failed row
+    # must not discard the rest of the bench.
+    def failover_bench():
+        import struct
+
+        from adlb_tpu.runtime.transport_tcp import spawn_world as _sw
+        from adlb_tpu.types import ADLB_SUCCESS
+        from adlb_tpu.types import InfoKey as _IK
+
+        n_units = 160
+
+        def app(ctx):
+            if ctx.rank == 0:
+                for i in range(n_units):
+                    ctx.put(struct.pack("<q", i), 1)
+            got = []
+            while True:
+                rc, w = ctx.get_work([1])
+                if rc != ADLB_SUCCESS:
+                    return got
+                got.append(struct.unpack("<q", w.payload)[0])
+                time.sleep(0.002)
+
+        res = _sw(
+            6, 2, [1], app,
+            cfg=Config(on_server_failure="failover",
+                       exhaust_check_interval=0.2,
+                       fault_spec={"seed": 9,
+                                   "kill_server_at_frame": {1: 80}}),
+            timeout=240.0,
+        )
+        done = [x for v in res.app_results.values() for x in v]
+        lost = sum(s.get(int(_IK.FAILOVER_LOST), 0.0)
+                   for s in res.server_stats.values())
+        mttr = max(
+            (s.get(int(_IK.FAILOVER_MTTR_MS), 0.0)
+             for s in res.server_stats.values()),
+            default=0.0,
+        )
+        missing = len(set(range(n_units)) - set(done))
+        assert missing <= lost, f"{missing} units vanished, {lost} counted"
+        return {
+            "failover_mttr_ms": round(mttr, 1),
+            "failover_units_total": n_units,
+            "failover_units_lost": int(lost),
+            "failover_units_reexecuted": len(done) - len(set(done)),
+            "failover_server_casualties": res.server_casualties,
+        }
+
+    try:
+        failover_rows = failover_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        failover_rows = {"failover_error": repr(e)[:200]}
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -938,6 +988,7 @@ def main() -> None:
                 round(r.latency_p50_ms, 3) for r in coin_runs["steal"]],
             "tpu_pop_p50_reps": [
                 round(r.latency_p50_ms, 3) for r in coin_runs["tpu"]],
+            **failover_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1049,6 +1100,7 @@ def main() -> None:
                               round(tric_pipe_tpu.dispatch_p50_ms, 2)],
             "disp_fast_p50": round(tric_fast.dispatch_p50_ms, 2),
             # pop service latency (coinop), paired-rep medians
+            "failover_mttr_ms": failover_rows.get("failover_mttr_ms"),
             "pop_p50": [round(lat_steal.latency_p50_ms, 3),
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),

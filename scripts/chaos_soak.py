@@ -36,8 +36,33 @@ sys.path.insert(0, __import__("os").path.join(
 
 from adlb_tpu.runtime.transport_tcp import spawn_world
 from adlb_tpu.runtime.world import Config
-from adlb_tpu.types import ADLB_SUCCESS
+from adlb_tpu.types import ADLB_SUCCESS, InfoKey
 from adlb_tpu.workloads import nq
+
+
+def coverage_pool(n_units):
+    """Self-validating coverage workload for SERVER-kill adversities:
+    rank 0 pre-loads ids, everyone consumes via get_work; the world ends
+    by exhaustion. Tolerates re-execution (failover may replay a unit
+    whose migration/ack was in flight) — the oracle is id coverage
+    modulo the COUNTED replication-lag losses, asserted by the caller.
+    The answer economy would deadlock instead: rank 0 blocks on exactly
+    n_pairs answers, so a single counted loss would hang the world."""
+    def app(ctx):
+        T = 1
+        if ctx.rank == 0:
+            for i in range(n_units):
+                rc = ctx.put(struct.pack("<q", i), T)
+                assert rc == ADLB_SUCCESS
+        got = []
+        while True:
+            rc, w = ctx.get_work([T])
+            if rc != ADLB_SUCCESS:
+                return got
+            got.append(struct.unpack("<q", w.payload)[0])
+            time.sleep(0.002)
+
+    return app
 
 GARBAGE = [
     struct.pack("<I", 41) + b"\x01" + os.urandom(40),
@@ -160,6 +185,16 @@ def one_iter(seed):
     # cannot validate two terminal outcomes at once)
     do_kill = workload == "economy" and not do_abort and rng.random() < 0.35
     policy = rng.choice(["abort", "reclaim"]) if do_kill else "abort"
+    # server-kill adversity: SIGKILL a random NON-master server mid-run,
+    # under both on_server_failure policies — "abort" must classify
+    # cleanly without hanging, "failover" must complete with id coverage
+    # modulo the counted replication-lag losses (its own coverage
+    # workload; mutually exclusive with the other terminal adversities)
+    do_skill = (
+        workload == "economy" and not do_abort and not do_kill
+        and servers >= 2 and rng.random() < 0.3
+    )
+    s_policy = rng.choice(["abort", "failover"]) if do_skill else "abort"
     # seeded delay faults: protocol-invisible, timing-hostile; applied to
     # every endpoint via Config so replays of this seed shake the same
     # interleavings
@@ -170,20 +205,58 @@ def one_iter(seed):
         # descriptor honest (the spawn-plane/native coverage comes from
         # the economy iterations)
         native = False
-    if policy == "reclaim" or do_faults:
-        # the C++ daemon implements neither the reclaim protocol nor the
-        # (Python-side) fault shim
+    if policy == "reclaim" or do_faults or do_skill:
+        # the C++ daemon implements neither the reclaim/failover
+        # protocols nor the (Python-side) fault shim
         native = False
 
     kw = dict(balancer=mode, exhaust_check_interval=0.2,
-              on_worker_failure=policy)
+              on_worker_failure=policy,
+              on_server_failure=s_policy)
     if native:
         kw["server_impl"] = "native"
     if cap:
         kw["max_malloc_per_server"] = cap
     if do_faults:
         kw["fault_spec"] = {"seed": seed, "delay": 0.03, "delay_s": 0.002}
+    if do_skill:
+        # kill a random non-master server a moment into the run (frame
+        # counts track protocol activity, so the death lands mid-workload)
+        victim_srv = rng.randrange(1, servers)
+        kw["fault_spec"] = dict(
+            kw.get("fault_spec") or {},
+            kill_server_at_frame={victim_srv: rng.randint(30, 120)},
+        )
     cfg = Config(**kw)
+
+    if do_skill:
+        n_units = rng.randint(24, 60)
+        app_fn = coverage_pool(n_units)
+        desc = dict(apps=apps, servers=servers, mode=mode, native=native,
+                    cap=cap, workload="coverage", skill=True,
+                    s_policy=s_policy, victim_srv=victim_srv,
+                    faults=do_faults)
+        if s_policy == "abort":
+            t0 = time.monotonic()
+            try:
+                res = spawn_world(apps, servers, [1, 2], app_fn,
+                                  cfg=cfg, timeout=90.0)
+                # the victim server may die after the pool drained; then
+                # the world completes before the death can abort it
+                done = [x for v in res.app_results.values() for x in v]
+                assert sorted(set(done)) == list(range(n_units)), done
+            except RuntimeError:
+                assert time.monotonic() - t0 < 75.0, "server abort hung"
+            return desc
+        res = spawn_world(apps, servers, [1, 2], app_fn,
+                          cfg=cfg, timeout=150.0)
+        done = [x for v in res.app_results.values() for x in v]
+        lost = sum(s.get(int(InfoKey.FAILOVER_LOST), 0.0)
+                   for s in res.server_stats.values())
+        missing = set(range(n_units)) - set(done)
+        assert len(missing) <= lost, (sorted(missing), lost)
+        assert not res.aborted
+        return desc
 
     if workload == "economy":
         n_pairs = rng.randint(8, 40)

@@ -91,10 +91,13 @@ def _dedup_metrics(docs: list[dict]) -> list[dict]:
 
 
 # structured failure events the runtime records with a fixed leading
-# keyword (server._on_rank_dead / _resurrect, client._send_retry)
+# keyword (server._on_rank_dead / _resurrect / the failover machinery,
+# client._send_retry / _apply_takeover)
 _FAILURE_PREFIXES = (
     "rank_dead", "lease_reclaimed", "targeted_dropped", "reconnect",
     "abort", "home server", "send to rank",
+    "server_dead", "failover_promoted", "failover_lost", "home_takeover",
+    "relay_consumed_on_failover", "replication",
 )
 
 

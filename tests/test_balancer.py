@@ -184,9 +184,14 @@ def test_hungry_gates_put_snapshots(monkeypatch):
         timeout=300.0,
     )
     assert res.app_results[1] == NTASK
-    # ungated, this would be >= NTASK/2 snapshots (one per couple of
-    # puts); gated it is a few parks + the slow idle heartbeat
-    assert calls["n"] < 40, calls["n"]
+    # ungated, this would be >= NTASK/2 (150) snapshots — one per couple
+    # of puts; gated it is a few parks + the slow idle heartbeat. The
+    # heartbeat count scales with wall-clock, and under host load the
+    # world runs 2-3x longer (measured: the old < 40 bound sat exactly
+    # at the boundary ~half the time on a busy host, at this PR's base
+    # commit too) — 60 keeps the full gated/ungated discrimination
+    # without the load sensitivity.
+    assert calls["n"] < 60, calls["n"]
 
 
 def test_hungry_tracker_drop_arms_shrink():

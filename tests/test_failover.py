@@ -1,0 +1,590 @@
+"""Server failover: replicated pool shards + home-server takeover
+(Config(on_server_failure="failover"), adlb_tpu/runtime/replica.py).
+
+Four layers of coverage:
+
+* **Replication log <-> mirror** — packed entries (checkpoint.py unit
+  wire format) reconstruct the primary's units/pins/commons/tombstones.
+* **Takeover race lattice** — Server instances driven handler-by-handler:
+  promotion replays the mirror (pinned units survive behind the seqno
+  translation, tombstoned fetches answer ADLB_RETRY and are counted),
+  a fused relay in flight through the dead home server resolves
+  delivered-at-death, a held END_1 token is re-kicked by the master,
+  and the double failure (no mirror at the buddy) aborts cleanly.
+* **Checkpoint shard header (ACK2)** — world-shape validation is loud;
+  ACK1 shards stay readable.
+* **End-to-end policy acceptance** — worlds surviving a server death on
+  both fabrics with conservation asserted modulo the counted
+  replication-lag losses; the default "abort" policy unchanged.
+"""
+
+import os
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime import checkpoint, replica
+from adlb_tpu.runtime.faults import resolve_spec
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.queues import WorkUnit
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import (
+    ADLB_RETRY,
+    ADLB_SUCCESS,
+    InfoKey,
+)
+
+T = 1
+
+
+# ------------------------------------------------------- log <-> mirror
+
+
+def test_replication_log_mirror_roundtrip():
+    log = replica.ReplicationLog(buddy=4)
+    u1 = WorkUnit(seqno=10, work_type=T, prio=5, target_rank=-1,
+                  answer_rank=2, payload=b"alpha")
+    u2 = WorkUnit(seqno=11, work_type=T, prio=0, target_rank=1,
+                  answer_rank=-1, payload=b"beta", common_len=3,
+                  common_server_rank=3, common_seqno=7)
+    log.log_put(u1, src=0, put_id=42)
+    log.log_put(u2, src=0, put_id=43)
+    log.log_common_put(7, b"PFX")
+    log.log_common_refcnt(7, 2)
+    log.log_pin(10, 0)
+    log.log_consume(11)
+    log.log_app_done(1)
+    mirror = replica.ReplicaMirror(primary=3)
+    mirror.apply(log.take())
+    assert set(mirror.units) == {10}
+    assert mirror.units[10]["payload"] == b"alpha"
+    assert mirror.pins == {10: 0}
+    assert 11 in mirror.tombstones
+    assert mirror.commons[7][0] == b"PFX" and mirror.commons[7][1] == 2
+    assert mirror.commons[7][2] == 0
+    assert mirror.seen_puts[0] == [42, 43]
+    assert mirror.finalized == {1}
+    # unpin + second frame: streams are cumulative and ordered
+    log.log_unpin(10)
+    log.log_common_op(7, "get")
+    mirror.apply(log.take())
+    assert mirror.pins == {}
+    assert mirror.commons[7][2] == 1
+    # a sealed mirror ignores late frames (post-promotion tail)
+    mirror.seal()
+    log.log_consume(10)
+    mirror.apply(log.take())
+    assert 10 in mirror.units
+
+
+def test_replicated_dedup_identities():
+    """Get/forfeit ids and the re-bootstrap put-window op ride the
+    stream, so the buddy's replay windows absorb requests the dead
+    server already accounted."""
+    log = replica.ReplicationLog(buddy=4)
+    log.log_common_put(7, b"PFX")
+    log.log_common_op(7, "get", src=0, op_id=91)
+    log.log_common_op(-1, "forfeit", src=2, op_id=55)  # window-only entry
+    log.log_seen_puts(5, [1, 2, 3])
+    m = replica.ReplicaMirror(primary=3)
+    m.apply(log.take())
+    assert m.last_common == {0: 91}
+    assert m.forfeit_ids == {2: [55]}
+    assert m.seen_puts[5] == [1, 2, 3]
+    assert m.commons[7][2] == 1  # the get still accounted against ngets
+
+
+def test_buddy_of_skips_dead_successors():
+    w = WorldSpec(nranks=5, nservers=3, types=(T,))
+    assert replica.buddy_of(w, 3) == 4
+    assert replica.buddy_of(w, 3, dead_servers={4}) == 2
+    assert replica.buddy_of(w, 3, dead_servers={4, 2, 3}) == 3  # nobody
+
+
+# ------------------------------------------------------- takeover lattice
+
+# world: nranks=5, nservers=3 -> apps 0..1, servers 2 (master), 3, 4.
+# app 0 homes at 2, app 1 homes at 3; ring: 2 -> 3 -> 4 -> 2, so server
+# 4 is server 3's buddy (mirrors its replication stream).
+
+
+def _mini(rank, **cfg_kw):
+    world = WorldSpec(nranks=5, nservers=3, types=(T,))
+    fabric = InProcFabric(5)
+    cfg = Config(on_server_failure="failover", **cfg_kw)
+    return Server(world, cfg, fabric.endpoint(rank)), fabric
+
+
+def _drain(fabric, rank):
+    out = []
+    while True:
+        m = fabric.endpoints[rank].recv(timeout=0.0)
+        if m is None:
+            return out
+        out.append(m)
+
+
+def _primary_blob(extra_consumed=False):
+    """A replication stream as server 3 would have sent it: one queued
+    unit, one unit pinned for (live) app rank 1, a batch-common prefix
+    with one consumed member tombstoned."""
+    log = replica.ReplicationLog(buddy=4)
+    queued = WorkUnit(seqno=100, work_type=T, prio=1, target_rank=-1,
+                      answer_rank=-1, payload=b"queued")
+    pinned = WorkUnit(seqno=101, work_type=T, prio=0, target_rank=-1,
+                      answer_rank=-1, payload=b"pinned")
+    log.log_put(queued, src=1, put_id=7)
+    log.log_put(pinned, src=1, put_id=8)
+    log.log_pin(101, 1)
+    log.log_common_put(5, b"COMMONPFX")
+    log.log_common_refcnt(5, 1)
+    if extra_consumed:
+        consumed = WorkUnit(seqno=102, work_type=T, prio=0, target_rank=-1,
+                            answer_rank=-1, payload=b"gone")
+        log.log_put(consumed, src=1, put_id=9)
+        log.log_pin(102, 1)
+        log.log_consume(102)
+    return log.take()
+
+
+def test_promotion_replays_shard_and_takes_over_home_duty():
+    srv, fabric = _mini(4)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(extra_consumed=True),
+                    seq=1))
+    # fan-out arrives before the dead server's own EOF: promotion waits
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    assert 3 in srv._dead_servers and 3 in srv._pending_promotion
+    assert srv.wq.count == 0
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=3))  # tail drained: promote
+    assert 3 not in srv._pending_promotion
+    assert srv.wq.count == 2  # queued + pinned replayed
+    assert len(srv.leases.owned_by(1)) == 1  # pin survived, same owner
+    assert 1 in srv.local_apps  # home duty adopted
+    assert srv.metrics.value("failover_promoted") == 1
+    assert srv._g_fo_mttr.v > 0
+    # every app rank got the epoch-stamped remap
+    for app in (0, 1):
+        notes = [m for m in _drain(fabric, app)
+                 if m.tag is Tag.TA_HOME_TAKEOVER]
+        assert notes and notes[0].dead == 3 and notes[0].src == 4
+    # the adopted pin serves the client's rerouted fetch via translation
+    old_seqno = 101
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=old_seqno, fo_from=3))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"pinned"
+    # a consumed-at-death unit's fetch is a counted loss, not a crash
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=102, fo_from=3))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_RETRY
+    assert srv.metrics.value("failover_lost") == 1
+    # the adopted common prefix serves under translation too
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=5, fo_from=3))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_COMMON_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"COMMONPFX"
+    # replayed puts are dedup-protected: rank 1 re-sending an acked put
+    # (id 7, accepted by the dead server) gets the idempotent ack
+    before = srv.wq.count
+    srv._handle(msg(Tag.FA_PUT, 1, payload=b"dup", work_type=T, prio=0,
+                    target_rank=-1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1, put_id=7))
+    assert srv.wq.count == before, "duplicate re-sent put was stored twice"
+
+
+def test_rerouted_common_ops_translate_and_count_lost():
+    """fo_from translation on the batch-common control plane: a rerouted
+    BATCH_DONE finalizes the ADOPTED prefix (not whatever local seqno
+    happens to collide with the dead server's numbering), and a rerouted
+    fetch of a prefix that missed the last replication flush answers
+    ADLB_RETRY and is counted — ADLB_ERROR would read as terminal and
+    the member would vanish uncounted."""
+    srv, fabric = _mini(4)
+    log = replica.ReplicationLog(buddy=4)
+    member = WorkUnit(seqno=100, work_type=T, prio=0, target_rank=-1,
+                      answer_rank=-1, payload=b"sfx", common_len=9,
+                      common_server_rank=3, common_seqno=5)
+    log.log_common_put(5, b"COMMONPFX")  # batch still open: no refcnt yet
+    log.log_put(member, src=1, put_id=7)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=log.take(), seq=1))
+    srv._server_eof_at[3] = time.monotonic()
+    srv._server_tail_drained.add(3)  # simulate the handled inbound EOF
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    adopted = srv._adopted_commons[(3, 5)]
+    # the client's end_batch_put reroutes here naming the DEAD server's
+    # seqno; the final refcount must land on the adopted entry
+    srv._handle(msg(Tag.FA_BATCH_DONE, 1, common_seqno=5, refcnt=1,
+                    fo_from=3))
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=5, fo_from=3,
+                    get_id=1))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_COMMON_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"COMMONPFX"
+    # refcount satisfied by the one fetch -> adopted prefix GC'd, which
+    # proves the rerouted BATCH_DONE hit the right entry
+    assert srv.cq.peek(adopted) is None
+    # a prefix that missed the last flush: counted loss, ADLB_RETRY
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=77, fo_from=3,
+                    get_id=2))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_COMMON_RESP][-1]
+    assert resp.rc == ADLB_RETRY
+    assert srv.metrics.value("failover_lost") == 1
+    # and the matching BATCH_DONE is a no-op, not a refcount misapplied
+    # to some unrelated live prefix
+    srv._handle(msg(Tag.FA_BATCH_DONE, 1, common_seqno=77, refcnt=3,
+                    fo_from=3))
+
+
+def test_send_failure_evidence_does_not_promote_before_tail_drains():
+    """A failed SEND to the dying server proves nothing about the
+    inbound replication tail: promotion must wait for the handled EOF
+    (or the deadline), or frames still queued — e.g. an acked put's
+    write-ahead entry — would be sealed out and lost uncountably."""
+    srv, fabric = _mini(4)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(), seq=1))
+    srv._server_eof_at[3] = time.monotonic()  # send-failure evidence only
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    assert 3 in srv._pending_promotion and srv.wq.count == 0
+    # the tail (a write-ahead-acked put) drains, THEN the EOF arrives
+    log = replica.ReplicationLog(buddy=4)
+    tail = WorkUnit(seqno=103, work_type=T, prio=0, target_rank=-1,
+                    answer_rank=-1, payload=b"tail")
+    log.log_put(tail, src=1, put_id=10)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=log.take(), seq=2))
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=3))
+    assert 3 not in srv._pending_promotion
+    assert srv.wq.count == 3, "the replication tail was sealed out"
+    assert {u.payload for u in srv.wq.units()} >= {b"tail"}
+
+
+def test_replayed_get_window_absorbs_resent_fetch():
+    """A common fetch the dead server accounted (and replicated) that the
+    client re-sends toward the buddy must be re-served, not accounted a
+    second time — double-accounting would GC the prefix one get early
+    and answer a later live member with a terminal error."""
+    srv, fabric = _mini(4)
+    log = replica.ReplicationLog(buddy=4)
+    log.log_common_put(5, b"PFX")
+    log.log_common_refcnt(5, 2)  # two members will fetch
+    log.log_common_op(5, "get", src=1, op_id=9)  # first fetch, accounted;
+    #                                              its response died
+    srv._handle(msg(Tag.SS_REPL, 3, blob=log.take(), seq=1))
+    srv._server_eof_at[3] = time.monotonic()
+    srv._server_tail_drained.add(3)  # simulate the handled inbound EOF
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    adopted = srv._adopted_commons[(3, 5)]
+    # the client re-sends the SAME request (same get_id) to the buddy
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=5, fo_from=3,
+                    get_id=9))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_COMMON_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"PFX"
+    assert srv.cq.peek(adopted) == b"PFX", "re-send was double-accounted"
+    # the second member's genuinely new fetch satisfies the refcount
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=5, fo_from=3,
+                    get_id=10))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_COMMON_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS
+    assert srv.cq.peek(adopted) is None  # refcount satisfied -> GC
+
+
+def test_takeover_note_reannounced_until_window_closes():
+    """The promote-time TA_HOME_TAKEOVER fan-out is one connect attempt
+    per rank; a note lost to a refused connect must be repaired by the
+    periodic re-announce before the client's failover window expires."""
+    srv, fabric = _mini(4)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(), seq=1))
+    srv._server_eof_at[3] = time.monotonic()
+    srv._server_tail_drained.add(3)  # simulate the handled inbound EOF
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    for app in (0, 1):
+        _drain(fabric, app)  # discard the promote-time notes
+    assert 3 in srv._takeover_renotify
+    srv._next_renotify = 0.0
+    srv._periodic(time.monotonic(), 0.05)
+    for app in (0, 1):
+        notes = [m for m in _drain(fabric, app)
+                 if m.tag is Tag.TA_HOME_TAKEOVER]
+        assert notes and notes[0].dead == 3, "note was not re-announced"
+    # window closed: the re-announce retires itself
+    srv._takeover_renotify[3] = time.monotonic() - 1.0
+    srv._next_renotify = 0.0
+    srv._periodic(time.monotonic(), 0.05)
+    assert 3 not in srv._takeover_renotify
+    assert not [m for m in _drain(fabric, 0)
+                if m.tag is Tag.TA_HOME_TAKEOVER]
+
+
+def test_promotion_deadline_fires_without_eof():
+    srv, fabric = _mini(4)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(), seq=1))
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    srv._pending_promotion[3] = time.monotonic() - 0.001  # force deadline
+    srv._periodic(time.monotonic(), 0.05)
+    assert srv.wq.count == 2
+    assert srv.metrics.value("failover_promoted") == 1
+
+
+def test_double_failure_aborts_cleanly():
+    """Buddy died before promotion: the shard has no replica anywhere —
+    the world must abort, not hang or run with silent loss."""
+    srv, fabric = _mini(4)
+    srv._server_eof_at[3] = time.monotonic()
+    srv._server_tail_drained.add(3)  # simulate the handled inbound EOF
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))  # no SS_REPL
+    assert srv._aborted and srv.done
+    aborts = [m for m in _drain(fabric, 2) if m.tag is Tag.SS_ABORT]
+    assert aborts, "double failure did not broadcast an abort"
+
+
+def test_master_death_aborts_under_failover():
+    srv, fabric = _mini(3)
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=2))  # master's EOF
+    assert srv._aborted and srv.done
+
+
+def test_server_death_under_abort_policy_unchanged():
+    world = WorldSpec(nranks=5, nservers=3, types=(T,))
+    fabric = InProcFabric(5)
+    srv = Server(world, Config(), fabric.endpoint(4))
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=3))
+    assert srv._aborted and srv.done
+
+
+def test_relay_in_flight_through_dead_home_resolves_at_most_once():
+    """Holder side: a fused relay left toward the dead home server, the
+    payload possibly already forwarded — delivered-at-death (consume);
+    a handle-shaped pin for the same home unpins and re-matches, and
+    the owner's late fetch gets ADLB_RETRY instead of an abort."""
+    srv, fabric = _mini(4)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(), seq=1))
+    # two local units; app rank 1's home is server 3
+    for payload in (b"relay", b"handle"):
+        srv._handle(msg(Tag.FA_PUT, 0, payload=payload, work_type=T, prio=0,
+                        target_rank=-1, answer_rank=-1, common_len=0,
+                        common_server=-1, common_seqno=-1))
+    _drain(fabric, 0)
+    units = {u.payload: u for u in srv.wq.units()}
+    # fused relay: payload rode the RFR response toward home server 3
+    srv._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=1, req_types=[T],
+                    targeted_lookup=False, lookup_type=-1, fetch=1))
+    # handle handoff for the same rank via a second RFR
+    srv._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=2, req_types=[T],
+                    targeted_lookup=False, lookup_type=-1))
+    assert sum(1 for u in srv.wq.units() if u.pinned) == 2
+    assert len(srv._relay_inflight) == 1
+    srv._server_eof_at[3] = time.monotonic()
+    srv._server_tail_drained.add(3)  # simulate the handled inbound EOF
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    # relay unit consumed (at-most-once), handle unit unpinned + rematchable
+    left = {u.payload for u in srv.wq.units() if not u.pinned}
+    assert units[b"relay"].seqno not in {u.seqno for u in srv.wq.units()}
+    assert b"handle" in left
+    # the owner's late fetch of the unpinned unit re-reserves, not aborts
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=units[b"handle"].seqno))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_RETRY
+
+
+def test_end_ring_rekicked_when_server_dies_holding_token():
+    """Master side: END_1 was circulating when a server died — the ring
+    restarts over the survivors instead of waiting forever."""
+    srv, fabric = _mini(2)  # the master
+    srv._handle(msg(Tag.SS_REPL, 3, blob=_primary_blob(), seq=1))
+    srv._finalized = set(srv.local_apps)
+    srv._end1_pending = True
+    srv._ending = True
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 4, rank=3, epoch=1))
+    # ring next live of 2 is 4 (3 is dead): the restarted token went there
+    end1 = [m for m in _drain(fabric, 4) if m.tag is Tag.SS_END_1]
+    assert end1, "END_1 was not re-kicked around the surviving ring"
+
+
+def test_migrate_batch_in_transit_to_dead_dest_requeues():
+    srv, fabric = _mini(2)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=b"", seq=1))
+    for i in range(3):
+        srv._handle(msg(Tag.FA_PUT, 0, payload=b"u%d" % i, work_type=T,
+                        prio=0, target_rank=-1, answer_rank=-1, common_len=0,
+                        common_server=-1, common_seqno=-1))
+    _drain(fabric, 0)
+    seqnos = [u.seqno for u in srv.wq.units()]
+    srv._handle(msg(Tag.SS_PLAN_MIGRATE, 2, dest=3, seqnos=seqnos, mig_id=1))
+    assert srv.wq.count == 0 and srv._migrate_unacked == 1
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 4, rank=3, epoch=1))
+    assert srv.wq.count == 3, "in-transit migration batch lost"
+    assert srv._migrate_unacked == 0
+
+
+# ------------------------------------------------------- checkpoint header
+
+
+def test_checkpoint_ack2_shape_validated(tmp_path):
+    w = WorldSpec(nranks=5, nservers=3, types=(T,))
+    prefix = str(tmp_path / "pool")
+    units = [WorkUnit(seqno=1, work_type=T, prio=0, target_rank=-1,
+                      answer_rank=-1, payload=b"x")]
+    checkpoint.save_shard(prefix, 2, units, None, world=w)
+    got, commons = checkpoint.load_shard(prefix, 2, w)
+    assert len(got) == 1 and got[0]["payload"] == b"x"
+    other = WorldSpec(nranks=7, nservers=3, types=(T,))
+    with pytest.raises(checkpoint.ShardShapeError):
+        checkpoint.load_shard(prefix, 2, other)
+    # shape-free callers (bare tooling) still load
+    got, _ = checkpoint.load_shard(prefix, 2)
+    assert len(got) == 1
+
+
+def test_checkpoint_ack1_read_compat(tmp_path):
+    """A pre-header shard (old builds / old native daemons) still loads."""
+    path = tmp_path / "old.2.ckpt"
+    body = [b"ACK1", struct.pack("<I", 1)]
+    body.append(struct.pack("<iiiqqq", T, -1, -1, 0, -1, -1))
+    body.append(struct.pack("<I", 0))  # common_len
+    body.append(struct.pack("<I", 3))  # payload_len
+    body.append(b"old")
+    body.append(struct.pack("<I", 0))  # no common entries
+    path.write_bytes(b"".join(body))
+    units, commons = checkpoint.load_shard(str(tmp_path / "old"), 2,
+                                           WorldSpec(5, 3, (T,)))
+    assert len(units) == 1 and units[0]["payload"] == b"old"
+    assert commons == []
+
+
+def test_resolve_spec_translates_server_kills():
+    w = WorldSpec(nranks=8, nservers=2, types=(T,))  # apps 0..5, servers 6,7
+    spec = resolve_spec({"kill_server_at_frame": {1: 40},
+                         "kill_server_at": {"0": 2.5}}, w)
+    assert spec["kill_at_frame"] == {7: 40}
+    assert spec["kill_at"] == {6: 2.5}
+    with pytest.raises(ValueError):
+        resolve_spec({"kill_server_at_frame": {2: 1}}, w)
+
+
+# ------------------------------------------------------- end-to-end worlds
+
+
+N_UNITS = 48
+
+
+def _coverage_economy(ctx):
+    """Producer pre-loads N_UNITS ids; every rank consumes via get_work
+    and returns the id set it executed. Failover may re-execute a unit
+    (at-least-once for in-transit state) but every id must be covered
+    modulo the counted replication-lag losses."""
+    if ctx.rank == 0:
+        for i in range(N_UNITS):
+            ctx.put(struct.pack("<q", i), T)
+    got = []
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got
+        got.append(struct.unpack("<q", w.payload)[0])
+        time.sleep(0.002)
+
+
+def _assert_coverage(res, expect_casualty):
+    done = [x for v in res.app_results.values() for x in v]
+    lost = sum(
+        s.get(int(InfoKey.FAILOVER_LOST), 0.0)
+        for s in res.server_stats.values()
+    )
+    missing = set(range(N_UNITS)) - set(done)
+    assert len(missing) <= lost, (
+        f"units {sorted(missing)} vanished but only {lost} counted lost"
+    )
+    assert res.server_casualties == [expect_casualty]
+    assert not res.aborted
+    promoted = sum(
+        s.get(int(InfoKey.NUM_FAILOVERS), 0.0)
+        for s in res.server_stats.values()
+    )
+    assert promoted >= 1, "no server reported a takeover"
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_inproc_server_death_failover_completes(mode):
+    """Deterministic in-proc server death (fault-injected disconnect of
+    server index 1 at its 40th outbound frame): the buddy takes over and
+    the world completes with conservation modulo counted losses."""
+    res = run_world(
+        4, 3, [T], _coverage_economy,
+        cfg=Config(
+            balancer=mode,
+            on_server_failure="failover",
+            exhaust_check_interval=0.2,
+            failover_client_wait=30.0,
+            fault_spec={"seed": 3, "disconnect_server_at": {1: 40}},
+        ),
+        timeout=120.0,
+    )
+    _assert_coverage(res, expect_casualty=5)  # server index 1 = rank 5
+
+
+def test_inproc_server_death_abort_policy_unchanged():
+    """Same injected death under the default policy: the world aborts
+    (reference semantics), promptly and classified."""
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        run_world(
+            4, 3, [T], _coverage_economy,
+            cfg=Config(
+                exhaust_check_interval=0.2,
+                fault_spec={"seed": 3, "disconnect_server_at": {1: 40}},
+            ),
+            timeout=60.0,
+        )
+    assert time.monotonic() - t0 < 45.0, "abort path hung"
+
+
+def _tcp_economy(ctx):
+    return _coverage_economy(ctx)
+
+
+@pytest.mark.slow
+def test_tcp_sigkill_server_failover_completes():
+    """The acceptance world: an 8-rank TCP world survives SIGKILL of the
+    non-master server mid-workload; clients re-arm via the takeover remap
+    and the run completes with every unit completed or re-executed
+    (conservation modulo counted lag losses); MTTR is recorded."""
+    res = spawn_world(
+        6, 2, [T], _tcp_economy,
+        cfg=Config(
+            on_server_failure="failover",
+            exhaust_check_interval=0.2,
+            failover_client_wait=30.0,
+            fault_spec={"seed": 11, "kill_server_at_frame": {1: 60}},
+        ),
+        timeout=150.0,
+    )
+    _assert_coverage(res, expect_casualty=7)  # server index 1 = rank 7
+    mttr = max(
+        s.get(int(InfoKey.FAILOVER_MTTR_MS), 0.0)
+        for s in res.server_stats.values()
+    )
+    assert mttr > 0.0, "promotion did not record an MTTR"
+
+
+@pytest.mark.slow
+def test_tcp_sigkill_server_abort_policy_classifies():
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        spawn_world(
+            6, 2, [T], _tcp_economy,
+            cfg=Config(
+                exhaust_check_interval=0.2,
+                fault_spec={"seed": 11, "kill_server_at_frame": {1: 60}},
+            ),
+            timeout=90.0,
+        )
+    assert time.monotonic() - t0 < 75.0, "abort classification hung"
