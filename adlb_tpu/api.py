@@ -120,6 +120,21 @@ class AdlbContext:
     def end_batch_put(self) -> int:
         return self._c.end_batch_put()
 
+    def extend_lease(self, handle: WorkHandle) -> int:
+        """Renew this rank's lease on a reserved-but-unfetched unit
+        (**extension**, Config(lease_timeout_s) > 0): long units opt out
+        of lease expiry explicitly instead of raising the whole world's
+        timeout. Fire-and-forget; an already-expired lease stays expired
+        (the fetch answers the retriable fencing code)."""
+        return self._c.extend_lease(handle)
+
+    def get_quarantined(self):
+        """(rc, records): the dead-letter quarantine — units moved aside
+        after exhausting Config(max_unit_retries), as plain dicts with
+        payload, metadata, attempt count, and the holding server
+        (**extension**; also served by the ops endpoint's /deadletter)."""
+        return self._c.get_quarantined()
+
     def set_problem_done(self) -> int:
         return self._c.set_problem_done()
 
@@ -173,6 +188,11 @@ class WorldResult:
     # Config(on_server_failure="failover"): their pool shard replayed at
     # the ring-successor buddy, which also took over their app ranks
     server_casualties: list[int] = dataclasses.field(default_factory=list)
+    # units moved to the dead-letter quarantine (retry budget exhausted,
+    # Config(max_unit_retries) > 0) — summed over surviving servers'
+    # InfoKey.QUARANTINED, same conservation contract as FAILOVER_LOST:
+    # every unit is completed, re-executed, or counted here
+    quarantined: int = 0
 
     def save_trace(self, path: str) -> None:
         from adlb_tpu.runtime.trace import save_chrome_trace
@@ -263,6 +283,9 @@ def join_world(
             ),
             on_server_failure=os.environ.get(
                 "ADLB_ON_SERVER_FAILURE", "abort"
+            ),
+            lease_timeout_s=float(
+                os.environ.get("ADLB_LEASE_TIMEOUT_S", "0") or 0
             ),
             fault_spec=fault_spec,
         )
@@ -421,6 +444,10 @@ def run_world(
         debug_server=debug_servers[0] if debug_servers else None,
         casualties=sorted(casualties),
         server_casualties=sorted(server_casualties),
+        quarantined=int(sum(
+            s.get(int(InfoKey.QUARANTINED), 0)
+            for s in server_stats.values()
+        )),
     )
     if errors:
         raise errors[0]

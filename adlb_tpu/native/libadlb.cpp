@@ -101,6 +101,7 @@ enum Field : uint8_t {
   F_PRIOS = 82,
   F_ANSWER_RANKS = 83,
   F_PATH = 72,
+  F_RETRY_AFTER_MS = 93,
 };
 
 enum Kind : uint8_t {
@@ -472,6 +473,7 @@ int next_server();
 struct PendingPut {
   std::string payload;
   int work_type, prio, target_rank, answer_rank, attempts, server;
+  int backoff_ms = 0;  // ADLB_BACKOFF retry-after hint awaiting replay
 };
 static std::map<int64_t, PendingPut> pending_puts;
 static std::vector<int64_t> resend_queue;  // rejected ids awaiting replay
@@ -498,6 +500,16 @@ static void settle_put(const Msg &m) {  // called with g->mu held
   auto it = pending_puts.find(id);
   if (it == pending_puts.end()) return;
   int rc = (int)m.geti(F_RC);
+  if (rc == ADLB_BACKOFF) {
+    // backpressured pipelined put: replay toward the same server without
+    // burning the reject budget, pacing by the server's carried hint
+    // (pump_resends sleeps it with the lock released — the fixed 2 ms
+    // resend pace would hammer the saturated server ~12x faster than it
+    // asked for, defeating the load shedding)
+    it->second.backoff_ms = (int)m.geti(F_RETRY_AFTER_MS, 25);
+    resend_queue.push_back(id);
+    return;
+  }
   if (rc == ADLB_PUT_REJECTED && ++it->second.attempts <= 10) {
     int hint = (int)m.geti(F_HINT, -1);
     it->second.server = hint >= 0 ? hint : next_server();
@@ -536,12 +548,16 @@ static void pump_resends() {
         if (it != pending_puts.end()) {
           id = cand;
           copy = it->second;
+          it->second.backoff_ms = 0;  // hint consumed by this replay
           break;
         }
       }
     }
     if (id < 0) return;
-    usleep(2000);  // pace like the synchronous retry loop
+    // a backpressured put sleeps the server's retry-after hint; a
+    // rejected-and-rerouted one paces like the synchronous retry loop
+    usleep(copy.backoff_ms > 0 ? (useconds_t)copy.backoff_ms * 1000
+                               : 2000);
     send_iput(id, copy);
   }
 }
@@ -784,6 +800,13 @@ int ADLBP_Put(void *work_buf, int work_len, int target_rank, int answer_rank,
     send_msg(server, e);
     Msg resp = wait_for(T_TA_PUT_RESP);
     rc = (int)resp.geti(F_RC);
+    if (rc == ADLB_BACKOFF) {
+      // overload backpressure: the fleet is above its hard watermark, so
+      // hopping servers would not help — wait out the carried hint and
+      // retry the SAME server without burning the reject budget
+      usleep((useconds_t)resp.geti(F_RETRY_AFTER_MS, 25) * 1000);
+      continue;
+    }
     if (rc != ADLB_PUT_REJECTED) break;
     if (++attempts > 10) {  // reference retry loop, src/adlb.c:2779-2796
       if (g->batch_active) g->batch_refcnt--;
@@ -921,6 +944,9 @@ int ADLBP_Get_reserved_timed(void *work_buf, int *work_handle,
   send_msg(holder, e);
   Msg resp = wait_for(T_TA_GET_RESERVED_RESP);
   int rc = (int)resp.geti(F_RC);
+  // ADLB_FENCED surfaces here as-is: this rank's lease expired while it
+  // was silent (lease_timeout_s armed on a Python-server world) and the
+  // unit went to another worker — drop the handle and re-reserve
   if (rc != ADLB_SUCCESS) return rc;
   const std::string &payload = resp.blobs[F_PAYLOAD];
   memcpy(out, payload.data(), payload.size());

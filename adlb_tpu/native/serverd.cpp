@@ -66,6 +66,12 @@ constexpr int ADLB_NO_MORE_WORK = -999999999;
 constexpr int ADLB_DONE_BY_EXHAUSTION = -999999998;
 constexpr int ADLB_NO_CURRENT_WORK = -999999997;
 constexpr int ADLB_PUT_REJECTED = -999999996;
+// Python-plane extension rcs (this daemon never issues them — no lease
+// table, no watermark backpressure — but the constants are registered so
+// the rc space stays in sync with adlb.h / adlb_tpu/types.py)
+constexpr int ADLB_RETRY = -999999995;
+constexpr int ADLB_FENCED = -999999994;
+constexpr int ADLB_BACKOFF = -999999993;
 constexpr int ADLB_LOWEST_PRIO = -999999999;
 
 // InfoKey (adlb_tpu/types.py InfoKey)
@@ -143,6 +149,10 @@ enum WireTag : uint16_t {
   T_FA_CHECKPOINT = 1048,
   T_TA_CHECKPOINT_RESP = 1049,
   T_SS_CHECKPOINT = 1123,
+  // gray-failure surface (Python servers only): a liveness beacon this
+  // daemon parses-and-ignores — it keeps no lease table, so a client
+  // heartbeating across a mixed-version world must not be fatal
+  T_FA_HEARTBEAT = 1054,
   T_PEER_EOF = 1999,  // transport-internal synthetic signal (never on wire)
 };
 
@@ -483,10 +493,11 @@ NMsg decode(const std::string& body) {
   if (off != body.size())
     throw FrameError("trailing bytes after field " +
                      std::to_string(nfields));
-  // tag outside the wire ranges (client block 1001-1049, server/debug
-  // block 1101-1132): a crafted or version-skewed frame — it must not
-  // reach the dispatch switch, whose unhandled-tag arm is fatal
-  if (!((m.tag >= 1001 && m.tag <= 1049) ||
+  // tag outside the wire ranges (client block 1001-1049 plus the
+  // heartbeat beacon 1054, server/debug block 1101-1132): a crafted or
+  // version-skewed frame — it must not reach the dispatch switch, whose
+  // unhandled-tag arm is fatal
+  if (!((m.tag >= 1001 && m.tag <= 1049) || m.tag == T_FA_HEARTBEAT ||
         (m.tag >= 1101 && m.tag <= 1132)))
     throw FrameError("unknown wire tag " + std::to_string(m.tag));
   return m;
@@ -1104,6 +1115,7 @@ class Server {
     events_ctr_ += 1;
     if (m.tag >= 1101 && m.tag <= 1125) ss_msgs_ctr_ += 1;
     switch (m.tag) {
+      case T_FA_HEARTBEAT: break;  // liveness beacon: parse-and-ignore
       case T_FA_PUT: on_put(m); break;
       case T_FA_PUT_COMMON: on_put_common(m); break;
       case T_FA_BATCH_DONE: on_batch_done(m); break;

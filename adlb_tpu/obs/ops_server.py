@@ -15,6 +15,11 @@ plane:
   sequence number so a scrape can be matched to the exact tick.
 * ``GET /dump`` — trigger a flight-record snapshot: returns the JSON doc
   inline and writes the artifact when a flight directory is configured.
+* ``GET /deadletter`` — this server's dead-letter quarantine (units that
+  exhausted ``Config(max_unit_retries)``): metadata + attempt counts,
+  payloads hex-encoded and truncated for transport. The store is
+  per-server; the ops endpoint runs on the master, so this is the
+  master's shard — ``ctx.get_quarantined()`` is the world-wide view.
 
 The handler only reads plain attributes of the live ``Server`` object
 (GIL-consistent snapshots, same discipline as the metrics registry), so
@@ -96,6 +101,9 @@ class OpsServer:
                     elif path == "/dump":
                         body = json.dumps(ops._dump()).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/deadletter":
+                        body = json.dumps(ops._deadletter()).encode()
+                        self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 — a scrape must
@@ -160,6 +168,35 @@ class OpsServer:
         if agg is not None:
             body += "\n".join(_world_agg_lines(agg)) + "\n"
         return body
+
+    def _deadletter(self) -> dict:
+        s = self.server
+        records = []
+        for q in list(getattr(s, "quarantine", ())):
+            payload = q.get("payload", b"")
+            records.append(
+                {
+                    "seqno": q["seqno"],
+                    "work_type": q["work_type"],
+                    "prio": q["prio"],
+                    "target_rank": q["target_rank"],
+                    "answer_rank": q["answer_rank"],
+                    "attempts": q["attempts"],
+                    "server_rank": q["server_rank"],
+                    "payload_len": len(payload),
+                    # bounded hex so a fat poison unit cannot blow up a
+                    # scrape; the full payload stays retrievable in-band
+                    # via ctx.get_quarantined()
+                    "payload_hex": bytes(payload[:256]).hex(),
+                    # a fused member whose prefix lives on another
+                    # server: payload is the suffix alone and the
+                    # common handle says where the rest is
+                    "suffix_only": bool(q.get("suffix_only")),
+                    "common_seqno": q.get("common_seqno", -1),
+                    "common_server_rank": q.get("common_server_rank", -1),
+                }
+            )
+        return {"rank": s.rank, "count": len(records), "records": records}
 
     def _dump(self) -> dict:
         s = self.server

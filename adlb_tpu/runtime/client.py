@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import threading
 import time
 from collections import OrderedDict, deque
 from typing import Iterator, Optional, Sequence
@@ -37,6 +38,8 @@ from adlb_tpu.runtime.trace import PID_APP, Tracer
 from adlb_tpu.runtime.transport import Endpoint
 from adlb_tpu.runtime.world import Config, WorldSpec, normalize_req_types
 from adlb_tpu.types import (
+    ADLB_BACKOFF,
+    ADLB_FENCED,
     ADLB_NO_CURRENT_WORK,
     ADLB_NO_MORE_WORK,
     ADLB_PUT_REJECTED,
@@ -142,6 +145,45 @@ class Client:
         # via _apply_takeover's re-sends): queued here and consumed by
         # _recv before the endpoint, never dropped
         self._redeliver: deque = deque()
+        # gray-failure surface (Config(lease_timeout_s) > 0): a liveness
+        # heartbeat thread — protocol traffic piggybacks liveness, this
+        # covers the idle-but-computing gaps so a BUSY rank is never
+        # misread as hung while a SIGSTOP'd one (the thread freezes with
+        # the process) is detected within the timeout
+        self._m_fenced = self.metrics.counter("fenced_fetches")
+        self._m_put_backoffs = self.metrics.counter("put_backoffs")
+        self._hb_stop: Optional[threading.Event] = None
+        if cfg.lease_timeout_s > 0:
+            self._hb_stop = threading.Event()
+            threading.Thread(
+                target=self._heartbeat_loop,
+                daemon=True,
+                name=f"adlb-hb-{self.rank}",
+            ).start()
+
+    def _heartbeat_loop(self) -> None:
+        """FA_HEARTBEAT to every (routed) server at timeout/3 cadence.
+        Endpoint sends are thread-safe; a peer that refuses is left to
+        the protocol plane's own retry/failover machinery. Beacons are
+        best-effort and periodic, so a dead destination gets only a
+        short connect grace — the default 15 s grace would stall the
+        whole round behind one dead server (the takeover remap happens
+        on the main thread) and starve the beacons that keep healthy
+        servers from declaring this rank hung."""
+        interval = max(self.cfg.lease_timeout_s / 3.0, 0.005)
+        while not self._hb_stop.wait(interval):
+            for dest in {self._route(s) for s in self.world.server_ranks}:
+                try:
+                    self.ep.send(
+                        dest, msg(Tag.FA_HEARTBEAT, self.rank),
+                        connect_grace=0.25,
+                    )
+                except OSError:
+                    pass
+
+    def _stop_heartbeat(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
 
     def _recv(self, timeout):
         """Endpoint recv that drains takeover-deferred frames first."""
@@ -441,6 +483,22 @@ class Client:
             self._send_retry(server, pm)
             resp = self._wait_put(put_id, dest=server, m_req=pm)
             rc = resp.rc
+            if rc == ADLB_BACKOFF:
+                # overload backpressure: the server (and, it believes,
+                # every peer) is above the hard watermark — hopping
+                # would not help. Retry the SAME server after the
+                # carried retry-after hint fed into the decorrelated-
+                # jitter backoff, WITHOUT burning the retry budget:
+                # shedding load, not failing the put.
+                self._m_put_backoffs.inc()
+                hint_s = float(
+                    resp.data.get("retry_after_ms", 25) or 25
+                ) / 1e3
+                self.flight.record(
+                    f"put_backoff server={server} retry_after_s={hint_s}"
+                )
+                sleep = self._backoff_sleep(max(sleep, hint_s))
+                continue
             if rc not in (ADLB_PUT_REJECTED, ADLB_RETRY):
                 break
             attempts += 1
@@ -690,6 +748,19 @@ class Client:
         self._send_retry(handle.server_rank, pm)
         resp = self._wait(Tag.TA_GET_RESERVED_RESP, dest=handle.server_rank,
                           m_req=pm)
+        if resp.rc == ADLB_FENCED:
+            # our lease on this unit EXPIRED (this rank went silent past
+            # lease_timeout_s — e.g. it was SIGSTOP'd and resumed): the
+            # unit was re-enqueued under a new attempt and this settle
+            # is rejected. Mapped onto the existing ADLB_RETRY path —
+            # drop the handle and re-reserve — so every retry loop
+            # (get_work, streams, app-level PR 2 handling) absorbs it
+            # unchanged.
+            self._m_fenced.inc()
+            self.flight.record(
+                f"fenced fetch seqno={handle.seqno} -> retry"
+            )
+            return ADLB_RETRY, None, 0.0
         if resp.rc != ADLB_SUCCESS:
             return resp.rc, None, 0.0
         return ADLB_SUCCESS, prefix + resp.payload, resp.time_on_q
@@ -961,6 +1032,8 @@ class Client:
             # a late/duplicate stream-cancel ack (the close() drain
             # already settled, or a re-sent cancel was acked twice)
             Tag.TA_STREAM_CANCEL_RESP,
+            # a duplicated dead-letter listing (re-sent across churn)
+            Tag.TA_QUARANTINED_RESP,
         ):
             # stray replay: a request re-sent across connection churn can
             # be answered twice (the server replays its at-most-once
@@ -1111,6 +1184,22 @@ class Client:
         put_id = m.put_id
         req = self._pending_puts[put_id]
         rc = m.rc
+        if rc == ADLB_BACKOFF:
+            # backpressured pipelined put: re-send after a pause floored
+            # at the server's retry-after hint, without burning the
+            # retry budget — replaying at the reject pace would hit the
+            # saturated server ~12x faster than it asked. Still capped:
+            # settles run inline in whatever recv loop the client is
+            # blocked in, so one backpressured put must not stall it.
+            self._m_put_backoffs.inc()
+            hint_s = min((m.data.get("retry_after_ms") or 0) / 1e3, 0.05)
+            slept = self._backoff_sleep(req.get("sleep", 0.0), cap=0.05)
+            if hint_s > slept:
+                time.sleep(hint_s - slept)
+                slept = hint_s
+            req["sleep"] = slept
+            self._send_iput(put_id, req)
+            return
         if rc in (ADLB_PUT_REJECTED, ADLB_RETRY):
             req["attempts"] += 1
             if req["attempts"] <= self.cfg.put_max_retries:
@@ -1216,9 +1305,69 @@ class Client:
         resp = self._wait(Tag.TA_INFO_NUM_RESP, dest=self.home, m_req=pm)
         return resp.rc, resp.count, resp.nbytes, resp.max_wq
 
+    def extend_lease(self, handle: WorkHandle) -> int:
+        """Explicitly renew this rank's lease on a reserved-but-unfetched
+        unit (Config(lease_timeout_s) > 0): a unit whose decode/compute
+        legitimately outlives the timeout opts out of expiry without
+        raising the whole rank's timeout. Fire-and-forget toward the
+        holding server (liveness piggybacks on the frame either way); a
+        lease already expired stays expired — the eventual fetch answers
+        ADLB_FENCED and the caller re-reserves."""
+        with self._span("adlb:extend_lease", seqno=handle.seqno):
+            self._send_retry(
+                handle.server_rank,
+                msg(Tag.FA_HEARTBEAT, self.rank, seqno=handle.seqno),
+            )
+        return ADLB_SUCCESS
+
+    def get_quarantined(self) -> tuple[int, list[dict]]:
+        """Retrieve the dead-letter quarantine: every unit the world
+        moved aside after it exhausted Config(max_unit_retries), as
+        plain dicts (payload + metadata + attempt count + the holding
+        server). Aggregated across live Python servers; native servers
+        hold no quarantine (the policy requires server_impl='python')."""
+        records: list[dict] = []
+        with self._span("adlb:get_quarantined"):
+            seen: set[int] = set()
+            for srv in self.world.server_ranks:
+                dest = self._route(srv)
+                if dest in seen:
+                    continue  # failed-over: its buddy holds the store
+                seen.add(dest)
+                if dest in getattr(self.ep, "binary_peers", ()):
+                    continue
+                pm = msg(Tag.FA_GET_QUARANTINED, self.rank)
+                self._send_retry(dest, pm)
+                resp = self._wait(Tag.TA_QUARANTINED_RESP, dest=dest,
+                                  m_req=pm)
+                d = resp.data
+                suffix_onlys = d.get("suffix_onlys") or ()
+                for i, seqno in enumerate(d.get("seqnos") or ()):
+                    records.append(
+                        {
+                            "seqno": seqno,
+                            "work_type": d["work_types"][i],
+                            "prio": d["prios"][i],
+                            "target_rank": d["target_ranks"][i],
+                            "answer_rank": d["answer_ranks"][i],
+                            "attempts": d["attempts_list"][i],
+                            "payload": d["payloads"][i],
+                            "server_rank": resp.src,
+                            # payload is a fused member's suffix whose
+                            # prefix did not survive on the answering
+                            # server
+                            "suffix_only": bool(
+                                suffix_onlys[i] if i < len(suffix_onlys)
+                                else 0
+                            ),
+                        }
+                    )
+        return ADLB_SUCCESS, records
+
     def finalize(self) -> int:
         if self.tracer is not None:
             self.tracer.api_entry()  # close any open inferred user span
+        self._stop_heartbeat()
         rc = ADLB_SUCCESS
         if not self.aborted:
             if self._active_stream is not None:
@@ -1251,6 +1400,7 @@ class Client:
         """Bring the whole world down (reference ADLB_Abort,
         ``src/adlb.c:3165-3176``)."""
         self.aborted = True
+        self._stop_heartbeat()
         self.flight.record(f"this rank called abort({code})")
         self.flight.dump_json("abort_initiated")
         try:

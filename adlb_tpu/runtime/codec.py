@@ -114,6 +114,13 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.FA_STREAM_IDLE: 1051,
     Tag.FA_STREAM_CANCEL: 1052,
     Tag.TA_STREAM_CANCEL_RESP: 1053,
+    # gray-failure surface (Config(lease_timeout_s) / max_unit_retries;
+    # Python servers only — the policy is rejected toward native planes,
+    # and native daemons parse-and-ignore FA_HEARTBEAT): liveness beacon /
+    # lease extension, and the dead-letter retrieval round trip
+    Tag.FA_HEARTBEAT: 1054,
+    Tag.FA_GET_QUARANTINED: 1055,
+    Tag.TA_QUARANTINED_RESP: 1056,
     # app<->app point-to-point (the reference's app_comm traffic; native
     # clients receive it via ADLB_App_recv — bytes payloads only, enforced
     # by encodable())
@@ -294,6 +301,20 @@ FIELDS: dict[str, tuple[int, int]] = {
     # server reconciles them against its parked entries exactly (idle
     # mark on equality; swept-stream phantom slots re-armed by id)
     "slots": (91, _KIND_LIST),
+    # gray-failure surface: a unit's failure-attempt count (rides
+    # SS_PUSH_WORK so quarantine budgets survive memory-pressure pushes)
+    # and the TA_PUT_RESP backpressure retry-after hint (ADLB_BACKOFF)
+    "attempts": (92, _KIND_I64),
+    "retry_after_ms": (93, _KIND_I64),
+    # TA_QUARANTINED_RESP: the dead-letter store as parallel per-unit
+    # lists (payloads/work_types/prios/answer_ranks/seqnos reused from
+    # the batch-fetch idiom above)
+    "target_ranks": (94, _KIND_LIST),
+    "attempts_list": (95, _KIND_LIST),
+    # ... and per-unit 0/1 flags: payload is a fused member's suffix
+    # whose prefix was not stored on (or did not survive to) the
+    # answering server
+    "suffix_onlys": (96, _KIND_LIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -19,7 +19,22 @@ function of ``(seed, rank, outbound frame number)``:
   SIGKILL (``os._exit`` fallback) — the byte-deterministic analogue of a
   preempted worker, pinned to an exact protocol point;
 * ``kill_at`` — the wall-clock variant (seconds after the endpoint is
-  wrapped), for soak-style adversities where determinism is not the goal.
+  wrapped), for soak-style adversities where determinism is not the goal;
+* ``stall_at_frame`` / ``stall_at`` — GRAY failure: at outbound frame N
+  (or after N wall-clock seconds) the endpoint freezes — outbound frames
+  buffer instead of leaving, inbound recv goes silent — while the
+  process stays alive, so peers observe no EOF, only silence. After
+  ``stall_for_s`` seconds (0 = forever) the endpoint resumes and the
+  buffered frames flush in order, modelling a SIGCONT'd process's
+  kernel buffers draining: the late-traffic burst that lease fencing
+  must reject. For spawned (real-process) worlds :func:`sigstop_self`
+  is the non-simulated variant — the whole process, heartbeat threads
+  included, really stops;
+* ``poison_types`` — a worker receiving a reservation for a unit of a
+  marked work type dies on the spot (SIGKILL), the deterministic
+  poison-unit: the reserve leaves a lease behind, reclaim re-enqueues
+  the unit, and it serially kills every worker that touches it until a
+  retry budget (``Config(max_unit_retries)``) quarantines it.
 
 Probabilistic faults (drop/delay/duplicate) draw from a per-rank
 ``random.Random`` in frame order, so the injected-event log — a list of
@@ -41,6 +56,7 @@ import time
 from typing import Optional
 
 from adlb_tpu.runtime.messages import Msg, Tag
+from adlb_tpu.types import ADLB_SUCCESS
 
 # actions recorded in the injected-event log
 DROP = "drop"
@@ -48,6 +64,9 @@ DELAY = "delay"
 DUP = "duplicate"
 DISCONNECT = "disconnect"
 KILL = "kill"
+STALL = "stall"
+RESUME = "resume"
+POISON = "poison"
 
 
 def _mix(seed: int, rank: int) -> int:
@@ -79,6 +98,15 @@ class FaultPlan:
         )
         self.kill_at = float(dict(spec.get("kill_at") or {}).get(rank, 0.0)
                              or 0.0)
+        self.stall_at_frame = int(
+            dict(spec.get("stall_at_frame") or {}).get(rank, 0) or 0
+        )
+        self.stall_at = float(
+            dict(spec.get("stall_at") or {}).get(rank, 0.0) or 0.0
+        )
+        # stall duration; 0 = stalled forever (the never-resuming hang)
+        self.stall_for_s = float(spec.get("stall_for_s", 0.0) or 0.0)
+        self.poison_types = frozenset(spec.get("poison_types") or ())
         self.log_dir = spec.get("log_dir") or os.environ.get(
             "ADLB_FAULT_LOG_DIR"
         )
@@ -87,6 +115,12 @@ class FaultPlan:
         self.frame = 0  # outbound frames observed (post-increment)
         self.events: list[tuple[int, str, str, int]] = []
         self.disconnected = False
+        # gray-failure stall window: None = not stalled, inf = forever,
+        # else the monotonic time at which the endpoint resumes; a stall
+        # fires at most once per plan (a resumed endpoint must not
+        # re-stall on its next frame)
+        self.stalled_until: Optional[float] = None
+        self._stall_done = False
 
     # -- decisions -----------------------------------------------------------
 
@@ -109,6 +143,15 @@ class FaultPlan:
                 self.events.append((n, DISCONNECT, m.tag.name, dest))
                 self._flush_log()
                 return DISCONNECT
+            if self._stalled_locked(n, m.tag.name, dest):
+                return STALL
+            if (
+                self.stall_at_frame
+                and n >= self.stall_at_frame
+                and not self._stall_done
+            ):
+                self._begin_stall_locked(n, m.tag.name, dest)
+                return STALL
             if not self.active:
                 return ""
             # one draw per probabilistic knob per frame, in fixed order:
@@ -127,6 +170,47 @@ class FaultPlan:
                 self.events.append((n, DUP, m.tag.name, dest))
                 return DUP
             return ""
+
+    # -- stall (gray failure) ------------------------------------------------
+
+    def _begin_stall_locked(self, frame: int, tag: str, dest: int) -> None:
+        self.stalled_until = (
+            time.monotonic() + self.stall_for_s
+            if self.stall_for_s > 0
+            else float("inf")
+        )
+        self._stall_done = True
+        self.events.append((frame, STALL, tag, dest))
+        self._flush_log()
+
+    def _stalled_locked(self, frame: int, tag: str, dest: int) -> bool:
+        """Inside the stall window? Clears the window (recording RESUME)
+        the first time it is consulted past its end."""
+        if self.stalled_until is None:
+            return False
+        if time.monotonic() < self.stalled_until:
+            return True
+        self.stalled_until = None
+        self.events.append((frame, RESUME, tag, dest))
+        return False
+
+    def stall_now(self) -> None:
+        """Begin a stall immediately (the wall-clock ``stall_at`` timer's
+        entry point, and the deterministic in-proc trigger for tests).
+        Unlike the frame-count trigger, explicit calls RE-ARM: a test
+        driving repeated gray failures (e.g. stalling the same owner
+        until its unit's retry budget quarantines it) stalls once per
+        call."""
+        with self._lock:
+            if self.stalled_until is None:
+                self._begin_stall_locked(self.frame, "<timer>", -1)
+
+    def stalled(self) -> bool:
+        """Inside the stall window right now? (recv-side check)"""
+        if self.stalled_until is None:
+            return False  # lock-free: every non-stalled recv lands here
+        with self._lock:
+            return self._stalled_locked(self.frame, "<recv>", -1)
 
     # -- log -----------------------------------------------------------------
 
@@ -163,7 +247,8 @@ class FaultyEndpoint:
     endpoint, so roles and harnesses cannot tell the difference.
     """
 
-    _OWN = ("_ep", "plan", "rank", "_contacted", "_killer")
+    _OWN = ("_ep", "plan", "rank", "_contacted", "_killer", "_staller",
+            "_stall_buf")
 
     def __init__(self, ep, plan: FaultPlan) -> None:
         object.__setattr__(self, "_ep", ep)
@@ -171,10 +256,17 @@ class FaultyEndpoint:
         object.__setattr__(self, "rank", ep.rank)
         object.__setattr__(self, "_contacted", set())
         object.__setattr__(self, "_killer", None)
+        object.__setattr__(self, "_staller", None)
+        object.__setattr__(self, "_stall_buf", [])
         if plan.kill_at > 0:
             t = threading.Timer(plan.kill_at, self._kill_now)
             t.daemon = True
             object.__setattr__(self, "_killer", t)
+            t.start()
+        if plan.stall_at > 0:
+            t = threading.Timer(plan.stall_at, plan.stall_now)
+            t.daemon = True
+            object.__setattr__(self, "_staller", t)
             t.start()
 
     def __getattr__(self, name):
@@ -222,6 +314,46 @@ class FaultyEndpoint:
             except OSError:
                 pass
 
+    def _flush_stalled(self) -> None:
+        """Drain frames buffered during a stall — the SIGCONT'd process's
+        kernel buffers finally going out, in order. The buffer swap holds
+        the plan lock: the app thread and the client's heartbeat thread
+        can resume concurrently, and a racing double-flush would
+        duplicate (or drop) the buffered tail."""
+        if not self._stall_buf:
+            # lock-free hot-path exit (every non-stalled frame lands
+            # here): the truthiness read is GIL-atomic, and a frame a
+            # racing stall appends right after it flushes on the next
+            # call — the same tolerance the buffer swap already has
+            return
+        with self.plan._lock:
+            buf = self._stall_buf
+            if not buf:
+                return
+            object.__setattr__(self, "_stall_buf", [])
+        for dest, m, kw in buf:
+            try:
+                self._ep.send(dest, m, **kw)
+            except OSError:
+                pass
+
+    def _maybe_poison(self, m: Msg) -> None:
+        """The poison-unit fault: receiving a reservation for a marked
+        work type kills this worker on the spot (the lease it just took
+        survives it — reclaim's retry budget is what bounds the blast
+        radius)."""
+        if (
+            m.tag is Tag.TA_RESERVE_RESP
+            and m.data.get("rc") == ADLB_SUCCESS
+            and m.data.get("work_type") in self.plan.poison_types
+        ):
+            with self.plan._lock:
+                self.plan.events.append(
+                    (self.plan.frame, POISON, m.tag.name, m.src)
+                )
+                self.plan._flush_log()
+            self._kill_now()
+
     def send(self, dest: int, m: Msg, **kw) -> None:
         act = self.plan.on_send(m, dest)
         if act == KILL:
@@ -235,6 +367,11 @@ class FaultyEndpoint:
                 f"fault injection: rank {self.rank} disconnected at frame "
                 f"{self.plan.frame}"
             )
+        if act == STALL:
+            with self.plan._lock:  # vs a concurrent resume's buffer swap
+                self._stall_buf.append((dest, m, kw))
+            return
+        self._flush_stalled()  # a resume flushes before new traffic
         if act == DROP:
             return
         if act == DELAY:
@@ -251,13 +388,24 @@ class FaultyEndpoint:
             if timeout:
                 time.sleep(min(timeout, 0.05))
             return None
-        return self._ep.recv(timeout=timeout)
+        if self.plan.stalled():
+            # frozen endpoint: inbound traffic waits in the transport
+            # (like a stopped process's socket buffers); burn the poll
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
+        self._flush_stalled()
+        m = self._ep.recv(timeout=timeout)
+        if m is not None and self.plan.poison_types:
+            self._maybe_poison(m)
+        return m
 
 
 def resolve_spec(spec: dict, world) -> dict:
-    """Expand server-targeted kill specs into world-rank form.
+    """Expand server-targeted kill/stall specs into world-rank form.
 
     ``kill_server_at_frame`` / ``kill_server_at`` / ``disconnect_server_at``
+    / ``stall_server_at_frame`` / ``stall_server_at``
     are keyed by SERVER INDEX (0 = the master, i = the i-th server rank)
     so a spec need not hard-code the world shape; with a ``world`` they
     translate into the corresponding ``kill_at_frame`` / ``kill_at`` /
@@ -269,6 +417,8 @@ def resolve_spec(spec: dict, world) -> dict:
         ("kill_server_at_frame", "kill_at_frame"),
         ("kill_server_at", "kill_at"),
         ("disconnect_server_at", "disconnect_at"),
+        ("stall_server_at_frame", "stall_at_frame"),
+        ("stall_server_at", "stall_at"),
     )
     if not any(spec.get(sk) for sk, _ in pairs):
         return spec
@@ -288,6 +438,34 @@ def resolve_spec(spec: dict, world) -> dict:
             merged[world.num_app_ranks + i] = v
         out[rank_key] = merged
     return out
+
+
+def sigstop_self(resume_after_s: float) -> None:
+    """SIGSTOP the calling process — the REAL gray failure for spawned
+    worlds: every thread (heartbeats included) and socket freezes with no
+    EOF for peers to observe — after forking a watchdog child that
+    SIGCONTs us ``resume_after_s`` seconds later (a stopped process
+    cannot resume itself). Execution continues here after the resume, so
+    the caller's next protocol op is exactly the "late settle from a
+    fenced owner" that lease expiry must reject."""
+    import signal
+
+    pid = os.getpid()
+    child = os.fork()
+    if child == 0:
+        # watchdog: nothing but sleep-and-resume, then vanish without
+        # running the parent's atexit/harness teardown
+        try:
+            time.sleep(resume_after_s)
+            os.kill(pid, signal.SIGCONT)
+        finally:
+            os._exit(0)
+    os.kill(pid, signal.SIGSTOP)
+    # ---- stopped until the watchdog's SIGCONT ----
+    try:
+        os.waitpid(child, 0)
+    except (OSError, ChildProcessError):
+        pass
 
 
 def maybe_wrap(ep, cfg, world=None):

@@ -145,6 +145,28 @@ def main(argv=None) -> int:
                          "dead server's replicated pool shard at its "
                          "ring-successor buddy, which takes over its app "
                          "ranks (python servers only)")
+    ap.add_argument("--lease-timeout-s", type=float, default=0.0,
+                    help="gray-failure detection: expire (and fence) a "
+                         "lease whose owner has been silent this long, "
+                         "re-enqueueing its unit; 0 = off (python servers "
+                         "only; exported to app programs as "
+                         "ADLB_LEASE_TIMEOUT_S so clients heartbeat)")
+    ap.add_argument("--max-unit-retries", type=int, default=0,
+                    help="retry budget per unit: more failed deliveries "
+                         "than this moves the unit to the dead-letter "
+                         "quarantine instead of the queue; 0 = unlimited "
+                         "(python servers only)")
+    ap.add_argument("--mem-hard-frac", type=float, default=0.0,
+                    help="overload backpressure: above this fraction of "
+                         "max-malloc-per-server with no peer believed to "
+                         "have room, puts answer ADLB_BACKOFF with a "
+                         "retry-after hint; 0 = off (python servers only)")
+    ap.add_argument("--mem-soft-frac", type=float, default=0.95,
+                    help="memory-pressure push threshold as a fraction of "
+                         "max-malloc-per-server (the reference's 0.95); "
+                         "lower it together with --mem-hard-frac to leave "
+                         "pushes headroom before backpressure bites "
+                         "(validation requires hard >= soft when armed)")
     ap.add_argument("--fault-spec", default=None,
                     help="JSON fault-injection spec "
                          "(adlb_tpu/runtime/faults.py), e.g. "
@@ -170,6 +192,10 @@ def main(argv=None) -> int:
                  flight_dir=args.flight_dir, ops_port=args.ops_port,
                  on_worker_failure=args.on_worker_failure,
                  on_server_failure=args.on_server_failure,
+                 lease_timeout_s=args.lease_timeout_s,
+                 max_unit_retries=args.max_unit_retries,
+                 mem_hard_frac=args.mem_hard_frac,
+                 mem_soft_frac=args.mem_soft_frac,
                  fault_spec=fault_spec)
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
@@ -288,6 +314,9 @@ def main(argv=None) -> int:
                 env["ADLB_ON_WORKER_FAILURE"] = args.on_worker_failure
             if args.on_server_failure != "abort":
                 env["ADLB_ON_SERVER_FAILURE"] = args.on_server_failure
+            if args.lease_timeout_s > 0:
+                # joined clients arm the liveness heartbeat from this
+                env["ADLB_LEASE_TIMEOUT_S"] = str(args.lease_timeout_s)
             if args.server_impl == "native":
                 env["ADLB_SERVER_IMPL"] = "native"
             procs.append(subprocess.Popen(args.prog, env=env))

@@ -44,6 +44,17 @@ class Tag(enum.Enum):
     # drop this rank's prefetch reserves (stream close); acked so the
     # client can drain deliveries that raced the cancel
     FA_STREAM_CANCEL = enum.auto()
+    # gray-failure detection (Config(lease_timeout_s) > 0; no reference
+    # analogue): a client's liveness beacon while idle-but-computing —
+    # ordinary protocol traffic already piggybacks liveness, this covers
+    # the long-compute gaps. With a ``seqno`` field it doubles as an
+    # explicit lease extension (ctx.extend_lease) for units whose
+    # compute legitimately outlives the timeout.
+    FA_HEARTBEAT = enum.auto()
+    # dead-letter retrieval: list this server's quarantined units
+    # (payload + metadata + attempt counts); ctx.get_quarantined()
+    # aggregates the per-server responses
+    FA_GET_QUARANTINED = enum.auto()
 
     # server -> client
     TA_PUT_RESP = enum.auto()
@@ -54,6 +65,7 @@ class Tag(enum.Enum):
     TA_INFO_NUM_RESP = enum.auto()
     TA_INFO_GET_RESP = enum.auto()
     TA_STREAM_CANCEL_RESP = enum.auto()
+    TA_QUARANTINED_RESP = enum.auto()
     TA_ABORT = enum.auto()
 
     # server <-> server

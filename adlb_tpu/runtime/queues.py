@@ -51,6 +51,12 @@ class WorkUnit:
     pinned: bool = False
     pin_rank: int = -1
     time_stamp: float = dataclasses.field(default_factory=time.monotonic)
+    # failure attempts: how many times delivery of this unit failed
+    # (owner-death reclaim, lease expiry, undeliverable response).
+    # Survives re-enqueue, memory-pressure push, and failover replay;
+    # exceeding Config(max_unit_retries) quarantines the unit instead of
+    # re-enqueueing it (bounded blast radius for poison units).
+    attempts: int = 0
 
     @property
     def work_len(self) -> int:
@@ -505,6 +511,11 @@ class Lease:
     owner: int
     lease_id: int
     granted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # last explicit extension (ctx.extend_lease / FA_HEARTBEAT with a
+    # seqno): the expiry scan ages a lease from max(granted_at,
+    # renewed_at, owner's last-heard), so a long unit can opt out of the
+    # timeout without touching the owner-wide liveness clock
+    renewed_at: float = 0.0
 
 
 class LeaseTable:
@@ -522,6 +533,30 @@ class LeaseTable:
         self._by_seqno[seqno] = lease
         self._by_owner.setdefault(owner, set()).add(seqno)
         return lease
+
+    def renew(self, seqno: int, now: Optional[float] = None) -> bool:
+        """Explicit lease extension; False when no such lease exists
+        (already expired/consumed — the caller's op will be fenced or
+        retried through the normal paths)."""
+        lease = self._by_seqno.get(seqno)
+        if lease is None:
+            return False
+        lease.renewed_at = time.monotonic() if now is None else now
+        return True
+
+    def leases(self) -> Iterable[Lease]:
+        """Snapshot of every outstanding lease (the expiry scan mutates
+        the table while iterating)."""
+        return list(self._by_seqno.values())
+
+    def oldest_age(self, now: float) -> float:
+        """Age of the oldest outstanding lease (0 when none) — the
+        lease_age_max_s gauge."""
+        return max(
+            (now - max(ls.granted_at, ls.renewed_at)
+             for ls in self._by_seqno.values()),
+            default=0.0,
+        )
 
     def release(self, seqno: int) -> Optional[Lease]:
         lease = self._by_seqno.pop(seqno, None)
@@ -665,8 +700,16 @@ class MemoryAccountant:
 
     PUSH_FRACTION = 0.95  # reference THRESHOLD_TO_START_PUSH (src/adlb.c:93)
 
-    def __init__(self, max_bytes: float) -> None:
+    def __init__(self, max_bytes: float, soft_frac: Optional[float] = None,
+                 hard_frac: float = 0.0) -> None:
         self.max_bytes = max_bytes
+        # soft watermark: pushes engage above it (reference semantics at
+        # the default); hard watermark: 0 = backpressure off, else puts
+        # above it with no eligible push destination answer ADLB_BACKOFF
+        self.soft_frac = (
+            self.PUSH_FRACTION if soft_frac is None else soft_frac
+        )
+        self.hard_frac = hard_frac
         self.curr = 0
         self.total = 0
         self.hwm = 0
@@ -688,7 +731,21 @@ class MemoryAccountant:
 
     @property
     def under_pressure(self) -> bool:
-        return self.max_bytes > 0 and self.curr > self.PUSH_FRACTION * self.max_bytes
+        return self.max_bytes > 0 and self.curr > self.soft_frac * self.max_bytes
+
+    @property
+    def pressure(self) -> float:
+        """Fill fraction (0 when uncapped) — the mem_pressure gauge."""
+        return self.curr / self.max_bytes if self.max_bytes > 0 else 0.0
+
+    def above_hard(self, nbytes: int = 0) -> bool:
+        """Would admitting nbytes cross the hard watermark? Always False
+        when backpressure is off (hard_frac == 0) or uncapped."""
+        return (
+            self.hard_frac > 0
+            and self.max_bytes > 0
+            and self.curr + nbytes > self.hard_frac * self.max_bytes
+        )
 
     def has_room(self, nbytes: int) -> bool:
-        return self.max_bytes <= 0 or self.curr + nbytes <= self.PUSH_FRACTION * self.max_bytes
+        return self.max_bytes <= 0 or self.curr + nbytes <= self.soft_frac * self.max_bytes

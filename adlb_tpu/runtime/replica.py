@@ -57,12 +57,19 @@ OP_APP_DONE = 12      # local app finalized
 OP_RANK_DEAD = 13     # app rank declared dead (reclaim policy)
 OP_COMMON_STATE = 14  # full refcount state (re-bootstrap after buddy death)
 OP_SEEN_PUTS = 15     # a sender's accepted-put dedup window (re-bootstrap)
+# gray-failure state (lease expiry / quarantine) — attempt counts and
+# fences must survive failover or a takeover would reset a poison unit's
+# retry budget and un-fence a stalled owner
+OP_ATTEMPTS = 16      # unit's failure-attempt count changed
+OP_FENCE = 17         # (seqno, owner) fenced by lease expiry
+OP_QUARANTINE = 18    # unit moved to the dead-letter quarantine
 
 _HDR = struct.Struct("<BI")       # op, body length
 _SEQ = struct.Struct("<q")        # one seqno
 _SEQ2 = struct.Struct("<qq")      # seqno + arg (pin rank, refcnt, ...)
 _SEQ3 = struct.Struct("<qqq")     # seqno + src + request id (common ops)
-_PUTHDR = struct.Struct("<qqqii")  # seqno, src, put_id, pinned(pin_rank|-1), pad
+# seqno, src, put_id, pinned(pin_rank|-1), attempts
+_PUTHDR = struct.Struct("<qqqii")
 
 # flush the buffered log at this many entries even mid-pass
 MAX_BUFFER = 256
@@ -116,7 +123,8 @@ class ReplicationLog:
     def log_put(self, unit, src: int, put_id) -> None:
         pid = -1 if put_id is None else int(put_id)
         body = _PUTHDR.pack(unit.seqno, src, pid,
-                            unit.pin_rank if unit.pinned else -1, 0)
+                            unit.pin_rank if unit.pinned else -1,
+                            getattr(unit, "attempts", 0))
         self._append(OP_PUT, body + _pack_unit(unit))
 
     def log_pin(self, seqno: int, rank: int) -> None:
@@ -151,6 +159,19 @@ class ReplicationLog:
                          credits: int) -> None:
         self._append(OP_COMMON_STATE,
                      struct.pack("<qqqq", seqno, refcnt, ngets, credits))
+
+    def log_attempts(self, seqno: int, attempts: int) -> None:
+        self._append(OP_ATTEMPTS, _SEQ2.pack(seqno, attempts))
+
+    def log_fence(self, seqno: int, owner: int, origin: int = -1) -> None:
+        """``origin`` is the server whose numbering ``seqno`` belongs to:
+        -1 for this primary's own fences, a rank for fences it ADOPTED in
+        an earlier takeover (a doubly-rerouted late fetch still stamps
+        the ORIGINAL home in fo_from, so the key must survive chains)."""
+        self._append(OP_FENCE, _SEQ3.pack(seqno, owner, origin))
+
+    def log_quarantine(self, seqno: int) -> None:
+        self._append(OP_QUARANTINE, _SEQ.pack(seqno))
 
     def log_app_done(self, rank: int) -> None:
         self._append(OP_APP_DONE, _SEQ.pack(rank))
@@ -204,6 +225,14 @@ class ReplicaMirror:
         # the dead server already accounted is absorbed, not re-counted
         self.last_common: dict[int, int] = {}      # src -> last get_id
         self.forfeit_ids: dict[int, list[int]] = {}  # src -> note ids
+        # gray-failure state: fences (seqno, owner, origin) from lease
+        # expiry at the primary (origin -1 = the primary's own
+        # numbering, else the server an earlier takeover adopted them
+        # from), and units it moved to its dead-letter quarantine (the
+        # takeover adopts both, so attempt budgets and fencing survive
+        # the failover)
+        self.fences: set[tuple[int, int, int]] = set()
+        self.quarantined: dict[int, dict] = {}     # seqno -> unit fields
         self.finalized: set[int] = set()
         self.dead_ranks: set[int] = set()
         self.entries_applied = 0
@@ -232,8 +261,9 @@ class ReplicaMirror:
 
     def _apply_one(self, op: int, body: bytes) -> None:
         if op == OP_PUT:
-            seqno, src, pid, pin_rank, _pad = _PUTHDR.unpack_from(body, 0)
+            seqno, src, pid, pin_rank, attempts = _PUTHDR.unpack_from(body, 0)
             fields, _ = _unpack_unit(body, _PUTHDR.size)
+            fields["attempts"] = attempts
             self.units[seqno] = fields
             if pin_rank >= 0:
                 self.pins[seqno] = pin_rank
@@ -298,6 +328,20 @@ class ReplicaMirror:
             e = self.commons.get(seqno)
             if e is not None:
                 e[1], e[2], e[3] = refcnt, ngets, credits
+        elif op == OP_ATTEMPTS:
+            seqno, attempts = _SEQ2.unpack(body)
+            f = self.units.get(seqno)
+            if f is not None:
+                f["attempts"] = attempts
+        elif op == OP_FENCE:
+            seqno, owner, origin = _SEQ3.unpack(body)
+            self.fences.add((seqno, owner, origin))
+        elif op == OP_QUARANTINE:
+            (seqno,) = _SEQ.unpack(body)
+            f = self.units.pop(seqno, None)
+            self.pins.pop(seqno, None)
+            if f is not None:
+                self.quarantined[seqno] = f
         elif op == OP_APP_DONE:
             (rank,) = _SEQ.unpack(body)
             self.finalized.add(rank)

@@ -51,7 +51,9 @@ from adlb_tpu.runtime.queues import (
 from adlb_tpu.runtime.transport import Endpoint
 from adlb_tpu.runtime.world import Config, WorldSpec
 from adlb_tpu.types import (
+    ADLB_BACKOFF,
     ADLB_DONE_BY_EXHAUSTION,
+    ADLB_FENCED,
     ADLB_LOWEST_PRIO,
     ADLB_NO_CURRENT_WORK,
     ADLB_NO_MORE_WORK,
@@ -224,12 +226,44 @@ class Server:
         self.wq = self._make_wq(cfg)
         self.rq = ReserveQueue()
         self.tq = TargetedDirectory()
-        self.mem = MemoryAccountant(cfg.max_malloc_per_server)
+        self.mem = MemoryAccountant(
+            cfg.max_malloc_per_server,
+            soft_frac=cfg.mem_soft_frac,
+            hard_frac=cfg.mem_hard_frac,
+        )
         self.cq = CommonStore(on_gc=self._on_common_gc)
         # lease per pinned unit (owner rank, lease id, grant time): under
         # on_worker_failure="reclaim" a dead owner's leases turn back into
         # queued work instead of blocking exhaustion forever
         self.leases = LeaseTable()
+
+        # ---- gray-failure state (Config(lease_timeout_s) / quarantine) ----
+        # liveness clock per app rank: stamped by EVERY frame the rank
+        # sends here (protocol traffic piggybacks liveness) plus its
+        # periodic FA_HEARTBEAT; the lease-expiry scan ages a lease from
+        # max(grant, renewal, owner last-heard), and the HOME server
+        # declares a rank hung after 2x the timeout of total silence —
+        # the bounded detection a SIGSTOP'd (gray-failed) worker needs,
+        # since it never EOFs
+        self._lease_armed = cfg.lease_timeout_s > 0
+        self._last_heard: dict[int, float] = {}
+        # fencing tokens from expired leases: (seqno, owner) pairs whose
+        # lease EXPIRED — the unit re-enqueued under a fresh attempt, and
+        # any late Get_reserved from the old owner answers ADLB_FENCED so
+        # a slow-but-alive worker can never double-settle it. Bounded
+        # like the failover tombstones.
+        self._fences: set[tuple[int, int]] = set()
+        self._fence_order: deque = deque()
+        # fences adopted from a failed-over predecessor, keyed by ITS
+        # numbering (the fenced owner's rerouted fetch arrives stamped
+        # fo_from): fencing must survive failover or a takeover would
+        # quietly un-fence a stalled owner
+        self._adopted_fences: set[tuple[int, int, int]] = set()
+        # dead-letter quarantine: units whose failure-attempt count
+        # exceeded Config(max_unit_retries) — out of the wq (settled for
+        # exhaustion voting), counted exactly-once, retrievable via
+        # ctx.get_quarantined() / ops /deadletter
+        self.quarantine: list[dict] = []
 
         # ---- server failover (Config(on_server_failure="failover")) ----
         # Each server streams a replication log of its pool mutations to
@@ -428,6 +462,15 @@ class Server:
         self._m_leases_reclaimed = self.metrics.counter("leases_reclaimed")
         self._m_targeted_dropped = self.metrics.counter("targeted_dropped")
         self._m_reconnects = self.metrics.counter("rank_reconnects")
+        # gray-failure surface (lease expiry / quarantine / backpressure)
+        self._m_leases_expired = self.metrics.counter("leases_expired")
+        self._m_quarantined = self.metrics.counter("quarantined")
+        self._m_put_backoffs = self.metrics.counter("put_backoff")
+        self._m_heartbeats = self.metrics.counter("heartbeats")
+        self._g_leases = self.metrics.gauge("leases_outstanding")
+        self._g_lease_age = self.metrics.gauge("lease_age_max_s")
+        self._g_quarantined = self.metrics.gauge("quarantined")
+        self._g_mem_pressure = self.metrics.gauge("mem_pressure")
         # failover surface (on_server_failure="failover")
         self._m_server_dead = self.metrics.counter("server_dead")
         self._m_failover_promoted = self.metrics.counter("failover_promoted")
@@ -455,6 +498,9 @@ class Server:
         # timers
         now = time.monotonic()
         self._next_state_sync = now
+        self._next_lease_scan = (
+            now + cfg.lease_timeout_s if self._lease_armed else float("inf")
+        )
         self._next_exhaust_check = now + cfg.exhaust_check_interval
         self._next_ds_log = now
         # since-last-DS_LOG bookkeeping for the reference's 11-counter
@@ -509,6 +555,8 @@ class Server:
             Tag.FA_STREAM_CANCEL: self._on_stream_cancel,
             Tag.FA_GET_RESERVED: self._on_get_reserved,
             Tag.FA_GET_COMMON: self._on_get_common,
+            Tag.FA_HEARTBEAT: self._on_heartbeat,
+            Tag.FA_GET_QUARANTINED: self._on_get_quarantined,
             Tag.FA_NO_MORE_WORK: self._on_fa_no_more_work,
             Tag.FA_LOCAL_APP_DONE: self._on_local_app_done,
             Tag.FA_ABORT: self._on_fa_abort,
@@ -674,6 +722,11 @@ class Server:
         if handler is None:
             raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
         self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
+        if self._lease_armed and m.src < self.world.num_app_ranks:
+            # every frame from an app rank is liveness evidence: protocol
+            # traffic piggybacks the heartbeat, FA_HEARTBEAT only covers
+            # the idle-but-computing gaps
+            self._last_heard[m.src] = time.monotonic()
         if self._dead_ranks and m.src in self._dead_ranks and (
             m.tag.name.startswith("FA_")
         ):
@@ -748,6 +801,13 @@ class Server:
                         pass
         if self._pending_delta and now >= self._delta_deadline:
             self._flush_task_deltas(now)
+        if self._lease_armed and now >= self._next_lease_scan:
+            # scan well inside the timeout so detection latency is
+            # bounded by ~1.25x lease_timeout_s, not 2x
+            self._next_lease_scan = now + max(
+                self.cfg.lease_timeout_s / 4.0, 0.01
+            )
+            self._scan_leases(now)
         if now >= self._next_state_sync:
             self._next_state_sync = now + interval
             # queue-depth gauges + bounded timelines, sampled on the tick:
@@ -765,6 +825,10 @@ class Server:
             m.gauge("rq_oldest_age_s").set(
                 self.rq.oldest_age(now, stream_idle=self._stream_idle)
             )
+            self._g_mem_pressure.set(self.mem.pressure)
+            self._g_leases.set(len(self.leases))
+            self._g_lease_age.set(self.leases.oldest_age(now))
+            self._g_quarantined.set(len(self.quarantine))
             if self.cfg.balancer == "tpu":
                 # The snapshot walk is O(wq); at the fast balancer cadence
                 # it is a real GIL tax on compute-bound workloads. Walk it
@@ -865,9 +929,18 @@ class Server:
                 f"seqno={unit.seqno} (undelivered)"
             )
             return
-        self.mem.alloc(len(unit.payload))
         unit.pinned = False
         unit.pin_rank = -1
+        if self._bump_attempts(unit, in_wq=False):
+            # retry budget exhausted: quarantined, not re-queued. A fused
+            # member's prefix share was never accounted (suffix-only
+            # delivery) and never will be — forfeit it so the prefix
+            # still GCs under its live members.
+            if unit.common_seqno >= 0 and not prefix_fetched:
+                self._forfeit_common(unit.common_seqno,
+                                     unit.common_server_rank)
+            return
+        self.mem.alloc(len(unit.payload))
         self.wq.add(unit)
         if self.repl is not None:
             self.repl.log_put(unit, -1, None)
@@ -1298,6 +1371,40 @@ class Server:
             and self.wq.hi_prio_of_type(m.work_type) <= ADLB_LOWEST_PRIO
         )
         payload: bytes = m.payload
+        if (
+            m.target_rank < 0
+            and self.mem.above_hard(len(payload))
+            and not self._peer_has_room(len(payload))
+        ):
+            # overload backpressure (Config(mem_hard_frac) > 0): above the
+            # hard watermark with nowhere to point the putter, a reject
+            # hint would only bounce it between equally-full servers
+            # until its retry budget aborts the producer — answer
+            # ADLB_BACKOFF with a retry-after hint instead, so the
+            # producer stalls (shedding load into its own pacing) while
+            # consumers drain this server below the watermark.
+            # UNTARGETED puts only: a targeted put is answer/completion
+            # traffic bound to THIS home server (no peer can take it),
+            # and stalling completions starves the very consumers whose
+            # fetches drain the pressure — the classic backpressure
+            # deadlock. Targeted puts fall through to the reference
+            # admission path (hard reject at the cap).
+            self._m_put_backoffs.inc()
+            self.flight.record(
+                f"put_backoff src={m.src} nbytes={len(payload)} "
+                f"curr={self.mem.curr}"
+            )
+            self.ep.send(
+                m.src,
+                msg(
+                    Tag.TA_PUT_RESP,
+                    self.rank,
+                    rc=ADLB_BACKOFF,
+                    retry_after_ms=25,
+                    put_id=put_id,
+                ),
+            )
+            return
         if not self.mem.try_alloc(len(payload)):
             self.stats[InfoKey.NREJECTED_PUTS] += 1
             self.flight.record(
@@ -1576,6 +1683,16 @@ class Server:
             # to replication lag answers ADLB_RETRY (re-reserve), counted
             new = self._adopted_units.get((fo, m.seqno))
             if new is None:
+                if (fo, m.seqno, m.src) in self._adopted_fences:
+                    # the predecessor fenced this owner's lease before
+                    # dying (replicated): a rejected settle, NOT a
+                    # counted loss — the re-enqueued unit is live
+                    self._send_app(
+                        m.src,
+                        msg(Tag.TA_GET_RESERVED_RESP, self.rank,
+                            rc=ADLB_FENCED),
+                    )
+                    return
                 # once per (dead server, seqno): the promote pass may
                 # already have counted it (lost prefix), and a re-sent
                 # fetch must not count it twice
@@ -1601,6 +1718,22 @@ class Server:
                 # across connection churn): the consume is unrepeatable,
                 # so replay the cached response instead of raising
                 self._send_app(m.src, cached[1])
+                return
+            if (m.seqno, m.src) in self._fences:
+                # the requester's lease on this unit EXPIRED (it went
+                # silent past lease_timeout_s) and the unit re-enqueued
+                # under a fresh attempt: this late settle is rejected —
+                # the fencing half of at-least-once. The client maps
+                # ADLB_FENCED onto its ADLB_RETRY path (drop the handle,
+                # re-reserve).
+                self.flight.record(
+                    f"fenced get_reserved seqno={m.seqno} rank={m.src}"
+                )
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_GET_RESERVED_RESP, self.rank,
+                        rc=ADLB_FENCED),
+                )
                 return
             if (
                 self.cfg.on_worker_failure == "reclaim"
@@ -2139,6 +2272,7 @@ class Server:
                 common_server=unit.common_server_rank,
                 common_seqno=unit.common_seqno,
                 time_stamp=unit.time_stamp,
+                attempts=unit.attempts,
             ),
         )
         if sent_to is None:
@@ -2164,6 +2298,7 @@ class Server:
             common_server_rank=m.common_server,
             common_seqno=m.common_seqno,
             time_stamp=m.time_stamp,
+            attempts=int(m.data.get("attempts", 0) or 0),
         )
         self._next_seqno += 1
         self.wq.add(unit)
@@ -2623,6 +2758,7 @@ class Server:
                     "common_server": unit.common_server_rank,
                     "common_seqno": unit.common_seqno,
                     "time_stamp": unit.time_stamp,
+                    "attempts": unit.attempts,
                 }
             )
         if units:
@@ -2695,6 +2831,7 @@ class Server:
                 common_server_rank=u["common_server"],
                 common_seqno=u["common_seqno"],
                 time_stamp=u["time_stamp"],
+                attempts=int(u.get("attempts", 0) or 0),
             )
             self._next_seqno += 1
             self.wq.add(unit)
@@ -3036,6 +3173,311 @@ class Server:
             )
             self._do_abort(-3, broadcast=True)
 
+    # ------------------------------------------------- gray failures
+    # Lease expiry with fencing + retry budgets + dead-letter quarantine
+    # (no reference analogue; Config(lease_timeout_s) / max_unit_retries,
+    # both inert by default). PR 2/PR 4 survive CLEAN deaths — an EOF
+    # fans out the reclaim — but a worker that HANGS without dying
+    # (SIGSTOP, wedged accelerator, live-but-frozen VM) holds its leases
+    # forever and never EOFs. Here: a lease whose owner has been silent
+    # past the timeout is FENCED (the lease_id becomes a fencing token —
+    # late settles from the old owner answer ADLB_FENCED) and its unit
+    # re-enqueues under a fresh attempt; a rank silent for 2x the
+    # timeout is declared hung by its HOME server (rank-dead under
+    # "reclaim", abort under "abort"); and a unit whose attempts exceed
+    # the retry budget moves to the dead-letter quarantine instead of
+    # serially killing the fleet.
+
+    def _scan_leases(self, now: float) -> None:
+        timeout = self.cfg.lease_timeout_s
+        # native (C) clients have no heartbeat plane: a compute-bound
+        # rank is indistinguishable from a hung one, so binary peers
+        # keep reference semantics — their leases never expire and they
+        # are never declared hung (libadlb would otherwise be aborted
+        # mid-computation by its own liveness watchdog)
+        native = getattr(self.ep, "binary_peers", None) or ()
+        expired = 0
+        for lease in self.leases.leases():
+            if lease.owner in self._dead_ranks:
+                continue  # the rank-dead sweep owns those
+            if lease.owner in native:
+                continue
+            t0 = max(
+                lease.granted_at,
+                lease.renewed_at,
+                self._last_heard.get(lease.owner, 0.0),
+            )
+            if now - t0 <= timeout:
+                continue
+            self._expire_lease(lease, now)
+            expired += 1
+        if expired:
+            # reclaimed inventory is activity (an in-flight exhaustion
+            # vote must not conclude around it) and may satisfy parked
+            # requesters right now
+            self.activity += 1
+            self._exhaust_held_since = None
+            self._match_rq()
+        # hang detection: only the HOME server judges (finalize knowledge
+        # is home-local, exactly like the EOF path) — total silence past
+        # 2x the timeout is a gray-failed rank. Per-lease expiry above
+        # already freed its work at ~1x; this releases its termination
+        # accounting so the WORLD still completes around it.
+        for r in sorted(self.local_apps):
+            if r in self._dead_ranks or r in self._finalized:
+                continue
+            if r in native:
+                continue  # no heartbeat plane: busy, not hung
+            last = self._last_heard.get(r)
+            if last is None:
+                continue  # never heard from: startup grace
+            silent = now - last
+            if silent <= 2.0 * timeout:
+                continue
+            if self.cfg.on_worker_failure == "reclaim":
+                aprintf(
+                    True, self.rank,
+                    f"app rank {r} silent {silent:.2f}s "
+                    f"(lease_timeout_s={timeout}); declaring it hung "
+                    f"(on_worker_failure=reclaim)",
+                )
+                self.flight.record(
+                    f"rank_hung rank={r} silent_s={silent:.3f}"
+                )
+                self._declare_rank_dead(r)
+            else:
+                aprintf(
+                    True, self.rank,
+                    f"app rank {r} silent {silent:.2f}s; aborting the "
+                    f"world (on_worker_failure=abort)",
+                )
+                self.flight.record(
+                    f"rank_hung rank={r} silent_s={silent:.3f} (abort)"
+                )
+                self._do_abort(-3, broadcast=True)
+                return
+
+    def _expire_lease(self, lease, now: float) -> None:
+        """Fence one expired lease and return its unit to service.
+
+        At-least-once by design: the owner may be slow rather than dead
+        — it may already hold (or be receiving) the payload — so the
+        re-enqueued unit can execute twice. The fence guarantees the
+        narrow thing that must never happen: the old owner double-
+        SETTLING the unit (its late fetch answers ADLB_FENCED and the
+        stale-relay/unreserve guards ignore it)."""
+        seqno, owner = lease.seqno, lease.owner
+        self.leases.release(seqno)
+        self._add_fence(seqno, owner)
+        self._m_leases_expired.inc()
+        if self.repl is not None:
+            self.repl.log_fence(seqno, owner)
+        self.flight.record(
+            f"lease_expired seqno={seqno} owner={owner} "
+            f"lease_id={lease.lease_id} "
+            f"age_s={now - max(lease.granted_at, lease.renewed_at):.3f}"
+        )
+        unit = self.wq.get(seqno)
+        if unit is None or not unit.pinned or unit.pin_rank != owner:
+            return  # already resolved through another path
+        # a relay in flight toward the silent owner: unlike the rank-DEAD
+        # sweep (at-most-once: the owner is gone, consume), expiry keeps
+        # the unit — the documented at-least-once window
+        self._relay_inflight.pop(seqno, None)
+        self.wq.unpin(seqno)
+        if self.repl is not None:
+            self.repl.log_unpin(seqno)
+        quarantined = self._bump_attempts(unit, in_wq=True)
+        if unit.common_seqno >= 0 and not quarantined:
+            # the silent owner may have fetched the prefix already; the
+            # re-consumption fetches it again (bounded-leak direction,
+            # as in the rank-death sweep). On quarantine: NO common op.
+            # A credit expects a re-consumption that will never come
+            # (certain leak); a forfeit assumes the silent owner never
+            # fetched — if it did, the overshoot could GC the prefix
+            # out from under surviving members. With neither, the books
+            # close exactly when every epoch fetched and leak bounded
+            # otherwise (the targeted-drop path forfeits only because
+            # its suffix-only delivery PROVES the share unaccounted).
+            self._forfeit_common(
+                unit.common_seqno, unit.common_server_rank, op="credit"
+            )
+
+    def _add_fence(self, seqno: int, owner: int) -> None:
+        key = (seqno, owner)
+        if key in self._fences:
+            return
+        self._fences.add(key)
+        self._fence_order.append(key)
+        if len(self._fence_order) > 65536:  # bounded, like tombstones
+            self._fences.discard(self._fence_order.popleft())
+
+    def _bump_attempts(self, unit, in_wq: bool) -> bool:
+        """Account one failed delivery attempt; quarantine the unit when
+        it exceeds the retry budget. Returns True when quarantined.
+        ``in_wq``: whether the unit currently sits (unpinned) in the wq
+        — False on the consumed-but-undeliverable path."""
+        unit.attempts += 1
+        if self.repl is not None and in_wq:
+            self.repl.log_attempts(unit.seqno, unit.attempts)
+        maxr = self.cfg.max_unit_retries
+        if maxr <= 0 or unit.attempts <= maxr:
+            return False
+        self._quarantine_unit(unit, in_wq=in_wq)
+        return True
+
+    def _quarantine_record(self, unit) -> dict:
+        """Dead-letter record for one unit — the single source of the
+        record shape (see _quarantine_unit / _adopt_quarantined). A
+        fused batch member carries only its suffix: reattach the prefix
+        when this server stores it, so the operator retrieves the
+        payload the app would have received; when the prefix lives
+        elsewhere the record is flagged ``suffix_only`` and keeps the
+        common handle instead of silently passing off the suffix as
+        the whole payload."""
+        payload, suffix_only = unit.payload, False
+        cseq, cs = unit.common_seqno, unit.common_server_rank
+        clen = unit.common_len
+        if cseq >= 0:
+            prefix = self.cq.peek(cseq) if cs in (-1, self.rank) else None
+            if prefix is not None:
+                payload, cseq, cs, clen = prefix + payload, -1, -1, 0
+            else:
+                suffix_only = True
+        return {
+            "seqno": unit.seqno,
+            "work_type": unit.work_type,
+            "prio": unit.prio,
+            "target_rank": unit.target_rank,
+            "answer_rank": unit.answer_rank,
+            "payload": payload,
+            "attempts": unit.attempts,
+            "server_rank": self.rank,
+            "suffix_only": suffix_only,
+            "common_seqno": cseq,
+            "common_server_rank": cs,
+            "common_len": clen,
+        }
+
+    def _quarantine_unit(self, unit, in_wq: bool) -> None:
+        """Move a unit to the dead-letter store: out of the wq (settled
+        for exhaustion voting — termination never hangs on a poison
+        unit), counted exactly-once, payload retained for retrieval."""
+        if in_wq:
+            self.wq.remove(unit.seqno)
+            self.leases.release(unit.seqno)
+            self.mem.free(len(unit.payload))
+        if self.repl is not None:
+            if not in_wq:
+                # the mirror tombstoned this unit at consume; re-install
+                # it so the quarantine entry has something to move
+                self.repl.log_put(unit, -1, None)
+            self.repl.log_quarantine(unit.seqno)
+        self.quarantine.append(self._quarantine_record(unit))
+        self.stats[InfoKey.QUARANTINED] += 1
+        self._m_quarantined.inc()
+        self.flight.record(
+            f"unit_quarantined seqno={unit.seqno} type={unit.work_type} "
+            f"attempts={unit.attempts}"
+        )
+
+    def _adopt_quarantined(self, f: dict, old_seqno: int,
+                           dead: int) -> None:
+        """Take over a failed-over predecessor's dead-letter entry under
+        a fresh local seqno, re-counting it here (the dead server's own
+        QUARANTINED stat died with it — exactly-once holds because only
+        the survivor's count reaches the final aggregation). A fused
+        member's prefix handle translates through the adopted-commons
+        map first, so the record can reattach a prefix this buddy now
+        stores."""
+        cs = f.get("common_server_rank", -1)
+        cseq = f.get("common_seqno", -1)
+        if cseq >= 0 and cs == dead:
+            new_c = self._adopted_commons.get((dead, cseq))
+            if new_c is not None:
+                cs, cseq = self.rank, new_c
+            # else: prefix lost to replication lag — the stale handle
+            # stays in the record, honestly suffix_only
+        unit = WorkUnit(
+            seqno=self._next_seqno,
+            work_type=f["work_type"],
+            prio=f["prio"],
+            target_rank=f["target_rank"],
+            answer_rank=f["answer_rank"],
+            payload=f["payload"],
+            common_len=f.get("common_len", 0),
+            common_server_rank=cs,
+            common_seqno=cseq,
+            attempts=f.get("attempts", 0),
+        )
+        self._next_seqno += 1
+        self.quarantine.append(self._quarantine_record(unit))
+        self.stats[InfoKey.QUARANTINED] += 1
+        self._m_quarantined.inc()
+        if self.repl is not None:
+            self.repl.log_put(unit, -1, None)
+            self.repl.log_quarantine(unit.seqno)
+        self.flight.record(
+            f"unit_quarantined seqno={unit.seqno} (adopted, was "
+            f"{old_seqno})"
+        )
+
+    def _peer_has_room(self, nbytes: int) -> bool:
+        """Any live peer believed able to admit nbytes under its cap —
+        the backpressure eligibility test (a push/hint would help)."""
+        cap = self.cfg.max_malloc_per_server
+        if cap <= 0:
+            return True
+        for s, st in self.peers.items():
+            if s == self.rank or s in self._dead_servers:
+                continue
+            if st.nbytes + nbytes <= cap:
+                return True
+        return False
+
+    def _on_heartbeat(self, m: Msg) -> None:
+        """Liveness beacon (last-heard already stamped in _handle); with
+        a seqno it is an explicit lease extension (ctx.extend_lease). A
+        seqno whose lease is gone (expired/consumed) is silently stale —
+        the owner's next settle attempt learns through the normal
+        fence/retry paths."""
+        self._m_heartbeats.inc()
+        seqno = m.data.get("seqno")
+        if seqno is not None:
+            fo = m.data.get("fo_from")
+            if fo is not None:
+                seqno = self._adopted_units.get((fo, seqno))
+                if seqno is None:
+                    return
+            lease = self.leases.get(seqno)
+            if lease is not None and lease.owner == m.src:
+                self.leases.renew(seqno)
+
+    def _on_get_quarantined(self, m: Msg) -> None:
+        """Dead-letter retrieval: this server's quarantine store, shipped
+        as parallel per-unit lists (the codec's batch idiom — plain dicts
+        do not cross the TCP fabric); the client zips them back into
+        records."""
+        q = list(self.quarantine)
+        self._send_app(
+            m.src,
+            msg(
+                Tag.TA_QUARANTINED_RESP,
+                self.rank,
+                rc=ADLB_SUCCESS,
+                seqnos=[r["seqno"] for r in q],
+                work_types=[r["work_type"] for r in q],
+                prios=[r["prio"] for r in q],
+                target_ranks=[r["target_rank"] for r in q],
+                answer_ranks=[r["answer_rank"] for r in q],
+                attempts_list=[r["attempts"] for r in q],
+                payloads=[r["payload"] for r in q],
+                suffix_onlys=[
+                    1 if r.get("suffix_only") else 0 for r in q
+                ],
+            ),
+        )
+
     # ------------------------------------------------- worker-death reclaim
     # No reference analogue (upstream: any rank failure kills the job,
     # src/adlb.c:2508-2526). Under Config(on_worker_failure="reclaim") an
@@ -3118,10 +3560,18 @@ class Server:
                 self.wq.unpin(lease.seqno)
                 if self.repl is not None:
                     self.repl.log_unpin(lease.seqno)
-                if unit.common_seqno >= 0:
+                # retry budget: a unit that serially kills its owners
+                # (poison) must not re-enqueue forever
+                quarantined = self._bump_attempts(unit, in_wq=True)
+                if unit.common_seqno >= 0 and not quarantined:
                     # the dead owner may have fetched the batch-common
                     # prefix already; the re-consumption will fetch it
-                    # again, so grant the prefix one extra expected get
+                    # again, so grant the prefix one extra expected get.
+                    # On quarantine, NO op (as in _expire_lease): a
+                    # credit expects a re-consumption that never comes,
+                    # a forfeit could over-count a fetch the dead owner
+                    # already accounted and GC the prefix under a live
+                    # member
                     self._forfeit_common(
                         unit.common_seqno, unit.common_server_rank,
                         op="credit",
@@ -3389,6 +3839,32 @@ class Server:
             r.log_app_done(rank)
         for rank in self._dead_ranks:
             r.log_rank_dead(rank)
+        # gray-failure state: fences and the dead-letter store must
+        # survive this server's own later death, or a takeover would
+        # un-fence stalled owners and silently drop the quarantine count
+        for seqno, owner in self._fences:
+            r.log_fence(seqno, owner)
+        for origin, seqno, owner in self._adopted_fences:
+            # fences adopted from predecessors keep their origin — a
+            # doubly-rerouted late fetch stamps the ORIGINAL home
+            r.log_fence(seqno, owner, origin=origin)
+        for q in self.quarantine:
+            r.log_put(
+                WorkUnit(
+                    seqno=q["seqno"],
+                    work_type=q["work_type"],
+                    prio=q["prio"],
+                    target_rank=q["target_rank"],
+                    answer_rank=q["answer_rank"],
+                    payload=q["payload"],
+                    attempts=q["attempts"],
+                    common_len=q.get("common_len", 0),
+                    common_server_rank=q.get("common_server_rank", -1),
+                    common_seqno=q.get("common_seqno", -1),
+                ),
+                -1, None,
+            )
+            r.log_quarantine(q["seqno"])
         # dedup windows: without these, a put this server acked (or a
         # get/forfeit it accounted) re-sent after a later death of THIS
         # server would be applied twice by the new buddy
@@ -3660,6 +4136,7 @@ class Server:
             common_server_rank=u["common_server"],
             common_seqno=u["common_seqno"],
             time_stamp=u["time_stamp"],
+            attempts=int(u.get("attempts", 0) or 0),
         )
         self._next_seqno += 1
         self.wq.add(unit)
@@ -3746,6 +4223,7 @@ class Server:
                 common_seqno=cseq,
                 pinned=pin_rank >= 0,
                 pin_rank=pin_rank if pin_rank >= 0 else -1,
+                attempts=f.get("attempts", 0),
             )
             self._next_seqno += 1
             self.mem.alloc(len(unit.payload))
@@ -3761,6 +4239,26 @@ class Server:
         # counted loss (the response died with the server), not an
         # invalid-handle abort
         self._adopted_tombs.update((dead, s) for s in mirror.tombstones)
+        # ... fencing state rides the stream too: a fenced owner's
+        # rerouted late fetch must stay rejected (ADLB_FENCED), never be
+        # miscounted as a replication-lag loss or — worse — served. A
+        # fence's key is the numbering of the ORIGINAL home (reroutes
+        # stamp fo_from with it), so fences the dead server had itself
+        # adopted (origin >= 0) keep their origin through the chain —
+        # and every adopted fence is logged onward to OUR buddy so a
+        # THIRD takeover still rejects the doubly-rerouted fetch
+        for (s, o, origin) in mirror.fences:
+            key = (dead if origin < 0 else origin, s, o)
+            self._adopted_fences.add(key)
+            if self.repl is not None:
+                self.repl.log_fence(s, o, origin=key[0])
+        # ... and the predecessor's dead-letter quarantine: re-homed
+        # under fresh seqnos and re-counted HERE (its own QUARANTINED
+        # stat died with it — only the survivor's count reaches the
+        # final aggregation, keeping the conservation total exact)
+        for old_seqno in sorted(mirror.quarantined):
+            self._adopt_quarantined(mirror.quarantined[old_seqno],
+                                    old_seqno, dead)
         # 4) duplicate-put protection survives the failover: the dead
         # server's accepted-put windows merge, so a client re-sending an
         # acked-but-unanswered put gets the idempotent ack, not a dup unit

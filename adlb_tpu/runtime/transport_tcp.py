@@ -759,6 +759,8 @@ def spawn_world(
             errors.extend(s for _, s in conn_lost)
     if errors:
         raise RuntimeError("; ".join(errors))
+    from adlb_tpu.types import InfoKey
+
     return WorldResult(
         app_results=app_results,
         server_stats=server_stats,
@@ -766,4 +768,8 @@ def spawn_world(
         exception=None,
         casualties=sorted(casualties),
         server_casualties=sorted(server_casualties),
+        quarantined=int(sum(
+            s.get(int(InfoKey.QUARANTINED), 0)
+            for s in server_stats.values()
+        )),
     )

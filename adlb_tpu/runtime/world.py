@@ -160,6 +160,46 @@ class Config:
     # losing a server connection before declaring the world dead
     # (failover policy only)
     failover_client_wait: float = 15.0
+    # gray-failure detection: a lease (reserved-but-unfetched unit) whose
+    # owner has neither sent traffic nor heartbeated for this long is
+    # EXPIRED — the unit re-enqueues under a fresh attempt and the old
+    # owner is FENCED for it (its late Get_reserved answers ADLB_FENCED;
+    # clients map that onto the ADLB_RETRY path). Clients arm a liveness
+    # heartbeat (FA_HEARTBEAT at timeout/3 cadence to every server) while
+    # this is set; a rank silent for 2x the timeout is declared hung by
+    # its home server (declared dead under "reclaim", world abort under
+    # "abort" — bounded detection either way; a SIGSTOP'd worker EOFs
+    # nothing, so without this the world hangs forever). 0 = off
+    # (reference semantics: a hung owner holds its leases forever).
+    # CAVEAT: armed expiry makes delivery at-least-once for exactly the
+    # expired-lease window (the fenced owner may have fetched the
+    # payload before stalling); fencing guarantees no double-SETTLE, not
+    # no double-execution. Python clients only (the C client does not
+    # heartbeat — a busy native rank would be misread as hung).
+    lease_timeout_s: float = 0.0
+    # retry budget per unit: a unit whose delivery failed (owner death
+    # reclaim, lease expiry, undeliverable response) more than this many
+    # times is moved to the per-server dead-letter QUARANTINE instead of
+    # the queue — bounded blast radius for a poison unit that crashes
+    # every worker it touches. Counted exactly-once
+    # (InfoKey.QUARANTINED / WorldResult.quarantined, surviving
+    # failover), settled for exhaustion voting, retrievable via
+    # ctx.get_quarantined() and the ops endpoint /deadletter.
+    # 0 = unlimited retries (reference-faithful: reclaim re-enqueues
+    # forever).
+    max_unit_retries: int = 0
+    # memory watermarks (fractions of max_malloc_per_server): above SOFT
+    # the server engages memory-pressure pushes (the reference's
+    # THRESHOLD_TO_START_PUSH, src/adlb.c:93 — 0.95 there and here) and
+    # reports the mem_pressure gauge; above HARD with no peer believed to
+    # have room, puts answer ADLB_BACKOFF with a retry-after hint that
+    # feeds the client's decorrelated-jitter backoff (not burning its
+    # retry budget), so an overloaded fleet sheds load instead of
+    # aborting producers on malloc exhaustion. mem_hard_frac 0 = off
+    # (reference behavior: ADLB_PUT_REJECTED hopping until retries
+    # exhaust).
+    mem_soft_frac: float = 0.95
+    mem_hard_frac: float = 0.0
     # seeded deterministic fault injection (adlb_tpu/runtime/faults.py):
     # a plain-data spec dict {seed, drop, delay, delay_s, duplicate,
     # disconnect_at: {rank: frame}, kill_at_frame: {rank: frame},
@@ -263,6 +303,33 @@ class Config:
             )
         if self.failover_client_wait <= 0:
             raise ValueError("failover_client_wait must be > 0")
+        if self.lease_timeout_s < 0:
+            raise ValueError("lease_timeout_s must be >= 0")
+        if self.lease_timeout_s > 0 and self.server_impl == "native":
+            # the C++ daemon has no lease table, heartbeat intake, or
+            # fence bookkeeping
+            raise ValueError(
+                "lease_timeout_s > 0 requires server_impl='python'"
+            )
+        if self.max_unit_retries < 0:
+            raise ValueError("max_unit_retries must be >= 0")
+        if self.max_unit_retries > 0 and self.server_impl == "native":
+            raise ValueError(
+                "max_unit_retries > 0 requires server_impl='python'"
+            )
+        if not (0.0 < self.mem_soft_frac <= 1.0):
+            raise ValueError("mem_soft_frac must be in (0, 1]")
+        if not (0.0 <= self.mem_hard_frac <= 1.0):
+            raise ValueError("mem_hard_frac must be in [0, 1]")
+        if self.mem_hard_frac > 0 and self.mem_hard_frac < self.mem_soft_frac:
+            raise ValueError(
+                "mem_hard_frac, when armed, must be >= mem_soft_frac"
+            )
+        if self.mem_hard_frac > 0 and self.server_impl == "native":
+            # the C++ daemon answers capacity with ADLB_PUT_REJECTED only
+            raise ValueError(
+                "mem_hard_frac > 0 requires server_impl='python'"
+            )
         if self.put_retry_cap < self.put_retry_sleep:
             raise ValueError("put_retry_cap must be >= put_retry_sleep")
         if self.reconnect_attempts < 0:

@@ -20,6 +20,19 @@ ADLB_PUT_REJECTED = -999999996
 # (e.g. the requester reconnected while its rank-death fan-out was still
 # settling). Clients retry with capped exponential backoff + jitter.
 ADLB_RETRY = -999999995
+# Fenced operation (no reference analogue; Config(lease_timeout_s) > 0):
+# the requester's lease on this unit EXPIRED — the unit was re-enqueued
+# under a new attempt, and this late settle attempt from the old owner is
+# rejected so a slow-but-alive worker can never double-settle a unit.
+# Clients map it onto the ADLB_RETRY backoff path (drop the handle,
+# re-reserve).
+ADLB_FENCED = -999999994
+# Overload backpressure (no reference analogue; Config(mem_hard_frac) > 0):
+# the server is above its hard memory watermark and knows no peer with
+# room either — retry the SAME request after the carried retry-after
+# hint instead of hopping between equally-full servers until the retry
+# budget aborts the producer. Does not burn put_max_retries.
+ADLB_BACKOFF = -999999993
 ADLB_LOWEST_PRIO = -999999999
 
 ADLB_RESERVE_REQUEST_ANY = -1
@@ -60,6 +73,12 @@ class InfoKey(enum.IntEnum):
     NUM_FAILOVERS = 15
     FAILOVER_LOST = 16
     FAILOVER_MTTR_MS = 17
+    # gray-failure surface: units moved to the per-server dead-letter
+    # quarantine after exhausting Config(max_unit_retries) — counted
+    # exactly-once under the same conservation contract as FAILOVER_LOST
+    # (every unit is completed, re-executed, or counted here), and
+    # retrievable via ctx.get_quarantined() / the ops /deadletter view
+    QUARANTINED = 18
 
 
 @dataclasses.dataclass(frozen=True)

@@ -880,6 +880,124 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         failover_rows = {"failover_error": repr(e)[:200]}
 
+    # gray-failure recovery cost (lease_timeout_s armed): a worker
+    # SIGSTOPped mid-trickle while holding an unfetched reservation —
+    # hang_mttr_ms is stall-to-redelivery (expiry detection + re-enqueue
+    # + rematch, measured across processes on the shared CLOCK_MONOTONIC)
+    # — and a put storm against a tiny hard-watermarked memory cap,
+    # recording that backoff sheds the overload instead of aborting the
+    # producer. Own containment, like the failover row.
+    def gray_bench():
+        import struct
+
+        from adlb_tpu.runtime.faults import sigstop_self
+        from adlb_tpu.runtime.transport_tcp import spawn_world as _sw
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        T_W, T_V, T_ANS, T_STALL, T_GO = 1, 2, 3, 4, 5
+        lease_s = 0.5
+
+        def hang_app(ctx):
+            # rank 1 is the ONLY requester of T_V until it confirms (via
+            # the T_GO token) that it HOLDS the marked unit's lease —
+            # then it stamps the clock and freezes. Expiry re-enqueues
+            # the unit; rank 2 (unblocked by T_GO) stamps its
+            # redelivery. Rank 0 waits for BOTH stamps before
+            # terminating, so the world can never tear down under the
+            # still-stopped victim.
+            if ctx.rank == 0:
+                assert ctx.put(b"marked", T_V) == _OK
+                for i in range(20):  # the trickle around the stall
+                    assert ctx.put(struct.pack("<q", i), T_W) == _OK
+                stamps = {}
+                while len(stamps) < 2:
+                    rc, r = ctx.reserve([T_ANS, T_STALL])
+                    assert rc == _OK, rc
+                    rc, buf = ctx.get_reserved(r.handle)
+                    if rc != _OK:
+                        continue
+                    stamps[r.work_type] = struct.unpack("<d", buf)[0]
+                ctx.set_problem_done()
+                return (stamps[T_ANS] - stamps[T_STALL]) * 1e3
+            if ctx.rank == 1:
+                rc, r = ctx.reserve([T_V])
+                assert rc == _OK, rc
+                assert ctx.put(b"go", T_GO) == _OK
+                t_stall = time.monotonic()
+                # past worst-case expiry latency (~1.25x lease + scan
+                # jitter) but under the 2x hang bar: a declared-dead
+                # rank would be excluded from the exhaustion vote and
+                # the world could terminate before this stamp lands
+                sigstop_self(1.6 * lease_s)
+                ctx.get_reserved(r.handle)  # fenced/void: rc != OK
+                ctx.put(struct.pack("<d", t_stall), T_STALL,
+                        target_rank=0)
+                return "stalled"
+            rc, r = ctx.reserve([T_GO])  # rank 1 holds the T_V lease now
+            assert rc == _OK, rc
+            ctx.get_reserved(r.handle)
+            got = 0
+            while True:
+                rc, r = ctx.reserve([T_W, T_V])
+                if rc != _OK:
+                    return got
+                rc, buf = ctx.get_reserved(r.handle)
+                if rc != _OK:
+                    continue
+                if buf == b"marked":  # the redelivered stalled unit
+                    ctx.put(struct.pack("<d", time.monotonic()), T_ANS,
+                            target_rank=0)
+                got += 1
+                time.sleep(0.01)
+
+        res = _sw(
+            3, 2, [T_W, T_V, T_ANS, T_STALL, T_GO], hang_app,
+            cfg=Config(on_worker_failure="reclaim",
+                       lease_timeout_s=lease_s,
+                       exhaust_check_interval=0.2),
+            timeout=120.0,
+        )
+        mttr_ms = res.app_results[0]
+        rows = {"hang_mttr_ms": round(mttr_ms, 1),
+                "hang_lease_timeout_ms": lease_s * 1e3}
+
+        def storm_app(ctx):
+            n = 80
+            if ctx.rank == 0:
+                for i in range(n):
+                    rc = ctx.put(struct.pack("<q", i) + b"\0" * 56, T_W)
+                    assert rc == _OK, rc
+                return {"put_backoffs":
+                        ctx._c.metrics.value("put_backoffs"),
+                        "put_retries": ctx._c.metrics.value("put_retries")}
+            got = 0
+            while True:
+                rc, w = ctx.get_work([T_W])
+                if rc != _OK:
+                    return got
+                got += 1
+                time.sleep(0.005)
+
+        res = _sw(
+            2, 2, [T_W], storm_app,
+            cfg=Config(max_malloc_per_server=512, mem_soft_frac=0.85,
+                       mem_hard_frac=0.9, put_max_retries=200,
+                       exhaust_check_interval=0.2),
+            timeout=120.0,
+        )
+        rows.update(
+            put_storm_units=80,
+            put_storm_consumed=res.app_results[1],
+            put_storm_backoffs=int(res.app_results[0]["put_backoffs"]),
+            put_storm_retries=int(res.app_results[0]["put_retries"]),
+        )
+        return rows
+
+    try:
+        gray_rows = gray_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        gray_rows = {"gray_error": repr(e)[:200]}
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -989,6 +1107,7 @@ def main() -> None:
             "tpu_pop_p50_reps": [
                 round(r.latency_p50_ms, 3) for r in coin_runs["tpu"]],
             **failover_rows,
+            **gray_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1101,6 +1220,8 @@ def main() -> None:
             "disp_fast_p50": round(tric_fast.dispatch_p50_ms, 2),
             # pop service latency (coinop), paired-rep medians
             "failover_mttr_ms": failover_rows.get("failover_mttr_ms"),
+            "hang_mttr_ms": gray_rows.get("hang_mttr_ms"),
+            "storm_backoffs": gray_rows.get("put_storm_backoffs"),
             "pop_p50": [round(lat_steal.latency_p50_ms, 3),
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),

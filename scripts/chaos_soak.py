@@ -170,6 +170,58 @@ def _economy_rank0(ctx, n_pairs, do_abort):
     return total
 
 
+def gray_economy(n_units, victim=None, stall_s=0.0, poison=False):
+    """Answer-at-cycle-boundary economy for the GRAY adversities: rank 0
+    puts ids (plus one poison-typed unit when ``poison``) and collects
+    answers until coverage is complete; workers reserve/fetch/answer with
+    a small compute sleep. ``victim`` SIGSTOPs itself between reserve and
+    fetch (holding an unfetched lease) and must survive the fencing of
+    its late fetch. Kills at reserve-response (the poison fault) land at
+    cycle boundaries, so a casualty loses nothing it already answered
+    and the id-coverage oracle stays exact."""
+    T, T_P, T_ANS = 1, 2, 3
+
+    def app(ctx):
+        from adlb_tpu.runtime.faults import sigstop_self
+
+        if ctx.rank == 0:
+            for i in range(n_units):
+                rc = ctx.put(struct.pack("<q", i), T, answer_rank=0)
+                assert rc == ADLB_SUCCESS, rc
+            if poison:
+                assert ctx.put(b"poison", T_P) == ADLB_SUCCESS
+            seen = set()
+            while len(seen) < n_units:
+                rc, r = ctx.reserve([T_ANS])
+                assert rc == ADLB_SUCCESS, rc
+                rc, buf = ctx.get_reserved(r.handle)
+                if rc != ADLB_SUCCESS:
+                    continue
+                seen.add(struct.unpack("<q", buf)[0])
+            ctx.set_problem_done()
+            return len(seen)
+        # the SIGSTOP victim never touches the poison type: it must
+        # SURVIVE (the adversity under test is the hang, not a kill)
+        my_types = [T] if ctx.rank == victim else [T, T_P]
+        n, retries, stopped = 0, 0, False
+        while True:
+            rc, r = ctx.reserve(my_types)
+            if rc != ADLB_SUCCESS:
+                return n, retries, stopped
+            if ctx.rank == victim and n >= 1 and not stopped:
+                stopped = True
+                sigstop_self(stall_s)
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc != ADLB_SUCCESS:
+                retries += 1  # fenced/void handle: re-reserve
+                continue
+            ctx.put(buf, T_ANS, target_rank=0)
+            n += 1
+            time.sleep(0.003)
+
+    return app
+
+
 def one_iter(seed):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
@@ -195,6 +247,23 @@ def one_iter(seed):
         and servers >= 2 and rng.random() < 0.3
     )
     s_policy = rng.choice(["abort", "failover"]) if do_skill else "abort"
+    # gray adversities (lease_timeout_s armed): a worker SIGSTOPped
+    # mid-lease (expiry + fencing must redeliver its unit and reject its
+    # post-SIGCONT fetch), or a poison-typed unit that kills every
+    # worker reserving it (the retry budget must quarantine it, exactly
+    # once, and the fleet must survive) — both run under both worker
+    # policies; python servers only (the daemon has no lease table)
+    do_stall = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and apps >= 3 and rng.random() < 0.35
+    )
+    do_poison = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and not do_stall and apps >= 5
+        and rng.random() < 0.35
+    )
+    g_policy = rng.choice(["abort", "reclaim"]) if (do_stall or do_poison) \
+        else None
     # seeded delay faults: protocol-invisible, timing-hostile; applied to
     # every endpoint via Config so replays of this seed shake the same
     # interleavings
@@ -205,20 +274,28 @@ def one_iter(seed):
         # descriptor honest (the spawn-plane/native coverage comes from
         # the economy iterations)
         native = False
-    if policy == "reclaim" or do_faults or do_skill:
-        # the C++ daemon implements neither the reclaim/failover
+    if policy == "reclaim" or do_faults or do_skill or do_stall or do_poison:
+        # the C++ daemon implements neither the reclaim/failover/lease
         # protocols nor the (Python-side) fault shim
         native = False
 
     kw = dict(balancer=mode, exhaust_check_interval=0.2,
               on_worker_failure=policy,
               on_server_failure=s_policy)
+    if do_stall or do_poison:
+        kw["on_worker_failure"] = g_policy
+        kw["lease_timeout_s"] = rng.choice([0.8, 1.2])
+        if do_poison:
+            kw["max_unit_retries"] = 2
+            kw["fault_spec"] = {"seed": seed, "poison_types": [2]}
     if native:
         kw["server_impl"] = "native"
     if cap:
         kw["max_malloc_per_server"] = cap
     if do_faults:
-        kw["fault_spec"] = {"seed": seed, "delay": 0.03, "delay_s": 0.002}
+        # merge-safe: a gray (poison) spec may already be installed
+        kw["fault_spec"] = dict(kw.get("fault_spec") or {},
+                                seed=seed, delay=0.03, delay_s=0.002)
     if do_skill:
         # kill a random non-master server a moment into the run (frame
         # counts track protocol activity, so the death lands mid-workload)
@@ -228,6 +305,49 @@ def one_iter(seed):
             kill_server_at_frame={victim_srv: rng.randint(30, 120)},
         )
     cfg = Config(**kw)
+
+    if do_stall or do_poison:
+        n_units = rng.randint(16, 40)
+        victim = rng.randrange(1, apps) if do_stall else None
+        # short stalls stay under the 2x hang bar (expiry + fencing only);
+        # long ones also trip hang detection (dead-declare + resurrect
+        # under "reclaim", world abort under "abort")
+        stall_s = round(rng.uniform(1.3, 2.6) * kw["lease_timeout_s"], 2)
+        app_fn = gray_economy(n_units, victim=victim, stall_s=stall_s,
+                              poison=do_poison)
+        desc = dict(apps=apps, servers=servers, mode=mode, cap=cap,
+                    workload="gray", stall=do_stall, poison=do_poison,
+                    policy=g_policy, stall_s=stall_s if do_stall else None,
+                    faults=do_faults)
+        t0 = time.monotonic()
+        try:
+            res = spawn_world(apps, servers, [1, 2, 3], app_fn,
+                              cfg=cfg, timeout=150.0)
+        except RuntimeError:
+            # a clean abort classification is a valid outcome under
+            # "abort" (hang detection, or a poison kill's EOF) — but it
+            # must be CLEAN: bounded, never a hang
+            assert g_policy == "abort", "survival policy aborted"
+            assert time.monotonic() - t0 < 120.0, "gray abort hung"
+            return desc
+        if res.aborted:
+            assert g_policy == "abort", "survival policy aborted"
+            return desc
+        # the world completed: coverage must be exact
+        assert res.app_results[0] == n_units, res.app_results
+        if do_stall:
+            # short stall: the victim is fenced, resumes, and reports.
+            # long stall (past the 2x hang bar): the world may complete
+            # around the hung rank before it resumes — then it is a
+            # counted casualty. Either way the FLEET survived with exact
+            # coverage; vanishing without a trace is the only failure.
+            assert victim in res.app_results or victim in res.casualties, \
+                "stalled worker vanished"
+        if do_poison:
+            assert res.quarantined == 1, res.quarantined
+            # poison kills at most budget+1 workers, and someone survives
+            assert len(res.casualties) <= 3, res.casualties
+        return desc
 
     if do_skill:
         n_units = rng.randint(24, 60)

@@ -92,12 +92,16 @@ def _dedup_metrics(docs: list[dict]) -> list[dict]:
 
 # structured failure events the runtime records with a fixed leading
 # keyword (server._on_rank_dead / _resurrect / the failover machinery,
-# client._send_retry / _apply_takeover)
+# client._send_retry / _apply_takeover, and the gray-failure surface:
+# lease expiry/fencing, hang detection, dead-letter quarantine, and
+# overload backpressure)
 _FAILURE_PREFIXES = (
     "rank_dead", "lease_reclaimed", "targeted_dropped", "reconnect",
     "abort", "home server", "send to rank",
     "server_dead", "failover_promoted", "failover_lost", "home_takeover",
     "relay_consumed_on_failover", "replication",
+    "lease_expired", "rank_hung", "unit_quarantined", "put_backoff",
+    "fenced",
 )
 
 

@@ -1,0 +1,771 @@
+"""Gray-failure survival: lease expiry with fencing, poison-unit
+quarantine, and overload backpressure (ISSUE 5 tentpole).
+
+Four layers of coverage:
+
+* **Fault-shim mechanics** — the `stall_at_frame`/`stall_at` gray-failure
+  injection (endpoint freezes, process stays alive, buffered frames
+  flush on resume) and the `poison_types` reserve-response kill, plus
+  `resolve_spec`'s server-index stall keys.
+* **Expiry race lattice** — Server instances driven handler-by-handler:
+  expiry fences the owner and re-enqueues under a fresh attempt, a
+  heartbeat (or explicit `extend_lease` renewal) crossing the expiry
+  window prevents it, late settles from the fenced owner answer
+  ADLB_FENCED (including after a failover, via the replicated fence
+  set), retry budgets quarantine poison units with exactly-once
+  counting, and the hard-watermark backpressure answers ADLB_BACKOFF to
+  untargeted puts only.
+* **Replication** — fences, attempt counts, and the dead-letter store
+  ride the PR 4 replication stream (log <-> mirror roundtrip), so
+  failover neither un-fences a stalled owner nor resets a poison unit's
+  budget.
+* **End-to-end** — in-proc worlds (both balancer modes) where a worker
+  stalls mid-lease and the world completes with exact unit conservation;
+  a quarantined unit settling the exhaustion vote; and the slow-marked
+  8-rank TCP acceptance world: one SIGSTOP'd worker, one poison unit,
+  and a put storm under `lease_timeout_s > 0` — every unit accounted
+  exactly once as completed, re-executed, or quarantined, and the
+  fenced owner's post-SIGCONT fetch rejected without double-execution.
+"""
+
+import os
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.runtime.faults import (
+    FaultPlan,
+    FaultyEndpoint,
+    resolve_spec,
+    sigstop_self,
+)
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.replica import ReplicaMirror, ReplicationLog
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.runtime.queues import WorkUnit
+from adlb_tpu.types import (
+    ADLB_BACKOFF,
+    ADLB_FENCED,
+    ADLB_RETRY,
+    ADLB_SUCCESS,
+    InfoKey,
+)
+
+T = 1
+T_POISON = 2
+
+
+# ---------------------------------------------------- fault-shim mechanics
+
+
+def test_stall_buffers_outbound_and_flushes_in_order():
+    fabric = InProcFabric(2)
+    plan = FaultPlan({"stall_at_frame": {0: 2}, "stall_for_s": 0.6}, 0)
+    fep = FaultyEndpoint(fabric.endpoints[0], plan)
+    fep.send(1, msg(Tag.FA_PUT, 0, payload=b"a"))
+    for p in (b"b", b"c"):  # frames 2, 3: stalled, buffered
+        fep.send(1, msg(Tag.FA_PUT, 0, payload=p))
+    got = [fabric.endpoints[1].recv(timeout=0.1) for _ in range(2)]
+    assert [m.payload for m in got if m is not None] == [b"a"]
+    # recv goes silent inside the window (inbound waits in the transport)
+    fabric.endpoints[1].send(0, msg(Tag.TA_PUT_RESP, 1, rc=0))
+    assert fep.recv(timeout=0.01) is None
+    time.sleep(0.6)  # window passes; next op flushes the buffer in order
+    m = fep.recv(timeout=1.0)
+    assert m is not None and m.tag is Tag.TA_PUT_RESP
+    got = [fabric.endpoints[1].recv(timeout=1.0) for _ in range(2)]
+    assert [m.payload for m in got] == [b"b", b"c"]
+    acts = [a for _, a, _, _ in plan.event_log()]
+    assert "stall" in acts and "resume" in acts
+
+
+def test_stall_now_rearms_for_repeated_gray_failures():
+    fabric = InProcFabric(2)
+    plan = FaultPlan({"seed": 1, "stall_for_s": 0.05}, 0)
+    FaultyEndpoint(fabric.endpoints[0], plan)
+    for _ in range(2):
+        plan.stall_now()
+        assert plan.stalled()
+        time.sleep(0.08)
+        assert not plan.stalled()
+    assert [a for _, a, _, _ in plan.event_log()].count("stall") == 2
+
+
+def test_poison_types_kills_on_marked_reserve_resp(monkeypatch):
+    fabric = InProcFabric(2)
+    plan = FaultPlan({"poison_types": [T_POISON]}, 1)
+    fep = FaultyEndpoint(fabric.endpoints[1], plan)
+    killed = []
+    monkeypatch.setattr(
+        FaultyEndpoint, "_kill_now", lambda self: killed.append(True)
+    )
+    # an unmarked type passes through unharmed
+    fabric.endpoints[0].send(
+        1, msg(Tag.TA_RESERVE_RESP, 0, rc=ADLB_SUCCESS, work_type=T)
+    )
+    assert fep.recv(timeout=1.0) is not None and not killed
+    # the marked type kills the worker on the spot (lease left behind)
+    fabric.endpoints[0].send(
+        1, msg(Tag.TA_RESERVE_RESP, 0, rc=ADLB_SUCCESS, work_type=T_POISON)
+    )
+    fep.recv(timeout=1.0)
+    assert killed
+    assert any(a == "poison" for _, a, _, _ in plan.event_log())
+
+
+def test_resolve_spec_translates_server_stall_keys():
+    world = WorldSpec(nranks=6, nservers=2, types=(T,))
+    spec = {"stall_server_at_frame": {1: 40}, "stall_server_at": {0: 2.5}}
+    out = resolve_spec(spec, world)
+    servers = sorted(world.server_ranks)
+    assert out["stall_at_frame"] == {servers[1]: 40}
+    assert out["stall_at"] == {servers[0]: 2.5}
+    assert "stall_server_at_frame" not in out
+
+
+# -------------------------------------------------- expiry race lattice
+
+
+def _mini_server(nranks=4, nservers=2, **cfg_kw):
+    """A Server on an in-proc fabric, driven handler-by-handler (its
+    reactor loop never runs). world: apps 0..1, servers 2..3."""
+    cfg_kw.setdefault("on_worker_failure", "reclaim")
+    cfg_kw.setdefault("lease_timeout_s", 0.5)
+    world = WorldSpec(nranks=nranks, nservers=nservers, types=(T, T_POISON))
+    fabric = InProcFabric(nranks)
+    return Server(world, Config(**cfg_kw), fabric.endpoint(2)), fabric
+
+
+def _drain(fabric, rank):
+    out = []
+    while True:
+        m = fabric.endpoints[rank].recv(timeout=0.0)
+        if m is None:
+            return out
+        out.append(m)
+
+
+def _put(srv, src=0, payload=b"unit", work_type=T, target=-1,
+         common_len=0, common_server=-1, common_seqno=-1):
+    srv._handle(msg(Tag.FA_PUT, src, payload=payload, work_type=work_type,
+                    prio=0, target_rank=target, answer_rank=-1,
+                    common_len=common_len, common_server=common_server,
+                    common_seqno=common_seqno))
+
+
+def _reserve(srv, src, rqseqno=1, types=(T,)):
+    srv._handle(msg(Tag.FA_RESERVE, src, req_types=list(types), hang=True,
+                    rqseqno=rqseqno))
+
+
+def test_expiry_fences_and_reenqueues_with_attempt_bump():
+    srv, fabric = _mini_server()
+    _put(srv)
+    _reserve(srv, 0)
+    [unit] = list(srv.wq.units())
+    assert unit.pinned and len(srv.leases) == 1
+    _drain(fabric, 0)
+    # the owner goes silent past the timeout: expiry, not rank death
+    srv._scan_leases(time.monotonic() + 0.75)
+    assert len(srv.leases) == 0
+    assert (unit.seqno, 0) in srv._fences
+    assert not unit.pinned and unit.attempts == 1
+    assert srv.metrics.value("leases_expired") == 1
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("lease_expired") for t in texts)
+    # the re-enqueued unit is matchable right now
+    assert srv.wq.find_match(1, frozenset([T])) is not None
+    # ... and the fenced owner's late fetch is rejected: no double-settle
+    srv._handle(msg(Tag.FA_GET_RESERVED, 0, seqno=unit.seqno))
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_FENCED
+    # the survivor reserves and settles the unit exactly once
+    _reserve(srv, 1)
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=unit.seqno))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"unit"
+    assert srv.wq.count == 0
+
+
+def test_liveness_piggyback_and_heartbeat_cross_expiry():
+    srv, fabric = _mini_server()
+    _put(srv)
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    # any frame from the owner is liveness evidence: a scan inside the
+    # timeout (aged from last-heard, which the reserve stamped) is a
+    # no-op even though the GRANT is older than you'd think
+    srv._scan_leases(time.monotonic() + 0.4)
+    assert len(srv.leases) == 1
+    # an idle-but-computing owner heartbeats: still no expiry at +0.9
+    # measured from the heartbeat
+    time.sleep(0.05)
+    srv._handle(msg(Tag.FA_HEARTBEAT, 0))
+    assert srv.metrics.value("heartbeats") == 1
+    srv._scan_leases(srv._last_heard[0] + 0.4)
+    assert len(srv.leases) == 1, "heartbeat did not carry liveness"
+
+
+def test_extend_lease_renews_one_lease_not_the_rank():
+    srv, fabric = _mini_server()
+    _put(srv, payload=b"short")
+    _put(srv, payload=b"long")
+    _reserve(srv, 0, rqseqno=1)
+    _reserve(srv, 0, rqseqno=2)
+    _drain(fabric, 0)
+    short, long_ = sorted(srv.leases.leases(), key=lambda ls: ls.seqno)
+    # ctx.extend_lease(handle) -> FA_HEARTBEAT with the unit's seqno
+    srv._on_heartbeat(msg(Tag.FA_HEARTBEAT, 0, seqno=long_.seqno))
+    assert srv.leases.get(long_.seqno).renewed_at > 0
+    # age the rank 1.5x the timeout (silent, but under the 2x hang bar):
+    # the un-renewed lease expires, the renewed one survives
+    for ls in (short, long_):
+        ls.granted_at -= 0.75
+    srv._last_heard[0] -= 0.75
+    srv._scan_leases(time.monotonic())
+    assert srv.leases.get(short.seqno) is None
+    assert srv.leases.get(long_.seqno) is not None
+    assert (short.seqno, 0) in srv._fences
+    # a renewal for a lease already gone is silently stale
+    srv._on_heartbeat(msg(Tag.FA_HEARTBEAT, 0, seqno=short.seqno))
+    assert srv.leases.get(short.seqno) is None
+
+
+@pytest.mark.parametrize("policy", ["reclaim", "abort"])
+def test_hang_detection_after_2x_silence(policy):
+    srv, fabric = _mini_server(on_worker_failure=policy)
+    _put(srv)
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    srv._last_heard[0] -= 1.2  # 2.4x the 0.5 s timeout of total silence
+    for ls in srv.leases.leases():
+        ls.granted_at -= 1.2
+    srv._scan_leases(time.monotonic())
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("rank_hung rank=0") for t in texts)
+    if policy == "reclaim":
+        assert 0 in srv._dead_ranks and not srv._aborted
+        # termination accounting released: nothing leased, rank excluded
+        assert not srv.leases.owned_by(0)
+    else:
+        assert srv._aborted
+
+
+def test_native_clients_exempt_from_expiry_and_hang():
+    """A native (C) client cannot heartbeat: its silence while
+    compute-bound must not expire its lease or declare it hung."""
+    srv, fabric = _mini_server()
+    _put(srv)
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    srv.ep.binary_peers = {0}
+    [ls] = srv.leases.leases()
+    ls.granted_at -= 5.0
+    srv._last_heard[0] -= 5.0  # 10x the timeout of total silence
+    srv._scan_leases(time.monotonic())
+    assert len(srv.leases) == 1, "binary peer's lease expired"
+    assert 0 not in srv._dead_ranks and not srv._aborted
+    texts = [t for _, t in srv.flight.entries()]
+    assert not any(t.startswith(("lease_expired", "rank_hung"))
+                   for t in texts)
+
+
+def test_expiry_credits_common_prefix_against_double_get():
+    """The silent owner may already have fetched the batch prefix; the
+    re-consumption fetches it again. The expiry-time credit absorbs
+    that second get so the prefix cannot GC out from under surviving
+    members (bounded leak, not a crash)."""
+    srv, fabric = _mini_server()
+    srv._handle(msg(Tag.FA_PUT_COMMON, 0, payload=b"PREFIX"))
+    common_seqno = _drain(fabric, 0)[-1].common_seqno
+    for p in (b"u0", b"u1"):
+        _put(srv, payload=p, common_len=6, common_server=srv.rank,
+             common_seqno=common_seqno)
+    srv._handle(msg(Tag.FA_BATCH_DONE, 0, common_seqno=common_seqno,
+                    refcnt=2))
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    [lease] = srv.leases.leases()
+    # the owner fetches the prefix, then stalls before the suffix
+    srv._handle(msg(Tag.FA_GET_COMMON, 0, common_seqno=common_seqno,
+                    get_id=1))
+    srv._scan_leases(time.monotonic() + 0.75)
+    assert len(srv.leases) == 0
+    # survivor consumes BOTH members, fetching the prefix once each:
+    # without the credit the second get would overrun refcnt
+    for rq in (1, 2):
+        _reserve(srv, 1, rqseqno=rq)
+        resp = [m for m in _drain(fabric, 1)
+                if m.tag is Tag.TA_RESERVE_RESP][-1]
+        assert resp.rc == ADLB_SUCCESS
+        srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=common_seqno,
+                        get_id=rq))
+        srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=resp.handle[0]))
+    assert srv.wq.count == 0
+    assert len(srv.cq) == 0, "credited prefix never GC'd"
+
+
+def test_expiry_quarantine_record_reassembles_and_books_balance():
+    """A fused member that expires its way into quarantine: the
+    dead-letter record carries prefix+suffix (not the bare suffix),
+    the quarantining expiry adds no credit (a re-consumption will
+    never come) and no forfeit (the silent owner's fetches are already
+    in the books), and the prefix still GCs once the surviving member
+    fetches."""
+    srv, fabric = _mini_server(max_unit_retries=1)
+    srv._handle(msg(Tag.FA_PUT_COMMON, 0, payload=b"PREFIX-"))
+    common_seqno = _drain(fabric, 0)[-1].common_seqno
+    _put(srv, payload=b"bad", target=0, common_len=7,
+         common_server=srv.rank, common_seqno=common_seqno)
+    _put(srv, payload=b"good", target=1, common_len=7,
+         common_server=srv.rank, common_seqno=common_seqno)
+    srv._handle(msg(Tag.FA_BATCH_DONE, 0, common_seqno=common_seqno,
+                    refcnt=2))
+    # two consumption epochs by rank 0: each fetches the prefix, then
+    # stalls past the timeout; the second expiry exhausts the budget
+    for epoch in (1, 2):
+        _reserve(srv, 0, rqseqno=epoch)
+        resp = [m for m in _drain(fabric, 0)
+                if m.tag is Tag.TA_RESERVE_RESP][-1]
+        assert resp.rc == ADLB_SUCCESS
+        srv._handle(msg(Tag.FA_GET_COMMON, 0, common_seqno=common_seqno,
+                        get_id=epoch))
+        for ls in srv.leases.leases():
+            ls.granted_at -= 0.75
+        srv._last_heard[0] -= 0.75
+        srv._scan_leases(time.monotonic())
+    assert srv.stats[InfoKey.QUARANTINED] == 1
+    [rec] = srv.quarantine
+    assert rec["payload"] == b"PREFIX-bad" and not rec["suffix_only"]
+    # the survivor's fetch closes the books exactly: refcnt (2 member
+    # shares + 1 first-expiry credit) == ngets (three fetches)
+    _reserve(srv, 1, rqseqno=1)
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_RESERVE_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS
+    srv._handle(msg(Tag.FA_GET_COMMON, 1, common_seqno=common_seqno,
+                    get_id=1))
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=resp.handle[0]))
+    assert srv.wq.count == 0
+    assert len(srv.cq) == 0, "prefix failed to GC after quarantine"
+
+
+def test_retry_budget_quarantines_exactly_once_and_settles():
+    srv, fabric = _mini_server(max_unit_retries=2)
+    _put(srv, payload=b"poison")
+    for attempt in range(3):
+        _reserve(srv, attempt % 2, rqseqno=attempt)
+        _drain(fabric, attempt % 2)
+        srv._scan_leases(time.monotonic() + 0.75)
+    # third expiry exceeded the budget: out of the wq, settled for the
+    # exhaustion vote, counted exactly once, payload retained
+    assert srv.wq.count == 0 and srv.wq.num_unpinned() == 0
+    assert len(srv.leases) == 0
+    assert len(srv.quarantine) == 1
+    assert srv.quarantine[0]["payload"] == b"poison"
+    assert srv.quarantine[0]["attempts"] == 3
+    assert srv.stats[InfoKey.QUARANTINED] == 1
+    assert srv.metrics.value("quarantined") == 1
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("unit_quarantined") for t in texts)
+    # dead-letter retrieval round trip (parallel-list wire form)
+    srv._handle(msg(Tag.FA_GET_QUARANTINED, 1))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_QUARANTINED_RESP][-1]
+    assert resp.data["payloads"] == [b"poison"]
+    assert resp.data["attempts_list"] == [3]
+
+
+def test_backoff_above_hard_watermark_untargeted_only():
+    srv, fabric = _mini_server(max_malloc_per_server=100,
+                               mem_soft_frac=0.85, mem_hard_frac=0.9,
+                               lease_timeout_s=0.0)
+    for st in srv.peers.values():  # gossip: every peer full
+        st.nbytes = 100
+    _put(srv, payload=b"x" * 85)
+    assert [m.rc for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_PUT_RESP] == [ADLB_SUCCESS]
+    # above hard, no peer has room: untargeted put answers ADLB_BACKOFF
+    # with a retry-after hint (not a reject — hopping would not help)
+    srv._handle(msg(Tag.FA_PUT, 0, payload=b"y" * 20, work_type=T, prio=0,
+                    target_rank=-1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1, put_id=7))
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_PUT_RESP][-1]
+    assert resp.rc == ADLB_BACKOFF and resp.data["retry_after_ms"] > 0
+    assert resp.data["put_id"] == 7
+    assert srv.metrics.value("put_backoff") == 1
+    # a targeted put is completion traffic bound to THIS server:
+    # backpressuring it would starve the consumers that drain the
+    # pressure — it falls through to the reference admission path
+    srv._handle(msg(Tag.FA_PUT, 0, payload=b"z" * 20, work_type=T, prio=0,
+                    target_rank=1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1, put_id=8))
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_PUT_RESP][-1]
+    assert resp.rc != ADLB_BACKOFF
+    # ... and a believed-roomy peer turns backoff into the normal
+    # reject-with-hint hop
+    [peer] = [s for s in srv.peers if s != srv.rank]
+    srv.peers[peer].nbytes = 0
+    srv._handle(msg(Tag.FA_PUT, 0, payload=b"w" * 20, work_type=T, prio=0,
+                    target_rank=-1, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1, put_id=9))
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_PUT_RESP][-1]
+    assert resp.rc != ADLB_BACKOFF
+
+
+# ------------------------------------------------------------ replication
+
+
+def test_fences_attempts_quarantine_ride_replication_stream():
+    log = ReplicationLog(buddy=4)
+    unit = WorkUnit(seqno=5, work_type=T, prio=0, target_rank=-1,
+                    answer_rank=3, payload=b"pp", attempts=2)
+    log.log_put(unit, 0, None)
+    log.log_fence(5, 1)
+    log.log_attempts(5, 3)
+    other = WorkUnit(seqno=6, work_type=T, prio=0, target_rank=-1,
+                     answer_rank=-1, payload=b"qq", attempts=4)
+    log.log_put(other, 0, None)
+    log.log_quarantine(6)
+    mirror = ReplicaMirror(primary=3)
+    mirror.apply(log.take())
+    assert mirror.units[5]["attempts"] == 3  # put carried 2, update to 3
+    assert (5, 1, -1) in mirror.fences  # origin -1: the primary's own
+    # a fence the primary itself adopted keeps its origin numbering
+    log.log_fence(7, 2, origin=11)
+    mirror.apply(log.take())
+    assert (7, 2, 11) in mirror.fences
+    assert 6 not in mirror.units  # moved, not duplicated
+    assert mirror.quarantined[6]["attempts"] == 4
+    assert mirror.quarantined[6]["payload"] == b"qq"
+
+
+def test_adopted_fence_rejects_rerouted_late_fetch():
+    """After a failover the fenced owner's fetch arrives at the buddy
+    stamped fo_from: it must stay rejected (ADLB_FENCED), not be
+    miscounted as a replication-lag loss."""
+    srv, fabric = _mini_server()
+    dead = 9
+    srv._adopted_fences.add((dead, 55, 0))
+    before = srv.metrics.value("failover_lost")
+    srv._handle(msg(Tag.FA_GET_RESERVED, 0, seqno=55, fo_from=dead))
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_FENCED
+    assert srv.metrics.value("failover_lost") == before
+    # an unfenced unknown seqno still takes the counted-loss path
+    srv._handle(msg(Tag.FA_GET_RESERVED, 0, seqno=56, fo_from=dead))
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_RETRY
+    assert srv.metrics.value("failover_lost") == before + 1
+
+
+def test_adopt_quarantined_recounts_at_survivor():
+    srv, fabric = _mini_server(max_unit_retries=1)
+    dead = srv.rank + 1
+    srv._adopt_quarantined(
+        {"work_type": T, "prio": 0, "target_rank": -1, "answer_rank": -1,
+         "payload": b"dead-letter", "attempts": 2},
+        old_seqno=40, dead=dead,
+    )
+    assert srv.stats[InfoKey.QUARANTINED] == 1
+    [rec] = srv.quarantine
+    assert rec["payload"] == b"dead-letter" and rec["server_rank"] == srv.rank
+    assert not rec["suffix_only"]
+    # a fused member whose prefix this buddy adopted: the record
+    # translates the common handle and reattaches the prefix
+    new_c = srv.cq.adopt(b"PREFIX-", refcnt=5, ngets=0, credits=0)
+    srv._adopted_commons[(dead, 7)] = new_c
+    srv._adopt_quarantined(
+        {"work_type": T, "prio": 0, "target_rank": -1, "answer_rank": -1,
+         "payload": b"suffix", "attempts": 2, "common_seqno": 7,
+         "common_server_rank": dead, "common_len": 7},
+        old_seqno=41, dead=dead,
+    )
+    rec = srv.quarantine[-1]
+    assert rec["payload"] == b"PREFIX-suffix" and not rec["suffix_only"]
+    # ... and one whose prefix was lost to replication lag stays an
+    # honestly-flagged suffix
+    srv._adopt_quarantined(
+        {"work_type": T, "prio": 0, "target_rank": -1, "answer_rank": -1,
+         "payload": b"tail", "attempts": 2, "common_seqno": 9,
+         "common_server_rank": dead, "common_len": 4},
+        old_seqno=42, dead=dead,
+    )
+    rec = srv.quarantine[-1]
+    assert rec["payload"] == b"tail" and rec["suffix_only"]
+    assert srv.stats[InfoKey.QUARANTINED] == 3
+
+
+# ---------------------------------------------------- end-to-end, in-proc
+
+
+def _stall_coverage(n_units, stall_s):
+    """Coverage workload where rank 1 freezes (endpoint stall — the
+    in-proc analogue of SIGSTOP) while holding an unfetched
+    reservation, then resumes and retries its fenced fetch."""
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(n_units):
+                assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        got, retries = [], 0
+        stalled = False
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return got, retries
+            if ctx.rank == 1 and not stalled and len(got) >= 1:
+                stalled = True
+                ctx._c.ep.plan.stall_now()
+                time.sleep(stall_s)  # frozen: heartbeats buffer, recv silent
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                retries += 1  # fenced (or resurrected): re-reserve
+                continue
+            assert rc == ADLB_SUCCESS, rc
+            got.append(struct.unpack("<q", buf)[0])
+            time.sleep(0.002)
+
+    return app
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_inproc_stalled_worker_fenced_and_conserved(mode):
+    """A worker freezes mid-lease past the timeout: its unit is fenced +
+    re-enqueued and executed elsewhere, its own late fetch answers a
+    retriable code, and every unit is delivered exactly once."""
+    n_units = 16
+    res = run_world(
+        3, 2, [T], _stall_coverage(n_units, stall_s=0.9),
+        cfg=Config(
+            balancer=mode,
+            on_worker_failure="reclaim",
+            lease_timeout_s=0.6,
+            exhaust_check_interval=0.2,
+            fault_spec={"seed": 3, "stall_for_s": 0.9},
+        ),
+        timeout=90.0,
+    )
+    done = [x for got, _ in res.app_results.values() for x in got]
+    assert sorted(done) == list(range(n_units)), done  # exactly once
+    assert res.app_results[1][1] >= 1, "stalled rank's fetch was not fenced"
+    assert res.quarantined == 0
+
+
+def test_inproc_quarantine_settles_exhaustion_and_is_retrievable():
+    """A unit that fails every delivery: the retry budget moves it to
+    the dead-letter store, the exhaustion vote settles around it (the
+    world terminates instead of hanging on the poison unit), and
+    ctx.get_quarantined() returns it."""
+    def app(ctx):
+        assert ctx.put(b"poison", T) == ADLB_SUCCESS
+        tries = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                rcq, recs = ctx.get_quarantined()
+                assert rcq == ADLB_SUCCESS
+                return tries, recs
+            ctx._c.ep.plan.stall_now()
+            time.sleep(0.85)
+            rc, _ = ctx.get_reserved(r.handle)
+            assert rc == ADLB_RETRY, rc
+            tries += 1
+
+    t0 = time.monotonic()
+    res = run_world(
+        1, 2, [T], app,
+        cfg=Config(
+            on_worker_failure="reclaim",
+            lease_timeout_s=0.55,
+            max_unit_retries=1,
+            exhaust_check_interval=0.2,
+            fault_spec={"seed": 4, "stall_for_s": 0.7},
+        ),
+        timeout=60.0,
+    )
+    assert time.monotonic() - t0 < 45.0, "exhaustion hung on the poison unit"
+    tries, recs = res.app_results[0]
+    assert tries == 2  # budget 1: two failed attempts, then quarantine
+    assert res.quarantined == 1
+    assert [r["payload"] for r in recs] == [b"poison"]
+    assert recs[0]["attempts"] == 2
+
+
+def test_lease_disarmed_world_is_frame_identical():
+    """lease_timeout_s=0 (the default): no heartbeat thread, no
+    heartbeat frames, no fence/backoff rcs — byte-identical behavior to
+    the pre-gray-failure protocol."""
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(6):
+                ctx.put(struct.pack("<q", i), T)
+        got = []
+        while True:
+            rc, w = ctx.get_work([T])
+            if rc != ADLB_SUCCESS:
+                m = ctx._c.metrics
+                return got, (
+                    m.value("tx_msgs", tag="FA_HEARTBEAT"),
+                    m.value("rx_msgs", tag="TA_QUARANTINED_RESP"),
+                    m.value("fenced_fetches"),
+                    m.value("put_backoffs"),
+                )
+            got.append(struct.unpack("<q", w.payload)[0])
+
+    res = run_world(2, 2, [T], app,
+                    cfg=Config(exhaust_check_interval=0.2), timeout=60.0)
+    for got, counters in res.app_results.values():
+        assert counters == (0.0, 0.0, 0.0, 0.0), counters
+    assert res.quarantined == 0
+    done = [x for got, _ in res.app_results.values() for x in got]
+    assert sorted(done) == list(range(6))
+
+
+# ------------------------------------------- end-to-end, TCP (acceptance)
+
+
+N_STORM = 60
+
+
+def _acceptance_app(ctx):
+    """6 apps + 2 servers: rank 0 storms 60 puts against a tiny memory
+    cap (backpressure), rank 1 SIGSTOPs itself holding an unfetched
+    reservation (lease expiry + fencing), ranks 2-5 are exposed to the
+    poison unit (fault-spec poison_types kills them at reserve-response;
+    the retry budget quarantines it after 3 kills). Workers answer every
+    unit at cycle boundaries, so a killed worker loses nothing it
+    already answered and conservation stays exact."""
+    T_ANS = 3
+    if ctx.rank == 0:
+        # 64 B units against a 512 B/server cap: the storm must cross
+        # the hard watermark long before it finishes
+        for i in range(N_STORM):
+            rc = ctx.put(struct.pack("<q", i) + b"\0" * 56, T,
+                         answer_rank=0)
+            assert rc == ADLB_SUCCESS, rc
+        rc = ctx.put(b"poison", T_POISON)
+        assert rc == ADLB_SUCCESS, rc
+        seen = set()
+        answers = 0
+        while len(seen) < N_STORM:
+            rc, r = ctx.reserve([T_ANS])
+            assert rc == ADLB_SUCCESS, rc
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                continue
+            answers += 1
+            seen.add(struct.unpack("<q", buf)[0])
+        ctx.set_problem_done()
+        return {
+            "distinct": len(seen),
+            "answers": answers,
+            "put_backoffs": ctx._c.metrics.value("put_backoffs"),
+        }
+    my_types = [T] if ctx.rank == 1 else [T, T_POISON]
+    n, retries, stopped = 0, 0, False
+    while True:
+        rc, r = ctx.reserve(my_types)
+        if rc != ADLB_SUCCESS:
+            return {"n": n, "retries": retries, "stopped": stopped}
+        if ctx.rank == 1 and n >= 1 and not stopped:
+            stopped = True
+            sigstop_self(2.0)  # the REAL gray failure; resumes via SIGCONT
+        rc, buf = ctx.get_reserved(r.handle)
+        if rc == ADLB_RETRY:
+            retries += 1  # post-SIGCONT fenced fetch: re-reserve
+            continue
+        assert rc == ADLB_SUCCESS, rc
+        ctx.put(buf[:8], 3, target_rank=0)
+        n += 1
+        time.sleep(0.01)  # compute: the storm must outrun the drain
+
+
+@pytest.mark.slow
+def test_tcp_sigstop_poison_storm_conservation():
+    """The acceptance world: 8-rank TCP, one SIGSTOP'd worker, one
+    poison unit, a put storm over the hard watermark — completes under
+    lease_timeout_s>0 with every unit accounted exactly once as
+    completed, re-executed, or quarantined; the fenced owner survives
+    SIGCONT without double-execution."""
+    res = spawn_world(
+        6, 2, [T, T_POISON, 3], _acceptance_app,
+        cfg=Config(
+            on_worker_failure="reclaim",
+            lease_timeout_s=1.2,
+            max_unit_retries=2,
+            max_malloc_per_server=512,
+            mem_soft_frac=0.85,
+            mem_hard_frac=0.9,
+            put_max_retries=200,
+            exhaust_check_interval=0.2,
+            fault_spec={"seed": 11, "poison_types": [T_POISON]},
+        ),
+        timeout=240.0,
+    )
+    assert not res.aborted
+    r0 = res.app_results[0]
+    # conservation: all 60 storm units answered (each exactly once --
+    # distinct==answers would even forbid re-execution, but expiry makes
+    # delivery at-least-once by design, so only coverage is asserted),
+    # and the poison unit accounted exactly once, in the quarantine
+    assert r0["distinct"] == N_STORM
+    assert res.quarantined == 1, res.quarantined
+    # the put storm hit the hard watermark and was shed, not aborted
+    assert r0["put_backoffs"] >= 1, r0
+    # the SIGSTOP'd worker survived: fenced on resume, then kept working
+    assert 1 in res.app_results, "stalled worker did not survive"
+    r1 = res.app_results[1]
+    assert r1["stopped"] and r1["retries"] >= 1, r1
+    # the poison unit serially killed workers until the budget tripped:
+    # attempts 1..3 with max_unit_retries=2 means up to 3 casualties,
+    # at least 1 (it never executed anywhere)
+    assert 1 <= len(res.casualties) <= 3, res.casualties
+    assert 1 not in res.casualties
+
+
+@pytest.mark.slow
+def test_tcp_sigstop_abort_policy_detects_hang():
+    """Under on_worker_failure="abort" with expiry armed, a hung worker
+    is DETECTED (2x timeout of silence) and the world aborts instead of
+    hanging forever — bounded detection, reference-faithful outcome."""
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(8):
+                ctx.put(struct.pack("<q", i), T)
+        n = 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return n
+            if ctx.rank == 1 and n >= 1:
+                sigstop_self(6.0)  # resumes only after the abort fanout
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                continue
+            n += 1
+            time.sleep(0.01)
+
+    t0 = time.monotonic()
+    try:
+        res = spawn_world(
+            3, 2, [T], app,
+            cfg=Config(on_worker_failure="abort", lease_timeout_s=0.8,
+                       exhaust_check_interval=0.2),
+            timeout=90.0,
+        )
+        # the server-initiated abort fans out TA_ABORT and the harness
+        # classifies the world aborted (a straggler's nonzero exit may
+        # instead surface as RuntimeError — both are clean detection)
+        assert res.aborted, "hung worker was not detected"
+    except RuntimeError:
+        pass
+    assert time.monotonic() - t0 < 60.0, "hang detection did not bound MTTR"
