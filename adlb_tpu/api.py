@@ -138,6 +138,43 @@ class AdlbContext:
     def set_problem_done(self) -> int:
         return self._c.set_problem_done()
 
+    # -- job namespaces (service mode; **extension** — the reference
+    # binds one world to one job): submit a namespace on the running
+    # fleet, bind ranks to it, drain/kill it from any rank or over the
+    # ops endpoint's /jobs control plane.
+
+    @property
+    def job(self) -> int:
+        """The namespace this rank is attached to (0 = default)."""
+        return self._c.job
+
+    def attach(self, job_id: int) -> "AdlbContext":
+        """Bind this rank to a job namespace; returns self so app code
+        reads naturally as ``ctx = ctx.attach(job_id)``. Raises on a
+        control-plane refusal."""
+        rc = self._c.attach(job_id)
+        if rc != ADLB_SUCCESS:
+            from adlb_tpu.types import AdlbError
+
+            raise AdlbError(f"attach({job_id}) refused (rc={rc})")
+        return self
+
+    def submit_job(self, name: str = "",
+                   quota_bytes: int = 0) -> tuple[int, int]:
+        """(rc, job_id): create a namespace (per-server byte quota
+        enforced at put with ADLB_BACKOFF; 0 = unlimited)."""
+        return self._c.submit_job(name, quota_bytes)
+
+    def drain_job(self, job_id: int) -> tuple[int, int]:
+        return self._c.drain_job(job_id)
+
+    def kill_job(self, job_id: int) -> tuple[int, int]:
+        return self._c.kill_job(job_id)
+
+    def job_status(self, job_id: int):
+        """(rc, status dict from the master's job table)."""
+        return self._c.job_status(job_id)
+
     def info_num_work_units(self, work_type: int):
         return self._c.info_num_work_units(work_type)
 

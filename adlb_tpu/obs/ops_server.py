@@ -17,14 +17,21 @@ plane:
   inline and writes the artifact when a flight directory is configured.
 * ``GET /deadletter`` — this server's dead-letter quarantine (units that
   exhausted ``Config(max_unit_retries)``): metadata + attempt counts,
-  payloads hex-encoded and truncated for transport. The store is
-  per-server; the ops endpoint runs on the master, so this is the
-  master's shard — ``ctx.get_quarantined()`` is the world-wide view.
+  payloads hex-encoded and truncated to ``Config(ops_dump_bytes)``. The
+  store is per-server; the ops endpoint runs on the master, so this is
+  the master's shard — ``ctx.get_quarantined()`` is the world-wide view.
+* ``/jobs`` — the service-mode control plane: ``GET /jobs`` lists the
+  job table, ``GET /jobs/<id>`` one job's status, ``POST /jobs`` (JSON
+  body ``{"name": ..., "quota_bytes": ...}``) submits a namespace, and
+  ``POST /jobs/<id>/drain`` / ``POST /jobs/<id>/kill`` drive its
+  lifecycle. Mutations are injected into the reactor thread via
+  ``Server.ctl_request`` (the HTTP thread never touches protocol state
+  directly) and fan out to the fleet as ``SS_JOB_CTL``.
 
-The handler only reads plain attributes of the live ``Server`` object
-(GIL-consistent snapshots, same discipline as the metrics registry), so
-it never blocks the reactor. Binding is 127.0.0.1-only by design: this
-is an operator surface, not a public one.
+The GET handlers only read plain attributes of the live ``Server``
+object (GIL-consistent snapshots, same discipline as the metrics
+registry), so they never block the reactor. Binding is 127.0.0.1-only
+by design: this is an operator surface, not a public one.
 """
 
 from __future__ import annotations
@@ -104,13 +111,43 @@ class OpsServer:
                     elif path == "/deadletter":
                         body = json.dumps(ops._deadletter()).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/jobs":
+                        body = json.dumps(ops._jobs()).encode()
+                        self._send(200, body, "application/json")
+                    elif path.startswith("/jobs/"):
+                        doc = ops._job_one(path.split("/")[2])
+                        if doc is None:
+                            self._send(404, b"no such job\n", "text/plain")
+                        else:
+                            self._send(200, json.dumps(doc).encode(),
+                                       "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 — a scrape must
                     # never kill the listener thread
                     self._send(500, repr(e).encode(), "text/plain")
 
-            do_POST = do_GET  # /dump is idempotent either way
+            def do_POST(self) -> None:  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(n) if n else b""
+                    parts = [p for p in path.split("/") if p]
+                    if path == "/dump":
+                        # historical alias: POST /dump == GET /dump
+                        body = json.dumps(ops._dump()).encode()
+                        self._send(200, body, "application/json")
+                    elif parts[:1] == ["jobs"] and len(parts) <= 3:
+                        body = json.dumps(
+                            ops._jobs_post(parts[1:], raw)
+                        ).encode()
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except (KeyError, ValueError, IndexError) as e:
+                    self._send(400, repr(e).encode(), "text/plain")
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, repr(e).encode(), "text/plain")
 
         ops = self
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -171,6 +208,7 @@ class OpsServer:
 
     def _deadletter(self) -> dict:
         s = self.server
+        cut = getattr(s.cfg, "ops_dump_bytes", 256)
         records = []
         for q in list(getattr(s, "quarantine", ())):
             payload = q.get("payload", b"")
@@ -184,10 +222,11 @@ class OpsServer:
                     "attempts": q["attempts"],
                     "server_rank": q["server_rank"],
                     "payload_len": len(payload),
-                    # bounded hex so a fat poison unit cannot blow up a
-                    # scrape; the full payload stays retrievable in-band
-                    # via ctx.get_quarantined()
-                    "payload_hex": bytes(payload[:256]).hex(),
+                    # bounded hex (Config(ops_dump_bytes)) so a fat
+                    # poison unit cannot blow up a scrape; the full
+                    # payload stays retrievable in-band via
+                    # ctx.get_quarantined()
+                    "payload_hex": bytes(payload[:cut]).hex(),
                     # a fused member whose prefix lives on another
                     # server: payload is the suffix alone and the
                     # common handle says where the rest is
@@ -204,6 +243,35 @@ class OpsServer:
         doc = s.flight.snapshot_doc(reason="ops")
         path = s.flight.dump_json(reason="ops")
         return {"artifact": path, "record": doc}
+
+    # -- /jobs control plane -------------------------------------------------
+
+    def _jobs(self) -> dict:
+        s = self.server
+        return {
+            "rank": s.rank,
+            "jobs": [j.summary() for j in s.jobs.values()],
+        }
+
+    def _job_one(self, jid_str: str):
+        job = self.server.jobs.get(int(jid_str))
+        return None if job is None else job.summary()
+
+    def _jobs_post(self, parts: list, raw: bytes) -> dict:
+        """POST /jobs (submit) and POST /jobs/<id>/{drain,kill}: build a
+        control request and hand it to the reactor thread."""
+        s = self.server
+        if not parts:  # POST /jobs — submit
+            body = json.loads(raw.decode() or "{}")
+            return s.ctl_request({
+                "op": "submit",
+                "name": str(body.get("name", "")),
+                "quota_bytes": int(body.get("quota_bytes", 0) or 0),
+            })
+        jid, action = int(parts[0]), (parts[1] if len(parts) > 1 else "")
+        if action not in ("drain", "kill"):
+            raise ValueError(f"unknown job action {action!r}")
+        return s.ctl_request({"op": action, "job_id": jid})
 
 
 def maybe_start(server, cfg) -> Optional[OpsServer]:

@@ -28,8 +28,12 @@ buf.
 Restores validate the header's world shape **loudly**: targeted units and
 batch-common references name ranks, so loading a shard into a different
 shape would silently misroute them. ``ACK1`` shards (pre-header, written
-by earlier builds and by older native daemons) still load — they carry no
-shape to check, so only the shard-set check in the server applies.
+by earlier builds and by older native daemons) carry no shape to check —
+loading one silently skips that validation, so since the WAL began
+compacting into ACK2-only snapshots the legacy read path is **gated**:
+an ACK1 shard raises unless the caller opts in via
+``Config(allow_legacy_shards=True)`` (the native daemon, serverd.cpp,
+writes and validates ACK2 itself and is unaffected).
 """
 
 from __future__ import annotations
@@ -102,14 +106,18 @@ def existing_shard_ranks(prefix: str) -> list[int]:
     return sorted(out)
 
 
-def load_shard(prefix: str, server_rank: int, world=None):
+def load_shard(prefix: str, server_rank: int, world=None,
+               allow_legacy: bool = False):
     """Read one server's shard; returns (units, common_entries) where units
     are dicts of constructor fields (seqnos are assigned by the server) and
     common_entries are (seqno, refcnt, ngets, buf) tuples. Missing shard =
     loud (a server with no queued work writes one anyway). With ``world``
     given, an ACK2 header naming a different world shape raises
     :class:`ShardShapeError` instead of silently misrouting targeted
-    units; ACK1 shards carry no shape and load as before."""
+    units. ACK1 shards carry no shape header, so they can never pass
+    that check — reading one is refused unless ``allow_legacy``
+    (Config(allow_legacy_shards)) explicitly opts into the unvalidated
+    path."""
     path = shard_path(prefix, server_rank)
     if not os.path.exists(path):
         raise FileNotFoundError(
@@ -137,7 +145,15 @@ def load_shard(prefix: str, server_rank: int, world=None):
                 f"nranks={world.nranks}/nservers={world.nservers}; restore "
                 f"with the same world shape"
             )
-    elif magic != _MAGIC_V1:  # ACK1: no shape header to validate
+    elif magic == _MAGIC_V1:
+        if not allow_legacy:
+            raise ShardShapeError(
+                f"{path}: legacy ACK1 shard (no world-shape header to "
+                f"validate); re-checkpoint with a current build, or opt "
+                f"into the unvalidated read with "
+                f"Config(allow_legacy_shards=True)"
+            )
+    else:
         raise ValueError(f"{path}: bad shard magic")
     (n,) = _U32.unpack_from(data, off)
     off += 4

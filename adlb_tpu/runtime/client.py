@@ -73,6 +73,11 @@ class Client:
         self.home = world.home_server(self.rank)
         self._rr = self.rank % world.nservers  # round-robin cursor
         self._batch: Optional[_BatchState] = None
+        # job namespace this rank is attached to (service mode): 0 = the
+        # default/legacy namespace; attach() binds another and every
+        # subsequent put/reserve rides in it (frames omit the field when
+        # 0, so single-job traffic stays byte-identical)
+        self.job = 0
         self._rqseqno = 0
         self._abort_event = abort_event
         self.aborted = False
@@ -480,6 +485,8 @@ class Client:
                 common_seqno=common.common_seqno if common else -1,
                 put_id=put_id,
             )
+            if self.job:
+                pm.data["job_id"] = self.job
             self._send_retry(server, pm)
             resp = self._wait_put(put_id, dest=server, m_req=pm)
             rc = resp.rc
@@ -603,6 +610,8 @@ class Client:
             self._rqseqno += 1
             pm = msg(Tag.FA_RESERVE, self.rank, rqseqno=self._rqseqno,
                      **fields)
+            if self.job:
+                pm.data["job_id"] = self.job
             self._send_retry(self.home, pm)
             resp = self._wait(Tag.TA_RESERVE_RESP, dest=self.home, m_req=pm)
             if resp.rc != ADLB_RETRY:
@@ -1156,29 +1165,29 @@ class Client:
         req = dict(
             payload=bytes(payload), work_type=work_type, prio=work_prio,
             target_rank=target_rank, answer_rank=answer_rank,
-            attempts=0, server=server,
+            attempts=0, server=server, job=self.job,
         )
         self._pending_puts[put_id] = req
         self._send_iput(put_id, req)
         return ADLB_SUCCESS
 
     def _send_iput(self, put_id: int, req: dict) -> None:
-        self._send_retry(
-            req["server"],
-            msg(
-                Tag.FA_PUT,
-                self.rank,
-                payload=req["payload"],
-                work_type=req["work_type"],
-                prio=req["prio"],
-                target_rank=req["target_rank"],
-                answer_rank=req["answer_rank"],
-                common_len=0,
-                common_server=-1,
-                common_seqno=-1,
-                put_id=put_id,
-            ),
+        pm = msg(
+            Tag.FA_PUT,
+            self.rank,
+            payload=req["payload"],
+            work_type=req["work_type"],
+            prio=req["prio"],
+            target_rank=req["target_rank"],
+            answer_rank=req["answer_rank"],
+            common_len=0,
+            common_server=-1,
+            common_seqno=-1,
+            put_id=put_id,
         )
+        if req.get("job"):
+            pm.data["job_id"] = req["job"]
+        self._send_retry(req["server"], pm)
 
     def _settle_put(self, m: Msg) -> None:
         put_id = m.put_id
@@ -1261,10 +1270,72 @@ class Client:
 
     def set_problem_done(self) -> int:
         """Explicit termination (reference ADLB_Set_problem_done,
-        ``src/adlb.c:3054-3062``)."""
+        ``src/adlb.c:3054-3062``). Attached to a non-default job, this
+        terminates the JOB (drain), not the world — the fleet keeps
+        serving every other namespace."""
+        if self.job:
+            rc, _state = self.drain_job(self.job)
+            return rc
         with self._span("adlb:set_problem_done"):
             self._send_retry(self.home, msg(Tag.FA_NO_MORE_WORK, self.rank))
         return ADLB_SUCCESS
+
+    # -- job control plane (service mode) ------------------------------------
+
+    def _job_ctl(self, op: str, job_id: int = 0, name: str = "",
+                 quota_bytes: int = 0, dest=None) -> Msg:
+        """One FA_JOB_CTL round trip: attach goes to the HOME server
+        (which owns this rank's exhaustion vote); submit/drain/kill/
+        status go to the MASTER (which owns the job table and fan-out)."""
+        dest = self.world.master_server_rank if dest is None else dest
+        fields = dict(op=op, job_id=job_id)
+        if name:
+            fields["job_name"] = name
+        if quota_bytes:
+            fields["quota"] = quota_bytes
+        pm = msg(Tag.FA_JOB_CTL, self.rank, **fields)
+        self._send_retry(dest, pm)
+        return self._wait(Tag.TA_JOB_CTL_RESP, dest=dest, m_req=pm)
+
+    def attach(self, job_id: int) -> int:
+        """Bind this rank to a job namespace on the running fleet: every
+        subsequent put/reserve/stream rides in it, and this rank's
+        parked-ness counts toward THAT job's exhaustion. attach(0)
+        returns to the default namespace."""
+        with self._span("adlb:attach", job=job_id):
+            resp = self._job_ctl("attach", job_id, dest=self.home)
+        if resp.rc == ADLB_SUCCESS:
+            self.job = job_id
+        return resp.rc
+
+    def submit_job(self, name: str = "",
+                   quota_bytes: int = 0) -> tuple[int, int]:
+        """Create a namespace on the fleet (master allocates the id and
+        fans it out). Returns (rc, job_id). ``quota_bytes`` bounds the
+        job's queued bytes PER SERVER; 0 = unlimited."""
+        with self._span("adlb:submit_job"):
+            resp = self._job_ctl("submit", name=name,
+                                 quota_bytes=quota_bytes)
+        return resp.rc, resp.data.get("job_id", -1)
+
+    def drain_job(self, job_id: int) -> tuple[int, int]:
+        """No new puts for the job; queued work completes, then the
+        per-job exhaustion ring marks it done. Returns (rc, job_id)."""
+        with self._span("adlb:drain_job", job=job_id):
+            resp = self._job_ctl("drain", job_id)
+        return resp.rc, resp.data.get("job_id", job_id)
+
+    def kill_job(self, job_id: int) -> tuple[int, int]:
+        """Drop the job's queued work everywhere and flush its parked
+        requesters with ADLB_NO_MORE_WORK. Returns (rc, job_id)."""
+        with self._span("adlb:kill_job", job=job_id):
+            resp = self._job_ctl("kill", job_id)
+        return resp.rc, resp.data.get("job_id", job_id)
+
+    def job_status(self, job_id: int) -> tuple[int, Optional[dict]]:
+        """The master's view of a job (state, quota, counters)."""
+        resp = self._job_ctl("status", job_id)
+        return resp.rc, resp.data.get("status")
 
     def checkpoint(self, path_prefix: str) -> tuple[int, int]:
         """Snapshot the whole pool to ``<path_prefix>.<server>.ckpt`` shards
@@ -1457,19 +1528,19 @@ class WorkStream:
         c = self._c
         c._rqseqno += 1
         self._outstanding.add(c._rqseqno)
-        c._send_retry(
-            c.home,
-            msg(
-                Tag.FA_RESERVE,
-                c.rank,
-                rqseqno=c._rqseqno,
-                req_types=None if self._types is None
-                else sorted(self._types),
-                hang=True,
-                fetch=True,
-                prefetch=True,
-            ),
+        pm = msg(
+            Tag.FA_RESERVE,
+            c.rank,
+            rqseqno=c._rqseqno,
+            req_types=None if self._types is None
+            else sorted(self._types),
+            hang=True,
+            fetch=True,
+            prefetch=True,
         )
+        if c.job:
+            pm.data["job_id"] = c.job
+        c._send_retry(c.home, pm)
 
     def _pump(self) -> None:
         if self.rc is not None or self._closed:

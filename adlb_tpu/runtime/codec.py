@@ -121,6 +121,12 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.FA_HEARTBEAT: 1054,
     Tag.FA_GET_QUARANTINED: 1055,
     Tag.TA_QUARANTINED_RESP: 1056,
+    # job control plane (service mode; Python servers only — the
+    # /jobs surface and per-job termination live in the Python reactor.
+    # Ids reserved so a native plane can join the protocol; native
+    # daemons reject tags outside their known ranges today.)
+    Tag.FA_JOB_CTL: 1057,
+    Tag.TA_JOB_CTL_RESP: 1058,
     # app<->app point-to-point (the reference's app_comm traffic; native
     # clients receive it via ADLB_App_recv — bytes payloads only, enforced
     # by encodable())
@@ -166,6 +172,8 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.SS_REPL: 1136,
     Tag.SS_SERVER_DEAD: 1137,
     Tag.TA_HOME_TAKEOVER: 1138,
+    # job-namespace lifecycle fan-out (service mode; python-only today)
+    Tag.SS_JOB_CTL: 1139,
     # transport-internal synthetic signal (never actually on the wire; the
     # id exists only so the codec table stays total)
     Tag.PEER_EOF: 1999,
@@ -315,6 +323,12 @@ FIELDS: dict[str, tuple[int, int]] = {
     # whose prefix was not stored on (or did not survive to) the
     # answering server
     "suffix_onlys": (96, _KIND_LIST),
+    # job namespace (service mode): which tenant a put/reserve/ctl frame
+    # belongs to. Omitted = the default namespace 0, so single-job
+    # traffic is byte-identical to the pre-service protocol; native
+    # daemons parse-and-ignore the field (job matching is a Python-
+    # server feature today).
+    "job_id": (97, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

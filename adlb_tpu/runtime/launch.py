@@ -167,6 +167,18 @@ def main(argv=None) -> int:
                          "lower it together with --mem-hard-frac to leave "
                          "pushes headroom before backpressure bites "
                          "(validation requires hard >= soft when armed)")
+    ap.add_argument("--wal-dir", default=None,
+                    help="durable service mode: per-server write-ahead "
+                         "log directory — pool mutations are teed to "
+                         "<dir>/server.<rank>.log with group-commit "
+                         "fsync, and a restarted launcher on the same "
+                         "directory replays the pool (python servers "
+                         "only; see USERGUIDE §10 for the restart "
+                         "runbook)")
+    ap.add_argument("--wal-fsync-ms", type=float, default=5.0,
+                    help="WAL group-commit window: put acks are held "
+                         "for the fsync that makes them durable; 0 = "
+                         "fsync every flush (strictest)")
     ap.add_argument("--fault-spec", default=None,
                     help="JSON fault-injection spec "
                          "(adlb_tpu/runtime/faults.py), e.g. "
@@ -196,6 +208,8 @@ def main(argv=None) -> int:
                  max_unit_retries=args.max_unit_retries,
                  mem_hard_frac=args.mem_hard_frac,
                  mem_soft_frac=args.mem_soft_frac,
+                 wal_dir=args.wal_dir,
+                 wal_fsync_ms=args.wal_fsync_ms,
                  fault_spec=fault_spec)
     my_ranks = _parse_ranks(args.ranks)
     host = args.host

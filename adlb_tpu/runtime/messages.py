@@ -55,6 +55,13 @@ class Tag(enum.Enum):
     # (payload + metadata + attempt counts); ctx.get_quarantined()
     # aggregates the per-server responses
     FA_GET_QUARANTINED = enum.auto()
+    # job control plane (service mode; no reference analogue — upstream
+    # is one world = one job): submit/status/drain/kill toward the
+    # MASTER (which allocates ids and fans out SS_JOB_CTL), attach
+    # toward the rank's HOME server (binding the rank to a namespace
+    # for per-job exhaustion voting). Same surface the ops endpoint's
+    # /jobs routes expose over HTTP.
+    FA_JOB_CTL = enum.auto()
 
     # server -> client
     TA_PUT_RESP = enum.auto()
@@ -66,6 +73,7 @@ class Tag(enum.Enum):
     TA_INFO_GET_RESP = enum.auto()
     TA_STREAM_CANCEL_RESP = enum.auto()
     TA_QUARANTINED_RESP = enum.auto()
+    TA_JOB_CTL_RESP = enum.auto()
     TA_ABORT = enum.auto()
 
     # server <-> server
@@ -103,6 +111,11 @@ class Tag(enum.Enum):
     # was dropped (targeted at a dead rank): the common server accounts a
     # forfeited get so the prefix still GCs when live members fetch
     SS_COMMON_FORFEIT = enum.auto()
+    # job-namespace lifecycle fan-out (service mode): the master
+    # broadcasts submit/drain/done/kill so every server's job table
+    # converges; "done" additionally flushes the job's parked
+    # requesters with ADLB_DONE_BY_EXHAUSTION (per-job termination)
+    SS_JOB_CTL = enum.auto()
 
     # server failover (Config(on_server_failure="failover"); no reference
     # analogue — upstream's servers ARE the pool and a server death kills

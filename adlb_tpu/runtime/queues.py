@@ -57,6 +57,11 @@ class WorkUnit:
     # exceeding Config(max_unit_retries) quarantines the unit instead of
     # re-enqueueing it (bounded blast radius for poison units).
     attempts: int = 0
+    # job namespace (service mode): 0 = the default/legacy namespace.
+    # A unit only ever matches requesters of its own job; non-default
+    # jobs live in their own wq partition (PartitionedWorkQueue) with
+    # per-job termination and per-tenant admission quotas.
+    job: int = 0
 
     @property
     def work_len(self) -> int:
@@ -275,6 +280,182 @@ class WorkQueue:
         return self.count, self.untargeted_avail, self.total_bytes
 
 
+class PartitionedWorkQueue:
+    """Per-job wq partitions behind the single-queue surface.
+
+    Job 0 (the default/legacy namespace) keeps whatever implementation
+    the config picked — including the C++ core — so single-job worlds
+    run exactly the code they always did. Non-default jobs each get
+    their own pure-Python :class:`WorkQueue` partition, created lazily
+    on first unit and dropped when the job is killed. Seqnos stay a
+    single server-wide sequence, so unit-addressed operations (get /
+    pin / unpin / remove) route through a seqno->job index and every
+    existing call site works unchanged; matching calls gain an optional
+    ``job`` argument so a requester only ever sees its own namespace.
+    """
+
+    def __init__(self, factory) -> None:
+        self._factory = factory
+        self._parts: dict[int, object] = {0: factory()}
+        self._job_of: dict[int, int] = {}  # seqno -> job, job != 0 only
+        self._max_count = 0
+
+    # -- partition plumbing --------------------------------------------------
+
+    def part(self, job: int = 0):
+        """The job's partition, or None when it holds nothing (job 0
+        always exists)."""
+        return self._parts.get(job)
+
+    def _part_of(self, seqno: int):
+        return self._parts[self._job_of.get(seqno, 0)]
+
+    def job_ids(self) -> list[int]:
+        """Non-default jobs with a (possibly empty) partition."""
+        return [j for j in self._parts if j != 0]
+
+    def has_job_units(self) -> bool:
+        return any(p.count for j, p in self._parts.items() if j != 0)
+
+    def drop_job(self, job: int) -> list[WorkUnit]:
+        """Remove a killed job's whole partition; returns its units so
+        the caller can settle memory accounting."""
+        if job == 0:
+            return []  # job 0 is never dropped
+        part = self._parts.pop(job, None)
+        if part is None:
+            return []
+        units = list(part.units())
+        for u in units:
+            self._job_of.pop(u.seqno, None)
+        return units
+
+    # -- insertion / removal / pin (seqno-routed) ----------------------------
+
+    def add(self, unit: WorkUnit) -> None:
+        job = getattr(unit, "job", 0)
+        part = self._parts.get(job)
+        if part is None:
+            # non-default partitions are always pure-Python: the C++
+            # core has no job column, and job partitions are small
+            part = self._parts[job] = WorkQueue()
+        if job != 0:
+            self._job_of[unit.seqno] = job
+        part.add(unit)
+        self._max_count = max(self._max_count, self.count)
+
+    def get(self, seqno: int) -> Optional[WorkUnit]:
+        return self._part_of(seqno).get(seqno)
+
+    def remove(self, seqno: int) -> WorkUnit:
+        part = self._part_of(seqno)
+        self._job_of.pop(seqno, None)
+        return part.remove(seqno)
+
+    def pin(self, seqno: int, rank: int) -> None:
+        self._part_of(seqno).pin(seqno, rank)
+
+    def unpin(self, seqno: int) -> None:
+        self._part_of(seqno).unpin(seqno)
+
+    # -- matching ------------------------------------------------------------
+
+    def find_targeted(self, rank, req_types, job: int = 0):
+        part = self._parts.get(job)
+        return None if part is None else part.find_targeted(rank, req_types)
+
+    def find_untargeted(self, req_types, job: int = 0):
+        part = self._parts.get(job)
+        return None if part is None else part.find_untargeted(req_types)
+
+    def find_match(self, rank, req_types, job: int = 0):
+        part = self._parts.get(job)
+        return None if part is None else part.find_match(rank, req_types)
+
+    def find_unpinned(self) -> Optional[WorkUnit]:
+        # memory-pressure pushes move job-0 work only: job partitions
+        # are quota-bounded at admission instead
+        return self._parts[0].find_unpinned()
+
+    # -- aggregates ----------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return sum(p.count for p in self._parts.values())
+
+    @property
+    def max_count(self) -> int:
+        return max(self._max_count, self._parts[0].max_count)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(p.total_bytes for p in self._parts.values())
+
+    @property
+    def untargeted_avail(self) -> int:
+        return sum(p.untargeted_avail for p in self._parts.values())
+
+    def num_unpinned(self) -> int:
+        return sum(p.num_unpinned() for p in self._parts.values())
+
+    def num_unpinned_untargeted(self) -> int:
+        # qmstat's qlen cell: job-0 inventory only (job work is never
+        # stolen type-blind; per-job prios ride the jq gossip table)
+        return self._parts[0].num_unpinned_untargeted()
+
+    def hi_prio_of_type(self, work_type: int, job: int = 0) -> int:
+        part = self._parts.get(job)
+        return ADLB_LOWEST_PRIO if part is None else part.hi_prio_of_type(
+            work_type
+        )
+
+    def job_hi_prio(self) -> dict:
+        """{(job, type): best prio} over non-default partitions — the
+        per-job qmstat gossip cells (only nonempty types appear).
+        Reads each partition's per-type untargeted index (O(jobs x
+        live types) per gossip tick), not a unit scan — non-default
+        partitions are always the pure-Python WorkQueue, whose lazy
+        heaps hi_prio_of_type already de-stales."""
+        out = {}
+        for j, p in self._parts.items():
+            if j == 0 or not p.count:
+                continue
+            for t in list(p._untargeted.keys()):
+                prio = p.hi_prio_of_type(t)
+                if prio > ADLB_LOWEST_PRIO:
+                    out[(j, t)] = prio
+        return out
+
+    def count_of_type(self, work_type: int) -> tuple[int, int]:
+        n = 0
+        nbytes = 0
+        for p in self._parts.values():
+            pn, pb = p.count_of_type(work_type)
+            n += pn
+            nbytes += pb
+        return n, nbytes
+
+    def units(self) -> Iterable[WorkUnit]:
+        for p in self._parts.values():
+            yield from p.units()
+
+    def depth_sample(self) -> tuple[int, int, int]:
+        c, a, b = 0, 0, 0
+        for p in self._parts.values():
+            pc, pa, pb = p.depth_sample()
+            c += pc
+            a += pa
+            b += pb
+        return c, a, b
+
+    def __getattr__(self, name):
+        if name == "snapshot_untargeted":
+            # balancer fast path: present only when the job-0 partition
+            # (the native core) provides it — callers getattr-probe
+            return getattr(self._parts[0], "snapshot_untargeted")
+        raise AttributeError(name)
+
+
 @dataclasses.dataclass
 class RqEntry:
     """A parked (blocking) Reserve waiting for work (reference
@@ -283,7 +464,9 @@ class RqEntry:
     payload rides the response. ``prefetch`` marks a pipelined
     ``get_work_stream`` reserve: the rank may still be computing while
     this entry is parked, so it only counts as idle for exhaustion
-    voting once the client sends FA_STREAM_IDLE."""
+    voting once the client sends FA_STREAM_IDLE. ``job`` is the
+    requester's attached namespace: an entry only ever matches units of
+    its own job."""
 
     world_rank: int
     rqseqno: int
@@ -291,6 +474,7 @@ class RqEntry:
     time_stamp: float = dataclasses.field(default_factory=time.monotonic)
     fetch: bool = False
     prefetch: bool = False
+    job: int = 0
 
     def wants(self, work_type: int) -> bool:
         return self.req_types is None or work_type in self.req_types
@@ -360,19 +544,21 @@ class ReserveQueue:
             self.remove_entry(e)
         return doomed
 
-    def find_for_type(self, work_type: int, target_rank: int = -1) -> Optional[RqEntry]:
+    def find_for_type(self, work_type: int, target_rank: int = -1,
+                      job: int = 0) -> Optional[RqEntry]:
         """First waiting requester a fresh unit could satisfy (reference
-        ``src/xq.c:352-444`` via ``rq_find_rank_queued_for_type``)."""
+        ``src/xq.c:352-444`` via ``rq_find_rank_queued_for_type``); the
+        unit's job namespace must match the entry's."""
         if target_rank >= 0:
             own = self._by_rank.get(target_rank)
             if not own:
                 return None
             for e in own:
-                if e.wants(work_type):
+                if e.job == job and e.wants(work_type):
                     return e
             return None
         for e in self._order.values():
-            if e.wants(work_type):
+            if e.job == job and e.wants(work_type):
                 return e
         return None
 

@@ -63,13 +63,18 @@ OP_SEEN_PUTS = 15     # a sender's accepted-put dedup window (re-bootstrap)
 OP_ATTEMPTS = 16      # unit's failure-attempt count changed
 OP_FENCE = 17         # (seqno, owner) fenced by lease expiry
 OP_QUARANTINE = 18    # unit moved to the dead-letter quarantine
+# job-namespace control (service mode): a job's state/quota changed —
+# rides the stream (and the per-server WAL that tees it) so job
+# membership and lifecycle survive failover and cold restart
+OP_JOB = 19
 
 _HDR = struct.Struct("<BI")       # op, body length
 _SEQ = struct.Struct("<q")        # one seqno
 _SEQ2 = struct.Struct("<qq")      # seqno + arg (pin rank, refcnt, ...)
 _SEQ3 = struct.Struct("<qqq")     # seqno + src + request id (common ops)
-# seqno, src, put_id, pinned(pin_rank|-1), attempts
-_PUTHDR = struct.Struct("<qqqii")
+# seqno, src, put_id, pinned(pin_rank|-1), attempts, job
+_PUTHDR = struct.Struct("<qqqiii")
+_JOBHDR = struct.Struct("<qqB")   # job id, quota bytes, state code
 
 # flush the buffered log at this many entries even mid-pass
 MAX_BUFFER = 256
@@ -124,7 +129,8 @@ class ReplicationLog:
         pid = -1 if put_id is None else int(put_id)
         body = _PUTHDR.pack(unit.seqno, src, pid,
                             unit.pin_rank if unit.pinned else -1,
-                            getattr(unit, "attempts", 0))
+                            getattr(unit, "attempts", 0),
+                            getattr(unit, "job", 0))
         self._append(OP_PUT, body + _pack_unit(unit))
 
     def log_pin(self, seqno: int, rank: int) -> None:
@@ -172,6 +178,16 @@ class ReplicationLog:
 
     def log_quarantine(self, seqno: int) -> None:
         self._append(OP_QUARANTINE, _SEQ.pack(seqno))
+
+    def log_job(self, job_id: int, state_code: int, quota_bytes: int,
+                name: str = "") -> None:
+        """Job lifecycle entry (service mode): state codes are
+        jobs.STATE_CODES (running/draining/done/killed)."""
+        self._append(
+            OP_JOB,
+            _JOBHDR.pack(job_id, quota_bytes, state_code)
+            + name.encode("utf-8", "replace"),
+        )
 
     def log_app_done(self, rank: int) -> None:
         self._append(OP_APP_DONE, _SEQ.pack(rank))
@@ -235,6 +251,9 @@ class ReplicaMirror:
         self.quarantined: dict[int, dict] = {}     # seqno -> unit fields
         self.finalized: set[int] = set()
         self.dead_ranks: set[int] = set()
+        # job-namespace lifecycle: job id -> (state_code, quota, name);
+        # replayed into the taker-over's / restarted server's job table
+        self.jobs_meta: dict[int, tuple[int, int, str]] = {}
         self.entries_applied = 0
         self.frames_applied = 0
         self.sealed = False
@@ -259,11 +278,21 @@ class ReplicaMirror:
             self.entries_applied += 1
         self.frames_applied += 1
 
+    def apply_entry(self, op: int, body: bytes) -> None:
+        """Apply ONE already-unframed entry — the WAL replay path (the
+        on-disk log wraps each entry in its own CRC record, so the
+        torn-tail scan unframes record by record)."""
+        self._apply_one(op, body)
+        self.entries_applied += 1
+
     def _apply_one(self, op: int, body: bytes) -> None:
         if op == OP_PUT:
-            seqno, src, pid, pin_rank, attempts = _PUTHDR.unpack_from(body, 0)
+            seqno, src, pid, pin_rank, attempts, job = _PUTHDR.unpack_from(
+                body, 0
+            )
             fields, _ = _unpack_unit(body, _PUTHDR.size)
             fields["attempts"] = attempts
+            fields["job"] = job
             self.units[seqno] = fields
             if pin_rank >= 0:
                 self.pins[seqno] = pin_rank
@@ -356,6 +385,10 @@ class ReplicaMirror:
             ids.extend(new)
             if len(ids) > 512:
                 del ids[:len(ids) - 512]
+        elif op == OP_JOB:
+            job_id, quota, state_code = _JOBHDR.unpack_from(body, 0)
+            name = body[_JOBHDR.size:].decode("utf-8", "replace")
+            self.jobs_meta[job_id] = (state_code, quota, name)
         # unknown ops are skipped by construction (op byte + length frame)
 
     def seal(self) -> None:

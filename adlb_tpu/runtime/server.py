@@ -42,6 +42,7 @@ from adlb_tpu.runtime.queues import (
     CommonStore,
     LeaseTable,
     MemoryAccountant,
+    PartitionedWorkQueue,
     ReserveQueue,
     RqEntry,
     TargetedDirectory,
@@ -208,6 +209,9 @@ class _PeerState:
         self.nbytes = 0
         self.qlen = 0
         self.hi_prio: dict[int, int] = {}
+        # per-job inventory cells {(job, type): prio} — present only
+        # while non-default namespaces hold work (service mode)
+        self.job_hi: dict[tuple[int, int], int] = {}
         self.rss_kb = 0
         self.stamp = 0.0
 
@@ -223,7 +227,10 @@ class Server:
         self.is_master = self.rank == world.master_server_rank
         self.local_apps = set(world.local_apps(self.rank))
 
-        self.wq = self._make_wq(cfg)
+        # per-job wq partitions behind the single-queue surface: job 0
+        # keeps the configured implementation (incl. the C++ core);
+        # non-default namespaces get lazy pure-Python partitions
+        self.wq = PartitionedWorkQueue(lambda: self._make_wq(cfg))
         self.rq = ReserveQueue()
         self.tq = TargetedDirectory()
         self.mem = MemoryAccountant(
@@ -286,6 +293,44 @@ class Server:
             from adlb_tpu.runtime import replica
 
             self.repl = replica.ReplicationLog(world.ring_next(self.rank))
+
+        # ---- durable service mode (Config(wal_dir), runtime/wal.py) ----
+        # the replica op stream teed to an append-only on-disk log with
+        # group-commit fsync; put acks are held for the commit that
+        # makes their entries durable (write-ahead across process death)
+        self.wal = None
+        if cfg.wal_dir:
+            from adlb_tpu.runtime import wal as walmod
+
+            self.wal = walmod.WriteAheadLog(
+                cfg.wal_dir, self.rank, world,
+                fsync_ms=cfg.wal_fsync_ms,
+                max_bytes=cfg.wal_max_bytes,
+                allow_legacy=cfg.allow_legacy_shards,
+            )
+        # the ONE mutation-log handle every pool-state change goes
+        # through: the network replication log, the WAL, or a tee of
+        # both (None when neither is armed)
+        self._refresh_wlog()
+
+        # ---- job namespaces (service mode, runtime/jobs.py) ----
+        from adlb_tpu.runtime.jobs import JobTable
+
+        self.jobs = JobTable()
+        # which namespace each LOCAL app rank is attached to (updated by
+        # FA_JOB_CTL attach and by any reserve naming a job): the
+        # per-job exhaustion vote reads it for this server's local apps
+        self._rank_job: dict[int, int] = {}
+        self._job_next_id = 1  # master-allocated job ids
+        # control-plane injection from the ops HTTP thread (POST /jobs):
+        # the reactor drains this on its periodic pass (see ctl_request)
+        self._ctl_inbox: deque = deque()
+        # units dropped by a job kill: their outstanding handles answer
+        # ADLB_NO_MORE_WORK instead of crashing the reactor (bounded,
+        # like fences)
+        self._killed_units: set[int] = set()
+        self._killed_order: deque = deque()
+        self.wal_recovered = 0  # units adopted from the WAL at startup
         # when each server's death was first observed here (MTTR t0)
         self._server_eof_at: dict[int, float] = {}
         # servers whose inbound connection EOF was HANDLED by this
@@ -476,6 +521,12 @@ class Server:
         self._m_failover_promoted = self.metrics.counter("failover_promoted")
         self._m_failover_lost = self.metrics.counter("failover_lost")
         self._g_repl_lag = self.metrics.gauge("repl_lag")
+        # durable-service surface (wal_dir / jobs): WAL depth (entries
+        # not yet durable) and fsync lag ride /metrics next to repl_lag
+        self._g_wal_depth = self.metrics.gauge("wal_depth")
+        self._g_wal_lag = self.metrics.gauge("wal_fsync_lag_ms")
+        self._m_wal_syncs = self.metrics.counter("wal_syncs")
+        self._m_jobs_done = self.metrics.counter("jobs_done")
         self._g_fo_mttr = self.metrics.gauge("failover_mttr_ms")
         self._g_wq = self.metrics.gauge("wq_depth")
         self._g_rq = self.metrics.gauge("rq_depth")
@@ -541,6 +592,12 @@ class Server:
 
         if cfg.restore_path:
             self._restore_from_checkpoint(cfg.restore_path)
+        if self.wal is not None:
+            # cold restart: shard-load + log replay through the replica
+            # mirror machinery, adopted into the live queues. Runs after
+            # the metrics/flight plumbing exists (it records) and never
+            # alongside restore_path (Config refuses the combination).
+            self._recover_from_wal()
 
         self._handlers = {
             Tag.PEER_EOF: self._on_peer_eof,
@@ -557,6 +614,8 @@ class Server:
             Tag.FA_GET_COMMON: self._on_get_common,
             Tag.FA_HEARTBEAT: self._on_heartbeat,
             Tag.FA_GET_QUARANTINED: self._on_get_quarantined,
+            Tag.FA_JOB_CTL: self._on_fa_job_ctl,
+            Tag.SS_JOB_CTL: self._on_ss_job_ctl,
             Tag.FA_NO_MORE_WORK: self._on_fa_no_more_work,
             Tag.FA_LOCAL_APP_DONE: self._on_local_app_done,
             Tag.FA_ABORT: self._on_fa_abort,
@@ -632,6 +691,18 @@ class Server:
         finally:
             if self.ops is not None:
                 self.ops.stop()
+            if self.wal is not None:
+                # final group commit: any held acks flush (the clients
+                # are gone at clean shutdown, so this is about the tail
+                # entries being durable for the next incarnation)
+                try:
+                    for app, resp in self.wal.tick(
+                        time.monotonic(), force=True
+                    ):
+                        self._send_app(app, resp)
+                except OSError:
+                    pass
+                self.wal.close()
             if self._balancer is not None:
                 self._balancer.stop()
                 # bounded join: a straggler round finishing after teardown
@@ -695,6 +766,11 @@ class Server:
                 if self.world.use_debug_server
                 else now + 1.0,
                 self._next_pstats if self.is_master else now + 1.0,
+                # the WAL's group-commit deadline: held put acks must
+                # release on time even when no traffic arrives
+                self.wal.next_deadline(now + 1.0)
+                if self.wal is not None
+                else now + 1.0,
             )
             m = self.ep.recv(timeout=max(deadline - time.monotonic(), 0.0))
             t0 = time.monotonic()
@@ -712,6 +788,7 @@ class Server:
                         break
                     self._handle(m2)
             self._flush_repl()
+            self._flush_wal()
             self.stats[InfoKey.LOOP_TOP_TIME] += time.monotonic() - t0
 
     def _handle(self, m: Msg) -> None:
@@ -763,6 +840,16 @@ class Server:
             handler(m)
 
     def _periodic(self, now: float, interval: float) -> None:
+        if self._ctl_inbox:
+            # ops-thread control requests (POST /jobs): serviced on the
+            # reactor thread, verdicts handed back via their events
+            self._drain_ctl_inbox()
+        if self.wal is not None:
+            self._g_wal_depth.set(self.wal.depth)
+            self._g_wal_lag.set(self.wal.fsync_lag_ms(now))
+            if self.wal.maybe_compact(self):
+                for app, resp in self.wal.take_compact_acks():
+                    self._send_app(app, resp)
         if self._pending_promotion:
             # SS_SERVER_DEAD arrived but the dead server's own EOF has
             # not: promote at the deadline anyway (the death may predate
@@ -849,6 +936,12 @@ class Server:
                 ):
                     self._next_idle_snap = now + 0.25
                     self._send_snapshot()
+                if self.wq.has_job_units():
+                    # non-default namespaces stay out of balancer
+                    # snapshots; their cross-server path is the RFR
+                    # pull, driven by the same per-job qmstat gossip
+                    # the steal mode uses
+                    self._broadcast_qmstat()
             else:
                 self._broadcast_qmstat()
             if self.mem.under_pressure:
@@ -858,6 +951,7 @@ class Server:
         if self.is_master and now >= self._next_exhaust_check:
             self._next_exhaust_check = now + self.cfg.exhaust_check_interval
             self._check_exhaustion(now)
+            self._check_job_exhaustion(now)
         if self.world.use_debug_server and now >= self._next_ds_log:
             self._next_ds_log = now + self.cfg.debug_log_interval
             self._send_ds_log()
@@ -875,16 +969,16 @@ class Server:
         owner's pins are findable in O(its leases) at reclaim time."""
         self.wq.pin(seqno, rank)
         self.leases.grant(seqno, rank)
-        if self.repl is not None:
-            self.repl.log_pin(seqno, rank)
+        if self.wlog is not None:
+            self.wlog.log_pin(seqno, rank)
 
     def _consume(self, unit) -> None:
         """Remove a fetched/inlined unit and settle its lease + memory."""
         self.wq.remove(unit.seqno)
         self.leases.release(unit.seqno)
         self.mem.free(len(unit.payload))
-        if self.repl is not None:
-            self.repl.log_consume(unit.seqno)
+        if self.wlog is not None:
+            self.wlog.log_consume(unit.seqno)
 
     def _send_app(self, app: int, m: Msg) -> bool:
         """Protocol response to an app rank. Under the reclaim policy a
@@ -942,8 +1036,8 @@ class Server:
             return
         self.mem.alloc(len(unit.payload))
         self.wq.add(unit)
-        if self.repl is not None:
-            self.repl.log_put(unit, -1, None)
+        if self.wlog is not None:
+            self.wlog.log_put(unit, -1, None)
         if unit.common_seqno >= 0 and prefix_fetched:
             # the dead requester fetched the prefix before this fetch
             # (Get_reserved orders common-first); the re-consumption
@@ -1158,6 +1252,7 @@ class Server:
         self._rq_wait_sum += wait
         self._rq_wait_n += 1
         self.activity += 1
+        self._job_activity(entry.job)
         self._reserve_resp(entry.world_rank, ADLB_SUCCESS, unit,
                            holder=holder, fetch=entry.fetch,
                            rqseqno=entry.rqseqno)
@@ -1170,7 +1265,8 @@ class Server:
         while progressed:
             progressed = False
             for entry in self.rq.entries():
-                unit = self.wq.find_match(entry.world_rank, entry.req_types)
+                unit = self.wq.find_match(entry.world_rank, entry.req_types,
+                                          job=entry.job)
                 if unit is not None:
                     self._pin(unit.seqno, entry.world_rank)
                     # _match_rq runs after cross-server deliveries
@@ -1201,22 +1297,25 @@ class Server:
                 f"({list(self.world.server_ranks)}); restore with the same "
                 f"world shape"
             )
-        units, centries = checkpoint.load_shard(prefix, self.rank, self.world)
+        units, centries = checkpoint.load_shard(
+            prefix, self.rank, self.world,
+            allow_legacy=self.cfg.allow_legacy_shards,
+        )
         for u in units:
             payload = u.pop("payload")
             self.mem.alloc(len(payload))
             unit = WorkUnit(seqno=self._next_seqno, payload=payload,
                             home_server=self.rank, **u)
             self.wq.add(unit)
-            if self.repl is not None:
-                self.repl.log_put(unit, -1, None)
+            if self.wlog is not None:
+                self.wlog.log_put(unit, -1, None)
             self._next_seqno += 1
         for seqno, refcnt, ngets, buf in centries:
             self.mem.alloc(len(buf))
             self.cq.restore(seqno, refcnt, ngets, buf)
-            if self.repl is not None:
-                self.repl.log_common_put(seqno, buf)
-                self.repl.log_common_state(seqno, refcnt, ngets, 0)
+            if self.wlog is not None:
+                self.wlog.log_common_put(seqno, buf)
+                self.wlog.log_common_state(seqno, refcnt, ngets, 0)
         aprintf(
             self.cfg.aprintf_flag, self.rank,
             f"restored {len(units)} units, {len(centries)} common entries "
@@ -1343,6 +1442,39 @@ class Server:
                            put_id=put_id)
             )
             return
+        jid = int(m.data.get("job_id", 0) or 0)
+        job = None
+        if jid:
+            job = self.jobs.ensure(jid)
+            if not job.accepts_puts:
+                # draining/done/killed namespace: the job's no-more-work
+                self.ep.send(
+                    m.src,
+                    msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_NO_MORE_WORK,
+                        put_id=put_id),
+                )
+                return
+            if job.quota_bytes > 0 and m.target_rank < 0:
+                # per-tenant admission quota: the job's queued bytes on
+                # THIS server against its per-server cap — the PR 5
+                # backpressure rc scoped to the tenant. Targeted puts
+                # exempt (answer/completion traffic; stalling it
+                # starves the consumers that drain the quota).
+                part = self.wq.part(jid)
+                used = part.total_bytes if part is not None else 0
+                if used + len(m.payload) > job.quota_bytes:
+                    job.backoffs += 1
+                    self._m_put_backoffs.inc()
+                    self.flight.record(
+                        f"job_quota_backoff job={jid} src={m.src} "
+                        f"used={used} quota={job.quota_bytes}"
+                    )
+                    self.ep.send(
+                        m.src,
+                        msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_BACKOFF,
+                            retry_after_ms=25, put_id=put_id),
+                    )
+                    return
         if m.target_rank >= 0 and m.target_rank in self._dead_ranks:
             # targeted at a dead rank: accept-and-drop (at-most-once — the
             # unit could never be fetched), keeping the batch-common
@@ -1364,11 +1496,12 @@ class Server:
         # it drives the steal-mode event qmstat below (peers whose view
         # dates from the last drain believe this type has nothing)
         type_was_empty = (
-            self.cfg.balancer == "steal"
+            (self.cfg.balancer == "steal" or jid != 0)
             and self.cfg.qmstat_mode == "broadcast"
             and self.cfg.qmstat_event_gap > 0
             and m.target_rank < 0
-            and self.wq.hi_prio_of_type(m.work_type) <= ADLB_LOWEST_PRIO
+            and self.wq.hi_prio_of_type(m.work_type, job=jid)
+            <= ADLB_LOWEST_PRIO
         )
         payload: bytes = m.payload
         if (
@@ -1433,19 +1566,24 @@ class Server:
             common_len=m.common_len,
             common_server_rank=m.common_server,
             common_seqno=m.common_seqno,
+            job=jid,
         )
         self._next_seqno += 1
         self.wq.add(unit)
-        if self.repl is not None:
-            self.repl.log_put(unit, m.src, put_id)
+        if self.wlog is not None:
+            self.wlog.log_put(unit, m.src, put_id)
         self.stats[InfoKey.MAX_WQ_COUNT] = max(
             self.stats[InfoKey.MAX_WQ_COUNT], self.wq.count
         )
         self.activity += 1
+        if job is not None:
+            job.puts += 1
+            job.activity += 1
         self._exhaust_held_since = None
         # immediate match against parked requesters (reference
         # rq_find_rank_queued_for_type on FA_PUT_HDR, src/adlb.c:988-1042)
-        entry = self.rq.find_for_type(unit.work_type, unit.target_rank)
+        entry = self.rq.find_for_type(unit.work_type, unit.target_rank,
+                                      job=jid)
         if entry is not None:
             self._pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
@@ -1456,13 +1594,20 @@ class Server:
         # re-sends). One extra one-way frame per accepted put, failover
         # mode only.
         self._flush_repl()
-        self._send_app(
-            m.src,
-            msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS, put_id=put_id),
-        )
+        resp = msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_SUCCESS,
+                   put_id=put_id)
+        if self.wal is not None:
+            # write-ahead DURABILITY: the ack is held until the group
+            # commit that fsyncs this put's entry (released immediately
+            # when wal_fsync_ms == 0)
+            self.wal.defer_ack(m.src, resp)
+            self._flush_wal()
+        else:
+            self._send_app(m.src, resp)
         if (
             entry is None
             and self.cfg.balancer == "tpu"
+            and unit.job == 0
             and unit.target_rank < 0
             and self._hungry_for(unit.work_type)
         ):
@@ -1502,14 +1647,16 @@ class Server:
             )
             return
         seqno = self.cq.put(m.payload)
-        if self.repl is not None:
-            self.repl.log_common_put(seqno, m.payload)
+        if self.wlog is not None:
+            self.wlog.log_common_put(seqno, m.payload)
         self._flush_repl()  # write-ahead, like the put ack
-        self.ep.send(
-            m.src,
-            msg(Tag.TA_PUT_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
-                common_seqno=seqno),
-        )
+        resp = msg(Tag.TA_PUT_COMMON_RESP, self.rank, rc=ADLB_SUCCESS,
+                   common_seqno=seqno)
+        if self.wal is not None:
+            self.wal.defer_ack(m.src, resp)  # durable before acked
+            self._flush_wal()
+        else:
+            self.ep.send(m.src, resp)
 
     def _on_batch_done(self, m: Msg) -> None:
         cseq = m.common_seqno
@@ -1522,8 +1669,8 @@ class Server:
             if cseq is None:
                 return  # prefix lost to replication lag; members' fetches
                 #         are counted at _on_get_common
-        if self.repl is not None:
-            self.repl.log_common_refcnt(cseq, m.refcnt)
+        if self.wlog is not None:
+            self.wlog.log_common_refcnt(cseq, m.refcnt)
         self.cq.set_refcnt(cseq, m.refcnt)
 
     def _on_did_put_at_remote(self, m: Msg) -> None:
@@ -1555,20 +1702,37 @@ class Server:
         # binary-codec clients encode "any type" by omitting the field
         raw_types = m.data.get("req_types")
         req_types = None if raw_types is None else frozenset(raw_types)
+        jid = int(m.data.get("job_id", 0) or 0)
+        if app in self.local_apps:
+            # a reserve names the namespace the rank consumes from —
+            # evidence for the per-job exhaustion vote
+            self._rank_job[app] = jid
         if self.no_more_work:
             self._reserve_resp(app, ADLB_NO_MORE_WORK, rqseqno=rq_id)
             return
         if self.done_by_exhaustion:
             self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION, rqseqno=rq_id)
             return
+        if jid:
+            from adlb_tpu.runtime import jobs as jobsmod
+
+            jstate = self.jobs.ensure(jid).state
+            if jstate == jobsmod.DONE:
+                self._reserve_resp(app, ADLB_DONE_BY_EXHAUSTION,
+                                   rqseqno=rq_id)
+                return
+            if jstate == jobsmod.KILLED:
+                self._reserve_resp(app, ADLB_NO_MORE_WORK, rqseqno=rq_id)
+                return
         fetch = bool(m.data.get("fetch", False))
         # clamped: the codec's list element counts are u16, and an
         # unclamped value would make the batch frame unencodable
         fetch_max = min(int(m.data.get("fetch_max", 1) or 1), 4096)
-        unit = self.wq.find_match(app, req_types)
+        unit = self.wq.find_match(app, req_types, job=jid)
         if unit is not None:
             self._pin(unit.seqno, app)
             self.activity += 1
+            self._job_activity(jid)
             self._n_reserve_immed += 1
             if fetch and fetch_max > 1 and unit.common_len == 0:
                 # batched fused fetch: pop up to fetch_max local prefix-free
@@ -1579,7 +1743,7 @@ class Server:
                 # locally is the mode that benefits
                 units = [unit]
                 while len(units) < fetch_max:
-                    extra = self.wq.find_match(app, req_types)
+                    extra = self.wq.find_match(app, req_types, job=jid)
                     if extra is None or extra.common_len != 0:
                         break
                     self._pin(extra.seqno, app)
@@ -1595,7 +1759,8 @@ class Server:
         self.stats[InfoKey.NUM_RESERVES_PUT_ON_RQ] += 1
         entry = RqEntry(world_rank=app, rqseqno=m.rqseqno,
                         req_types=req_types, fetch=fetch,
-                        prefetch=bool(m.data.get("prefetch", False)))
+                        prefetch=bool(m.data.get("prefetch", False)),
+                        job=jid)
         self.rq.add(entry)
         self._rfr_excluded.pop(app, None)
         self._try_rfr(entry)
@@ -1748,6 +1913,16 @@ class Server:
                     msg(Tag.TA_GET_RESERVED_RESP, self.rank, rc=ADLB_RETRY),
                 )
                 return
+            if m.seqno in self._killed_units:
+                # the unit's job was killed between reserve and fetch:
+                # the handle is void and the namespace is closed — the
+                # terminal code, not a retry loop
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_GET_RESERVED_RESP, self.rank,
+                        rc=ADLB_NO_MORE_WORK),
+                )
+                return
             if self._failover:
                 # a failover sweep may have unpinned/re-matched this unit
                 # (its handoff was routed via a dead home server): the
@@ -1831,8 +2006,8 @@ class Server:
             return
         if get_id is not None:
             self._last_common[m.src] = get_id
-        if self.repl is not None:
-            self.repl.log_common_op(
+        if self.wlog is not None:
+            self.wlog.log_common_op(
                 m.common_seqno, "get", m.src,
                 get_id if get_id is not None else -1,
             )
@@ -1913,12 +2088,30 @@ class Server:
             server, wtype = hit
             self._send_rfr(entry, server, targeted_lookup=True, lookup_type=wtype)
             return
-        if self.cfg.balancer == "tpu":
+        if self.cfg.balancer == "tpu" and entry.job == 0:
             return  # untargeted stealing is the planner's job
+            # (non-default jobs stay OUT of balancer snapshots, so their
+            # cross-server matching is the RFR pull below in both modes)
         # 2) best advertised priority among peers for the requested types
         best_server, best_prio = -1, ADLB_LOWEST_PRIO
         for s, st in self.peers.items():
             if s == self.rank or s in excluded:
+                continue
+            if entry.job:
+                # per-job inventory gossip: {(job, type): prio} cells
+                if entry.req_types is None:
+                    cand = [
+                        p for (j, _t), p in st.job_hi.items()
+                        if j == entry.job
+                    ]
+                else:
+                    cand = [
+                        st.job_hi.get((entry.job, t), ADLB_LOWEST_PRIO)
+                        for t in entry.req_types
+                    ]
+                for p in cand:
+                    if p > best_prio:
+                        best_server, best_prio = s, p
                 continue
             types = (
                 entry.req_types if entry.req_types is not None else st.hi_prio.keys()
@@ -1954,6 +2147,9 @@ class Server:
                 # payload in the RFR response (remote fused fetch) so the
                 # requester never pays a GET_RESERVED round trip
                 fetch=int(entry.fetch),
+                # the requester's namespace: the holder matches only
+                # units of this job (omitted/0 = default namespace)
+                job_id=entry.job or None,
             ),
         )
 
@@ -1973,6 +2169,7 @@ class Server:
         # a handoff is in flight: counts as activity so the exhaustion
         # double-pass cannot declare done around it
         self.activity += 1
+        self._job_activity(getattr(unit, "job", 0))
         self._exhaust_held_since = None
         fields = dict(
             found=True,
@@ -2002,12 +2199,13 @@ class Server:
             self._relay_inflight.pop(unit.seqno, None)
             self.wq.unpin(unit.seqno)
             self.leases.release(unit.seqno)
-            if self.repl is not None:
-                self.repl.log_unpin(unit.seqno)
+            if self.wlog is not None:
+                self.wlog.log_unpin(unit.seqno)
 
     def _on_rfr(self, m: Msg) -> None:
         req_types = None if m.req_types is None else frozenset(m.req_types)
-        unit = self.wq.find_match(m.for_rank, req_types)
+        jid = int(m.data.get("job_id", 0) or 0)
+        unit = self.wq.find_match(m.for_rank, req_types, job=jid)
         if unit is not None:
             self._rfr_found_resp(
                 m.src, m.for_rank, m.rqseqno, unit,
@@ -2025,6 +2223,7 @@ class Server:
                     req_types=m.req_types,
                     targeted_lookup=m.targeted_lookup,
                     lookup_type=m.lookup_type,
+                    job_id=jid or None,
                 ),
             )
 
@@ -2126,8 +2325,19 @@ class Server:
             # stale belief: patch it like the reference patches qmstat
             # (src/adlb.c:1979-2005), strike the peer out for this requester,
             # and retry an alternate candidate.
+            jid = int(m.data.get("job_id", 0) or 0)
             if m.targeted_lookup:
                 self.tq.remove(app, m.lookup_type, m.src)
+            elif jid:
+                st = self.peers.get(m.src)
+                if st is not None:
+                    keys = (
+                        [(jid, t) for t in m.req_types]
+                        if m.req_types is not None
+                        else [k for k in st.job_hi if k[0] == jid]
+                    )
+                    for k in keys:
+                        st.job_hi[k] = ADLB_LOWEST_PRIO
             else:
                 st = self.peers.get(m.src)
                 if st is not None:
@@ -2161,8 +2371,8 @@ class Server:
         self._relay_inflight.pop(m.seqno, None)
         self.wq.unpin(m.seqno)
         self.leases.release(m.seqno)
-        if self.repl is not None:
-            self.repl.log_unpin(m.seqno)
+        if self.wlog is not None:
+            self.wlog.log_unpin(m.seqno)
         self._match_rq()
 
     def _on_delivered(self, m: Msg) -> None:
@@ -2240,8 +2450,8 @@ class Server:
             return
         self.wq.remove(seqno)
         self.mem.free(len(unit.payload))
-        if self.repl is not None:
-            self.repl.log_remove(seqno)
+        if self.wlog is not None:
+            self.wlog.log_remove(seqno)
         self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
         if unit.target_rank >= 0:
             home = self.world.home_server(unit.target_rank)
@@ -2280,8 +2490,8 @@ class Server:
             # already admitted to the system is never dropped — keep it
             self.mem.alloc(len(unit.payload))
             self.wq.add(unit)
-            if self.repl is not None:
-                self.repl.log_put(unit, -1, None)
+            if self.wlog is not None:
+                self.wlog.log_put(unit, -1, None)
             self.stats[InfoKey.NPUSHED_FROM_HERE] -= 1
 
     def _on_push_work(self, m: Msg) -> None:
@@ -2302,8 +2512,8 @@ class Server:
         )
         self._next_seqno += 1
         self.wq.add(unit)
-        if self.repl is not None:
-            self.repl.log_put(unit, -1, None)
+        if self.wlog is not None:
+            self.wlog.log_put(unit, -1, None)
         self.stats[InfoKey.NPUSHED_TO_HERE] += 1
         self._match_rq()
 
@@ -2331,7 +2541,7 @@ class Server:
     def _qmstat_entry(self) -> dict:
         from adlb_tpu.utils.stats import rss_kb
 
-        return {
+        ent = {
             "nbytes": self.mem.curr,
             "qlen": self.wq.num_unpinned_untargeted(),
             "hi_prio": {t: self.wq.hi_prio_of_type(t) for t in self.world.types},
@@ -2340,6 +2550,12 @@ class Server:
             # same way, src/adlb.c:3347-3369)
             "rss_kb": rss_kb(),
         }
+        jq = self.wq.job_hi_prio()
+        if jq:
+            # per-job inventory rides along only while job partitions
+            # hold work: single-job worlds gossip byte-identically
+            ent["jq"] = jq
+        return ent
 
     def _broadcast_qmstat(self) -> None:
         ent = self._qmstat_entry()
@@ -2383,11 +2599,14 @@ class Server:
         st.nbytes = ent["nbytes"]
         st.qlen = ent["qlen"]
         st.hi_prio = dict(ent["hi_prio"])
+        st.job_hi = dict(ent.get("jq") or {})
         st.rss_kb = ent.get("rss_kb", 0)
         st.stamp = time.monotonic()
         # fresh evidence of work at this peer lifts any strike-out, else a
         # requester could permanently ignore a peer that refilled later
-        if any(p > ADLB_LOWEST_PRIO for p in st.hi_prio.values()):
+        if any(p > ADLB_LOWEST_PRIO for p in st.hi_prio.values()) or any(
+            p > ADLB_LOWEST_PRIO for p in st.job_hi.values()
+        ):
             for excluded in self._rfr_excluded.values():
                 excluded.discard(src)
 
@@ -2457,6 +2676,7 @@ class Server:
                         (-u.prio, u.seqno, u.work_type, len(u.payload))
                         for u in self.wq.units()
                         if not u.pinned and u.target_rank < 0
+                        and getattr(u, "job", 0) == 0
                     ),
                 )
                 tasks = [(s, t, -np_, ln) for np_, s, t, ln in tasks]
@@ -2471,7 +2691,7 @@ class Server:
                 bool(e.fetch),
             )
             for e in self.rq.entries()
-            if e.world_rank not in self._rfr_out
+            if e.world_rank not in self._rfr_out and e.job == 0
         ][: self.cfg.balancer_max_requesters]
         snap = {
             "tasks": tasks,
@@ -2744,8 +2964,8 @@ class Server:
                 continue  # stale plan entry
             self.wq.remove(seqno)
             self.mem.free(len(unit.payload))
-            if self.repl is not None:
-                self.repl.log_remove(seqno)
+            if self.wlog is not None:
+                self.wlog.log_remove(seqno)
             self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
             units.append(
                 {
@@ -2835,8 +3055,8 @@ class Server:
             )
             self._next_seqno += 1
             self.wq.add(unit)
-            if self.repl is not None:
-                self.repl.log_put(unit, -1, None)
+            if self.wlog is not None:
+                self.wlog.log_put(unit, -1, None)
             self.stats[InfoKey.NPUSHED_TO_HERE] += 1
         self._send_srv(
             m.src,
@@ -2960,6 +3180,11 @@ class Server:
         ring confirmation (reference ``src/adlb.c:754-785,1575-1650``)."""
         if self.no_more_work or self.done_by_exhaustion:
             return
+        if self.jobs.any_jobs():
+            # service mode: once any namespace exists, termination is
+            # per-job (_check_job_exhaustion) and the FLEET idles
+            # between jobs instead of declaring the world exhausted
+            return
         if self._exhaust_inflight:
             # lost-token recovery: if the ring token has not come home in
             # 10 intervals, assume it died and allow a fresh vote; the
@@ -2997,6 +3222,9 @@ class Server:
         )
 
     def _on_exhaust_chk(self, m: Msg) -> None:
+        if "job" in m.token:
+            self._on_job_exhaust_chk(m)
+            return
         token = m.token
         phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
         if m.data.get("complete") and token["origin"] == self.rank:
@@ -3061,8 +3289,8 @@ class Server:
 
     def _on_local_app_done(self, m: Msg) -> None:
         self._finalized.add(m.src)
-        if self.repl is not None:
-            self.repl.log_app_done(m.src)
+        if self.wlog is not None:
+            self.wlog.log_app_done(m.src)
         # a finalizing rank can never consume again: any leftover parked
         # entries (an abandoned stream's prefetch slots) must not attract
         # deliveries that would then be consumed into a closed endpoint
@@ -3270,8 +3498,8 @@ class Server:
         self.leases.release(seqno)
         self._add_fence(seqno, owner)
         self._m_leases_expired.inc()
-        if self.repl is not None:
-            self.repl.log_fence(seqno, owner)
+        if self.wlog is not None:
+            self.wlog.log_fence(seqno, owner)
         self.flight.record(
             f"lease_expired seqno={seqno} owner={owner} "
             f"lease_id={lease.lease_id} "
@@ -3285,8 +3513,8 @@ class Server:
         # the unit — the documented at-least-once window
         self._relay_inflight.pop(seqno, None)
         self.wq.unpin(seqno)
-        if self.repl is not None:
-            self.repl.log_unpin(seqno)
+        if self.wlog is not None:
+            self.wlog.log_unpin(seqno)
         quarantined = self._bump_attempts(unit, in_wq=True)
         if unit.common_seqno >= 0 and not quarantined:
             # the silent owner may have fetched the prefix already; the
@@ -3318,8 +3546,8 @@ class Server:
         ``in_wq``: whether the unit currently sits (unpinned) in the wq
         — False on the consumed-but-undeliverable path."""
         unit.attempts += 1
-        if self.repl is not None and in_wq:
-            self.repl.log_attempts(unit.seqno, unit.attempts)
+        if self.wlog is not None and in_wq:
+            self.wlog.log_attempts(unit.seqno, unit.attempts)
         maxr = self.cfg.max_unit_retries
         if maxr <= 0 or unit.attempts <= maxr:
             return False
@@ -3367,12 +3595,12 @@ class Server:
             self.wq.remove(unit.seqno)
             self.leases.release(unit.seqno)
             self.mem.free(len(unit.payload))
-        if self.repl is not None:
+        if self.wlog is not None:
             if not in_wq:
                 # the mirror tombstoned this unit at consume; re-install
                 # it so the quarantine entry has something to move
-                self.repl.log_put(unit, -1, None)
-            self.repl.log_quarantine(unit.seqno)
+                self.wlog.log_put(unit, -1, None)
+            self.wlog.log_quarantine(unit.seqno)
         self.quarantine.append(self._quarantine_record(unit))
         self.stats[InfoKey.QUARANTINED] += 1
         self._m_quarantined.inc()
@@ -3414,9 +3642,9 @@ class Server:
         self.quarantine.append(self._quarantine_record(unit))
         self.stats[InfoKey.QUARANTINED] += 1
         self._m_quarantined.inc()
-        if self.repl is not None:
-            self.repl.log_put(unit, -1, None)
-            self.repl.log_quarantine(unit.seqno)
+        if self.wlog is not None:
+            self.wlog.log_put(unit, -1, None)
+            self.wlog.log_quarantine(unit.seqno)
         self.flight.record(
             f"unit_quarantined seqno={unit.seqno} (adopted, was "
             f"{old_seqno})"
@@ -3478,6 +3706,439 @@ class Server:
             ),
         )
 
+    # ------------------------------------------------- service mode
+    # Durable multi-tenant operation (ROADMAP item 3): the per-server
+    # WAL (Config(wal_dir), runtime/wal.py) makes the pool survive
+    # process death, and job namespaces (runtime/jobs.py) multiplex
+    # many jobs over one persistent fleet — per-job wq partitions,
+    # per-job exhaustion rings, per-tenant put quotas, and a /jobs
+    # control plane on the ops endpoint + the FA_JOB_CTL round trip.
+
+    def _refresh_wlog(self) -> None:
+        """Rebuild the single mutation-log handle (network replication
+        log, WAL, tee of both, or None) — called at init and whenever
+        the replication stream re-targets."""
+        repl = getattr(self, "repl", None)
+        wal = getattr(self, "wal", None)
+        if repl is not None and wal is not None:
+            from adlb_tpu.runtime.wal import TeeLog
+
+            self.wlog = TeeLog([repl, wal])
+        else:
+            self.wlog = repl if repl is not None else wal
+
+    def _flush_wal(self, force: bool = False) -> None:
+        """Write buffered WAL entries; run the group commit when due and
+        release the put acks it covers."""
+        w = self.wal
+        if w is None:
+            return
+        synced_before = w.syncs
+        for app, resp in w.tick(time.monotonic(), force=force):
+            self._send_app(app, resp)
+        if w.syncs != synced_before:
+            self._m_wal_syncs.inc(w.syncs - synced_before)
+
+    def _wal_seed(self, log) -> None:
+        """Durable non-pool state re-seeded into a fresh WAL segment at
+        compaction (the ACK2 shard carries the pool itself): quarantine
+        records, put-dedup windows, and the job table."""
+        from adlb_tpu.runtime.jobs import STATE_CODES
+
+        for q in self.quarantine:
+            unit = WorkUnit(
+                seqno=q["seqno"], work_type=q["work_type"], prio=q["prio"],
+                target_rank=q["target_rank"], answer_rank=q["answer_rank"],
+                payload=q["payload"], attempts=q["attempts"],
+                common_len=q.get("common_len", 0),
+                common_server_rank=q.get("common_server_rank", -1),
+                common_seqno=q.get("common_seqno", -1),
+            )
+            log.log_put(unit, -1, None)
+            log.log_quarantine(q["seqno"])
+        for src, (_ids, order) in self._seen_puts.items():
+            log.log_seen_puts(src, order)
+        for job in self.jobs.values():
+            if job.job_id:
+                log.log_job(job.job_id, STATE_CODES[job.state],
+                            job.quota_bytes, job.name)
+
+    def _recover_from_wal(self) -> None:
+        """Cold restart: replay the on-disk log (snapshot shard + tail)
+        through a ReplicaMirror and adopt the result into the live
+        queues. Units come back unpinned — their owners died with the
+        previous fleet — so recovered work re-executes, the standard
+        crash-recovery contract; an ACKED put is always here (or in the
+        quarantine), never silently gone."""
+        mirror = self.wal.recover()
+        if mirror is None:
+            return
+        n_units = 0  # adopted: units, commons, quarantine, job table
+        for seqno in sorted(mirror.units):
+            f = dict(mirror.units[seqno])
+            payload = f.pop("payload")
+            unit = WorkUnit(seqno=seqno, payload=payload,
+                            home_server=self.rank, **f)
+            unit.pinned = False
+            unit.pin_rank = -1
+            self.mem.alloc(len(payload))
+            self.wq.add(unit)
+            # re-log toward the buddy only (self.repl): the WAL already
+            # holds these entries durably — re-teeing them would double
+            # the segment on every restart
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
+            self._next_seqno = max(self._next_seqno, seqno + 1)
+            n_units += 1
+        for seqno in sorted(mirror.commons):
+            buf, refcnt, ngets, _credits = mirror.commons[seqno]
+            self.mem.alloc(len(buf))
+            self.cq.restore(seqno, refcnt, ngets, buf)
+            if self.repl is not None:
+                self.repl.log_common_put(seqno, buf)
+                self.repl.log_common_state(seqno, refcnt, ngets, 0)
+        for seqno in sorted(mirror.quarantined):
+            f = mirror.quarantined[seqno]
+            unit = WorkUnit(
+                seqno=seqno, work_type=f["work_type"], prio=f["prio"],
+                target_rank=f["target_rank"], answer_rank=f["answer_rank"],
+                payload=f["payload"], attempts=f.get("attempts", 0),
+                common_len=f.get("common_len", 0),
+                common_server_rank=f.get("common_server_rank", -1),
+                common_seqno=f.get("common_seqno", -1),
+            )
+            self.quarantine.append(self._quarantine_record(unit))
+            self.stats[InfoKey.QUARANTINED] += 1
+            self._next_seqno = max(self._next_seqno, seqno + 1)
+            if self.repl is not None:
+                self.repl.log_put(unit, -1, None)
+                self.repl.log_quarantine(seqno)
+        # mirror.seen_puts is deliberately NOT adopted: the put-dedup
+        # window keys on per-client put ids, and a cold restart means
+        # NEW client processes whose ids restart from 1 — a restored
+        # window would silently swallow their first puts as "duplicates"
+        # of the dead world's. (The failover promote path DOES adopt it:
+        # there the clients survive and their id streams continue.)
+        for jid, (code, quota, name) in mirror.jobs_meta.items():
+            self.jobs.restore(jid, code, quota, name)
+        self.wal_recovered = n_units
+        if n_units or mirror.entries_applied:
+            self.flight.record(
+                f"wal_recovered units={n_units} "
+                f"commons={len(mirror.commons)} "
+                f"quarantined={len(mirror.quarantined)} "
+                f"jobs={len(mirror.jobs_meta)} "
+                f"torn_tail={self.wal.recovered_torn}"
+            )
+            aprintf(
+                self.cfg.aprintf_flag, self.rank,
+                f"WAL recovery: {n_units} units, {len(mirror.commons)} "
+                f"common entries, {len(mirror.quarantined)} quarantined, "
+                f"{len(mirror.jobs_meta)} jobs "
+                f"(torn tail: {self.wal.recovered_torn})",
+            )
+
+    def _void_killed_unit(self, seqno: int) -> None:
+        self._killed_units.add(seqno)
+        self._killed_order.append(seqno)
+        if len(self._killed_order) > 65536:
+            self._killed_units.discard(self._killed_order.popleft())
+
+    # -- job control plane ---------------------------------------------------
+
+    def ctl_request(self, req: dict, timeout: float = 5.0) -> dict:
+        """Thread-safe control-plane injection (the ops HTTP thread's
+        POST /jobs): enqueue for the reactor, wait for its verdict."""
+        req = dict(req)
+        req["done"] = threading.Event()
+        self._ctl_inbox.append(req)
+        if not req["done"].wait(timeout):
+            raise TimeoutError("reactor did not service the control "
+                               "request in time")
+        if "error" in req:
+            raise RuntimeError(req["error"])
+        return req["result"]
+
+    def _drain_ctl_inbox(self) -> None:
+        while self._ctl_inbox:
+            req = self._ctl_inbox.popleft()
+            try:
+                req["result"] = self._handle_ctl(req)
+            except Exception as e:  # noqa: BLE001 — surfaces over HTTP
+                req["error"] = repr(e)
+            req["done"].set()
+
+    def _handle_ctl(self, req: dict) -> dict:
+        op = req["op"]
+        if op == "submit":
+            jid = self._alloc_job_id()
+            self._job_ctl_fanout(
+                "submit", jid, name=str(req.get("name", "")),
+                quota=int(req.get("quota_bytes", 0) or 0),
+            )
+            return {"job_id": jid, "state": self.jobs.get(jid).state}
+        if op in ("drain", "kill"):
+            jid = int(req["job_id"])
+            if self.jobs.get(jid) is None:
+                raise KeyError(f"unknown job {jid}")
+            self._job_ctl_fanout(op, jid)
+            return {"job_id": jid, "state": self.jobs.get(jid).state}
+        raise ValueError(f"unknown control op {op!r}")
+
+    def _alloc_job_id(self) -> int:
+        """Master: next unused job id — floored above every id the table
+        has ever seen, so ids restored from the WAL (or adopted in a
+        takeover) are never reissued to a new tenant (a reused id would
+        inherit the old job's state: a DONE job is born closed, a
+        RUNNING one silently merges two tenants)."""
+        jid = max(self._job_next_id, self.jobs.max_id() + 1)
+        self._job_next_id = jid + 1
+        return jid
+
+    def _job_ctl_fanout(self, op: str, jid: int, name: str = "",
+                        quota: int = 0) -> None:
+        """Master: apply a job lifecycle change and broadcast it."""
+        for srv in self._live_servers():
+            if srv == self.rank:
+                continue
+            try:
+                self.ep.send(
+                    srv,
+                    msg(Tag.SS_JOB_CTL, self.rank, op=op, job_id=jid,
+                        job_name=name, quota=quota),
+                )
+            except OSError:
+                if not self._failover:
+                    raise
+                self._note_server_unreachable(srv)
+        self._apply_job_ctl(op, jid, name, quota)
+
+    def _on_ss_job_ctl(self, m: Msg) -> None:
+        self._apply_job_ctl(
+            m.data["op"], m.job_id, m.data.get("job_name", ""),
+            m.data.get("quota", 0),
+        )
+
+    def _apply_job_ctl(self, op: str, jid: int, name: str = "",
+                       quota: int = 0) -> None:
+        from adlb_tpu.runtime.jobs import STATE_CODES
+
+        job = self.jobs.apply(op, jid, name=name, quota_bytes=quota)
+        if self.wlog is not None:
+            self.wlog.log_job(jid, STATE_CODES[job.state],
+                              job.quota_bytes, job.name)
+        if op == "done":
+            self._m_jobs_done.inc()
+            self.flight.record(f"job_done job={jid}")
+            self._flush_rq_job(jid, ADLB_DONE_BY_EXHAUSTION)
+        elif op == "kill":
+            dropped = self.wq.drop_job(jid)
+            for u in dropped:
+                self.mem.free(len(u.payload))
+                self.leases.release(u.seqno)
+                self._relay_inflight.pop(u.seqno, None)
+                self._void_killed_unit(u.seqno)
+                if u.common_seqno >= 0:
+                    # a fused batch member's prefix share will never be
+                    # fetched: forfeit it so the common entry still GCs
+                    # (same discipline as every other drop path)
+                    self._forfeit_common(u.common_seqno,
+                                         u.common_server_rank)
+                if self.wlog is not None:
+                    self.wlog.log_remove(u.seqno)
+            self.flight.record(
+                f"job_killed job={jid} dropped={len(dropped)}"
+            )
+            self._flush_rq_job(jid, ADLB_NO_MORE_WORK)
+
+    def _on_fa_job_ctl(self, m: Msg) -> None:
+        op = m.data["op"]
+        jid = int(m.data.get("job_id", 0) or 0)
+        if op == "attach":
+            # the rank's HOME server records the namespace binding; the
+            # per-job exhaustion vote reads it for this server's locals
+            self._rank_job[m.src] = jid
+            if jid:
+                self.jobs.ensure(jid)
+            self._send_app(
+                m.src,
+                msg(Tag.TA_JOB_CTL_RESP, self.rank, rc=ADLB_SUCCESS,
+                    job_id=jid),
+            )
+            return
+        if op == "status":
+            job = self.jobs.get(jid)
+            self._send_app(
+                m.src,
+                msg(Tag.TA_JOB_CTL_RESP, self.rank,
+                    rc=ADLB_SUCCESS if job is not None else -1,
+                    job_id=jid,
+                    status=None if job is None else job.summary()),
+            )
+            return
+        if not self.is_master:
+            # submit/drain/kill are the master's to serialize (it
+            # allocates ids and owns the fan-out)
+            self._send_app(
+                m.src,
+                msg(Tag.TA_JOB_CTL_RESP, self.rank, rc=-1, job_id=jid),
+            )
+            return
+        if op == "submit":
+            jid = self._alloc_job_id()
+            name = m.data.get("job_name", "")
+            if isinstance(name, bytes):
+                name = name.decode("utf-8", "replace")
+            self._job_ctl_fanout(
+                "submit", jid, name=name,
+                quota=int(m.data.get("quota", 0) or 0),
+            )
+        elif op in ("drain", "kill"):
+            if self.jobs.get(jid) is None:
+                self._send_app(
+                    m.src,
+                    msg(Tag.TA_JOB_CTL_RESP, self.rank, rc=-1, job_id=jid),
+                )
+                return
+            self._job_ctl_fanout(op, jid)
+        else:
+            self._send_app(
+                m.src,
+                msg(Tag.TA_JOB_CTL_RESP, self.rank, rc=-1, job_id=jid),
+            )
+            return
+        self._send_app(
+            m.src,
+            msg(Tag.TA_JOB_CTL_RESP, self.rank, rc=ADLB_SUCCESS,
+                job_id=jid),
+        )
+
+    # -- per-job termination -------------------------------------------------
+
+    def _flush_rq_job(self, jid: int, rc: int) -> None:
+        """Flush ONE job's parked requesters (its termination verdict)
+        without touching any other namespace — one job draining never
+        blocks another."""
+        for entry in self.rq.entries():
+            if entry.job == jid:
+                self.rq.remove_entry(entry)
+                self._reserve_resp(entry.world_rank, rc,
+                                   rqseqno=entry.rqseqno)
+
+    def _exhaust_vote_job(self, jid: int) -> bool:
+        """This server's per-job exhaustion vote: the job's partition is
+        EMPTY here (consumed work only — a job completes when its queue
+        drains; unmatchable leftovers keep it running until /jobs kill)
+        and every local app attached to the job is parked or finished.
+        Ranks attached to other namespaces are invisible — their compute
+        never blocks this job's verdict."""
+        part = self.wq.part(jid)
+        if part is not None and part.count != 0:
+            return False
+        for r in self.local_apps:
+            if r in self._finalized or r in self._dead_ranks:
+                continue
+            if self._rank_job.get(r, 0) != jid:
+                continue
+            if not (
+                r in self.rq
+                and (self.rq.has_blocking(r) or r in self._stream_idle)
+            ):
+                return False
+        return True
+
+    def _check_job_exhaustion(self, now: float) -> None:
+        """Master: the WORLD exhaustion logic run per live job — same
+        held-vote debounce, same two-pass ring with activity stamps,
+        token stamped with the job id."""
+        if self.no_more_work or self.done_by_exhaustion:
+            return
+        for jid in self.jobs.active_ids():
+            job = self.jobs.get(jid)
+            if job.exhaust_inflight:
+                if now - job.exhaust_sent_at < (
+                    10 * self.cfg.exhaust_check_interval
+                ):
+                    continue
+                job.exhaust_inflight = False  # lost-token recovery
+            if not self._exhaust_vote_job(jid):
+                job.exhaust_held_since = None
+                continue
+            if job.exhaust_held_since is None:
+                job.exhaust_held_since = now
+                continue
+            if now - job.exhaust_held_since < (
+                self.cfg.exhaust_check_interval
+            ):
+                continue
+            job.exhaust_inflight = True
+            job.exhaust_sent_at = now
+            job.exhaust_token_id += 1
+            token = {
+                "job": jid,
+                "origin": self.rank,
+                "token_id": job.exhaust_token_id,
+                "ok": True,
+                "act": {self.rank: job.activity},
+            }
+            self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
+
+    def _on_job_exhaust_chk(self, m: Msg) -> None:
+        token = m.token
+        jid = token["job"]
+        phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
+        job = self.jobs.ensure(jid)
+        if m.data.get("complete") and token["origin"] == self.rank:
+            if token.get("token_id", 0) != job.exhaust_token_id:
+                return  # straggler from an abandoned token
+            from adlb_tpu.runtime import jobs as jobsmod
+
+            ok = (
+                token["ok"]
+                and self._exhaust_vote_job(jid)
+                and job.activity == token["act"].get(self.rank, -1)
+                # a submitted-but-never-started job must not complete:
+                # "done" needs evidence the job RAN (activity somewhere
+                # in the fleet) — or an explicit drain, which is the
+                # operator saying there is nothing more to wait for
+                and (
+                    sum(token["act"].values()) > 0
+                    or job.state == jobsmod.DRAINING
+                )
+            )
+            if not ok:
+                job.exhaust_held_since = None
+                job.exhaust_inflight = False
+                return
+            if phase1:
+                token2 = {
+                    "job": jid,
+                    "origin": self.rank,
+                    "token_id": job.exhaust_token_id,
+                    "ok": True,
+                    "act": token["act"],
+                }
+                self._forward_exhaust(Tag.SS_EXHAUST_CHK_2, token2)
+            else:
+                job.exhaust_inflight = False
+                self._job_ctl_fanout("done", jid)
+            return
+        # contribute and forward
+        if phase1:
+            token["ok"] = token["ok"] and self._exhaust_vote_job(jid)
+            token["act"][self.rank] = job.activity
+        else:
+            token["ok"] = (
+                token["ok"]
+                and self._exhaust_vote_job(jid)
+                and job.activity == token["act"].get(self.rank, -1)
+            )
+        self._forward_exhaust(m.tag, token)
+
+    def _job_activity(self, jid: int) -> None:
+        if jid:
+            self.jobs.ensure(jid).activity += 1
+
     # ------------------------------------------------- worker-death reclaim
     # No reference analogue (upstream: any rank failure kills the job,
     # src/adlb.c:2508-2526). Under Config(on_worker_failure="reclaim") an
@@ -3506,8 +4167,8 @@ class Server:
             return
         self._dead_ranks.add(rank)
         self._m_rank_dead.inc()
-        if self.repl is not None:
-            self.repl.log_rank_dead(rank)
+        if self.wlog is not None:
+            self.wlog.log_rank_dead(rank)
         self.flight.record(f"rank_dead rank={rank} declared_by={m.src}")
         # 1) the dead requester's park/steal state (every entry — a
         # streaming rank may hold several prefetch slots). Flag the rank
@@ -3558,8 +4219,8 @@ class Server:
                     )
                     continue
                 self.wq.unpin(lease.seqno)
-                if self.repl is not None:
-                    self.repl.log_unpin(lease.seqno)
+                if self.wlog is not None:
+                    self.wlog.log_unpin(lease.seqno)
                 # retry budget: a unit that serially kills its owners
                 # (poison) must not re-enqueue forever
                 quarantined = self._bump_attempts(unit, in_wq=True)
@@ -3594,8 +4255,8 @@ class Server:
             self.wq.remove(u.seqno)
             self.leases.release(u.seqno)
             self.mem.free(len(u.payload))
-            if self.repl is not None:
-                self.repl.log_remove(u.seqno)
+            if self.wlog is not None:
+                self.wlog.log_remove(u.seqno)
             self._m_targeted_dropped.inc()
             self._forfeit_common(u.common_seqno, u.common_server_rank)
             self.flight.record(
@@ -3654,8 +4315,8 @@ class Server:
 
     def _apply_common_op(self, common_seqno: int, op: str,
                          src: int = -1, op_id: int = -1) -> None:
-        if self.repl is not None:
-            self.repl.log_common_op(
+        if self.wlog is not None:
+            self.wlog.log_common_op(
                 common_seqno, "credit" if op == "credit" else "forfeit",
                 src, op_id,
             )
@@ -3801,8 +4462,8 @@ class Server:
 
     def _on_common_gc(self, e) -> None:
         self.mem.free(len(e.buf))
-        if self.repl is not None:
-            self.repl.log_common_op(e.seqno, "gc")
+        if self.wlog is not None:
+            self.wlog.log_common_op(e.seqno, "gc")
 
     def _flush_repl(self) -> None:
         r = self.repl
@@ -3828,6 +4489,7 @@ class Server:
 
         if new_buddy == self.rank:
             self.repl = None  # no live peer left to replicate to
+            self._refresh_wlog()
             return
         r = replica.ReplicationLog(new_buddy)
         for u in self.wq.units():
@@ -3876,6 +4538,7 @@ class Server:
             for fid in order:
                 r.log_common_op(-1, "forfeit", src, fid)
         self.repl = r
+        self._refresh_wlog()
         self.flight.record(
             f"replication re-bootstrapped to server {new_buddy} "
             f"({len(list(self.wq.units()))} units)"
@@ -4088,8 +4751,8 @@ class Server:
                     continue
                 self.leases.release(lease.seqno)
                 self.wq.unpin(lease.seqno)
-                if self.repl is not None:
-                    self.repl.log_unpin(lease.seqno)
+                if self.wlog is not None:
+                    self.wlog.log_unpin(lease.seqno)
                 if unit.common_seqno >= 0:
                     # the owner may have fetched the prefix already (the
                     # handle path orders common-first); the re-match
@@ -4140,8 +4803,8 @@ class Server:
         )
         self._next_seqno += 1
         self.wq.add(unit)
-        if self.repl is not None:
-            self.repl.log_put(unit, -1, None)
+        if self.wlog is not None:
+            self.wlog.log_put(unit, -1, None)
         self.stats[InfoKey.NPUSHED_TO_HERE] += 1
 
     # -- takeover (buddy side) ----------------------------------------------
@@ -4172,9 +4835,9 @@ class Server:
             self.mem.alloc(len(buf))
             new_cseq = self.cq.adopt(buf, refcnt, ngets, credits)
             self._adopted_commons[(dead, old_cseq)] = new_cseq
-            if self.repl is not None:
-                self.repl.log_common_put(new_cseq, buf)
-                self.repl.log_common_state(new_cseq, refcnt, ngets, credits)
+            if self.wlog is not None:
+                self.wlog.log_common_put(new_cseq, buf)
+                self.wlog.log_common_state(new_cseq, refcnt, ngets, credits)
         # 2) units: pinned-to-a-live-client survive PINNED under their
         # lease behind a seqno translation (the client's in-flight fetch
         # lands here via the fo_from reroute); everything else re-enqueues
@@ -4224,6 +4887,7 @@ class Server:
                 pinned=pin_rank >= 0,
                 pin_rank=pin_rank if pin_rank >= 0 else -1,
                 attempts=f.get("attempts", 0),
+                job=f.get("job", 0),
             )
             self._next_seqno += 1
             self.mem.alloc(len(unit.payload))
@@ -4233,8 +4897,8 @@ class Server:
                 self._adopted_units[(dead, old_seqno)] = unit.seqno
                 pinned_kept += 1
             adopted += 1
-            if self.repl is not None:
-                self.repl.log_put(unit, -1, None)
+            if self.wlog is not None:
+                self.wlog.log_put(unit, -1, None)
         # 3) tombstones: a post-takeover fetch of a consumed unit is a
         # counted loss (the response died with the server), not an
         # invalid-handle abort
@@ -4250,8 +4914,8 @@ class Server:
         for (s, o, origin) in mirror.fences:
             key = (dead if origin < 0 else origin, s, o)
             self._adopted_fences.add(key)
-            if self.repl is not None:
-                self.repl.log_fence(s, o, origin=key[0])
+            if self.wlog is not None:
+                self.wlog.log_fence(s, o, origin=key[0])
         # ... and the predecessor's dead-letter quarantine: re-homed
         # under fresh seqnos and re-counted HERE (its own QUARANTINED
         # stat died with it — only the survivor's count reaches the
@@ -4280,6 +4944,12 @@ class Server:
         # their finalize/death accounting)
         newly = set(self.world.local_apps(dead))
         self.local_apps |= newly
+        # job lifecycle the predecessor knew (normally already here via
+        # the SS_JOB_CTL fan-out; the replay makes it exact even when a
+        # fan-out frame died with the server)
+        for jid, (code, quota, jname) in mirror.jobs_meta.items():
+            if self.jobs.get(jid) is None:
+                self.jobs.restore(jid, code, quota, jname)
         self._finalized |= mirror.finalized & newly
         for r in mirror.dead_ranks:
             self._dead_ranks.add(r)

@@ -253,6 +253,36 @@ class Config:
     # (no reference analogue — SURVEY §5: checkpoint/resume absent there);
     # requires the same world shape the checkpoint was taken with
     restore_path: Optional[str] = None
+    # ---- durable service mode (adlb_tpu/runtime/wal.py) ----
+    # per-server write-ahead log directory: every pool mutation (the
+    # replica op stream, OP_PUT..OP_JOB) is teed to an append-only
+    # crc-framed log at <wal_dir>/server.<rank>.log; put acks are held
+    # for the group commit that makes their entries durable, so an
+    # ACKED put always survives a cold restart (shard-load + replay at
+    # server init). None = off (reference semantics: a dead fleet loses
+    # the pool). Python servers only.
+    wal_dir: Optional[str] = None
+    # group-commit window in milliseconds: fsync at most once per
+    # window, releasing the put acks the commit covers. 0 = fsync every
+    # reactor flush (strict, per-batch durability at per-batch fsync
+    # cost). Durability/latency trade-off table in USERGUIDE §10.
+    wal_fsync_ms: float = 5.0
+    # compaction threshold: when the live segment outgrows this, the
+    # server snapshots its pool into the ACK2 checkpoint shard format
+    # and starts a fresh segment headed by the seqno manifest. 0 = never
+    # compact (the log grows for the fleet's lifetime).
+    wal_max_bytes: int = 64 << 20
+    # legacy ACK1 (pre-header) checkpoint shards: WAL compaction writes
+    # ACK2 only, and silently accepting a headerless shard means
+    # silently skipping the world-shape check that keeps targeted units
+    # routable — so ACK1 reads now fail LOUDLY unless this flag opts
+    # back in (old native daemons' shards; serverd.cpp still writes and
+    # validates ACK2 itself).
+    allow_legacy_shards: bool = False
+    # ops endpoint payload truncation: how many payload bytes /deadletter
+    # (and other ops views) hex-encode per record before cutting off.
+    # The full payload stays retrievable in-band via ctx.get_quarantined().
+    ops_dump_bytes: int = 256
     aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
     selfdiag_interval: float = 30.0  # server health dumps; 0 = off
     # (src/adlb.c:558-710; the reference hard-codes 30 s)
@@ -340,6 +370,23 @@ class Config:
             raise ValueError("qmstat_event_gap must be >= 0")
         if self.ops_port is not None and not (0 <= self.ops_port <= 65535):
             raise ValueError("ops_port must be None or in 0..65535")
+        if self.wal_dir is not None and self.server_impl == "native":
+            # the C++ daemon has no WAL writer; its durability story is
+            # the explicit checkpoint ring only
+            raise ValueError("wal_dir requires server_impl='python'")
+        if self.wal_dir is not None and self.restore_path is not None:
+            # two competing sources of restored pool state would apply
+            # in an arbitrary-looking order; pick one
+            raise ValueError(
+                "wal_dir and restore_path are mutually exclusive (WAL "
+                "recovery IS a restore)"
+            )
+        if self.wal_fsync_ms < 0:
+            raise ValueError("wal_fsync_ms must be >= 0")
+        if self.wal_max_bytes < 0:
+            raise ValueError("wal_max_bytes must be >= 0")
+        if self.ops_dump_bytes < 0:
+            raise ValueError("ops_dump_bytes must be >= 0")
         # snapshot lists are flattened into binary-codec list fields whose
         # element count is a u16 (4 entries per task, 3+ntypes per
         # requester); keep a wide safety margin under 65535

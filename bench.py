@@ -998,6 +998,66 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         gray_rows = {"gray_error": repr(e)[:200]}
 
+    # durable-service recovery cost (Config(wal_dir)): cold restart of a
+    # server from its write-ahead log — construction-to-recovered-pool
+    # time over a synthetic log of WAL_UNITS 64 B puts, the shard-load +
+    # replay path a restarted fleet pays per server. Own containment,
+    # like the failover row.
+    def service_bench():
+        import shutil
+        import struct as _struct
+        import tempfile
+
+        from adlb_tpu.runtime import wal as _walmod
+        from adlb_tpu.runtime.queues import WorkUnit as _WU
+        from adlb_tpu.runtime.server import Server as _Server
+        from adlb_tpu.runtime.transport import InProcFabric as _Fab
+        from adlb_tpu.runtime.world import WorldSpec as _WS
+
+        WAL_UNITS = 2000
+        wal_dir = tempfile.mkdtemp(prefix="adlb-bench-wal-")
+        try:
+            world = _WS(nranks=4, nservers=2, types=(1,))
+            w = _walmod.WriteAheadLog(wal_dir, 2, world, fsync_ms=0.0)
+            for i in range(WAL_UNITS):
+                w.log_put(
+                    _WU(seqno=i + 1, work_type=1, prio=0, target_rank=-1,
+                        answer_rank=-1,
+                        payload=_struct.pack("<q", i) + b"\0" * 56),
+                    src=0, put_id=i,
+                )
+            # a realistic tail: half the pool consumed before the crash
+            for i in range(WAL_UNITS // 2):
+                w.log_pin(i + 1, 0)
+                w.log_consume(i + 1)
+            w.tick(time.monotonic(), force=True)
+            w.close()
+            cfg2 = Config(wal_dir=wal_dir, exhaust_check_interval=0.2)
+            # warm the module graph: Server's first construction pulls
+            # the balancer (and jax) imports, which would otherwise be
+            # billed to the replay measurement
+            _Server(_WS(nranks=4, nservers=2, types=(1,)),
+                    Config(exhaust_check_interval=0.2), _Fab(4).endpoint(2))
+            fabric = _Fab(4)
+            t0 = time.monotonic()
+            srv = _Server(world, cfg2, fabric.endpoint(2))
+            replay_ms = (time.monotonic() - t0) * 1e3
+            assert srv.wal_recovered == WAL_UNITS - WAL_UNITS // 2, \
+                srv.wal_recovered
+            srv.wal.close()
+            return {
+                "restart_replay_ms": round(replay_ms, 1),
+                "restart_replay_units": srv.wal_recovered,
+                "restart_replay_log_entries": WAL_UNITS * 2,
+            }
+        finally:
+            shutil.rmtree(wal_dir, ignore_errors=True)
+
+    try:
+        service_rows = service_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        service_rows = {"service_error": repr(e)[:200]}
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -1108,6 +1168,7 @@ def main() -> None:
                 round(r.latency_p50_ms, 3) for r in coin_runs["tpu"]],
             **failover_rows,
             **gray_rows,
+            **service_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1222,6 +1283,7 @@ def main() -> None:
             "failover_mttr_ms": failover_rows.get("failover_mttr_ms"),
             "hang_mttr_ms": gray_rows.get("hang_mttr_ms"),
             "storm_backoffs": gray_rows.get("put_storm_backoffs"),
+            "restart_replay_ms": service_rows.get("restart_replay_ms"),
             "pop_p50": [round(lat_steal.latency_p50_ms, 3),
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),

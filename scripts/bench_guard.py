@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Bench regression guard: diff a fresh BENCH record against a pinned
+baseline and FAIL on latency regressions of the guarded per-op rows.
+
+    python scripts/bench_guard.py NEW.json [--baseline BENCH_r05.json]
+                                  [--threshold 0.15]
+
+Guarded rows (latencies — higher is worse):
+
+* ``coinop_p50`` — the all-native plane's pop-latency probe
+  (``native_coinop_p50_ms_steal`` / ``_tpu``), the per-op transport
+  floor;
+* ``pop_p50`` — the Python plane's pop service latency
+  (``steal_pop_latency_p50_ms`` / ``tpu_pop_latency_p50_ms``).
+
+A guarded value more than ``threshold`` (default 15%) above the
+baseline exits 1, naming the row. A guarded value MISSING from the new
+record also fails (a silently dropped metric reads as "no regression"
+forever); one missing from the baseline is skipped with a note.
+
+Both file shapes are accepted: the driver's wrapper
+(``{"tail": ..., "parsed": {...}}`` — values are regex-scanned out of
+the raw tail when the parsed compact record lacks them) and bench.py's
+own compact JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+# guarded row -> list of (label, raw-text key) latency scalars
+GUARDS = {
+    "coinop_p50": [
+        ("steal", "native_coinop_p50_ms_steal"),
+        ("tpu", "native_coinop_p50_ms_tpu"),
+    ],
+    "pop_p50": [
+        ("steal", "steal_pop_latency_p50_ms"),
+        ("tpu", "tpu_pop_latency_p50_ms"),
+    ],
+}
+
+_NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"
+
+
+def _load(path: str) -> tuple[dict, str]:
+    """(parsed compact detail or {}, raw searchable text)."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return {}, raw
+    if isinstance(doc, dict) and "parsed" in doc:
+        parsed = doc.get("parsed") or {}
+        detail = parsed.get("detail", {}) if isinstance(parsed, dict) else {}
+        # the decoded tail (json.loads already unescaped it) is the
+        # searchable text — the raw file holds it \"-escaped
+        return detail, str(doc.get("tail") or "")
+    if isinstance(doc, dict):
+        return doc.get("detail", doc), raw
+    return {}, raw
+
+
+def _scan(text: str, key: str):
+    m = re.search(rf'"{re.escape(key)}":\s*{_NUM}', text)
+    return float(m.group(1)) if m else None
+
+
+def extract(detail: dict, text: str, row: str, idx: int,
+            raw_key: str):
+    """One guarded scalar: the compact pair list first (row key holds
+    [steal, tpu]), then a raw-text scan for the long-form key."""
+    pair = detail.get(row)
+    if isinstance(pair, (list, tuple)) and len(pair) > idx and \
+            isinstance(pair[idx], (int, float)):
+        return float(pair[idx])
+    v = detail.get(raw_key)
+    if isinstance(v, (int, float)):
+        return float(v)
+    return _scan(text, raw_key)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("new", help="fresh BENCH record (json)")
+    ap.add_argument("--baseline", default="BENCH_r05.json")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="allowed fractional regression (0.15 = 15%%)")
+    args = ap.parse_args(argv)
+
+    new_detail, new_text = _load(args.new)
+    base_detail, base_text = _load(args.baseline)
+
+    failures = []
+    checked = 0
+    for row, cells in GUARDS.items():
+        for idx, (label, raw_key) in enumerate(cells):
+            base = extract(base_detail, base_text, row, idx, raw_key)
+            if base is None or base <= 0:
+                print(f"[bench-guard] {row}[{label}]: no usable baseline "
+                      f"in {args.baseline}; skipped")
+                continue
+            new = extract(new_detail, new_text, row, idx, raw_key)
+            if new is None:
+                failures.append(
+                    f"{row}[{label}]: MISSING from {args.new} "
+                    f"(baseline {base:.3f} ms) — a dropped metric is "
+                    f"not a pass"
+                )
+                continue
+            checked += 1
+            ratio = new / base
+            verdict = "OK"
+            if ratio > 1.0 + args.threshold:
+                verdict = "REGRESSION"
+                failures.append(
+                    f"{row}[{label}]: {new:.3f} ms vs baseline "
+                    f"{base:.3f} ms ({(ratio - 1) * 100:+.1f}% > "
+                    f"{args.threshold * 100:.0f}% allowed)"
+                )
+            print(f"[bench-guard] {row}[{label}]: new {new:.3f} ms, "
+                  f"baseline {base:.3f} ms ({(ratio - 1) * 100:+.1f}%) "
+                  f"{verdict}")
+    if failures:
+        print("[bench-guard] FAIL:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    if checked == 0:
+        print("[bench-guard] FAIL: no guarded metric found in either "
+              "record")
+        return 1
+    print(f"[bench-guard] PASS ({checked} guarded rows within "
+          f"{args.threshold * 100:.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,6 +222,73 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False):
     return app
 
 
+def two_jobs_economy(n_units, poison=True):
+    """Service-mode adversity: two namespaces on one fleet. Rank 0
+    produces/collects job A (plus one poison-typed unit when ``poison``
+    — the fault spec SIGKILLs job-A workers that reserve it until the
+    retry budget quarantines it); rank 1 produces/collects job B; the
+    worker pool splits between the jobs by parity. Job B must drain to
+    completion with exact coverage REGARDLESS of job A's poison churn —
+    per-job exhaustion isolation — and job A itself completes with its
+    poison unit quarantined."""
+    T, T_P, T_ANS = 1, 2, 3
+
+    def producer(ctx, jid, ids_base):
+        ctx.attach(jid)
+        for i in range(n_units):
+            rc = ctx.put(struct.pack("<q", ids_base + i), T,
+                         answer_rank=ctx.rank)
+            assert rc == ADLB_SUCCESS, rc
+        if poison and jid == 1:
+            assert ctx.put(b"poison", T_P) == ADLB_SUCCESS
+        seen = set()
+        while len(seen) < n_units:
+            # the producer doubles as a backstop consumer of its own
+            # job's work (it never requests the poison type): even if
+            # the poison kills the job's whole worker pool, the job
+            # still drains — and answers carry the same id payload, so
+            # either way one reserve closes one id
+            rc, r = ctx.reserve([T, T_ANS])
+            assert rc == ADLB_SUCCESS, rc
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc != ADLB_SUCCESS:
+                continue
+            seen.add(struct.unpack("<q", buf)[0])
+        ctx.drain_job(jid)
+        return len(seen)
+
+    def app(ctx):
+        if ctx.rank == 0:
+            rc, ja = ctx.submit_job("job-a")
+            assert (rc, ja) == (ADLB_SUCCESS, 1), (rc, ja)
+            rc, jb = ctx.submit_job("job-b")
+            assert (rc, jb) == (ADLB_SUCCESS, 2), (rc, jb)
+            return producer(ctx, 1, 0)
+        if ctx.rank == 1:
+            time.sleep(0.3)  # submits land; ids are deterministic
+            return producer(ctx, 2, 1000)
+        time.sleep(0.3)
+        jid = 1 if ctx.rank % 2 == 0 else 2
+        my_answer_rank = 0 if jid == 1 else 1
+        ctx.attach(jid)
+        # only job-A workers touch the poison type: job B's pool must be
+        # untouched by job A's adversity
+        my_types = [T, T_P] if jid == 1 else [T]
+        n = 0
+        while True:
+            rc, r = ctx.reserve(my_types)
+            if rc != ADLB_SUCCESS:
+                return jid, n
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc != ADLB_SUCCESS:
+                continue
+            ctx.put(buf, T_ANS, target_rank=my_answer_rank)
+            n += 1
+            time.sleep(0.002)
+
+    return app
+
+
 def one_iter(seed):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
@@ -262,6 +329,17 @@ def one_iter(seed):
         and not do_skill and not do_stall and apps >= 5
         and rng.random() < 0.35
     )
+    # service-mode adversity: two jobs multiplexed over one fleet, a
+    # poison unit quarantined in job A while job B drains to completion
+    # (per-job exhaustion isolation), under both worker policies
+    # (apps >= 5 => at least two even-rank job-A workers, so the
+    # budget-1 poison is guaranteed to exceed its retry budget and
+    # quarantine even though only job A's half-pool ever touches it)
+    do_two_jobs = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and not do_stall and not do_poison
+        and apps >= 5 and rng.random() < 0.4
+    )
     g_policy = rng.choice(["abort", "reclaim"]) if (do_stall or do_poison) \
         else None
     # seeded delay faults: protocol-invisible, timing-hostile; applied to
@@ -274,9 +352,10 @@ def one_iter(seed):
         # descriptor honest (the spawn-plane/native coverage comes from
         # the economy iterations)
         native = False
-    if policy == "reclaim" or do_faults or do_skill or do_stall or do_poison:
+    if (policy == "reclaim" or do_faults or do_skill or do_stall
+            or do_poison or do_two_jobs):
         # the C++ daemon implements neither the reclaim/failover/lease
-        # protocols nor the (Python-side) fault shim
+        # protocols, the (Python-side) fault shim, nor job namespaces
         native = False
 
     kw = dict(balancer=mode, exhaust_check_interval=0.2,
@@ -288,6 +367,16 @@ def one_iter(seed):
         if do_poison:
             kw["max_unit_retries"] = 2
             kw["fault_spec"] = {"seed": seed, "poison_types": [2]}
+    if do_two_jobs:
+        # both worker policies: "reclaim" must complete BOTH jobs with
+        # the poison quarantined; "abort" must classify the first
+        # poison kill cleanly (bounded, never a hang)
+        kw["on_worker_failure"] = rng.choice(["abort", "reclaim"])
+        kw["lease_timeout_s"] = rng.choice([0.8, 1.2])
+        # budget 1: the SECOND reclaim quarantines — job A's half-pool
+        # (two+ workers) is enough to exceed it
+        kw["max_unit_retries"] = 1
+        kw["fault_spec"] = {"seed": seed, "poison_types": [2]}
     if native:
         kw["server_impl"] = "native"
     if cap:
@@ -347,6 +436,36 @@ def one_iter(seed):
             assert res.quarantined == 1, res.quarantined
             # poison kills at most budget+1 workers, and someone survives
             assert len(res.casualties) <= 3, res.casualties
+        return desc
+
+    if do_two_jobs:
+        n_units = rng.randint(12, 30)
+        tj_policy = kw["on_worker_failure"]
+        app_fn = two_jobs_economy(n_units, poison=True)
+        desc = dict(apps=apps, servers=servers, mode=mode, cap=cap,
+                    workload="two_jobs", policy=tj_policy,
+                    faults=do_faults)
+        t0 = time.monotonic()
+        try:
+            res = spawn_world(apps, servers, [1, 2, 3], app_fn,
+                              cfg=cfg, timeout=150.0)
+        except RuntimeError:
+            assert tj_policy == "abort", "survival policy aborted"
+            assert time.monotonic() - t0 < 120.0, "two-jobs abort hung"
+            return desc
+        if res.aborted:
+            assert tj_policy == "abort", "survival policy aborted"
+            return desc
+        # both producers report full coverage of their OWN namespace
+        assert res.app_results[0] == n_units, res.app_results
+        assert res.app_results[1] == n_units, res.app_results
+        # the poison unit was quarantined exactly once, in job A, and
+        # only job-A workers (even ranks) could be casualties — job B's
+        # pool must come through untouched
+        assert res.quarantined == 1, res.quarantined
+        assert all(r >= 2 and r % 2 == 0 for r in res.casualties), \
+            res.casualties
+        assert len(res.casualties) <= 2, res.casualties
         return desc
 
     if do_skill:

@@ -444,8 +444,11 @@ def test_checkpoint_ack2_shape_validated(tmp_path):
     assert len(got) == 1
 
 
-def test_checkpoint_ack1_read_compat(tmp_path):
-    """A pre-header shard (old builds / old native daemons) still loads."""
+def test_checkpoint_ack1_gated_behind_allow_legacy(tmp_path):
+    """A pre-header ACK1 shard (old builds / old native daemons) is
+    refused LOUDLY by default — it carries no world shape to validate,
+    and the WAL compacts into ACK2 only — with the error naming the
+    Config(allow_legacy_shards) opt-in, which restores the old read."""
     path = tmp_path / "old.2.ckpt"
     body = [b"ACK1", struct.pack("<I", 1)]
     body.append(struct.pack("<iiiqqq", T, -1, -1, 0, -1, -1))
@@ -454,8 +457,13 @@ def test_checkpoint_ack1_read_compat(tmp_path):
     body.append(b"old")
     body.append(struct.pack("<I", 0))  # no common entries
     path.write_bytes(b"".join(body))
+    with pytest.raises(checkpoint.ShardShapeError) as ei:
+        checkpoint.load_shard(str(tmp_path / "old"), 2,
+                              WorldSpec(5, 3, (T,)))
+    assert "allow_legacy_shards" in str(ei.value)
     units, commons = checkpoint.load_shard(str(tmp_path / "old"), 2,
-                                           WorldSpec(5, 3, (T,)))
+                                           WorldSpec(5, 3, (T,)),
+                                           allow_legacy=True)
     assert len(units) == 1 and units[0]["payload"] == b"old"
     assert commons == []
 
