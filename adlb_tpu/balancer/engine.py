@@ -166,6 +166,9 @@ class PlanEngine:
         # either way.
         self._mig_next = 1  # batch-id counter (monotone per dest follows)
         self._planned_in: dict[int, list] = {}
+        # rank -> last time OUR plan touched its ledger view (drives the
+        # sharded solver's effective ingest stamps)
+        self._rank_planned: dict[int, float] = {}
         # rank -> adaptive per-consumer lookahead window and the time it
         # last triggered a top-up (see LOOKAHEAD)
         self._look: dict[int, float] = {}
@@ -314,13 +317,35 @@ class PlanEngine:
         filtered = {}
         for rank, snap in snapshots.items():
             # task eligibility uses the task-side stamp: a reqs-only park
-            # snapshot must not re-eligibilize in-flight planned tasks
+            # snapshot must not re-eligibilize in-flight planned tasks.
+            # Stamps ride along so the sharded solver's ingest can skip
+            # unchanged servers without diffing their lists (the
+            # single-device solver ignores the extra keys).
             tstamp = snap.get("task_stamp", snap.get("stamp", now))
             tasks = [
                 t for t in snap["tasks"]
                 if self._planned_tasks.get((rank, t[0]), -1.0) < tstamp
             ]
-            filtered[rank] = {"tasks": tasks, "reqs": freqs[rank]}
+            filtered[rank] = {
+                "tasks": tasks, "reqs": freqs[rank],
+                "task_stamp": tstamp,
+                "stamp": snap.get("stamp", now),
+                # event task deltas / dead-rank req patches mutate the
+                # snapshot in place WITHOUT a stamp bump (see
+                # server._merge_task_delta / _patch_snapshots_for_dead),
+                # and OUR own plans/migrations change the ledger-filtered
+                # view with no snapshot at all: the sequence numbers and
+                # the ledger stamp carry those changes to the solver's
+                # unchanged-server fast path. ledger_stamp is a SEPARATE
+                # field (never max()ed into the snapshot stamps): stamps
+                # are the SENDING host's monotonic clock while the
+                # ledger stamp is the planner's — ordering across the
+                # two domains is meaningless, and the solver only ever
+                # compares the key tuple for (in)equality.
+                "delta_seq": snap.get("delta_seq", 0),
+                "req_seq": snap.get("req_seq", 0),
+                "ledger_stamp": self._rank_planned.get(rank, -1.0),
+            }
         if cross:
             pairs = self.solver.solve(filtered, world)
         else:
@@ -339,6 +364,8 @@ class PlanEngine:
                 continue
             self._planned_reqs[(req_home, for_rank, rqseqno)] = t_planned
             self._planned_tasks[(holder, seqno)] = t_planned
+            self._rank_planned[holder] = t_planned
+            self._rank_planned[req_home] = t_planned
             matches.append((holder, seqno, req_home, for_rank, rqseqno))
         migrations = []
         if pump_due:
@@ -364,10 +391,18 @@ class PlanEngine:
                     self.metrics.histogram("balancer_plan_age_s").observe(
                         max(ages)
                     )
+        for src_rank, dest, _seqnos, _mid in migrations:
+            self._rank_planned[src_rank] = t_planned
+            self._rank_planned[dest] = t_planned
         if self.metrics is not None:
-            self.metrics.histogram("balancer_round_s").observe(
-                time.monotonic() - now
-            )
+            dur = time.monotonic() - now
+            self.metrics.histogram("balancer_round_s").observe(dur)
+            # gauges for live scraping (/metrics): last planning-round
+            # wall time, and the sharded solver's last device sweep
+            self.metrics.gauge("balancer_round_ms").set(dur * 1e3)
+            sweep = getattr(self.solver, "last_sweep_ms", None)
+            if sweep is not None:
+                self.metrics.gauge("solve_shard_ms").set(sweep)
             if matches:
                 self.metrics.counter("balancer_pairs").inc(len(matches))
             if migrations:

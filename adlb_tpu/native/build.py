@@ -58,6 +58,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "adlb_wq_total_bytes": (i64, [p]),
         "adlb_wq_num_unpinned": (i64, [p]),
         "adlb_wq_num_unpinned_untargeted": (i64, [p]),
+        "adlb_wq_depth_sample": (None, [p, i64p]),
         "adlb_wq_snapshot_untargeted": (i64, [p, i64, i64p, i32p, i32p, i64p]),
         "adlb_wq_get": (i32, [p, i64, i32p, i32p, i32p, i32p, i64p]),
     }
@@ -65,6 +66,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.restype = restype
         fn.argtypes = argtypes
+    # The O(1) getters are ALSO bound through a PyDLL view of the same
+    # library: CDLL releases the GIL around every call, and on a loaded
+    # host each re-acquire can stall the calling (reactor) thread for
+    # up to a scheduler switch interval — milliseconds — which made the
+    # periodic tick's depth gauges a measurable slice of tpu-mode pop
+    # latency. PyDLL keeps the GIL held: correct for these functions
+    # (no I/O, no blocking, nanoseconds of C) and ~1000x cheaper under
+    # thread contention. Heavy calls (snapshot sorts, matching) stay on
+    # the GIL-releasing CDLL where parallelism pays.
+    fast = ctypes.PyDLL(lib._name)
+    for name in (
+        "adlb_wq_count", "adlb_wq_max_count", "adlb_wq_total_bytes",
+        "adlb_wq_num_unpinned", "adlb_wq_num_unpinned_untargeted",
+        "adlb_wq_depth_sample", "adlb_wq_hi_prio_of_type",
+    ):
+        restype, argtypes = sig[name]
+        fn = getattr(fast, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    lib._fast = fast
     return lib
 
 

@@ -34,6 +34,9 @@ class NativeWorkQueue:
             from adlb_tpu.native.build import build_error
 
             raise RuntimeError(build_error() or "native core unavailable")
+        # O(1) getters go through the PyDLL view (no GIL release —
+        # see build._bind); everything else through the CDLL
+        self._fast = self._lib._fast
         self._h = self._lib.adlb_wq_new()
         self._units: dict[int, WorkUnit] = {}
 
@@ -127,10 +130,10 @@ class NativeWorkQueue:
     # -- stats ---------------------------------------------------------------
 
     def num_unpinned(self) -> int:
-        return self._lib.adlb_wq_num_unpinned(self._h)
+        return self._fast.adlb_wq_num_unpinned(self._h)
 
     def num_unpinned_untargeted(self) -> int:
-        return self._lib.adlb_wq_num_unpinned_untargeted(self._h)
+        return self._fast.adlb_wq_num_unpinned_untargeted(self._h)
 
     # availability signal for the balancer's snapshot gating (the Python
     # queue keeps an O(1) counter; the C core's count is cheap per tick)
@@ -138,7 +141,7 @@ class NativeWorkQueue:
 
     def hi_prio_of_type(self, work_type: int) -> int:
         out = ctypes.c_int32()
-        rc = self._lib.adlb_wq_hi_prio_of_type(
+        rc = self._fast.adlb_wq_hi_prio_of_type(
             self._h, work_type, ctypes.byref(out)
         )
         return out.value if rc == 0 else ADLB_LOWEST_PRIO
@@ -171,18 +174,24 @@ class NativeWorkQueue:
 
     @property
     def count(self) -> int:
-        return self._lib.adlb_wq_count(self._h)
+        return self._fast.adlb_wq_count(self._h)
 
     @property
     def max_count(self) -> int:
-        return self._lib.adlb_wq_max_count(self._h)
+        return self._fast.adlb_wq_max_count(self._h)
 
     @property
     def total_bytes(self) -> int:
-        return self._lib.adlb_wq_total_bytes(self._h)
+        return self._fast.adlb_wq_total_bytes(self._h)
 
     def depth_sample(self) -> tuple[int, int, int]:
         """(count, unpinned-untargeted, bytes) — the periodic
         observability tick's queue-depth gauges (twin of the Python
-        WorkQueue's depth_sample; three cheap C calls)."""
-        return self.count, self.untargeted_avail, self.total_bytes
+        WorkQueue's depth_sample). ONE C call: every ctypes crossing
+        releases and re-acquires the GIL, and on a loaded host each
+        re-acquire can stall the reactor thread for milliseconds — the
+        old three-property version was a measurable slice of tpu-mode
+        pop latency."""
+        out = (ctypes.c_int64 * 3)()
+        self._fast.adlb_wq_depth_sample(self._h, out)
+        return out[0], out[1], out[2]

@@ -37,6 +37,7 @@ int32_t adlb_wq_add(void* h, int64_t seqno, int32_t work_type, int32_t prio,
     wq->count += 1;
     if (wq->count > wq->max_count) wq->max_count = wq->count;
     wq->total_bytes += payload_len;
+    if (!pinned && target_rank < 0) wq->unpinned_untargeted += 1;
     if (!pinned) wq->index(u);
     return 0;
 }
@@ -46,6 +47,8 @@ int32_t adlb_wq_remove(void* h, int64_t seqno) {
     auto it = wq->units.find(seqno);
     if (it == wq->units.end()) return -1;
     wq->total_bytes -= it->second.payload_len;
+    if (it->second.pin_rank < 0 && it->second.target_rank < 0)
+        wq->unpinned_untargeted -= 1;
     wq->units.erase(it);
     wq->count -= 1;
     return 0;
@@ -55,6 +58,9 @@ int32_t adlb_wq_pin(void* h, int64_t seqno, int32_t rank) {
     auto* wq = static_cast<WorkQueue*>(h);
     auto it = wq->units.find(seqno);
     if (it == wq->units.end()) return -1;
+    if (it->second.pin_rank < 0 && rank >= 0 &&
+        it->second.target_rank < 0)
+        wq->unpinned_untargeted -= 1;
     it->second.pin_rank = rank;
     return 0;
 }
@@ -63,6 +69,8 @@ int32_t adlb_wq_unpin(void* h, int64_t seqno) {
     auto* wq = static_cast<WorkQueue*>(h);
     auto it = wq->units.find(seqno);
     if (it == wq->units.end()) return -1;
+    if (it->second.pin_rank >= 0 && it->second.target_rank < 0)
+        wq->unpinned_untargeted += 1;
     it->second.pin_rank = -1;
     wq->index(it->second);
     return 0;
@@ -121,11 +129,22 @@ int64_t adlb_wq_num_unpinned(void* h) {
 }
 
 int64_t adlb_wq_num_unpinned_untargeted(void* h) {
+    // O(1): the counter is maintained at add/remove/pin/unpin — this is
+    // the balancer's per-tick availability signal, and the old O(n)
+    // walk (paired with the per-call GIL release/re-acquire) was a
+    // measurable slice of tpu-mode pop latency
+    return static_cast<WorkQueue*>(h)->unpinned_untargeted;
+}
+
+// (count, unpinned-untargeted, bytes) in ONE call: the periodic tick's
+// queue-depth gauges. Every ctypes crossing releases and re-acquires
+// the GIL; on a loaded host each re-acquire can cost milliseconds on
+// the reactor thread, so the tick pays one crossing, not three.
+void adlb_wq_depth_sample(void* h, int64_t* out) {
     auto* wq = static_cast<WorkQueue*>(h);
-    int64_t n = 0;
-    for (auto& kv : wq->units)
-        if (kv.second.pin_rank < 0 && kv.second.target_rank < 0) n += 1;
-    return n;
+    out[0] = wq->count;
+    out[1] = wq->unpinned_untargeted;
+    out[2] = wq->total_bytes;
 }
 
 // Fill out arrays with up to `cap` unpinned untargeted units, sorted by

@@ -57,6 +57,10 @@ struct WorkQueue {
     int64_t count = 0;
     int64_t max_count = 0;
     int64_t total_bytes = 0;
+    // O(1) mirror of "unpinned && untargeted" (the balancer's
+    // availability signal, read every periodic tick): maintained at
+    // add/remove/pin/unpin so the tick never walks the unit table
+    int64_t unpinned_untargeted = 0;
 
     void index(const Unit& u) {
         HeapKey k{-u.prio, u.seqno};

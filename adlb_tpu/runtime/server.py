@@ -549,6 +549,7 @@ class Server:
         # timers
         now = time.monotonic()
         self._next_state_sync = now
+        self._next_gauge_sample = now  # first tick samples immediately
         self._next_lease_scan = (
             now + cfg.lease_timeout_s if self._lease_armed else float("inf")
         )
@@ -895,11 +896,18 @@ class Server:
                 self.cfg.lease_timeout_s / 4.0, 0.01
             )
             self._scan_leases(now)
-        if now >= self._next_state_sync:
-            self._next_state_sync = now + interval
-            # queue-depth gauges + bounded timelines, sampled on the tick:
-            # the per-server depth history a post-mortem needs (VERDICT
-            # item 3's flat-wait diagnosis) at O(1) per tick
+        if now >= self._next_gauge_sample:
+            # queue-depth gauges + bounded timelines, sampled on their
+            # OWN cadence (Config(gauge_interval), 0.25 s default),
+            # decoupled from the balancer tick: in tpu mode the state
+            # sync runs at balancer_interval (20 ms), and paying the
+            # gauge walk + its ctypes GIL crossings 50x/s on the reactor
+            # thread was a measured slice of the r01->r05 tpu pop-latency
+            # drift (see docs/pop_latency_r06.md). Observability loses
+            # nothing: the timelines still cover the same history,
+            # just at post-mortem resolution.
+            self._next_gauge_sample = now + max(
+                interval, self.cfg.gauge_interval)
             wq_d, wq_avail, wq_bytes = self.wq.depth_sample()
             rq_d = len(self.rq)
             self._g_wq.set(wq_d)
@@ -916,6 +924,8 @@ class Server:
             self._g_leases.set(len(self.leases))
             self._g_lease_age.set(self.leases.oldest_age(now))
             self._g_quarantined.set(len(self.quarantine))
+        if now >= self._next_state_sync:
+            self._next_state_sync = now + interval
             if self.cfg.balancer == "tpu":
                 # The snapshot walk is O(wq); at the fast balancer cadence
                 # it is a real GIL tax on compute-bound workloads. Walk it
@@ -926,8 +936,11 @@ class Server:
                 # qualifies (planner-side admission wants fresh nbytes).
                 # Otherwise a slow heartbeat (parks themselves send event
                 # snapshots immediately).
+                # rq length first: it is a plain Python len, while
+                # untargeted_avail crosses into the C core (a GIL
+                # release/re-acquire per call on this hot tick)
                 relevant = self._hungry and (
-                    self.wq.untargeted_avail > 0 or len(self.rq) > 0
+                    len(self.rq) > 0 or self.wq.untargeted_avail > 0
                 )
                 if (
                     relevant
@@ -2757,6 +2770,10 @@ class Server:
             snap["mig_acks"] = (
                 prev.get("mig_acks") if prev is not None else None
             )
+            # the inherited task list carries its event-delta sequence
+            # (the sharded solver keys its fast path on it)
+            if prev is not None:
+                snap["delta_seq"] = prev.get("delta_seq", 0)
         else:
             snap["task_stamp"] = snap["stamp"]
         self._snapshots[src] = snap
@@ -2841,6 +2858,10 @@ class Server:
         # NOTE: snap["stamp"] is NOT bumped — requester (re-)eligibility in
         # the plan ledger must only come from full snapshots that re-observe
         # the requester parked; the new task is eligible under any stamp.
+        # The delta SEQUENCE lets the sharded solver's unchanged-server
+        # fast path notice the in-place append without a stamp bump
+        # (bumping task_stamp here would re-eligibilize planned tasks).
+        snap["delta_seq"] = snap.get("delta_seq", 0) + 1
         if self._balancer is not None:
             self._balancer.wake.set()
 
@@ -4286,6 +4307,10 @@ class Server:
             kept = [r for r in reqs if r[0] != rank]
             if len(kept) != len(reqs):
                 snap["reqs"] = kept
+                # no stamp bump (it would re-eligibilize the ledger);
+                # the sequence carries the in-place patch to the
+                # sharded solver's unchanged-server fast path
+                snap["req_seq"] = snap.get("req_seq", 0) + 1
                 self._req_sigs[src] = tuple(
                     sorted((r[0], r[1]) for r in kept)
                 )

@@ -284,6 +284,11 @@ class Config:
     # The full payload stays retrievable in-band via ctx.get_quarantined().
     ops_dump_bytes: int = 256
     aprintf_flag: bool = False  # stamped debug prints (src/adlb.c:3395-3417)
+    # queue-depth gauge / timeline sampling cadence on the reactor tick
+    # (floored at the state-sync interval): decoupled from the 20 ms
+    # tpu-mode balancer tick, whose per-tick gauge walk was a measured
+    # slice of the r01->r05 tpu pop-latency drift
+    gauge_interval: float = 0.25
     selfdiag_interval: float = 30.0  # server health dumps; 0 = off
     # (src/adlb.c:558-710; the reference hard-codes 30 s)
     selfdiag_stuck_after: float = 5.0  # rq age that counts as "stuck"

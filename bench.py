@@ -1058,6 +1058,39 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         service_rows = {"service_error": repr(e)[:200]}
 
+    # multichip planning-round latency at scale: the sharded balancer's
+    # full round (snapshot-delta ingest -> sharded solve -> plan
+    # extraction) at 1,000 servers / 100k parked requesters on an 8-way
+    # host-simulated mesh (ROADMAP item 1's sub-10 ms target). Runs in a
+    # subprocess so the virtual-mesh provisioning cannot disturb this
+    # process's accelerator backend. Own containment.
+    def plan_round_bench():
+        import subprocess as _sp
+
+        proc = _sp.run(
+            [sys.executable, "-m", "adlb_tpu.balancer.plan_bench",
+             "--quick", "--json-only"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"plan_bench rc={proc.returncode}: {proc.stderr[-200:]}")
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        big = doc["rows"][-1]
+        return {
+            "plan_round_1k_ms": big["plan_round_p50_ms"],
+            "plan_round_1k_p90_ms": big["plan_round_p90_ms"],
+            "plan_round_1k_servers": big["servers"],
+            "plan_round_1k_parked": big["parked_reqs"],
+            "plan_round_sweep_ms": big["device_sweep_ms"],
+        }
+
+    try:
+        plan_rows = plan_round_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        plan_rows = {"plan_round_error": repr(e)[:200]}
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -1169,6 +1202,7 @@ def main() -> None:
             **failover_rows,
             **gray_rows,
             **service_rows,
+            **plan_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1284,6 +1318,8 @@ def main() -> None:
             "hang_mttr_ms": gray_rows.get("hang_mttr_ms"),
             "storm_backoffs": gray_rows.get("put_storm_backoffs"),
             "restart_replay_ms": service_rows.get("restart_replay_ms"),
+            # multichip planning round @ 1k servers / 100k parked (p50)
+            "plan_round_1k_ms": plan_rows.get("plan_round_1k_ms"),
             "pop_p50": [round(lat_steal.latency_p50_ms, 3),
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),

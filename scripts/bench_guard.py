@@ -41,6 +41,17 @@ GUARDS = {
         ("steal", "steal_pop_latency_p50_ms"),
         ("tpu", "tpu_pop_latency_p50_ms"),
     ],
+    # the batched global solve's end-to-end latency (snapshot->pairs,
+    # device path forced, 4096x512 pool) — the balancer-brain budget
+    "solve_ms": [
+        ("4096x512", "solve_4096x512_ms"),
+    ],
+    # the multichip planning round at 1,000 servers / 100k parked
+    # requesters on the 8-way simulated mesh (r06 metric; baselines
+    # older than r06 skip it with a note, per the missing-baseline rule)
+    "plan_round": [
+        ("1k", "plan_round_1k_ms"),
+    ],
 }
 
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"

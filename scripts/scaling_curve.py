@@ -25,7 +25,20 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="halve task counts (smoke test)")
+    ap.add_argument("--plan-sweep", action="store_true",
+                    help="run the sharded-balancer planning-latency "
+                         "sweep (8-way virtual mesh, to 1,000 servers / "
+                         "100k parked requesters) instead of the "
+                         "measured-worlds curve")
     args = ap.parse_args()
+
+    if args.plan_sweep:
+        # the sweep re-provisions JAX onto a virtual 8-device CPU mesh,
+        # so it runs before any world touches the accelerator
+        from adlb_tpu.balancer import plan_bench
+
+        raise SystemExit(
+            plan_bench.main(["--quick"] if args.quick else []))
 
     from adlb_tpu.runtime.world import Config
     from adlb_tpu.workloads import hotspot_native

@@ -38,7 +38,14 @@ an order of magnitude higher at the adaptive window's converged batch
 sizes. The simulation exists to show where each ceiling bites as ranks
 grow, with discovery staleness and strike-outs layered on top.
 
-Usage: python scripts/sim_scale.py
+Usage: python scripts/sim_scale.py [--plan-sweep]
+
+``--plan-sweep`` instead runs the MEASURED planning-latency sweep of the
+sharded balancer (snapshot-delta ingest -> sharded solve -> plan
+extracted) on a self-provisioned 8-way virtual mesh, to 1,000 servers /
+100k parked requesters — ROADMAP item 1's sub-10 ms target. The sweep
+lives in :mod:`adlb_tpu.balancer.plan_bench` (also callable as
+``python -m adlb_tpu.balancer.plan_bench``).
 """
 
 from __future__ import annotations
@@ -371,7 +378,24 @@ class Sim:
 
 
 def main() -> None:
-    argparse.ArgumentParser().parse_args()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--plan-sweep", action="store_true",
+                    help="measured sharded-balancer planning-latency "
+                         "sweep (8-way virtual mesh) instead of the "
+                         "hotspot simulation")
+    ap.add_argument("--quick", action="store_true",
+                    help="with --plan-sweep: fewer reps/scales")
+    args = ap.parse_args()
+    if args.plan_sweep:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from adlb_tpu.balancer import plan_bench
+
+        argv = ["--quick"] if args.quick else []
+        raise SystemExit(plan_bench.main(argv))
 
     params = {
         # per-message reactor service time: in-proc Python reactor

@@ -3,6 +3,7 @@ launcher invocations (one per simulated host) rendezvous through a shared
 directory and run a complete world."""
 
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -31,7 +32,10 @@ _APP = textwrap.dedent(
             if rc != ADLB_SUCCESS:
                 break
             got.append(struct.unpack("<q", w.payload)[0])
-        print("APP", ctx.rank, "GOT", sorted(got))
+        # ONE write: multi-arg print issues a pipe write per argument,
+        # and two apps sharing the launcher's stdout interleave
+        # mid-token ("APP 0 GOTAPP 1 ...") under load
+        sys.stdout.write("APP {} GOT {!r}\\n".format(ctx.rank, sorted(got)))
     """
 ) % (_REPO,)
 
@@ -97,10 +101,12 @@ def test_two_launchers_one_world(tmp_path, server_impl):
     assert pa.returncode == 0, f"launcher A rc={pa.returncode}\n{out_a}\n{err_a}"
     assert pb.returncode == 0, f"launcher B rc={pb.returncode}\n{out_b}\n{err_b}"
     got = []
+    # regex, not line-splitting: app subprocesses share the launcher's
+    # stdout pipe and their report lines can interleave mid-line under
+    # load ("[...]APP 2 GOT [...]"), which a per-line eval chokes on
     for out in (out_a, out_b):
-        for line in out.splitlines():
-            if line.startswith("APP "):
-                got.extend(eval(line.split("GOT", 1)[1]))
+        for lst in re.findall(r"APP \d+ GOT (\[[^\]]*\])", out):
+            got.extend(eval(lst))
     assert sorted(got) == list(range(40)), sorted(got)
 
 
