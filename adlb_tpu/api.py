@@ -333,6 +333,20 @@ def join_world(
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
     ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers)
+    # shm ring fabric toward same-host ranks (the launcher exports
+    # ADLB_FABRIC/ADLB_SHM_KEY; a bare join derives the key from the
+    # rendezvous directory, so all parties of one world agree)
+    from adlb_tpu.runtime.transport_shm import (
+        key_for_rendezvous,
+        maybe_shm,
+        resolve_fabric,
+    )
+
+    if resolve_fabric(cfg) == "shm":
+        shm_key = os.environ.get("ADLB_SHM_KEY") or key_for_rendezvous(
+            os.path.dirname(os.path.abspath(path))
+        )
+        ep = maybe_shm(ep, cfg, shm_key)
     if cfg.fault_spec:
         from adlb_tpu.runtime.faults import maybe_wrap
 

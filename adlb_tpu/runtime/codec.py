@@ -174,6 +174,9 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.TA_HOME_TAKEOVER: 1138,
     # job-namespace lifecycle fan-out (service mode; python-only today)
     Tag.SS_JOB_CTL: 1139,
+    # shm-fabric pair announcement (rides the TCP plane once per
+    # connected pair; swallowed by the transport reader)
+    Tag.SHM_HELLO: 1998,
     # transport-internal synthetic signal (never actually on the wire; the
     # id exists only so the codec table stays total)
     Tag.PEER_EOF: 1999,
@@ -350,41 +353,89 @@ def encodable(m: Msg) -> bool:
     return all(k in FIELDS for k, v in m.data.items() if v is not None)
 
 
-def encode_binary(m: Msg) -> bytes:
+# bytes fields at least this large ride the iovec as zero-copy views;
+# smaller ones fold into the accumulating header segment (a syscall's
+# iovec slots and a ring's bookkeeping both cost more than a small copy)
+IOV_INLINE_MAX = 512
+
+
+def _bytes_view(value):
+    """Normalize a bytes-field value to a flat byte buffer. A memoryview
+    with itemsize != 1 must be cast to bytes ('B') first: ``len()`` on
+    it counts ITEMS, and emitting an item count as the u32 byte length
+    would desync the whole TLV stream."""
+    if isinstance(value, (bytes, bytearray)):
+        return value
+    if isinstance(value, memoryview):
+        if value.itemsize == 1 and value.ndim == 1 and value.contiguous:
+            return value  # zero-copy fast path; len() == byte length
+        return bytes(value)  # flatten (tobytes) — correct byte length
+    return bytes(value)
+
+
+def encode_binary_iov(m: Msg) -> list:
+    """Scatter-gather form of :func:`encode_binary`: a list of buffers
+    whose concatenation is the frame body, with large ``bytes`` payloads
+    (put/fetch bodies, batch payload lists) left as zero-copy views
+    instead of being concatenated into a fresh body. The TCP plane hands
+    the list straight to ``sendmsg`` and the shm fabric writes the
+    segments into the ring — either way the payload bytes are copied
+    exactly once (into the kernel buffer / the ring), never first into
+    an intermediate ``hdr + body`` string."""
     fields = [(k, v) for k, v in m.data.items() if v is not None]
-    out = [_HDR.pack(BINARY_MAGIC, WIRE_TAG[m.tag], m.src, len(fields))]
+    parts: list = []
+    acc = bytearray(_HDR.pack(BINARY_MAGIC, WIRE_TAG[m.tag], m.src,
+                              len(fields)))
     for name, value in fields:
         fid, kind = FIELDS[name]
-        out.append(struct.pack("<BB", fid, kind))
+        acc += struct.pack("<BB", fid, kind)
         if kind == _KIND_I64:
-            out.append(_I64.pack(int(value)))
+            acc += _I64.pack(int(value))
         elif kind == _KIND_BYTES:
-            b = bytes(value)
-            out.append(_U32.pack(len(b)))
-            out.append(b)
+            b = _bytes_view(value)
+            acc += _U32.pack(len(b))
+            if len(b) >= IOV_INLINE_MAX:
+                parts.append(bytes(acc))
+                acc = bytearray()
+                parts.append(b)
+            else:
+                acc += b
         elif kind == _KIND_LIST:
             seq = [int(x) for x in value]
             if len(seq) > 65535:
                 raise ValueError(f"list field {name} overflows u16 bound")
-            out.append(_U16.pack(len(seq)))
-            out.extend(_I64.pack(x) for x in seq)
+            acc += _U16.pack(len(seq))
+            for x in seq:
+                acc += _I64.pack(x)
         elif kind == _KIND_BLIST:
             if len(value) > 65535:
                 raise ValueError(f"blist field {name} overflows u16 bound")
-            out.append(_U16.pack(len(value)))
+            acc += _U16.pack(len(value))
             for item in value:
-                b = bytes(item)
-                out.append(_U32.pack(len(b)))
-                out.append(b)
+                b = _bytes_view(item)
+                acc += _U32.pack(len(b))
+                if len(b) >= IOV_INLINE_MAX:
+                    parts.append(bytes(acc))
+                    acc = bytearray()
+                    parts.append(b)
+                else:
+                    acc += b
         elif kind == _KIND_FLIST:
             seq = [float(x) for x in value]
             if len(seq) > 65535:
                 raise ValueError(f"flist field {name} overflows u16 bound")
-            out.append(_U16.pack(len(seq)))
-            out.extend(_F64.pack(x) for x in seq)
+            acc += _U16.pack(len(seq))
+            for x in seq:
+                acc += _F64.pack(x)
         else:
-            out.append(_F64.pack(float(value)))
-    return b"".join(out)
+            acc += _F64.pack(float(value))
+    if acc:
+        parts.append(bytes(acc))
+    return parts
+
+
+def encode_binary(m: Msg) -> bytes:
+    return b"".join(encode_binary_iov(m))
 
 
 def decode_binary(body: bytes) -> Msg:

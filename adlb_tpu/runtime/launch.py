@@ -123,6 +123,14 @@ def main(argv=None) -> int:
     ap.add_argument("--server-impl", default="python",
                     choices=["python", "native"])
     ap.add_argument("--balancer", default="steal", choices=["steal", "tpu"])
+    ap.add_argument("--fabric", default="auto",
+                    choices=["auto", "shm", "tcp"],
+                    help="process-world transport: 'auto' upgrades "
+                         "same-host rank pairs to the shared-memory ring "
+                         "fabric when the host can run it (cross-host "
+                         "pairs stay TCP); 'tcp' disables the upgrade "
+                         "(exported to app programs as ADLB_FABRIC / "
+                         "ADLB_SHM_KEY)")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--flight-dir", default=None,
                     help="directory for per-rank flight-record JSON "
@@ -201,6 +209,7 @@ def main(argv=None) -> int:
 
         fault_spec = json.loads(args.fault_spec)
     cfg = Config(balancer=args.balancer, server_impl=args.server_impl,
+                 fabric=args.fabric,
                  flight_dir=args.flight_dir, ops_port=args.ops_port,
                  on_worker_failure=args.on_worker_failure,
                  on_server_failure=args.on_server_failure,
@@ -214,6 +223,19 @@ def main(argv=None) -> int:
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
     rdv = args.rendezvous
+    # fabric negotiation: every launcher (and joined client) of this
+    # world derives the SAME shm namespace from the rendezvous
+    # directory, so same-host pairs find each other's rings while
+    # cross-host pairs silently stay on TCP
+    from adlb_tpu.runtime.transport_shm import (
+        cleanup_world,
+        key_for_rendezvous,
+        resolve_fabric,
+    )
+
+    shm_key = (
+        key_for_rendezvous(rdv) if resolve_fabric(cfg) == "shm" else None
+    )
     failures: list[str] = []
     threads: list[threading.Thread] = []
     server_eps = {}   # rank -> TcpEndpoint (python impl)
@@ -232,10 +254,15 @@ def main(argv=None) -> int:
             _publish(rdv, rank, host, daemon.read_hello(proc, rank))
         else:
             from adlb_tpu.runtime.faults import maybe_wrap
+            from adlb_tpu.runtime.transport_shm import maybe_shm
             from adlb_tpu.runtime.transport_tcp import TcpEndpoint
 
-            ep = maybe_wrap(TcpEndpoint(rank, {rank: (host, 0)}), cfg,
-                            world)
+            # shm wrapper inside, fault shim outside (faults must apply
+            # to ring traffic exactly as to TCP traffic)
+            ep = maybe_wrap(
+                maybe_shm(TcpEndpoint(rank, {rank: (host, 0)}), cfg,
+                          shm_key),
+                cfg, world)
             server_eps[rank] = ep
             _publish(rdv, rank, host, ep.port)
     if (args.server_impl == "native" and args.balancer == "tpu"
@@ -324,6 +351,12 @@ def main(argv=None) -> int:
                 env["ADLB_FLIGHT_DIR"] = args.flight_dir
             if args.fault_spec:
                 env["ADLB_FAULT_SPEC"] = args.fault_spec
+            if shm_key:
+                # joined clients upgrade their same-host pairs too
+                env["ADLB_FABRIC"] = "shm"
+                env["ADLB_SHM_KEY"] = shm_key
+            elif args.fabric == "tcp":
+                env["ADLB_FABRIC"] = "tcp"
             if args.on_worker_failure != "abort":
                 env["ADLB_ON_WORKER_FAILURE"] = args.on_worker_failure
             if args.on_server_failure != "abort":
@@ -361,6 +394,14 @@ def main(argv=None) -> int:
         from adlb_tpu.balancer.sidecar import stop_sidecar
 
         stop_sidecar(*sidecar)
+    # best-effort sweep of this world's ring segments/FIFOs: ranks that
+    # died without unlinking (SIGKILL chaos) would otherwise leak them.
+    # Exactly ONE party sweeps — the launcher hosting the master server —
+    # so a same-host sibling launcher still finalizing its ranks never
+    # has live rings unlinked from under it (others' strays are replaced
+    # at create time by the next incarnation anyway).
+    if world.master_server_rank in my_ranks:
+        cleanup_world(shm_key)
     for f in failures:
         print(f"[adlb_launch] {f}", file=sys.stderr)
     return rc_final if not failures else (rc_final or 1)

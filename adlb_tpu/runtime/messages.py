@@ -161,6 +161,15 @@ class Tag(enum.Enum):
     DS_LOG = enum.auto()
     DS_END = enum.auto()
 
+    # transport-internal, TCP-carried: a rank that just attached a
+    # shared-memory ring toward the receiver announces it here (one
+    # frame per pair, before any ring traffic). The TCP reader records
+    # the sender and swallows the frame — roles never see it — so the
+    # connection it rides becomes the pair's death sentinel: a SIGKILLed
+    # shm peer EOFs this socket, and the existing PEER_EOF machinery
+    # (reclaim, failover, takeover) works unchanged over the ring fabric.
+    SHM_HELLO = enum.auto()
+
     # transport-internal (never on the wire): a peer's connection hit EOF.
     # The reference's failure model is "any rank failure kills the job"
     # (MPI_Abort paths, reference src/adlb.c:2508-2526); over TCP the

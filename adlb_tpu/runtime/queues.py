@@ -62,10 +62,24 @@ class WorkUnit:
     # jobs live in their own wq partition (PartitionedWorkQueue) with
     # per-job termination and per-tenant admission quotas.
     job: int = 0
+    # disk spill tier (Config(spill_dir), runtime/spill.py): when the
+    # payload has been spilled, ``payload`` is empty and ``spill_len``
+    # remembers its true size; Server._unspill faults it back in before
+    # any delivery/ship/snapshot path reads the bytes.
+    spilled: bool = False
+    spill_len: int = 0
 
     @property
     def work_len(self) -> int:
-        return len(self.payload) + self.common_len
+        n = self.spill_len if self.spilled else len(self.payload)
+        return n + self.common_len
+
+    @property
+    def payload_len(self) -> int:
+        """True payload size whether resident or spilled — metadata
+        paths (balancer snapshots, push queries) must not read a
+        spilled unit as empty."""
+        return self.spill_len if self.spilled else len(self.payload)
 
 
 class WorkQueue:
@@ -899,6 +913,11 @@ class MemoryAccountant:
         self.curr = 0
         self.total = 0
         self.hwm = 0
+        # disk spill tier: bytes whose payloads live in the spill file
+        # instead of RAM. ``curr`` is RESIDENT bytes only — watermarks,
+        # pushes, and admission all act on what actually occupies
+        # memory; ``curr + spilled`` is the logical pool size.
+        self.spilled = 0
 
     def try_alloc(self, nbytes: int) -> bool:
         """Admission-controlled alloc for puts (reference ``pmalloc``)."""
@@ -914,6 +933,23 @@ class MemoryAccountant:
 
     def free(self, nbytes: int) -> None:
         self.curr -= nbytes
+
+    def note_spill(self, nbytes: int) -> None:
+        """Payload moved RAM -> spill file: resident shrinks, the bytes
+        stay accounted to the pool."""
+        self.curr -= nbytes
+        self.spilled += nbytes
+
+    def note_faultin(self, nbytes: int) -> None:
+        """Payload moved spill file -> RAM."""
+        self.curr += nbytes
+        self.spilled -= nbytes
+        self.hwm = max(self.hwm, self.curr)
+
+    def note_spill_drop(self, nbytes: int) -> None:
+        """A spilled payload was discarded outright (dead target, killed
+        job) — it never returns to residency."""
+        self.spilled -= nbytes
 
     @property
     def under_pressure(self) -> bool:

@@ -239,6 +239,14 @@ class Server:
             hard_frac=cfg.mem_hard_frac,
         )
         self.cq = CommonStore(on_gc=self._on_common_gc)
+        # disk spill tier (Config(spill_dir), runtime/spill.py): cold
+        # parked payloads move to disk above the spill watermark and
+        # fault back in at delivery time — see _maybe_spill/_unspill
+        self.spill = None
+        if cfg.spill_dir is not None:
+            from adlb_tpu.runtime.spill import SpillStore
+
+            self.spill = SpillStore(cfg.spill_dir, self.rank)
         # lease per pinned unit (owner rank, lease id, grant time): under
         # on_worker_failure="reclaim" a dead owner's leases turn back into
         # queued work instead of blocking exhaustion forever
@@ -516,6 +524,13 @@ class Server:
         self._g_lease_age = self.metrics.gauge("lease_age_max_s")
         self._g_quarantined = self.metrics.gauge("quarantined")
         self._g_mem_pressure = self.metrics.gauge("mem_pressure")
+        # spill tier (Config(spill_dir)): bytes/units currently on disk,
+        # spill-out and fault-in counts, and fault-in latency
+        self._m_spills = self.metrics.counter("spill_outs")
+        self._m_faultins = self.metrics.counter("spill_faultins")
+        self._g_spill_bytes = self.metrics.gauge("spill_bytes")
+        self._g_spill_units = self.metrics.gauge("spill_units")
+        self._h_faultin = self.metrics.histogram("spill_faultin_s")
         # failover surface (on_server_failure="failover")
         self._m_server_dead = self.metrics.counter("server_dead")
         self._m_failover_promoted = self.metrics.counter("failover_promoted")
@@ -656,8 +671,10 @@ class Server:
     @staticmethod
     def _make_wq(cfg: Config):
         """Pick the work-queue implementation: C++ core (ctypes) when wanted
-        and buildable, else the pure-Python indexed queue."""
-        if cfg.native_queues == "off":
+        and buildable, else the pure-Python indexed queue. The spill tier
+        forces the Python queue: spilling swaps a unit's payload residency
+        in place, which the C++ core's unit storage cannot express."""
+        if cfg.native_queues == "off" or cfg.spill_dir is not None:
             return WorkQueue()
         try:
             from adlb_tpu.native.wq import NativeWorkQueue
@@ -704,6 +721,8 @@ class Server:
                 except OSError:
                     pass
                 self.wal.close()
+            if self.spill is not None:
+                self.spill.close()
             if self._balancer is not None:
                 self._balancer.stop()
                 # bounded join: a straggler round finishing after teardown
@@ -921,6 +940,9 @@ class Server:
                 self.rq.oldest_age(now, stream_idle=self._stream_idle)
             )
             self._g_mem_pressure.set(self.mem.pressure)
+            if self.spill is not None:
+                self._g_spill_bytes.set(self.mem.spilled)
+                self._g_spill_units.set(len(self.spill))
             self._g_leases.set(len(self.leases))
             self._g_lease_age.set(self.leases.oldest_age(now))
             self._g_quarantined.set(len(self.quarantine))
@@ -958,7 +980,12 @@ class Server:
             else:
                 self._broadcast_qmstat()
             if self.mem.under_pressure:
-                self._try_push()
+                # spill tier first (local disk beats shipping bytes to a
+                # peer); pushes remain for what spilling cannot absorb
+                if self.spill is not None:
+                    self._maybe_spill()
+                if self.mem.under_pressure:
+                    self._try_push()
         if self.is_master and self.cfg.balancer == "tpu":
             self._flush_hungry_shrink(now)
         if self.is_master and now >= self._next_exhaust_check:
@@ -980,6 +1007,12 @@ class Server:
     def _pin(self, seqno: int, rank: int) -> None:
         """Pin + lease: every reservation handed out is owned, so a dead
         owner's pins are findable in O(its leases) at reclaim time."""
+        if self.spill is not None:
+            # delivery needs the bytes: fault a spilled payload in at
+            # reservation time (covers fused, handle, RFR, plan paths)
+            unit = self.wq.get(seqno)
+            if unit is not None and unit.spilled:
+                self._unspill(unit)
         self.wq.pin(seqno, rank)
         self.leases.grant(seqno, rank)
         if self.wlog is not None:
@@ -1059,6 +1092,98 @@ class Server:
                                  op="credit")
         self.flight.record(f"lease_reclaimed seqno={unit.seqno} (undelivered)")
         self._m_leases_reclaimed.inc()
+
+    # ------------------------------------------------------- spill tier
+    # Config(spill_dir): above the spill watermark, cold/large parked
+    # payloads move to the per-server spill file (runtime/spill.py) and
+    # only metadata stays resident; every path that reads payload bytes
+    # (pin->deliver, push, migrate, checkpoint, quarantine) faults them
+    # back in first. The accountant tracks resident vs spilled bytes, so
+    # watermarks/pushes/admission act on real RAM occupancy.
+
+    def _spill_unit(self, unit) -> None:
+        n = len(unit.payload)
+        self.spill.put(unit.seqno, unit.payload)
+        # remove/re-add so the queue's byte accounting and indexes track
+        # the residency change (the heaps tolerate the duplicate entry)
+        self.wq.remove(unit.seqno)
+        unit.payload = b""
+        unit.spilled = True
+        unit.spill_len = n
+        self.wq.add(unit)
+        self.mem.note_spill(n)
+        self._m_spills.inc()
+
+    def _unspill(self, unit) -> None:
+        """Fault a spilled payload back in (transparent to callers)."""
+        if self.spill is None or not unit.spilled:
+            return
+        t0 = time.monotonic()
+        payload = self.spill.take(unit.seqno)
+        in_wq = self.wq.get(unit.seqno) is unit
+        if in_wq:
+            self.wq.remove(unit.seqno)
+        unit.payload = payload
+        unit.spilled = False
+        unit.spill_len = 0
+        if in_wq:
+            self.wq.add(unit)
+        self.mem.note_faultin(len(payload))
+        self._m_faultins.inc()
+        self._h_faultin.observe(time.monotonic() - t0)
+
+    def _spill_drop(self, unit) -> None:
+        """A spilled unit is being dropped outright (dead target, killed
+        job): release its spill-file entry and accounting."""
+        if self.spill is not None and unit.spilled:
+            self.mem.note_spill_drop(self.spill.discard(unit.seqno))
+            unit.spilled = False
+            unit.spill_len = 0
+
+    def _maybe_spill(self, incoming: int = 0) -> None:
+        """Move cold parked payloads to disk until ``incoming`` more
+        bytes fit under the spill watermark. Victims are unpinned
+        resident payloads, largest first (fewest records for the most
+        relief), oldest first among equals (cold before hot). O(wq)
+        scan — runs only above the watermark, where the alternative is
+        backpressure."""
+        if self.spill is None or self.mem.max_bytes <= 0:
+            return
+        frac = self.cfg.spill_watermark_frac or self.mem.soft_frac
+        need = self.mem.curr + incoming - frac * self.mem.max_bytes
+        if need <= 0:
+            return
+        # top-K by (size desc, age) instead of a full sort: the scan is
+        # already O(wq) per call under sustained pressure, and K=64
+        # victims per pass cover any realistic per-put deficit (a
+        # size-ordered resident index is the follow-up if profiles ever
+        # show this pass on top)
+        import heapq as _heapq
+
+        cands = _heapq.nsmallest(
+            64,
+            (
+                (-len(u.payload), u.time_stamp, u.seqno, u)
+                for u in self.wq.units()
+                if not u.pinned and not u.spilled and len(u.payload) > 0
+            ),
+        )
+        freed = 0
+        for _nlen, _ts, _sq, u in cands:
+            if freed >= need:
+                break
+            freed += len(u.payload)
+            self._spill_unit(u)
+
+    def _spill_fault_in_all(self) -> None:
+        """Restore every spilled payload (checkpoint shards and WAL
+        compaction snapshots serialize payload bytes; a transient
+        resident spike beats silently checkpointing empty payloads)."""
+        if self.spill is None:
+            return
+        for u in list(self.wq.units()):
+            if u.spilled:
+                self._unspill(u)
 
     def _least_loaded_peer(self, nbytes_needed: int = 0) -> int:
         """Least-loaded peer believed to have room for nbytes_needed, else
@@ -1338,6 +1463,7 @@ class Server:
     def _write_checkpoint_shard(self, prefix: str) -> int:
         from adlb_tpu.runtime import checkpoint
 
+        self._spill_fault_in_all()  # shards serialize payload bytes
         return checkpoint.save_shard(prefix, self.rank, self.wq.units(),
                                      self.cq, world=self.world)
 
@@ -1517,6 +1643,12 @@ class Server:
             <= ADLB_LOWEST_PRIO
         )
         payload: bytes = m.payload
+        if self.spill is not None:
+            # spill tier: make room from cold parked payloads BEFORE the
+            # watermark checks, so a put storm over the soft watermark
+            # degrades to slower-fetch (spilled cold units) instead of
+            # ADLB_BACKOFF / ADLB_PUT_REJECTED
+            self._maybe_spill(len(payload))
         if (
             m.target_rank < 0
             and self.mem.above_hard(len(payload))
@@ -1652,6 +1784,8 @@ class Server:
                 self._broadcast_qmstat()
 
     def _on_put_common(self, m: Msg) -> None:
+        if self.spill is not None:
+            self._maybe_spill(len(m.payload))
         if not self.mem.try_alloc(len(m.payload)):
             self.ep.send(
                 m.src,
@@ -2416,7 +2550,7 @@ class Server:
             if s == self.rank:
                 continue
             cap = self.cfg.max_malloc_per_server
-            if cap <= 0 or st.nbytes + len(unit.payload) <= 0.9 * cap:
+            if cap <= 0 or st.nbytes + unit.payload_len <= 0.9 * cap:
                 if target is None or st.nbytes < self.peers[target].nbytes:
                     target = s
         if target is None:
@@ -2431,7 +2565,7 @@ class Server:
                 Tag.SS_PUSH_QUERY,
                 self.rank,
                 query_id=qid,
-                nbytes=len(unit.payload),
+                nbytes=unit.payload_len,
             ),
         ) is None:
             self._push_offered.pop(qid, None)
@@ -2461,6 +2595,7 @@ class Server:
                 m.src, msg(Tag.SS_PUSH_DEL, self.rank, query_id=m.query_id)
             )
             return
+        self._unspill(unit)  # shipping needs the bytes
         self.wq.remove(seqno)
         self.mem.free(len(unit.payload))
         if self.wlog is not None:
@@ -2686,7 +2821,7 @@ class Server:
                 tasks = _heapq.nsmallest(
                     K,
                     (
-                        (-u.prio, u.seqno, u.work_type, len(u.payload))
+                        (-u.prio, u.seqno, u.work_type, u.payload_len)
                         for u in self.wq.units()
                         if not u.pinned and u.target_rank < 0
                         and getattr(u, "job", 0) == 0
@@ -2793,10 +2928,11 @@ class Server:
         to the balancer at one unit per gap — a 30x-lagging inventory
         view that kept the pump's scarcity gate closed while whole worker
         pools idled (the round-3 hotspot startup stall)."""
-        # len(payload), NOT unit.work_len (payload + common prefix): full
+        # payload bytes, NOT unit.work_len (payload + common prefix): full
         # snapshots record payload bytes, and the planner's admission math
-        # compares against payload-only memory accounting
-        nlen = len(unit.payload)
+        # compares against payload-only memory accounting (spill-aware:
+        # a spilled unit's true size, not its empty resident stub)
+        nlen = unit.payload_len
         if self.is_master:
             self._merge_task_delta(
                 self.rank, [unit.seqno], [unit.work_type], [unit.prio],
@@ -2983,6 +3119,7 @@ class Server:
             unit = self.wq.get(seqno)
             if unit is None or unit.pinned or unit.target_rank >= 0:
                 continue  # stale plan entry
+            self._unspill(unit)  # shipping needs the bytes
             self.wq.remove(seqno)
             self.mem.free(len(unit.payload))
             if self.wlog is not None:
@@ -3612,6 +3749,7 @@ class Server:
         """Move a unit to the dead-letter store: out of the wq (settled
         for exhaustion voting — termination never hangs on a poison
         unit), counted exactly-once, payload retained for retrieval."""
+        self._unspill(unit)  # the dead-letter record keeps the payload
         if in_wq:
             self.wq.remove(unit.seqno)
             self.leases.release(unit.seqno)
@@ -3955,6 +4093,7 @@ class Server:
         elif op == "kill":
             dropped = self.wq.drop_job(jid)
             for u in dropped:
+                self._spill_drop(u)
                 self.mem.free(len(u.payload))
                 self.leases.release(u.seqno)
                 self._relay_inflight.pop(u.seqno, None)
@@ -4275,6 +4414,7 @@ class Server:
         for u in doomed:
             self.wq.remove(u.seqno)
             self.leases.release(u.seqno)
+            self._spill_drop(u)
             self.mem.free(len(u.payload))
             if self.wlog is not None:
                 self.wlog.log_remove(u.seqno)

@@ -25,7 +25,7 @@ from typing import Optional
 from adlb_tpu.runtime.codec import (
     decode_binary,
     encodable,
-    encode_binary,
+    encode_binary_iov,
     loads_restricted,
 )
 from adlb_tpu.runtime.messages import Msg, Tag
@@ -70,6 +70,13 @@ class TcpEndpoint:
         self._rx_stats: dict = {}
         self._h_send = None  # send_s / recv_wait_s histograms, cached on
         self._h_recv = None  # first use (hot path: no per-message lookup)
+        # shm-fabric hooks (transport_shm.py): ``notify`` fires after
+        # every inbox delivery so a recv blocked on the shm doorbell
+        # wakes for TCP traffic too; ``shm_ctl`` receives the swallowed
+        # SHM_HELLO frames (ring-attach announcements). Both None when
+        # no shm wrapper is stacked on this endpoint.
+        self.notify = None
+        self.shm_ctl = None
 
         host, port = self.addr_map[rank]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -148,6 +155,15 @@ class TcpEndpoint:
                         # than silently dropping a frame someone awaits
                         return
                 last_src = m.src
+                if m.tag is Tag.SHM_HELLO:
+                    # ring-attach announcement: record the sender (this
+                    # connection is now the pair's death sentinel — its
+                    # EOF synthesizes PEER_EOF below) and hand the frame
+                    # to the shm wrapper instead of the role's inbox
+                    ctl = self.shm_ctl
+                    if ctl is not None:
+                        ctl(m)
+                    continue
                 reg = self.metrics
                 if reg is not None:
                     st = self._rx_stats.get(m.tag)
@@ -161,6 +177,9 @@ class TcpEndpoint:
                     # with its peers' tx_bytes (which count the frame)
                     st[1].inc(_HDR.size + len(body))
                 self.inbox.put(m)
+                cb = self.notify
+                if cb is not None:
+                    cb()
         except OSError:
             return
         finally:
@@ -170,6 +189,9 @@ class TcpEndpoint:
             # src/adlb.c:2508-2526; a silent EOF here would hang instead)
             if last_src is not None and not self._closed:
                 self.inbox.put(Msg(tag=Tag.PEER_EOF, src=last_src))
+                cb = self.notify
+                if cb is not None:
+                    cb()
             conn.close()
 
     @staticmethod
@@ -209,10 +231,13 @@ class TcpEndpoint:
                     f"message {m.tag} carries fields outside the binary "
                     f"codec but rank {dest} is a native (non-pickle) client"
                 )
-            body = encode_binary(m)
+            # scatter-gather encode: the payload views ride the iovec
+            # straight into sendmsg — no body-concat copy on the hot path
+            parts = encode_binary_iov(m)
         else:
-            body = pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)
-        hdr = _HDR.pack(len(body))
+            parts = [pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)]
+        nbody = sum(len(p) for p in parts)
+        frame = [_HDR.pack(nbody), *parts]
         reg = self.metrics
         t0 = time.monotonic() if reg is not None else 0.0
         # per-destination serialization: a slow/dead peer (15 s connect
@@ -227,7 +252,7 @@ class TcpEndpoint:
                 with self._out_lock:
                     self._out[dest] = sock
             try:
-                self._send_frame(sock, hdr, body)
+                self._send_iov(sock, frame)
             except OSError:
                 # one reconnect attempt (a FRESH stream, so restarting the
                 # frame from its first byte is safe); beyond that the
@@ -235,7 +260,7 @@ class TcpEndpoint:
                 sock = self._connect(dest, connect_grace)
                 with self._out_lock:
                     self._out[dest] = sock
-                self._send_frame(sock, hdr, body)
+                self._send_iov(sock, frame)
         if reg is not None:
             st = self._tx_stats.get(m.tag)
             if st is None:
@@ -244,7 +269,7 @@ class TcpEndpoint:
                     reg.counter("tx_bytes", tag=m.tag.name),
                 )
             st[0].inc()
-            st[1].inc(len(hdr) + len(body))
+            st[1].inc(_HDR.size + nbody)
             # whole-path send latency: serialization wait + (re)connect +
             # kernel buffer admission — the "how backed up is this peer"
             # signal the reference reads off MPI's unexpected queue
@@ -253,24 +278,39 @@ class TcpEndpoint:
             self._h_send.observe(time.monotonic() - t0)
 
     @staticmethod
-    def _send_frame(sock: socket.socket, hdr: bytes, body: bytes) -> None:
-        """Write one length-prefixed frame as a gather (writev-style) send
-        instead of materializing ``hdr + body`` — the old concat copied
-        every payload once more per hop, a measurable tax on the
-        work-delivery data plane. Short writes (kernel buffer full) fall
-        back to sendall on the remainder."""
+    def _send_iov(sock: socket.socket, parts: list) -> None:
+        """Write one frame as a gather (writev-style) send over an
+        arbitrary iovec instead of materializing a concatenated body —
+        the old concat copied every payload once more per hop, a
+        measurable tax on the work-delivery data plane. A short write
+        (kernel buffer full) RESUMES the iovec at the unsent offset:
+        the remainder re-gathers into the next sendmsg, so large frames
+        never fall back to a concat copy either."""
+        # Linux IOV_MAX is 1024 segments; a batched fused fetch can carry
+        # more payload views than that — split into sequential gathers
+        # (the caller holds the per-destination lock, so the frame stays
+        # contiguous on the stream)
+        while len(parts) > 1000:
+            head, parts = parts[:1000], parts[1000:]
+            TcpEndpoint._send_iov(sock, head)
         try:
-            sent = sock.sendmsg([hdr, body])
+            sent = sock.sendmsg(parts)
         except (AttributeError, NotImplementedError):  # platform without
-            sock.sendall(hdr + body)  # sendmsg: keep the old copy path
+            for p in parts:  # sendmsg: plain per-segment writes
+                sock.sendall(p)
             return
-        if sent >= len(hdr) + len(body):
-            return
-        if sent < len(hdr):
-            sock.sendall(hdr[sent:])
-            sock.sendall(body)
-        else:
-            sock.sendall(memoryview(body)[sent - len(hdr):])
+        total = sum(len(p) for p in parts)
+        while sent < total:
+            total -= sent
+            rest = []
+            for p in parts:
+                if sent >= len(p):
+                    sent -= len(p)
+                    continue
+                rest.append(memoryview(p)[sent:] if sent else p)
+                sent = 0
+            parts = rest
+            sent = sock.sendmsg(parts)
 
     def backlog(self) -> int:
         """Received-but-unhandled frames — the TCP-era analogue of the
@@ -473,7 +513,8 @@ def _native_server_main(rank, world, cfg, port_q, conn, result_q, abort_event):
         report("error", repr(e))
 
 
-def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
+def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event,
+                shm_key=None):
     """One rank's process body: bind, rendezvous, run role, report result.
 
     Exactly one message goes on result_q per rank — the parent counts ranks,
@@ -499,6 +540,13 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event):
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
     ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)}, binary_peers=binary_peers)
+    if shm_key:
+        # same-host ranks upgrade to the shared-memory ring fabric; the
+        # fault shim stacks OUTSIDE it, so injected faults apply to ring
+        # traffic exactly as to TCP traffic
+        from adlb_tpu.runtime.transport_shm import ShmEndpoint
+
+        ep = ShmEndpoint(ep, shm_key, ring_bytes=cfg.shm_ring_bytes)
     if cfg.fault_spec:
         from adlb_tpu.runtime.faults import maybe_wrap
 
@@ -593,6 +641,18 @@ def spawn_world(
         types=tuple(types),
         use_debug_server=use_debug_server,
     )
+    # fabric negotiation: spawn_world ranks are same-host by
+    # construction, so the resolved "shm" fabric upgrades every
+    # python<->python pair to rings under one fresh world key (native
+    # daemon ranks negotiate down to TCP per pair inside the endpoint)
+    from adlb_tpu.runtime.transport_shm import (
+        cleanup_world,
+        new_world_key,
+        resolve_fabric,
+    )
+
+    shm_key = new_world_key() if resolve_fabric(cfg) == "shm" else None
+
     ctx = mp.get_context(start_method)
     port_q = ctx.Queue()
     result_q = ctx.Queue()
@@ -605,7 +665,7 @@ def spawn_world(
         p = ctx.Process(
             target=_child_main,
             args=(rank, world, cfg, app_fn, port_q, child_end, result_q,
-                  abort_event),
+                  abort_event, shm_key),
             name=f"adlb-rank-{rank}",
         )
         procs[rank] = p
@@ -662,6 +722,7 @@ def spawn_world(
             from adlb_tpu.balancer.sidecar import stop_sidecar
 
             stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
+        cleanup_world(shm_key)
         raise
 
     app_results, server_stats = {}, {}
@@ -743,6 +804,9 @@ def spawn_world(
         from adlb_tpu.balancer.sidecar import stop_sidecar
 
         stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
+    # every child is gone: sweep ring segments/FIFOs whose owners died
+    # without unlinking (SIGKILL chaos legs would otherwise leak them)
+    cleanup_world(shm_key)
 
     # a rank losing its home server is abort COLLATERAL when some rank
     # REALLY aborted the world (the server may close its listener before

@@ -220,6 +220,11 @@ class WriteAheadLog(ReplicationLog):
         from adlb_tpu.runtime import checkpoint
 
         gen = self.generation + 1
+        # spill tier: the snapshot shard serializes payload bytes, so
+        # any spilled payloads must be resident first
+        fault_in = getattr(server, "_spill_fault_in_all", None)
+        if fault_in is not None:
+            fault_in()
         units = list(server.wq.units())
         checkpoint.save_shard(
             snap_prefix(self.dir, self.rank, gen), self.rank, units,

@@ -295,6 +295,32 @@ class Config:
     # server work-queue implementation: "auto" uses the C++ core when it
     # builds, falling back to the pure-Python queues; "on" requires it
     native_queues: str = "auto"
+    # process-world transport fabric (spawn_world / launch.py / joined
+    # clients; in-proc thread worlds always use the queue fabric):
+    # "auto" upgrades same-host rank pairs to the shared-memory ring
+    # fabric (adlb_tpu/runtime/transport_shm.py) whenever the host can
+    # run it (honoring the ADLB_FABRIC env override — the CI shm leg's
+    # hook), with cross-host pairs staying on TCP; "shm" forces the ring
+    # fabric (same-host pairs only — others still fall back to TCP);
+    # "tcp" disables the upgrade entirely.
+    fabric: str = "auto"
+    # per-direction ring capacity per connected pair; frames larger than
+    # the ring stream through it, so this bounds /dev/shm footprint
+    # (pairs x 2 x this), not payload size. 1 MiB keeps a 2 MiB payload
+    # to two backpressure cycles while a 16-app/4-server world still
+    # maps under 150 MiB of (reclaimable) tmpfs
+    shm_ring_bytes: int = 1 << 20
+    # ---- disk spill tier (adlb_tpu/runtime/spill.py) ----
+    # directory for the per-server payload spill file: above the spill
+    # watermark, cold/large parked payloads move to disk (crc-framed,
+    # the WAL's record format) and fault back in transparently at
+    # delivery time — memory pressure degrades to slower-fetch instead
+    # of ADLB_BACKOFF/ADLB_PUT_REJECTED. None = off (reference
+    # semantics). Python servers only.
+    spill_dir: Optional[str] = None
+    # fraction of max_malloc_per_server above which spilling engages;
+    # 0 = track mem_soft_frac (the PR 5 soft watermark)
+    spill_watermark_frac: float = 0.0
     # server reactor implementation (spawn_world / TCP worlds only):
     # "python" runs adlb_tpu.runtime.server.Server per server rank; "native"
     # runs the C++ daemon (adlb_tpu/native/serverd.cpp) — the reference's
@@ -317,6 +343,24 @@ class Config:
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
         if self.qmstat_mode not in ("broadcast", "ring"):
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
+        if self.fabric not in ("auto", "shm", "tcp"):
+            raise ValueError(f"unknown fabric {self.fabric!r}")
+        if self.shm_ring_bytes < 4096:
+            raise ValueError("shm_ring_bytes must be >= 4096")
+        if not (0.0 <= self.spill_watermark_frac <= 1.0):
+            raise ValueError("spill_watermark_frac must be in [0, 1]")
+        if self.spill_dir is not None and self.server_impl == "native":
+            # the C++ daemon has no spill store; its capacity story is
+            # the reference admission control only
+            raise ValueError("spill_dir requires server_impl='python'")
+        if self.spill_dir is not None and self.native_queues == "on":
+            # the spill tier swaps payload residency in place, which the
+            # C++ queue core cannot express; an explicit 'on' must fail
+            # loudly rather than silently losing the native core
+            raise ValueError(
+                "spill_dir requires the Python work queue "
+                "(native_queues='auto' or 'off')"
+            )
         if self.on_worker_failure not in ("abort", "reclaim"):
             raise ValueError(
                 f"unknown on_worker_failure {self.on_worker_failure!r}"

@@ -43,8 +43,19 @@ def run(
     work_time: float = 0.0,
     cfg: Optional[Config] = None,
     timeout: float = 180.0,
+    spawn: bool = False,
+    consumer: str = "classic",
 ) -> CoinopResult:
+    """``spawn=True`` runs real processes over spawn_world — the shape
+    that exercises the process fabrics (``Config(fabric)``: shm rings vs
+    TCP); the default in-proc thread world measures the queue fabric.
+    ``consumer="batch:N"`` pops through the batched fused get_work
+    (per-pop latency amortizes the round trip over the batch — the
+    framework's own best consumer path, as in the native bench rows);
+    "classic" keeps the reference's two-call Reserve+Get loop."""
     payload = b"c" * token_bytes
+    batch = int(consumer.split(":")[1]) if consumer.startswith("batch") \
+        else 0
 
     def app(ctx):
         if ctx.rank == 0:
@@ -56,6 +67,18 @@ def run(
         lats = []
         stats = RunningStats(f"pop-latency-rank{ctx.rank}")
         stats.on()
+        if batch > 0:
+            while True:
+                t0 = time.monotonic()
+                rc, units = ctx.get_work_batch([TOKEN], max_units=batch)
+                if rc != ADLB_SUCCESS or not units:
+                    return lats, stats.mean, stats.stddev
+                dt = (time.monotonic() - t0) / len(units)
+                for _ in units:
+                    lats.append(dt)
+                    stats.enter(dt)
+                    if work_time > 0:
+                        time.sleep(work_time)
         while True:
             t0 = time.monotonic()
             rc, r = ctx.reserve([TOKEN])
@@ -69,14 +92,26 @@ def run(
                 time.sleep(work_time)
 
     t0 = time.monotonic()
-    res = run_world(
-        num_app_ranks,
-        nservers,
-        [TOKEN],
-        app,
-        cfg=cfg or Config(exhaust_check_interval=0.25),
-        timeout=timeout,
-    )
+    if spawn:
+        from adlb_tpu.runtime.transport_tcp import spawn_world
+
+        res = spawn_world(
+            num_app_ranks,
+            nservers,
+            [TOKEN],
+            app,
+            cfg=cfg or Config(exhaust_check_interval=0.25),
+            timeout=timeout,
+        )
+    else:
+        res = run_world(
+            num_app_ranks,
+            nservers,
+            [TOKEN],
+            app,
+            cfg=cfg or Config(exhaust_check_interval=0.25),
+            timeout=timeout,
+        )
     elapsed = time.monotonic() - t0
     all_lats = sorted(
         lat for rank, (lats, _m, _s) in res.app_results.items()

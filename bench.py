@@ -1058,6 +1058,169 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         service_rows = {"service_error": repr(e)[:200]}
 
+    # shm ring fabric + spill tier (round 7, ROADMAP item 4): pop
+    # latency over REAL PROCESSES on the ring fabric vs the identical
+    # world on TCP (paired interleaved reps), the >1 MiB payload put
+    # row, and the spill tier's fault-in latency + the put-storm
+    # acceptance (0 backoffs over a hard-watermarked cap when spill_dir
+    # is set, every payload byte-identical). Own containment. NOTE for
+    # cross-round reads: on this single-core dev box every cross-process
+    # hop pays a scheduler wakeup, so absolute latencies here are
+    # scheduling-bound — the fabric's syscall/copy savings show in the
+    # batched-consumer row and the large-payload row, and fully on
+    # multi-core hosts (the in-proc coinop rows above remain the
+    # single-host thread-fabric continuity metric).
+    def shm_bench():
+        import hashlib
+        import shutil
+        import struct as _struct
+        import tempfile
+
+        from adlb_tpu.runtime.transport_shm import shm_available
+        from adlb_tpu.runtime.transport_tcp import spawn_world as _sw
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        if not shm_available():
+            return {"shm_note": "no usable /dev/shm; shm rows skipped"}
+
+        def coin_spawn(fabric, consumer="classic"):
+            return coinop.run(
+                n_tokens=400, num_app_ranks=4, nservers=2,
+                cfg=Config(fabric=fabric, exhaust_check_interval=0.25),
+                timeout=180.0, spawn=True, consumer=consumer,
+            )
+
+        runs = interleaved(lambda f: coin_spawn(f), modes=("shm", "tcp"))
+        shm_med = median_by(runs["shm"], key=lambda r: r.latency_p50_ms)
+        tcp_med = median_by(runs["tcp"], key=lambda r: r.latency_p50_ms)
+        rows = {
+            "coinop_shm_p50_ms": round(shm_med.latency_p50_ms, 3),
+            "coinop_spawn_tcp_p50_ms": round(tcp_med.latency_p50_ms, 3),
+            "coinop_shm_p95_ms": round(shm_med.latency_p95_ms, 3),
+            "coinop_spawn_tcp_p95_ms": round(tcp_med.latency_p95_ms, 3),
+            "coinop_shm_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["shm"]],
+            "coinop_spawn_tcp_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["tcp"]],
+        }
+        # the framework's own best consumer path on the ring fabric:
+        # batched fused fetch amortizes the scheduler round trip
+        bat = [coin_spawn("shm", consumer="batch:8") for _ in range(3)]
+        bmed = median_by(bat, key=lambda r: r.latency_p50_ms)
+        rows["coinop_shm_batch8_p50_ms"] = round(bmed.latency_p50_ms, 3)
+
+        # >1 MiB payload put latency (acked round trip), shm vs tcp —
+        # the scatter-gather encode + ring streaming vs loopback TCP
+        PAY = 2 << 20
+        N_BIG = 24
+
+        def big_app(ctx):
+            if ctx.rank == 0:
+                lats = []
+                blob = b"P" * PAY
+                for _i in range(N_BIG):
+                    t0 = time.monotonic()
+                    assert ctx.put(blob, 1) == _OK
+                    lats.append(time.monotonic() - t0)
+                return lats
+            n = 0
+            while True:
+                rc, w = ctx.get_work([1])
+                if rc != _OK:
+                    return n
+                assert len(w.payload) == PAY
+                n += 1
+
+        def big_one(fabric):
+            res = _sw(2, 1, [1], big_app,
+                      cfg=Config(fabric=fabric,
+                                 exhaust_check_interval=0.25),
+                      timeout=180.0)
+            lats = sorted(res.app_results[0])
+            assert sum(v for k, v in res.app_results.items()
+                       if k != 0) == N_BIG
+            return lats[len(lats) // 2] * 1e3
+
+        big = interleaved(lambda f: big_one(f), modes=("shm", "tcp"))
+        rows["put_large_p50_ms_shm"] = round(median_by(big["shm"]), 2)
+        rows["put_large_p50_ms_tcp"] = round(median_by(big["tcp"]), 2)
+        rows["put_large_payload_mib"] = PAY >> 20
+
+        # spill tier: store-level fault-in latency for 1 MiB payloads
+        from adlb_tpu.runtime.spill import SpillStore
+
+        sdir = tempfile.mkdtemp(prefix="adlb-bench-spill-")
+        try:
+            store = SpillStore(sdir, 0)
+            blob = os.urandom(1 << 20)
+            for i in range(32):
+                store.put(i, blob)
+            lats = []
+            for i in range(32):
+                t0 = time.monotonic()
+                got = store.take(i)
+                lats.append(time.monotonic() - t0)
+                assert got == blob
+            store.close()
+            lats.sort()
+            rows["spill_faultin_ms"] = round(lats[len(lats) // 2] * 1e3, 3)
+
+            # acceptance storm: ~240 KiB of puts through a 64 KiB
+            # hard-watermarked cap WITH spill_dir — must complete with
+            # zero ADLB_BACKOFF and byte-identical fetch-back
+            N_STORM, SPAY = 60, 4096
+
+            def storm_app(ctx):
+                if ctx.rank == 0:
+                    sent = {}
+                    for i in range(N_STORM):
+                        p = _struct.pack("<q", i) + hashlib.sha256(
+                            str(i).encode()).digest() * (SPAY // 32)
+                        assert ctx.put(p, 1) == _OK
+                        sent[i] = hashlib.sha256(p).hexdigest()
+                    return {"sent": sent,
+                            "backoffs":
+                            ctx._c.metrics.value("put_backoffs"),
+                            "retries":
+                            ctx._c.metrics.value("put_retries")}
+                got = {}
+                while True:
+                    rc, w = ctx.get_work([1])
+                    if rc != _OK:
+                        return got
+                    i = _struct.unpack("<q", w.payload[:8])[0]
+                    got[i] = hashlib.sha256(w.payload).hexdigest()
+                    time.sleep(0.002)
+
+            res = _sw(3, 2, [1], storm_app,
+                      cfg=Config(max_malloc_per_server=64 << 10,
+                                 mem_soft_frac=0.7, mem_hard_frac=0.8,
+                                 spill_dir=sdir,
+                                 exhaust_check_interval=0.25),
+                      timeout=180.0)
+            prod = res.app_results[0]
+            got = {}
+            for r, v in res.app_results.items():
+                if r != 0:
+                    got.update(v)
+            rows.update(
+                spill_storm_units=N_STORM,
+                spill_storm_consumed=len(got),
+                spill_storm_backoffs=int(prod["backoffs"]),
+                spill_storm_retries=int(prod["retries"]),
+                spill_storm_byte_identical=all(
+                    got.get(i) == h for i, h in prod["sent"].items()
+                ),
+            )
+        finally:
+            shutil.rmtree(sdir, ignore_errors=True)
+        return rows
+
+    try:
+        shm_rows = shm_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        shm_rows = {"shm_error": repr(e)[:200]}
+
     # multichip planning-round latency at scale: the sharded balancer's
     # full round (snapshot-delta ingest -> sharded solve -> plan
     # extraction) at 1,000 servers / 100k parked requesters on an 8-way
@@ -1202,6 +1365,7 @@ def main() -> None:
             **failover_rows,
             **gray_rows,
             **service_rows,
+            **shm_rows,
             **plan_rows,
         },
     }
@@ -1324,6 +1488,19 @@ def main() -> None:
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),
                      round(lat_tpu.pops_per_sec, 1)],
+            # shm ring fabric (real processes): [shm, tcp, shm-batch:8]
+            # classic-consumer pop p50s; large-payload put [shm, tcp];
+            # spill fault-in latency and the storm acceptance counters
+            "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),
+                           shm_rows.get("coinop_spawn_tcp_p50_ms"),
+                           shm_rows.get("coinop_shm_batch8_p50_ms")],
+            "put_large": [shm_rows.get("put_large_p50_ms_shm"),
+                          shm_rows.get("put_large_p50_ms_tcp")],
+            "spill": [shm_rows.get("spill_faultin_ms")],
+            "storm": [shm_rows.get("spill_storm_backoffs"),
+                      shm_rows.get("spill_storm_retries"),
+                      1 if shm_rows.get("spill_storm_byte_identical")
+                      else 0],
             "ndisp_p50": [native_rows.get("native_trickle_p50_ms_steal"),
                           native_rows.get("native_trickle_p50_ms_tpu")],
             # on-chip solve scale (4096x512 / 16384x2048 pools, device

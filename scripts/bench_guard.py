@@ -52,6 +52,23 @@ GUARDS = {
     "plan_round": [
         ("1k", "plan_round_1k_ms"),
     ],
+    # shm ring fabric (r07 metrics; older baselines skip with a note):
+    # pop latency over real processes on the ring fabric vs the same
+    # world on TCP, classic two-call consumer + the batched path
+    "coinop_shm": [
+        ("shm", "coinop_shm_p50_ms"),
+        ("tcp", "coinop_spawn_tcp_p50_ms"),
+        ("shm-batch8", "coinop_shm_batch8_p50_ms"),
+    ],
+    # >1 MiB payload put latency, shm vs tcp (r07)
+    "put_large": [
+        ("shm", "put_large_p50_ms_shm"),
+        ("tcp", "put_large_p50_ms_tcp"),
+    ],
+    # spill tier: disk fault-in latency for a 1 MiB payload (r07)
+    "spill": [
+        ("faultin", "spill_faultin_ms"),
+    ],
 }
 
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"

@@ -14,7 +14,11 @@ or exhaustion vs explicit termination. Any wrong answer, hang
 (timeout), or unexpected exception stops the soak with the seed for
 replay.
 
-Usage: python scripts/chaos_soak.py <minutes> [seed0]
+Usage: python scripts/chaos_soak.py [--fabric shm|tcp|auto] <minutes> [seed0]
+
+``--fabric shm`` pins every spawn-plane world onto the shared-memory
+ring fabric (transport_shm.py), so the worker-kill / server-kill /
+stall / poison adversities all exercise peers dying mid-ring.
 
 First session of use found a real bug within minutes: a mid-run
 abort could be misclassified as a world failure when a tearing-down
@@ -289,7 +293,7 @@ def two_jobs_economy(n_units, poison=True):
     return app
 
 
-def one_iter(seed):
+def one_iter(seed, fabric=None):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
     servers = rng.randint(2, 4)
@@ -361,6 +365,11 @@ def one_iter(seed):
     kw = dict(balancer=mode, exhaust_check_interval=0.2,
               on_worker_failure=policy,
               on_server_failure=s_policy)
+    if fabric:
+        # --fabric shm: every spawn-plane world in the soak rides the
+        # shared-memory ring fabric, so the kill/stall/poison/server-kill
+        # adversities all exercise a peer dying mid-ring
+        kw["fabric"] = fabric
     if do_stall or do_poison:
         kw["on_worker_failure"] = g_policy
         kw["lease_timeout_s"] = rng.choice([0.8, 1.2])
@@ -550,8 +559,15 @@ def one_iter(seed):
 
 
 def main():
-    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
-    seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    args = list(sys.argv[1:])
+    fabric = None
+    if "--fabric" in args:
+        i = args.index("--fabric")
+        fabric = args[i + 1]
+        assert fabric in ("auto", "shm", "tcp"), fabric
+        del args[i:i + 2]
+    minutes = float(args[0]) if args else 10.0
+    seed0 = int(args[1]) if len(args) > 1 else 1000
     # every world in the soak writes flight-record post-mortems, so a
     # failure is diagnosable from artifacts instead of demanding a
     # replay (summarize with scripts/obs_report.py <dir>)
@@ -565,9 +581,10 @@ def main():
     while time.monotonic() < deadline:
         seed = seed0 + i
         try:
-            desc = one_iter(seed)
+            desc = one_iter(seed, fabric=fabric)
         except BaseException as e:
-            print(f"CHAOS FAIL seed={seed}: {e!r}", flush=True)
+            print(f"CHAOS FAIL seed={seed} fabric={fabric}: {e!r}",
+                  flush=True)
             print(f"flight records in {flight} "
                   f"(python scripts/obs_report.py {flight})", flush=True)
             raise

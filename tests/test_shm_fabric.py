@@ -1,0 +1,338 @@
+"""The shared-memory ring fabric (adlb_tpu/runtime/transport_shm.py).
+
+Four layers of coverage:
+
+* **Ring mechanics** — SPSC byte ring wraparound, streaming of frames
+  larger than the ring, occupancy accounting.
+* **Endpoint pair** — two ShmEndpoints in one process: pair upgrade via
+  the doorbell probe + SHM_HELLO, TLV and pickle bodies, metrics, and
+  the cross-channel EOF ordering fix (final ring frames must beat the
+  TCP-carried PEER_EOF).
+* **Fault-injection parity** — the seeded FaultPlan produces
+  byte-identical injected-event logs over all THREE fabrics (in-proc
+  queues, TCP, shm rings): decisions are a pure function of
+  (seed, rank, frame), never of transport.
+* **World acceptance** — spawn_world worlds with ``fabric="shm"``:
+  clean completion (incl. a >ring-size payload), and a worker SIGKILLed
+  mid-ring under ``on_worker_failure="reclaim"`` with leases reclaimed
+  and the world completing around the casualty.
+"""
+
+import os
+import signal
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.runtime.faults import FaultPlan, FaultyEndpoint
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_shm import (
+    ShmEndpoint,
+    ShmRing,
+    cleanup_world,
+    new_world_key,
+    shm_available,
+)
+from adlb_tpu.runtime.transport_tcp import TcpEndpoint, spawn_world
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+pytestmark = pytest.mark.skipif(
+    not shm_available(), reason="no usable /dev/shm on this host"
+)
+
+T = 1
+
+
+# --------------------------------------------------------------------- ring
+
+
+def test_ring_wraparound_and_occupancy():
+    key = new_world_key()
+    try:
+        w = ShmRing(f"{key}.a", 4096, create=True)
+        r = ShmRing(f"{key}.a")
+        # fill, drain, refill across the wrap point, several times
+        for rep in range(5):
+            blob = bytes([rep]) * 3000
+            mv = memoryview(blob)
+            n = w.write_some(mv)
+            assert 0 < n <= 3000
+            assert r.occupancy > 0
+            got = r.read_some()
+            assert got == blob[:n]
+            if n < len(blob):
+                assert w.write_some(mv[n:]) == len(blob) - n
+                assert r.read_some() == blob[n:]
+        assert r.avail() == 0 and w.occupancy == 0.0
+        r.close(unlink=False)
+        w.close()
+        assert not os.path.exists(w.path)
+    finally:
+        cleanup_world(key)
+
+
+def test_ring_full_returns_zero():
+    key = new_world_key()
+    try:
+        w = ShmRing(f"{key}.a", 4096, create=True)
+        assert w.write_some(memoryview(b"x" * 8192)) == w.cap
+        assert w.write_some(memoryview(b"y")) == 0  # full, not blocked
+        w.close()
+    finally:
+        cleanup_world(key)
+
+
+# ----------------------------------------------------------- endpoint pair
+
+
+def _pair(key, ring_bytes=64 << 10):
+    """Two shm endpoints in one process, rendezvous'd."""
+    a = ShmEndpoint(TcpEndpoint(0, {0: ("127.0.0.1", 0)}), key,
+                    ring_bytes=ring_bytes)
+    b = ShmEndpoint(TcpEndpoint(1, {1: ("127.0.0.1", 0)}), key,
+                    ring_bytes=ring_bytes)
+    a.addr_map.update(b.addr_map)
+    b.addr_map.update(a.addr_map)
+    return a, b
+
+
+def test_pair_upgrade_and_both_codecs():
+    key = new_world_key()
+    a, b = _pair(key)
+    try:
+        # TLV-able frame (hot path) and a pickle-only frame (dict token)
+        a.send(1, msg(Tag.FA_PUT, 0, payload=b"p" * 100, work_type=T,
+                      prio=3, target_rank=-1, answer_rank=-1))
+        a.send(1, msg(Tag.SS_PERIODIC_STATS, 0, token={"seq": 1}))
+        m1 = b.recv(timeout=5.0)
+        m2 = b.recv(timeout=5.0)
+        assert m1.tag is Tag.FA_PUT and bytes(m1.payload) == b"p" * 100
+        assert m1.prio == 3 and m1.work_type == T
+        assert m2.tag is Tag.SS_PERIODIC_STATS and m2.token == {"seq": 1}
+        # both frames rode the ring, not TCP
+        assert a.shm_frames_tx == 2
+        assert b.shm_frames_rx == 2
+        # reply direction upgrades independently
+        b.send(0, msg(Tag.TA_PUT_RESP, 1, rc=ADLB_SUCCESS, put_id=7))
+        r = a.recv(timeout=5.0)
+        assert r.tag is Tag.TA_PUT_RESP and r.rc == ADLB_SUCCESS
+        assert r.put_id == 7
+    finally:
+        a.close()
+        b.close()
+        cleanup_world(key)
+
+
+def test_pair_streams_frame_larger_than_ring():
+    key = new_world_key()
+    a, b = _pair(key, ring_bytes=16 << 10)
+    try:
+        big = os.urandom(1 << 20)  # 1 MiB through a 16 KiB ring
+        got = {}
+
+        import threading
+
+        def rx():
+            m = b.recv(timeout=30.0)
+            got["m"] = m
+
+        t = threading.Thread(target=rx)
+        t.start()
+        a.send(1, msg(Tag.FA_PUT, 0, payload=big, work_type=T, prio=0,
+                      target_rank=-1, answer_rank=-1))
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert bytes(got["m"].payload) == big
+    finally:
+        a.close()
+        b.close()
+        cleanup_world(key)
+
+
+def test_eof_never_overtakes_final_ring_frames():
+    """The peer's last ring frames are written before the close that
+    raises the TCP EOF; recv must deliver them BEFORE the synthetic
+    PEER_EOF even though the EOF entered the inbox first (the
+    cross-channel ordering fix — without it every clean finalize over
+    shm reads as 'died before finalize')."""
+    key = new_world_key()
+    a, b = _pair(key)
+    try:
+        for i in range(5):
+            a.send(1, msg(Tag.FA_PUT, 0, payload=struct.pack("<q", i),
+                          work_type=T, prio=0, target_rank=-1,
+                          answer_rank=-1))
+        a.close()  # EOF races the 5 undrained ring frames
+        seen = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            m = b.recv(timeout=0.5)
+            if m is None:
+                continue
+            seen.append(m.tag)
+            if m.tag is Tag.PEER_EOF:
+                break
+        assert seen.count(Tag.FA_PUT) == 5
+        assert seen[-1] is Tag.PEER_EOF
+        # and after the EOF, sends toward the dead peer fail like TCP's
+        with pytest.raises(OSError):
+            b.send(0, msg(Tag.TA_PUT_RESP, 1, rc=ADLB_SUCCESS))
+    finally:
+        b.close()
+        cleanup_world(key)
+
+
+# -------------------------------------------------- fault parity (3 fabrics)
+
+
+_SCRIPT_TAGS = [Tag.FA_PUT, Tag.FA_RESERVE, Tag.SS_QMSTAT, Tag.TA_PUT_RESP]
+
+
+def _drive_scripted(ep, spec, n=200):
+    plan = FaultPlan(spec, ep.rank)
+    fep = FaultyEndpoint(ep, plan)
+    for i in range(n):
+        fep.send(
+            1,
+            msg(_SCRIPT_TAGS[i % len(_SCRIPT_TAGS)], 0, payload=b"x" * 10,
+                work_type=1),
+        )
+    return plan.event_log()
+
+
+def test_fault_plan_identical_across_three_fabrics():
+    """drop/delay/duplicate schedules are byte-identical on the in-proc
+    queue fabric, the TCP fabric, and the shm ring fabric."""
+    spec = dict(seed=42, drop=0.15, delay=0.1, delay_s=0.0, duplicate=0.1)
+    logs = []
+    fabric = InProcFabric(2)
+    logs.append(_drive_scripted(fabric.endpoints[0], spec))
+    a = TcpEndpoint(0, {0: ("127.0.0.1", 0)})
+    b = TcpEndpoint(1, {1: ("127.0.0.1", 0)})
+    a.addr_map[1] = b.addr_map[1]
+    try:
+        logs.append(_drive_scripted(a, spec))
+    finally:
+        a.close()
+        b.close()
+    key = new_world_key()
+    sa, sb = _pair(key)
+    try:
+        logs.append(_drive_scripted(sa, spec))
+        assert sa.shm_frames_tx > 0, "scripted frames never rode the ring"
+    finally:
+        sa.close()
+        sb.close()
+        cleanup_world(key)
+    assert logs[0], "seeded plan injected nothing — test is vacuous"
+    assert logs[0] == logs[1] == logs[2]
+
+
+def test_disconnect_at_frame_over_shm():
+    """A fault-injected disconnect over the shm fabric: the endpoint
+    closes (peers see EOF), further sends raise OSError."""
+    key = new_world_key()
+    a, b = _pair(key)
+    try:
+        plan = FaultPlan(dict(seed=1, disconnect_at={0: 3}), 0)
+        fep = FaultyEndpoint(a, plan)
+        fep.send(1, msg(Tag.FA_PUT, 0, payload=b"1", work_type=T))
+        fep.send(1, msg(Tag.FA_PUT, 0, payload=b"2", work_type=T))
+        with pytest.raises(OSError):
+            fep.send(1, msg(Tag.FA_PUT, 0, payload=b"3", work_type=T))
+        tags = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            m = b.recv(timeout=0.5)
+            if m is None:
+                continue
+            tags.append(m.tag)
+            if m.tag is Tag.PEER_EOF:
+                break
+        assert tags.count(Tag.FA_PUT) == 2
+        assert tags[-1] is Tag.PEER_EOF
+    finally:
+        b.close()
+        cleanup_world(key)
+
+
+# -------------------------------------------------------- world acceptance
+
+
+def _echo_app(ctx):
+    big = b"B" * (1 << 20)
+    if ctx.rank == 0:
+        assert ctx.put(big, T) == ADLB_SUCCESS  # > ring size: streams
+        for i in range(30):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+    got, nbig = [], 0
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got, nbig
+        if len(w.payload) > 1000:
+            assert w.payload == big
+            nbig += 1
+        else:
+            got.append(struct.unpack("<q", w.payload)[0])
+
+
+@pytest.mark.parametrize("mode", ["steal", "tpu"])
+def test_shm_world_completes(mode):
+    res = spawn_world(
+        3, 2, [T], _echo_app,
+        cfg=Config(balancer=mode, fabric="shm", exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    done = sorted(x for v, _ in res.app_results.values() for x in v)
+    assert done == list(range(30))
+    assert sum(nb for _, nb in res.app_results.values()) == 1
+    assert not res.aborted
+
+
+def _kill_mid_ring(ctx):
+    if ctx.rank == 0:
+        for i in range(24):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+    n = 0
+    while True:
+        rc, r = ctx.reserve([T])
+        if rc != ADLB_SUCCESS:
+            return n
+        if ctx.rank == 1 and n >= 1:
+            # dies holding a lease, between reserve and fetch — the
+            # reclaim must recover the pinned unit over the ring fabric
+            os.kill(os.getpid(), signal.SIGKILL)
+        rc, buf = ctx.get_reserved(r.handle)
+        if rc != ADLB_SUCCESS:
+            continue
+        n += 1
+        time.sleep(0.004)
+
+
+def test_shm_worker_sigkill_mid_ring_reclaimed():
+    """chaos leg: a peer dying mid-ring (SIGKILL between reserve and
+    fetch) over the shm fabric — leases reclaimed, world completes
+    around the casualty, segments swept."""
+    import glob
+
+    before = set(glob.glob("/dev/shm/adlb*"))
+    res = spawn_world(
+        4, 2, [T], _kill_mid_ring,
+        cfg=Config(fabric="shm", on_worker_failure="reclaim",
+                   exhaust_check_interval=0.2),
+        timeout=90.0,
+    )
+    assert res.casualties == [1]
+    assert not res.aborted
+    # conservation: the victim consumed exactly 1 unit before dying; its
+    # reserved-but-unfetched unit was reclaimed and re-delivered
+    consumed = sum(v for k, v in res.app_results.items())
+    assert consumed == 24 - 1
+    # the world sweep left nothing NEW behind (scoped to this world:
+    # concurrent/previous worlds' teardown must not flake this)
+    leaked = set(glob.glob("/dev/shm/adlb*")) - before
+    assert not leaked, f"leaked shm artifacts: {sorted(leaked)}"

@@ -1,0 +1,245 @@
+"""The disk spill tier (adlb_tpu/runtime/spill.py + server hooks).
+
+* **Store mechanics** — crc-framed put/take byte-identity, corruption
+  detection, discard, dead-space compaction.
+* **Server residency lattice** — a handler-driven Server over a tiny
+  memory cap: puts over the watermark spill the coldest/largest parked
+  payloads (resident vs spilled accounting splits), delivery faults
+  them back in byte-identical, quarantine records fault in before
+  capturing the payload, and a dead targeted rank's spilled units
+  release their spill-file entries.
+* **Acceptance** — a put storm over the soft watermark against a
+  hard-watermarked cap completes with ZERO ADLB_BACKOFF when
+  ``spill_dir`` is set, every payload fetched back byte-identical.
+"""
+
+import hashlib
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.spill import SpillCorruption, SpillStore
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_SUCCESS
+
+T = 1
+
+
+# -------------------------------------------------------------------- store
+
+
+def test_store_roundtrip_and_discard(tmp_path):
+    s = SpillStore(str(tmp_path), 2)
+    blobs = {i: bytes([i]) * (100 + i) for i in range(8)}
+    for i, b in blobs.items():
+        s.put(i, b)
+    assert len(s) == 8 and s.live_bytes == sum(map(len, blobs.values()))
+    assert s.take(3) == blobs[3]
+    assert 3 not in s
+    assert s.discard(5) == len(blobs[5])
+    assert s.discard(5) == 0  # idempotent
+    for i in (0, 1, 2, 4, 6, 7):
+        assert s.take(i) == blobs[i]
+    assert s.live_bytes == 0
+    s.close()
+
+
+def test_store_detects_corruption(tmp_path):
+    s = SpillStore(str(tmp_path), 0)
+    s.put(7, b"payload-bytes" * 10)
+    # flip one byte of the record body on disk
+    with open(s.path, "r+b") as f:
+        f.seek(20)
+        c = f.read(1)
+        f.seek(20)
+        f.write(bytes([c[0] ^ 0xFF]))
+    with pytest.raises(SpillCorruption):
+        s.take(7)
+    s.close()
+
+
+def test_store_compacts_dead_space(tmp_path):
+    import os
+
+    s = SpillStore(str(tmp_path), 0)
+    blob = b"z" * (1 << 20)
+    for i in range(12):
+        s.put(i, blob)
+    for i in range(10):
+        s.take(i)  # 10 MiB dead vs 2 MiB live -> compaction triggers
+    assert s.compactions >= 1
+    assert os.path.getsize(s.path) < 4 * len(blob)
+    assert s.take(10) == blob and s.take(11) == blob  # index survived
+    s.close()
+
+
+# -------------------------------------------------- server residency lattice
+
+
+def _mini_server(tmp_path, cap=4096, **cfg_kw):
+    world = WorldSpec(nranks=4, nservers=2, types=(T,))
+    fabric = InProcFabric(4)
+    cfg = Config(max_malloc_per_server=cap, mem_soft_frac=0.5,
+                 spill_dir=str(tmp_path), **cfg_kw)
+    return Server(world, cfg, fabric.endpoint(2)), fabric
+
+
+def _put(srv, payload, src=0, target=-1):
+    srv._handle(msg(Tag.FA_PUT, src, payload=payload, work_type=T, prio=0,
+                    target_rank=target, answer_rank=-1, common_len=0,
+                    common_server=-1, common_seqno=-1))
+
+
+def _drain(fabric, rank):
+    out = []
+    while True:
+        m = fabric.endpoints[rank].recv(timeout=0.0)
+        if m is None:
+            return out
+        out.append(m)
+
+
+def test_put_over_watermark_spills_cold_payloads(tmp_path):
+    srv, fabric = _mini_server(tmp_path, cap=4096)
+    blob = b"a" * 1500
+    _put(srv, blob)          # resident: 1500 / soft 2048
+    time.sleep(0.01)         # strictly older time_stamp
+    _put(srv, b"b" * 1500)   # resident: 3000 > soft -> next put spills
+    _put(srv, b"c" * 1500)
+    assert srv.mem.spilled > 0, "nothing spilled over the watermark"
+    assert srv.mem.curr + srv.mem.spilled == 4500
+    assert srv.mem.curr <= 0.5 * 4096 + 1500
+    spilled = [u for u in srv.wq.units() if u.spilled]
+    assert spilled and all(u.payload == b"" for u in spilled)
+    assert all(u.spill_len == 1500 for u in spilled)
+    assert all(u.work_len == 1500 for u in spilled)  # metadata keeps size
+    # every accepted (no backoff/reject rcs)
+    resp = [m for m in _drain(fabric, 0) if m.tag is Tag.TA_PUT_RESP]
+    assert [m.rc for m in resp] == [ADLB_SUCCESS] * 3
+
+
+def test_delivery_faults_spilled_payload_back_in(tmp_path):
+    srv, fabric = _mini_server(tmp_path, cap=4096)
+    payloads = [bytes([65 + i]) * 1500 for i in range(3)]
+    for p in payloads:
+        _put(srv, p)
+        time.sleep(0.005)
+    assert srv.mem.spilled > 0
+    got = []
+    for rq in range(3):
+        srv._handle(msg(Tag.FA_RESERVE, 1, req_types=[T], hang=True,
+                        rqseqno=rq, fetch=1))
+        for m in _drain(fabric, 1):
+            if m.tag is Tag.TA_RESERVE_RESP and m.rc == ADLB_SUCCESS:
+                got.append(bytes(m.payload))
+    assert sorted(got) == sorted(payloads), "fault-in not byte-identical"
+    assert srv.mem.spilled == 0 and len(srv.spill) == 0
+    assert srv.mem.curr == 0  # all consumed
+    assert srv.metrics.value("spill_faultins") >= 1
+
+
+def test_quarantine_record_faults_in_spilled_payload(tmp_path):
+    srv, fabric = _mini_server(tmp_path, cap=4096, max_unit_retries=1,
+                               on_worker_failure="reclaim")
+    blob = b"q" * 1500
+    _put(srv, blob)
+    time.sleep(0.005)
+    _put(srv, b"r" * 1500)
+    _put(srv, b"s" * 1500)
+    victim = next(u for u in srv.wq.units() if u.spilled)
+    victim.attempts = 5  # budget exhausted: next failure quarantines
+    srv._quarantine_unit(victim, in_wq=True)
+    [rec] = srv.quarantine
+    assert rec["payload"] in (blob, b"r" * 1500, b"s" * 1500)
+    assert len(rec["payload"]) == 1500, "quarantined a spilled stub"
+    assert victim.seqno not in srv.spill
+
+
+def test_dead_target_releases_spilled_entry(tmp_path):
+    srv, fabric = _mini_server(tmp_path, cap=4096,
+                               on_worker_failure="reclaim")
+    _put(srv, b"t" * 1500, target=1)
+    time.sleep(0.005)
+    _put(srv, b"u" * 1500)
+    _put(srv, b"v" * 1500)
+    assert srv.mem.spilled > 0
+    spilled_total = srv.mem.spilled
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=1))  # rank 1 dies
+    # its targeted unit is dropped; if it was spilled, the spill entry
+    # and accounting released with it
+    assert srv.mem.spilled <= spilled_total
+    assert srv.mem.curr + srv.mem.spilled == sum(
+        u.payload_len for u in srv.wq.units()
+    )
+
+
+def test_checkpoint_faults_in_all(tmp_path):
+    srv, fabric = _mini_server(tmp_path, cap=4096)
+    for c in b"xyz":
+        _put(srv, bytes([c]) * 1500)
+        time.sleep(0.005)
+    assert srv.mem.spilled > 0
+    n = srv._write_checkpoint_shard(str(tmp_path / "ck"))
+    assert n == 3
+    assert srv.mem.spilled == 0  # everything resident again
+    from adlb_tpu.runtime import checkpoint
+
+    units, _ = checkpoint.load_shard(str(tmp_path / "ck"), 2, srv.world)
+    assert sorted(len(u["payload"]) for u in units) == [1500] * 3
+
+
+# --------------------------------------------------------------- acceptance
+
+
+_N_STORM = 60
+_PAY = 4096
+
+
+def _storm_app(ctx):
+    if ctx.rank == 0:
+        sent = {}
+        for i in range(_N_STORM):
+            p = struct.pack("<q", i) + hashlib.sha256(
+                str(i).encode()).digest() * (_PAY // 32)
+            assert ctx.put(p, T) == ADLB_SUCCESS
+            sent[i] = hashlib.sha256(p).hexdigest()
+        return {"sent": sent,
+                "backoffs": ctx._c.metrics.value("put_backoffs"),
+                "retries": ctx._c.metrics.value("put_retries")}
+    got = {}
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got
+        i = struct.unpack("<q", w.payload[:8])[0]
+        got[i] = hashlib.sha256(w.payload).hexdigest()
+        time.sleep(0.002)
+
+
+def test_put_storm_over_watermark_zero_backoffs(tmp_path):
+    """The spill acceptance world: ~240 KiB of puts through a 64 KiB
+    hard-watermarked cap. With spill_dir set the storm completes with 0
+    ADLB_BACKOFF rcs and every spilled payload fetches back
+    byte-identical."""
+    res = spawn_world(
+        3, 2, [T], _storm_app,
+        cfg=Config(max_malloc_per_server=64 << 10, mem_soft_frac=0.7,
+                   mem_hard_frac=0.8, spill_dir=str(tmp_path),
+                   exhaust_check_interval=0.25),
+        timeout=120.0,
+    )
+    prod = res.app_results[0]
+    got = {}
+    for r, v in res.app_results.items():
+        if r != 0:
+            got.update(v)
+    assert len(got) == _N_STORM
+    assert prod["backoffs"] == 0, "spill tier still answered BACKOFF"
+    assert prod["retries"] == 0, "spill tier still rejected puts"
+    assert all(got[i] == h for i, h in prod["sent"].items()), \
+        "spilled payload came back different"
