@@ -340,6 +340,12 @@ class DistributedAssignmentSolver:
     snapshot — at most one idle-heartbeat interval late, well inside the
     protocol's plans-are-hints staleness tolerance."""
 
+    #: the engine may hand solve() a LedgerView instead of a snapshot
+    #: dict (array-resident host tier, balancer/ledger.py): ingest then
+    #: copies packed rows for servers whose ledger generation moved —
+    #: no tuple re-derivation, no stamp-key diffing
+    SUPPORTS_VIEW = True
+
     #: changed-row count above which a plan re-sweeps the table on the
     #: mesh instead of patching the merged candidate lists in place
     DELTA_RESYNC_ROWS = 16
@@ -393,6 +399,11 @@ class DistributedAssignmentSolver:
         # servers whose tasks/reqs our own last plan consumed: their
         # ledger-filtered snapshot content changes without a stamp bump
         self._planned_servers: set = set()
+        # view-ingest bookkeeping: last consumed ledger generation per
+        # server (rank-keyed; generations are globally monotonic so a
+        # slot reused for a new rank can never alias)
+        self._vgen_t: dict[int, int] = {}
+        self._vgen_r: dict[int, int] = {}
 
         # device state & jitted fns, built lazily (constructing a solver
         # must not force accelerator init before first use)
@@ -593,6 +604,14 @@ class DistributedAssignmentSolver:
                     changed.append(self._si[s])
                 if self._req_cache.get(s):
                     self._pack_reqs(s, ())
+        self._finish_ingest(changed)
+        self.last_ingest_ms = (time.perf_counter() - t0) * 1e3
+        return len(changed)
+
+    def _finish_ingest(self, changed: list) -> None:
+        """Shared ingest tail (tuple and view paths): ship changed
+        device blocks, patch or dirty the merged candidate lists,
+        rebuild the requester slot windows."""
         if self._full_reload:
             self._reload_devices(range(self.ndev))
             self._full_reload = False
@@ -610,6 +629,75 @@ class DistributedAssignmentSolver:
             self._rw, self._lens = _reqwin(
                 self._req_mask, self._req_valid, self.T, self.C)
             self._reqs_dirty = False
+
+    def _ingest_view(self, view) -> int:
+        """Delta ingest from the engine's array-resident host ledger:
+        copy the packed rows of every server whose ledger generation
+        moved since we last consumed it. The ledger already applied the
+        plan-mark/suppression filtering, so there is no stamp-key
+        bookkeeping and no tuple compare here — the generation counters
+        ARE the change signal (they cover in-place deltas, dead-rank
+        patches, and the engine's own plan touches alike)."""
+        t0 = time.perf_counter()
+        self._ensure_built()
+        # layout agreement is load-bearing: refs index [K]/[R] rows
+        assert (view.K, view.R, tuple(view.types)) == (
+            self.K, self.R, self.types)
+        servers = view.servers
+        for s in servers:
+            self._map_server(s)  # may remap + flag a full reload
+        full = self._full_reload
+        changed: list[int] = []
+        R = self.R
+        for s in servers:
+            si = self._si.get(s)
+            if si is None:
+                continue  # beyond capacity: unplanned extras (as ever)
+            slot = view.slot_of(s)
+            tg = view.t_gen_of(s)
+            if full or self._vgen_t.get(s) != tg:
+                self._tp[si, :] = view.pk_tp[slot]
+                self._tt[si, :] = view.pk_tt[slot]
+                self._task_ref[si] = list(view.pk_trefs[slot])
+                self._vgen_t[s] = tg
+                changed.append(si)
+            rg = view.r_gen_of(s)
+            if full or self._vgen_r.get(s) != rg:
+                base = si * R
+                self._req_valid[base:base + R] = view.pk_rv[slot]
+                self._req_mask[base:base + R, :] = view.pk_rm[slot]
+                rrefs = view.pk_rrefs[slot]
+                for i in range(R):
+                    self._req_ref[base + i] = rrefs[i]
+                self._vgen_r[s] = rg
+                self._reqs_dirty = True
+        # vanished servers: clear their resident rows (unconditional
+        # membership check, same rationale as the tuple path — a death
+        # may coincide with a join or a beyond-capacity world)
+        sset = set(servers)
+        for s in self._servers:
+            if s in sset:
+                continue
+            si = self._si[s]
+            if (self._tp[si] > int(_NEG)).any():
+                self._tp[si, :] = int(_NEG)
+                self._tt[si, :] = -1
+                self._task_ref[si] = [None] * self.K
+                changed.append(si)
+            base = si * R
+            if self._req_valid[base:base + R].any():
+                self._req_valid[base:base + R] = False
+                self._req_mask[base:base + R, :] = False
+                for i in range(R):
+                    self._req_ref[base + i] = None
+                self._reqs_dirty = True
+            self._vgen_t.pop(s, None)
+            self._vgen_r.pop(s, None)
+        # plan() keeps recording its touches for the tuple path; the
+        # view path's generations already carry them — drop so the set
+        # cannot grow unboundedly
+        self._planned_servers.clear()
+        self._finish_ingest(changed)
         self.last_ingest_ms = (time.perf_counter() - t0) * 1e3
         return len(changed)
 
@@ -741,7 +829,12 @@ class DistributedAssignmentSolver:
         self.solve_count += 1
         return pairs
 
-    def solve(self, snapshots: dict, world) -> list:
-        """Engine-compatible one-call path: ingest deltas, then plan."""
-        self.ingest(snapshots)
+    def solve(self, snapshots, world) -> list:
+        """Engine-compatible one-call path: ingest deltas, then plan.
+        Accepts either the filtered-snapshot dict or the engine's
+        array-resident ledger view."""
+        if getattr(snapshots, "is_array", False):
+            self._ingest_view(snapshots)
+        else:
+            self.ingest(snapshots)
         return self.plan()

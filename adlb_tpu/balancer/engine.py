@@ -75,6 +75,7 @@ class PlanEngine:
         grow_window: Optional[float] = None,
         inflow_ttl: Optional[float] = None,
         inflow_min_age: Optional[float] = None,
+        host_ledger: str = "array",
         metrics=None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
@@ -150,8 +151,29 @@ class PlanEngine:
             raise ValueError("inflow_min_age must be <= inflow_ttl")
         if self.LOOK_MAX < max(1, self.LOOKAHEAD):
             raise ValueError("look_max must be >= max(1, lookahead)")
+        # Plan ledgers: when each requester/task was last planned. The
+        # HOST TIER keeps these and everything derived from them (the
+        # per-round filter, suppression budgets, the cross-feasibility
+        # gate, the pump pre-check, the solver's packed inputs) resident
+        # in numpy columns (balancer/ledger.py, host_ledger="array",
+        # default) so round admission costs O(changed rows); the
+        # pure-Python twin ("py") is the retained reference semantics,
+        # fuzz-proven identical by tests/test_ledger_parity.py. The
+        # dicts below stay the authoritative mark store either way —
+        # the array ledger's columns cache them via mutation hooks.
+        if host_ledger not in ("array", "py"):
+            raise ValueError(f"unknown host_ledger {host_ledger!r}")
+        from adlb_tpu.balancer.ledger import ArrayLedger, PyLedger, _Marks
+
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
+        if host_ledger == "array":
+            led = ArrayLedger(self, tuple(types), max_tasks, max_requesters)
+            self._planned_reqs = _Marks(led.on_req_mark, led.on_req_mark)
+            self._planned_tasks = _Marks(led.on_task_mark, led.on_task_mark)
+            self._ledger = led
+        else:
+            self._ledger = PyLedger(self)
         # rank -> [(plan time, nunits, mig_id, src, frozenset(types))] for
         # migration batches en route there; until those units land they
         # are invisible in the
@@ -239,52 +261,52 @@ class PlanEngine:
             return [], []
         now = time.monotonic()
         self._prune_credits(snapshots, now)
-        # requester-side ledger filter first (reqs are few): rounds run at
-        # event rate, so a round that can plan nothing must cost O(reqs),
-        # not O(queued tasks). A requester whose home server has a live
-        # inflow credit covering a type it wants is suppressed outright:
-        # the batch already in flight will match it LOCALLY within
-        # milliseconds, and solving it too would both burn a round's CPU
-        # (2+ ms on wide worlds — pure theft from the workers on a shared
-        # core) and deliver a second unit via the expensive per-unit
-        # remote-fetch path (the round-3 native-64-rank regression: ~3.6k
-        # double-served matches per run).
-        freqs = {}
-        for rank, snap in snapshots.items():
-            stamp = snap.get("stamp", now)
-            if snap["reqs"]:
-                # stamped with the SNAPSHOT's capture time, not now: the
-                # master re-reads the same snapshot every round, and a
-                # satisfied park must age out, not stay forever "recent"
-                if stamp > self._last_parked.get(rank, -1e9):
-                    self._last_parked[rank] = stamp
-            # suppression budget: only YOUNG credits (a lost batch must
-            # not block per-unit matching for the whole 2 s TTL — it
-            # stops suppressing after SUPPRESS_TTL and the solve takes
-            # over), and at most as many requesters as there are units
-            # in flight (a 1-unit batch must not park a whole pool)
-            fed: Optional[set] = None
+        led = self._ledger
+        # incremental resident-state sync (array ledger: O(changed rows),
+        # keyed on the same stamp/delta_seq/req_seq change keys the
+        # sharded solver's ingest fast path uses; py twin: no-op)
+        led.sync(snapshots, now)
+        # raw-park recency, stamped with the SNAPSHOT's capture time, not
+        # now: the master re-reads the same snapshot every round, and a
+        # satisfied park must age out, not stay forever "recent". The
+        # array ledger feeds the O(changed) rebuild events (a rank's
+        # park stamp can only move when its snapshot did); the py twin
+        # walks the snapshots like it always has.
+        parked = led.parked_updates(now)
+        if parked is None:
+            parked = (
+                (rank, snap.get("stamp", now))
+                for rank, snap in snapshots.items() if snap["reqs"]
+            )
+        for rank, stamp in parked:
+            if stamp > self._last_parked.get(rank, -1e9):
+                self._last_parked[rank] = stamp
+        # suppression budgets: only YOUNG credits (a lost batch must
+        # not block per-unit matching for the whole 2 s TTL — it
+        # stops suppressing after SUPPRESS_TTL and the solve takes
+        # over), and at most as many requesters as there are units
+        # in flight (a 1-unit batch must not park a whole pool)
+        sup: dict = {}
+        for rank, entries in self._planned_in.items():
+            fed: set = set()
             budget = 0
-            if rank in self._planned_in:
-                fed = set()
-                for e in self._planned_in[rank]:
-                    if e[0] > now - self.SUPPRESS_TTL:
-                        fed |= e[4]
-                        budget += e[1]
-            kept = []
-            for r in snap["reqs"]:
-                if self._planned_reqs.get((rank, r[0], r[1]), -1.0) >= stamp:
-                    continue
-                if (
-                    budget > 0
-                    and fed
-                    and (r[2] is None or not fed.isdisjoint(r[2]))
-                ):
-                    budget -= 1
-                    continue
-                kept.append(r)
-            freqs[rank] = kept
-        have_reqs = any(freqs.values())
+            for e in entries:
+                if e[0] > now - self.SUPPRESS_TTL:
+                    fed |= e[4]
+                    budget += e[1]
+            if budget > 0 and fed:
+                sup[rank] = (fed, budget)
+        # requester-side ledger filter first (kept rows are few): rounds
+        # run at event rate, so a round that can plan nothing must cost
+        # O(changed rows), not O(world). A requester whose home server
+        # has a live inflow credit covering a type it wants is suppressed
+        # outright: the batch already in flight will match it LOCALLY
+        # within milliseconds, and solving it too would both burn a
+        # round's CPU and deliver a second unit via the expensive
+        # per-unit remote-fetch path (the round-3 native-64-rank
+        # regression: ~3.6k double-served matches per run).
+        led.filter_reqs(snapshots, sup, now)
+        have_reqs = led.have_reqs()
         # The solve's only useful output is CROSS-server pairs: same-server
         # pairs are dropped below (the data plane's immediate local matching
         # already covers them), so a round where no parked requester's
@@ -293,10 +315,10 @@ class PlanEngine:
         # every round is such a round — workers park only transiently
         # against local supply — and on a shared core every skipped solve
         # is cycles handed back to the workers. The gate reads RAW task
-        # lists (no per-task ledger lookups): in-flight planned tasks can
+        # supply (no per-task ledger lookups): in-flight planned tasks can
         # over-admit a solve for one snapshot generation, which the
         # filtered solve input then corrects.
-        cross = have_reqs and self._cross_feasible(freqs, snapshots)
+        cross = have_reqs and led.cross_feasible(snapshots)
         # The fair-share pump runs at most once per PUMP_INTERVAL AND
         # only when the cheap pre-check sees a plausible deficit:
         # deficits cannot change faster than batches land, and each pump
@@ -306,48 +328,34 @@ class PlanEngine:
         # since round 4 they no longer walk the pump unconditionally
         # either — in balanced scarce economies that walk was ~5% of
         # throughput for moves that never shipped.
-        pump_due = (
-            now - self._last_pump >= self.PUMP_INTERVAL
-            and self._maybe_imbalanced(snapshots)
-        )
+        pump_due = False
+        if now - self._last_pump >= self.PUMP_INTERVAL:
+            # array ledger answers from resident aggregate columns; it
+            # returns None when not synced with these snapshots (direct
+            # unit-test calls) and the Python pre-check runs instead
+            imb = led.maybe_imbalanced(self, snapshots)
+            pump_due = self._maybe_imbalanced(snapshots) if imb is None \
+                else imb
         if not cross and not pump_due:
             return [], []  # nothing plannable: skip the task-ledger walk
         if pump_due:
             self._last_pump = now
-        filtered = {}
-        for rank, snap in snapshots.items():
-            # task eligibility uses the task-side stamp: a reqs-only park
-            # snapshot must not re-eligibilize in-flight planned tasks.
-            # Stamps ride along so the sharded solver's ingest can skip
-            # unchanged servers without diffing their lists (the
-            # single-device solver ignores the extra keys).
-            tstamp = snap.get("task_stamp", snap.get("stamp", now))
-            tasks = [
-                t for t in snap["tasks"]
-                if self._planned_tasks.get((rank, t[0]), -1.0) < tstamp
-            ]
-            filtered[rank] = {
-                "tasks": tasks, "reqs": freqs[rank],
-                "task_stamp": tstamp,
-                "stamp": snap.get("stamp", now),
-                # event task deltas / dead-rank req patches mutate the
-                # snapshot in place WITHOUT a stamp bump (see
-                # server._merge_task_delta / _patch_snapshots_for_dead),
-                # and OUR own plans/migrations change the ledger-filtered
-                # view with no snapshot at all: the sequence numbers and
-                # the ledger stamp carry those changes to the solver's
-                # unchanged-server fast path. ledger_stamp is a SEPARATE
-                # field (never max()ed into the snapshot stamps): stamps
-                # are the SENDING host's monotonic clock while the
-                # ledger stamp is the planner's — ordering across the
-                # two domains is meaningless, and the solver only ever
-                # compares the key tuple for (in)equality.
-                "delta_seq": snap.get("delta_seq", 0),
-                "req_seq": snap.get("req_seq", 0),
-                "ledger_stamp": self._rank_planned.get(rank, -1.0),
-            }
+        # The solver consumes the ledger's resident arrays directly (the
+        # "view": packed kept-requester masks + eligible-task rows, per-
+        # server generation counters for the sharded solver's delta
+        # ingest) — the legacy per-rank dict of filtered tuple lists is
+        # materialized only for pump rounds (the migration planner walks
+        # tuples) and for the py twin. Materialization happens BEFORE
+        # the plan marks below so the pump sees the same pre-plan
+        # filtered view it always did.
+        view = led.view() if getattr(self.solver, "SUPPORTS_VIEW", False) \
+            else None
+        filtered = None
+        if view is None or pump_due:
+            filtered = self._materialize(snapshots, now)
         if cross:
-            pairs = self.solver.solve(filtered, world)
+            pairs = self.solver.solve(
+                view if view is not None else filtered, world)
         else:
             pairs = []  # still consider migrations below
         t_planned = time.monotonic()
@@ -403,6 +411,12 @@ class PlanEngine:
             sweep = getattr(self.solver, "last_sweep_ms", None)
             if sweep is not None:
                 self.metrics.gauge("solve_shard_ms").set(sweep)
+            if led.is_array:
+                # host-tier resident ledger: row count + last
+                # incremental-sync cost (USERGUIDE §11 "host tier")
+                self.metrics.gauge("ledger_rows").set(led.rows_resident())
+                self.metrics.gauge("ledger_patch_us").set(
+                    round(led.last_sync_us, 1))
             if matches:
                 self.metrics.counter("balancer_pairs").inc(len(matches))
             if migrations:
@@ -412,16 +426,45 @@ class PlanEngine:
                 self.metrics.counter("balancer_migrated_units").inc(
                     sum(len(mv[2]) for mv in migrations)
                 )
-        # bound the memory of the plan ledgers
+        # bound the memory of the plan ledgers (per-key deletes so the
+        # array ledger's mark hooks keep its columns coherent)
         if len(self._planned_reqs) > 4096 or len(self._planned_tasks) > 4096:
             cutoff = t_planned - 5.0
-            self._planned_reqs = {
-                k: v for k, v in self._planned_reqs.items() if v > cutoff
-            }
-            self._planned_tasks = {
-                k: v for k, v in self._planned_tasks.items() if v > cutoff
-            }
+            for d in (self._planned_reqs, self._planned_tasks):
+                for k in [k for k, v in d.items() if v <= cutoff]:
+                    del d[k]
         return matches, migrations
+
+    def _materialize(self, snapshots: dict, now: float) -> dict:
+        """The legacy filtered-snapshot dict (exact tuple lists), built
+        from the ledger's kept/eligible row state. Task eligibility uses
+        the task-side stamp: a reqs-only park snapshot must not
+        re-eligibilize in-flight planned tasks. Stamps ride along so the
+        sharded solver's tuple-path ingest can skip unchanged servers
+        without diffing their lists (the single-device solver ignores
+        the extra keys): event task deltas / dead-rank req patches
+        mutate the snapshot in place WITHOUT a stamp bump (see
+        server._merge_task_delta / _patch_snapshots_for_dead), and OUR
+        own plans/migrations change the ledger-filtered view with no
+        snapshot at all — the sequence numbers and the ledger stamp
+        carry those changes. ledger_stamp is a SEPARATE field (never
+        max()ed into the snapshot stamps): stamps are the SENDING
+        host's monotonic clock while the ledger stamp is the planner's —
+        ordering across the two domains is meaningless, and the solver
+        only ever compares the key tuple for (in)equality."""
+        led = self._ledger
+        filtered = {}
+        for rank, snap in snapshots.items():
+            filtered[rank] = {
+                "tasks": led.elig_tasks(rank),
+                "reqs": led.kept_reqs(rank),
+                "task_stamp": snap.get("task_stamp", snap.get("stamp", now)),
+                "stamp": snap.get("stamp", now),
+                "delta_seq": snap.get("delta_seq", 0),
+                "req_seq": snap.get("req_seq", 0),
+                "ledger_stamp": self._rank_planned.get(rank, -1.0),
+            }
+        return filtered
 
     @staticmethod
     def _cross_feasible(freqs: dict, snapshots: dict) -> bool:

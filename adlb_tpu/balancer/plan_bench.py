@@ -167,20 +167,171 @@ def run_sweep(scales=None, reps: int = 40, ndev: int = 8,
     }
 
 
+#: engine-round overhead ladder: (servers, tasks-per-supply-server,
+#: reqs-per-server) — parked totals 1k / 10k / 100k
+ENGINE_SCALES = [(1000, 16, 1), (1000, 16, 10), (1000, 16, 100)]
+SUPPLY_SERVERS = 64  # servers holding queued inventory (cross demand)
+
+
+class _NullSolver:
+    """Measures ENGINE-side admission only: accepts either input shape
+    and plans nothing (the solve itself is plan_round_1k_ms's job)."""
+
+    SUPPORTS_VIEW = True
+
+    def solve(self, snapshots, world) -> list:
+        return []
+
+
+def run_engine_sweep(scales=None, reps: int = 40) -> dict:
+    """engine.round() overhead at 1k/10k/100k parked requesters, array
+    ledger vs the pure-Python twin (the pre-vectorization cost), on a
+    steady state that stamps DELTA_SERVERS fresh snapshots per round —
+    the O(changed rows) path the resident ledger exists for. Needs no
+    devices (null solver): this isolates admission — ledger filter,
+    suppression, cross-feasibility gate, pump pre-check, solver-input
+    packing — from the solve."""
+    import time as _time
+
+    from adlb_tpu.balancer.engine import PlanEngine
+
+    rows = []
+    for S, K, R in scales or ENGINE_SCALES:
+        row = {"servers": S, "parked_reqs": S * R}
+        for ledger in ("array", "py"):
+            rng = np.random.default_rng(S * R)
+            eng = PlanEngine(
+                types=TYPES, max_tasks=K, max_requesters=max(R, 4),
+                host_ledger=ledger,
+            )
+            eng.solver = _NullSolver()
+            seq = [10**6]
+            snaps = {}
+            t0 = _time.monotonic()
+            for s in range(S):
+                tasks = []
+                if s < SUPPLY_SERVERS:
+                    tasks = [
+                        (seq[0] + i, int(rng.integers(1, len(TYPES) + 1)),
+                         int(rng.integers(-50, 50)), 64)
+                        for i in range(K)
+                    ]
+                    seq[0] += K
+                # reqs park on NON-supply servers: cross-server demand,
+                # so every round admits the solve (the representative
+                # steady state for a serving fleet; consumers stay 0 so
+                # the pump never fires — its walk is measured by the
+                # hotspot benches)
+                reqs = _mk_reqs(rng, s, R) if s >= SUPPLY_SERVERS else []
+                snaps[100 + s] = {
+                    "tasks": tasks, "reqs": reqs, "consumers": 0,
+                    "stamp": t0, "task_stamp": t0,
+                }
+            lat = []
+            rq = [10**7]
+            for it in range(max(reps, 4)):
+                t1 = _time.perf_counter()
+                eng.round(snaps, None)
+                dt = (_time.perf_counter() - t1) * 1e6
+                if it >= 3:  # first rounds pay allocation/registration
+                    lat.append(dt)
+                # steady state: a handful of servers re-stamp with fresh
+                # parks (everything else rides the unchanged fast path)
+                t2 = _time.monotonic()
+                for d in range(DELTA_SERVERS):
+                    s = SUPPLY_SERVERS + (
+                        (it * DELTA_SERVERS + d) % (S - SUPPLY_SERVERS))
+                    snap = snaps[100 + s]
+                    rq[0] += 1
+                    snap["reqs"] = list(snap["reqs"][1:]) + [
+                        (s * 200, rq[0],
+                         [int(rng.integers(1, len(TYPES) + 1))])
+                    ]
+                    snap["stamp"] = t2
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            key = "engine_round_us" if ledger == "array" \
+                else "engine_round_py_us"
+            row[key] = round(p50, 1)
+            if ledger == "array":
+                led = eng._ledger
+                # the fast path must actually be taken: patches happened,
+                # and NOT MORE than the workload explains — cold start
+                # builds 2 columns per server, each steady round rebuilds
+                # the DELTA_SERVERS re-stamped servers' req columns (a
+                # change-key bug that silently rebuilt the world every
+                # round would blow straight through this bound), plus a
+                # full-resync allowance; full rebuilds only at cadence
+                assert led.patch_count > 0, "ledger fast path never taken"
+                budget = (
+                    2 * S + (max(reps, 4) + 1) * 2 * DELTA_SERVERS
+                    + led.resync_count * 2 * S
+                )
+                assert led.patch_count <= budget, (
+                    f"fast path lost: {led.patch_count} patches > "
+                    f"{budget} explained by the workload")
+                assert led.resync_count <= reps // led.LEDGER_RESYNC_INTERVAL + 1, (
+                    led.resync_count)
+                row["ledger_patches"] = led.patch_count
+                row["ledger_resyncs"] = led.resync_count
+                row["ledger_rows"] = led.rows_resident()
+        row["speedup"] = round(row["engine_round_py_us"]
+                               / max(row["engine_round_us"], 1e-9), 1)
+        rows.append(row)
+        print(
+            f"engine-round {row['parked_reqs']:6d} parked: array p50 "
+            f"{row['engine_round_us']:9.1f} us  py twin "
+            f"{row['engine_round_py_us']:9.1f} us  "
+            f"({row['speedup']}x, {row['ledger_patches']} patches, "
+            f"{row['ledger_resyncs']} resyncs)"
+        )
+    return {
+        "metric": "engine_round_overhead",
+        "delta_servers_per_round": DELTA_SERVERS,
+        "rows": rows,
+        "note": (
+            "engine.round() admission overhead (ledger filter + "
+            "suppression + cross gate + pump pre-check + solver-input "
+            "packing; null solver, so the solve itself is excluded) on "
+            "a steady state re-stamping DELTA_SERVERS snapshots per "
+            "round. engine_round_us = array-resident host ledger "
+            "(balancer/ledger.py), engine_round_py_us = the retained "
+            "pure-Python twin (the pre-PR-10 cost)."
+        ),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true",
                     help="fewer reps, smallest+largest scales only")
     ap.add_argument("--ndev", type=int, default=8)
+    ap.add_argument("--engine-rounds", action="store_true",
+                    help="measure engine.round admission overhead "
+                         "(host-ledger ladder) instead of the mesh "
+                         "planning sweep; needs no devices")
     ap.add_argument("--json-only", action="store_true",
                     help="suppress progress lines (JSON on stdout)")
     args = ap.parse_args(argv)
 
-    from adlb_tpu.utils.jaxenv import force_cpu_devices
+    if args.engine_rounds:
+        def run():
+            scales = (
+                [ENGINE_SCALES[0], ENGINE_SCALES[-1]] if args.quick
+                else ENGINE_SCALES
+            )
+            return run_engine_sweep(
+                scales=scales, reps=20 if args.quick else 40)
+    else:
+        from adlb_tpu.utils.jaxenv import force_cpu_devices
 
-    force_cpu_devices(args.ndev)
-    scales = [SCALES[0], SCALES[-1]] if args.quick else SCALES
-    reps = 20 if args.quick else 40
+        force_cpu_devices(args.ndev)
+        scales = [SCALES[0], SCALES[-1]] if args.quick else SCALES
+        reps = 20 if args.quick else 40
+
+        def run():
+            return run_sweep(scales=scales, reps=reps, ndev=args.ndev)
+
     if args.json_only:
         import contextlib
         import io
@@ -188,10 +339,10 @@ def main(argv=None) -> int:
 
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
-            out = run_sweep(scales=scales, reps=reps, ndev=args.ndev)
+            out = run()
         sys.stdout.write(json.dumps(out) + "\n")
     else:
-        out = run_sweep(scales=scales, reps=reps, ndev=args.ndev)
+        out = run()
         print(json.dumps(out))
     return 0
 

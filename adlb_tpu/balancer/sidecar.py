@@ -115,6 +115,7 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         grow_window=cfg.balancer_grow_window,
         inflow_ttl=cfg.balancer_inflow_ttl,
         inflow_min_age=cfg.balancer_inflow_min_age,
+        host_ledger=cfg.host_ledger,
     )
     snapshots: dict[int, dict] = {}
     ended: set[int] = set()
@@ -193,6 +194,12 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                                 break
                             snap["tasks"].append((sq, wt, pr, ln))
                         snap["nbytes"] = m.data.get("nbytes", snap["nbytes"])
+                        # in-place append with no stamp bump: the delta
+                        # sequence is the change signal the resident
+                        # ledgers/solver fast paths key on (the server's
+                        # _merge_task_delta has always bumped it; the
+                        # sidecar merge was the one spot that didn't)
+                        snap["delta_seq"] = snap.get("delta_seq", 0) + 1
                         dirty = True
                 elif m.tag is Tag.DS_END:
                     ended.add(m.src)

@@ -129,7 +129,16 @@ class AssignmentSolver:
     on the host (an accelerator dispatch round-trip would dominate); larger
     instances run the jitted scan on device. Both produce the identical
     matching (same greedy order), so the threshold is purely a latency
-    knob."""
+    knob.
+
+    ``solve`` also accepts the engine's array-resident host ledger (a
+    :class:`adlb_tpu.balancer.ledger.ArrayLedger` view) in place of the
+    snapshot dict: the packed kept-requester / eligible-task rows are
+    consumed directly — no per-row tuple walk — and the matching is
+    identical to the dict path (fuzz-proven by tests/test_ledger_parity)."""
+
+    #: the engine may hand solve() a LedgerView instead of a snapshot dict
+    SUPPORTS_VIEW = True
 
     def __init__(
         self, types: Sequence[int], max_tasks: int, max_requesters: int,
@@ -172,12 +181,15 @@ class AssignmentSolver:
                 self._device_fn = _greedy_assign
         return self._device_fn
 
-    def solve(self, snapshots: dict, world) -> list:
+    def solve(self, snapshots, world) -> list:
         """snapshots: server_rank -> {"tasks": [(seqno, type, prio, len)...],
-        "reqs": [(rank, rqseqno, req_types|None)...]}.
+        "reqs": [(rank, rqseqno, req_types|None)...]} — or an
+        ArrayLedger view (see class docstring).
 
         Returns [(holder_server, seqno, req_home_server, for_rank, rqseqno)].
         """
+        if getattr(snapshots, "is_array", False):
+            return self._solve_view(snapshots)
         servers = sorted(snapshots)
         S, K, R, T = len(servers), self.K, self.R, len(self.types)
         if S == 0:
@@ -261,5 +273,63 @@ class AssignmentSolver:
                 continue
             holder, seqno = task_ref[t]
             req_home, for_rank, rqseqno = req_ref[i]
+            pairs.append((holder, seqno, req_home, for_rank, rqseqno))
+        return pairs
+
+    def _solve_view(self, view) -> list:
+        """The array-ledger fast path: identical greedy matching over the
+        ledger's packed per-server rows (kept requesters truncated [:R],
+        eligible tasks [:K], sorted-server row order — exactly the dict
+        packer's layout), with no per-row Python walk."""
+        K, R, T = self.K, self.R, len(self.types)
+        # the ledger is built from the same engine Config; the row
+        # layouts must agree or refs would misindex
+        assert (view.K, view.R, tuple(view.types)) == (K, R, self.types)
+        slots = view.slot_order
+        S = slots.size
+        if S == 0:
+            return []
+        req_valid = view.pk_rv[slots].reshape(-1)
+        n_reqs = int(req_valid.sum())
+        if n_reqs == 0:
+            return []
+        req_mask = view.pk_rm[slots].reshape(S * R, T)
+        task_prio = view.pk_tp[slots].reshape(-1)
+        task_type = view.pk_tt[slots].reshape(-1)
+        host = (
+            self.host_threshold_reqs is not None
+            and n_reqs <= self.host_threshold_reqs
+        )
+        if host:
+            # _host_greedy's internal wanted/live filter makes the
+            # compacted pre-pack of the dict path unnecessary: same
+            # candidates, same stable order, same matching
+            assign = _host_greedy(task_prio, task_type, req_mask, req_valid)
+            self.host_solve_count += 1
+            if not (assign >= 0).any():
+                return []
+        else:
+            if (task_type < 0).all():
+                return []
+            assign = np.asarray(
+                self._device_assign()(
+                    jnp.asarray(task_prio),
+                    jnp.asarray(task_type),
+                    jnp.asarray(req_mask),
+                    jnp.asarray(req_valid),
+                )
+            )
+        self.solve_count += 1
+        pairs = []
+        slot_list = slots.tolist()
+        trefs, rrefs = view.pk_trefs, view.pk_rrefs
+        for i in np.flatnonzero(assign >= 0).tolist():
+            t = int(assign[i])
+            tref = trefs[slot_list[t // K]][t % K]
+            rref = rrefs[slot_list[i // R]][i % R]
+            if tref is None or rref is None:
+                continue
+            holder, seqno = tref
+            req_home, for_rank, rqseqno = rref
             pairs.append((holder, seqno, req_home, for_rank, rqseqno))
         return pairs

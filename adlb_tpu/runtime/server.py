@@ -111,6 +111,7 @@ class _BalancerWorker(threading.Thread):
             grow_window=s.cfg.balancer_grow_window,
             inflow_ttl=s.cfg.balancer_inflow_ttl,
             inflow_min_age=s.cfg.balancer_inflow_min_age,
+            host_ledger=s.cfg.host_ledger,
             metrics=s.metrics,
         )
         s._solver = engine.solver

@@ -85,6 +85,15 @@ _FULL_SLEEP_MAX = 1e-3
 # every role already handles (TCP's analogue is a refused reconnect)
 FULL_RING_TIMEOUT = 20.0
 
+# receiver insurance: with rings attached, a blocking recv re-scans
+# them at least this often even without a bell. Bounds the theoretical
+# lost-wakeup window of the sender-side doorbell coalescing (a stale
+# head read can make a sender skip a bell the receiver needed; on
+# x86-TSO the store->load reorder that requires has never been
+# observed at Python's instruction granularity, but 4 spurious
+# wakeups/s is cheap certainty)
+_INSURANCE_S = 0.25
+
 SHM_DIR = "/dev/shm"
 
 
@@ -365,9 +374,17 @@ class ShmEndpoint:
         self._rx_stats: dict = {}
         self._g_occ = None
         self._g_wake = None
+        self._g_sup = None
         self._h_send = None  # send_s / recv_wait_s histograms — same
         self._h_recv = None  # exposition contract as the TCP endpoint
         self.doorbell_wakeups = 0
+        # doorbell coalescing: per-dest ring tail at the last bell we
+        # rang (guarded by that dest's send lock). A peer that has not
+        # consumed up to that point either still has our byte in its
+        # FIFO or is awake mid-drain — both end in a ring scan that
+        # sees any newer frame, so the bell write is skipped.
+        self._rung: dict[int, int] = {}
+        self.doorbell_suppressed = 0
         self.shm_frames_tx = 0
         self.shm_frames_rx = 0
         self._bell = Doorbell(self._bell_path(self.rank), create=True)
@@ -509,12 +526,30 @@ class ShmEndpoint:
             if self._h_send is None:
                 self._h_send = reg.histogram("send_s")
             self._h_send.observe(time.monotonic() - t0)
+            # suppression is SENDER-side state: export it here, not
+            # only from the rx drain (a mostly-sending rank would
+            # otherwise scrape a stale 0 forever)
+            if self._g_sup is None:
+                self._g_sup = reg.gauge("shm_doorbell_suppressed")
+            self._g_sup.set(self.doorbell_suppressed)
 
     def _write_frame(self, ring: ShmRing, bell: Doorbell, dest: int,
                      nbody: int, parts: list) -> None:
         """Stream one length-prefixed frame into the ring, waiting for
         the reader when full (frames larger than the ring flow through
-        it in ring-sized installments)."""
+        it in ring-sized installments).
+
+        ONE wakeup per frame, coalesced: the bell rings after the whole
+        frame lands (not per segment — a TLV frame used to ring once
+        per header/field/payload part), and even that ring is skipped
+        when the peer is known-awake: our previous bell's byte is
+        unconsumed (head behind the tail it advertised), so the drain
+        it triggers will pick this frame up too. The full-ring wait
+        needs no extra bell — ``probe()`` writes a byte each lap, which
+        doubles as the wakeup for the bytes already streamed. A stale
+        head read can only over-skip, never over-ring; recv()'s
+        insurance re-scan bounds the (never-observed, theoretical
+        store-order) lost-wakeup window."""
         deadline = None
         sleep_s = _FULL_SLEEP_MIN
         for seg in (_LEN.pack(nbody), *parts):
@@ -523,7 +558,6 @@ class ShmEndpoint:
                 n = ring.write_some(mv)
                 if n:
                     mv = mv[n:]
-                    bell.ring()
                     deadline = None
                     sleep_s = _FULL_SLEEP_MIN
                     continue
@@ -549,6 +583,13 @@ class ShmEndpoint:
                     )
                 time.sleep(sleep_s)
                 sleep_s = min(sleep_s * 2, _FULL_SLEEP_MAX)
+        tail = ring._tail()
+        last = self._rung.get(dest, -1)
+        if last >= 0 and ring._head() < last:
+            self.doorbell_suppressed += 1
+        else:
+            bell.ring()
+            self._rung[dest] = tail
 
     # -- recv ----------------------------------------------------------------
 
@@ -618,8 +659,10 @@ class ShmEndpoint:
                 if self._g_occ is None:
                     self._g_occ = reg.gauge("shm_ring_occupancy")
                     self._g_wake = reg.gauge("shm_doorbell_wakeups")
+                    self._g_sup = reg.gauge("shm_doorbell_suppressed")
                 self._g_occ.set(occ)
                 self._g_wake.set(self.doorbell_wakeups)
+                self._g_sup.set(self.doorbell_suppressed)
             self.shm_frames_rx += got
             if got > 1:
                 # a second consumer thread may be parked in select while
@@ -699,6 +742,8 @@ class ShmEndpoint:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
+            if self._rx and (remaining is None or remaining > _INSURANCE_S):
+                remaining = _INSURANCE_S  # bounded re-scan (see above)
             if self._bell.wait(remaining):
                 self.doorbell_wakeups += 1
                 self._bell.drain()

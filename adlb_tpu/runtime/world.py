@@ -233,6 +233,12 @@ class Config:
     # balancer's task table over a jax.sharding.Mesh (one shard per device,
     # balancer/distributed.py); "off" = single-device solve
     balancer_mesh: str = "off"
+    # host tier of the plan engine (balancer/ledger.py): "array" keeps
+    # parked requesters / snapshot tasks resident in numpy columns so
+    # round admission costs O(changed rows); "py" is the pure-Python
+    # twin (exact reference semantics, fuzz-proven identical — an
+    # escape hatch, not a feature switch)
+    host_ledger: str = "array"
     trace: bool = False  # event tracing hooks (reference MPE shims);
     # since the obs unification this traces BOTH sides: client API spans
     # (pid 0) and server handler / balancer-round spans (pid 1) into one
@@ -339,6 +345,8 @@ class Config:
             raise ValueError(f"unknown native_queues {self.native_queues!r}")
         if self.solver_backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown solver_backend {self.solver_backend!r}")
+        if self.host_ledger not in ("array", "py"):
+            raise ValueError(f"unknown host_ledger {self.host_ledger!r}")
         if self.server_impl not in ("python", "native"):
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
         if self.qmstat_mode not in ("broadcast", "ring"):

@@ -1254,6 +1254,42 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         plan_rows = {"plan_round_error": repr(e)[:200]}
 
+    # host-tier round admission: engine.round() overhead at 1k/10k/100k
+    # parked requesters (array-resident ledger vs the pure-Python twin;
+    # null solver, so this is purely the admission the host ledger
+    # vectorizes). Subprocess-isolated like the plan sweep; needs no
+    # devices. Own containment.
+    def engine_round_bench():
+        import subprocess as _sp
+
+        proc = _sp.run(
+            [sys.executable, "-m", "adlb_tpu.balancer.plan_bench",
+             "--engine-rounds", "--json-only"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"engine_rounds rc={proc.returncode}: {proc.stderr[-200:]}")
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        out = {}
+        for row in doc["rows"]:
+            parked = row["parked_reqs"]
+            label = f"{parked // 1000}k"
+            out[f"engine_round_us_{label}"] = row["engine_round_us"]
+            out[f"engine_round_py_us_{label}"] = row["engine_round_py_us"]
+        big = doc["rows"][-1]
+        out["engine_round_us"] = big["engine_round_us"]
+        out["engine_round_speedup"] = big["speedup"]
+        out["ledger_patches"] = big["ledger_patches"]
+        out["ledger_resyncs"] = big["ledger_resyncs"]
+        return out
+
+    try:
+        engine_rows = engine_round_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        engine_rows = {"engine_round_error": repr(e)[:200]}
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -1367,6 +1403,7 @@ def main() -> None:
             **service_rows,
             **shm_rows,
             **plan_rows,
+            **engine_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1484,6 +1521,14 @@ def main() -> None:
             "restart_replay_ms": service_rows.get("restart_replay_ms"),
             # multichip planning round @ 1k servers / 100k parked (p50)
             "plan_round_1k_ms": plan_rows.get("plan_round_1k_ms"),
+            # host-tier round admission @ 100k parked: [array us, py
+            # twin us] + the 1k/10k rungs of the same ladder
+            "engine_round": [engine_rows.get("engine_round_us_100k"),
+                             engine_rows.get("engine_round_py_us_100k")],
+            "engine_round_1k": [engine_rows.get("engine_round_us_1k"),
+                                engine_rows.get("engine_round_py_us_1k")],
+            "engine_round_10k": [engine_rows.get("engine_round_us_10k"),
+                                 engine_rows.get("engine_round_py_us_10k")],
             "pop_p50": [round(lat_steal.latency_p50_ms, 3),
                         round(lat_tpu.latency_p50_ms, 3)],
             "pops": [round(lat_steal.pops_per_sec, 1),
@@ -1535,6 +1580,10 @@ def main() -> None:
     }
     if "native_error" in native_rows:
         compact["detail"]["native_error"] = native_rows["native_error"][:120]
+    if "engine_round_error" in engine_rows:
+        compact["detail"]["engine_round_error"] = (
+            engine_rows["engine_round_error"][:120]
+        )
     if "device_solve_error" in device_rows:
         compact["detail"]["device_error"] = (
             device_rows["device_solve_error"][:120]

@@ -52,6 +52,14 @@ GUARDS = {
     "plan_round": [
         ("1k", "plan_round_1k_ms"),
     ],
+    # host-tier round admission at 100k parked requesters (r08 metric;
+    # older baselines skip with a note): engine.round() p50 in
+    # MICROSECONDS on the array-resident ledger. Guarded cell is the
+    # array path only — the compact pair's second cell is the py twin,
+    # kept for reference (it IS the regression the ledger removed).
+    "engine_round": [
+        ("100k", "engine_round_us_100k"),
+    ],
     # shm ring fabric (r07 metrics; older baselines skip with a note):
     # pop latency over real processes on the ring fabric vs the same
     # world on TCP, classic two-call consumer + the batched path

@@ -44,6 +44,33 @@ from adlb_tpu.types import ADLB_SUCCESS, InfoKey
 from adlb_tpu.workloads import nq
 
 
+def load_factor(cap: float = 5.0) -> float:
+    """How oversubscribed this host is right now (1.0 = idle enough).
+
+    The gray/two-jobs adversities arm sub-second lease timeouts; their
+    quarantine/casualty ORACLES assume the only thing exceeding a lease
+    is the injected fault. On a heavily loaded host that assumption
+    breaks mechanically, not behaviorally: a HEALTHY worker descheduled
+    past lease_timeout_s (its heartbeat thread starved too) gets
+    fenced, its unit's attempts bump, and with the deliberately tiny
+    retry budget (max_unit_retries=1) a second innocent expiry
+    quarantines a NON-poison unit — quarantined becomes 2+ and the
+    assert fires. Reproduced identically on ``--fabric tcp`` (CHANGES
+    PR 8), i.e. it is load-induced scheduler starvation, not a fabric
+    or quarantine bug: the seed replays green on an idle host.
+
+    Fix: scale the armed lease timeouts by the measured 1-minute load
+    per core, capped (a saturated CI box still has to finish). The
+    stall durations derive from lease_timeout_s, so the
+    short-stall/long-stall ratio semantics are preserved.
+    """
+    try:
+        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+    except OSError:  # no /proc: assume idle
+        return 1.0
+    return min(max(per_core, 1.0), cap)
+
+
 def coverage_pool(n_units):
     """Self-validating coverage workload for SERVER-kill adversities:
     rank 0 pre-loads ids, everyone consumes via get_work; the world ends
@@ -372,7 +399,12 @@ def one_iter(seed, fabric=None):
         kw["fabric"] = fabric
     if do_stall or do_poison:
         kw["on_worker_failure"] = g_policy
-        kw["lease_timeout_s"] = rng.choice([0.8, 1.2])
+        # load-aware: the quarantine/casualty oracles assume only the
+        # injected fault exceeds a lease — scale the timeout by the
+        # host's current oversubscription so a starved-but-healthy
+        # worker cannot fence/quarantine innocents (see load_factor)
+        kw["lease_timeout_s"] = round(
+            rng.choice([0.8, 1.2]) * load_factor(), 2)
         if do_poison:
             kw["max_unit_retries"] = 2
             kw["fault_spec"] = {"seed": seed, "poison_types": [2]}
@@ -381,7 +413,11 @@ def one_iter(seed, fabric=None):
         # the poison quarantined; "abort" must classify the first
         # poison kill cleanly (bounded, never a hang)
         kw["on_worker_failure"] = rng.choice(["abort", "reclaim"])
-        kw["lease_timeout_s"] = rng.choice([0.8, 1.2])
+        # load-aware lease (same rationale as the gray adversities: an
+        # innocent expiry under host load would quarantine a second,
+        # NON-poison unit and fail the quarantined==1 oracle)
+        kw["lease_timeout_s"] = round(
+            rng.choice([0.8, 1.2]) * load_factor(), 2)
         # budget 1: the SECOND reclaim quarantines — job A's half-pool
         # (two+ workers) is enough to exceed it
         kw["max_unit_retries"] = 1

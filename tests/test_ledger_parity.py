@@ -1,0 +1,321 @@
+"""Host-tier ledger parity: the array-resident ledger must be
+indistinguishable from the retained pure-Python twin.
+
+Contract (see balancer/ledger.py): identical kept-requester and
+eligible-task sets — and therefore identical matches AND migrations —
+across randomized sequences of full snapshot restamps, in-place task
+deltas (``delta_seq`` bumps, no stamp change), dead-rank requester
+patches (``req_seq`` bumps), server death/rejoin, credit suppression,
+plan-mark expiry (pruning), and direct plan-dict pokes.  Checked with
+the single-device solver and the sharded solver at mesh sizes 1/2/8,
+plus a no-realloc guard on the resident arrays and the sharded solver's
+no-retrace guard under view ingest.
+
+The wall-clock window knobs (SUPPRESS_TTL, INFLOW_*, PARK_RECENT,
+LOOK_GROW_WINDOW) are pinned to deterministic extremes: the two engines
+run sequentially, so their round clocks differ by one solve — a credit
+or park sitting exactly on a window edge would flip between them for
+timing, not semantics.
+"""
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU platform)
+
+import jax
+from jax.sharding import Mesh
+
+from adlb_tpu.balancer.distributed import DistributedAssignmentSolver
+from adlb_tpu.balancer.engine import PlanEngine
+
+TYPES = (1, 2, 3, 4)
+
+
+def _mk_engine(host_ledger, solver=None):
+    eng = PlanEngine(types=TYPES, max_tasks=12, max_requesters=6,
+                     host_ledger=host_ledger)
+    if solver is not None:
+        eng.solver = solver
+    eng.PUMP_INTERVAL = 0.0
+    eng.INFLOW_MIN_AGE = 0.0
+    eng.INFLOW_TTL = 1e9
+    eng.SUPPRESS_TTL = 1e9
+    eng.PARK_RECENT = 1e9
+    eng.LOOK_GROW_WINDOW = 1e9
+    return eng
+
+
+def _rand_snaps(rng, nservers, seq, stamp):
+    snaps = {}
+    for s in range(100, 100 + nservers):
+        tasks = []
+        for _ in range(int(rng.integers(0, 10))):
+            seq[0] += 1
+            tasks.append(
+                (seq[0], int(rng.choice(TYPES)), int(rng.integers(-9, 10)),
+                 8)
+            )
+        tasks.sort(key=lambda t: -t[2])
+        reqs = []
+        for r in range(int(rng.integers(0, 5))):
+            reqs.append(
+                ((s - 100) * 50 + r, int(rng.integers(1, 1000)),
+                 None if rng.random() < 0.2
+                 else sorted({int(rng.choice(TYPES))
+                              for _ in range(int(rng.integers(1, 3)))}))
+            )
+        snaps[s] = {"tasks": tasks, "reqs": reqs,
+                    "consumers": int(rng.integers(0, 3)),
+                    "stamp": stamp, "task_stamp": stamp}
+    return snaps
+
+
+def _mutate(rng, pair, seq, rnd, matches):
+    """One randomized world step applied identically to both engines'
+    snapshot dicts: consume the plan, then a mix of delta appends,
+    req-seq patches, death/rejoin, and fresh restamps."""
+    t = time.monotonic()
+    for snaps in pair:
+        for holder, s_, rh, fr, rq in matches:
+            hs = snaps.get(holder)
+            if hs is not None:
+                hs["tasks"] = [x for x in hs["tasks"] if x[0] != s_]
+                hs["task_stamp"] = t
+            rs = snaps.get(rh)
+            if rs is not None:
+                rs["reqs"] = [
+                    r for r in rs["reqs"]
+                    if not (r[0] == fr and r[1] == rq)
+                ]
+                rs["stamp"] = t
+    ranks = sorted(pair[0])
+    if not ranks:
+        return
+    # in-place task delta (no stamp bump, delta_seq carries it)
+    if rng.random() < 0.7:
+        tgt = int(rng.choice(ranks))
+        seq[0] += 1
+        unit = (seq[0], int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+        for snaps in pair:
+            snaps[tgt]["tasks"].append(unit)
+            snaps[tgt]["delta_seq"] = snaps[tgt].get("delta_seq", 0) + 1
+    # dead-rank req patch (req_seq bump, no stamp bump)
+    if rng.random() < 0.4:
+        tgt = int(rng.choice(ranks))
+        dead = int(rng.integers(0, 400))
+        for snaps in pair:
+            kept = [r for r in snaps[tgt]["reqs"] if r[0] != dead]
+            if len(kept) != len(snaps[tgt]["reqs"]):
+                snaps[tgt]["reqs"] = kept
+                snaps[tgt]["req_seq"] = snaps[tgt].get("req_seq", 0) + 1
+    # server death (and a later rejoin via the restamp below)
+    if rng.random() < 0.15 and len(ranks) > 2:
+        tgt = int(rng.choice(ranks))
+        for snaps in pair:
+            snaps.pop(tgt, None)
+    # fresh full restamps for a couple of servers (rejoins included)
+    t2 = time.monotonic()
+    for _ in range(int(rng.integers(1, 3))):
+        tgt = 100 + int(rng.integers(0, 8))
+        tasks = []
+        for _ in range(int(rng.integers(0, 10))):
+            seq[0] += 1
+            tasks.append((seq[0], int(rng.choice(TYPES)),
+                          int(rng.integers(-9, 10)), 8))
+        tasks.sort(key=lambda x: -x[2])
+        reqs = [((tgt - 100) * 50 + 20 + rnd, int(rng.integers(1, 1000)),
+                 [int(rng.choice(TYPES))])]
+        cons = int(rng.integers(0, 3))  # drawn ONCE: both dicts identical
+        for snaps in pair:
+            snaps[tgt] = {"tasks": list(tasks), "reqs": list(reqs),
+                          "consumers": cons, "stamp": t2, "task_stamp": t2}
+
+
+def _assert_filter_parity(a, p, snapsA, snapsP):
+    """Beyond plan equality: the per-rank kept/eligible row sets must
+    match exactly.  Both ledgers re-filter at compare time (the py
+    twin's kept lists are a round-time snapshot, the array ledger's
+    columns are live — this round's plan marks already applied)."""
+    now = time.monotonic()
+    for e, sn in ((a, snapsA), (p, snapsP)):
+        e._ledger.sync(sn, now)
+        e._ledger.filter_reqs(sn, {}, now)
+    for rank in snapsA:
+        assert a._ledger.kept_reqs(rank) == p._ledger.kept_reqs(rank), rank
+        assert a._ledger.elig_tasks(rank) == p._ledger.elig_tasks(rank), rank
+
+
+def _drive(a, p, seed, rounds=14, nservers=8):
+    rng = np.random.default_rng(seed)
+    seq = [0]
+    snapsA = _rand_snaps(rng, nservers, seq, time.monotonic())
+    snapsP = copy.deepcopy(snapsA)
+    pair = (snapsA, snapsP)
+    for rnd in range(rounds):
+        if rnd == 4:
+            # identical far-future in-flight credits: the suppression
+            # budget path (fed types + budget) on both engines
+            far = time.monotonic() + 100.0
+            for e in (a, p):
+                e._planned_in.setdefault(102, []).append(
+                    (far, 2, 10**6, 100, frozenset({1, 2})))
+        mA = a.round(snapsA, None)
+        mP = p.round(snapsP, None)
+        assert mA == mP, (rnd, mA, mP)
+        _assert_filter_parity(a, p, snapsA, snapsP)
+        _mutate(rng, pair, seq, rnd, mA[0])
+
+
+def test_parity_single_device_solver():
+    for seed in range(4):
+        a = _mk_engine("array")
+        p = _mk_engine("py")
+        _drive(a, p, seed)
+
+
+@pytest.fixture(scope="module", params=[1, 2, 8])
+def mesh(request):
+    devs = np.array(jax.devices()[: request.param])
+    return Mesh(devs, axis_names=("s",))
+
+
+def test_parity_sharded_solver(mesh):
+    """Array-ledger view ingest into the sharded solver vs the py twin's
+    materialized-dict path, at mesh 1/2/8 — same plans, same filters."""
+    ndev = mesh.devices.size
+    nservers = 2 * ndev if ndev > 4 else 8
+
+    def dist():
+        return DistributedAssignmentSolver(
+            types=TYPES, max_tasks_per_server=12, max_requesters=6,
+            mesh=mesh, rounds=64,
+            servers_per_device=-(-nservers // ndev),
+        )
+
+    a = _mk_engine("array", dist())
+    p = _mk_engine("py", dist())
+    _drive(a, p, 1000 + ndev, nservers=nservers)
+
+
+def test_no_realloc_and_no_retrace_steady_state():
+    """Steady rounds must neither reallocate the ledger's resident
+    arrays nor retrace the sharded solver's jitted sweep."""
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, axis_names=("s",))
+    eng = _mk_engine("array", DistributedAssignmentSolver(
+        types=TYPES, max_tasks_per_server=12, max_requesters=6, mesh=mesh,
+        rounds=16))
+    rng = np.random.default_rng(3)
+    seq = [0]
+    snaps = _rand_snaps(rng, 8, seq, time.monotonic())
+    eng.round(snaps, None)  # registration/allocation round
+    led = eng._ledger
+    ids = {
+        n: id(getattr(led, n))
+        for n in ("pk_tp", "pk_tt", "pk_rv", "pk_rm", "g_dem", "g_sup",
+                  "g_taskcnt", "g_eligreq")
+    }
+    for rnd in range(12):
+        t = time.monotonic()
+        for tgt in (100, 101):
+            seq[0] += 1
+            snaps[tgt]["tasks"] = [
+                (seq[0], int(rng.choice(TYPES)), int(rng.integers(-9, 10)),
+                 8)
+            ]
+            snaps[tgt]["reqs"] = [
+                ((tgt - 100) * 50 + rnd, int(rng.integers(1, 1000)),
+                 [int(rng.choice(TYPES))])
+            ]
+            snaps[tgt]["stamp"] = snaps[tgt]["task_stamp"] = t
+        eng.round(snaps, None)
+    for n, i in ids.items():
+        assert id(getattr(led, n)) == i, f"{n} reallocated mid-steady-state"
+    assert eng.solver._gather_fn._cache_size() == 1
+    assert led.patch_count > 0
+    # the fast path really carried the rounds: no cadence resync yet
+    assert led.resync_count == 0
+
+
+def test_direct_plan_dict_pokes_stay_coherent():
+    """Tests (and future code) poke engine._planned_tasks/_planned_reqs
+    directly; the array ledger's columns must follow via the dict
+    hooks — including deletes (the prune path)."""
+    a = _mk_engine("array")
+    p = _mk_engine("py")
+    t0 = time.monotonic()
+    snaps = {
+        10: {"tasks": [(1, 1, 5, 8), (2, 2, 4, 8)], "reqs": [],
+             "consumers": 1, "stamp": t0, "task_stamp": t0},
+        11: {"tasks": [], "reqs": [(5, 1, [1]), (6, 2, [2])],
+             "consumers": 1, "stamp": t0, "task_stamp": t0},
+    }
+    snaps2 = copy.deepcopy(snaps)
+    now = time.monotonic()
+    for e, sn in ((a, snaps), (p, snaps2)):
+        e._ledger.sync(sn, now)
+        e._ledger.filter_reqs(sn, {}, now)
+    # poke AFTER the array columns exist: mark task (10, 1) and req
+    # (11, 6, 2) planned in the future — the dict hooks must keep the
+    # columns live
+    for e in (a, p):
+        e._planned_tasks[(10, 1)] = t0 + 100.0
+        e._planned_reqs[(11, 6, 2)] = t0 + 100.0
+    assert a._ledger.elig_tasks(10) == p._ledger.elig_tasks(10) == [
+        (2, 2, 4, 8)]
+    # only the unmarked pair remains — and it is type-incompatible, so
+    # no plan on either engine
+    mA, mP = a.round(snaps, None), p.round(snaps2, None)
+    assert mA == mP == ([], [])
+    _assert_filter_parity(a, p, snaps, snaps2)
+    # delete the marks (what pruning does) — both become eligible again
+    for e in (a, p):
+        del e._planned_tasks[(10, 1)]
+        del e._planned_reqs[(11, 6, 2)]
+    mA, mP = a.round(snaps, None), p.round(snaps2, None)
+    assert mA == mP and len(mA[0]) == 2
+    _assert_filter_parity(a, p, snaps, snaps2)
+
+
+def test_pump_precheck_parity_fuzz():
+    """The vectorized _maybe_imbalanced twin answers exactly like the
+    Python pre-check over random synced instances (consumers, raw
+    counts, windows, planned-away edges)."""
+    rng = np.random.default_rng(11)
+    for trial in range(30):
+        eng = _mk_engine("array")
+        seq = [0]
+        t0 = time.monotonic()
+        snaps = _rand_snaps(rng, int(rng.integers(2, 8)), seq, t0)
+        # sprinkle planned-away marks over some listed tasks
+        for rank, snap in snaps.items():
+            for tk in snap["tasks"]:
+                if rng.random() < 0.3:
+                    eng._planned_tasks[(rank, tk[0])] = (
+                        t0 + (1.0 if rng.random() < 0.5 else -100.0))
+        # random adaptive windows
+        for rank in snaps:
+            if rng.random() < 0.4:
+                eng._look[rank] = float(rng.integers(8, 64))
+        now = time.monotonic()
+        eng._ledger.sync(snaps, now)
+        fast = eng._ledger.maybe_imbalanced(eng, snaps)
+        assert fast is not None, "ledger should be synced here"
+        assert fast == eng._maybe_imbalanced(snaps), (trial, snaps)
+
+
+def test_unsynced_direct_call_falls_back():
+    """maybe_imbalanced on a dict the ledger never synced returns None
+    (the engine then runs the Python pre-check) — the contract the
+    pre-existing direct-call unit tests rely on."""
+    eng = _mk_engine("array")
+    snaps = {
+        10: {"tasks": [(1, 1, 1, 8)], "reqs": [], "consumers": 1},
+        11: {"tasks": [], "reqs": [], "consumers": 1},
+    }
+    assert eng._ledger.maybe_imbalanced(eng, snaps) is None
+    assert isinstance(eng._maybe_imbalanced(snaps), bool)
