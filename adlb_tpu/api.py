@@ -332,7 +332,20 @@ def join_world(
     binary_peers = (
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
-    ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers)
+    from adlb_tpu.runtime.codec import select_codec
+
+    select_codec(cfg.codec)
+    if cfg.tcp_mux == "on":
+        # no silent fallback for an explicit ask (the codec="c" rule):
+        # the rendezvous-file harness has no broker publication yet —
+        # the channel plane is spawn_world-only today (ROADMAP item 5)
+        raise ValueError(
+            "tcp_mux='on' requires a harness that runs a channel broker "
+            "(spawn_world today); the rendezvous launcher still runs "
+            "per-pair TCP"
+        )
+    ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers,
+                     compress_min=cfg.compress_min_bytes)
     # shm ring fabric toward same-host ranks (the launcher exports
     # ADLB_FABRIC/ADLB_SHM_KEY; a bare join derives the key from the
     # rendezvous directory, so all parties of one world agree)

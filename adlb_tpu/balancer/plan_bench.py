@@ -340,11 +340,25 @@ def main(argv=None) -> int:
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             out = run()
+        _stamp_provenance(out)
         sys.stdout.write(json.dumps(out) + "\n")
     else:
         out = run()
+        _stamp_provenance(out)
         print(json.dumps(out))
     return 0
+
+
+def _stamp_provenance(out) -> None:
+    """Core count + load on every MULTICHIP record (the r07 caveat made
+    policy): scheduler-bound numbers from a 1-core box must be readable
+    as such, and bench_guard skips-with-note across core-count changes."""
+    if isinstance(out, dict):
+        import os as _os
+
+        out.setdefault("cpu_count", _os.cpu_count() or 1)
+        if hasattr(_os, "getloadavg"):
+            out.setdefault("loadavg_1m", round(_os.getloadavg()[0], 2))
 
 
 if __name__ == "__main__":

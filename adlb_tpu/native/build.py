@@ -119,6 +119,122 @@ def build_error() -> Optional[str]:
     return _build_error
 
 
+# ------------------------------------------------------------------ codec
+
+_CODEC_SRC = os.path.join(_DIR, "codec.cpp")
+_CODEC_LIB = os.path.join(_DIR, "libadlbcodec.so")
+_CODEC_ERRMARK = os.path.join(_DIR, "libadlbcodec.err")
+
+
+def _errmark_paths() -> list:
+    """Candidate failed-compile marker locations: the package dir, then
+    a tempdir fallback keyed on the source path — a read-only
+    site-packages must still be able to record "this compile is doomed"
+    so every spawned rank doesn't re-pay the failed g++ at import."""
+    import hashlib
+    import tempfile
+
+    h = hashlib.sha1(_CODEC_SRC.encode()).hexdigest()[:12]
+    return [
+        _CODEC_ERRMARK,
+        os.path.join(tempfile.gettempdir(), f"adlbcodec.{h}.err"),
+    ]
+
+_codec_lock = threading.Lock()
+_codec_lib = None  # the _adlbcodec module object once loaded
+_codec_error: Optional[str] = None
+
+
+def _compile_codec() -> None:
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    if not os.path.exists(os.path.join(inc, "Python.h")):
+        raise OSError(f"Python.h not found under {inc}")
+    tmp = f"{_CODEC_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", f"-I{inc}",
+        "-o", tmp, _CODEC_SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _CODEC_LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _bind_codec(lib: ctypes.PyDLL):
+    # PyDLL (the wqcore O(1)-getter discipline, GIL held throughout): the
+    # ONE ctypes call asks the library for a fully-formed module object,
+    # whose encode/decode are METH_FASTCALL builtins — per-frame calls
+    # cost a builtin vector call, not a ctypes FFI marshal
+    lib.adlb_codec_module.restype = ctypes.py_object
+    lib.adlb_codec_module.argtypes = []
+    return lib.adlb_codec_module()
+
+
+def ensure_codec():
+    """Build (if stale) and load the compiled TLV codec; returns the
+    codec MODULE object, or None (recording why) when the toolchain or
+    headers are unavailable.
+
+    A failed compile writes a marker stamped with the source mtime so
+    every subsequently spawned rank skips the doomed g++ attempt instead
+    of paying it per process (spawn worlds fork dozens)."""
+    global _codec_lib, _codec_error
+    with _codec_lock:
+        if _codec_lib is not None:
+            return _codec_lib
+        if _codec_error is not None:
+            return None
+        src_mtime = os.path.getmtime(_CODEC_SRC)
+        try:
+            if (
+                not os.path.exists(_CODEC_LIB)
+                or os.path.getmtime(_CODEC_LIB) < src_mtime
+            ):
+                for mark in _errmark_paths():
+                    try:
+                        with open(mark) as f:
+                            if float(f.read().split("\n", 1)[0]) \
+                                    == src_mtime:
+                                _codec_error = (
+                                    "codec build failed previously "
+                                    f"(see {mark})"
+                                )
+                                return None
+                    except (OSError, ValueError):
+                        continue
+                _compile_codec()
+                for mark in _errmark_paths():
+                    try:
+                        os.unlink(mark)
+                    except OSError:
+                        pass
+            _codec_lib = _bind_codec(ctypes.PyDLL(_CODEC_LIB))
+            return _codec_lib
+        except AttributeError as e:
+            # a stale .so predating the module-object entrypoint
+            _codec_error = f"compiled codec unavailable: {e}"
+            return None
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _codec_error = f"compiled codec unavailable: {detail[:500]}"
+            for mark in _errmark_paths():
+                try:
+                    with open(mark, "w") as f:
+                        f.write(f"{src_mtime}\n{_codec_error}\n")
+                    break  # first writable location wins
+                except OSError:
+                    continue
+            return None
+
+
+def codec_error() -> Optional[str]:
+    return _codec_error
+
+
 # ---------------------------------------------------------------- serverd
 
 _SERVERD_SRC = os.path.join(_DIR, "serverd.cpp")

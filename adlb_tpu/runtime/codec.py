@@ -29,6 +29,7 @@ in sync.
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import struct
 
@@ -373,7 +374,7 @@ def _bytes_view(value):
     return bytes(value)
 
 
-def encode_binary_iov(m: Msg) -> list:
+def encode_binary_iov_py(m: Msg) -> list:
     """Scatter-gather form of :func:`encode_binary`: a list of buffers
     whose concatenation is the frame body, with large ``bytes`` payloads
     (put/fetch bodies, batch payload lists) left as zero-copy views
@@ -434,11 +435,7 @@ def encode_binary_iov(m: Msg) -> list:
     return parts
 
 
-def encode_binary(m: Msg) -> bytes:
-    return b"".join(encode_binary_iov(m))
-
-
-def decode_binary(body: bytes) -> Msg:
+def decode_binary_py(body) -> Msg:
     magic, wire_tag, src, nfields = _HDR.unpack_from(body, 0)
     if magic != BINARY_MAGIC:
         raise ValueError(f"bad binary frame magic {magic:#x}")
@@ -495,3 +492,124 @@ def decode_binary(body: bytes) -> Msg:
     if "hang" in data:
         data["hang"] = bool(data["hang"])
     return Msg(tag=tag, src=src, data=data)
+
+
+# --------------------------------------------------------- compiled twin
+#
+# The hot-path encode/decode pair also exists as a C core
+# (adlb_tpu/native/codec.cpp, built like wqcore by native/build.py and
+# loaded through ctypes.PyDLL — the PR 7 O(1)-getter discipline: GIL
+# held, PyObjects in and out, one plain C call per frame). The Python
+# implementations above are retained verbatim as the fallback/reference
+# twin; tests/test_codec_fuzz.py holds the two byte-identical in both
+# directions. Selection is per-process at import, like wqcore:
+# ``ADLB_CODEC`` env ("auto"/"c"/"py", default auto = C when the .so
+# builds) decides the initial implementation, and the world harnesses
+# re-apply ``Config(codec=...)`` via :func:`select_codec` ("c" there is
+# strict — no silent fallback for an explicit ask).
+
+_codec_active = "py"
+_c_encode_iov = None
+_c_decode = None
+
+
+def _load_c_codec() -> bool:
+    """Bind the compiled codec (building it if needed); False + recorded
+    reason when the toolchain is unavailable."""
+    global _c_encode_iov, _c_decode
+    if _c_encode_iov is not None:
+        return True
+    from adlb_tpu.native.build import ensure_codec
+
+    mod = ensure_codec()
+    if mod is None:
+        return False
+    # hand the C core the live protocol tables — same objects, so the
+    # twins cannot drift within a process
+    mod.setup(FIELDS, IOV_INLINE_MAX, WIRE_TAG, TAG_FOR_WIRE, Msg)
+    _c_encode_iov = mod.encode_iov
+    _c_decode = mod.decode
+    return True
+
+
+_ENC_IOV = encode_binary_iov_py
+_DEC = decode_binary_py
+
+
+def select_codec(which: str = "auto") -> str:
+    """Pick the wire-codec implementation for this process: "py" forces
+    the Python twin, "c" requires the compiled core (RuntimeError when it
+    cannot build), "auto" uses the compiled core when available. Returns
+    the implementation now active."""
+    global _ENC_IOV, _DEC, _codec_active
+    if which not in ("auto", "c", "py"):
+        raise ValueError(f"unknown codec {which!r}")
+    if which == "py":
+        _ENC_IOV, _DEC, _codec_active = encode_binary_iov_py, decode_binary_py, "py"
+    elif _load_c_codec():
+        _ENC_IOV, _DEC, _codec_active = _c_encode_iov, _c_decode, "c"
+    elif which == "c":
+        from adlb_tpu.native.build import codec_error
+
+        raise RuntimeError(
+            f"Config(codec='c') but the compiled codec is unavailable: "
+            f"{codec_error()}"
+        )
+    else:
+        _ENC_IOV, _DEC, _codec_active = encode_binary_iov_py, decode_binary_py, "py"
+    return _codec_active
+
+
+def active_codec() -> str:
+    """Which implementation carries this process's frames ("c"/"py")."""
+    return _codec_active
+
+
+def encode_binary_iov(m: Msg) -> list:
+    """Scatter-gather frame encode via the active implementation (see
+    :func:`select_codec`); the docstring of record is on the Python twin
+    :func:`encode_binary_iov_py`."""
+    return _ENC_IOV(m)
+
+
+def decode_binary(body) -> Msg:
+    return _DEC(body)
+
+
+def encode_binary(m: Msg) -> bytes:
+    return b"".join(bytes(p) for p in _ENC_IOV(m))
+
+
+# import-time selection, like wqcore: the env override is the CI hook
+select_codec(os.environ.get("ADLB_CODEC", "auto").strip().lower() or "auto")
+
+
+# ------------------------------------------------------ wire-native gate
+
+
+_WIRE_NATIVE = (int, float, bytes, bytearray, memoryview)
+
+
+def wire_native_ok(m: Msg) -> bool:
+    """Should this python<->python frame ride the TLV body instead of
+    pickle (shm rings and multiplexed TCP channels both ask)? Only
+    client<->server traffic — the put/fetch hot path, whose
+    TLV-into-Python-server decode is proven by the native C clients —
+    and only when every value is wire-native: a str (checkpoint path,
+    forfeit op) or richer object would round-trip as a different type
+    than the pickle plane delivers, so those frames keep the pickle
+    body."""
+    name = m.tag.name
+    if not (name.startswith("FA_") or name.startswith("TA_")
+            or m.tag is Tag.AM_APP):
+        return False
+    if not encodable(m):
+        return False
+    for v in m.data.values():
+        if v is None or isinstance(v, _WIRE_NATIVE):
+            continue
+        if isinstance(v, (list, tuple, frozenset, set)):
+            if all(isinstance(x, _WIRE_NATIVE) for x in v):
+                continue
+        return False
+    return True

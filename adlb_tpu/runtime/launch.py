@@ -220,6 +220,22 @@ def main(argv=None) -> int:
                  wal_dir=args.wal_dir,
                  wal_fsync_ms=args.wal_fsync_ms,
                  fault_spec=fault_spec)
+    # per-process wire-codec selection (ADLB_CODEC env is the exec'd
+    # app ranks' hook; in-launcher server reactors select here).
+    # NOTE: the multi-host launcher still runs per-pair TCP across
+    # hosts — publishing a per-host broker through the rendezvous dir
+    # (one `broker.<host>.addr` file, ranks attaching like spawn_world's)
+    # is the named follow-up that turns tcp_mux="auto" on for fleets.
+    from adlb_tpu.runtime.codec import select_codec
+
+    select_codec(cfg.codec)
+    if cfg.tcp_mux == "on":
+        # explicit ask, no broker here yet: fail loudly (codec="c" rule)
+        raise ValueError(
+            "tcp_mux='on' requires a harness that runs a channel broker "
+            "(spawn_world today); the rendezvous launcher still runs "
+            "per-pair TCP"
+        )
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
     rdv = args.rendezvous

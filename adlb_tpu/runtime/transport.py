@@ -45,6 +45,15 @@ class InProcEndpoint:
         self.metrics = None
         self._tx_stats: dict = {}
 
+    def submit_begin(self) -> None:
+        """Submission batching is a wire-transport concern (deferred
+        doorbells / coalesced channel gathers); in-proc delivery is one
+        queue put, so the batch surface is a no-op here — kept so role
+        code can bracket bursts transport-agnostically."""
+
+    def submit_flush(self) -> None:
+        pass
+
     def send(self, dest: int, m: Msg, connect_grace: float = 0.0) -> None:
         # connect_grace is a TCP-endpoint knob; accepted (and ignored)
         # here so role code can pass it transport-agnostically

@@ -60,9 +60,9 @@ from typing import Optional
 
 from adlb_tpu.runtime.codec import (
     decode_binary,
-    encodable,
     encode_binary_iov,
     loads_restricted,
+    wire_native_ok,
 )
 from adlb_tpu.runtime.messages import Msg, Tag, msg
 
@@ -304,30 +304,16 @@ class Doorbell:
                 pass
 
 
-_WIRE_NATIVE = (int, float, bytes, bytearray, memoryview)
+# the TLV-vs-pickle body decision is shared with the multiplexed TCP
+# channel plane and lives in the codec module (codec.wire_native_ok)
 
 
-def _ring_tlv_ok(m: Msg) -> bool:
-    """Use the scatter-gather TLV body for this frame? Only client<->
-    server traffic (the put/fetch hot path — the TLV-into-Python-server
-    decode is already proven by the native C clients), and only when
-    every value is wire-native: a str (checkpoint path, forfeit op) or
-    richer object would round-trip as a different type than the pickle
-    plane delivers, so those frames keep the pickle body."""
-    name = m.tag.name
-    if not (name.startswith("FA_") or name.startswith("TA_")
-            or m.tag is Tag.AM_APP):
-        return False
-    if not encodable(m):
-        return False
-    for v in m.data.values():
-        if v is None or isinstance(v, _WIRE_NATIVE):
-            continue
-        if isinstance(v, (list, tuple, frozenset, set)):
-            if all(isinstance(x, _WIRE_NATIVE) for x in v):
-                continue
-        return False
-    return True
+class _BellBatch(threading.local):
+    """Per-thread submit-batch state for the ring fabric: destinations
+    whose bells are owed, rung once at flush."""
+
+    depth = 0
+    pending: "Optional[dict]" = None
 
 
 class _RxState:
@@ -387,6 +373,11 @@ class ShmEndpoint:
         self.doorbell_suppressed = 0
         self.shm_frames_tx = 0
         self.shm_frames_rx = 0
+        # submit batching: per-thread deferred doorbells — a reactor
+        # tick's burst of N ring writes rings each destination's bell
+        # ONCE at submit_flush instead of per frame (the PR 8 named
+        # follow-up; composes with the _rung suppression below)
+        self._submit = _BellBatch()
         self._bell = Doorbell(self._bell_path(self.rank), create=True)
         self._bell.open_write()  # self-notify end for the TCP hooks
         tcp_ep.notify = self._bell.ring
@@ -502,7 +493,7 @@ class ShmEndpoint:
         # scatter-gather TLV when every field has a wire id (the whole
         # put/fetch hot path), restricted pickle otherwise; the reader
         # discriminates on the first body byte exactly like the TCP plane
-        if _ring_tlv_ok(m):
+        if wire_native_ok(m):
             parts = encode_binary_iov(m)
         else:
             parts = [pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)]
@@ -583,6 +574,16 @@ class ShmEndpoint:
                     )
                 time.sleep(sleep_s)
                 sleep_s = min(sleep_s * 2, _FULL_SLEEP_MAX)
+        st = self._submit
+        if st.depth > 0 and st.pending is not None:
+            # submit batch: the bell is owed, not rung — submit_flush
+            # rings each pending destination once (the frame is already
+            # IN the ring, so the deferral moves only the wakeup)
+            st.pending[dest] = (ring, bell)
+            return
+        self._ring_bell(dest, ring, bell)
+
+    def _ring_bell(self, dest: int, ring: ShmRing, bell: Doorbell) -> None:
         tail = ring._tail()
         last = self._rung.get(dest, -1)
         if last >= 0 and ring._head() < last:
@@ -590,6 +591,25 @@ class ShmEndpoint:
         else:
             bell.ring()
             self._rung[dest] = tail
+
+    # -- submit batching ------------------------------------------------------
+
+    def submit_begin(self) -> None:
+        st = self._submit
+        st.depth += 1
+        if st.pending is None:
+            st.pending = {}
+        self._tcp.submit_begin()
+
+    def submit_flush(self) -> None:
+        st = self._submit
+        if st.depth > 0:
+            st.depth -= 1
+        if st.depth == 0 and st.pending:
+            pending, st.pending = st.pending, {}
+            for dest, (ring, bell) in pending.items():
+                self._ring_bell(dest, ring, bell)
+        self._tcp.submit_flush()
 
     # -- recv ----------------------------------------------------------------
 

@@ -22,15 +22,31 @@ import threading
 import time
 from typing import Optional
 
+from adlb_tpu.runtime.channel import data_envelope as _data_envelope
 from adlb_tpu.runtime.codec import (
     decode_binary,
     encodable,
     encode_binary_iov,
     loads_restricted,
+    wire_native_ok,
 )
 from adlb_tpu.runtime.messages import Msg, Tag
 
 _HDR = struct.Struct("<I")
+
+# sentinel: _deliver_body refused a frame in a way that must close a
+# per-pair connection (hostile pickle); the channel plane drops instead
+_REFUSED = object()
+
+
+class _SubmitBatch(threading.local):
+    """Per-thread submit-batch state (see TcpEndpoint.submit_begin):
+    channel-plane envelopes accumulated between begin/flush so a burst
+    of N frames costs one gather syscall, not N."""
+
+    depth = 0
+    envs: Optional[list] = None
+    saved = 0
 
 # staggers the rendezvous-port probe start for successive worlds created
 # by the same process (see local_addr_map)
@@ -49,6 +65,8 @@ class TcpEndpoint:
         rank: int,
         addr_map: dict[int, tuple[str, int]],
         binary_peers: Optional[set[int]] = None,
+        mux: Optional[tuple[str, int]] = None,
+        compress_min: int = 0,
     ) -> None:
         self.rank = rank
         self.addr_map = dict(addr_map)
@@ -77,6 +95,19 @@ class TcpEndpoint:
         # no shm wrapper is stacked on this endpoint.
         self.notify = None
         self.shm_ctl = None
+        # multiplexed channel plane (adlb_tpu/runtime/channel.py): when a
+        # broker address is given, python<->python traffic rides (src,
+        # dst, frame) envelopes over ONE socket to the host's broker —
+        # O(hosts^2) fleet sockets — while native peers (binary TLV,
+        # no envelope support) keep direct per-pair connections, which
+        # is also why the listener below stays up under the mux.
+        self._mux = None
+        self._compress_min = int(compress_min)
+        self._submit = _SubmitBatch()
+        self._g_ch = None       # tcp_channels_open gauge, cached
+        self._c_coal = None     # frames_coalesced counter, cached
+        self._c_comp = None     # bytes_compressed counter, cached
+        self._h_enc = None      # codec_encode_us histogram, cached
 
         host, port = self.addr_map[rank]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -89,6 +120,10 @@ class TcpEndpoint:
             target=self._accept_loop, daemon=True, name=f"adlb-tcp-acceptor-{rank}"
         )
         self._acceptor.start()
+        if mux is not None:
+            from adlb_tpu.runtime.channel import ChannelClient
+
+            self._mux = ChannelClient(self, mux, compress_min)
 
     @property
     def port(self) -> int:
@@ -104,6 +139,78 @@ class TcpEndpoint:
                 target=self._reader, args=(conn,), daemon=True
             ).start()
 
+    def _deliver_body(self, body, learn_binary: bool = True):
+        """Decode one frame body (first-byte pickle/TLV discrimination)
+        and deliver it: rx accounting, SHM_HELLO swallowing, inbox put,
+        notify. Shared by the per-pair reader threads and the channel
+        plane's client. Returns the Msg, None for a dropped binary
+        frame or a swallowed HELLO, or ``_REFUSED`` for a frame whose
+        unpickle was refused (the per-pair reader closes on it; the
+        channel plane drops and keeps the shared channel up)."""
+        if body[:1] == b"\x01":
+            try:
+                m = decode_binary(body)
+            except Exception as e:  # noqa: BLE001 — stale C peer
+                # A malformed frame (e.g. a native client built against
+                # stale codec tables) must be diagnosable, not a silent
+                # reader-thread death + peer hang.
+                import sys
+
+                print(
+                    f"[adlb tcp rank {self.rank}] dropping "
+                    f"undecodable binary frame ({len(body)}B): {e!r}",
+                    file=sys.stderr,
+                )
+                return None
+            if learn_binary:
+                # inbound TLV on a DIRECT connection marks a native
+                # client; TLV over the channel plane is just a python
+                # peer's wire-native frame and must not re-route our
+                # replies off the mux
+                self.binary_peers.add(m.src)
+        else:
+            try:
+                m = loads_restricted(body)
+                if not isinstance(m, Msg):
+                    raise pickle.UnpicklingError(
+                        f"frame unpickled to "
+                        f"{type(m).__name__}, not Msg"
+                    )
+            except Exception as e:  # noqa: BLE001 — hostile bytes
+                import sys
+
+                print(
+                    f"[adlb tcp rank {self.rank}] refusing "
+                    f"unpicklable frame ({len(body)}B): {e!r}",
+                    file=sys.stderr,
+                )
+                return _REFUSED
+        if m.tag is Tag.SHM_HELLO:
+            # ring-attach announcement: hand the frame to the shm
+            # wrapper instead of the role's inbox (the connection — or
+            # channel attachment — it rode is the pair's death sentinel)
+            ctl = self.shm_ctl
+            if ctl is not None:
+                ctl(m)
+            return m
+        reg = self.metrics
+        if reg is not None:
+            st = self._rx_stats.get(m.tag)
+            if st is None:
+                st = self._rx_stats[m.tag] = (
+                    reg.counter("rx_msgs", tag=m.tag.name),
+                    reg.counter("rx_bytes", tag=m.tag.name),
+                )
+            st[0].inc()
+            # header included, so a rank's rx_bytes reconciles
+            # with its peers' tx_bytes (which count the frame)
+            st[1].inc(_HDR.size + len(body))
+        self.inbox.put(m)
+        cb = self.notify
+        if cb is not None:
+            cb()
+        return m
+
     def _reader(self, conn: socket.socket) -> None:
         last_src: Optional[int] = None
         try:
@@ -115,71 +222,17 @@ class TcpEndpoint:
                 body = self._read_exact(conn, n)
                 if body is None:
                     return
-                if body[:1] == b"\x01":
-                    try:
-                        m = decode_binary(body)
-                    except Exception as e:  # noqa: BLE001 — stale C peer
-                        # A malformed frame (e.g. a native client built
-                        # against stale codec tables) must be diagnosable,
-                        # not a silent reader-thread death + peer hang.
-                        import sys
-
-                        print(
-                            f"[adlb tcp rank {self.rank}] dropping "
-                            f"undecodable binary frame ({len(body)}B): {e!r}",
-                            file=sys.stderr,
-                        )
-                        continue
-                    self.binary_peers.add(m.src)
-                else:
-                    try:
-                        m = loads_restricted(body)
-                        if not isinstance(m, Msg):
-                            raise pickle.UnpicklingError(
-                                f"frame unpickled to "
-                                f"{type(m).__name__}, not Msg"
-                            )
-                    except Exception as e:  # noqa: BLE001 — hostile bytes
-                        import sys
-
-                        print(
-                            f"[adlb tcp rank {self.rank}] refusing "
-                            f"unpicklable frame ({len(body)}B): {e!r}",
-                            file=sys.stderr,
-                        )
-                        # close the connection either way: for a
-                        # never-established stray connection (last_src is
-                        # None) nothing else happens; for an established
-                        # peer stream the finally below synthesizes
-                        # PEER_EOF — the rank-death fail-fast — rather
-                        # than silently dropping a frame someone awaits
-                        return
-                last_src = m.src
-                if m.tag is Tag.SHM_HELLO:
-                    # ring-attach announcement: record the sender (this
-                    # connection is now the pair's death sentinel — its
-                    # EOF synthesizes PEER_EOF below) and hand the frame
-                    # to the shm wrapper instead of the role's inbox
-                    ctl = self.shm_ctl
-                    if ctl is not None:
-                        ctl(m)
-                    continue
-                reg = self.metrics
-                if reg is not None:
-                    st = self._rx_stats.get(m.tag)
-                    if st is None:
-                        st = self._rx_stats[m.tag] = (
-                            reg.counter("rx_msgs", tag=m.tag.name),
-                            reg.counter("rx_bytes", tag=m.tag.name),
-                        )
-                    st[0].inc()
-                    # header included, so a rank's rx_bytes reconciles
-                    # with its peers' tx_bytes (which count the frame)
-                    st[1].inc(_HDR.size + len(body))
-                self.inbox.put(m)
-                cb = self.notify
-                if cb is not None:
-                    cb()
+                m = self._deliver_body(body)
+                if m is _REFUSED:
+                    # close the connection: for a never-established
+                    # stray connection (last_src is None) nothing else
+                    # happens; for an established peer stream the
+                    # finally below synthesizes PEER_EOF — the
+                    # rank-death fail-fast — rather than silently
+                    # dropping a frame someone awaits
+                    return
+                if m is not None:
+                    last_src = m.src
         except OSError:
             return
         finally:
@@ -223,8 +276,24 @@ class TcpEndpoint:
                 time.sleep(0.05)
 
     def send(self, dest: int, m: Msg, connect_grace: float = 15.0) -> None:
+        reg = self.metrics
+        # channel-plane routing: python peers ride the broker; native
+        # peers (binary TLV, no envelope support) and self keep direct
+        # per-pair sockets
+        mux = self._mux
+        if mux is not None and (dest == self.rank
+                                or dest in self.binary_peers):
+            mux = None
+        if mux is not None and dest in mux.dead:
+            # sends to a dead peer must fail like a refused reconnect,
+            # not vanish into a dropped envelope
+            raise OSError(
+                f"channel plane: rank {dest} is dead (DETACH seen)"
+            )
         # serialization (pickle/TLV encode) runs OUTSIDE the send lock:
         # only socket I/O is serialized per destination
+        t_enc = time.monotonic() if reg is not None else 0.0
+        tlv = False
         if dest in self.binary_peers:
             if not encodable(m):
                 raise ValueError(
@@ -234,33 +303,55 @@ class TcpEndpoint:
             # scatter-gather encode: the payload views ride the iovec
             # straight into sendmsg — no body-concat copy on the hot path
             parts = encode_binary_iov(m)
+            tlv = True
+        elif mux is not None and wire_native_ok(m):
+            # the channel plane carries TLV for the wire-native hot path
+            # (same body rule as the shm rings), pickle for the rest
+            parts = encode_binary_iov(m)
+            tlv = True
         else:
             parts = [pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL)]
         nbody = sum(len(p) for p in parts)
-        frame = [_HDR.pack(nbody), *parts]
-        reg = self.metrics
         t0 = time.monotonic() if reg is not None else 0.0
-        # per-destination serialization: a slow/dead peer (15 s connect
-        # retry) must not stall sends to every other rank
-        with self._out_lock:
-            dlock = self._dest_locks.setdefault(dest, threading.Lock())
-        with dlock:
+        if reg is not None and tlv:
+            if self._h_enc is None:
+                self._h_enc = reg.histogram("codec_encode_us")
+            self._h_enc.observe((t0 - t_enc) * 1e6)
+        if mux is not None:
+            env, saved = _data_envelope(self.rank, dest, parts, nbody,
+                                        self._compress_min)
+            if saved and reg is not None:
+                if self._c_comp is None:
+                    self._c_comp = reg.counter("bytes_compressed")
+                self._c_comp.inc(saved)
+            st_b = self._submit
+            if st_b.depth > 0 and st_b.envs is not None:
+                st_b.envs.append(env)  # one gather at submit_flush
+            else:
+                mux.send_batch([env])
+        else:
+            frame = [_HDR.pack(nbody), *parts]
+            # per-destination serialization: a slow/dead peer (15 s
+            # connect retry) must not stall sends to every other rank
             with self._out_lock:
-                sock = self._out.get(dest)
-            if sock is None:
-                sock = self._connect(dest, connect_grace)
+                dlock = self._dest_locks.setdefault(dest, threading.Lock())
+            with dlock:
                 with self._out_lock:
-                    self._out[dest] = sock
-            try:
-                self._send_iov(sock, frame)
-            except OSError:
-                # one reconnect attempt (a FRESH stream, so restarting the
-                # frame from its first byte is safe); beyond that the
-                # watchdog handles it
-                sock = self._connect(dest, connect_grace)
-                with self._out_lock:
-                    self._out[dest] = sock
-                self._send_iov(sock, frame)
+                    sock = self._out.get(dest)
+                if sock is None:
+                    sock = self._connect(dest, connect_grace)
+                    with self._out_lock:
+                        self._out[dest] = sock
+                try:
+                    self._send_iov(sock, frame)
+                except OSError:
+                    # one reconnect attempt (a FRESH stream, so
+                    # restarting the frame from its first byte is safe);
+                    # beyond that the watchdog handles it
+                    sock = self._connect(dest, connect_grace)
+                    with self._out_lock:
+                        self._out[dest] = sock
+                    self._send_iov(sock, frame)
         if reg is not None:
             st = self._tx_stats.get(m.tag)
             if st is None:
@@ -276,6 +367,46 @@ class TcpEndpoint:
             if self._h_send is None:
                 self._h_send = reg.histogram("send_s")
             self._h_send.observe(time.monotonic() - t0)
+            # data-plane socket census: direct per-pair sockets plus the
+            # one channel to the broker (the O(1)-per-host-pair claim,
+            # scraped off /metrics as tcp_channels_open)
+            if self._g_ch is None:
+                self._g_ch = reg.gauge("tcp_channels_open")
+            self._g_ch.set(len(self._out) + (1 if self._mux else 0))
+
+    # -- submit batching ------------------------------------------------------
+
+    def submit_begin(self) -> None:
+        """Enter a per-thread submission batch: channel-plane sends
+        accumulate and go out as ONE gather at :meth:`submit_flush` (a
+        reactor tick's burst of N responses costs O(1) syscalls and
+        wakeups). Per-pair sockets stay synchronous — their error
+        surface (reconnect-at-caller) must not move to the flush point.
+        Nests; only the outermost flush submits."""
+        st = self._submit
+        st.depth += 1
+        if st.envs is None:
+            st.envs = []
+
+    def submit_flush(self) -> None:
+        st = self._submit
+        if st.depth > 0:
+            st.depth -= 1
+        if st.depth > 0:
+            return
+        envs, st.envs = st.envs, None
+        if not envs:
+            return
+        mux = self._mux
+        if mux is None:  # closed mid-batch
+            return
+        mux.send_batch(envs)
+        if len(envs) > 1:
+            reg = self.metrics
+            if reg is not None:
+                if self._c_coal is None:
+                    self._c_coal = reg.counter("frames_coalesced")
+                self._c_coal.inc(len(envs) - 1)
 
     @staticmethod
     def _send_iov(sock: socket.socket, parts: list) -> None:
@@ -295,6 +426,11 @@ class TcpEndpoint:
             TcpEndpoint._send_iov(sock, head)
         try:
             sent = sock.sendmsg(parts)
+        except InterruptedError:
+            # EINTR surfaced by a raising signal handler: nothing was
+            # written, resume the same gather (PEP 475 auto-retries the
+            # silent case; this covers the loud one)
+            sent = 0
         except (AttributeError, NotImplementedError):  # platform without
             for p in parts:  # sendmsg: plain per-segment writes
                 sock.sendall(p)
@@ -310,7 +446,10 @@ class TcpEndpoint:
                 rest.append(memoryview(p)[sent:] if sent else p)
                 sent = 0
             parts = rest
-            sent = sock.sendmsg(parts)
+            try:
+                sent = sock.sendmsg(parts)
+            except InterruptedError:
+                sent = 0
 
     def backlog(self) -> int:
         """Received-but-unhandled frames — the TCP-era analogue of the
@@ -348,6 +487,12 @@ class TcpEndpoint:
 
     def close(self) -> None:
         self._closed = True
+        mux, self._mux = self._mux, None
+        if mux is not None:
+            # FIN after our queued envelopes: the broker forwards them,
+            # then fans out our DETACH — peers see our last frames
+            # before the PEER_EOF, exactly like the per-pair plane
+            mux.close()
         with self._out_lock:
             for s in self._out.values():
                 # Outbound sockets are unidirectional (replies arrive on the
@@ -514,7 +659,7 @@ def _native_server_main(rank, world, cfg, port_q, conn, result_q, abort_event):
 
 
 def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event,
-                shm_key=None):
+                shm_key=None, mux_addr=None):
     """One rank's process body: bind, rendezvous, run role, report result.
 
     Exactly one message goes on result_q per rank — the parent counts ranks,
@@ -534,12 +679,21 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event,
             reported = True
             result_q.put((kind, rank, value))
 
+    # per-process codec selection (Config(codec) beats the import-time
+    # env default; "c" is strict — an explicit ask must not silently
+    # fall back to the Python twin)
+    from adlb_tpu.runtime.codec import select_codec
+
+    select_codec(cfg.codec)
+
     # with native servers, Python ranks must speak the binary codec toward
     # every server rank (the daemon cannot read pickle frames)
     binary_peers = (
         set(world.server_ranks) if cfg.server_impl == "native" else None
     )
-    ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)}, binary_peers=binary_peers)
+    ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)},
+                     binary_peers=binary_peers, mux=mux_addr,
+                     compress_min=cfg.compress_min_bytes)
     if shm_key:
         # same-host ranks upgrade to the shared-memory ring fabric; the
         # fault shim stacks OUTSIDE it, so injected faults apply to ring
@@ -653,6 +807,16 @@ def spawn_world(
 
     shm_key = new_world_key() if resolve_fabric(cfg) == "shm" else None
 
+    # channel plane (Config(tcp_mux) / ADLB_TCP_MUX): one broker for
+    # this single-host world, running in the parent like the balancer
+    # sidecar; ranks hold ONE data-plane socket each instead of one per
+    # peer. Native server worlds keep direct sockets toward the daemons
+    # (binary peers route around the mux inside the endpoint).
+    from adlb_tpu.runtime.channel import ChannelBroker, resolve_tcp_mux
+
+    broker = ChannelBroker() if resolve_tcp_mux(cfg) else None
+    mux_addr = broker.addr if broker is not None else None
+
     ctx = mp.get_context(start_method)
     port_q = ctx.Queue()
     result_q = ctx.Queue()
@@ -665,7 +829,7 @@ def spawn_world(
         p = ctx.Process(
             target=_child_main,
             args=(rank, world, cfg, app_fn, port_q, child_end, result_q,
-                  abort_event, shm_key),
+                  abort_event, shm_key, mux_addr),
             name=f"adlb-rank-{rank}",
         )
         procs[rank] = p
@@ -722,6 +886,8 @@ def spawn_world(
             from adlb_tpu.balancer.sidecar import stop_sidecar
 
             stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
+        if broker is not None:
+            broker.close()
         cleanup_world(shm_key)
         raise
 
@@ -804,6 +970,8 @@ def spawn_world(
         from adlb_tpu.balancer.sidecar import stop_sidecar
 
         stop_sidecar(sidecar_ep, sidecar_thread, abort_event)
+    if broker is not None:
+        broker.close()
     # every child is gone: sweep ring segments/FIFOs whose owners died
     # without unlinking (SIGKILL chaos legs would otherwise leak them)
     cleanup_world(shm_key)

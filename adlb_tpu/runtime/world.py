@@ -327,6 +327,28 @@ class Config:
     # fraction of max_malloc_per_server above which spilling engages;
     # 0 = track mem_soft_frac (the PR 5 soft watermark)
     spill_watermark_frac: float = 0.0
+    # wire-codec implementation for TLV frames (native peers, shm rings,
+    # mux'd channels): "auto" uses the compiled C core
+    # (adlb_tpu/native/codec.cpp) whenever it builds, falling back to
+    # the pure-Python twin; "c" requires it (no silent fallback); "py"
+    # forces the Python twin. Selected per-process at world start; the
+    # ADLB_CODEC env var sets the import-time default the same way.
+    codec: str = "auto"
+    # ---- multiplexed cross-host channels (adlb_tpu/runtime/channel.py) ----
+    # "auto" rides per-pair TCP today (single-host worlds lose latency
+    # on the mux's two hops; engaging it automatically for multi-host
+    # fleets — the O(hosts^2)-not-O(ranks^2) socket regime — awaits the
+    # launcher's broker publication, ROADMAP item 5); "on" forces the
+    # channel plane and requires a harness that runs a broker
+    # (spawn_world today; the rendezvous launcher / join_world reject
+    # it loudly rather than silently running per-pair) — also
+    # forceable via ADLB_TCP_MUX=1 (the CI leg's hook); "off" pins
+    # per-pair TCP.
+    tcp_mux: str = "auto"
+    # compress DATA-envelope bodies at least this large on the channel
+    # plane (zlib level 1, flag bit 0 of the envelope header; the
+    # receiver inflates before frame decode). 0 = off.
+    compress_min_bytes: int = 0
     # server reactor implementation (spawn_world / TCP worlds only):
     # "python" runs adlb_tpu.runtime.server.Server per server rank; "native"
     # runs the C++ daemon (adlb_tpu/native/serverd.cpp) — the reference's
@@ -353,6 +375,12 @@ class Config:
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
         if self.fabric not in ("auto", "shm", "tcp"):
             raise ValueError(f"unknown fabric {self.fabric!r}")
+        if self.codec not in ("auto", "c", "py"):
+            raise ValueError(f"unknown codec {self.codec!r}")
+        if self.tcp_mux not in ("auto", "on", "off"):
+            raise ValueError(f"unknown tcp_mux {self.tcp_mux!r}")
+        if self.compress_min_bytes < 0:
+            raise ValueError("compress_min_bytes must be >= 0")
         if self.shm_ring_bytes < 4096:
             raise ValueError("shm_ring_bytes must be >= 4096")
         if not (0.0 <= self.spill_watermark_frac <= 1.0):

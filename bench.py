@@ -1221,6 +1221,160 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         shm_rows = {"shm_error": repr(e)[:200]}
 
+    # wire-codec microbench (round 8, ROADMAP item 5c): encode+decode
+    # per-frame cost of the compiled C codec vs the pure-Python twin on
+    # the wire-native frame mix (put/reserve/fused-response/state-delta
+    # — the Put/Reserve/Get_reserved hot path's actual traffic shape).
+    # codec_encode_us is the bench_guard-guarded row; the speedup rows
+    # carry the >=5x acceptance claim. Own containment.
+    def codec_bench():
+        from adlb_tpu.runtime import codec as codec_mod
+        from adlb_tpu.runtime.messages import Tag, msg
+
+        mix = [
+            msg(Tag.FA_PUT, 3, payload=b"\xa5" * 1024, work_type=2,
+                prio=-7, target_rank=-1, answer_rank=0, common_len=0,
+                common_server=-1, common_seqno=-1, put_id=12),
+            msg(Tag.TA_PUT_RESP, 5, rc=1, hint=-1, put_id=12),
+            msg(Tag.FA_RESERVE, 0, req_types=[1, 2, 9], hang=True,
+                rqseqno=42, prefetch=1),
+            msg(Tag.TA_RESERVE_RESP, 6, rc=1, work_type=1, prio=3,
+                handle=[7, 5, 0, -1, -1], work_len=4096, answer_rank=-1,
+                fetch=1, payloads=[b"u" * 4096] * 8,
+                work_types=[1] * 8, prios=[0] * 8,
+                answer_ranks=[-1] * 8,
+                times_on_q=[0.25] * 8),
+            msg(Tag.TA_GET_RESERVED_RESP, 6, rc=1, payload=b"w" * 4096,
+                time_on_q=0.125),
+            msg(Tag.SS_STATE_DELTA, 4, seqnos=list(range(32)),
+                work_types=[1] * 32, prios=[0] * 32,
+                work_lens=[64] * 32, nbytes=2048),
+            msg(Tag.FA_PUT, 1, payload=b"j" * 64, work_type=1, job_id=7),
+            msg(Tag.FA_LOCAL_APP_DONE, 1),
+        ]
+        bodies = [b"".join(bytes(p) for p in
+                           codec_mod.encode_binary_iov_py(m)) for m in mix]
+        reps = 4000  # x8 frames = 32k encodes per implementation
+
+        def us_per_frame(fn, args):
+            best = float("inf")
+            for _rep in range(3):
+                t0 = time.perf_counter()
+                for a in args:
+                    for _ in range(reps // 4):
+                        fn(a)
+                best = min(
+                    best,
+                    (time.perf_counter() - t0) / (len(args) * (reps // 4)),
+                )
+            return best * 1e6
+
+        have_c = codec_mod._load_c_codec()
+        rows = {"codec_impl": codec_mod.active_codec(),
+                "codec_frames_in_mix": len(mix)}
+        enc_py = us_per_frame(codec_mod.encode_binary_iov_py, mix)
+        dec_py = us_per_frame(codec_mod.decode_binary_py, bodies)
+        rows["codec_encode_us_py"] = round(enc_py, 2)
+        rows["codec_decode_us_py"] = round(dec_py, 2)
+        if have_c:
+            enc_c = us_per_frame(codec_mod._c_encode_iov, mix)
+            dec_c = us_per_frame(codec_mod._c_decode, bodies)
+            rows["codec_encode_us_c"] = round(enc_c, 2)
+            rows["codec_decode_us_c"] = round(dec_c, 2)
+            rows["codec_encode_speedup"] = round(enc_py / enc_c, 2)
+            rows["codec_decode_speedup"] = round(dec_py / dec_c, 2)
+        # the GUARDED row is the ACTIVE implementation's cost — what
+        # this record's real frames actually paid — so a record that
+        # silently fell back to py regresses against a compiled
+        # baseline, which is exactly what the guard exists to catch
+        active_c = codec_mod.active_codec() == "c" and have_c
+        rows["codec_encode_us"] = rows["codec_encode_us_c"] if active_c \
+            else round(enc_py, 2)
+        rows["codec_decode_us"] = rows["codec_decode_us_c"] if active_c \
+            else round(dec_py, 2)
+        if not have_c:
+            rows["codec_note"] = "compiled codec unavailable; rows are py"
+        return rows
+
+    try:
+        codec_rows = codec_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        codec_rows = {"codec_error": repr(e)[:200]}
+
+    # multiplexed channel plane (round 8, ROADMAP item 5b): pop latency
+    # over REAL PROCESSES with every python<->python frame riding the
+    # host broker (tcp_mux="on") vs the identical per-pair world, paired
+    # interleaved reps — on a 1-core box both are scheduler-bound (the
+    # provenance stamp records that); plus the 8-burst submission row:
+    # wall time for an 8-frame burst delivered through one coalesced
+    # gather vs eight sequential sends, endpoint-level (no scheduler in
+    # the loop). Own containment.
+    def mux_bench():
+        from adlb_tpu.runtime.channel import ChannelBroker
+        from adlb_tpu.runtime.messages import Tag as _Tag
+        from adlb_tpu.runtime.messages import msg as _msg
+
+        def coin_mux(mode):
+            return coinop.run(
+                n_tokens=400, num_app_ranks=4, nservers=2,
+                cfg=Config(fabric="tcp", tcp_mux=mode,
+                           exhaust_check_interval=0.25),
+                timeout=180.0, spawn=True,
+            )
+
+        runs = interleaved(lambda m: coin_mux(m), modes=("on", "off"))
+        mux_med = median_by(runs["on"], key=lambda r: r.latency_p50_ms)
+        tcp_med = median_by(runs["off"], key=lambda r: r.latency_p50_ms)
+        rows = {
+            "coinop_mux_p50_ms": round(mux_med.latency_p50_ms, 3),
+            "coinop_mux_tcp_p50_ms": round(tcp_med.latency_p50_ms, 3),
+            "coinop_mux_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["on"]],
+            "coinop_mux_tcp_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["off"]],
+        }
+
+        # 8-burst submission: one coalesced gather vs 8 sequential sends
+        from adlb_tpu.runtime.transport_tcp import TcpEndpoint as _EP
+
+        broker = ChannelBroker()
+        a = _EP(0, {0: ("127.0.0.1", 0)}, mux=broker.addr)
+        b = _EP(1, {1: ("127.0.0.1", 0)}, mux=broker.addr)
+        try:
+            frame = _msg(_Tag.FA_PUT, 0, payload=b"b" * 256, work_type=1)
+
+            def burst(batched):
+                t0 = time.perf_counter()
+                if batched:
+                    a.submit_begin()
+                for _i in range(8):
+                    a.send(1, frame)
+                if batched:
+                    a.submit_flush()
+                got = 0
+                while got < 8:
+                    if b.recv(timeout=5.0) is not None:
+                        got += 1
+                return (time.perf_counter() - t0) * 1e3
+
+            for _warm in range(20):
+                burst(True)
+                burst(False)
+            bat = sorted(burst(True) for _ in range(60))
+            seq = sorted(burst(False) for _ in range(60))
+            rows["mux_burst8_batched_ms"] = round(bat[len(bat) // 2], 3)
+            rows["mux_burst8_sequential_ms"] = round(seq[len(seq) // 2], 3)
+        finally:
+            a.close()
+            b.close()
+            broker.close()
+        return rows
+
+    try:
+        mux_rows = mux_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        mux_rows = {"mux_error": repr(e)[:200]}
+
     # multichip planning-round latency at scale: the sharded balancer's
     # full round (snapshot-delta ingest -> sharded solve -> plan
     # extraction) at 1,000 servers / 100k parked requesters on an 8-way
@@ -1290,6 +1444,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         engine_rows = {"engine_round_error": repr(e)[:200]}
 
+    # measurement provenance (the r07 caveat made policy): every record
+    # carries the core count + load so cross-round comparisons can tell
+    # a real regression from a different (or busy) box — bench_guard
+    # skips-with-note when baseline and candidate disagree on cores
+    provenance = {
+        "cpu_count": os.cpu_count() or 1,
+        "loadavg_1m": round(os.getloadavg()[0], 2)
+        if hasattr(os, "getloadavg") else None,
+    }
+
     result = {
         "metric": "hotspot_tasks_per_sec_tpu_balancer",
         "value": round(hot_tpu.tasks_per_sec, 1),
@@ -1298,6 +1462,7 @@ def main() -> None:
         if hot_steal.tasks_per_sec
         else 0.0,
         "detail": {
+            **provenance,
             "platform": platform,
             "app_ranks": APPS,
             "servers": SERVERS,
@@ -1402,6 +1567,8 @@ def main() -> None:
             **gray_rows,
             **service_rows,
             **shm_rows,
+            **codec_rows,
+            **mux_rows,
             **plan_rows,
             **engine_rows,
         },
@@ -1536,6 +1703,25 @@ def main() -> None:
             # shm ring fabric (real processes): [shm, tcp, shm-batch:8]
             # classic-consumer pop p50s; large-payload put [shm, tcp];
             # spill fault-in latency and the storm acceptance counters
+            # measurement provenance (the r07 caveat made policy)
+            "cpu_count": provenance["cpu_count"],
+            "load1": provenance["loadavg_1m"],
+            # compiled wire codec: [active-impl encode us, py-twin
+            # encode us] + speedups (>=5x acceptance) and the impl tag
+            "codec_encode_us": codec_rows.get("codec_encode_us"),
+            "codec": [codec_rows.get("codec_encode_us"),
+                      codec_rows.get("codec_encode_us_py"),
+                      codec_rows.get("codec_decode_us"),
+                      codec_rows.get("codec_decode_us_py")],
+            "codec_speedup": [codec_rows.get("codec_encode_speedup"),
+                              codec_rows.get("codec_decode_speedup")],
+            "codec_impl": codec_rows.get("codec_impl"),
+            # multiplexed channels: [mux pop p50, per-pair pop p50] and
+            # the 8-burst submission [coalesced, sequential]
+            "coinop_mux": [mux_rows.get("coinop_mux_p50_ms"),
+                           mux_rows.get("coinop_mux_tcp_p50_ms")],
+            "mux_burst8": [mux_rows.get("mux_burst8_batched_ms"),
+                           mux_rows.get("mux_burst8_sequential_ms")],
             "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),
                            shm_rows.get("coinop_spawn_tcp_p50_ms"),
                            shm_rows.get("coinop_shm_batch8_p50_ms")],

@@ -77,6 +77,18 @@ GUARDS = {
     "spill": [
         ("faultin", "spill_faultin_ms"),
     ],
+    # compiled wire codec: per-frame encode cost on the wire-native
+    # frame mix (r08 metric; older baselines skip with a note). The
+    # guarded cell is the ACTIVE implementation's row — a py-fallback
+    # record regresses vs a compiled baseline, which is the point.
+    "codec": [
+        ("encode", "codec_encode_us"),
+    ],
+    # multiplexed channel plane: pop p50 over real processes with every
+    # frame riding the host broker (r08; older baselines skip)
+    "coinop_mux": [
+        ("mux", "coinop_mux_p50_ms"),
+    ],
 }
 
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"
@@ -130,6 +142,22 @@ def main(argv=None) -> int:
 
     new_detail, new_text = _load(args.new)
     base_detail, base_text = _load(args.baseline)
+
+    # measurement-provenance gate (the r07 caveat made policy): latency
+    # rows measured on different core counts are not comparable — a
+    # 1-core box's numbers are scheduler-bound, a 4-core box's are not.
+    # Records carry cpu_count since r08; when both sides have it and
+    # they disagree, print a skip-note instead of failing the build.
+    base_cpus = extract(base_detail, base_text, "", 0, "cpu_count")
+    new_cpus = extract(new_detail, new_text, "", 0, "cpu_count")
+    if base_cpus and new_cpus and int(base_cpus) != int(new_cpus):
+        print(
+            f"[bench-guard] SKIP: baseline measured on {int(base_cpus)} "
+            f"core(s), candidate on {int(new_cpus)} — latency rows are "
+            f"scheduler-bound incomparable across core counts; "
+            f"re-measure both on one box to re-arm the guard"
+        )
+        return 0
 
     failures = []
     checked = 0
