@@ -12,7 +12,14 @@ dies, and one live HTTP surface
 """
 
 from adlb_tpu.obs.flight import FlightRecorder, resolve_flight_dir
-from adlb_tpu.obs.metrics import Counter, Gauge, Histogram, Registry
+from adlb_tpu.obs.journey import JourneyRecorder
+from adlb_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    expose_merged,
+)
 
 __all__ = [
     "Counter",
@@ -20,5 +27,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "FlightRecorder",
+    "JourneyRecorder",
+    "expose_merged",
     "resolve_flight_dir",
 ]

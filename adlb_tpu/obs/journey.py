@@ -1,0 +1,252 @@
+"""Unit-lifecycle tracing: per-unit journeys through the fleet.
+
+The SLO sensor layer: a sampled work unit (``Config(trace_sample)``
+head-sampling at put — the client mints a ``trace_id`` that rides
+``FA_PUT`` as codec field 98) accumulates a span list of
+``(stage, rank, t_mono)`` tuples as it moves through the system:
+
+    put_recv -> enqueue -> [wal_commit] -> [migrate | push | expire |
+    adopt | replay]* -> match -> [relay] -> deliver -> finalize
+
+The span list lives ON the unit (``WorkUnit.spans``) so every path that
+moves a unit moves its history with it: ``SS_PUSH_WORK``,
+``SS_MIGRATE_WORK``, the fused-relay ``SS_RFR_RESP``, the replication
+stream / WAL (``replica.OP_TRACE``), and failover adoption. A terminal
+event — delivery (``finalize``), quarantine, failover loss — closes the
+record into a **journey** dict; the closing server feeds per-stage
+latency histograms (``unit_stage_s{stage=,job=,type=}``: the time spent
+REACHING each stage from the previous one, so queue wait / plan wait /
+relay / fetch attribute separately) and, when ``Config(trace=True)``,
+emits the journey into the Chrome-trace stream as a flow-event chain
+(``ph: s/t/f`` sharing ``id=trace_id``) binding the hops across rank
+lanes.
+
+Closed journeys ride the fleet metrics gossip (``SS_OBS_SYNC``) to the
+master, whose ops endpoint serves them on ``/trace/units``; summarize
+offline with ``scripts/obs_report.py --journeys``.
+
+Clock caveat: spans are ``time.monotonic`` stamps, comparable across
+processes on ONE host (Linux CLOCK_MONOTONIC is system-wide). Cross-host
+journeys carry each host's own clock — per-stage deltas that cross a
+host boundary include the clock skew.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from time import monotonic as _monotonic
+from typing import Optional
+
+# Stage registry: the codes are the replica/WAL wire form (OP_TRACE),
+# the names are the histogram labels and journey entries. Append-only —
+# renumbering would corrupt WAL replays of older logs.
+STAGES = (
+    "put_recv",    # 1  FA_PUT arrived at the home-of-record server
+    "enqueue",     # 2  unit admitted to the work queue
+    "wal_commit",  # 3  the group commit covering this put fsynced (ack released)
+    "match",       # 4  pinned for a requester (local match, plan, or RFR)
+    "migrate",     # 5  landed at a migration destination (SS_MIGRATE_WORK)
+    "push",        # 6  landed at a memory-pressure push target (SS_PUSH_WORK)
+    "relay",       # 7  payload left the holder in a fused SS_RFR_RESP
+    "deliver",     # 8  payload handed to the consuming app rank
+    "finalize",    # 9  journey closed (terminal)
+    "expire",      # 10 lease expired; unit re-enqueued under a fresh attempt
+    "adopt",       # 11 adopted by a failover buddy at promotion
+    "replay",      # 12 recovered from the WAL at cold restart
+)
+STAGE_CODES = {name: i + 1 for i, name in enumerate(STAGES)}
+CODE_STAGES = {v: k for k, v in STAGE_CODES.items()}
+
+# per-unit span cap: a unit bouncing through expiry loops must not grow
+# an unbounded history (the journey keeps its most recent window)
+MAX_SPANS = 64
+
+_SPANHDR = struct.Struct("<qH")  # trace id, span count
+_SPAN = struct.Struct("<Bid")    # stage code, rank, t_mono
+
+
+def pack_spans(trace_id: int, spans) -> bytes:
+    """Wire/WAL form of a unit's trace context (replica OP_TRACE body)."""
+    spans = spans or []
+    return _SPANHDR.pack(trace_id, len(spans)) + b"".join(
+        _SPAN.pack(STAGE_CODES.get(stage, 0), rank, t)
+        for stage, rank, t in spans
+    )
+
+
+def unpack_spans(body: bytes) -> tuple[int, list]:
+    trace_id, n = _SPANHDR.unpack_from(body, 0)
+    spans = []
+    off = _SPANHDR.size
+    for _ in range(n):
+        code, rank, t = _SPAN.unpack_from(body, off)
+        off += _SPAN.size
+        spans.append((CODE_STAGES.get(code, "?"), rank, t))
+    return trace_id, spans
+
+
+class JourneyRecorder:
+    """One server's unit-trace bookkeeping.
+
+    ``begin``/``stamp`` are reactor-thread appends on the unit's own
+    span list; ``close`` folds the spans into per-stage latency
+    histograms and a bounded closed-journey deque (drained by the
+    SS_OBS_SYNC gossip toward the master, or read directly on the
+    master). ``live`` caps how many traced units this server will track
+    at once — past it, new puts simply go untraced (``trace_dropped``
+    counter) instead of growing without bound.
+    """
+
+    def __init__(self, rank: int, registry, tracer=None,
+                 max_live: int = 4096, max_done: int = 1024) -> None:
+        self.rank = rank
+        self.registry = registry
+        self.tracer = tracer
+        self.max_live = max_live
+        self.live = 0
+        self.done: deque = deque(maxlen=max_done)
+        self._m_closed = registry.counter("trace_journeys_closed")
+        self._m_dropped = registry.counter("trace_dropped")
+        # instrument cache: close_spans runs on the delivery hot path,
+        # and the registry's kwargs/label lookup per observation is the
+        # expensive part — hold the histogram objects by plain key
+        self._hists: dict = {}
+        self._totals: dict = {}
+
+    # -- span lifecycle ------------------------------------------------------
+
+    def begin(self, unit, trace_id: int, t: float) -> None:
+        """Arm a freshly-put unit with its trace context (or drop the
+        context at the live cap) and stamp ``put_recv``."""
+        if self.live >= self.max_live:
+            self._m_dropped.inc()
+            return
+        self.live += 1
+        unit.trace_id = trace_id
+        unit.spans = [("put_recv", self.rank, t)]
+
+    def adopt(self, unit, trace_id: int, spans, stage: Optional[str] = None,
+              t: Optional[float] = None) -> None:
+        """Attach a context that arrived WITH the unit (push, migrate,
+        WAL replay, failover adoption), optionally stamping the arrival
+        stage. Counts against the live cap like begin()."""
+        if not trace_id:
+            return
+        if self.live >= self.max_live:
+            self._m_dropped.inc()
+            return
+        self.live += 1
+        unit.trace_id = trace_id
+        unit.spans = list(spans or [])
+        if stage is not None:
+            self.stamp(unit, stage, t)
+
+    def stamp(self, unit, stage: str, t: Optional[float] = None) -> None:
+        spans = unit.spans
+        if spans is None:
+            return
+        if len(spans) >= MAX_SPANS:
+            del spans[1:2]  # keep put_recv; shed the oldest middle hop
+        spans.append((stage, self.rank,
+                      _monotonic() if t is None else t))
+
+    def forget(self, unit) -> None:
+        """Release a unit's context without closing (the fused-relay
+        handoff: the requester's HOME closed the journey from the copy
+        that rode the SS_RFR_RESP; the holder's original is dropped at
+        the SS_DELIVERED consume)."""
+        if unit.spans is not None:
+            unit.spans = None
+            unit.trace_id = 0
+            self.live = max(0, self.live - 1)
+
+    # -- closing -------------------------------------------------------------
+
+    def close(self, unit, end: str, t: Optional[float] = None) -> None:
+        """Terminal event on a locally-held unit: finalize-stamp and fold
+        the journey."""
+        if unit.spans is None:
+            return
+        self.stamp(unit, "finalize", t)
+        spans, trace_id = unit.spans, unit.trace_id
+        unit.spans = None
+        unit.trace_id = 0
+        self.live = max(0, self.live - 1)
+        self.close_spans(trace_id, unit.job, unit.work_type, end, spans)
+
+    def close_spans(self, trace_id: int, job: int, work_type: int,
+                    end: str, spans: list) -> None:
+        """Fold an explicit span list into a closed journey (the relay
+        path at the requester's home server, and failover-loss closes,
+        hold spans without a live local unit)."""
+        if not spans:
+            return
+        reg = self.registry
+        prev_t = spans[0][2]
+        for stage, _rank, t in spans[1:]:
+            h = self._hists.get((stage, job, work_type))
+            if h is None:
+                h = self._hists[(stage, job, work_type)] = reg.histogram(
+                    "unit_stage_s", stage=stage, job=str(job),
+                    type=str(work_type),
+                )
+            h.observe(max(t - prev_t, 0.0))
+            prev_t = t
+        ht = self._totals.get((job, work_type))
+        if ht is None:
+            ht = self._totals[(job, work_type)] = reg.histogram(
+                "unit_total_s", job=str(job), type=str(work_type)
+            )
+        ht.observe(max(spans[-1][2] - spans[0][2], 0.0))
+        self._m_closed.inc()
+        self.done.append({
+            "trace_id": trace_id,
+            "job": job,
+            "type": work_type,
+            "end": end,
+            "t0": round(spans[0][2], 6),
+            "total_s": round(max(spans[-1][2] - spans[0][2], 0.0), 6),
+            "spans": [[stage, rank, round(t, 6)] for stage, rank, t in spans],
+        })
+        tr = self.tracer
+        if tr is not None:
+            # flow-event chain into the merged Chrome-trace stream: one
+            # s/t/.../f sequence sharing id=trace_id, each step on the
+            # lane (tid) of the rank that performed the hop, so Perfetto
+            # draws the unit's path across server lanes
+            last = len(spans) - 1
+            for i, (stage, rank, t) in enumerate(spans):
+                ev = {
+                    "name": "unit",
+                    "cat": "unit",
+                    "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                    "id": trace_id,
+                    "ts": t * 1e6,
+                    "pid": tr.pid,
+                    "tid": rank,
+                    "args": {"stage": stage, "job": job,
+                             "type": work_type, "end": end},
+                }
+                if i == last:
+                    ev["bp"] = "e"
+                tr._emit(ev)
+
+    def take_done(self) -> list:
+        """Drain closed journeys (the gossip tick toward the master)."""
+        out = []
+        while self.done:
+            try:
+                out.append(self.done.popleft())
+            except IndexError:  # pragma: no cover — single-consumer today
+                break
+        return out
+
+
+def trace_fields(unit) -> Optional[dict]:
+    """The one-key wire form a unit's context rides in pickled SS frames
+    (push / migrate dicts, the fused-relay response): ``None`` when the
+    unit is untraced, so untraced frames stay byte-identical."""
+    if not unit.trace_id or unit.spans is None:
+        return None
+    return {"id": unit.trace_id, "spans": list(unit.spans)}

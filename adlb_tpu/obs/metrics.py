@@ -39,6 +39,9 @@ _DEF_BASE = 1e-6
 _DEF_MULT = 4.0
 _DEF_NBUCKETS = 16
 
+# summary-style point quantiles emitted next to the cumulative buckets
+_QUANTILES = ("0.5", "0.95", "0.99")
+
 
 class Counter:
     """Monotone counter. ``inc`` is a plain add — see module docstring."""
@@ -91,15 +94,21 @@ class Histogram:
     def quantile(self, q: float) -> float:
         """Upper bucket bound at quantile ``q`` (0..1) — coarse by design
         (log buckets), good enough for p50/p95 health lines."""
-        if self.n == 0:
-            return 0.0
-        target = q * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target:
-                return self.bounds[i] if i < len(self.bounds) else float("inf")
-        return float("inf")
+        return quantile_of(self.bounds, self.counts, self.n, q)
+
+
+def quantile_of(bounds, counts, n: int, q: float) -> float:
+    """Bucket-bound quantile shared by live Histograms and merged
+    snapshot dicts (the fleet /metrics and /jobs stage-latency views)."""
+    if n == 0:
+        return 0.0
+    target = q * n
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= target:
+            return bounds[i] if i < len(bounds) else float("inf")
+    return float("inf")
 
 
 class Timeseries:
@@ -323,7 +332,117 @@ class Registry:
                 out.append(fmt(name + "_bucket", {**lab, "le": le}, cum))
             out.append(fmt(name + "_sum", dict(labels), round(h.sum, 9)))
             out.append(fmt(name + "_count", dict(labels), h.n))
+            # point quantiles alongside the cumulative buckets (summary-
+            # style compat lines for dashboards that read p50/p95/p99
+            # directly; bucket-bound coarse, like Histogram.quantile)
+            for q in _QUANTILES:
+                out.append(
+                    fmt(name, {**lab, "quantile": q},
+                        f"{h.quantile(float(q)):.9g}")
+                )
         return "\n".join(out) + "\n"
+
+    # -- fleet gossip (delta snapshots) --------------------------------------
+
+    def delta_snapshot(self, last: dict) -> dict:
+        """Changed-instruments-only snapshot for the SS_OBS_SYNC gossip:
+        ``last`` is the caller-held per-instrument memo of what was last
+        shipped (mutated in place). Values are CUMULATIVE — the receiver
+        overwrites per-key, so a lost-and-reconnected stream heals on
+        the next change rather than drifting. Histograms ship whole on
+        any change (cells are elementwise-merged downstream)."""
+
+        def lk(k: tuple) -> str:
+            name, labels = k
+            if not labels:
+                return name
+            return name + "{" + ",".join(f"{a}={b}" for a, b in labels) + "}"
+
+        counters, gauges, hists, _ = self._stable_items()
+        lc = last.setdefault("c", {})
+        lg = last.setdefault("g", {})
+        lh = last.setdefault("h", {})
+        out: dict = {}
+        dc = {}
+        for k, c in counters:
+            key = lk(k)
+            if lc.get(key) != c.v:
+                lc[key] = dc[key] = c.v
+        if dc:
+            out["counters"] = dc
+        dg = {}
+        for k, g in gauges:
+            key = lk(k)
+            if lg.get(key) != g.v:
+                lg[key] = dg[key] = g.v
+        if dg:
+            out["gauges"] = dg
+        dh = {}
+        for k, h in hists:
+            key = lk(k)
+            if lh.get(key) != h.n:
+                lh[key] = h.n
+                dh[key] = {
+                    "bounds": list(h.bounds),
+                    "counts": list(h.counts),
+                    "sum": h.sum,
+                    "count": h.n,
+                }
+        if dh:
+            out["histograms"] = dh
+        return out
+
+
+def _prom_key(key: str) -> tuple[str, dict]:
+    """Split a snapshot label-key (``name{a=b,c=d}`` / ``name``) back
+    into (name, labels) for re-exposition."""
+    if not key.endswith("}"):
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for pair in rest[:-1].split(","):
+        a, _, b = pair.partition("=")
+        labels[a] = b
+    return name, labels
+
+
+def expose_merged(merged: dict, prefix: str = "adlb_fleet_") -> str:
+    """Prometheus-style exposition of a :meth:`Registry.merge` result —
+    the master's FLEET view on ``/metrics``: counters and histogram
+    cells are fleet sums, gauges keep the per-rank label merge() gave
+    them. Same line shapes as :meth:`Registry.expose` (counters gain
+    ``_total``; histograms emit ``_bucket``/``_sum``/``_count`` plus the
+    point-quantile compat lines)."""
+    out: list[str] = []
+
+    def fmt(name: str, labels: dict, v) -> str:
+        if not labels:
+            return f"{prefix}{name} {v}"
+        ls = ",".join(f'{a}="{b}"' for a, b in sorted(labels.items()))
+        return f"{prefix}{name}{{{ls}}} {v}"
+
+    for key, v in sorted(merged.get("counters", {}).items()):
+        name, labels = _prom_key(key)
+        out.append(fmt(name + "_total", labels, v))
+    for key, v in sorted(merged.get("gauges", {}).items()):
+        name, labels = _prom_key(key)
+        out.append(fmt(name, labels, v))
+    for key, h in sorted(merged.get("histograms", {}).items()):
+        name, labels = _prom_key(key)
+        bounds, counts = h["bounds"], h["counts"]
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            le = f"{bounds[i]:.9g}" if i < len(bounds) else "+Inf"
+            out.append(fmt(name + "_bucket", {**labels, "le": le}, cum))
+        out.append(fmt(name + "_sum", labels, round(h["sum"], 9)))
+        out.append(fmt(name + "_count", labels, h["count"]))
+        for q in _QUANTILES:
+            out.append(fmt(
+                name, {**labels, "quantile": q},
+                f"{quantile_of(bounds, counts, h['count'], float(q)):.9g}",
+            ))
+    return "\n".join(out) + ("\n" if out else "")
 
 
 def attach(ep, registry: Optional[Registry]) -> None:

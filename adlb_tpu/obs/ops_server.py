@@ -5,14 +5,23 @@ scraper — can interrogate a running world without touching the protocol
 plane:
 
 * ``GET /healthz`` — liveness + role summary (uptime, wq/rq depth,
-  done/aborted flags); JSON.
+  done/aborted flags) plus per-rank snapshot staleness from the
+  SS_OBS_SYNC gossip (a wedged server's age grows before it EOFs); JSON.
 * ``GET /metrics`` — Prometheus-style text exposition of the master's
   registry (per-tag message counters, queue-depth gauges, latency
-  histograms), followed by the **world aggregate**: the most recent
-  STAT_APS record the periodic-stats ring delivered (enable with
+  histograms), the ``adlb_fleet_*`` merged-fleet section (the master's
+  registry + every gossiped per-rank snapshot through
+  ``Registry.merge``) with per-rank seq/age provenance rows, and the
+  **world aggregate**: the most recent STAT_APS record the
+  periodic-stats ring delivered (enable with
   ``Config(periodic_log_interval=...)``), exposed as
   ``adlb_world_*``/``adlb_server_*`` samples stamped with the ring
-  sequence number so a scrape can be matched to the exact tick.
+  sequence number AND aged (``adlb_stat_aps_age_seconds``) so stale
+  data is distinguishable from live.
+* ``GET /trace/units`` — the fleet journey store (unit-lifecycle
+  tracing, ``Config(trace_sample)``): closed per-unit journeys from
+  every rank, summarizable offline with
+  ``scripts/obs_report.py --journeys``.
 * ``GET /dump`` — trigger a flight-record snapshot: returns the JSON doc
   inline and writes the artifact when a flight directory is configured.
 * ``GET /deadletter`` — this server's dead-letter quarantine (units that
@@ -40,6 +49,20 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+
+def _stable_dict(d: dict) -> dict:
+    """Copy a dict the reactor thread may be inserting into (the fleet
+    snapshot/staleness ledgers): per-key VALUES are published by swap
+    (never mutated in place), so a retried shallow copy of the outer
+    dict is a consistent read. Same retry discipline as
+    metrics.safe_copy."""
+    for _ in range(8):
+        try:
+            return dict(d)
+        except RuntimeError:
+            continue
+    return {}
 
 
 def _world_agg_lines(agg: dict) -> list[str]:
@@ -111,6 +134,9 @@ class OpsServer:
                     elif path == "/deadletter":
                         body = json.dumps(ops._deadletter()).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/trace/units":
+                        body = json.dumps(ops._trace_units()).encode()
+                        self._send(200, body, "application/json")
                     elif path == "/jobs":
                         body = json.dumps(ops._jobs()).encode()
                         self._send(200, body, "application/json")
@@ -172,7 +198,10 @@ class OpsServer:
 
     def stop(self) -> None:
         try:
-            self._httpd.shutdown()
+            if self._thread.is_alive():
+                # shutdown() handshakes with serve_forever — calling it
+                # on a never-started listener would block forever
+                self._httpd.shutdown()
             self._httpd.server_close()
         except OSError:
             pass
@@ -183,11 +212,35 @@ class OpsServer:
         import time
 
         s = self.server
+        now = time.monotonic()
+        # per-rank snapshot staleness from the SS_OBS_SYNC gossip: a
+        # wedged server stops heartbeating and its age grows — visible
+        # here BEFORE its connections EOF. The master is age 0 (its own
+        # registry is read live); ranks never heard from report seq 0
+        # with age since the endpoint started.
+        cadence = getattr(s.cfg, "obs_sync_interval", 0) or 0
+        fleet_seen = _stable_dict(s._fleet_seen)
+        ranks = {str(s.rank): {"seq": -1, "age_s": 0.0, "stale": False}}
+        for r in s.world.server_ranks:
+            if r == s.rank:
+                continue
+            seen = fleet_seen.get(r)
+            if seen is None:
+                age = round(now - (self._t0 or now), 3)
+                seq = 0
+            else:
+                seq, t_at = seen
+                age = round(now - t_at, 3)
+            ranks[str(r)] = {
+                "seq": seq,
+                "age_s": age,
+                "stale": bool(cadence) and age > 3.0 * cadence,
+            }
         return {
             "ok": not s._aborted,
             "rank": s.rank,
             "role": "master" if s.is_master else "server",
-            "uptime_s": round(time.monotonic() - (self._t0 or 0.0), 3),
+            "uptime_s": round(now - (self._t0 or 0.0), 3),
             "wq": s.wq.count,
             "rq": len(s.rq),
             "nbytes": s.mem.curr,
@@ -196,15 +249,64 @@ class OpsServer:
             "no_more_work": s.no_more_work,
             "done_by_exhaustion": s.done_by_exhaustion,
             "nservers": s.world.nservers,
+            "obs_sync_interval": cadence,
+            "ranks": ranks,
+            "stale_ranks": sorted(
+                int(r) for r, e in ranks.items() if e["stale"]
+            ),
         }
 
     def _metrics(self) -> str:
+        import time
+
+        from adlb_tpu.obs.metrics import Registry, expose_merged
+
         s = self.server
+        now = time.monotonic()
         body = s.metrics.expose()
+        # ---- fleet view: the master's live registry merged with every
+        # gossiped per-rank snapshot (counters/histogram cells sum,
+        # gauges keep rank identity) — what Registry.merge computed
+        # offline for post-mortems, served live
+        fleet = [s.metrics.snapshot()] + list(
+            _stable_dict(s._fleet_snaps).values()
+        )
+        body += "# fleet view: merged across gossiped rank snapshots\n"
+        body += expose_merged(Registry.merge(fleet))
+        # per-rank snapshot provenance: seq + age, so a scraper can tell
+        # live rows from stale ones (the staleness /healthz alarms on)
+        for r, (seq, t_at) in sorted(_stable_dict(s._fleet_seen).items()):
+            body += (
+                f'adlb_obs_snapshot_seq{{rank="{r}"}} {seq}\n'
+                f'adlb_obs_snapshot_age_seconds{{rank="{r}"}} '
+                f"{max(now - t_at, 0.0):.3f}\n"
+            )
         agg = getattr(s, "last_aggregate", None)
         if agg is not None:
             body += "\n".join(_world_agg_lines(agg)) + "\n"
+            # age-stamp the aggregate: it is the LAST ring tick's data,
+            # and without an age a stalled ring is indistinguishable
+            # from a live one
+            body += (
+                f"adlb_stat_aps_age_seconds "
+                f"{max(now - s._last_aggregate_at, 0.0):.3f}\n"
+            )
         return body
+
+    def _trace_units(self) -> dict:
+        """The fleet journey store: every closed unit journey that
+        reached the master (its own + the SS_OBS_SYNC gossip), newest
+        last. Spans are (stage, rank, t_mono) triples; per-stage deltas
+        are the same data the unit_stage_s histograms aggregate."""
+        from adlb_tpu.obs.metrics import safe_copy
+
+        s = self.server
+        journeys = safe_copy(s._journeys_fleet)
+        return {
+            "rank": s.rank,
+            "count": len(journeys),
+            "journeys": journeys,
+        }
 
     def _deadletter(self) -> dict:
         s = self.server
@@ -254,8 +356,103 @@ class OpsServer:
         }
 
     def _job_one(self, jid_str: str):
-        job = self.server.jobs.get(int(jid_str))
-        return None if job is None else job.summary()
+        jid = int(jid_str)
+        job = self.server.jobs.get(jid)
+        if job is None:
+            return None
+        doc = job.summary()
+        doc.update(self._job_gauges(jid))
+        return doc
+
+    def _job_gauges(self, jid: int) -> dict:
+        """Live per-job depth/bytes/age + stage-latency quantiles: the
+        master's own queues read directly, every other rank's from its
+        gossiped snapshot's ``job_*`` gauges and ``unit_stage_s``
+        histogram cells (the item-3 autoscaler's sensor row)."""
+        from adlb_tpu.obs.metrics import quantile_of
+
+        s = self.server
+        import time
+
+        now = time.monotonic()
+        part = s.wq.part(jid)
+        depth = part.count if part is not None else 0
+        nbytes = part.total_bytes if part is not None else 0
+        age = max(
+            (now - u.time_stamp for u in part.units()), default=0.0
+        ) if part is not None else 0.0
+        per_rank = {
+            str(s.rank): {
+                "depth": depth, "bytes": nbytes, "age_s": round(age, 3)
+            }
+        }
+        jl = f"job={jid}"
+        fleet_snaps = _stable_dict(s._fleet_snaps)
+        for r, snap in fleet_snaps.items():
+            g = snap.get("gauges", {})
+
+            def cell(name: str) -> float:
+                # gauge keys carry sorted labels: job_* have only {job=}
+                return float(g.get(f"{name}{{{jl}}}", 0.0))
+
+            d = cell("job_wq_depth")
+            b = cell("job_wq_bytes")
+            a = cell("job_oldest_age_s")
+            per_rank[str(r)] = {
+                "depth": int(d), "bytes": int(b), "age_s": round(a, 3)
+            }
+            depth += int(d)
+            nbytes += int(b)
+            age = max(age, a)
+        # stage latencies: Registry.merge sums the unit_stage_s cells
+        # across ranks (per full label set); what remains here is only
+        # restricting to this job's label and folding the TYPE label
+        # away so /jobs reports one row per stage
+        from adlb_tpu.obs.metrics import Registry
+
+        merged = Registry.merge(
+            [s.metrics.snapshot()] + list(fleet_snaps.values())
+        )["histograms"]
+        stages: dict = {}
+        for key, h in merged.items():
+            if not key.startswith("unit_stage_s{"):
+                continue
+            labels = key[len("unit_stage_s{"):-1].split(",")
+            if jl not in labels:
+                continue
+            stage = next(
+                (x.split("=", 1)[1] for x in labels
+                 if x.startswith("stage=")), "?",
+            )
+            agg = stages.get(stage)
+            if agg is None:
+                stages[stage] = {
+                    "bounds": list(h["bounds"]),
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"], "count": h["count"],
+                }
+            elif len(agg["counts"]) == len(h["counts"]):
+                agg["counts"] = [
+                    a_ + b_ for a_, b_ in zip(agg["counts"], h["counts"])
+                ]
+                agg["sum"] += h["sum"]
+                agg["count"] += h["count"]
+        return {
+            "queue_depth": depth,
+            "queued_bytes": nbytes,
+            "oldest_age_s": round(age, 3),
+            "per_rank": per_rank,
+            "stage_latency_s": {
+                stage: {
+                    "p50": quantile_of(a["bounds"], a["counts"],
+                                       a["count"], 0.5),
+                    "p99": quantile_of(a["bounds"], a["counts"],
+                                       a["count"], 0.99),
+                    "count": a["count"],
+                }
+                for stage, a in sorted(stages.items())
+            },
+        }
 
     def _jobs_post(self, parts: list, raw: bytes) -> dict:
         """POST /jobs (submit) and POST /jobs/<id>/{drain,kill}: build a

@@ -116,6 +116,14 @@ class Client:
         # fixed interval synchronized whole worker pools into retry
         # convoys. Seeded per rank: reproducible, and ranks decorrelate.
         self._retry_rng = random.Random(0xADB0 + 7919 * self.rank)
+        # unit-lifecycle head sampling (Config(trace_sample)): its OWN
+        # seeded RNG, so arming/raising the sample rate never perturbs
+        # the retry-jitter stream (and sampling is reproducible per
+        # rank). trace_sample=0 never draws — the put path is
+        # allocation-identical to a pre-trace build.
+        self._trace_rng = random.Random(0x7ACE ^ (104729 * self.rank))
+        self._trace_seq = 0
+        self._m_traced_puts = self.metrics.counter("traced_puts")
         self._m_put_retries = self.metrics.counter("put_retries")
         self._m_reserve_retries = self.metrics.counter("reserve_retries")
         self._m_reconnects = self.metrics.counter("reconnects")
@@ -202,6 +210,18 @@ class Client:
             return nullcontext()
         self.tracer.api_entry()
         return self.tracer.span(name, **args)
+
+    def _sample_trace(self):
+        """Head-sampling decision for one put: a minted trace id (rank
+        in the high bits, per-rank sequence below — unique world-wide)
+        or None. The id rides FA_PUT as codec field 98 and the unit's
+        journey is recorded server-side (obs/journey.py)."""
+        rate = self.cfg.trace_sample
+        if not rate or self._trace_rng.random() >= rate:
+            return None
+        self._trace_seq += 1
+        self._m_traced_puts.inc()
+        return ((self.rank + 1) << 32) | (self._trace_seq & 0xFFFFFFFF)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -471,6 +491,9 @@ class Client:
         # re-send into an idempotent ack instead of a duplicated unit
         put_id = self._next_put_id
         self._next_put_id += 1
+        trace_id = self._sample_trace()  # one decision per logical put:
+        # retries/re-routes keep the id (the server dedup window keeps
+        # re-sends from double-tracing a unit)
         while True:
             pm = msg(
                 Tag.FA_PUT,
@@ -487,6 +510,8 @@ class Client:
             )
             if self.job:
                 pm.data["job_id"] = self.job
+            if trace_id is not None:
+                pm.data["trace_id"] = trace_id
             self._send_retry(server, pm)
             resp = self._wait_put(put_id, dest=server, m_req=pm)
             rc = resp.rc
@@ -1166,6 +1191,7 @@ class Client:
             payload=bytes(payload), work_type=work_type, prio=work_prio,
             target_rank=target_rank, answer_rank=answer_rank,
             attempts=0, server=server, job=self.job,
+            trace=self._sample_trace(),
         )
         self._pending_puts[put_id] = req
         self._send_iput(put_id, req)
@@ -1187,6 +1213,8 @@ class Client:
         )
         if req.get("job"):
             pm.data["job_id"] = req["job"]
+        if req.get("trace"):
+            pm.data["trace_id"] = req["trace"]
         self._send_retry(req["server"], pm)
 
     def _settle_put(self, m: Msg) -> None:

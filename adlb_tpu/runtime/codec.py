@@ -175,6 +175,9 @@ WIRE_TAG: dict[Tag, int] = {
     Tag.TA_HOME_TAKEOVER: 1138,
     # job-namespace lifecycle fan-out (service mode; python-only today)
     Tag.SS_JOB_CTL: 1139,
+    # fleet metrics gossip: server -> master registry-snapshot deltas +
+    # closed unit journeys (python-only; pickled dict payloads)
+    Tag.SS_OBS_SYNC: 1140,
     # shm-fabric pair announcement (rides the TCP plane once per
     # connected pair; swallowed by the transport reader)
     Tag.SHM_HELLO: 1998,
@@ -333,6 +336,12 @@ FIELDS: dict[str, tuple[int, int]] = {
     # daemons parse-and-ignore the field (job matching is a Python-
     # server feature today).
     "job_id": (97, _KIND_I64),
+    # unit-lifecycle trace context (Config(trace_sample) head-sampling):
+    # a sampled FA_PUT carries the client-minted trace id and the unit's
+    # journey is recorded server-side stage by stage (obs/journey.py).
+    # Omitted for unsampled puts, so trace_sample=0 worlds stay
+    # byte-identical on the wire; native daemons parse-and-ignore it.
+    "trace_id": (98, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -116,6 +116,15 @@ class Tag(enum.Enum):
     # converges; "done" additionally flushes the job's parked
     # requesters with ADLB_DONE_BY_EXHAUSTION (per-job termination)
     SS_JOB_CTL = enum.auto()
+    # fleet metrics plane (no reference analogue — upstream's whole
+    # diagnostic surface is end-of-run counter dumps): each non-master
+    # server ships a delta-encoded registry snapshot (changed
+    # counters/gauges/histograms, cumulative values) plus its closed
+    # unit journeys to the master on the obs_sync_interval tick, so the
+    # master's /metrics serves a merged fleet view, /healthz exposes
+    # per-rank snapshot staleness, and /trace/units serves the
+    # fleet-wide journey store. Armed only when ops_port is configured.
+    SS_OBS_SYNC = enum.auto()
 
     # server failover (Config(on_server_failure="failover"); no reference
     # analogue — upstream's servers ARE the pool and a server death kills

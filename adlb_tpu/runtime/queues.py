@@ -68,6 +68,13 @@ class WorkUnit:
     # any delivery/ship/snapshot path reads the bytes.
     spilled: bool = False
     spill_len: int = 0
+    # unit-lifecycle trace context (Config(trace_sample), obs/journey.py):
+    # 0 / None for the unsampled ~everything. A sampled unit carries the
+    # client-minted trace id and its accumulated (stage, rank, t_mono)
+    # span list; both travel with the unit across every path that moves
+    # it (push, migrate, fused relay, replication, WAL).
+    trace_id: int = 0
+    spans: Optional[list] = None
 
     @property
     def work_len(self) -> int:

@@ -67,6 +67,10 @@ OP_QUARANTINE = 18    # unit moved to the dead-letter quarantine
 # rides the stream (and the per-server WAL that tees it) so job
 # membership and lifecycle survive failover and cold restart
 OP_JOB = 19
+# unit-lifecycle trace context (obs/journey.py): a traced unit's
+# (trace_id, span list) — logged right behind its OP_PUT so the journey
+# survives failover adoption and WAL cold-restart replay
+OP_TRACE = 20
 
 _HDR = struct.Struct("<BI")       # op, body length
 _SEQ = struct.Struct("<q")        # one seqno
@@ -132,6 +136,18 @@ class ReplicationLog:
                             getattr(unit, "attempts", 0),
                             getattr(unit, "job", 0))
         self._append(OP_PUT, body + _pack_unit(unit))
+        if getattr(unit, "trace_id", 0) and \
+                getattr(unit, "spans", None) is not None:
+            # the trace context travels with the unit through EVERY
+            # log_put site (put intake, push/migrate re-log, promote
+            # re-log, WAL recovery re-log) by construction
+            self.log_trace(unit.seqno, unit.trace_id, unit.spans)
+
+    def log_trace(self, seqno: int, trace_id: int, spans) -> None:
+        from adlb_tpu.obs.journey import pack_spans
+
+        self._append(OP_TRACE,
+                     _SEQ.pack(seqno) + pack_spans(trace_id, spans))
 
     def log_pin(self, seqno: int, rank: int) -> None:
         self._append(OP_PIN, _SEQ2.pack(seqno, rank))
@@ -389,6 +405,15 @@ class ReplicaMirror:
             job_id, quota, state_code = _JOBHDR.unpack_from(body, 0)
             name = body[_JOBHDR.size:].decode("utf-8", "replace")
             self.jobs_meta[job_id] = (state_code, quota, name)
+        elif op == OP_TRACE:
+            from adlb_tpu.obs.journey import unpack_spans
+
+            (seqno,) = _SEQ.unpack_from(body, 0)
+            f = self.units.get(seqno)
+            if f is not None:
+                tid, spans = unpack_spans(body[_SEQ.size:])
+                f["trace_id"] = tid
+                f["spans"] = spans
         # unknown ops are skipped by construction (op byte + length frame)
 
     def seal(self) -> None:

@@ -34,6 +34,7 @@ from collections import deque
 from typing import Optional
 
 from adlb_tpu.obs.flight import FlightRecorder
+from adlb_tpu.obs.journey import JourneyRecorder, trace_fields
 from adlb_tpu.obs.metrics import Registry, attach
 from adlb_tpu.runtime.debug import aprintf, self_diagnosis
 from adlb_tpu.runtime.messages import Msg, Tag, msg
@@ -562,10 +563,49 @@ class Server:
         )
         self._span_names: dict[Tag, str] = {}
 
+        # unit-lifecycle tracing (Config(trace_sample), obs/journey.py):
+        # sampled units carry a span list stamped at every hop; terminal
+        # events close them into journeys feeding the unit_stage_s
+        # histograms, the closed-journey store, and (when trace=True)
+        # flow events in the Chrome-trace stream
+        self.journeys = JourneyRecorder(
+            self.rank, self.metrics, tracer=self.tracer
+        )
+        # traced puts whose ack is held for the WAL group commit:
+        # (src, put_id) -> unit, stamped "wal_commit" when the covering
+        # fsync releases the ack
+        self._trace_wal_pending: dict[tuple[int, int], WorkUnit] = {}
+
+        # ---- fleet metrics plane (SS_OBS_SYNC gossip) ----
+        # armed only for observed worlds (ops endpoint configured):
+        # non-master servers ship delta-encoded registry snapshots +
+        # closed journeys to the master every obs_sync_interval; the
+        # master merges them for /metrics, /healthz staleness, and
+        # /trace/units. Unobserved worlds pay zero gossip traffic.
+        self._obs_sync_armed = (
+            cfg.ops_port is not None and cfg.obs_sync_interval > 0
+        )
+        self._obs_last: dict = {}   # delta-snapshot memo (what we sent)
+        self._obs_seq = 0
+        # master side: rank -> cumulative registry view; rank -> (seq,
+        # received-at monotonic) staleness ledger; fleet journey store
+        self._fleet_snaps: dict[int, dict] = {}
+        self._fleet_seen: dict[int, tuple[int, float]] = {}
+        self._journeys_fleet: deque = deque(maxlen=4096)
+        self._last_aggregate_at = 0.0
+        # jobs whose gauges the last gauge tick set (so a dropped
+        # partition's gauges get zeroed exactly once, not left frozen)
+        self._job_gauged: set[int] = set()
+
         # timers
         now = time.monotonic()
         self._next_state_sync = now
         self._next_gauge_sample = now  # first tick samples immediately
+        self._next_obs_sync = (
+            now + cfg.obs_sync_interval
+            if self._obs_sync_armed
+            else float("inf")
+        )
         self._next_lease_scan = (
             now + cfg.lease_timeout_s if self._lease_armed else float("inf")
         )
@@ -667,6 +707,7 @@ class Server:
             Tag.SS_COMMON_FORFEIT: self._on_common_forfeit,
             Tag.SS_REPL: self._on_repl,
             Tag.SS_SERVER_DEAD: self._on_server_dead,
+            Tag.SS_OBS_SYNC: self._on_obs_sync,
         }
 
     @staticmethod
@@ -877,8 +918,7 @@ class Server:
             self._g_wal_depth.set(self.wal.depth)
             self._g_wal_lag.set(self.wal.fsync_lag_ms(now))
             if self.wal.maybe_compact(self):
-                for app, resp in self.wal.take_compact_acks():
-                    self._send_app(app, resp)
+                self._release_wal_acks(self.wal.take_compact_acks())
         if self._pending_promotion:
             # SS_SERVER_DEAD arrived but the dead server's own EOF has
             # not: promote at the deadline anyway (the death may predate
@@ -955,6 +995,42 @@ class Server:
             self._g_leases.set(len(self.leases))
             self._g_lease_age.set(self.leases.oldest_age(now))
             self._g_quarantined.set(len(self.quarantine))
+            # per-job depth/bytes/age gauges (non-default namespaces
+            # only — job 0 IS the world-level gauges above): what
+            # /jobs/<id> serves live and the autoscaler watches
+            gauged = set()
+            for jid in self.wq.job_ids():
+                if jid == 0:
+                    continue
+                part = self.wq.part(jid)
+                if part is None:
+                    continue
+                gauged.add(jid)
+                jl = str(jid)
+                m.gauge("job_wq_depth", job=jl).set(part.count)
+                m.gauge("job_wq_bytes", job=jl).set(part.total_bytes)
+                m.gauge("job_oldest_age_s", job=jl).set(max(
+                    (now - u.time_stamp for u in part.units()),
+                    default=0.0,
+                ))
+            # a dropped partition (job kill) leaves its gauges frozen at
+            # the last sample — zero them once so a dead job cannot
+            # report phantom backlog to /jobs/<id> forever (the change
+            # also rides the next gossip delta, healing the master)
+            for jid in self._job_gauged - gauged:
+                jl = str(jid)
+                m.gauge("job_wq_depth", job=jl).set(0)
+                m.gauge("job_wq_bytes", job=jl).set(0)
+                m.gauge("job_oldest_age_s", job=jl).set(0.0)
+            self._job_gauged = gauged
+        if self._obs_sync_armed and now >= self._next_obs_sync:
+            self._next_obs_sync = now + self.cfg.obs_sync_interval
+            if self.is_master:
+                # the master's own journeys join the fleet store directly
+                for j in self.journeys.take_done():
+                    self._journeys_fleet.append(j)
+            else:
+                self._obs_sync_send()
         if now >= self._next_state_sync:
             self._next_state_sync = now + interval
             if self.cfg.balancer == "tpu":
@@ -1024,6 +1100,12 @@ class Server:
                 self._unspill(unit)
         self.wq.pin(seqno, rank)
         self.leases.grant(seqno, rank)
+        if self.journeys.live:
+            unit = self.wq.get(seqno)
+            if unit is not None and unit.spans is not None:
+                # every reservation path (local match, plan enactment,
+                # RFR service) pins here — the "match" hop
+                self.journeys.stamp(unit, "match")
         if self.wlog is not None:
             self.wlog.log_pin(seqno, rank)
 
@@ -1073,6 +1155,8 @@ class Server:
                 self._forfeit_common(unit.common_seqno,
                                      unit.common_server_rank)
             self._m_targeted_dropped.inc()
+            if unit.spans is not None:
+                self.journeys.close(unit, "dropped")
             self.flight.record(
                 f"targeted_dropped rank={unit.target_rank} "
                 f"seqno={unit.seqno} (undelivered)"
@@ -1274,6 +1358,11 @@ class Server:
                 # the dead requester never fetched the prefix (fused
                 # responses carry only the suffix), so no common credit
                 self._requeue_consumed(unit, prefix_fetched=False)
+            elif unit.spans is not None:
+                # fused local delivery is terminal: the payload left
+                # with the reservation response
+                self.journeys.stamp(unit, "deliver")
+                self.journeys.close(unit, "delivered")
             return
         handle = WorkHandle(
             seqno=unit.seqno,
@@ -1311,6 +1400,11 @@ class Server:
         if not delivered:
             for u in units:
                 self._requeue_consumed(u)
+        else:
+            for u in units:
+                if u.spans is not None:
+                    self.journeys.stamp(u, "deliver")
+                    self.journeys.close(u, "delivered")
 
     def _send_reserve_handle(self, app_rank, unit, handle,
                              rqseqno=None) -> None:
@@ -1349,6 +1443,7 @@ class Server:
         }
         if self.world.nservers == 1:
             self.last_aggregate = pstats.aggregate(token, time.monotonic())
+            self._last_aggregate_at = time.monotonic()
             pstats.emit_stat_aps(self.last_aggregate)
             return
         self._forward_pstats(token)
@@ -1370,12 +1465,60 @@ class Server:
         token = m.token
         if self.is_master:
             # kept for the ops endpoint: /metrics serves this aggregate
-            # (stamped with its ring seq) as the world-level rows
+            # (stamped with its ring seq + an age, so a stalled ring
+            # reads as STALE data, not live data)
             self.last_aggregate = pstats.aggregate(token, time.monotonic())
+            self._last_aggregate_at = time.monotonic()
             pstats.emit_stat_aps(self.last_aggregate)
             return
         token["entries"][self.rank] = pstats.contribution(self)
         self._forward_pstats(token)
+
+    # ------------------------------------------- fleet metrics plane
+
+    def _obs_sync_send(self) -> None:
+        """Ship this server's delta registry snapshot + closed journeys
+        to the master (the SS_OBS_SYNC gossip tick). Best-effort like
+        the stats ring: the master dying aborts the world anyway."""
+        delta = self.metrics.delta_snapshot(self._obs_last)
+        journeys = self.journeys.take_done()
+        # an empty delta still goes: the seq-stamped frame doubles as
+        # the staleness heartbeat /healthz reads — an idle server stays
+        # distinguishable from a wedged one
+        self._obs_seq += 1
+        try:
+            self.ep.send(
+                self.world.master_server_rank,
+                msg(Tag.SS_OBS_SYNC, self.rank, snap=delta,
+                    journeys=journeys, seq=self._obs_seq),
+            )
+        except OSError:
+            pass  # droppable; cumulative values heal on the next tick
+
+    def _on_obs_sync(self, m: Msg) -> None:
+        if not self.is_master:
+            return
+        base = self._fleet_snaps.get(m.src) or {
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+        snap = m.data.get("snap") or {}
+        # publish-by-swap, never update-in-place: the ops HTTP thread
+        # iterates these dicts concurrently, and an in-place update
+        # inserting a first-seen key would blow up its iteration —
+        # a fresh dict swapped in under the GIL is always safe to read
+        self._fleet_snaps[m.src] = {
+            "rank": m.src,
+            "counters": {**base["counters"],
+                         **snap.get("counters", {})},
+            "gauges": {**base["gauges"], **snap.get("gauges", {})},
+            "histograms": {**base["histograms"],
+                           **snap.get("histograms", {})},
+        }
+        self._fleet_seen[m.src] = (
+            int(m.data.get("seq", 0)), time.monotonic()
+        )
+        for j in m.data.get("journeys") or ():
+            self._journeys_fleet.append(j)
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
                         holder: Optional[int] = None,
@@ -1723,7 +1866,15 @@ class Server:
             job=jid,
         )
         self._next_seqno += 1
+        trace_id = m.data.get("trace_id")
+        if trace_id:
+            # head-sampled unit: arm the journey (put_recv stamp) before
+            # anything else happens to it — the wlog append below then
+            # carries the context to the buddy/WAL with the unit
+            self.journeys.begin(unit, trace_id, time.monotonic())
         self.wq.add(unit)
+        if unit.spans is not None:
+            self.journeys.stamp(unit, "enqueue")
         if self.wlog is not None:
             self.wlog.log_put(unit, m.src, put_id)
         self.stats[InfoKey.MAX_WQ_COUNT] = max(
@@ -1754,6 +1905,10 @@ class Server:
             # write-ahead DURABILITY: the ack is held until the group
             # commit that fsyncs this put's entry (released immediately
             # when wal_fsync_ms == 0)
+            if unit.spans is not None and put_id is not None:
+                # stamp "wal_commit" when the covering fsync releases
+                # this ack (see _release_wal_acks)
+                self._trace_wal_pending[(m.src, put_id)] = unit
             self.wal.defer_ack(m.src, resp)
             self._flush_wal()
         else:
@@ -2116,6 +2271,10 @@ class Server:
         delivered = self._send_app(m.src, resp)
         if not delivered:
             self._requeue_consumed(unit)
+        elif unit.spans is not None:
+            # handle-path fetch served: the terminal hop
+            self.journeys.stamp(unit, "deliver")
+            self.journeys.close(unit, "delivered")
 
     def _on_get_common(self, m: Msg) -> None:
         fo = m.data.get("fo_from")
@@ -2347,6 +2506,14 @@ class Server:
                 payload=unit.payload,
                 time_on_q=time.monotonic() - unit.time_stamp,
             )
+            if unit.spans is not None:
+                # the payload leaves with the RFR response: journey
+                # custody transfers to the requester's HOME server,
+                # which closes it on delivery; our original context is
+                # dropped at the SS_DELIVERED consume (an UNRESERVE
+                # bounce keeps it — the journey continues here)
+                self.journeys.stamp(unit, "relay")
+                fields["trace"] = trace_fields(unit)
         if self._send_srv(
             dest, msg(Tag.SS_RFR_RESP, self.rank, **fields)
         ) is None:
@@ -2444,6 +2611,19 @@ class Server:
                 delivered = self._send_app(
                     app, msg(Tag.TA_RESERVE_RESP, self.rank, **fields)
                 )
+                tctx = m.data.get("trace")
+                if delivered and tctx:
+                    # the relayed journey closes HERE: the forwarding is
+                    # the delivery, and the deliver hop belongs to this
+                    # rank (the holder's copy is dropped at its
+                    # SS_DELIVERED consume)
+                    spans = list(tctx["spans"])
+                    spans.append(("deliver", self.rank, time.monotonic()))
+                    spans.append(("finalize", self.rank, time.monotonic()))
+                    self.journeys.close_spans(
+                        tctx["id"], entry.job, m.work_type, "delivered",
+                        spans,
+                    )
                 self._send_srv(
                     m.src,
                     msg(Tag.SS_DELIVERED, self.rank, seqno=m.seqno,
@@ -2544,6 +2724,9 @@ class Server:
         unit = self.wq.get(m.seqno)
         if unit is None or not unit.pinned or unit.pin_rank != m.for_rank:
             return  # already resolved (reclaim re-match / stale confirm)
+        # the home server closed the relayed journey from its copy;
+        # drop ours without a second close
+        self.journeys.forget(unit)
         self._consume(unit)
 
     # ------------------------------------------------------- push (memory)
@@ -2623,24 +2806,25 @@ class Server:
                     to_server=m.src,
                 ),
             )
+        pushed = dict(
+            query_id=m.query_id,
+            payload=unit.payload,
+            work_type=unit.work_type,
+            prio=unit.prio,
+            target_rank=unit.target_rank,
+            answer_rank=unit.answer_rank,
+            home_server=unit.home_server,
+            common_len=unit.common_len,
+            common_server=unit.common_server_rank,
+            common_seqno=unit.common_seqno,
+            time_stamp=unit.time_stamp,
+            attempts=unit.attempts,
+        )
+        tf = trace_fields(unit)
+        if tf is not None:  # untraced pushes stay byte-identical
+            pushed["trace"] = tf
         sent_to = self._send_srv(
-            m.src,
-            msg(
-                Tag.SS_PUSH_WORK,
-                self.rank,
-                query_id=m.query_id,
-                payload=unit.payload,
-                work_type=unit.work_type,
-                prio=unit.prio,
-                target_rank=unit.target_rank,
-                answer_rank=unit.answer_rank,
-                home_server=unit.home_server,
-                common_len=unit.common_len,
-                common_server=unit.common_server_rank,
-                common_seqno=unit.common_seqno,
-                time_stamp=unit.time_stamp,
-                attempts=unit.attempts,
-            ),
+            m.src, msg(Tag.SS_PUSH_WORK, self.rank, **pushed)
         )
         if sent_to is None:
             # the accepting peer died before the payload left: a unit
@@ -2650,6 +2834,9 @@ class Server:
             if self.wlog is not None:
                 self.wlog.log_put(unit, -1, None)
             self.stats[InfoKey.NPUSHED_FROM_HERE] -= 1
+        else:
+            # context custody moved with the frame (the receiver adopts)
+            self.journeys.forget(unit)
 
     def _on_push_work(self, m: Msg) -> None:
         self._push_reserved.pop(m.query_id, None)  # budget now owned by the unit
@@ -2668,6 +2855,9 @@ class Server:
             attempts=int(m.data.get("attempts", 0) or 0),
         )
         self._next_seqno += 1
+        tf = m.data.get("trace")
+        if tf:
+            self.journeys.adopt(unit, tf["id"], tf["spans"], stage="push")
         self.wq.add(unit)
         if self.wlog is not None:
             self.wlog.log_put(unit, -1, None)
@@ -3134,20 +3324,23 @@ class Server:
             if self.wlog is not None:
                 self.wlog.log_remove(seqno)
             self.stats[InfoKey.NPUSHED_FROM_HERE] += 1
-            units.append(
-                {
-                    "payload": unit.payload,
-                    "work_type": unit.work_type,
-                    "prio": unit.prio,
-                    "answer_rank": unit.answer_rank,
-                    "home_server": unit.home_server,
-                    "common_len": unit.common_len,
-                    "common_server": unit.common_server_rank,
-                    "common_seqno": unit.common_seqno,
-                    "time_stamp": unit.time_stamp,
-                    "attempts": unit.attempts,
-                }
-            )
+            shipped = {
+                "payload": unit.payload,
+                "work_type": unit.work_type,
+                "prio": unit.prio,
+                "answer_rank": unit.answer_rank,
+                "home_server": unit.home_server,
+                "common_len": unit.common_len,
+                "common_server": unit.common_server_rank,
+                "common_seqno": unit.common_seqno,
+                "time_stamp": unit.time_stamp,
+                "attempts": unit.attempts,
+            }
+            tf = trace_fields(unit)
+            if tf is not None:  # untraced batches stay byte-identical
+                shipped["trace"] = tf
+                self.journeys.forget(unit)  # custody rides the dict
+            units.append(shipped)
         if units:
             self.activity += 1
             self._exhaust_held_since = None
@@ -3221,6 +3414,10 @@ class Server:
                 attempts=int(u.get("attempts", 0) or 0),
             )
             self._next_seqno += 1
+            tf = u.get("trace")
+            if tf:
+                self.journeys.adopt(unit, tf["id"], tf["spans"],
+                                    stage="migrate")
             self.wq.add(unit)
             if self.wlog is not None:
                 self.wlog.log_put(unit, -1, None)
@@ -3680,6 +3877,8 @@ class Server:
         # the unit — the documented at-least-once window
         self._relay_inflight.pop(seqno, None)
         self.wq.unpin(seqno)
+        if unit.spans is not None:
+            self.journeys.stamp(unit, "expire")
         if self.wlog is not None:
             self.wlog.log_unpin(seqno)
         quarantined = self._bump_attempts(unit, in_wq=True)
@@ -3772,6 +3971,9 @@ class Server:
         self.quarantine.append(self._quarantine_record(unit))
         self.stats[InfoKey.QUARANTINED] += 1
         self._m_quarantined.inc()
+        if unit.spans is not None:
+            # quarantine is terminal: close the journey with its cause
+            self.journeys.close(unit, "quarantined")
         self.flight.record(
             f"unit_quarantined seqno={unit.seqno} type={unit.work_type} "
             f"attempts={unit.attempts}"
@@ -3902,10 +4104,28 @@ class Server:
         if w is None:
             return
         synced_before = w.syncs
-        for app, resp in w.tick(time.monotonic(), force=force):
-            self._send_app(app, resp)
+        self._release_wal_acks(w.tick(time.monotonic(), force=force))
         if w.syncs != synced_before:
             self._m_wal_syncs.inc(w.syncs - synced_before)
+
+    def _release_wal_acks(self, acks) -> None:
+        """Send the put acks a group commit (or compaction) released;
+        traced puts among them get their ``wal_commit`` span — the ack
+        release IS the durability instant the client observes."""
+        for app, resp in acks:
+            if self._trace_wal_pending:
+                unit = self._trace_wal_pending.pop(
+                    (app, resp.data.get("put_id")), None
+                )
+                if unit is not None and unit.spans is not None:
+                    self.journeys.stamp(unit, "wal_commit")
+                    # the OP_TRACE written at put time predates this
+                    # span: re-log so the durable copy (and the buddy's
+                    # mirror) carries the commit hop too
+                    if self.wlog is not None:
+                        self.wlog.log_trace(unit.seqno, unit.trace_id,
+                                            unit.spans)
+            self._send_app(app, resp)
 
     def _wal_seed(self, log) -> None:
         """Durable non-pool state re-seeded into a fresh WAL segment at
@@ -3930,6 +4150,12 @@ class Server:
             if job.job_id:
                 log.log_job(job.job_id, STATE_CODES[job.state],
                             job.quota_bytes, job.name)
+        # live units' trace contexts: the ACK2 shard cannot carry them,
+        # so they re-seed as OP_TRACE entries applied after the manifest
+        # installs the units
+        for u in self.wq.units():
+            if u.trace_id and u.spans is not None:
+                log.log_trace(u.seqno, u.trace_id, u.spans)
 
     def _recover_from_wal(self) -> None:
         """Cold restart: replay the on-disk log (snapshot shard + tail)
@@ -3945,11 +4171,19 @@ class Server:
         for seqno in sorted(mirror.units):
             f = dict(mirror.units[seqno])
             payload = f.pop("payload")
+            trace_id = f.pop("trace_id", 0)
+            tspans = f.pop("spans", None)
             unit = WorkUnit(seqno=seqno, payload=payload,
                             home_server=self.rank, **f)
             unit.pinned = False
             unit.pin_rank = -1
             self.mem.alloc(len(payload))
+            if trace_id:
+                # cold restart keeps the journey: the pre-crash spans
+                # (durable via OP_TRACE / the compaction seed) continue
+                # with a "replay" hop
+                self.journeys.adopt(unit, trace_id, tspans,
+                                    stage="replay")
             self.wq.add(unit)
             # re-log toward the buddy only (self.repl): the WAL already
             # holds these entries durably — re-teeing them would double
@@ -4107,6 +4341,11 @@ class Server:
                 self.leases.release(u.seqno)
                 self._relay_inflight.pop(u.seqno, None)
                 self._void_killed_unit(u.seqno)
+                if u.spans is not None:
+                    # a kill is terminal for the journey too (and must
+                    # release the recorder's live slot — leaking it
+                    # would eventually cap out tracing fleet-wide)
+                    self.journeys.close(u, "dropped")
                 if u.common_seqno >= 0:
                     # a fused batch member's prefix share will never be
                     # fetched: forfeit it so the common entry still GCs
@@ -4381,6 +4620,9 @@ class Server:
                     # accounted) is the acceptable one, as everywhere
                     # else in the common accounting.
                     self._relay_inflight.pop(lease.seqno, None)
+                    # the home server (if the payload landed) closed the
+                    # relayed journey; our copy just releases
+                    self.journeys.forget(unit)
                     self._consume(unit)
                     self.flight.record(
                         f"relay_consumed_on_death seqno={lease.seqno} "
@@ -4425,6 +4667,8 @@ class Server:
             self.leases.release(u.seqno)
             self._spill_drop(u)
             self.mem.free(len(u.payload))
+            if u.spans is not None:
+                self.journeys.close(u, "dropped")
             if self.wlog is not None:
                 self.wlog.log_remove(u.seqno)
             self._m_targeted_dropped.inc()
@@ -4976,6 +5220,10 @@ class Server:
             attempts=int(u.get("attempts", 0) or 0),
         )
         self._next_seqno += 1
+        tf = u.get("trace")
+        if tf:
+            self.journeys.adopt(unit, tf["id"], tf["spans"],
+                                stage="migrate")
         self.wq.add(unit)
         if self.wlog is not None:
             self.wlog.log_put(unit, -1, None)
@@ -5031,6 +5279,13 @@ class Server:
                     lost += 1
                     self._counted_lost.add((dead, old_seqno))
                     self._m_failover_lost.inc()
+                    if f.get("trace_id"):
+                        # failover loss is terminal for the journey too
+                        self.journeys.close_spans(
+                            f["trace_id"], f.get("job", 0),
+                            f["work_type"], "lost",
+                            list(f.get("spans") or []),
+                        )
                     self.flight.record(
                         f"failover_lost unit={old_seqno} (prefix gone)"
                     )
@@ -5065,6 +5320,11 @@ class Server:
             )
             self._next_seqno += 1
             self.mem.alloc(len(unit.payload))
+            if f.get("trace_id"):
+                # the journey survives the takeover with an "adopt" hop
+                # (and rides our own wlog onward via log_put below)
+                self.journeys.adopt(unit, f["trace_id"], f.get("spans"),
+                                    stage="adopt")
             self.wq.add(unit)
             if pin_rank >= 0:
                 self.leases.grant(unit.seqno, pin_rank)

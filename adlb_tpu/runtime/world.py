@@ -243,6 +243,25 @@ class Config:
     # since the obs unification this traces BOTH sides: client API spans
     # (pid 0) and server handler / balancer-round spans (pid 1) into one
     # merged Chrome-trace stream
+    # unit-lifecycle tracing (adlb_tpu/obs/journey.py): head-sampling
+    # probability at put — a sampled unit's FA_PUT carries a trace id
+    # (codec field 98) and every server it crosses appends
+    # (stage, rank, t) spans until a terminal event closes the journey
+    # (per-stage latency histograms + /trace/units on the master's ops
+    # endpoint). 0 disables it entirely: no wire field, no allocations
+    # on the put path — trace_sample=0 worlds are frame-identical to
+    # pre-trace builds. Sampling decisions come from a dedicated
+    # per-rank seeded RNG, so they are reproducible and never perturb
+    # the retry-jitter stream.
+    trace_sample: float = 0.01
+    # fleet metrics plane: non-master servers gossip delta-encoded
+    # registry snapshots (changed counters/gauges/histograms, cumulative
+    # values) plus their closed journeys to the master every this many
+    # seconds, so the master's /metrics serves a merged FLEET view and
+    # /healthz exposes per-rank snapshot staleness. Armed only when the
+    # ops endpoint is configured (ops_port is not None) — worlds without
+    # an observer pay zero gossip traffic. 0 disables the plane.
+    obs_sync_interval: float = 1.0
     # Flight-recorder JSON artifacts: directory for per-rank post-mortem
     # dumps on abort / watchdog timeout / lost home server. None defers
     # to the ADLB_FLIGHT_DIR env var; unset = text dumps only
@@ -455,6 +474,10 @@ class Config:
             raise ValueError("qmstat_event_gap must be >= 0")
         if self.ops_port is not None and not (0 <= self.ops_port <= 65535):
             raise ValueError("ops_port must be None or in 0..65535")
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError("trace_sample must be in [0, 1]")
+        if self.obs_sync_interval < 0:
+            raise ValueError("obs_sync_interval must be >= 0")
         if self.wal_dir is not None and self.server_impl == "native":
             # the C++ daemon has no WAL writer; its durability story is
             # the explicit checkpoint ring only

@@ -1444,6 +1444,61 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         engine_rows = {"engine_round_error": repr(e)[:200]}
 
+    # unit-lifecycle tracing overhead (round 9, the SLO sensor layer):
+    # coinop pop p50 at trace_sample=1.0 (every put journeyed — the
+    # worst case), at the DEFAULT sample rate, and at 0.0 (off), paired
+    # interleaved reps. trace_overhead_ratio is the DEFAULT-rate/off
+    # per-pair median — the ISSUE 13 acceptance bar bench_guard bounds
+    # absolutely at 1.05; the full-sampling rows are baseline-relative
+    # regression rows. Own containment.
+    def trace_overhead_bench():
+        default_rate = Config().trace_sample
+
+        def coin_trace(rate):
+            return coinop.run(
+                n_tokens=400, num_app_ranks=APPS, nservers=SERVERS,
+                cfg=Config(balancer="steal", exhaust_check_interval=0.2,
+                           trace_sample=rate),
+                timeout=300.0,
+            )
+
+        rates = {"full": 1.0, "default": default_rate, "off": 0.0}
+        runs = interleaved(
+            lambda m: coin_trace(rates[m]), modes=tuple(rates),
+        )
+
+        def med(mode):
+            return median_by(
+                runs[mode], key=lambda r: r.latency_p50_ms
+            ).latency_p50_ms
+
+        def pair_med(mode):
+            pairs = sorted(
+                a.latency_p50_ms / b.latency_p50_ms
+                for a, b in zip(runs[mode], runs["off"])
+                if b.latency_p50_ms
+            )
+            return round(pairs[len(pairs) // 2], 3) if pairs else 0.0
+
+        return {
+            "coinop_trace_p50_ms": round(med("full"), 3),
+            "coinop_trace_default_p50_ms": round(med("default"), 3),
+            "coinop_notrace_p50_ms": round(med("off"), 3),
+            # per-pair medians (phase-cancelling, like the bar metrics)
+            "trace_overhead_ratio": pair_med("default"),
+            "trace_overhead_full_ratio": pair_med("full"),
+            "trace_sample_default": default_rate,
+            "coinop_trace_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["full"]],
+            "coinop_notrace_p50_reps": [
+                round(r.latency_p50_ms, 3) for r in runs["off"]],
+        }
+
+    try:
+        trace_rows = trace_overhead_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        trace_rows = {"trace_overhead_error": repr(e)[:200]}
+
     # measurement provenance (the r07 caveat made policy): every record
     # carries the core count + load so cross-round comparisons can tell
     # a real regression from a different (or busy) box — bench_guard
@@ -1571,6 +1626,7 @@ def main() -> None:
             **mux_rows,
             **plan_rows,
             **engine_rows,
+            **trace_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1720,6 +1776,17 @@ def main() -> None:
             # the 8-burst submission [coalesced, sequential]
             "coinop_mux": [mux_rows.get("coinop_mux_p50_ms"),
                            mux_rows.get("coinop_mux_tcp_p50_ms")],
+            # unit-lifecycle tracing: [p50 @ trace_sample=1.0, p50 @ 0.0,
+            # p50 @ default rate] + the default-rate per-pair overhead
+            # ratio bench_guard bounds at 1.05 (ISSUE 13 acceptance)
+            "trace_overhead": [
+                trace_rows.get("coinop_trace_p50_ms"),
+                trace_rows.get("coinop_notrace_p50_ms"),
+                trace_rows.get("coinop_trace_default_p50_ms"),
+            ],
+            "trace_overhead_ratio": trace_rows.get("trace_overhead_ratio"),
+            "trace_overhead_full_ratio": trace_rows.get(
+                "trace_overhead_full_ratio"),
             "mux_burst8": [mux_rows.get("mux_burst8_batched_ms"),
                            mux_rows.get("mux_burst8_sequential_ms")],
             "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),

@@ -89,7 +89,25 @@ GUARDS = {
     "coinop_mux": [
         ("mux", "coinop_mux_p50_ms"),
     ],
+    # unit-lifecycle tracing (r09 metrics; older baselines skip with a
+    # note): pop p50 with every put head-sampled, vs the same world with
+    # tracing off — the SLO sensor layer's hot-path cost rows
+    "trace_overhead": [
+        ("traced", "coinop_trace_p50_ms"),
+        ("off", "coinop_notrace_p50_ms"),
+    ],
 }
+
+# Absolute arms: self-contained bounds checked against the NEW record
+# alone (no baseline needed — the bound IS the acceptance bar).
+# (key, max allowed value, description)
+ABSOLUTE = [
+    # the DEFAULT sample rate may cost at most 5% of coinop pop p50
+    # (ISSUE 13 acceptance); full sampling is gated baseline-relative
+    # via the trace_overhead rows above
+    ("trace_overhead_ratio", 1.05,
+     "default-sample-rate/untraced coinop pop p50 ratio"),
+]
 
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"
 
@@ -189,6 +207,28 @@ def main(argv=None) -> int:
             print(f"[bench-guard] {row}[{label}]: new {new:.3f} ms, "
                   f"baseline {base:.3f} ms ({(ratio - 1) * 100:+.1f}%) "
                   f"{verdict}")
+    # absolute arms: bound the NEW record directly (the bound is the
+    # acceptance bar, so no baseline row is needed); a metric absent
+    # from BOTH records is a not-yet-armed row, skipped with a note
+    for key, bound, desc in ABSOLUTE:
+        new = extract(new_detail, new_text, "", 0, key)
+        if new is None:
+            if extract(base_detail, base_text, "", 0, key) is None:
+                print(f"[bench-guard] {key}: not present yet; skipped "
+                      f"(arms once a record carries it)")
+            else:
+                failures.append(
+                    f"{key}: MISSING from {args.new} but present in the "
+                    f"baseline — a dropped metric is not a pass"
+                )
+            continue
+        checked += 1
+        if new > bound:
+            failures.append(
+                f"{key}: {new:.3f} > {bound:.3f} allowed ({desc})"
+            )
+        print(f"[bench-guard] {key}: {new:.3f} (bound {bound:.3f}, "
+              f"{desc}) {'REGRESSION' if new > bound else 'OK'}")
     if failures:
         print("[bench-guard] FAIL:")
         for f in failures:

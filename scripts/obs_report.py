@@ -16,8 +16,16 @@ directory (or an explicit file list) of them into a post-mortem:
 * per-server wq/rq queue-depth timelines (min/max/last + a coarse
   sparkline) — the depth history that explains a hang or a flat wait.
 
+With ``--journeys`` the inputs are unit-journey documents instead — the
+JSON served by the master's ``/trace/units`` ops route (or any file
+holding a ``{"journeys": [...]}`` doc / a bare journey list): prints a
+per-stage latency table (p50/p99 by job/type) plus a text waterfall of
+the N slowest sampled units (``--slowest N``, default 5).
+
 Usage:  python scripts/obs_report.py <flight-dir | flight-*.json ...>
         python scripts/obs_report.py --json <...>   (merged record as JSON)
+        python scripts/obs_report.py --journeys trace_units.json
+        python scripts/obs_report.py --journeys --slowest 8 <file ...>
 """
 
 from __future__ import annotations
@@ -190,12 +198,139 @@ def report(docs: list[dict], tail: int = 8) -> list[str]:
     return out
 
 
+# ------------------------------------------------------- journey report
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over raw per-journey samples (exact — the
+    offline tool sees the spans themselves, not log buckets)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def load_journeys(paths: list[str]) -> list[dict]:
+    """Accept /trace/units response docs, bare journey lists, or flight
+    dirs holding either as *.json files."""
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        files.extend(sorted(pp.glob("*.json")) if pp.is_dir() else [pp])
+    out: list[dict] = []
+    for f in files:
+        try:
+            doc = json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+            continue
+        if isinstance(doc, dict):
+            doc = doc.get("journeys", [])
+        out.extend(j for j in doc if isinstance(j, dict) and j.get("spans"))
+    return out
+
+
+def journey_report(journeys: list[dict], slowest: int = 5) -> list[str]:
+    out = [f"journeys: {len(journeys)}"]
+    ends: dict[str, int] = {}
+    for j in journeys:
+        ends[j.get("end", "?")] = ends.get(j.get("end", "?"), 0) + 1
+    out.append("ends: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(ends.items())
+    ))
+
+    # -- per-stage latency table (p50/p99 by job/type) -----------------------
+    # stage latency = time to REACH the stage from the previous span,
+    # the same attribution the live unit_stage_s histograms use
+    cells: dict[tuple, list[float]] = {}
+    totals: dict[tuple, list[float]] = {}
+    for j in journeys:
+        key = (j.get("job", 0), j.get("type", -1))
+        spans = j["spans"]
+        totals.setdefault(key, []).append(
+            j.get("total_s", spans[-1][2] - spans[0][2])
+        )
+        prev_t = spans[0][2]
+        for stage, _rank, t in spans[1:]:
+            cells.setdefault(key + (stage,), []).append(max(t - prev_t, 0.0))
+            prev_t = t
+    if cells:
+        out.append("\nper-stage latency (ms) by job/type:")
+        out.append(
+            f"  {'job':>4} {'type':>5} {'stage':<11} {'n':>6} "
+            f"{'p50':>9} {'p99':>9} {'max':>9}"
+        )
+        for (job, typ, stage), vals in sorted(cells.items()):
+            vals.sort()
+            out.append(
+                f"  {job:>4} {typ:>5} {stage:<11} {len(vals):>6} "
+                f"{_pctl(vals, 0.50) * 1e3:>9.3f} "
+                f"{_pctl(vals, 0.99) * 1e3:>9.3f} "
+                f"{vals[-1] * 1e3:>9.3f}"
+            )
+        for (job, typ), vals in sorted(totals.items()):
+            vals.sort()
+            out.append(
+                f"  {job:>4} {typ:>5} {'TOTAL':<11} {len(vals):>6} "
+                f"{_pctl(vals, 0.50) * 1e3:>9.3f} "
+                f"{_pctl(vals, 0.99) * 1e3:>9.3f} "
+                f"{vals[-1] * 1e3:>9.3f}"
+            )
+
+    # -- waterfall of the N slowest units ------------------------------------
+    ranked = sorted(
+        journeys,
+        key=lambda j: j.get("total_s",
+                            j["spans"][-1][2] - j["spans"][0][2]),
+        reverse=True,
+    )[:slowest]
+    if ranked:
+        out.append(f"\nslowest {len(ranked)} sampled units (waterfall):")
+    width = 40
+    for j in ranked:
+        spans = j["spans"]
+        t0, t1 = spans[0][2], spans[-1][2]
+        span_s = (t1 - t0) or 1e-9
+        out.append(
+            f"  unit trace_id={j.get('trace_id')} job={j.get('job', 0)} "
+            f"type={j.get('type', -1)} end={j.get('end')} "
+            f"total={span_s * 1e3:.3f} ms"
+        )
+        prev_t = t0
+        for stage, rank, t in spans:
+            off = int((prev_t - t0) / span_s * width)
+            ln = max(int((t - prev_t) / span_s * width), 0)
+            bar = " " * off + ("·" if ln == 0 else "█" * ln)
+            out.append(
+                f"    {stage:<11} rank {rank:>3} "
+                f"+{(t - prev_t) * 1e3:>9.3f} ms |{bar:<{width + 1}}|"
+            )
+            prev_t = t
+    return out
+
+
 def main(argv: list[str]) -> int:
     as_json = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
+    if "--slowest" in argv:
+        i = argv.index("--slowest")
+        slowest = int(argv[i + 1])
+        paths = [a for a in paths if a != argv[i + 1]]
+    else:
+        slowest = 5
     if not paths:
         print(__doc__, file=sys.stderr)
         return 2
+    if "--journeys" in argv:
+        journeys = load_journeys(paths)
+        if not journeys:
+            print("no journeys found", file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps({"journeys": journeys}))
+            return 0
+        print("\n".join(journey_report(journeys, slowest=slowest)))
+        return 0
     docs = load(paths)
     if not docs:
         print("no flight artifacts found", file=sys.stderr)

@@ -78,6 +78,14 @@ def _rand_frame(rng: random.Random, wild: bool = False) -> Msg:
         data["job_id"] = rng.choice([0, 1, 97, 2**31])
     else:
         data.pop("job_id", None)
+    # same treatment for the field-98 trace id (unit-lifecycle tracing):
+    # omitted-for-unsampled is the trace_sample=0 frame-identity contract
+    if rng.random() < 0.5:
+        data["trace_id"] = rng.choice(
+            [1, (1 << 32) | 1, (255 << 32) | 0xFFFFFFFF, 2**62]
+        )
+    else:
+        data.pop("trace_id", None)
     return Msg(tag=tag, src=rng.randrange(-1, 1 << 20), data=data)
 
 
@@ -118,6 +126,11 @@ def test_parity_known_corpus():
         msg(Tag.FA_PUT, 1, payload=b"x" * IOV_INLINE_MAX),
         msg(Tag.FA_PUT, 1, payload=b"x", job_id=7),
         msg(Tag.FA_PUT, 1, payload=b"x"),
+        # field-98 trace id: the sampled-put arm and the bare twin whose
+        # bytes must not change (trace_sample=0 frame identity)
+        msg(Tag.FA_PUT, 1, payload=b"x", put_id=3,
+            trace_id=(2 << 32) | 9),
+        msg(Tag.FA_PUT, 1, payload=b"x", put_id=3),
         msg(Tag.FA_RESERVE, 0, req_types=frozenset({1, 2, 9}),
             hang=True, rqseqno=42),
         msg(Tag.FA_RESERVE, 0, req_types=None, hang=False, rqseqno=1),

@@ -1,0 +1,637 @@
+"""Unit-lifecycle tracing + the fleet metrics plane (ISSUE 13).
+
+Trace-context survival across every path that moves a unit — local
+fused delivery, SS_PUSH_WORK, SS_MIGRATE_WORK, the fused-relay
+SS_RFR_RESP custody transfer, the replication stream, WAL cold-restart
+replay, failover adoption — plus the SS_OBS_SYNC gossip, the master's
+merged /metrics + /healthz staleness + /trace/units routes, and the
+end-to-end acceptance world (a migrated unit and a relay-delivered unit
+both retrievable as complete journeys from the master's ops endpoint).
+"""
+
+import json
+import struct
+import time
+import urllib.request
+
+import pytest
+
+from adlb_tpu.api import run_world
+from adlb_tpu.obs.journey import (
+    STAGE_CODES,
+    JourneyRecorder,
+    pack_spans,
+    trace_fields,
+    unpack_spans,
+)
+from adlb_tpu.obs.metrics import Registry
+from adlb_tpu.runtime.codec import decode_binary_py, encode_binary_iov_py
+from adlb_tpu.runtime.messages import Tag, msg
+from adlb_tpu.runtime.queues import RqEntry, WorkUnit
+from adlb_tpu.runtime.replica import ReplicaMirror, ReplicationLog
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import probe_free_ports, spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_SUCCESS
+
+T = 1
+
+
+class _RecEp:
+    """Recording endpoint: send() appends, recv() never delivers."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.sent = []
+
+    def send(self, dest, m, **_kw):
+        self.sent.append((dest, m))
+
+    def recv(self, timeout=None):
+        return None
+
+    def of(self, tag):
+        return [(d, m) for d, m in self.sent if m.tag is tag]
+
+
+def _mk_server(rank=2, nranks=4, nservers=2, **cfg_kw):
+    cfg_kw.setdefault("balancer", "steal")
+    cfg_kw.setdefault("native_queues", "off")
+    world = WorldSpec(nranks=nranks, nservers=nservers, types=(T,))
+    ep = _RecEp(rank)
+    return Server(world, Config(**cfg_kw), ep), ep
+
+
+def _put(server, payload, src=0, target=-1, trace_id=None, put_id=None,
+         job=None):
+    data = dict(payload=payload, work_type=T, prio=0, target_rank=target,
+                answer_rank=-1, common_len=0, common_server=-1,
+                common_seqno=-1, put_id=put_id)
+    if trace_id is not None:
+        data["trace_id"] = trace_id
+    if job is not None:
+        data["job_id"] = job
+    server._handle(msg(Tag.FA_PUT, src, **data))
+
+
+def _stages(journey):
+    return [s[0] for s in journey["spans"]]
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_span_pack_roundtrip():
+    spans = [("put_recv", 4, 12.5), ("enqueue", 4, 12.6),
+             ("migrate", 5, 12.9)]
+    tid, out = unpack_spans(pack_spans(0xBEEF, spans))
+    assert tid == 0xBEEF and out == spans
+    # stage codes are append-only wire ids — renumbering would corrupt
+    # old WAL replays
+    assert STAGE_CODES["put_recv"] == 1 and STAGE_CODES["replay"] == 12
+
+
+def test_recorder_close_feeds_histograms_and_store():
+    reg = Registry(rank=7)
+    rec = JourneyRecorder(7, reg, max_live=2, max_done=4)
+    u = WorkUnit(seqno=1, work_type=3, prio=0, target_rank=-1,
+                 answer_rank=-1, payload=b"x", job=2)
+    rec.begin(u, 99, 1.0)
+    rec.stamp(u, "enqueue", 1.5)
+    rec.stamp(u, "match", 2.0)
+    rec.stamp(u, "deliver", 2.25)
+    rec.close(u, "delivered", t=2.5)
+    assert u.spans is None and u.trace_id == 0 and rec.live == 0
+    (j,) = list(rec.done)
+    assert j["trace_id"] == 99 and j["end"] == "delivered"
+    assert j["job"] == 2 and j["type"] == 3
+    assert _stages(j) == ["put_recv", "enqueue", "match", "deliver",
+                          "finalize"]
+    # per-stage latency = time to REACH the stage from the previous one
+    h = reg.histogram("unit_stage_s", stage="enqueue", job="2", type="3")
+    assert h.n == 1 and h.sum == pytest.approx(0.5)
+    assert reg.histogram("unit_total_s", job="2", type="3").sum == \
+        pytest.approx(1.5)
+    # live cap: past it, contexts are dropped (counted), not grown
+    others = [
+        WorkUnit(seqno=i, work_type=3, prio=0, target_rank=-1,
+                 answer_rank=-1, payload=b"x") for i in (2, 3, 4)
+    ]
+    for i, o in enumerate(others):
+        rec.begin(o, 100 + i, 1.0)
+    assert rec.live == 2
+    assert others[2].spans is None
+    assert reg.value("trace_dropped") == 1
+
+
+def test_fa_put_trace_id_codec_roundtrip():
+    m = msg(Tag.FA_PUT, 3, payload=b"w", work_type=T, prio=0,
+            target_rank=-1, answer_rank=-1, put_id=5, trace_id=(4 << 32) | 7)
+    body = b"".join(bytes(p) for p in encode_binary_iov_py(m))
+    out = decode_binary_py(body)
+    assert out.data["trace_id"] == (4 << 32) | 7
+    # omitted = absent (the trace_sample=0 frame-identity contract)
+    m2 = msg(Tag.FA_PUT, 3, payload=b"w", work_type=T, prio=0,
+             target_rank=-1, answer_rank=-1, put_id=5)
+    assert b"".join(bytes(p) for p in encode_binary_iov_py(m2)) != body
+    assert "trace_id" not in decode_binary_py(
+        b"".join(bytes(p) for p in encode_binary_iov_py(m2))
+    ).data
+
+
+# ------------------------------------------------- server-side lifecycle
+
+
+def test_local_fused_delivery_closes_journey():
+    srv, ep = _mk_server(rank=2)
+    _put(srv, b"unit0", trace_id=42)
+    assert srv.journeys.live == 1
+    srv._handle(msg(Tag.FA_RESERVE, 0, rqseqno=1, req_types=[T],
+                    hang=False, fetch=1))
+    (dest, r), = ep.of(Tag.TA_RESERVE_RESP)
+    assert dest == 0 and r.rc == ADLB_SUCCESS and r.payload == b"unit0"
+    assert srv.journeys.live == 0
+    (j,) = srv.journeys.take_done()
+    assert j["trace_id"] == 42 and j["end"] == "delivered"
+    assert _stages(j) == ["put_recv", "enqueue", "match", "deliver",
+                          "finalize"]
+    assert all(rank == 2 for _, rank, _t in
+               [tuple(s) for s in j["spans"]])
+
+
+def test_untraced_put_records_nothing():
+    srv, ep = _mk_server(rank=2)
+    _put(srv, b"unit0")
+    assert srv.journeys.live == 0
+    unit = next(iter(srv.wq.units()))
+    assert unit.trace_id == 0 and unit.spans is None
+    assert trace_fields(unit) is None
+    # no trace key rides the push/migrate dicts for untraced units
+    srv._handle(msg(Tag.SS_PLAN_MIGRATE, 3, dest=3,
+                    seqnos=[unit.seqno], mig_id=1))
+    (_, mig), = ep.of(Tag.SS_MIGRATE_WORK)
+    assert "trace" not in mig.units[0]
+
+
+def test_trace_survives_push():
+    src, ep = _mk_server(rank=2)
+    _put(src, b"unit0", trace_id=7)
+    unit = next(iter(src.wq.units()))
+    qid = 1234
+    src._push_offered[qid] = unit.seqno
+    src._handle(msg(Tag.SS_PUSH_QUERY_RESP, 3, query_id=qid, accept=True))
+    (_, pushed), = ep.of(Tag.SS_PUSH_WORK)
+    assert pushed.data["trace"]["id"] == 7
+    assert src.journeys.live == 0  # custody left with the frame
+    dest, _ep2 = _mk_server(rank=3)
+    dest._handle(pushed)
+    got = next(iter(dest.wq.units()))
+    assert got.trace_id == 7
+    assert [s[0] for s in got.spans] == ["put_recv", "enqueue", "push"]
+    assert got.spans[0][1] == 2 and got.spans[-1][1] == 3
+    assert dest.journeys.live == 1
+
+
+def test_trace_survives_migrate():
+    src, ep = _mk_server(rank=2)
+    _put(src, b"unit0", trace_id=9)
+    unit = next(iter(src.wq.units()))
+    src._handle(msg(Tag.SS_PLAN_MIGRATE, 3, dest=3, seqnos=[unit.seqno],
+                    mig_id=1))
+    (_, mig), = ep.of(Tag.SS_MIGRATE_WORK)
+    assert mig.units[0]["trace"]["id"] == 9
+    dest, _ep2 = _mk_server(rank=3)
+    dest._handle(mig)
+    got = next(iter(dest.wq.units()))
+    assert got.trace_id == 9
+    assert [s[0] for s in got.spans] == ["put_recv", "enqueue", "migrate"]
+    assert got.spans[-1][1] == 3  # the migrate hop belongs to the dest
+
+
+def test_relay_journey_closes_at_home_not_holder():
+    holder, hep = _mk_server(rank=2)
+    _put(holder, b"fused", trace_id=11)
+    holder._handle(msg(Tag.SS_RFR, 3, for_rank=1, rqseqno=5,
+                       req_types=[T], targeted_lookup=False,
+                       lookup_type=-1, fetch=1))
+    (_, resp), = hep.of(Tag.SS_RFR_RESP)
+    assert resp.data["trace"]["id"] == 11
+    assert [s[0] for s in resp.data["trace"]["spans"]] == \
+        ["put_recv", "enqueue", "match", "relay"]
+    # home side: forwards + closes with its own deliver hop
+    home, ep2 = _mk_server(rank=3)
+    home.rq.add(RqEntry(world_rank=1, rqseqno=5,
+                        req_types=frozenset([T]), fetch=True))
+    home._handle(resp)
+    assert ep2.of(Tag.TA_RESERVE_RESP)
+    (j,) = home.journeys.take_done()
+    assert j["trace_id"] == 11 and j["end"] == "delivered"
+    assert _stages(j) == ["put_recv", "enqueue", "match", "relay",
+                          "deliver", "finalize"]
+    by_stage = {s[0]: s[1] for s in j["spans"]}
+    assert by_stage["relay"] == 2 and by_stage["deliver"] == 3
+    # holder: SS_DELIVERED consumes WITHOUT a second close
+    (_, conf), = ep2.of(Tag.SS_DELIVERED)
+    holder._handle(conf)
+    assert holder.journeys.live == 0
+    assert not holder.journeys.take_done()
+
+
+def test_quarantine_closes_journey():
+    srv, _ep = _mk_server(rank=2, lease_timeout_s=0.05, max_unit_retries=0)
+    _put(srv, b"poison", trace_id=13)
+    unit = next(iter(srv.wq.units()))
+    srv.cfg.max_unit_retries = 1
+    unit.attempts = 2
+    srv._quarantine_unit(unit, in_wq=True)
+    (j,) = srv.journeys.take_done()
+    assert j["end"] == "quarantined"
+    assert _stages(j)[-1] == "finalize"
+    assert srv.journeys.live == 0
+
+
+def test_trace_survives_replica_roundtrip():
+    log = ReplicationLog(buddy=3)
+    u = WorkUnit(seqno=5, work_type=T, prio=0, target_rank=-1,
+                 answer_rank=-1, payload=b"x", trace_id=21,
+                 spans=[("put_recv", 2, 1.0), ("enqueue", 2, 1.1)])
+    log.log_put(u, 0, 17)
+    mirror = ReplicaMirror(primary=2)
+    mirror.apply(log.take())
+    f = mirror.units[5]
+    assert f["trace_id"] == 21
+    assert f["spans"] == [("put_recv", 2, 1.0), ("enqueue", 2, 1.1)]
+
+
+def test_trace_survives_failover_adoption():
+    # primary (rank 2) logs a traced put; its buddy (rank 3) mirrors the
+    # stream, the primary dies, and the promoted pool keeps the journey
+    # with an "adopt" hop
+    log = ReplicationLog(buddy=3)
+    u = WorkUnit(seqno=5, work_type=T, prio=0, target_rank=-1,
+                 answer_rank=-1, payload=b"x", trace_id=33,
+                 spans=[("put_recv", 2, 1.0), ("enqueue", 2, 1.1)])
+    log.log_put(u, 0, 17)
+    buddy, _ep = _mk_server(rank=3, on_server_failure="failover")
+    mirror = ReplicaMirror(primary=2)
+    mirror.apply(log.take())
+    buddy.mirrors[2] = mirror
+    buddy._dead_servers.add(2)
+    buddy._promote(2)
+    got = next(iter(buddy.wq.units()))
+    assert got.trace_id == 33
+    assert [s[0] for s in got.spans] == ["put_recv", "enqueue", "adopt"]
+    assert got.spans[-1][1] == 3
+    assert buddy.journeys.live == 1
+
+
+def test_trace_survives_wal_cold_restart(tmp_path):
+    cfg = dict(wal_dir=str(tmp_path), wal_fsync_ms=0.0)
+    srv, ep = _mk_server(rank=2, **cfg)
+    _put(srv, b"durable", trace_id=55, put_id=1)
+    srv._flush_wal(force=True)
+    # the group commit released the held ack AND stamped wal_commit
+    unit = next(iter(srv.wq.units()))
+    assert [s[0] for s in unit.spans] == \
+        ["put_recv", "enqueue", "wal_commit"]
+    assert ep.of(Tag.TA_PUT_RESP)
+    srv.wal.close()
+    # cold restart: same wal_dir, fresh server — the journey continues
+    srv2, _ep2 = _mk_server(rank=2, **cfg)
+    assert srv2.wal_recovered == 1
+    got = next(iter(srv2.wq.units()))
+    assert got.trace_id == 55
+    assert [s[0] for s in got.spans] == \
+        ["put_recv", "enqueue", "wal_commit", "replay"]
+    assert srv2.journeys.live == 1
+    srv2.wal.close()
+
+
+def test_trace_survives_wal_compaction(tmp_path):
+    """Compaction snapshots the pool into an ACK2 shard (which cannot
+    carry spans): the fresh segment's seed must re-install the trace
+    contexts via OP_TRACE."""
+    cfg = dict(wal_dir=str(tmp_path), wal_fsync_ms=0.0)
+    srv, _ep = _mk_server(rank=2, **cfg)
+    _put(srv, b"keep", trace_id=77, put_id=1)
+    srv._flush_wal(force=True)
+    srv.wal.compact(srv)
+    srv.wal.close()
+    srv2, _ep2 = _mk_server(rank=2, **cfg)
+    got = next(iter(srv2.wq.units()))
+    assert got.trace_id == 77
+    assert [s[0] for s in got.spans] == \
+        ["put_recv", "enqueue", "wal_commit", "replay"]
+    srv2.wal.close()
+
+
+# --------------------------------------------------- fleet metrics plane
+
+
+def test_obs_sync_merges_at_master():
+    master, _ep = _mk_server(rank=2, nranks=4, nservers=2, ops_port=0)
+    # a gossiped delta from rank 3: counters are cumulative, gauges
+    # point-in-time, histograms whole
+    master._handle(msg(Tag.SS_OBS_SYNC, 3, seq=1, journeys=[
+        {"trace_id": 1, "job": 0, "type": T, "end": "delivered",
+         "t0": 0.0, "total_s": 0.5,
+         "spans": [["put_recv", 3, 0.0], ["finalize", 3, 0.5]]},
+    ], snap={"counters": {"puts": 4}, "gauges": {"wq_depth": 2.0}}))
+    master._handle(msg(Tag.SS_OBS_SYNC, 3, seq=2, journeys=[],
+                       snap={"counters": {"puts": 9}}))
+    assert master._fleet_snaps[3]["counters"]["puts"] == 9
+    assert master._fleet_seen[3][0] == 2
+    assert len(master._journeys_fleet) == 1
+    # the ops view: merged fleet counters include the gossiped rank
+    from adlb_tpu.obs.ops_server import OpsServer
+
+    master.metrics.counter("puts").inc(3)
+    ops = OpsServer(master, 0)
+    try:
+        m = ops._metrics()
+        assert "adlb_fleet_puts_total 12" in m
+        assert 'adlb_obs_snapshot_seq{rank="3"} 2' in m
+        assert 'adlb_obs_snapshot_age_seconds{rank="3"}' in m
+        h = ops._healthz()
+        assert h["ranks"]["3"]["seq"] == 2
+        assert h["ranks"]["3"]["stale"] is False
+        tu = ops._trace_units()
+        assert tu["count"] == 1 and tu["journeys"][0]["trace_id"] == 1
+    finally:
+        ops.stop()
+
+
+def test_delta_snapshot_sends_changes_only():
+    reg = Registry(rank=4)
+    c = reg.counter("puts")
+    g = reg.gauge("wq_depth")
+    h = reg.histogram("unit_total_s", job="0", type="1")
+    c.inc(2)
+    g.set(5)
+    h.observe(0.25)
+    memo: dict = {}
+    d1 = reg.delta_snapshot(memo)
+    assert d1["counters"]["puts"] == 2
+    assert d1["gauges"]["wq_depth"] == 5
+    assert 'unit_total_s{job=0,type=1}' in d1["histograms"]
+    # unchanged -> empty delta (the heartbeat's empty frame)
+    assert reg.delta_snapshot(memo) == {}
+    c.inc()
+    d3 = reg.delta_snapshot(memo)
+    assert d3 == {"counters": {"puts": 3}}
+
+
+def test_job_gauges_on_jobs_route():
+    from adlb_tpu.obs.ops_server import OpsServer
+
+    master, _ep = _mk_server(rank=2, nranks=4, nservers=2)
+    master.jobs.ensure(5, name="tenant")
+    _put(master, b"abc", job=5)
+    _put(master, b"defgh", job=5)
+    # a peer's gossiped job gauges fold into the totals
+    master._fleet_snaps[3] = {
+        "rank": 3, "counters": {}, "histograms": {},
+        "gauges": {"job_wq_depth{job=5}": 3.0,
+                   "job_wq_bytes{job=5}": 64.0,
+                   "job_oldest_age_s{job=5}": 9.5},
+    }
+    ops = OpsServer(master, 0)
+    try:
+        doc = ops._job_one("5")
+        assert doc["queue_depth"] == 5
+        assert doc["queued_bytes"] == 8 + 64
+        assert doc["oldest_age_s"] >= 9.5
+        assert doc["per_rank"]["3"]["depth"] == 3
+        assert "stage_latency_s" in doc
+    finally:
+        ops.stop()
+
+
+def test_gauge_tick_sets_job_gauges():
+    srv, _ep = _mk_server(rank=2)
+    _put(srv, b"abcd", job=7)
+    srv._next_gauge_sample = 0.0
+    srv._periodic(time.monotonic(), 0.05)
+    assert srv.metrics.value("job_wq_depth", job="7") == 1
+    assert srv.metrics.value("job_wq_bytes", job="7") == 4
+    # a killed job's partition disappears: the gauges must zero, not
+    # freeze at the last sample (phantom backlog on /jobs/<id>)
+    srv._apply_job_ctl("kill", 7)
+    srv._next_gauge_sample = 0.0
+    srv._periodic(time.monotonic(), 0.05)
+    assert srv.metrics.value("job_wq_depth", job="7") == 0
+    assert srv.metrics.value("job_wq_bytes", job="7") == 0
+
+
+def test_job_kill_closes_journeys():
+    srv, _ep = _mk_server(rank=2)
+    _put(srv, b"doomed", job=9, trace_id=17)
+    assert srv.journeys.live == 1
+    srv._apply_job_ctl("kill", 9)
+    assert srv.journeys.live == 0  # the live slot is released
+    (j,) = srv.journeys.take_done()
+    assert j["end"] == "dropped" and j["job"] == 9
+
+
+# ----------------------------------------------------------- client side
+
+
+def test_trace_sample_zero_draws_nothing():
+    from adlb_tpu.runtime.client import Client
+
+    world = WorldSpec(nranks=3, nservers=1, types=(T,))
+    fabric = InProcFabric(3)
+    c = Client(world, Config(trace_sample=0.0), fabric.endpoint(0))
+    state = c._trace_rng.getstate()
+    for _ in range(32):
+        assert c._sample_trace() is None
+    assert c._trace_rng.getstate() == state  # zero draws, zero allocs
+    assert c.metrics.value("traced_puts") == 0
+    c2 = Client(world, Config(trace_sample=1.0), fabric.endpoint(1))
+    tid = c2._sample_trace()
+    assert tid == (2 << 32) | 1
+    assert c2.metrics.value("traced_puts") == 1
+
+
+# -------------------------------------------------- acceptance (worlds)
+
+
+def _world_journeys(cfg_kw, n_units=40, apps=4, servers=2, port=None):
+    port = port if port is not None else probe_free_ports(1)[0]
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for a in range(n_units):
+                ctx.put(struct.pack("<q", a), T)
+            deadline = time.monotonic() + 30.0
+            out = {}
+            while time.monotonic() < deadline:
+                time.sleep(0.4)
+                tu = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/units", timeout=10,
+                ).read().decode())
+                if tu["count"] >= n_units:
+                    break
+            out["trace"] = tu
+            for route in ("metrics", "healthz"):
+                out[route] = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/{route}", timeout=10,
+                ).read().decode()
+            ctx.set_problem_done()
+            return out
+        if ctx.rank % servers == 0:
+            return 0  # consumers live only at the non-master server
+        n = 0
+        while True:
+            rc, _got = ctx.get_work([T])
+            if rc != ADLB_SUCCESS:
+                return n
+            time.sleep(0.005)
+            n += 1
+
+    cfg = Config(ops_port=port, trace_sample=1.0, obs_sync_interval=0.2,
+                 **cfg_kw)
+    res = spawn_world(apps, servers, [T], app, cfg=cfg, timeout=120.0)
+    consumed = sum(v for k, v in res.app_results.items() if k != 0)
+    return res.app_results[0], consumed
+
+
+@pytest.mark.slow
+def test_acceptance_journeys_migrated_and_relayed_tcp():
+    """The issue's acceptance world: a multi-server TCP fleet where a
+    sampled unit's FULL journey — including one that migrated and one
+    delivered via fused relay — is retrievable from the master's
+    /trace/units with per-stage latencies attributed to the right
+    rank, and /metrics reflects every rank's counters."""
+    got, consumed = _world_journeys(
+        dict(balancer="tpu", put_routing="home"), n_units=40,
+    )
+    tu = got["trace"]
+    assert consumed == 40
+    assert tu["count"] == 40, f"only {tu['count']} journeys closed"
+    master = 4  # 4 apps + 2 servers -> master rank 4, peer rank 5
+    migrated = [j for j in tu["journeys"] if "migrate" in _stages(j)]
+    relayed = [j for j in tu["journeys"] if "relay" in _stages(j)]
+    assert migrated, "no migrated journey (planner moved nothing?)"
+    assert relayed or migrated, "no cross-server journey at all"
+    for j in tu["journeys"]:
+        stages = _stages(j)
+        assert stages[0] == "put_recv" and stages[-1] == "finalize"
+        assert j["end"] == "delivered"
+        # per-stage rank attribution: the put landed on rank 0's home
+        # (the master, put_routing="home"); delivery happened wherever
+        # the consumer's server is
+        assert j["spans"][0][1] == master
+        for _stage, rank, _t in j["spans"]:
+            assert rank in (4, 5)
+        # spans are time-ordered (shared CLOCK_MONOTONIC on one host)
+        ts = [s[2] for s in j["spans"]]
+        assert ts == sorted(ts)
+    mj = migrated[0]
+    by_stage = {s[0]: s[1] for s in mj["spans"]}
+    assert by_stage["put_recv"] == master
+    assert by_stage["migrate"] == 5 and by_stage["deliver"] == 5
+    if relayed:
+        rj = relayed[0]
+        rs = {s[0]: s[1] for s in rj["spans"]}
+        assert rs["relay"] == master and rs["deliver"] == 5
+    # fleet /metrics covers every rank within a gossip cadence
+    m = got["metrics"]
+    assert "adlb_fleet_puts_total 40" in m
+    assert "adlb_fleet_unit_total_s_count" in m
+    assert 'adlb_obs_snapshot_seq{rank="5"}' in m
+    h = json.loads(got["healthz"])
+    assert set(h["ranks"]) == {"4", "5"}
+    assert h["stale_ranks"] == []
+
+
+@pytest.mark.slow
+def test_acceptance_journeys_relay_steal_mode_tcp():
+    """Same world over the steal balancer: cross-server delivery rides
+    RFR + fused relay, and the journey's relay hop must be attributed
+    to the holder."""
+    got, consumed = _world_journeys(dict(balancer="steal"), n_units=24)
+    tu = got["trace"]
+    assert consumed == 24
+    assert tu["count"] == 24
+    relayed = [j for j in tu["journeys"] if "relay" in _stages(j)]
+    assert relayed, "no relay journey (all units matched locally?)"
+    for j in relayed:
+        spans = {s[0]: s[1] for s in j["spans"]}
+        assert spans["relay"] != spans["deliver"], (
+            "relay and deliver on the same rank — custody transfer "
+            "did not happen"
+        )
+
+
+def test_obs_report_journeys_mode(tmp_path):
+    """scripts/obs_report.py --journeys: per-stage p50/p99 table by
+    job/type plus the slowest-units waterfall, straight off a
+    /trace/units response doc."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    doc = {"count": 2, "journeys": [
+        {"trace_id": 1, "job": 0, "type": T, "end": "delivered",
+         "t0": 10.0, "total_s": 0.5,
+         "spans": [["put_recv", 4, 10.0], ["enqueue", 4, 10.01],
+                   ["migrate", 5, 10.2], ["match", 5, 10.3],
+                   ["deliver", 5, 10.45], ["finalize", 5, 10.5]]},
+        {"trace_id": 2, "job": 3, "type": T, "end": "quarantined",
+         "t0": 10.0, "total_s": 0.1,
+         "spans": [["put_recv", 4, 10.0], ["enqueue", 4, 10.02],
+                   ["finalize", 4, 10.1]]},
+    ]}
+    f = tmp_path / "trace_units.json"
+    f.write_text(json.dumps(doc))
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "obs_report.py")
+    out = subprocess.run(
+        [_sys.executable, script, "--journeys", "--slowest", "1", str(f)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "journeys: 2" in out.stdout
+    assert "delivered=1" in out.stdout and "quarantined=1" in out.stdout
+    assert "migrate" in out.stdout  # the stage table has the hop
+    assert "TOTAL" in out.stdout
+    assert "waterfall" in out.stdout
+    assert "trace_id=1" in out.stdout  # the slower of the two
+    assert "trace_id=2" not in out.stdout  # --slowest 1 cut it
+
+
+def test_journey_flow_events_in_merged_trace():
+    """Config(trace=True) + sampling: closed journeys emit s/t/f flow
+    chains into the merged Chrome-trace stream."""
+
+    def app(ctx):
+        if ctx.rank == 0:
+            for i in range(6):
+                ctx.put(b"w" * 16, T, work_prio=i)
+        n = 0
+        while True:
+            rc, _r = ctx.get_work([T])
+            if rc < 0:
+                break
+            n += 1
+        if ctx.rank == 0:
+            ctx.set_problem_done()
+        return n
+
+    res = run_world(2, 1, [T], app,
+                    cfg=Config(trace=True, trace_sample=1.0), timeout=60.0)
+    assert sum(res.app_results.values()) == 6
+    flows = [e for e in res.trace_events if e.get("cat") == "unit"]
+    assert flows, "no journey flow events in the merged trace"
+    by_id: dict = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    assert len(by_id) == 6
+    for chain in by_id.values():
+        phases = [e["ph"] for e in chain]
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert set(phases[1:-1]) <= {"t"}
+        assert chain[0]["args"]["stage"] == "put_recv"
+        assert chain[-1]["args"]["stage"] == "finalize"

@@ -85,6 +85,9 @@ def test_exposition_format():
     assert '# TYPE adlb_send_s histogram' in text
     assert 'adlb_send_s_bucket{le="+Inf",rank="8"} 1' in text
     assert 'adlb_send_s_count{rank="8"} 1' in text
+    # point-quantile compat lines ride alongside the cumulative buckets
+    assert 'adlb_send_s{quantile="0.5",rank="8"}' in text
+    assert 'adlb_send_s{quantile="0.99",rank="8"}' in text
 
 
 def test_merge_across_ranks():
