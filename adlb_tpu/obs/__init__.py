@@ -8,11 +8,15 @@ each piece in isolation; this package unifies them around one per-rank
 reactor, balancer engine, client) writes into, one JSON
 :class:`~adlb_tpu.obs.flight.FlightRecorder` artifact emitted when a world
 dies, and one live HTTP surface
-(:class:`~adlb_tpu.obs.ops_server.OpsServer`) on the master server.
+(:class:`~adlb_tpu.obs.ops_server.OpsServer`) on the master server —
+plus the tail-aware layer: unit journeys with tail-based promotion
+(:mod:`~adlb_tpu.obs.journey`) and the continuous sampling profiler
+(:mod:`~adlb_tpu.obs.profile`), both riding the same gossip plane.
 """
 
 from adlb_tpu.obs.flight import FlightRecorder, resolve_flight_dir
 from adlb_tpu.obs.journey import JourneyRecorder
+from adlb_tpu.obs.profile import Profiler
 from adlb_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -28,6 +32,7 @@ __all__ = [
     "Registry",
     "FlightRecorder",
     "JourneyRecorder",
+    "Profiler",
     "expose_merged",
     "resolve_flight_dir",
 ]

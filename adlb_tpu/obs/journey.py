@@ -25,6 +25,34 @@ Closed journeys ride the fleet metrics gossip (``SS_OBS_SYNC``) to the
 master, whose ops endpoint serves them on ``/trace/units``; summarize
 offline with ``scripts/obs_report.py --journeys``.
 
+**Tail-based promotion** (``Config(trace_tail)``, default on when
+``ops_port`` is set): head sampling by construction almost never
+records the p99/p999 outliers, so under tail mode EVERY put is armed
+with spans (server-minted NEGATIVE trace ids — client-minted head ids
+are positive, so the wire field 98 and the retention decision never
+collide) and the recorder decides *retention* at terminal close:
+
+* head-sampled (``trace_id > 0``) — kept, as before (``why=["head"]``);
+* anomalous terminal — ``quarantined`` / ``dropped`` / ``lost``, or a
+  delivered journey that crossed a lease ``expire`` hop — ALWAYS kept,
+  so chaos events arrive with their full hop history attached;
+* slow — total latency exceeds the live per-(job, type) p99 threshold
+  the master computes from the merged fleet ``unit_total_s`` cells and
+  gossips back on ``SS_OBS_SYNC`` replies. Hysteresis: a threshold
+  only arms once its fleet cell holds ``TAIL_MIN_COUNT`` closes, so a
+  cold histogram promotes nothing (anomalous terminals still do).
+
+Unretained tail journeys still feed one ``unit_total_s`` observation
+(that histogram IS the p99 estimator; the per-stage ``unit_stage_s``
+cells stay head-sampled-only — the unbiased baseline) and skip the
+journey-dict build — the hot-path cost of tail mode is spans + one
+fold, bounded by the ``trace_tail_overhead_ratio`` bench arm. Promoted
+journeys carry
+``why=[...]`` and route to ``/trace/tails`` on the master (head
+journeys keep ``/trace/units``), plus a ``prof_win`` window-id range
+binding them to the continuous profiler's clock-aligned windows
+(``obs/profile.py``) for the tail↔profile join.
+
 Clock caveat: spans are ``time.monotonic`` stamps, comparable across
 processes on ONE host (Linux CLOCK_MONOTONIC is system-wide). Cross-host
 journeys carry each host's own clock — per-stage deltas that cross a
@@ -34,9 +62,12 @@ host boundary include the clock skew.
 from __future__ import annotations
 
 import struct
+from bisect import bisect_left
 from collections import deque
 from time import monotonic as _monotonic
 from typing import Optional
+
+from adlb_tpu.obs.profile import window_of
 
 # Stage registry: the codes are the replica/WAL wire form (OP_TRACE),
 # the names are the histogram labels and journey entries. Append-only —
@@ -61,6 +92,11 @@ CODE_STAGES = {v: k for k, v in STAGE_CODES.items()}
 # per-unit span cap: a unit bouncing through expiry loops must not grow
 # an unbounded history (the journey keeps its most recent window)
 MAX_SPANS = 64
+
+# tail-promotion hysteresis: the per-(job, type) p99 threshold only
+# arms once the fleet's unit_total_s cell has seen this many closes —
+# a cold histogram's p99 is noise and would promote everything
+TAIL_MIN_COUNT = 64
 
 _SPANHDR = struct.Struct("<qH")  # trace id, span count
 _SPAN = struct.Struct("<Bid")    # stage code, rank, t_mono
@@ -106,8 +142,17 @@ class JourneyRecorder:
         self.max_live = max_live
         self.live = 0
         self.done: deque = deque(maxlen=max_done)
+        # tail-based promotion (Config(trace_tail)): when armed the
+        # server begins a journey on EVERY put (begin_tail) and close
+        # decides retention; tail_thr is the fleet-fed per-(job, type)
+        # p99 map the master computes and gossips (swapped whole, never
+        # mutated in place — the reactor reads it mid-close)
+        self.tail = False
+        self.tail_thr: dict = {}
+        self._tail_seq = 0
         self._m_closed = registry.counter("trace_journeys_closed")
         self._m_dropped = registry.counter("trace_dropped")
+        self._m_promoted = registry.counter("trace_tail_promoted")
         # instrument cache: close_spans runs on the delivery hot path,
         # and the registry's kwargs/label lookup per observation is the
         # expensive part — hold the histogram objects by plain key
@@ -125,6 +170,14 @@ class JourneyRecorder:
         self.live += 1
         unit.trace_id = trace_id
         unit.spans = [("put_recv", self.rank, t)]
+
+    def begin_tail(self, unit, t: float) -> None:
+        """Arm an un-head-sampled unit under tail mode: the server mints
+        a NEGATIVE trace id (rank in the high bits, like the client's
+        positive head ids) so retention can tell the two apart at close
+        without any extra per-unit state."""
+        self._tail_seq += 1
+        self.begin(unit, -((self.rank << 40) | self._tail_seq), t)
 
     def adopt(self, unit, trace_id: int, spans, stage: Optional[str] = None,
               t: Optional[float] = None) -> None:
@@ -163,12 +216,41 @@ class JourneyRecorder:
 
     # -- closing -------------------------------------------------------------
 
+    def deliver_close(self, unit, t: Optional[float] = None) -> None:
+        """Fused deliver-stamp + delivered-close — ONE call on the hot
+        delivery path (under tail mode it runs for every unit; the
+        deliver and finalize stamps share one clock read, since they
+        land in the same handler anyway)."""
+        spans = unit.spans
+        if spans is None:
+            return
+        tm = _monotonic() if t is None else t
+        if len(spans) >= MAX_SPANS:
+            del spans[1:2]
+        spans.append(("deliver", self.rank, tm))
+        tid = unit.trace_id
+        if tid > 0:
+            # head journeys keep the PR 12 stage set (finalize last);
+            # tail journeys end at deliver (same instant, one fold less)
+            spans.append(("finalize", self.rank, tm))
+        unit.spans = None
+        unit.trace_id = 0
+        if self.live > 0:
+            self.live -= 1
+        self.close_spans(tid, unit.job, unit.work_type, "delivered", spans)
+
     def close(self, unit, end: str, t: Optional[float] = None) -> None:
         """Terminal event on a locally-held unit: finalize-stamp and fold
-        the journey."""
+        the journey. Tail-minted journeys (negative ids — EVERY unit in
+        a tail-armed world) skip the finalize stamp when the last hop is
+        already this close's own ``deliver``: the two stamps land in the
+        same handler microseconds apart, so the hop carries no
+        attribution and costs a span + a fold per unit. Terminal closes
+        without a deliver hop (quarantine, drop, loss) still stamp."""
         if unit.spans is None:
             return
-        self.stamp(unit, "finalize", t)
+        if unit.trace_id > 0 or unit.spans[-1][0] != "deliver":
+            self.stamp(unit, "finalize", t)
         spans, trace_id = unit.spans, unit.trace_id
         unit.spans = None
         unit.trace_id = 0
@@ -177,36 +259,87 @@ class JourneyRecorder:
 
     def close_spans(self, trace_id: int, job: int, work_type: int,
                     end: str, spans: list) -> None:
-        """Fold an explicit span list into a closed journey (the relay
-        path at the requester's home server, and failover-loss closes,
-        hold spans without a live local unit)."""
+        """Close an explicit span list into a journey (the relay path
+        at the requester's home server, and failover-loss closes, hold
+        spans without a live local unit).
+
+        Under tail mode this runs for EVERY unit, so the folds split by
+        what each estimator actually needs: the p99 promotion threshold
+        is a quantile of TOTAL latency, so the tail bulk (negative ids)
+        feeds one ``unit_total_s`` observation and nothing else; the
+        per-stage ``unit_stage_s`` cells stay head-sampled-only — they
+        exist to be an UNBIASED per-stage baseline (the /jobs view and
+        the tails excess attribution), and folding the promoted slow
+        journeys into them would bias exactly that baseline, while
+        promoted journeys already carry their raw spans for exact
+        within-journey deltas. Net: the every-unit path costs one
+        histogram observation plus the retention check (written for the
+        1-core GIL-coupled worst case — each microsecond here is
+        client-visible pop latency on a saturated core)."""
         if not spans:
             return
-        reg = self.registry
-        prev_t = spans[0][2]
-        for stage, _rank, t in spans[1:]:
-            h = self._hists.get((stage, job, work_type))
-            if h is None:
-                h = self._hists[(stage, job, work_type)] = reg.histogram(
-                    "unit_stage_s", stage=stage, job=str(job),
-                    type=str(work_type),
-                )
-            h.observe(max(t - prev_t, 0.0))
-            prev_t = t
+        self._m_closed.v += 1  # counter.inc() inlined: every-unit path
+        total = spans[-1][2] - spans[0][2]
+        if total < 0.0:
+            total = 0.0
         ht = self._totals.get((job, work_type))
         if ht is None:
-            ht = self._totals[(job, work_type)] = reg.histogram(
+            ht = self._totals[(job, work_type)] = self.registry.histogram(
                 "unit_total_s", job=str(job), type=str(work_type)
             )
-        ht.observe(max(spans[-1][2] - spans[0][2], 0.0))
-        self._m_closed.inc()
+        # Histogram.observe inlined (every-unit path): one bisect + adds
+        ht.counts[bisect_left(ht.bounds, total)] += 1
+        ht.sum += total
+        ht.n += 1
+        if trace_id > 0:
+            # head-sampled: the unbiased per-stage baseline cells
+            hists = self._hists
+            prev_t = spans[0][2]
+            for span in spans[1:]:
+                stage = span[0]
+                t = span[2]
+                h = hists.get((stage, job, work_type))
+                if h is None:
+                    h = hists[(stage, job, work_type)] = \
+                        self.registry.histogram(
+                            "unit_stage_s", stage=stage, job=str(job),
+                            type=str(work_type),
+                        )
+                d = t - prev_t
+                h.observe(d if d > 0.0 else 0.0)
+                prev_t = t
+        # ---- retention decision (the head-vs-tail sampling gap fix):
+        # the journey dict below is only built for what we keep; the
+        # dominant case — tail-armed clean delivery, below threshold —
+        # exits with two dict probes and a span scan
+        if trace_id < 0 and end == "delivered":
+            for s in spans:
+                if s[0] == "expire":
+                    why = ["expired_lease"]
+                    break
+            else:
+                thr = self.tail_thr.get((job, work_type))
+                if thr is None or total <= thr:
+                    return
+                why = ["slow"]
+        else:
+            why = self._why(trace_id, job, work_type, end, total, spans)
+            if not why:
+                return
+        if why != ["head"]:
+            self._m_promoted.inc()
         self.done.append({
             "trace_id": trace_id,
             "job": job,
             "type": work_type,
             "end": end,
+            "why": why,
             "t0": round(spans[0][2], 6),
-            "total_s": round(max(spans[-1][2] - spans[0][2], 0.0), 6),
+            "total_s": round(total, 6),
+            # the profiler window-id range this journey crossed: window
+            # ids are clock-aligned (t // WINDOW_S on the shared host
+            # CLOCK_MONOTONIC), so no profiler handshake is needed here
+            "prof_win": [window_of(spans[0][2]), window_of(spans[-1][2])],
             "spans": [[stage, rank, round(t, 6)] for stage, rank, t in spans],
         })
         tr = self.tracer
@@ -231,6 +364,38 @@ class JourneyRecorder:
                 if i == last:
                     ev["bp"] = "e"
                 tr._emit(ev)
+
+    def _why(self, trace_id: int, job: int, work_type: int, end: str,
+             total: float, spans: list) -> list:
+        """Retention reasons for a closed journey (empty = drop).
+
+        Head-sampled ids (positive) always keep — the PR 12 behavior is
+        unchanged. Under tail mode, anomalous terminals (anything but a
+        clean delivery, plus delivered journeys that crossed a lease
+        expiry) always promote, and a clean delivery promotes iff it
+        blew past the fleet-fed per-(job, type) p99 threshold."""
+        why = []
+        if trace_id > 0:
+            why.append("head")
+        if self.tail:
+            if end != "delivered":
+                why.append(end)
+            else:
+                # plain loop, not any(genexpr): this runs per close
+                # under tail mode and the generator allocation is a
+                # measured slice of the per-journey cost
+                expired = False
+                for s in spans:
+                    if s[0] == "expire":
+                        expired = True
+                        break
+                if expired:
+                    why.append("expired_lease")
+                else:
+                    thr = self.tail_thr.get((job, work_type))
+                    if thr is not None and total > thr:
+                        why.append("slow")
+        return why
 
     def take_done(self) -> list:
         """Drain closed journeys (the gossip tick toward the master)."""

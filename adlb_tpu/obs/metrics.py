@@ -92,23 +92,35 @@ class Histogram:
         self.n += 1
 
     def quantile(self, q: float) -> float:
-        """Upper bucket bound at quantile ``q`` (0..1) — coarse by design
-        (log buckets), good enough for p50/p95 health lines."""
+        """Within-bucket linearly interpolated quantile at ``q`` (0..1)
+        — still log-bucket coarse between bucket edges, but sharp enough
+        for the point-quantile /metrics lines and the tail-promotion p99
+        threshold (Prometheus ``histogram_quantile`` semantics)."""
         return quantile_of(self.bounds, self.counts, self.n, q)
 
 
 def quantile_of(bounds, counts, n: int, q: float) -> float:
-    """Bucket-bound quantile shared by live Histograms and merged
-    snapshot dicts (the fleet /metrics and /jobs stage-latency views)."""
+    """Interpolated quantile shared by live Histograms and merged
+    snapshot dicts (the fleet /metrics, /jobs stage-latency views, and
+    the tail-promotion thresholds): linear within the bucket the target
+    rank lands in (lower edge 0 for the first bucket). A quantile in
+    the +Inf overflow bucket answers the highest finite bound —
+    Prometheus ``histogram_quantile`` convention; ``inf`` would poison
+    every threshold compare downstream."""
     if n == 0:
         return 0.0
     target = q * n
-    seen = 0
+    seen = 0.0
     for i, c in enumerate(counts):
+        prev = seen
         seen += c
-        if seen >= target:
-            return bounds[i] if i < len(bounds) else float("inf")
-    return float("inf")
+        if seen >= target and c > 0:
+            if i >= len(bounds):
+                return bounds[-1] if bounds else float("inf")
+            lo = bounds[i - 1] if i > 0 else 0.0
+            frac = min(max((target - prev) / c, 0.0), 1.0)
+            return lo + (bounds[i] - lo) * frac
+    return bounds[-1] if bounds else float("inf")
 
 
 class Timeseries:
@@ -334,7 +346,8 @@ class Registry:
             out.append(fmt(name + "_count", dict(labels), h.n))
             # point quantiles alongside the cumulative buckets (summary-
             # style compat lines for dashboards that read p50/p95/p99
-            # directly; bucket-bound coarse, like Histogram.quantile)
+            # directly; within-bucket interpolated, like
+            # Histogram.quantile)
             for q in _QUANTILES:
                 out.append(
                     fmt(name, {**lab, "quantile": q},

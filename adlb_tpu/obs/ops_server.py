@@ -21,7 +21,23 @@ plane:
 * ``GET /trace/units`` — the fleet journey store (unit-lifecycle
   tracing, ``Config(trace_sample)``): closed per-unit journeys from
   every rank, summarizable offline with
-  ``scripts/obs_report.py --journeys``.
+  ``scripts/obs_report.py --journeys``. Supports ``?job=``, ``?type=``,
+  ``?min_ms=`` and ``?limit=`` (newest N) query filters — the bounded
+  store holds up to 4096 journeys, which is an unwieldy single body.
+* ``GET /trace/tails`` — the TAIL store (``Config(trace_tail)``):
+  journeys promoted at close because they blew the live per-(job,type)
+  fleet p99 or ended anomalously (quarantined/dropped/lost/expired
+  lease). Same query filters as ``/trace/units``. Each journey comes
+  annotated with the stage that blew past its fleet-typical p50
+  (``slow_stage``/``excess_s``) and, when the continuous profiler is
+  armed, the dominant folded stacks active on the responsible rank
+  during the window(s) that stage crossed (``stacks``) — the
+  tail↔profile join. Render with ``scripts/obs_report.py --tails``.
+* ``GET /profile`` — the merged fleet continuous profile
+  (``Config(profile_hz)``): collapsed-stack text (flamegraph-ready;
+  one ``role;[phase:..;]frames... count`` line per stack), or the full
+  JSON document (per-rank stacks + sampling windows) with
+  ``?format=json``. Render with ``scripts/obs_report.py --profile``.
 * ``GET /dump`` — trigger a flight-record snapshot: returns the JSON doc
   inline and writes the artifact when a flight directory is configured.
 * ``GET /deadletter`` — this server's dead-letter quarantine (units that
@@ -118,7 +134,10 @@ class OpsServer:
                 self.wfile.write(body)
 
             def do_GET(self) -> None:  # noqa: N802 — http.server contract
-                path = self.path.split("?", 1)[0]
+                from urllib.parse import parse_qs
+
+                path, _, query = self.path.partition("?")
+                q = {k: v[-1] for k, v in parse_qs(query).items()}
                 try:
                     if path == "/healthz":
                         body = json.dumps(ops._healthz()).encode()
@@ -135,8 +154,21 @@ class OpsServer:
                         body = json.dumps(ops._deadletter()).encode()
                         self._send(200, body, "application/json")
                     elif path == "/trace/units":
-                        body = json.dumps(ops._trace_units()).encode()
+                        body = json.dumps(ops._trace_units(q)).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/trace/tails":
+                        body = json.dumps(ops._trace_tails(q)).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/profile":
+                        if q.get("format") == "json":
+                            self._send(
+                                200,
+                                json.dumps(ops._profile_doc()).encode(),
+                                "application/json",
+                            )
+                        else:
+                            self._send(200, ops._profile_text().encode(),
+                                       "text/plain")
                     elif path == "/jobs":
                         body = json.dumps(ops._jobs()).encode()
                         self._send(200, body, "application/json")
@@ -293,7 +325,33 @@ class OpsServer:
             )
         return body
 
-    def _trace_units(self) -> dict:
+    @staticmethod
+    def _filter_journeys(journeys: list, q: Optional[dict]) -> list:
+        """Apply the ``?job= / ?type= / ?min_ms= / ?limit=`` query
+        filters (limit keeps the NEWEST n; the stores append newest
+        last). Unknown keys are ignored; malformed values raise
+        ValueError, which the handler answers as a 500 with the repr."""
+        if not q:
+            return journeys
+        if "job" in q:
+            want = int(q["job"])
+            journeys = [j for j in journeys if j.get("job", 0) == want]
+        if "type" in q:
+            want = int(q["type"])
+            journeys = [j for j in journeys if j.get("type", -1) == want]
+        if "min_ms" in q:
+            floor_s = float(q["min_ms"]) / 1e3
+            journeys = [
+                j for j in journeys if j.get("total_s", 0.0) >= floor_s
+            ]
+        if "limit" in q:
+            n = max(int(q["limit"]), 0)
+            # negative-index slice: clamps when n exceeds the store
+            # (journeys[len-n:] would wrap and DROP results instead)
+            journeys = journeys[-n:] if n else []
+        return journeys
+
+    def _trace_units(self, q: Optional[dict] = None) -> dict:
         """The fleet journey store: every closed unit journey that
         reached the master (its own + the SS_OBS_SYNC gossip), newest
         last. Spans are (stage, rank, t_mono) triples; per-stage deltas
@@ -301,12 +359,147 @@ class OpsServer:
         from adlb_tpu.obs.metrics import safe_copy
 
         s = self.server
-        journeys = safe_copy(s._journeys_fleet)
+        journeys = self._filter_journeys(safe_copy(s._journeys_fleet), q)
         return {
             "rank": s.rank,
             "count": len(journeys),
             "journeys": journeys,
         }
+
+    # -- tail store + the tail<->profile join --------------------------------
+
+    def _fleet_stage_p50(self) -> dict:
+        """(stage, job, type) -> fleet-typical p50 from the merged
+        unit_stage_s cells — the baseline each tail journey's per-stage
+        deltas are judged against."""
+        from adlb_tpu.obs.metrics import Registry, quantile_of
+
+        s = self.server
+        merged = Registry.merge(
+            [s.metrics.snapshot()] + list(_stable_dict(s._fleet_snaps).values())
+        )["histograms"]
+        out = {}
+        for key, h in merged.items():
+            if not key.startswith("unit_stage_s{"):
+                continue
+            lab = dict(
+                kv.split("=", 1)
+                for kv in key[len("unit_stage_s{"):-1].split(",")
+            )
+            try:
+                out[(lab["stage"], int(lab["job"]), int(lab["type"]))] = \
+                    quantile_of(h["bounds"], h["counts"], h["count"], 0.5)
+            except (KeyError, ValueError):
+                continue
+        return out
+
+    def _rank_windows(self, rank: int) -> list:
+        """A rank's sealed profiler windows: the master's own live from
+        its owned sampler, every other rank's from the gossip ring —
+        with an in-proc fallback: a single-interpreter world runs ONE
+        process profiler whose samples cover every co-located rank's
+        threads but are filed under the owner, so when nothing has ever
+        gossiped windows (the profile plane is entirely local) the
+        process profiler's windows ARE this rank's windows."""
+        from adlb_tpu.obs import profile as _profile
+        from adlb_tpu.obs.metrics import safe_copy
+
+        s = self.server
+        wins = s._prof_windows.get(rank)
+        if wins is not None:
+            return safe_copy(wins)
+        if rank == s.rank and s._prof is not None:
+            return safe_copy(s._prof.windows)
+        if not s._prof_windows:
+            p = s._prof or _profile.active()
+            if p is not None:
+                return safe_copy(p.windows)
+        return []
+
+    def _trace_tails(self, q: Optional[dict] = None) -> dict:
+        """The tail store (Config(trace_tail)): promoted journeys, each
+        annotated with the stage its excess attributes to (the stage
+        whose delta most exceeds the fleet-typical p50) and — when the
+        continuous profiler runs — the dominant folded stacks active on
+        the responsible rank during the window(s) that stage crossed."""
+        from adlb_tpu.obs.metrics import safe_copy
+        from adlb_tpu.obs.profile import window_of
+
+        s = self.server
+        journeys = self._filter_journeys(safe_copy(s._tails_fleet), q)
+        p50 = self._fleet_stage_p50()
+        out = []
+        for j in journeys:
+            j = dict(j)
+            spans = j.get("spans") or []
+            best = None  # (excess, stage, rank, t_prev, t)
+            prev_t = spans[0][2] if spans else 0.0
+            for stage, rank, t in spans[1:]:
+                delta = max(t - prev_t, 0.0)
+                excess = delta - p50.get(
+                    (stage, j.get("job", 0), j.get("type", -1)), 0.0
+                )
+                if best is None or excess > best[0]:
+                    best = (excess, stage, rank, prev_t, t)
+                prev_t = t
+            if best is not None and best[0] > 0:
+                excess, stage, rank, t_a, t_b = best
+                j["slow_stage"] = stage
+                j["slow_rank"] = rank
+                j["excess_s"] = round(excess, 6)
+                # profiler join: sum the responsible rank's window
+                # stacks over the window ids the slow interval crossed
+                # (window ids are clock-aligned on the shared host
+                # CLOCK_MONOTONIC, so span stamps index them directly)
+                w0, w1 = window_of(t_a), window_of(t_b)
+                stacks: dict = {}
+                for w in self._rank_windows(rank):
+                    if w0 <= w["id"] <= w1:
+                        for k, v in w["stacks"].items():
+                            stacks[k] = stacks.get(k, 0) + v
+                if stacks:
+                    j["stacks"] = sorted(
+                        stacks.items(), key=lambda kv: -kv[1]
+                    )[:5]
+            out.append(j)
+        return {"rank": s.rank, "count": len(out), "journeys": out}
+
+    # -- continuous profile --------------------------------------------------
+
+    def _profile_doc(self) -> dict:
+        """The merged fleet profile: per-rank cumulative folded stacks
+        (the master's own read live from its sampler, peers' from the
+        SS_OBS_SYNC gossip), their elementwise-summed merge, and the
+        per-rank sealed sampling windows (the tail-join inputs)."""
+        from adlb_tpu.obs.profile import merge_stacks
+
+        s = self.server
+        per_rank: dict[str, dict] = {}
+        windows: dict[str, list] = {}
+        if s._prof is not None:
+            own = s._prof.snapshot()
+            per_rank[str(s.rank)] = own["stacks"]
+            windows[str(s.rank)] = own["win"]
+        from adlb_tpu.obs.metrics import safe_copy
+
+        for r, stacks in sorted(_stable_dict(s._prof_fleet).items()):
+            per_rank[str(r)] = dict(stacks)
+        for r, wins in sorted(_stable_dict(s._prof_windows).items()):
+            windows[str(r)] = safe_copy(wins)
+        return {
+            "rank": s.rank,
+            "hz": getattr(s.cfg, "profile_hz", 0.0),
+            "ranks": per_rank,
+            "merged": merge_stacks(per_rank),
+            "windows": windows,
+        }
+
+    def _profile_text(self) -> str:
+        """Flamegraph-compatible collapsed-stack text of the merged
+        fleet profile (one ``stack count`` line, heaviest first)."""
+        from adlb_tpu.obs.profile import collapsed_text
+
+        return collapsed_text(self._profile_doc()["merged"])
 
     def _deadletter(self) -> dict:
         s = self.server

@@ -165,6 +165,13 @@ class Client:
         # the process) is detected within the timeout
         self._m_fenced = self.metrics.counter("fenced_fetches")
         self._m_put_backoffs = self.metrics.counter("put_backoffs")
+        # continuous-profiler role tag (obs/profile.py): a plain dict
+        # write — in-proc worlds share the interpreter with the servers'
+        # sampler, so app-rank stacks fold under "client" instead of a
+        # raw thread name; a no-op when nothing ever profiles
+        from adlb_tpu.obs import profile as _profile
+
+        _profile.register_thread("client")
         self._hb_stop: Optional[threading.Event] = None
         if cfg.lease_timeout_s > 0:
             self._hb_stop = threading.Event()
@@ -183,6 +190,9 @@ class Client:
         whole round behind one dead server (the takeover remap happens
         on the main thread) and starve the beacons that keep healthy
         servers from declaring this rank hung."""
+        from adlb_tpu.obs import profile as _profile
+
+        _profile.register_thread("heartbeat")
         interval = max(self.cfg.lease_timeout_s / 3.0, 0.005)
         while not self._hb_stop.wait(interval):
             for dest in {self._route(s) for s in self.world.server_ranks}:

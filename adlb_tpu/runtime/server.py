@@ -33,9 +33,10 @@ import time
 from collections import deque
 from typing import Optional
 
+from adlb_tpu.obs import profile
 from adlb_tpu.obs.flight import FlightRecorder
-from adlb_tpu.obs.journey import JourneyRecorder, trace_fields
-from adlb_tpu.obs.metrics import Registry, attach
+from adlb_tpu.obs.journey import TAIL_MIN_COUNT, JourneyRecorder, trace_fields
+from adlb_tpu.obs.metrics import Registry, attach, quantile_of
 from adlb_tpu.runtime.debug import aprintf, self_diagnosis
 from adlb_tpu.runtime.messages import Msg, Tag, msg
 from adlb_tpu.runtime.trace import PID_SERVER, Tracer
@@ -116,12 +117,18 @@ class _BalancerWorker(threading.Thread):
             metrics=s.metrics,
         )
         s._solver = engine.solver
+        from adlb_tpu.obs import profile as _profile
+
+        _profile.register_thread("balancer")
+        prof = _profile.active()
         while True:
             self.wake.wait(timeout=0.25)
             self.wake.clear()
             if self.stopped or s.done:
                 return
             try:
+                if prof is not None:
+                    prof.set_phase("balancer_tick")
                 self._one_round(engine)
             except Exception as e:  # noqa: BLE001
                 # The balancer must survive solver/backend errors — in tpu
@@ -571,6 +578,12 @@ class Server:
         self.journeys = JourneyRecorder(
             self.rank, self.metrics, tracer=self.tracer
         )
+        # tail-based promotion (Config(trace_tail)): "auto" arms iff the
+        # world is observed (ops endpoint configured) — unobserved
+        # worlds keep the untraced-put frame identity
+        self.journeys.tail = cfg.trace_tail == "on" or (
+            cfg.trace_tail == "auto" and cfg.ops_port is not None
+        )
         # traced puts whose ack is held for the WAL group commit:
         # (src, put_id) -> unit, stamped "wal_commit" when the covering
         # fsync releases the ack
@@ -592,6 +605,24 @@ class Server:
         self._fleet_snaps: dict[int, dict] = {}
         self._fleet_seen: dict[int, tuple[int, float]] = {}
         self._journeys_fleet: deque = deque(maxlen=4096)
+        # tail-promoted journeys (why != head): the /trace/tails store
+        self._tails_fleet: deque = deque(maxlen=2048)
+        # per-(job, type) p99 thresholds the master computes from the
+        # merged fleet unit_total_s cells (cached per obs tick; replies
+        # to gossip frames carry it back to the closing servers)
+        self._tail_thr_cache: list = []
+        # continuous profiler (Config(profile_hz)): _prof is the OWNED
+        # instance (this server started it, gossips it, stops it);
+        # _prof_shared is whatever profiler lives in this process (for
+        # phase markers — in-proc worlds share one across servers)
+        self._prof = None
+        self._prof_shared = None
+        self._prof_memo: dict = {}
+        self._phase_names: dict[Tag, str] = {}
+        # master side: per-rank gossiped cumulative folded stacks and
+        # sealed sampling windows (the /profile merge + tail join)
+        self._prof_fleet: dict[int, dict] = {}
+        self._prof_windows: dict[int, deque] = {}
         self._last_aggregate_at = 0.0
         # jobs whose gauges the last gauge tick set (so a dropped
         # partition's gauges get zeroed exactly once, not left frozen)
@@ -745,10 +776,19 @@ class Server:
                         self.cfg.aprintf_flag, self.rank,
                         f"ops endpoint on 127.0.0.1:{self.ops.port}",
                     )
+            if self.cfg.profile_hz > 0:
+                # per-PROCESS singleton: in-proc worlds run many server
+                # threads in one interpreter and the sampler sees them
+                # all — the first starter owns (and gossips) it, the
+                # rest share it for phase markers only
+                self._prof = profile.start(self.cfg.profile_hz, self.rank)
+            self._prof_shared = profile.active()
             if self._balancer is not None:
                 self._balancer.start()
             self._run_loop()
         finally:
+            profile.stop(self._prof)
+            self._prof = None
             if self.ops is not None:
                 self.ops.stop()
             if self.wal is not None:
@@ -808,6 +848,9 @@ class Server:
             if self.cfg.balancer == "tpu"
             else self.cfg.qmstat_interval
         )
+        profile.register_thread("reactor")
+        prof = self._prof_shared  # None when profiling is off: the
+        # phase markers below cost one None check per transition then
         while not self.done:
             if self._abort_event is not None and self._abort_event.is_set():
                 # every server dumps state on abort (the reference gives a
@@ -834,6 +877,11 @@ class Server:
                 if self.wal is not None
                 else now + 1.0,
             )
+            if prof is not None:
+                # "decode" covers the recv wait + frame decode; a sample
+                # landing in the idle wait shows poll/recv frames, which
+                # the stack itself disambiguates from decode work
+                prof.set_phase("decode")
             m = self.ep.recv(timeout=max(deadline - time.monotonic(), 0.0))
             t0 = time.monotonic()
             if m is not None:
@@ -851,11 +899,15 @@ class Server:
                     for _ in range(128):
                         if self.done or time.monotonic() >= deadline:
                             break
+                        if prof is not None:
+                            prof.set_phase("decode")
                         m2 = self.ep.recv(timeout=0.0)
                         if m2 is None:
                             break
                         self._handle(m2)
                 finally:
+                    if prof is not None:
+                        prof.set_phase("submit_flush")
                     self.ep.submit_flush()
             self._flush_repl()
             self._flush_wal()
@@ -869,6 +921,14 @@ class Server:
         if handler is None:
             raise AdlbError(f"server {self.rank}: no handler for {m.tag}")
         self.tag_freq[m.tag] = self.tag_freq.get(m.tag, 0) + 1
+        prof = self._prof_shared
+        if prof is not None:
+            # phase marker: a profiler sample interrupting this handler
+            # attributes to handler:<TAG> (cached string, edge-set)
+            pname = self._phase_names.get(m.tag)
+            if pname is None:
+                pname = self._phase_names[m.tag] = f"handler:{m.tag.name}"
+            prof.set_phase(pname)
         if self._lease_armed and m.src < self.world.num_app_ranks:
             # every frame from an app rank is liveness evidence: protocol
             # traffic piggybacks the heartbeat, FA_HEARTBEAT only covers
@@ -1026,9 +1086,19 @@ class Server:
         if self._obs_sync_armed and now >= self._next_obs_sync:
             self._next_obs_sync = now + self.cfg.obs_sync_interval
             if self.is_master:
-                # the master's own journeys join the fleet store directly
-                for j in self.journeys.take_done():
-                    self._journeys_fleet.append(j)
+                # the master's own journeys join the fleet stores
+                # directly (head -> /trace/units, promoted -> tails)
+                self._route_journeys(self.journeys.take_done())
+                if self.journeys.tail:
+                    # refresh the per-(job, type) p99 promotion
+                    # thresholds from the merged fleet unit_total_s
+                    # cells; install locally and cache for the gossip
+                    # replies that carry them to the closing servers
+                    thr = self._tail_thresholds()
+                    self._tail_thr_cache = [
+                        [j, t, v] for (j, t), v in thr.items()
+                    ]
+                    self.journeys.tail_thr = thr
             else:
                 self._obs_sync_send()
         if now >= self._next_state_sync:
@@ -1361,8 +1431,7 @@ class Server:
             elif unit.spans is not None:
                 # fused local delivery is terminal: the payload left
                 # with the reservation response
-                self.journeys.stamp(unit, "deliver")
-                self.journeys.close(unit, "delivered")
+                self.journeys.deliver_close(unit)
             return
         handle = WorkHandle(
             seqno=unit.seqno,
@@ -1403,8 +1472,7 @@ class Server:
         else:
             for u in units:
                 if u.spans is not None:
-                    self.journeys.stamp(u, "deliver")
-                    self.journeys.close(u, "delivered")
+                    self.journeys.deliver_close(u)
 
     def _send_reserve_handle(self, app_rank, unit, handle,
                              rqseqno=None) -> None:
@@ -1480,23 +1548,39 @@ class Server:
         """Ship this server's delta registry snapshot + closed journeys
         to the master (the SS_OBS_SYNC gossip tick). Best-effort like
         the stats ring: the master dying aborts the world anyway."""
-        delta = self.metrics.delta_snapshot(self._obs_last)
         journeys = self.journeys.take_done()
+        delta = self.metrics.delta_snapshot(self._obs_last)
         # an empty delta still goes: the seq-stamped frame doubles as
         # the staleness heartbeat /healthz reads — an idle server stays
         # distinguishable from a wedged one
         self._obs_seq += 1
+        extra = {}
+        if self._prof is not None:
+            # owned profiler: changed-stacks-only cumulative counters +
+            # windows sealed since the last ship (lost frames heal —
+            # same contract as the registry delta)
+            pd = self._prof.take_delta(self._prof_memo)
+            if pd:
+                extra["prof"] = pd
         try:
             self.ep.send(
                 self.world.master_server_rank,
                 msg(Tag.SS_OBS_SYNC, self.rank, snap=delta,
-                    journeys=journeys, seq=self._obs_seq),
+                    journeys=journeys, seq=self._obs_seq, **extra),
             )
         except OSError:
             pass  # droppable; cumulative values heal on the next tick
 
     def _on_obs_sync(self, m: Msg) -> None:
         if not self.is_master:
+            # master -> server reply: the tail-promotion thresholds
+            # computed from the FLEET hist cells (list-of-triples wire
+            # form; swapped whole so a mid-close read stays consistent)
+            thr = m.data.get("thr")
+            if thr is not None:
+                self.journeys.tail_thr = {
+                    (int(j), int(t)): float(v) for j, t, v in thr
+                }
             return
         base = self._fleet_snaps.get(m.src) or {
             "counters": {}, "gauges": {}, "histograms": {},
@@ -1517,8 +1601,90 @@ class Server:
         self._fleet_seen[m.src] = (
             int(m.data.get("seq", 0)), time.monotonic()
         )
-        for j in m.data.get("journeys") or ():
-            self._journeys_fleet.append(j)
+        self._route_journeys(m.data.get("journeys") or ())
+        pd = m.data.get("prof")
+        if pd:
+            # cumulative folded stacks overwrite per key (publish-by-
+            # swap for the ops thread, like the registry snapshots);
+            # sealed windows append to the per-rank ring
+            base = self._prof_fleet.get(m.src) or {}
+            stacks = pd.get("stacks")
+            if stacks:
+                self._prof_fleet[m.src] = {**base, **stacks}
+            wins = self._prof_windows.get(m.src)
+            if wins is None:
+                wins = self._prof_windows[m.src] = deque(
+                    maxlen=profile.MAX_WINDOWS
+                )
+            for w in pd.get("win") or ():
+                wins.append(w)
+        if self.journeys.tail and self._tail_thr_cache:
+            # carry the promotion thresholds back on the same plane
+            # (best-effort, 1 small frame per gossip tick per server)
+            try:
+                self.ep.send(
+                    m.src,
+                    msg(Tag.SS_OBS_SYNC, self.rank,
+                        thr=self._tail_thr_cache),
+                )
+            except OSError:
+                pass
+
+    def _route_journeys(self, journeys) -> None:
+        """Sort closed journeys into the master's fleet stores by their
+        retention reasons: head-sampled -> /trace/units (the PR 12
+        store), any tail-promotion reason -> /trace/tails. A journey
+        can be both (a head-sampled unit that also blew the p99)."""
+        for j in journeys:
+            why = j.get("why") or ["head"]
+            if "head" in why:
+                self._journeys_fleet.append(j)
+            if any(w != "head" for w in why):
+                self._tails_fleet.append(j)
+
+    def _tail_thresholds(self) -> dict:
+        """Per-(job, type) p99 of unit total latency over the MERGED
+        fleet ``unit_total_s`` cells (the master's live registry + every
+        gossiped snapshot). Hysteresis: a cell arms only past
+        TAIL_MIN_COUNT closes, so a cold histogram promotes nothing."""
+        agg: dict[tuple, list] = {}
+
+        def add(bounds, counts, n, job, typ):
+            key = (job, typ)
+            cur = agg.get(key)
+            if cur is None:
+                agg[key] = [list(bounds), list(counts), n]
+            elif len(cur[1]) == len(counts):
+                cur[1] = [a + b for a, b in zip(cur[1], counts)]
+                cur[2] += n
+
+        for (name, labels), h in self.metrics._stable_items()[2]:
+            if name != "unit_total_s":
+                continue
+            lab = dict(labels)
+            try:
+                add(h.bounds, h.counts, h.n,
+                    int(lab["job"]), int(lab["type"]))
+            except (KeyError, ValueError):
+                continue
+        for snap in list(self._fleet_snaps.values()):
+            for key, h in snap.get("histograms", {}).items():
+                if not key.startswith("unit_total_s{"):
+                    continue
+                lab = dict(
+                    kv.split("=", 1)
+                    for kv in key[len("unit_total_s{"):-1].split(",")
+                )
+                try:
+                    add(h["bounds"], h["counts"], h["count"],
+                        int(lab["job"]), int(lab["type"]))
+                except (KeyError, ValueError):
+                    continue
+        return {
+            key: quantile_of(bounds, counts, n, 0.99)
+            for key, (bounds, counts, n) in agg.items()
+            if n >= TAIL_MIN_COUNT
+        }
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
                         holder: Optional[int] = None,
@@ -1872,8 +2038,17 @@ class Server:
             # anything else happens to it — the wlog append below then
             # carries the context to the buddy/WAL with the unit
             self.journeys.begin(unit, trace_id, time.monotonic())
+        elif self.journeys.tail:
+            # tail mode: EVERY put accumulates spans under a server-
+            # minted (negative) id; whether the journey is KEPT is
+            # decided at terminal close (p99 / anomalous-end promotion)
+            self.journeys.begin_tail(unit, time.monotonic())
         self.wq.add(unit)
-        if unit.spans is not None:
+        if unit.trace_id > 0:
+            # the enqueue hop separates admission work from queue wait —
+            # meaningful at head-sample volume, but its delta is this
+            # handler's own microseconds, so the every-unit tail arm
+            # skips it (tail attribution charges the wait to "match")
             self.journeys.stamp(unit, "enqueue")
         if self.wlog is not None:
             self.wlog.log_put(unit, m.src, put_id)
@@ -2273,8 +2448,7 @@ class Server:
             self._requeue_consumed(unit)
         elif unit.spans is not None:
             # handle-path fetch served: the terminal hop
-            self.journeys.stamp(unit, "deliver")
-            self.journeys.close(unit, "delivered")
+            self.journeys.deliver_close(unit)
 
     def _on_get_common(self, m: Msg) -> None:
         fo = m.data.get("fo_from")
@@ -4103,6 +4277,9 @@ class Server:
         w = self.wal
         if w is None:
             return
+        prof = self._prof_shared
+        if prof is not None:
+            prof.set_phase("wal_fsync")
         synced_before = w.syncs
         self._release_wal_acks(w.tick(time.monotonic(), force=force))
         if w.syncs != synced_before:

@@ -254,6 +254,26 @@ class Config:
     # per-rank seeded RNG, so they are reproducible and never perturb
     # the retry-jitter stream.
     trace_sample: float = 0.01
+    # tail-based journey promotion (the head-vs-tail sampling gap fix,
+    # obs/journey.py): "auto" arms it whenever the ops endpoint is
+    # configured (ops_port is not None) — an observed world captures its
+    # p99 by construction; "on"/"off" force it. Armed, EVERY put
+    # accumulates spans (server-minted negative trace ids; the put wire
+    # stays byte-identical — nothing new rides FA_PUT) and the terminal
+    # close decides retention: head-sampled as before, anomalous
+    # terminals (quarantined/dropped/lost/expired-lease) always, and
+    # clean deliveries only when their total latency exceeds the live
+    # fleet per-(job,type) p99 (threshold gossiped back on SS_OBS_SYNC
+    # replies; hysteresis: arms at TAIL_MIN_COUNT closes per cell).
+    # Promoted journeys serve on the master's /trace/tails.
+    trace_tail: str = "auto"
+    # continuous sampling profiler (obs/profile.py): per-process
+    # folded-stack sampler at this many Hz walking sys._current_frames()
+    # into role/phase-keyed collapsed stacks, delta-gossiped over
+    # SS_OBS_SYNC; the master serves the merged fleet profile at
+    # /profile. 0 = off (no thread at all); 19 Hz recommended (prime —
+    # cannot phase-lock the balancer/qmstat cadences).
+    profile_hz: float = 0.0
     # fleet metrics plane: non-master servers gossip delta-encoded
     # registry snapshots (changed counters/gauges/histograms, cumulative
     # values) plus their closed journeys to the master every this many
@@ -476,6 +496,10 @@ class Config:
             raise ValueError("ops_port must be None or in 0..65535")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError("trace_sample must be in [0, 1]")
+        if self.trace_tail not in ("auto", "on", "off"):
+            raise ValueError(f"unknown trace_tail {self.trace_tail!r}")
+        if self.profile_hz < 0:
+            raise ValueError("profile_hz must be >= 0")
         if self.obs_sync_interval < 0:
             raise ValueError("obs_sync_interval must be >= 0")
         if self.wal_dir is not None and self.server_impl == "native":

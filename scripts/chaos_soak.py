@@ -38,7 +38,7 @@ import time
 sys.path.insert(0, __import__("os").path.join(
     __import__("os").path.dirname(__import__("os").path.abspath(__file__)), ".."))
 
-from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.transport_tcp import probe_free_ports, spawn_world
 from adlb_tpu.runtime.world import Config
 from adlb_tpu.types import ADLB_SUCCESS, InfoKey
 from adlb_tpu.workloads import nq
@@ -201,7 +201,8 @@ def _economy_rank0(ctx, n_pairs, do_abort):
     return total
 
 
-def gray_economy(n_units, victim=None, stall_s=0.0, poison=False):
+def gray_economy(n_units, victim=None, stall_s=0.0, poison=False,
+                 ops_port=None):
     """Answer-at-cycle-boundary economy for the GRAY adversities: rank 0
     puts ids (plus one poison-typed unit when ``poison``) and collects
     answers until coverage is complete; workers reserve/fetch/answer with
@@ -209,7 +210,13 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False):
     fetch (holding an unfetched lease) and must survive the fencing of
     its late fetch. Kills at reserve-response (the poison fault) land at
     cycle boundaries, so a casualty loses nothing it already answered
-    and the id-coverage oracle stays exact."""
+    and the id-coverage oracle stays exact.
+
+    With ``ops_port`` the world is OBSERVED (trace_sample=0 + tail
+    promotion armed by the port): rank 0 polls the master's
+    /trace/tails before finishing and returns the doc, so the harness
+    can assert the quarantined / lease-expired unit's journey was
+    captured — observability exercised under faults, not happy path."""
     T, T_P, T_ANS = 1, 2, 3
 
     def app(ctx):
@@ -229,8 +236,36 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False):
                 if rc != ADLB_SUCCESS:
                     continue
                 seen.add(struct.unpack("<q", buf)[0])
+            tails = None
+            if ops_port:
+                import json as _json
+                import urllib.request
+
+                # the adversity's journey closes on a server and rides
+                # the obs gossip to the master — poll for it (bounded)
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    try:
+                        tails = _json.loads(urllib.request.urlopen(
+                            f"http://127.0.0.1:{ops_port}/trace/tails",
+                            timeout=5,
+                        ).read().decode())
+                    except OSError:
+                        time.sleep(0.4)
+                        continue
+                    js = tails.get("journeys") or []
+                    if poison and any(
+                        j.get("end") == "quarantined" for j in js
+                    ):
+                        break
+                    if not poison and any(
+                        "expire" in [s[0] for s in j.get("spans") or ()]
+                        for j in js
+                    ):
+                        break
+                    time.sleep(0.4)
             ctx.set_problem_done()
-            return len(seen)
+            return len(seen), tails
         # the SIGSTOP victim never touches the poison type: it must
         # SURVIVE (the adversity under test is the hang, not a kill)
         my_types = [T] if ctx.rank == victim else [T, T_P]
@@ -397,6 +432,7 @@ def one_iter(seed, fabric=None):
         # shared-memory ring fabric, so the kill/stall/poison/server-kill
         # adversities all exercise a peer dying mid-ring
         kw["fabric"] = fabric
+    gray_port = None
     if do_stall or do_poison:
         kw["on_worker_failure"] = g_policy
         # load-aware: the quarantine/casualty oracles assume only the
@@ -408,6 +444,15 @@ def one_iter(seed, fabric=None):
         if do_poison:
             kw["max_unit_retries"] = 2
             kw["fault_spec"] = {"seed": seed, "poison_types": [2]}
+        # observe the adversity (ISSUE 14): the ops port alone arms
+        # tail promotion (trace_sample stays 0 — nothing head-sampled),
+        # so the quarantined / lease-expired unit's journey MUST
+        # surface in /trace/tails with its full hop chain — the
+        # observability plane exercised under faults, not happy path
+        gray_port = probe_free_ports(1)[0]
+        kw["ops_port"] = gray_port
+        kw["trace_sample"] = 0.0
+        kw["obs_sync_interval"] = 0.25
     if do_two_jobs:
         # both worker policies: "reclaim" must complete BOTH jobs with
         # the poison quarantined; "abort" must classify the first
@@ -448,7 +493,7 @@ def one_iter(seed, fabric=None):
         # under "reclaim", world abort under "abort")
         stall_s = round(rng.uniform(1.3, 2.6) * kw["lease_timeout_s"], 2)
         app_fn = gray_economy(n_units, victim=victim, stall_s=stall_s,
-                              poison=do_poison)
+                              poison=do_poison, ops_port=gray_port)
         desc = dict(apps=apps, servers=servers, mode=mode, cap=cap,
                     workload="gray", stall=do_stall, poison=do_poison,
                     policy=g_policy, stall_s=stall_s if do_stall else None,
@@ -468,7 +513,33 @@ def one_iter(seed, fabric=None):
             assert g_policy == "abort", "survival policy aborted"
             return desc
         # the world completed: coverage must be exact
-        assert res.app_results[0] == n_units, res.app_results
+        n_seen, tails = res.app_results[0]
+        assert n_seen == n_units, res.app_results
+        # tail-capture oracle: the adversity's journey reached the
+        # master's /trace/tails with an anomalous terminal and hops
+        # attributed to server ranks only (trace_sample=0, so nothing
+        # here came from head sampling)
+        server_ranks = set(range(apps, apps + servers))
+        js = (tails or {}).get("journeys") or []
+        if do_poison:
+            quar = [j for j in js if j.get("end") == "quarantined"]
+            assert quar, "quarantined journey missing from /trace/tails"
+            qj = quar[0]
+            assert qj.get("why") == ["quarantined"], qj
+            stages = [s[0] for s in qj["spans"]]
+            assert stages[0] == "put_recv" and stages[-1] == "finalize", \
+                stages
+            assert all(s[1] in server_ranks for s in qj["spans"]), \
+                qj["spans"]
+        if do_stall:
+            expired = [
+                j for j in js
+                if "expire" in [s[0] for s in j.get("spans") or ()]
+            ]
+            assert expired, "expired-lease journey missing from /trace/tails"
+            assert all(
+                s[1] in server_ranks for j in expired for s in j["spans"]
+            ), expired
         if do_stall:
             # short stall: the victim is fenced, resumes, and reports.
             # long stall (past the 2x hang bar): the world may complete

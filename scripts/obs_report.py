@@ -22,10 +22,25 @@ holding a ``{"journeys": [...]}`` doc / a bare journey list): prints a
 per-stage latency table (p50/p99 by job/type) plus a text waterfall of
 the N slowest sampled units (``--slowest N``, default 5).
 
+With ``--tails`` the inputs are ``/trace/tails`` documents (tail-based
+promotion, ``Config(trace_tail)``): prints one row per promoted
+journey — why it was kept, which stage its excess attributes to, and
+the dominant profiler stacks active on the responsible rank during
+that stage's window — plus the usual waterfall of the slowest.
+
+With ``--profile`` the inputs are ``/profile?format=json`` documents
+(the continuous profiler, ``Config(profile_hz)``): prints top-N
+self/cumulative frame tables of the merged fleet profile
+(``--top N``, default 15) and, with ``--collapsed PATH``, writes the
+flamegraph-compatible collapsed-stack file.
+
 Usage:  python scripts/obs_report.py <flight-dir | flight-*.json ...>
         python scripts/obs_report.py --json <...>   (merged record as JSON)
         python scripts/obs_report.py --journeys trace_units.json
         python scripts/obs_report.py --journeys --slowest 8 <file ...>
+        python scripts/obs_report.py --tails trace_tails.json
+        python scripts/obs_report.py --profile [--top 20]
+                                     [--collapsed out.folded] profile.json
 """
 
 from __future__ import annotations
@@ -309,19 +324,107 @@ def journey_report(journeys: list[dict], slowest: int = 5) -> list[str]:
     return out
 
 
+# ------------------------------------------------------- tail report
+
+
+def tails_report(journeys: list[dict], slowest: int = 5) -> list[str]:
+    """One row per promoted tail journey: the retention reasons, the
+    stage the excess attributes to, and the dominant profiler stacks on
+    the responsible rank during that stage's window (annotations are
+    computed server-side by the /trace/tails join)."""
+    out = [f"tail journeys: {len(journeys)}"]
+    whys: dict[str, int] = {}
+    for j in journeys:
+        for w in j.get("why") or ("?",):
+            whys[w] = whys.get(w, 0) + 1
+    out.append("promoted because: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(whys.items())
+    ))
+    out.append(
+        f"\n  {'trace_id':>16} {'job':>4} {'type':>5} {'end':<12} "
+        f"{'total_ms':>9} {'slow stage':<11} {'rank':>4} {'excess_ms':>9}"
+    )
+    ranked = sorted(journeys, key=lambda j: -j.get("total_s", 0.0))
+    for j in ranked:
+        out.append(
+            f"  {j.get('trace_id', 0):>16} {j.get('job', 0):>4} "
+            f"{j.get('type', -1):>5} {j.get('end', '?'):<12} "
+            f"{j.get('total_s', 0.0) * 1e3:>9.3f} "
+            f"{j.get('slow_stage', '-'):<11} "
+            f"{j.get('slow_rank', -1):>4} "
+            f"{j.get('excess_s', 0.0) * 1e3:>9.3f}"
+        )
+        for stack, n in (j.get("stacks") or [])[:3]:
+            out.append(f"      [{n:>4} samples] {stack}")
+    out.append("")
+    out.extend(journey_report(journeys, slowest=slowest)[2:])
+    return out
+
+
+# ----------------------------------------------------- profile report
+
+
+def load_profiles(paths: list[str]) -> dict:
+    """Merge /profile?format=json documents (or bare {stack: count}
+    dicts) from files/dirs into one {stack: count} map."""
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        files.extend(sorted(pp.glob("*.json")) if pp.is_dir() else [pp])
+    merged: dict[str, int] = {}
+    for f in files:
+        try:
+            doc = json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+            continue
+        stacks = doc.get("merged", doc) if isinstance(doc, dict) else {}
+        for k, v in stacks.items():
+            if isinstance(v, (int, float)):
+                merged[k] = merged.get(k, 0) + int(v)
+    return merged
+
+
+def profile_report(stacks: dict, top: int = 15) -> list[str]:
+    """Top-N frames by self and by cumulative samples. Self = samples
+    whose stack ENDS at the frame; cumulative = samples whose stack
+    contains it (deduped per stack, so recursion cannot double-count)."""
+    total = sum(stacks.values())
+    out = [f"profile: {len(stacks)} folded stacks, {total} samples"]
+    self_c: dict[str, int] = {}
+    cum_c: dict[str, int] = {}
+    for stack, n in stacks.items():
+        frames = stack.split(";")
+        self_c[frames[-1]] = self_c.get(frames[-1], 0) + n
+        for fr in set(frames):
+            cum_c[fr] = cum_c.get(fr, 0) + n
+    for title, table in (("self", self_c), ("cumulative", cum_c)):
+        out.append(f"\ntop {top} frames by {title} samples:")
+        out.append(f"  {'samples':>8} {'%':>6}  frame")
+        for fr, n in sorted(table.items(), key=lambda kv: -kv[1])[:top]:
+            pct = 100.0 * n / total if total else 0.0
+            out.append(f"  {n:>8} {pct:>5.1f}%  {fr}")
+    return out
+
+
 def main(argv: list[str]) -> int:
     as_json = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
-    if "--slowest" in argv:
-        i = argv.index("--slowest")
-        slowest = int(argv[i + 1])
-        paths = [a for a in paths if a != argv[i + 1]]
-    else:
-        slowest = 5
+
+    def opt(name, default, cast):
+        if name not in argv:
+            return default
+        val = argv[argv.index(name) + 1]
+        paths[:] = [a for a in paths if a != val]
+        return cast(val)
+
+    slowest = opt("--slowest", 5, int)
+    top = opt("--top", 15, int)
+    collapsed = opt("--collapsed", None, str)
     if not paths:
         print(__doc__, file=sys.stderr)
         return 2
-    if "--journeys" in argv:
+    if "--journeys" in argv or "--tails" in argv:
         journeys = load_journeys(paths)
         if not journeys:
             print("no journeys found", file=sys.stderr)
@@ -329,7 +432,24 @@ def main(argv: list[str]) -> int:
         if as_json:
             print(json.dumps({"journeys": journeys}))
             return 0
-        print("\n".join(journey_report(journeys, slowest=slowest)))
+        rep = tails_report if "--tails" in argv else journey_report
+        print("\n".join(rep(journeys, slowest=slowest)))
+        return 0
+    if "--profile" in argv:
+        stacks = load_profiles(paths)
+        if not stacks:
+            print("no profile stacks found", file=sys.stderr)
+            return 1
+        if collapsed:
+            Path(collapsed).write_text("".join(
+                f"{k} {v}\n" for k, v in
+                sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0]))
+            ))
+            print(f"collapsed stacks written to {collapsed}")
+        if as_json:
+            print(json.dumps({"merged": stacks}))
+            return 0
+        print("\n".join(profile_report(stacks, top=top)))
         return 0
     docs = load(paths)
     if not docs:

@@ -434,6 +434,195 @@ def test_job_kill_closes_journeys():
     assert j["end"] == "dropped" and j["job"] == 9
 
 
+# --------------------------------------------------- tail-based promotion
+
+
+def _mk_unit(seqno=1, typ=T, job=0):
+    return WorkUnit(seqno=seqno, work_type=typ, prio=0, target_rank=-1,
+                    answer_rank=-1, payload=b"x", job=job)
+
+
+def test_tail_retention_slow_vs_fast():
+    from adlb_tpu.obs.journey import JourneyRecorder as JR
+
+    reg = Registry(rank=2)
+    rec = JR(2, reg)
+    rec.tail = True
+    rec.tail_thr = {(0, T): 0.1}
+    # fast clean delivery: histograms fed, journey NOT retained
+    u = _mk_unit(1)
+    rec.begin_tail(u, 1.0)
+    assert u.trace_id < 0  # server-minted tail id, never a head id
+    rec.stamp(u, "match", 1.01)
+    rec.stamp(u, "deliver", 1.02)
+    rec.close(u, "delivered", t=1.03)
+    assert not rec.take_done()
+    assert reg.value("trace_journeys_closed") == 1
+    assert reg.histogram("unit_total_s", job="0", type=str(T)).n == 1
+    assert reg.value("trace_tail_promoted") == 0
+    # slow clean delivery: past the per-(job,type) p99 -> promoted
+    u2 = _mk_unit(2)
+    rec.begin_tail(u2, 2.0)
+    rec.stamp(u2, "match", 2.4)
+    rec.stamp(u2, "deliver", 2.45)
+    rec.close(u2, "delivered", t=2.5)
+    (j,) = rec.take_done()
+    assert j["why"] == ["slow"]
+    assert j["prof_win"] == [2, 2]  # clock-aligned window ids
+    assert reg.value("trace_tail_promoted") == 1
+
+
+def test_tail_anomalous_terminals_always_promote():
+    from adlb_tpu.obs.journey import JourneyRecorder as JR
+
+    rec = JR(2, Registry(rank=2))
+    rec.tail = True  # NO thresholds armed (cold histogram)
+    u = _mk_unit(1)
+    rec.begin_tail(u, 1.0)
+    rec.close(u, "quarantined", t=1.001)
+    # a delivered journey that crossed a lease expiry is an anomaly too
+    u2 = _mk_unit(2)
+    rec.begin_tail(u2, 1.0)
+    rec.stamp(u2, "expire", 1.01)
+    rec.stamp(u2, "deliver", 1.02)
+    rec.close(u2, "delivered", t=1.03)
+    a, b = rec.take_done()
+    assert a["why"] == ["quarantined"] and a["end"] == "quarantined"
+    assert b["why"] == ["expired_lease"] and b["end"] == "delivered"
+
+
+def test_tail_cold_histogram_promotes_nothing_slow():
+    """Hysteresis: with no armed threshold (cold cells), a slow-but-
+    clean delivery is NOT promoted — only anomalies and head samples
+    survive a cold start."""
+    from adlb_tpu.obs.journey import JourneyRecorder as JR
+
+    rec = JR(2, Registry(rank=2))
+    rec.tail = True
+    u = _mk_unit(1)
+    rec.begin_tail(u, 1.0)
+    rec.stamp(u, "deliver", 99.0)  # absurdly slow
+    rec.close(u, "delivered", t=99.1)
+    assert not rec.take_done()
+
+
+def test_tail_head_sample_path_unchanged():
+    from adlb_tpu.obs.journey import JourneyRecorder as JR
+
+    # tail OFF: a head-sampled journey closes exactly as in PR 12
+    rec = JR(2, Registry(rank=2))
+    u = _mk_unit(1)
+    rec.begin(u, 42, 1.0)
+    rec.stamp(u, "deliver", 1.01)
+    rec.close(u, "delivered", t=1.02)
+    (j,) = rec.take_done()
+    assert j["why"] == ["head"] and j["trace_id"] == 42
+    # tail ON: head samples still always keep, threshold or not
+    rec2 = JR(2, Registry(rank=2))
+    rec2.tail = True
+    u2 = _mk_unit(2)
+    rec2.begin(u2, 43, 1.0)
+    rec2.stamp(u2, "deliver", 1.01)
+    rec2.close(u2, "delivered", t=1.02)
+    (j2,) = rec2.take_done()
+    assert j2["why"] == ["head"]
+
+
+def test_tail_armed_by_ops_port_and_server_mints_ids():
+    # auto + ops_port -> armed; every put journeys in a trace_sample=0
+    # world, with NOTHING new riding FA_PUT (server-side arming only)
+    srv, _ep = _mk_server(rank=2, ops_port=0, trace_sample=0.0)
+    assert srv.journeys.tail
+    _put(srv, b"u0")
+    u = next(iter(srv.wq.units()))
+    assert u.trace_id < 0 and u.spans is not None
+    # tail arms skip the enqueue hop (its delta is the put handler's
+    # own microseconds — every-unit cost for no attribution)
+    assert [s[0] for s in u.spans] == ["put_recv"]
+    # unobserved world (no ops_port) stays untraced under auto
+    srv2, _ep2 = _mk_server(rank=2, trace_sample=0.0)
+    assert not srv2.journeys.tail
+    _put(srv2, b"u0")
+    assert next(iter(srv2.wq.units())).spans is None
+    # explicit off overrides an observed world
+    srv3, _ep3 = _mk_server(rank=2, ops_port=0, trace_tail="off")
+    assert not srv3.journeys.tail
+
+
+def test_tail_threshold_computation_and_gossip_reply():
+    master, ep = _mk_server(rank=2, nranks=4, nservers=2, ops_port=0)
+    h = master.metrics.histogram("unit_total_s", job="0", type=str(T))
+    for _ in range(40):
+        h.observe(0.001)
+    # below TAIL_MIN_COUNT (64): hysteresis keeps the cell unarmed
+    assert master._tail_thresholds() == {}
+    for _ in range(30):
+        h.observe(0.002)
+    thr = master._tail_thresholds()
+    assert (0, T) in thr and 0.0 < thr[(0, T)] < 0.1
+    # fleet cells merge in: a gossiped snapshot's histogram counts too
+    master._handle(msg(Tag.SS_OBS_SYNC, 3, seq=1, journeys=[], snap={
+        "histograms": {f"unit_total_s{{job=0,type={T}}}": {
+            "bounds": list(h.bounds), "counts": list(h.counts),
+            "sum": h.sum, "count": h.n}}}))
+    thr2 = master._tail_thresholds()
+    assert thr2.keys() == thr.keys()
+    # the master's obs tick installs + caches, and gossip frames get the
+    # thresholds carried back (SS_OBS_SYNC reply, list-of-triples form)
+    master._next_obs_sync = 0.0
+    master._periodic(time.monotonic(), 0.05)
+    assert master.journeys.tail_thr == thr2
+    assert master._tail_thr_cache
+    ep.sent.clear()
+    master._handle(msg(Tag.SS_OBS_SYNC, 3, seq=2, journeys=[], snap={}))
+    (dest, reply), = ep.of(Tag.SS_OBS_SYNC)
+    assert dest == 3
+    # and the non-master side installs the reply
+    peer, _pep = _mk_server(rank=3, nranks=4, nservers=2, ops_port=0)
+    peer._handle(reply)
+    assert peer.journeys.tail_thr == thr2
+
+
+def test_tails_store_routing_and_query_filters():
+    from adlb_tpu.obs.ops_server import OpsServer
+
+    master, _ep = _mk_server(rank=2, nranks=4, nservers=2, ops_port=0)
+    mk = lambda tid, why, total, job=0: {  # noqa: E731
+        "trace_id": tid, "job": job, "type": T, "end": "delivered",
+        "why": why, "t0": 1.0, "total_s": total,
+        "spans": [["put_recv", 3, 1.0], ["match", 3, 1.0 + total * 0.9],
+                  ["finalize", 3, 1.0 + total]]}
+    master._handle(msg(Tag.SS_OBS_SYNC, 3, seq=1, snap={}, journeys=[
+        mk(5, ["head"], 0.01),
+        mk(-9, ["slow"], 0.8),
+        mk(6, ["head", "slow"], 0.9, job=2),
+    ]))
+    # head -> units, promoted -> tails, both -> both
+    assert [j["trace_id"] for j in master._journeys_fleet] == [5, 6]
+    assert [j["trace_id"] for j in master._tails_fleet] == [-9, 6]
+    ops = OpsServer(master, 0)
+    try:
+        assert ops._trace_units()["count"] == 2
+        assert ops._trace_units({"min_ms": "100"})["count"] == 1
+        assert ops._trace_units({"job": "2"})["count"] == 1
+        assert ops._trace_units({"type": "99"})["count"] == 0
+        assert ops._trace_units({"limit": "1"})["journeys"][0][
+            "trace_id"] == 6  # newest kept
+        # limit past the store size clamps to everything (a wrapped
+        # negative slice index silently DROPPED results; regression)
+        assert ops._trace_units({"limit": "999"})["count"] == 2
+        assert ops._trace_units({"limit": "0"})["count"] == 0
+        tails = ops._trace_tails()
+        assert tails["count"] == 2
+        # the excess-attribution annotation names the dominant stage
+        assert all(j["slow_stage"] == "match" for j in tails["journeys"])
+        assert ops._trace_tails({"job": "2"})["count"] == 1
+        assert ops._trace_tails({"limit": "1", "min_ms": "1"})[
+            "count"] == 1
+    finally:
+        ops.stop()
+
+
 # ----------------------------------------------------------- client side
 
 
@@ -563,6 +752,169 @@ def test_acceptance_journeys_relay_steal_mode_tcp():
             "relay and deliver on the same rank — custody transfer "
             "did not happen"
         )
+
+
+@pytest.mark.slow
+def test_acceptance_tail_capture_trace_sample_zero_tcp():
+    """The ISSUE 14 acceptance world: in a trace_sample=0 TCP fleet
+    (tail promotion armed by ops_port alone), a deliberately stalled
+    unit and a quarantined unit BOTH appear in /trace/tails with full
+    hop chains and correct stage attribution, while the fast bulk is
+    not retained — and /trace/units stays empty (no head samples)."""
+    import os
+    import re
+
+    port = probe_free_ports(1)[0]
+    T2 = 2
+    n_fast = 80
+    # load-aware stall timing (the chaos_soak lesson): a starved-but-
+    # healthy host must not push the SIGSTOP past the 2x hang bar
+    try:
+        load = min(max(os.getloadavg()[0] / max(os.cpu_count() or 1, 1),
+                       1.0), 3.0)
+    except OSError:
+        load = 1.0
+    lease = round(1.2 * load, 2)
+
+    def fetch(route):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{route}", timeout=10,
+        ).read().decode()
+
+    def app(ctx):
+        from adlb_tpu.runtime.faults import sigstop_self
+
+        if ctx.rank == 1:
+            # fast consumer: drains the untargeted bulk promptly
+            n = 0
+            while True:
+                rc, _got = ctx.get_work([T])
+                if rc != ADLB_SUCCESS:
+                    return n
+                n += 1
+        if ctx.rank == 2:
+            # slow/quarantine agent: wait for the go token, consume the
+            # deliberately-stalled targeted unit, then hold leases
+            # through SIGSTOPs until the retry budget quarantines one
+            rc, r = ctx.reserve([T2])
+            assert rc == ADLB_SUCCESS
+            ctx.get_reserved(r.handle)
+            rc, got = ctx.get_work([T])  # the stalled unit (targeted)
+            assert rc == ADLB_SUCCESS and got.payload == b"slow"
+            stalls = 0
+            while stalls < 6:
+                rc, r = ctx.reserve([T])
+                if rc != ADLB_SUCCESS:
+                    return stalls
+                stalls += 1
+                sigstop_self(round(lease * 1.5, 2))
+                # never fetch: the expired lease re-enqueues the unit
+                # (attempts+1) and this rank's late fetch is fenced
+            return stalls
+        # rank 0: producer + observer
+        for i in range(n_fast):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        # wait until the bulk has CLOSED fleet-wide (the p99 estimator
+        # needs >= TAIL_MIN_COUNT cells) and the threshold tick ran
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            closed = sum(
+                int(x) for x in re.findall(
+                    r'adlb_fleet_unit_total_s_count\{[^}]*\} (\d+)',
+                    fetch("metrics"))
+            )
+            if closed >= n_fast:
+                break
+            time.sleep(0.3)
+        time.sleep(1.0)  # two threshold ticks + gossip replies
+        # the deliberate stall: a targeted unit that sits queued while
+        # its only eligible consumer waits for the go token
+        assert ctx.put(b"slow", T, target_rank=2) == ADLB_SUCCESS
+        time.sleep(2.0)
+        assert ctx.put(b"go", T2, target_rank=2) == ADLB_SUCCESS
+        # the poison-ish unit: targeted at the stalling rank, budget 1
+        assert ctx.put(b"doom", T, target_rank=2) == ADLB_SUCCESS
+        out = {}
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            tails = json.loads(fetch("trace/tails"))
+            js = tails["journeys"]
+            if any(j["end"] == "quarantined" for j in js) and any(
+                "slow" in (j.get("why") or []) for j in js
+            ):
+                out["tails"] = tails
+                break
+            time.sleep(0.5)
+        out["units"] = json.loads(fetch("trace/units"))
+        ctx.set_problem_done()
+        return out
+
+    cfg = Config(
+        balancer="steal", ops_port=port, trace_sample=0.0,
+        obs_sync_interval=0.2, exhaust_check_interval=0.2,
+        lease_timeout_s=lease, max_unit_retries=1,
+        on_worker_failure="reclaim",
+    )
+    res = spawn_world(3, 2, [T, T2], app, cfg=cfg, timeout=180.0)
+    got = res.app_results[0]
+    assert "tails" in got, "tail store never showed both promotions"
+    js = got["tails"]["journeys"]
+    # no head samples exist in this world at all
+    assert got["units"]["count"] == 0
+    assert res.quarantined == 1
+    slow = [j for j in js if "slow" in (j.get("why") or [])]
+    quar = [j for j in js if j["end"] == "quarantined"]
+    assert slow and quar
+    sj = slow[0]
+    stages = _stages(sj)
+    # tail journeys skip enqueue and the finalize-after-deliver stamp
+    assert stages[0] == "put_recv" and stages[-1] == "deliver"
+    assert sj["end"] == "delivered"
+    assert sj["total_s"] >= 1.0  # the deliberate 2 s queue sit
+    # stage attribution: the sit shows up as time-to-REACH match
+    assert sj["slow_stage"] == "match"
+    assert all(rank in (3, 4) for _s, rank, _t in
+               [tuple(s) for s in sj["spans"]])
+    qj = quar[0]
+    qs = _stages(qj)
+    assert qs[0] == "put_recv" and qs[-1] == "finalize"
+    assert "expire" in qs  # the lease-expiry hops that burned the budget
+    assert qj.get("why") == ["quarantined"]
+    # the fast bulk was NOT retained: every delivered tail journey here
+    # is the genuinely slow one
+    assert all(j["total_s"] > 0.5 for j in js if j["end"] == "delivered")
+
+
+def test_obs_report_tails_mode(tmp_path):
+    """scripts/obs_report.py --tails: the promotion-reason summary plus
+    per-journey slow-stage rows with the joined profiler stacks."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    doc = {"count": 1, "journeys": [
+        {"trace_id": -99, "job": 0, "type": T, "end": "delivered",
+         "why": ["slow"], "t0": 10.0, "total_s": 2.0,
+         "slow_stage": "match", "slow_rank": 4, "excess_s": 1.9,
+         "stacks": [["reactor;phase:decode;loop.recv", 12]],
+         "spans": [["put_recv", 4, 10.0], ["enqueue", 4, 10.01],
+                   ["match", 4, 11.9], ["deliver", 4, 11.95],
+                   ["finalize", 4, 12.0]]},
+    ]}
+    f = tmp_path / "trace_tails.json"
+    f.write_text(json.dumps(doc))
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "obs_report.py")
+    out = subprocess.run(
+        [_sys.executable, script, "--tails", str(f)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "tail journeys: 1" in out.stdout
+    assert "slow=1" in out.stdout
+    assert "match" in out.stdout  # the attributed stage
+    assert "reactor;phase:decode;loop.recv" in out.stdout  # the join
+    assert "waterfall" in out.stdout
 
 
 def test_obs_report_journeys_mode(tmp_path):

@@ -67,9 +67,15 @@ def test_histogram_log_buckets():
     assert h.counts == [1, 2, 0, 1, 1]
     assert h.n == 5
     assert h.sum == pytest.approx(5e-7 + 1e-5 + 5e-4 + 1.0, rel=1e-6)
-    # coarse quantiles land on bucket upper bounds
-    assert h.quantile(0.5) == pytest.approx(1e-5, rel=1e-9)
-    assert h.quantile(1.0) == float("inf")
+    # quantiles interpolate linearly within the bucket the target rank
+    # lands in: p50 target = 2.5 of 5, bucket (1e-6, 1e-5] holds ranks
+    # 2..3, so 1e-6 + (1e-5 - 1e-6) * 1.5/2
+    assert h.quantile(0.5) == pytest.approx(7.75e-6, rel=1e-9)
+    # a quantile in the +Inf overflow bucket answers the highest finite
+    # bound (Prometheus histogram_quantile convention), never inf
+    assert h.quantile(1.0) == pytest.approx(1e-3, rel=1e-9)
+    # q=0 pins to the lower edge of the first occupied bucket
+    assert h.quantile(0.0) == pytest.approx(0.0, abs=1e-12)
 
 
 def test_exposition_format():
