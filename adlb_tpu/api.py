@@ -148,6 +148,15 @@ class AdlbContext:
         """The namespace this rank is attached to (0 = default)."""
         return self._c.job
 
+    def detach_world(self) -> int:
+        """Cleanly LEAVE a running world (**extension** — elastic
+        membership): the master drops this rank from every server's
+        membership under a fresh fleet epoch, leases drain, and
+        exhaustion/END counting forgets the rank. After a successful
+        detach the context is dead (finalize is a no-op; just close).
+        Distinct from :meth:`attach`, which binds a JOB namespace."""
+        return self._c.detach()
+
     def attach(self, job_id: int) -> "AdlbContext":
         """Bind this rank to a job namespace; returns self so app code
         reads naturally as ``ctx = ctx.attach(job_id)``. Raises on a
@@ -300,7 +309,11 @@ def join_world(
             f"nservers={nservers} disagrees with the launcher's "
             f"ADLB_NUM_SERVERS={env_ns}"
         )
-    rank = int(os.environ["ADLB_RANK"]) if rank is None else rank
+    attach = rank is None and os.environ.get(
+        "ADLB_ATTACH", ""
+    ).strip().lower() in ("1", "on", "true", "yes")
+    if not attach:
+        rank = int(os.environ["ADLB_RANK"]) if rank is None else rank
     path = rendezvous or os.environ["ADLB_RENDEZVOUS"]
     addr_map: dict[int, tuple[str, int]] = {}
     with open(path) as f:
@@ -335,16 +348,35 @@ def join_world(
     from adlb_tpu.runtime.codec import select_codec
 
     select_codec(cfg.codec)
-    if cfg.tcp_mux == "on":
-        # no silent fallback for an explicit ask (the codec="c" rule):
-        # the rendezvous-file harness has no broker publication yet —
-        # the channel plane is spawn_world-only today (ROADMAP item 5)
-        raise ValueError(
-            "tcp_mux='on' requires a harness that runs a channel broker "
-            "(spawn_world today); the rendezvous launcher still runs "
-            "per-pair TCP"
+    if attach:
+        # elastic membership (ADLB_ATTACH=1, launch.py --attach): this
+        # process is a NEW rank joining the running world — negotiate a
+        # rank id + home server from the master instead of reading
+        # ADLB_RANK. Attached ranks ride per-pair TCP (the launcher's
+        # brokers route only the static world).
+        return attach_world(
+            world, cfg,
+            master_addr=addr_map[world.master_server_rank],
         )
+    mux_addr = None
+    broker_env = os.environ.get("ADLB_BROKER_ADDR", "").strip()
+    if cfg.tcp_mux != "off" and broker_env:
+        # the launcher published this host's channel broker: one
+        # data-plane socket to it instead of one per peer
+        h, _, p = broker_env.rpartition(":")
+        mux_addr = (h, int(p))
+    elif cfg.tcp_mux == "on":
+        # no silent fallback for an explicit ask (the codec="c" rule)
+        raise ValueError(
+            "tcp_mux='on' requires a broker-running harness "
+            "(spawn_world, or the launcher's broker publication via "
+            "ADLB_BROKER_ADDR — is the launcher running with the mux "
+            "enabled?)"
+        )
+    mux_ranks = int(os.environ.get("ADLB_MUX_RANKS", "0") or 0) \
+        or world.nranks
     ep = TcpEndpoint(rank, addr_map, binary_peers=binary_peers,
+                     mux=mux_addr, mux_ranks=mux_ranks,
                      compress_min=cfg.compress_min_bytes)
     # shm ring fabric toward same-host ranks (the launcher exports
     # ADLB_FABRIC/ADLB_SHM_KEY; a bare join derives the key from the
@@ -365,6 +397,32 @@ def join_world(
 
         ep = maybe_wrap(ep, cfg, world)
     return JoinedWorld(AdlbContext(Client(world, cfg, ep)), ep)
+
+
+def attach_world(
+    world,
+    cfg: Optional[Config] = None,
+    *,
+    fabric=None,
+    master_addr=None,
+    abort_event=None,
+) -> JoinedWorld:
+    """Attach a NEW app rank to a RUNNING world (**extension** — elastic
+    membership; the reference fixes the world at ADLB_Init). The master
+    allocates a rank id + home server under a fresh fleet epoch; the
+    returned JoinedWorld finalizes on exit, or call
+    ``ctx.detach_world()`` to leave mid-run::
+
+        with attach_world(world, cfg, fabric=fabric) as ctx:
+            ctx.put(b"...", 1)
+
+    Exactly one of ``fabric`` (in-proc worlds) or ``master_addr`` (TCP:
+    the master server's (host, port)) selects the transport. Python
+    servers only."""
+    from adlb_tpu.runtime.membership import attach_app
+
+    return attach_app(world, cfg or Config(), fabric=fabric,
+                      master_addr=master_addr, abort_event=abort_event)
 
 
 def run_world(

@@ -85,6 +85,10 @@ STAGES = (
     "expire",      # 10 lease expired; unit re-enqueued under a fresh attempt
     "adopt",       # 11 adopted by a failover buddy at promotion
     "replay",      # 12 recovered from the WAL at cold restart
+    # elastic membership (append-only — renumbering corrupts old WALs):
+    "attach",      # 13 shipped to a scale-out shard's bootstrap rebalance
+    "drain",       # 14 crossed a detach/scale-in drain (lease drained,
+    #                   shard shipped to the buddy, target departed)
 )
 STAGE_CODES = {name: i + 1 for i, name in enumerate(STAGES)}
 CODE_STAGES = {v: k for k, v in STAGE_CODES.items()}
@@ -313,11 +317,19 @@ class JourneyRecorder:
         # dominant case — tail-armed clean delivery, below threshold —
         # exits with two dict probes and a span scan
         if trace_id < 0 and end == "delivered":
+            why = None
             for s in spans:
-                if s[0] == "expire":
+                st = s[0]
+                if st == "expire":
                     why = ["expired_lease"]
                     break
-            else:
+                if st == "attach" or st == "drain":
+                    # membership churn crossed this journey (scale-out
+                    # bootstrap / detach / scale-in drain): always keep,
+                    # so churn events are visible in /trace/tails
+                    why = ["churn"]
+                    break
+            if why is None:
                 thr = self.tail_thr.get((job, work_type))
                 if thr is None or total <= thr:
                     return
@@ -384,13 +396,17 @@ class JourneyRecorder:
                 # plain loop, not any(genexpr): this runs per close
                 # under tail mode and the generator allocation is a
                 # measured slice of the per-journey cost
-                expired = False
+                mark = None
                 for s in spans:
-                    if s[0] == "expire":
-                        expired = True
+                    st = s[0]
+                    if st == "expire":
+                        mark = "expired_lease"
                         break
-                if expired:
-                    why.append("expired_lease")
+                    if st == "attach" or st == "drain":
+                        mark = "churn"
+                        break
+                if mark is not None:
+                    why.append(mark)
                 else:
                     thr = self.tail_thr.get((job, work_type))
                     if thr is not None and total > thr:

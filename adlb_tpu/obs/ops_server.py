@@ -45,6 +45,14 @@ plane:
   payloads hex-encoded and truncated to ``Config(ops_dump_bytes)``. The
   store is per-server; the ops endpoint runs on the master, so this is
   the master's shard — ``ctx.get_quarantined()`` is the world-wide view.
+* ``/fleet`` — elastic membership (adlb_tpu/runtime/membership.py):
+  ``GET /fleet`` serves the live topology under the fleet epoch — every
+  server with its state (live/joining/draining/drained/dead, extra =
+  scale-out shard), every app rank with its home and state (attached =
+  joined after bring-up), the detached-rank history, and any parked
+  scale request (the autoscaler feed). ``POST /fleet/scale`` with
+  ``{"dir": "out"}`` requests a new server shard; ``{"dir": "in"}``
+  (optional ``"rank"``) drains one through the zero-loss promote path.
 * ``/jobs`` — the service-mode control plane: ``GET /jobs`` lists the
   job table, ``GET /jobs/<id>`` one job's status, ``POST /jobs`` (JSON
   body ``{"name": ..., "quota_bytes": ...}``) submits a namespace, and
@@ -169,6 +177,9 @@ class OpsServer:
                         else:
                             self._send(200, ops._profile_text().encode(),
                                        "text/plain")
+                    elif path == "/fleet":
+                        body = json.dumps(srv.fleet_doc()).encode()
+                        self._send(200, body, "application/json")
                     elif path == "/jobs":
                         body = json.dumps(ops._jobs()).encode()
                         self._send(200, body, "application/json")
@@ -198,6 +209,11 @@ class OpsServer:
                     elif parts[:1] == ["jobs"] and len(parts) <= 3:
                         body = json.dumps(
                             ops._jobs_post(parts[1:], raw)
+                        ).encode()
+                        self._send(200, body, "application/json")
+                    elif parts == ["fleet", "scale"]:
+                        body = json.dumps(
+                            ops._fleet_scale(raw)
                         ).encode()
                         self._send(200, body, "application/json")
                     else:
@@ -253,8 +269,13 @@ class OpsServer:
         cadence = getattr(s.cfg, "obs_sync_interval", 0) or 0
         fleet_seen = _stable_dict(s._fleet_seen)
         ranks = {str(s.rank): {"seq": -1, "age_s": 0.0, "stale": False}}
-        for r in s.world.server_ranks:
+        for r in list(s.world.server_ranks):
             if r == s.rank:
+                continue
+            if r in s._dead_servers or not s._is_live_member(r):
+                # retired (dead/drained) or not-yet-live members must
+                # not report stale forever — /fleet keeps the topology
+                # history; staleness is a LIVE-member alarm
                 continue
             seen = fleet_seen.get(r)
             if seen is None:
@@ -646,6 +667,24 @@ class OpsServer:
                 for stage, a in sorted(stages.items())
             },
         }
+
+    def _fleet_scale(self, raw: bytes) -> dict:
+        """POST /fleet/scale — elastic membership: ``{"dir": "out"}``
+        requests a new server shard (spawned via the registered member
+        spawner, or parked as a pending request feeding the autoscaler);
+        ``{"dir": "in"}`` (optionally ``{"rank": N}``) drains a server
+        through the zero-loss promote path. Serviced on the reactor via
+        the same ctl inbox as /jobs."""
+        body = json.loads(raw.decode() or "{}")
+        direction = body.get("dir") or body.get("direction")
+        if direction == "out":
+            return self.server.ctl_request({"op": "scale_out"})
+        if direction == "in":
+            req = {"op": "scale_in"}
+            if body.get("rank") is not None:
+                req["rank"] = int(body["rank"])
+            return self.server.ctl_request(req)
+        raise ValueError('scale needs {"dir": "out"|"in"}')
 
     def _jobs_post(self, parts: list, raw: bytes) -> dict:
         """POST /jobs (submit) and POST /jobs/<id>/{drain,kill}: build a

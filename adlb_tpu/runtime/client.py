@@ -151,6 +151,11 @@ class Client:
         # holds out for the takeover note.
         self._srv_route: dict[int, int] = {}
         self._fo_epoch = 0
+        # elastic membership: True once this rank cleanly detached (a
+        # detached rank's finalize is a no-op); attached_member marks a
+        # rank that JOINED a running world (membership.attach_app)
+        self._detached = False
+        self.attached_member = False
         self._lost_at: dict[int, float] = {}
         self._m_failovers = self.metrics.counter("home_takeovers")
         # frames _await_takeover pulled off the endpoint that belong to
@@ -236,14 +241,26 @@ class Client:
     # -- plumbing ------------------------------------------------------------
 
     def _next_server(self) -> int:
-        s = self.world.num_app_ranks + self._rr
-        self._rr = (self._rr + 1) % self.world.nservers
+        # indexed through server_ranks, not rank arithmetic: under
+        # elastic membership scale-out server ids are not contiguous
+        # with the base range (a plain WorldSpec's range indexes the
+        # same way)
+        servers = self.world.server_ranks
+        s = servers[self._rr % len(servers)]
+        self._rr = (self._rr + 1) % len(servers)
         return s
 
     def _route_put(self, target_rank: int) -> int:
         """Initial server for a put (reference src/adlb.c:2767-2773)."""
         if target_rank >= 0:
-            return self.world.home_server(target_rank)
+            try:
+                return self.world.home_server(target_rank)
+            except KeyError:
+                # an attached rank this client's membership view has not
+                # learned: route via our own home — the receiving server
+                # announces the inventory to the target's real home
+                # (off-home TargetedDirectory redirection)
+                return self.home
         if self.cfg.put_routing == "home":
             return self.home
         return self._next_server()
@@ -476,6 +493,23 @@ class Client:
         ):
             return self._put(payload, work_type, work_prio, target_rank, answer_rank)
 
+    def _validate_target(self, target_rank: int) -> None:
+        """Targeted-put destination check. Ranks ABOVE the base world
+        (and the sidecar pseudo-rank) may be dynamically attached
+        members this client's — possibly static — view has not learned:
+        those pass through, and the SERVERS, which hold the
+        authoritative membership, answer an unknown target loudly
+        (elastic membership, adlb_tpu/runtime/membership.py). In-range
+        non-app ranks are always a caller bug."""
+        if target_rank < 0 or self.world.is_app(target_rank):
+            return
+        from adlb_tpu.runtime.membership import is_provisional
+
+        if target_rank <= self.world.nranks or is_provisional(target_rank):
+            raise AdlbError(
+                f"target rank {target_rank} is not an app rank"
+            )
+
     def _put(
         self,
         payload: bytes,
@@ -486,8 +520,7 @@ class Client:
     ) -> int:
         if not self.world.validate_type(work_type):
             raise AdlbError(f"unregistered work type {work_type}")
-        if target_rank >= 0 and not self.world.is_app(target_rank):
-            raise AdlbError(f"target rank {target_rank} is not an app rank")
+        self._validate_target(target_rank)
         common = self._batch
         if common is not None:
             common.refcnt += 1
@@ -558,13 +591,22 @@ class Client:
             sleep = self._backoff_sleep(sleep)
         if rc != ADLB_SUCCESS and common is not None:
             common.refcnt -= 1  # unit never stored; keep prefix GC reachable
+        try:
+            t_home = (
+                self.world.home_server(target_rank)
+                if target_rank >= 0 else -1
+            )
+        except KeyError:
+            # an attached member this view has not learned: the
+            # receiving server's own off-home announce covers it
+            t_home = server
         if (
             rc == ADLB_SUCCESS
             and target_rank >= 0
-            and server != self.world.home_server(target_rank)
+            and server != t_home
         ):
             self._send_retry(
-                self.world.home_server(target_rank),
+                t_home,
                 msg(
                     Tag.FA_DID_PUT_AT_REMOTE,
                     self.rank,
@@ -1078,6 +1120,9 @@ class Client:
             Tag.TA_STREAM_CANCEL_RESP,
             # a duplicated dead-letter listing (re-sent across churn)
             Tag.TA_QUARANTINED_RESP,
+            # a duplicated membership verdict (detach re-sent across
+            # churn; the first response already settled the call)
+            Tag.TA_MEMBER_RESP,
         ):
             # stray replay: a request re-sent across connection churn can
             # be answered twice (the server replays its at-most-once
@@ -1184,8 +1229,7 @@ class Client:
             raise AdlbError("iput inside begin_batch_put is not supported")
         if not self.world.validate_type(work_type):
             raise AdlbError(f"unregistered work type {work_type}")
-        if target_rank >= 0 and not self.world.is_app(target_rank):
-            raise AdlbError(f"target rank {target_rank} is not an app rank")
+        self._validate_target(target_rank)
         # opportunistically settle responses already delivered, so a pure
         # producer loop's pending map (payload copies!) and the transport
         # queue stay bounded by in-flight work, not the whole stream
@@ -1335,6 +1379,36 @@ class Client:
         self._send_retry(dest, pm)
         return self._wait(Tag.TA_JOB_CTL_RESP, dest=dest, m_req=pm)
 
+    def detach(self) -> int:
+        """Cleanly LEAVE the world (elastic membership): settle every
+        pipelined put, then ask the MASTER to drop this rank from
+        membership. The master fans the change to every server (ack-
+        barriered), so exhaustion/END counting and /healthz forget this
+        rank before the reply lands. After a successful detach,
+        finalize() is a no-op and the endpoint can simply close.
+
+        Returns ADLB_SUCCESS, or ADLB_NO_MORE_WORK when termination was
+        already underway — then a plain finalize() is the right exit
+        (and this client does NOT mark itself detached)."""
+        with self._span("adlb:detach"):
+            if self._active_stream is not None:
+                try:
+                    self._active_stream.close()
+                except Exception:  # teardown races: best-effort
+                    self._active_stream = None
+            if self._pending_puts:
+                self.flush_puts()
+            master = self.world.master_server_rank
+            pm = msg(Tag.FA_MEMBER, self.rank, mop="detach")
+            self._send_retry(master, pm)
+            resp = self._wait(Tag.TA_MEMBER_RESP, dest=master, m_req=pm)
+        rc = resp.data.get("rc", -1)
+        if rc == ADLB_SUCCESS:
+            self._detached = True
+            self._stop_heartbeat()
+            self.flight.record("detached from world")
+        return rc
+
     def attach(self, job_id: int) -> int:
         """Bind this rank to a job namespace on the running fleet: every
         subsequent put/reserve/stream rides in it, and this rank's
@@ -1474,6 +1548,11 @@ class Client:
         return ADLB_SUCCESS, records
 
     def finalize(self) -> int:
+        if self._detached:
+            # the rank already left membership: there is no home-server
+            # accounting left to settle (FA_LOCAL_APP_DONE from a
+            # non-member would be noise)
+            return ADLB_SUCCESS
         if self.tracer is not None:
             self.tracer.api_entry()  # close any open inferred user span
         self._stop_heartbeat()

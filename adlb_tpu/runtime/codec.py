@@ -128,6 +128,11 @@ WIRE_TAG: dict[Tag, int] = {
     # daemons reject tags outside their known ranges today.)
     Tag.FA_JOB_CTL: 1057,
     Tag.TA_JOB_CTL_RESP: 1058,
+    # elastic membership (adlb_tpu/runtime/membership.py; python-only —
+    # native daemons keep the fixed-at-init world and reject these tags,
+    # which is the loud mixed-version degradation we want)
+    Tag.FA_MEMBER: 1059,
+    Tag.TA_MEMBER_RESP: 1060,
     # app<->app point-to-point (the reference's app_comm traffic; native
     # clients receive it via ADLB_App_recv — bytes payloads only, enforced
     # by encodable())
@@ -178,6 +183,10 @@ WIRE_TAG: dict[Tag, int] = {
     # fleet metrics gossip: server -> master registry-snapshot deltas +
     # closed unit journeys (python-only; pickled dict payloads)
     Tag.SS_OBS_SYNC: 1140,
+    # elastic-membership fan-out/control plane (python-only; pickled —
+    # the id exists so the codec table stays total and a native plane
+    # could one day join the protocol)
+    Tag.SS_MEMBER: 1141,
     # shm-fabric pair announcement (rides the TCP plane once per
     # connected pair; swallowed by the transport reader)
     Tag.SHM_HELLO: 1998,
@@ -342,6 +351,19 @@ FIELDS: dict[str, tuple[int, int]] = {
     # Omitted for unsampled puts, so trace_sample=0 worlds stay
     # byte-identical on the wire; native daemons parse-and-ignore it.
     "trace_id": (98, _KIND_I64),
+    # elastic membership (FA_MEMBER/TA_MEMBER_RESP/SS_MEMBER; python-only
+    # today — ids reserved append-only so a native plane joining later,
+    # or a mixed-version fleet, degrades loudly instead of misparsing):
+    # the fleet epoch every membership op (and exhaustion/END token)
+    # keys on; the membership op name; the joiner's listener endpoint;
+    # the fan-out ack token; the allocated home server; member kind
+    "epoch": (99, _KIND_I64),
+    "mop": (100, _KIND_BYTES),
+    "host": (101, _KIND_BYTES),
+    "port": (102, _KIND_I64),
+    "member_tok": (103, _KIND_I64),
+    "home": (104, _KIND_I64),
+    "kind": (105, _KIND_BYTES),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -301,7 +301,7 @@ class FaultyEndpoint:
             # every rank, not just contacted ones: a TCP death closes all
             # listeners at once, and the home server must learn even about
             # a rank that died before its first frame reached it
-            for peer in fabric.endpoints:
+            for peer in list(fabric.endpoints.values()):
                 if peer.rank == self.rank:
                     continue
                 try:

@@ -21,6 +21,26 @@ program is exec'd with ``ADLB_RENDEZVOUS``/``ADLB_RANK``/
 With ``--server-impl native --balancer tpu`` the JAX sidecar runs on the
 master server's host, bound to that host's ``--host`` address so servers
 anywhere can stream snapshots to it.
+
+**Channel plane (multiplexed host-pair sockets).** Each launcher runs
+one :class:`~adlb_tpu.runtime.channel.ChannelBroker` for its ranks and
+publishes ``broker.<host>.<pid>.addr`` (address + the rank list it
+serves) in the rendezvous directory; after the rendezvous every broker
+learns the full rank->broker routing, so the fleet's python<->python
+data plane is O(ranks + hosts^2) sockets instead of O(ranks^2).
+``tcp_mux="auto"`` turns the plane ON exactly where that explosion
+lives — when this launcher owns a strict subset of the world (a real
+multi-launcher fleet) — and stays per-pair for single-launcher worlds
+(``ADLB_TCP_MUX=1`` still forces it, the CI hook). App programs inherit
+the local broker through ``ADLB_BROKER_ADDR``/``ADLB_MUX_RANKS``.
+
+**Elastic membership** (``adlb_tpu/runtime/membership.py``): a running
+world grows without restart. ``--attach N`` execs N copies of the app
+program against an ALREADY-RUNNING world's rendezvous directory — each
+sets ``ADLB_ATTACH=1`` so :func:`adlb_tpu.api.join_world` negotiates a
+fresh rank id + home server from the master instead of reading
+``ADLB_RANK``. Attached ranks ride per-pair TCP (brokers route the
+static world; the ``mux_ranks`` bound keeps joiners off them).
 """
 
 from __future__ import annotations
@@ -76,6 +96,97 @@ def _await_all(dirpath: str, nranks: int, timeout: float) -> dict:
     return addr_map
 
 
+def _publish_broker(dirpath: str, addr: tuple, ranks) -> None:
+    """Publish this launcher's channel broker: address + the world ranks
+    it serves (named per launcher, so same-host launchers coexist)."""
+    os.makedirs(dirpath, exist_ok=True)
+    name = f"broker.{addr[0]}.{os.getpid()}.addr"
+    tmp = os.path.join(dirpath, f".{name}.tmp")
+    with open(tmp, "w") as f:
+        f.write(f"{addr[0]} {addr[1]}\n")
+        f.write(",".join(str(r) for r in sorted(ranks)) + "\n")
+    os.replace(tmp, os.path.join(dirpath, name))
+
+
+def _await_brokers(dirpath: str, nranks: int,
+                   timeout: float) -> tuple[dict, dict]:
+    """Wait until every world rank is covered by some launcher's broker
+    publication; returns (rank -> hostkey, hostkey -> broker addr) for
+    :meth:`ChannelBroker.set_routes`. Mixed-config fleets (one launcher
+    muxed, another not) time out loudly here instead of wedging later."""
+    deadline = time.monotonic() + timeout
+    while True:
+        rank_host: dict[int, str] = {}
+        broker_addrs: dict[str, tuple[str, int]] = {}
+        try:
+            names = os.listdir(dirpath)
+        except OSError:
+            names = []
+        for fn in names:
+            if not (fn.startswith("broker.") and fn.endswith(".addr")):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn)) as f:
+                    addr_line, ranks_line = f.read().split("\n")[:2]
+                h, p = addr_line.split()
+                hostkey = f"{h}:{int(p)}"
+                broker_addrs[hostkey] = (h, int(p))
+                for r in ranks_line.split(","):
+                    if r:
+                        rank_host[int(r)] = hostkey
+            except (OSError, ValueError):
+                continue
+        if set(range(nranks)) <= set(rank_host):
+            return rank_host, broker_addrs
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(nranks)) - set(rank_host))
+            raise TimeoutError(
+                f"broker rendezvous incomplete after {timeout}s: no "
+                f"broker covers ranks {missing[:10]} — is every "
+                f"launcher running with the same tcp_mux setting?"
+            )
+        time.sleep(0.05)
+
+
+def _attach_main(args) -> int:
+    """``--attach N``: exec N copies of the app program against an
+    ALREADY-RUNNING world (elastic membership). Each process negotiates
+    a fresh rank id + home server from the master via join_world's
+    ``ADLB_ATTACH`` contract — no restart, no rank-range bookkeeping."""
+    merged = os.path.join(args.rendezvous, "world.addr")
+    deadline = time.monotonic() + args.timeout
+    while not os.path.exists(merged):
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"--attach: no running world at {merged} (the launcher "
+                f"writes it after its rendezvous completes)"
+            )
+        time.sleep(0.1)
+    if not args.prog:
+        print("[adlb_launch] --attach needs an app program",
+              file=sys.stderr)
+        return 2
+    procs = []
+    for _ in range(args.attach):
+        env = dict(os.environ)
+        env["ADLB_RENDEZVOUS"] = merged
+        env["ADLB_ATTACH"] = "1"
+        env["ADLB_NUM_SERVERS"] = str(args.nservers)
+        env.pop("ADLB_RANK", None)  # attached ranks are ALLOCATED
+        if args.flight_dir:
+            env["ADLB_FLIGHT_DIR"] = args.flight_dir
+        procs.append(subprocess.Popen(args.prog, env=env))
+    rc_final = 0
+    for p in procs:
+        try:
+            p.wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc_final = rc_final or 1
+        rc_final = rc_final or (p.returncode or 0)
+    return rc_final
+
+
 def _check_port_clash(addr_map: dict) -> None:
     """Fail fast if two ranks published the same (host, port).
 
@@ -112,12 +223,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--rendezvous", required=True,
                     help="shared directory for the world's rendezvous")
-    ap.add_argument("--nranks", type=int, required=True)
+    ap.add_argument("--nranks", type=int, default=None)
     ap.add_argument("--nservers", type=int, required=True)
     ap.add_argument("--types", required=True,
                     help="comma-separated work types, e.g. 1,2,3")
-    ap.add_argument("--ranks", required=True,
+    ap.add_argument("--ranks", default=None,
                     help="this host's world ranks, e.g. 0-3 or 0,2,5")
+    ap.add_argument("--attach", type=int, default=0, metavar="N",
+                    help="elastic membership: attach N NEW app ranks to "
+                         "an ALREADY-RUNNING world on this rendezvous "
+                         "directory and exec the program once per rank "
+                         "(ADLB_ATTACH=1 — join_world negotiates rank "
+                         "ids + home servers from the master; no "
+                         "restart). --nranks/--ranks are not used; "
+                         "python servers only")
     ap.add_argument("--host", default="127.0.0.1",
                     help="address other hosts reach this one at")
     ap.add_argument("--server-impl", default="python",
@@ -198,6 +317,11 @@ def main(argv=None) -> int:
                          "ADLB_RENDEZVOUS/ADLB_RANK set)")
     args = ap.parse_args(argv)
 
+    if args.attach:
+        return _attach_main(args)
+    if args.nranks is None or args.ranks is None:
+        ap.error("--nranks and --ranks are required (unless --attach)")
+
     from adlb_tpu.runtime.world import Config, WorldSpec
 
     types = [int(t) for t in args.types.split(",")]
@@ -221,24 +345,27 @@ def main(argv=None) -> int:
                  wal_fsync_ms=args.wal_fsync_ms,
                  fault_spec=fault_spec)
     # per-process wire-codec selection (ADLB_CODEC env is the exec'd
-    # app ranks' hook; in-launcher server reactors select here).
-    # NOTE: the multi-host launcher still runs per-pair TCP across
-    # hosts — publishing a per-host broker through the rendezvous dir
-    # (one `broker.<host>.addr` file, ranks attaching like spawn_world's)
-    # is the named follow-up that turns tcp_mux="auto" on for fleets.
+    # app ranks' hook; in-launcher server reactors select here)
     from adlb_tpu.runtime.codec import select_codec
 
     select_codec(cfg.codec)
-    if cfg.tcp_mux == "on":
-        # explicit ask, no broker here yet: fail loudly (codec="c" rule)
-        raise ValueError(
-            "tcp_mux='on' requires a harness that runs a channel broker "
-            "(spawn_world today); the rendezvous launcher still runs "
-            "per-pair TCP"
-        )
     my_ranks = _parse_ranks(args.ranks)
     host = args.host
     rdv = args.rendezvous
+    # channel plane: one broker per launcher, published through the
+    # rendezvous dir. "auto" turns ON exactly where the per-pair socket
+    # explosion lives — a launcher owning a strict subset of the world
+    # is a multi-launcher fleet — and stays per-pair for single-launcher
+    # worlds (ADLB_TCP_MUX=1 still forces it, the CI hook)
+    from adlb_tpu.runtime.channel import ChannelBroker, resolve_tcp_mux
+
+    mux_on = cfg.tcp_mux == "on" or (
+        cfg.tcp_mux == "auto"
+        and (len(my_ranks) < args.nranks or resolve_tcp_mux(cfg))
+    )
+    broker = ChannelBroker(host=host) if mux_on else None
+    if broker is not None:
+        _publish_broker(rdv, broker.addr, my_ranks)
     # fabric negotiation: every launcher (and joined client) of this
     # world derives the SAME shm namespace from the rendezvous
     # directory, so same-host pairs find each other's rings while
@@ -274,10 +401,17 @@ def main(argv=None) -> int:
             from adlb_tpu.runtime.transport_tcp import TcpEndpoint
 
             # shm wrapper inside, fault shim outside (faults must apply
-            # to ring traffic exactly as to TCP traffic)
+            # to ring traffic exactly as to TCP traffic); the mux bound
+            # keeps dynamically attached ranks on per-pair sockets
             ep = maybe_wrap(
-                maybe_shm(TcpEndpoint(rank, {rank: (host, 0)}), cfg,
-                          shm_key),
+                maybe_shm(
+                    TcpEndpoint(
+                        rank, {rank: (host, 0)},
+                        mux=broker.addr if broker is not None else None,
+                        mux_ranks=world.nranks,
+                        compress_min=cfg.compress_min_bytes,
+                    ),
+                    cfg, shm_key),
                 cfg, world)
             server_eps[rank] = ep
             _publish(rdv, rank, host, ep.port)
@@ -313,6 +447,13 @@ def main(argv=None) -> int:
     write_rendezvous_file(
         merged, {r: a for r, a in addr_map.items() if r < world.nranks}
     )
+    if broker is not None:
+        # every launcher published a broker: teach ours the fleet's
+        # rank -> broker routing so cross-host envelopes bridge
+        rank_host, broker_addrs = _await_brokers(
+            rdv, world.nranks, args.timeout
+        )
+        broker.set_routes(rank_host, broker_addrs)
 
     # 4. run servers
     if sidecar is not None:
@@ -373,6 +514,14 @@ def main(argv=None) -> int:
                 env["ADLB_SHM_KEY"] = shm_key
             elif args.fabric == "tcp":
                 env["ADLB_FABRIC"] = "tcp"
+            if broker is not None:
+                # joined clients attach to this host's broker (one
+                # data-plane socket each); the bound keeps them off it
+                # for dynamically attached ranks
+                env["ADLB_BROKER_ADDR"] = (
+                    f"{broker.addr[0]}:{broker.addr[1]}"
+                )
+                env["ADLB_MUX_RANKS"] = str(world.nranks)
             if args.on_worker_failure != "abort":
                 env["ADLB_ON_WORKER_FAILURE"] = args.on_worker_failure
             if args.on_server_failure != "abort":
@@ -410,6 +559,8 @@ def main(argv=None) -> int:
         from adlb_tpu.balancer.sidecar import stop_sidecar
 
         stop_sidecar(*sidecar)
+    if broker is not None:
+        broker.close()
     # best-effort sweep of this world's ring segments/FIFOs: ranks that
     # died without unlinking (SIGKILL chaos) would otherwise leak them.
     # Exactly ONE party sweeps — the launcher hosting the master server —

@@ -126,6 +126,25 @@ class Tag(enum.Enum):
     # fleet-wide journey store. Armed only when ops_port is configured.
     SS_OBS_SYNC = enum.auto()
 
+    # elastic membership (adlb_tpu/runtime/membership.py; no reference
+    # analogue — upstream fixes every role at ADLB_Init):
+    # FA_MEMBER — joiner (provisional id) -> MASTER: attach an app rank
+    # or a scale-out server (kind="app"|"server", + listener host/port
+    # on TCP fabrics); member rank -> master: clean detach. The master
+    # allocates rank id + home under a fresh fleet epoch and answers
+    # only after every live server acked the fan-out.
+    FA_MEMBER = enum.auto()
+    TA_MEMBER_RESP = enum.auto()
+    # SS_MEMBER — the membership fan-out/control plane, epoch-stamped:
+    # mop="attach"/"detach"/"server_join" (apply + ack toward the
+    # master), "ack" (barrier), "ready" (new shard's reactor is up),
+    # "rebalance" (master -> donor: ship backlog to the new shard over
+    # the acked migration plane), "server_drain" (master -> all: rank S
+    # is draining; S itself force-bootstraps a full replication stream
+    # to its buddy, flushes, announces "drain_done", and exits — the
+    # buddy promotes a COMPLETE mirror, so scale-in counts no losses)
+    SS_MEMBER = enum.auto()
+
     # server failover (Config(on_server_failure="failover"); no reference
     # analogue — upstream's servers ARE the pool and a server death kills
     # the job, SURVEY §5):

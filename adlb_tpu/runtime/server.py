@@ -56,6 +56,7 @@ from adlb_tpu.runtime.world import Config, WorldSpec
 from adlb_tpu.types import (
     ADLB_BACKOFF,
     ADLB_DONE_BY_EXHAUSTION,
+    ADLB_ERROR,
     ADLB_FENCED,
     ADLB_LOWEST_PRIO,
     ADLB_NO_CURRENT_WORK,
@@ -229,6 +230,12 @@ class Server:
     def __init__(
         self, world: WorldSpec, cfg: Config, ep: Endpoint, abort_event=None
     ) -> None:
+        from adlb_tpu.runtime.membership import MemberView
+
+        # every server holds the DYNAMIC membership view (behavior-
+        # identical to the plain spec until membership actually changes);
+        # scale-out shards arrive with a pre-seeded view
+        world = MemberView.of(world)
         self.world = world
         self.cfg = cfg
         self.ep = ep
@@ -300,7 +307,6 @@ class Server:
         )
         self._dead_servers: set[int] = set()
         self._srv_route: dict[int, int] = {}  # dead server -> its buddy
-        self._fo_epoch = 0
         self.repl = None  # ReplicationLog toward the current buddy
         # primary rank -> ReplicaMirror (normally just the ring
         # predecessor; re-bootstraps after intermediate deaths can add
@@ -348,6 +354,43 @@ class Server:
         self._killed_units: set[int] = set()
         self._killed_order: deque = deque()
         self.wal_recovered = 0  # units adopted from the WAL at startup
+
+        # ---- elastic membership (adlb_tpu/runtime/membership.py) ----
+        # master's id pool for attached ranks / scale-out servers: above
+        # the base world AND the sidecar pseudo-rank (== spec.nranks)
+        self._member_next_rank = world.spec.nranks + 1
+        # fan-out/ack barrier: the master answers an attach/detach only
+        # once every live server acked the membership change, so a new
+        # rank's first frame can never outrun its own membership
+        self._member_tok = 0
+        self._member_pending: dict[int, dict] = {}
+        # scale-out shards whose reactors announced "ready" (master);
+        # shards published live fleet-wide (server_live fan-out) — only
+        # these join rings, fan-outs, and buddy walks
+        self._member_ready: set[int] = set()
+        self._member_live: set[int] = set(
+            s for s in world.extra_servers if s != self.rank
+        )
+        # scale-in: servers mid-drain, and servers retired CLEANLY
+        # (full-mirror promote, zero counted losses)
+        self._draining_servers: set[int] = set()
+        self._draining_self = False
+        self._drain_deadline = 0.0
+        self._drained_exit = False
+        self._drained_servers: set[int] = set()
+        self._clean_retire: set[int] = set()
+        # harness hook: callable(alloc) that spawns a new server shard
+        # (in-proc thread, subprocess, k8s pod — the harness's business)
+        self.member_spawner = None
+        # watermark-triggered scale-out with no spawner registered parks
+        # here, visible at /fleet — the future autoscaler's feed
+        self._scale_pending: Optional[dict] = None
+        self._scaleout_t0: Optional[float] = None
+        self._next_elastic_check = 0.0
+        self._elastic_cooldown_until = 0.0
+        # member rank -> published (host, port), for TCP joiners
+        self._member_addrs: dict[int, tuple] = {}
+
         # when each server's death was first observed here (MTTR t0)
         self._server_eof_at: dict[int, float] = {}
         # servers whose inbound connection EOF was HANDLED by this
@@ -461,6 +504,7 @@ class Server:
         self.done = False
         self._finalized: set[int] = set()
         self._end1_pending = False  # END_1 token held until local apps finish
+        self._end1_sent_at = 0.0    # last kick (the lost-END watchdog's t0)
         self._ending = False  # shutdown ring underway: peer EOFs are benign
         self._exhaust_held_since: Optional[float] = None
         self._exhaust_inflight = False
@@ -552,6 +596,14 @@ class Server:
         self._m_wal_syncs = self.metrics.counter("wal_syncs")
         self._m_jobs_done = self.metrics.counter("jobs_done")
         self._g_fo_mttr = self.metrics.gauge("failover_mttr_ms")
+        # elastic-membership surface: counted ONCE fleet-wide (attach/
+        # detach at the home server, joins/drains at the master)
+        self._m_attached = self.metrics.counter("ranks_attached")
+        self._m_detached = self.metrics.counter("ranks_detached")
+        self._m_servers_joined = self.metrics.counter("servers_joined")
+        self._m_servers_drained = self.metrics.counter("servers_drained")
+        self._g_epoch = self.metrics.gauge("member_epoch")
+        self._g_scaleout_mttr = self.metrics.gauge("scaleout_mttr_ms")
         self._g_wq = self.metrics.gauge("wq_depth")
         self._g_rq = self.metrics.gauge("rq_depth")
         self._ts_wq = self.metrics.timeseries("wq_depth")
@@ -734,6 +786,8 @@ class Server:
             Tag.SS_PLAN_MIGRATE: self._on_plan_migrate,
             Tag.SS_MIGRATE_WORK: self._on_migrate_work,
             Tag.SS_MIGRATE_ACK: self._on_migrate_ack,
+            Tag.FA_MEMBER: self._on_fa_member,
+            Tag.SS_MEMBER: self._on_ss_member,
             Tag.SS_RANK_DEAD: self._on_rank_dead,
             Tag.SS_COMMON_FORFEIT: self._on_common_forfeit,
             Tag.SS_REPL: self._on_repl,
@@ -785,6 +839,14 @@ class Server:
             self._prof_shared = profile.active()
             if self._balancer is not None:
                 self._balancer.start()
+            if self.rank not in self.world.spec.server_ranks:
+                # scale-out shard: the reactor is up — announce ready so
+                # the master publishes us live (rings, buddy walks) and
+                # directs the donor bootstrap at us
+                self.ep.send(
+                    self.world.master_server_rank,
+                    msg(Tag.SS_MEMBER, self.rank, mop="ready"),
+                )
             self._run_loop()
         finally:
             profile.stop(self._prof)
@@ -929,7 +991,7 @@ class Server:
             if pname is None:
                 pname = self._phase_names[m.tag] = f"handler:{m.tag.name}"
             prof.set_phase(pname)
-        if self._lease_armed and m.src < self.world.num_app_ranks:
+        if self._lease_armed and self.world.is_app(m.src):
             # every frame from an app rank is liveness evidence: protocol
             # traffic piggybacks the heartbeat, FA_HEARTBEAT only covers
             # the idle-but-computing gaps
@@ -979,6 +1041,46 @@ class Server:
             self._g_wal_lag.set(self.wal.fsync_lag_ms(now))
             if self.wal.maybe_compact(self):
                 self._release_wal_acks(self.wal.take_compact_acks())
+        if self._draining_self:
+            # scale-in drain parked on in-flight push custody: the
+            # deadline bounds a pusher that died mid-handshake
+            self._maybe_finish_drain()
+        if (
+            self.is_master and self._end1_pending and not self.done
+            and not self._aborted and not self._member_pending
+            and self._finalized >= self.local_apps
+            and now - self._end1_sent_at
+            > 10 * self.cfg.exhaust_check_interval
+        ):
+            # lost-END recovery: an epoch-voided END_1 dies at the
+            # voiding server; once the gossip converges the epochs,
+            # re-kick under the current one (token-less ring — the
+            # generous deadline, not an id, bounds duplicates)
+            self._forward_end1(
+                {"origin": self.rank, "epoch": self.world.epoch}
+            )
+        if self._member_pending:
+            # membership fan-out/ack barrier timeout: a wedged server
+            # must not park a joiner forever. The change already applied
+            # at every RESPONSIVE server (the fan-out is idempotent), so
+            # answer the joiner; the silent server is on its way to an
+            # EOF-declared death anyway.
+            for tok, p in list(self._member_pending.items()):
+                if now >= p["deadline"]:
+                    del self._member_pending[tok]
+                    self.flight.record(
+                        f"member barrier timeout tok={tok} "
+                        f"unacked={sorted(p['need'])}"
+                    )
+                    self._member_reply(p)
+        if (
+            self.is_master
+            and self.cfg.elastic_scaleout == "auto"
+            and self.cfg.max_malloc_per_server > 0
+            and now >= self._next_elastic_check
+        ):
+            self._next_elastic_check = now + 0.25
+            self._maybe_autoscale(now)
         if self._pending_promotion:
             # SS_SERVER_DEAD arrived but the dead server's own EOF has
             # not: promote at the deadline anyway (the death may predate
@@ -1010,7 +1112,7 @@ class Server:
                     try:
                         self.ep.send(
                             r, msg(Tag.TA_HOME_TAKEOVER, self.rank,
-                                   dead=dead, epoch=self._fo_epoch),
+                                   dead=dead, epoch=self.world.epoch),
                             connect_grace=0.25,
                         )
                     except OSError:
@@ -1932,8 +2034,25 @@ class Server:
                             retry_after_ms=25, put_id=put_id),
                     )
                     return
-        if m.target_rank >= 0 and m.target_rank in self._dead_ranks:
-            # targeted at a dead rank: accept-and-drop (at-most-once — the
+        if m.target_rank >= 0 and not self.world.is_app(m.target_rank) \
+                and m.target_rank not in self._dead_ranks \
+                and m.target_rank not in self.world.detached:
+            # elastic membership: the CLIENT passed an above-base-world
+            # target through (it cannot tell an attached member from a
+            # typo) — the servers hold the authoritative membership, so
+            # an unknown member is answered loudly, never parked forever
+            self._send_app(
+                m.src,
+                msg(Tag.TA_PUT_RESP, self.rank, rc=ADLB_ERROR,
+                    put_id=put_id),
+            )
+            return
+        if m.target_rank >= 0 and (
+            m.target_rank in self._dead_ranks
+            or m.target_rank in self.world.detached
+        ):
+            # targeted at a dead (or cleanly detached) rank:
+            # accept-and-drop (at-most-once — the
             # unit could never be fetched), keeping the batch-common
             # refcount correct so the prefix still GCs
             self._m_targeted_dropped.inc()
@@ -2067,6 +2186,29 @@ class Server:
         if entry is not None:
             self._pin(unit.seqno, entry.world_rank)
             self._satisfy_parked(entry, unit)
+        elif unit.target_rank >= 0:
+            # elastic membership: a targeted put can land OFF the
+            # target's home (a static client's base-modulo route cannot
+            # know an attached rank's assigned home, and a rank attached
+            # after the putter's view was seeded re-homes under a later
+            # epoch). Announce the inventory to the target's home so its
+            # TargetedDirectory redirects the rank's reserve here —
+            # exactly the off-home directory the failover re-announce
+            # path already maintains. Static worlds never take this
+            # branch (clients route targeted puts home by construction).
+            try:
+                t_home = self.world.home_server(unit.target_rank)
+            except KeyError:
+                t_home = self.rank  # not yet a member here: the rank's
+                # own reserve traffic will find it once membership lands
+            if t_home != self.rank:
+                self._send_srv(
+                    t_home,
+                    msg(Tag.SS_MOVING_TARGETED_WORK, self.rank,
+                        app_rank=unit.target_rank,
+                        work_type=unit.work_type,
+                        from_server=-1, to_server=self.rank, count=1),
+                )
         self._put_record(m.src, put_id)
         # write-ahead replication: the unit's log entry must be on the
         # wire BEFORE the accept ack, or a server death in between loses
@@ -2915,6 +3057,16 @@ class Server:
         for s, st in self.peers.items():
             if s == self.rank:
                 continue
+            if (
+                s in self._draining_servers
+                or s in self._dead_servers
+                or not self._is_live_member(s)
+            ):
+                # elastic membership: a push is custody transfer with no
+                # ack — never aim one at a server that is leaving (the
+                # drain flushes its wq to the buddy, not frames still in
+                # its inbox) or not yet live
+                continue
             cap = self.cfg.max_malloc_per_server
             if cap <= 0 or st.nbytes + unit.payload_len <= 0.9 * cap:
                 if target is None or st.nbytes < self.peers[target].nbytes:
@@ -2937,6 +3089,16 @@ class Server:
             self._push_offered.pop(qid, None)
 
     def _on_push_query(self, m: Msg) -> None:
+        if self._draining_self or self.done:
+            # scale-in: no NEW custody once draining — accepted pushes
+            # gate the drain's final flush (_maybe_finish_drain), so a
+            # query accepted now would only widen that window
+            self._send_srv(
+                m.src,
+                msg(Tag.SS_PUSH_QUERY_RESP, self.rank,
+                    query_id=m.query_id, accept=False),
+            )
+            return
         ok = self.mem.has_room(m.nbytes)
         if ok:
             self.mem.alloc(m.nbytes)  # budget reserved until WORK or DEL
@@ -3037,11 +3199,16 @@ class Server:
             self.wlog.log_put(unit, -1, None)
         self.stats[InfoKey.NPUSHED_TO_HERE] += 1
         self._match_rq()
+        if self._draining_self:
+            # the custody this drain was waiting on just landed
+            self._maybe_finish_drain()
 
     def _on_push_del(self, m: Msg) -> None:
         nbytes = self._push_reserved.pop(m.query_id, None)
         if nbytes is not None:
             self.mem.free(nbytes)
+        if self._draining_self:
+            self._maybe_finish_drain()
 
     def _on_moving_targeted(self, m: Msg) -> None:
         """Home-server directory fixup when targeted work migrates
@@ -3076,6 +3243,14 @@ class Server:
             # per-job inventory rides along only while job partitions
             # hold work: single-job worlds gossip byte-identically
             ent["jq"] = jq
+        if self.world.epoch:
+            # elastic membership: the fleet epoch rides the gossip it
+            # already pays for, so a server that missed one epoch-bump
+            # fan-out (a drain_done toward a peer mid-join, a dropped
+            # frame) converges within a tick instead of voiding every
+            # exhaustion/END token forever. Static worlds (epoch 0)
+            # gossip byte-identically.
+            ent["epoch"] = self.world.epoch
         return ent
 
     def _broadcast_qmstat(self) -> None:
@@ -3116,6 +3291,9 @@ class Server:
                 self._note_server_unreachable(srv)
 
     def _apply_qmstat_entry(self, src: int, ent: dict) -> None:
+        e = ent.get("epoch")
+        if e:
+            self.world.note_epoch(e)  # monotonic: only ever heals a lag
         st = self.peers[src]
         st.nbytes = ent["nbytes"]
         st.qlen = ent["qlen"]
@@ -3750,6 +3928,10 @@ class Server:
             "act": {self.rank: self.activity},
             "nparked": len(self.rq),
             "parked": self._parked_list(),
+            # exhaustion is EPOCH-based, not fixed-count: the verdict is
+            # void if membership changed while the token circulated (a
+            # rank attaching mid-ring must not race the verdict)
+            "epoch": self.world.epoch,
         }
         self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
 
@@ -3759,22 +3941,50 @@ class Server:
                             complete=nxt == token["origin"])
         )
 
+    def _ring_covered(self, visited) -> bool:
+        """Origin-side completeness check for ring verdicts. The epoch
+        stamp alone cannot catch a hop whose epoch NUMBER healed (qmstat
+        gossip / a prior void) while its membership CONTENT still lags —
+        `server_live` is the one fan-out without an ack barrier, so such
+        a hop's ring_next silently skips the just-published shard. A
+        verdict that missed a live server must not conclude; the void
+        costs one round while the SS_MEMBER frame lands."""
+        need = {
+            s for s in self.world.server_ranks
+            if s not in self._dead_servers and self._is_live_member(s)
+        }
+        return need <= set(visited)
+
     def _on_exhaust_chk(self, m: Msg) -> None:
         if "job" in m.token:
             self._on_job_exhaust_chk(m)
             return
         token = m.token
         phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
+        if token.get("epoch", self.world.epoch) != self.world.epoch:
+            # the token crossed a membership-epoch boundary (attach /
+            # detach / scale / failover): the vote it carries mixes two
+            # worlds — void it so the origin re-votes under the new one.
+            # note_epoch heals the LAGGING side (a missed bump fan-out);
+            # the qmstat gossip heals the other direction, so the void
+            # is one round, never forever.
+            token["ok"] = False
+            self.world.note_epoch(token.get("epoch", 0) or 0)
         if m.data.get("complete") and token["origin"] == self.rank:
             if token.get("token_id", 0) != self._exhaust_token_id:
                 return  # straggler from a token we already gave up on
             # token made it all the way around; pass 2 validates against the
             # globally-gathered parked list from pass 1
+            visited = token["act"] if phase1 else (
+                set(token.get("seen2", ())) | {self.rank}
+            )
             ok = (
                 token["ok"]
                 and token["nparked"] > 0
                 and self._exhaust_vote(token["parked"])
                 and self.activity == token["act"].get(self.rank, -1)
+                and self._ring_covered(token["act"])
+                and self._ring_covered(visited)
             )
             if not ok:
                 self._exhaust_held_since = None
@@ -3788,6 +3998,7 @@ class Server:
                     "act": token["act"],
                     "nparked": token["nparked"],
                     "parked": token["parked"],
+                    "epoch": self.world.epoch,
                 }
                 self._forward_exhaust(Tag.SS_EXHAUST_CHK_2, token2)
             else:
@@ -3806,6 +4017,7 @@ class Server:
                 and self._exhaust_vote(token["parked"])
                 and self.activity == token["act"].get(self.rank, -1)
             )
+            token.setdefault("seen2", []).append(self.rank)
         self._forward_exhaust(m.tag, token)
 
     def _declare_exhaustion(self) -> None:
@@ -3843,6 +4055,11 @@ class Server:
         whose last straggler was a casualty still ends cleanly."""
         if not (self._finalized >= self.local_apps):
             return
+        if self.is_master and self._member_pending:
+            # a membership fan-out is mid-barrier: kicking the END ring
+            # now would stamp an epoch some server has not reached yet.
+            # The barrier's completion re-calls this.
+            return
         held = getattr(self, "_held_end1", None)
         if self._end1_pending and held is not None:
             self._end1_pending = False
@@ -3850,9 +4067,17 @@ class Server:
             self._forward_end1(held)
         elif self.is_master and not self._end1_pending:
             self._end1_pending = True
-            self._forward_end1({"origin": self.rank})
+            self._forward_end1(
+                {"origin": self.rank, "epoch": self.world.epoch}
+            )
 
     def _forward_end1(self, token: dict) -> None:
+        self._end1_sent_at = time.monotonic()
+        # visit record for the origin's coverage check (every forwarder,
+        # origin included at kick)
+        seen = token.setdefault("seen", [])
+        if self.rank not in seen:
+            seen.append(self.rank)
         self._ring_forward(
             lambda nxt: msg(Tag.SS_END_1, self.rank, token=token,
                             complete=(nxt == token["origin"]))
@@ -3861,7 +4086,31 @@ class Server:
     def _on_end_1(self, m: Msg) -> None:
         self._ending = True
         token = m.token
+        tok_epoch = token.get("epoch")
+        if tok_epoch is not None and tok_epoch != self.world.epoch:
+            # membership changed under the ring (a server retire is the
+            # only epoch bump possible here — attach/detach/scale are
+            # refused once termination is underway): void the token; the
+            # master re-kicks under the new epoch (the retire path, the
+            # _periodic lost-END watchdog, and _apply_member all do)
+            self.world.note_epoch(tok_epoch)  # heal a lagging view
+            if (
+                self.is_master
+                and not self.done
+                and self._finalized >= self.local_apps
+            ):
+                self._end1_pending = True
+                self._forward_end1(
+                    {"origin": self.rank, "epoch": self.world.epoch}
+                )
+            return
         if m.data.get("complete") and token["origin"] == self.rank:
+            if not self._ring_covered(token.get("seen", ())):
+                # a hop's lagging membership skipped a live server (see
+                # _ring_covered): drop the verdict; _end1_pending stays
+                # set, so the lost-END watchdog re-kicks once the
+                # skipped server's SS_MEMBER frame has landed fleet-wide
+                return
             # every server's local apps have finalized: circulate phase 2
             self._ring_forward(
                 lambda nxt: msg(Tag.SS_END_2, self.rank, token=token,
@@ -4462,6 +4711,68 @@ class Server:
                 raise KeyError(f"unknown job {jid}")
             self._job_ctl_fanout(op, jid)
             return {"job_id": jid, "state": self.jobs.get(jid).state}
+        if op == "fleet":
+            return self.fleet_doc()
+        if op == "scale_out":
+            if not self.is_master:
+                raise ValueError("scale_out is a master op")
+            if self._member_terminating():
+                raise RuntimeError("world terminating")
+            return self._request_scale_out("manual")
+        if op == "scale_in":
+            if not self.is_master:
+                raise ValueError("scale_in is a master op")
+            if self.cfg.on_server_failure != "failover":
+                raise RuntimeError(
+                    "scale_in drains through the promote path: "
+                    "on_server_failure='failover' required (clients "
+                    "must follow TA_HOME_TAKEOVER)"
+                )
+            if self._member_terminating():
+                raise RuntimeError("world terminating")
+            live = [
+                s for s in self.world.server_ranks
+                if s not in self._dead_servers
+                and s not in self._draining_servers
+                and self._is_live_member(s)
+            ]
+            rank = req.get("rank")
+            if rank is None:
+                # newest scale-out shard first, else the highest-ranked
+                # non-master base server
+                extras = [s for s in live
+                          if s not in self.world.spec.server_ranks]
+                cands = extras or [
+                    s for s in live
+                    if s != self.world.master_server_rank
+                ]
+                if not cands:
+                    raise RuntimeError("no drainable server")
+                rank = max(cands)
+            rank = int(rank)
+            if rank == self.world.master_server_rank:
+                raise ValueError("cannot drain the master")
+            if rank not in live:
+                raise ValueError(f"server {rank} is not live")
+            if len(live) <= 2:
+                raise RuntimeError(
+                    "refusing to drain below two live servers (the "
+                    "drained shard needs a buddy)"
+                )
+            epoch = self.world.epoch + 1
+            for s in self._live_servers():
+                try:
+                    self.ep.send(
+                        s, msg(Tag.SS_MEMBER, self.rank,
+                               mop="server_drain", rank=rank,
+                               epoch=epoch),
+                    )
+                except OSError:
+                    self._note_server_unreachable(s)
+            self._apply_member(
+                dict(mop="server_drain", rank=rank, epoch=epoch)
+            )
+            return {"rank": rank, "epoch": epoch}
         raise ValueError(f"unknown control op {op!r}")
 
     def _alloc_job_id(self) -> int:
@@ -4665,6 +4976,7 @@ class Server:
                 "token_id": job.exhaust_token_id,
                 "ok": True,
                 "act": {self.rank: job.activity},
+                "epoch": self.world.epoch,
             }
             self._forward_exhaust(Tag.SS_EXHAUST_CHK_1, token)
 
@@ -4673,6 +4985,12 @@ class Server:
         jid = token["job"]
         phase1 = m.tag is Tag.SS_EXHAUST_CHK_1
         job = self.jobs.ensure(jid)
+        if token.get("epoch", self.world.epoch) != self.world.epoch:
+            # per-job votes key on the membership epoch exactly like the
+            # world vote: a rank joining (and attaching to this job)
+            # mid-ring voids the verdict (and heals a lagging view)
+            token["ok"] = False
+            self.world.note_epoch(token.get("epoch", 0) or 0)
         if m.data.get("complete") and token["origin"] == self.rank:
             if token.get("token_id", 0) != job.exhaust_token_id:
                 return  # straggler from an abandoned token
@@ -4682,6 +5000,9 @@ class Server:
                 token["ok"]
                 and self._exhaust_vote_job(jid)
                 and job.activity == token["act"].get(self.rank, -1)
+                # same completeness bar as the world vote: a hop whose
+                # membership lagged a scale-out shard skipped it
+                and self._ring_covered(token["act"])
                 # a submitted-but-never-started job must not complete:
                 # "done" needs evidence the job RAN (activity somewhere
                 # in the fleet) — or an explicit drain, which is the
@@ -4702,6 +5023,7 @@ class Server:
                     "token_id": job.exhaust_token_id,
                     "ok": True,
                     "act": token["act"],
+                    "epoch": self.world.epoch,
                 }
                 self._forward_exhaust(Tag.SS_EXHAUST_CHK_2, token2)
             else:
@@ -4723,6 +5045,735 @@ class Server:
     def _job_activity(self, jid: int) -> None:
         if jid:
             self.jobs.ensure(jid).activity += 1
+
+    # ------------------------------------------------- elastic membership
+    # adlb_tpu/runtime/membership.py; no reference analogue — upstream
+    # fixes every role at ADLB_Init. The MASTER owns allocation (rank
+    # ids, home servers, fleet epochs) and the fan-out/ack barrier;
+    # every server applies SS_MEMBER ops against its MemberView; the
+    # exhaustion/END rings key on the epoch, so a join can never race a
+    # termination verdict; scale-out bootstraps a new shard from a
+    # donor over the acked migration plane; scale-in drains through the
+    # failover promote path with a force-flushed full mirror (zero
+    # counted losses).
+
+    @staticmethod
+    def _mstr(v) -> str:
+        return v.decode("utf-8", "replace") if isinstance(v, bytes) else v
+
+    def _member_terminating(self) -> bool:
+        return (
+            self.no_more_work or self.done_by_exhaustion or self._ending
+            or self._end1_pending or self.done or self._aborted
+        )
+
+    def _is_live_member(self, s: int) -> bool:
+        """A server eligible for rings/fan-outs/buddy duty: base servers
+        always (death is handled by _dead_servers); scale-out shards
+        only once their reactor announced ready (server_live fan-out) —
+        a not-yet-running shard must not receive ring tokens or become
+        someone's replication target."""
+        if s in self.world.spec.server_ranks or s == self.rank:
+            return True
+        return s in self._member_live
+
+    def _buddy_excluded(self) -> set:
+        """Servers a buddy walk must skip: the dead, plus joined-but-
+        not-yet-live shards (no mirror could exist there)."""
+        out = set(self._dead_servers)
+        for s in self.world.extra_servers:
+            if not self._is_live_member(s):
+                out.add(s)
+        return out
+
+    def _on_fa_member(self, m: Msg) -> None:
+        mop = self._mstr(m.data.get("mop") or "")
+        if mop == "detach":
+            self._member_detach_req(m)
+            return
+        if mop != "attach":
+            self._member_refuse(m.src, f"unknown member op {mop!r}")
+            return
+        if not self.is_master:
+            self._member_refuse(m.src, "attach goes to the master server")
+            return
+        if self._member_terminating():
+            self._member_refuse(
+                m.src, "world terminating", rc=ADLB_NO_MORE_WORK
+            )
+            return
+        kind = self._mstr(m.data.get("kind") or "app")
+        host = m.data.get("host")
+        port = m.data.get("port")
+        addr = (self._mstr(host), int(port)) if host is not None else None
+        if addr is not None and hasattr(self.ep, "addr_map"):
+            # the joiner's listener: the reply (and everyone's future
+            # traffic) dials it; learned under the PROVISIONAL id too so
+            # the TA_MEMBER_RESP can be delivered at all
+            self.ep.addr_map.setdefault(m.src, addr)
+        rank = self._member_next_rank
+        self._member_next_rank += 1
+        epoch = self.world.epoch + 1
+        if addr is not None:
+            self._member_addrs[rank] = addr
+        if kind == "server":
+            fields = dict(mop="server_join", rank=rank, epoch=epoch)
+            resp = dict(
+                rc=ADLB_SUCCESS, rank=rank, epoch=epoch,
+                member=None,  # filled at reply time (fresh snapshot)
+                jobs=self._member_jobs_seed(),
+                # the new shard must know which base servers are gone:
+                # its ring/buddy walks and live-member checks start from
+                # the static spec otherwise
+                srv_dead=sorted(self._dead_servers),
+                srv_drained=sorted(self._drained_servers),
+            )
+            if hasattr(self.ep, "addr_map"):
+                from adlb_tpu.runtime.membership import is_provisional
+
+                resp["rank_addrs"] = {
+                    r: a for r, a in self.ep.addr_map.items()
+                    if r != rank and not is_provisional(r)
+                }
+        else:
+            home = self._member_pick_home()
+            fields = dict(mop="attach", rank=rank, home=home, epoch=epoch)
+            # the joiner dialed only the master: it needs EVERY server's
+            # listener (its home above all — FA_LOCAL_APP_DONE must land
+            # there, or the home counts the rank unfinalized forever)
+            srv_addrs = {}
+            if hasattr(self.ep, "addr_map"):
+                for r in self.world.server_ranks:
+                    a = self.ep.addr_map.get(r) or self._member_addrs.get(r)
+                    if a is not None:
+                        srv_addrs[r] = a
+            resp = dict(rc=ADLB_SUCCESS, rank=rank, home=home, epoch=epoch,
+                        member=None, srv_addrs=srv_addrs,
+                        srv_route=self._member_srv_route())
+        if addr is not None:
+            fields["host"], fields["port"] = addr
+        self._member_barrier(fields, to=m.src, resp=resp)
+
+    def _member_jobs_seed(self) -> list:
+        from adlb_tpu.runtime.jobs import STATE_CODES
+
+        return [
+            (j.job_id, STATE_CODES[j.state], j.quota_bytes, j.name)
+            for j in self.jobs.values() if j.job_id
+        ]
+
+    def _member_srv_route(self) -> dict:
+        """Retired (dead/drained) server -> the LIVE ring successor that
+        owns its shard today, chains collapsed. A joiner missed every
+        TA_HOME_TAKEOVER broadcast that predates it, so the attach reply
+        must seed its client-side route map directly — otherwise its
+        round-robin puts dial the retired listener and time out waiting
+        for a takeover note that will never re-arrive."""
+        retired = self._dead_servers | self._drained_servers
+        route = {}
+        ring = self.world.server_ranks
+        for r in retired:
+            nxt = self.world.ring_next(r)
+            for _ in range(len(ring)):
+                if nxt not in retired and self._is_live_member(nxt):
+                    break
+                nxt = self.world.ring_next(nxt)
+            if nxt not in retired and nxt != r:
+                route[r] = nxt
+        return route
+
+    def _member_pick_home(self) -> int:
+        """Least-loaded live server by homed-rank count — scale-out
+        shards participate, which IS the TargetedDirectory rebalance:
+        new ranks (and their targeted traffic) land on new capacity."""
+        cands = [
+            s for s in self.world.server_ranks
+            if s not in self._dead_servers
+            and s not in self._draining_servers
+            and self._is_live_member(s)
+        ]
+        return min(cands, key=lambda s: (len(self.world.local_apps(s)), s))
+
+    def _member_refuse(self, to: int, error: str, rc: int = -1) -> None:
+        try:
+            self.ep.send(
+                to, msg(Tag.TA_MEMBER_RESP, self.rank, rc=rc, error=error),
+                connect_grace=1.0,
+            )
+        except OSError:
+            pass
+
+    def _member_detach_req(self, m: Msg) -> None:
+        rank = m.src
+        if not self.is_master:
+            self._member_refuse(rank, "detach goes to the master server")
+            return
+        if not self.world.is_app(rank):
+            # idempotent: a re-sent detach after the first applied
+            ok = rank in self.world.detached
+            self._member_refuse(
+                rank, "not a member", rc=ADLB_SUCCESS if ok else -1
+            )
+            return
+        if self._member_terminating():
+            # termination already counts the rank out as it finalizes;
+            # refuse with the termination rc so the client falls back to
+            # a plain finalize
+            self._member_refuse(
+                rank, "world terminating", rc=ADLB_NO_MORE_WORK
+            )
+            return
+        epoch = self.world.epoch + 1
+        self._member_barrier(
+            dict(mop="detach", rank=rank, epoch=epoch),
+            to=rank,
+            resp=dict(rc=ADLB_SUCCESS, rank=rank, epoch=epoch),
+        )
+
+    def _member_barrier(self, fields: dict, to: int, resp: dict) -> None:
+        """Apply a membership op locally, fan it to every live server,
+        and hold the joiner's reply until all acks land (or the barrier
+        deadline passes — the op is idempotent and applied everywhere
+        responsive). The END ring defers while a barrier is open, so
+        the epoch a token carries is never ahead of a voter."""
+        self._member_tok += 1
+        tok = self._member_tok
+        need = set()
+        for s in self._live_servers():
+            if not self._is_live_member(s):
+                continue
+            try:
+                self.ep.send(
+                    s, msg(Tag.SS_MEMBER, self.rank, member_tok=tok,
+                           **fields)
+                )
+                need.add(s)
+            except OSError:
+                self._note_server_unreachable(s)
+        self._apply_member(dict(fields))
+        p = {
+            "need": need,
+            "to": to,
+            "resp": resp,
+            "deadline": time.monotonic() + 5.0,
+            "fields": fields,
+        }
+        if need:
+            self._member_pending[tok] = p
+        else:
+            self._member_reply(p)
+
+    def _member_reply(self, p: dict) -> None:
+        resp = dict(p["resp"])
+        if resp.get("member", "x") is None:
+            # snapshot at REPLY time: attaches that completed while this
+            # barrier was open are included
+            resp["member"] = self.world.snapshot()
+        try:
+            self.ep.send(
+                p["to"], msg(Tag.TA_MEMBER_RESP, self.rank, **resp),
+                connect_grace=2.0,
+            )
+        except OSError:
+            self.flight.record(
+                f"member reply to {p['to']} undeliverable"
+            )
+        # a deferred END ring can proceed now
+        self._maybe_complete_finalize()
+
+    def _on_ss_member(self, m: Msg) -> None:
+        mop = self._mstr(m.data.get("mop") or "")
+        if mop == "ack":
+            p = self._member_pending.get(m.data.get("member_tok"))
+            if p is None:
+                return
+            p["need"].discard(m.src)
+            if not p["need"]:
+                del self._member_pending[m.data["member_tok"]]
+                self._member_reply(p)
+            return
+        if mop == "ready":
+            self._member_on_ready(m.src)
+            return
+        if mop == "rebalance":
+            self._member_rebalance(int(m.data["dest"]))
+            return
+        if mop == "drain_done":
+            rank = int(m.data["rank"])
+            self._draining_servers.discard(rank)
+            self._clean_retire.add(rank)
+            # per-pair FIFO: every SS_REPL frame of the drain's final
+            # flush was handled before this frame — the mirror here (if
+            # we are the buddy) is COMPLETE, no EOF wait needed
+            self._server_tail_drained.add(rank)
+            self._on_server_dead(
+                msg(Tag.SS_SERVER_DEAD, m.src, rank=rank,
+                    epoch=int(m.data.get("epoch", 0) or 0), clean=1)
+            )
+            return
+        if mop == "sync":
+            self.world.seed(m.data.get("member") or {})
+            for r, a in (m.data.get("addrs") or {}).items():
+                if hasattr(self.ep, "addr_map"):
+                    self.ep.addr_map.setdefault(int(r), tuple(a))
+            for jid, code, quota, name in m.data.get("jobs") or ():
+                # close the spawn-window gap: a job submitted / drained
+                # / killed between this shard's FA_MEMBER seed and its
+                # "ready" fan-out membership never reached it
+                self.jobs.restore(jid, code, quota, name)
+            self._g_epoch.set(self.world.epoch)
+            return
+        self._apply_member(dict(m.data))
+        tok = m.data.get("member_tok")
+        if tok:
+            try:
+                self.ep.send(
+                    m.src, msg(Tag.SS_MEMBER, self.rank, mop="ack",
+                               member_tok=tok)
+                )
+            except OSError:
+                pass
+
+    def _apply_member(self, d: dict) -> None:
+        mop = self._mstr(d.get("mop") or "")
+        epoch = int(d.get("epoch", 0) or 0)
+        rank = int(d.get("rank", -1))
+        host = d.get("host")
+        if host is not None and hasattr(self.ep, "addr_map"):
+            self.ep.addr_map.setdefault(
+                rank, (self._mstr(host), int(d.get("port", 0)))
+            )
+        if mop == "attach":
+            home = int(d["home"])
+            self.world.add_app(rank, home, epoch)
+            if home == self.rank:
+                self.local_apps.add(rank)
+                self._m_attached.inc()  # once fleet-wide: home counts
+            self.flight.record(
+                f"member_attach rank={rank} home={home} epoch={epoch}"
+            )
+        elif mop == "detach":
+            self._apply_detach(rank, epoch)
+        elif mop == "server_join":
+            self.world.add_server(rank, epoch)
+            self.peers.setdefault(rank, _PeerState())
+            if self.is_master:
+                self._m_servers_joined.inc()
+            self.flight.record(
+                f"member_server_join rank={rank} epoch={epoch}"
+            )
+        elif mop == "server_live":
+            self._member_live.add(rank)
+            self.world.note_epoch(epoch)
+            # ring membership changed: if the live walk now puts the new
+            # shard right after us, re-target the replication stream at
+            # it (full-state bootstrap — its mirror starts empty)
+            if self.cfg.on_server_failure == "failover":
+                if not self._failover and self.world.nservers > 1:
+                    self._failover = True
+                nxt = self._ring_next_live()
+                if (
+                    self._failover
+                    and nxt != self.rank
+                    and (self.repl is None or self.repl.buddy != nxt)
+                ):
+                    self._rebootstrap_repl(nxt)
+            self.flight.record(
+                f"member_server_live rank={rank} epoch={epoch}"
+            )
+        elif mop == "server_drain":
+            self._draining_servers.add(rank)
+            self.world.note_epoch(epoch)
+            self.flight.record(
+                f"member_server_drain rank={rank} epoch={epoch}"
+            )
+            if rank == self.rank:
+                self._begin_drain()
+        # every membership change is activity: an in-flight exhaustion
+        # vote must not conclude across it (the epoch stamp catches the
+        # ring; this catches the master's own held vote)
+        self.activity += 1
+        self._exhaust_held_since = None
+        self._g_epoch.set(self.world.epoch)
+
+    def _apply_detach(self, rank: int, epoch: int) -> None:
+        """A clean lease-draining rank-dead: the rank leaves membership
+        and termination counting WITHOUT the death bookkeeping (no
+        rank_dead count, no attempt bumps, no quarantine pressure).
+        Journeys its departure touches carry a ``drain`` hop, so churn
+        is visible in /trace/tails."""
+        if rank in self.world.detached:
+            return
+        was_local = rank in self.local_apps
+        self.world.remove_app(rank, epoch)
+        if was_local:
+            self._m_detached.inc()  # once fleet-wide: home counts
+        # parked/steal state — same sweep as the death path
+        self.rq.remove_rank(rank)
+        self._stream_idle.discard(rank)
+        self._swept_streams.discard(rank)
+        self._rfr_out.discard(rank)
+        self._rfr_excluded.pop(rank, None)
+        self._park_res_local.pop(rank, None)
+        self._seen_rqseqnos.pop(rank, None)
+        self._last_heard.pop(rank, None)
+        self._rank_job.pop(rank, None)
+        # leases: drain cleanly — unpin and re-enqueue WITHOUT an
+        # attempt bump (leaving is not a delivery failure)
+        reclaimed = 0
+        for lease in self.leases.owned_by(rank):
+            self.leases.release(lease.seqno)
+            unit = self.wq.get(lease.seqno)
+            if unit is None or not unit.pinned or unit.pin_rank != rank:
+                continue
+            if self._relay_inflight.get(lease.seqno) == rank:
+                # fused relay in flight: the payload may already be at
+                # the leaver — at-most-once wins (delivered-at-detach)
+                self._relay_inflight.pop(lease.seqno, None)
+                self.journeys.forget(unit)
+                self._consume(unit)
+                continue
+            self.wq.unpin(lease.seqno)
+            if self.wlog is not None:
+                self.wlog.log_unpin(lease.seqno)
+            if unit.spans is not None:
+                self.journeys.stamp(unit, "drain")
+            if unit.common_seqno >= 0:
+                self._forfeit_common(
+                    unit.common_seqno, unit.common_server_rank,
+                    op="credit",
+                )
+            reclaimed += 1
+        if reclaimed:
+            self._m_leases_reclaimed.inc(reclaimed)
+        # targeted units for the leaver can never be fetched: drop them
+        # (refcount-correct), closing their journeys through the drain
+        doomed = [u for u in self.wq.units() if u.target_rank == rank]
+        for u in doomed:
+            self.wq.remove(u.seqno)
+            self.leases.release(u.seqno)
+            self._spill_drop(u)
+            self.mem.free(len(u.payload))
+            if u.spans is not None:
+                self.journeys.stamp(u, "drain")
+                self.journeys.close(u, "dropped")
+            if self.wlog is not None:
+                self.wlog.log_remove(u.seqno)
+            self._forfeit_common(u.common_seqno, u.common_server_rank)
+        self.tq.drop_rank(rank)
+        if was_local:
+            self.local_apps.discard(rank)
+            self._finalized.discard(rank)
+        if self.is_master and self.cfg.balancer == "tpu":
+            self._patch_snapshots_for_dead(rank)
+        if reclaimed:
+            self._match_rq()
+        self.flight.record(
+            f"member_detach rank={rank} epoch={epoch} "
+            f"reclaimed={reclaimed} targeted_dropped={len(doomed)}"
+        )
+        # the leaver no longer gates END: its home may be complete now
+        self._maybe_complete_finalize()
+
+    def _member_on_ready(self, new: int) -> None:
+        """Master: a scale-out shard's reactor is up. Publish it live
+        (everyone adds it to rings/buddy walks), sync it to the freshest
+        membership, and direct a donor rebalance at it."""
+        if not self.is_master or new in self._member_ready:
+            return
+        self._member_ready.add(new)
+        self._member_live.add(new)
+        epoch = self.world.epoch + 1
+        self.world.note_epoch(epoch)
+        # fresh membership + learned addresses for the late arrival —
+        # and the job table AGAIN: it was seeded at FA_MEMBER time, and
+        # any /jobs submit/drain/kill during the spawn window fanned out
+        # to _live_servers(), which excluded the not-yet-ready shard
+        try:
+            self.ep.send(
+                new, msg(Tag.SS_MEMBER, self.rank, mop="sync",
+                         member=self.world.snapshot(),
+                         addrs=dict(self._member_addrs),
+                         jobs=self._member_jobs_seed()),
+            )
+        except OSError:
+            self._note_server_unreachable(new)
+            return
+        for s in self._live_servers():
+            try:
+                self.ep.send(
+                    s, msg(Tag.SS_MEMBER, self.rank, mop="server_live",
+                           rank=new, epoch=epoch),
+                )
+            except OSError:
+                pass
+        self._apply_member(dict(mop="server_live", rank=new, epoch=epoch))
+        # donor: the most loaded live shard sheds backlog to the new one
+        cands = [
+            s for s in self.world.server_ranks
+            if s != new and s not in self._dead_servers
+            and s not in self._draining_servers and self._is_live_member(s)
+        ]
+        def load(s):
+            if s == self.rank:
+                return self.mem.curr
+            p = self.peers.get(s)
+            return p.nbytes if p is not None else 0
+        donor = max(cands, key=load) if cands else self.rank
+        if self._scaleout_t0 is not None:
+            mttr = (time.monotonic() - self._scaleout_t0) * 1e3
+            self._g_scaleout_mttr.set(mttr)
+            self._scaleout_t0 = None
+            self.flight.record(
+                f"scaleout_ready rank={new} donor={donor} "
+                f"mttr_ms={mttr:.1f}"
+            )
+        if donor == self.rank:
+            self._member_rebalance(new)
+        else:
+            try:
+                self.ep.send(
+                    donor, msg(Tag.SS_MEMBER, self.rank, mop="rebalance",
+                               dest=new),
+                )
+            except OSError:
+                self._note_server_unreachable(donor)
+
+    def _member_rebalance(self, dest: int) -> None:
+        """Donor side of scale-out bootstrap: ship a fair share of the
+        unpinned untargeted backlog to the new shard over the ACKED
+        migration plane (serialized-unit wire format; a dest death
+        mid-transit hands the units back via _migrate_pending), so
+        every put acked before the scale-out stays fetchable after it.
+        Shipped journeys gain an ``attach`` hop — scale-out churn is
+        visible in /trace/tails."""
+        if dest in self._dead_servers or self.done:
+            return
+        pool = [
+            u for u in self.wq.units()
+            if not u.pinned and u.target_rank < 0 and u.job == 0
+        ]
+        n_live = max(
+            len([
+                s for s in self.world.server_ranks
+                if s not in self._dead_servers and self._is_live_member(s)
+            ]),
+            2,
+        )
+        take = len(pool) // n_live
+        if take <= 0:
+            return
+        pool.sort(key=lambda u: u.time_stamp)  # coldest first
+        units = []
+        for unit in pool[:take]:
+            self._unspill(unit)
+            self.wq.remove(unit.seqno)
+            self.mem.free(len(unit.payload))
+            if self.wlog is not None:
+                self.wlog.log_remove(unit.seqno)
+            if unit.spans is not None:
+                self.journeys.stamp(unit, "attach")
+            shipped = {
+                "payload": unit.payload,
+                "work_type": unit.work_type,
+                "prio": unit.prio,
+                "answer_rank": unit.answer_rank,
+                "home_server": unit.home_server,
+                "common_len": unit.common_len,
+                "common_server": unit.common_server_rank,
+                "common_seqno": unit.common_seqno,
+                "time_stamp": unit.time_stamp,
+                "attempts": unit.attempts,
+            }
+            tf = trace_fields(unit)
+            if tf is not None:
+                shipped["trace"] = tf
+                self.journeys.forget(unit)
+            units.append(shipped)
+        self.activity += 1
+        self._exhaust_held_since = None
+        self.flight.record(
+            f"scaleout_rebalance dest={dest} shipped={len(units)} "
+            f"of={len(pool)}"
+        )
+        self._send_migrate_batch(dest, units, bounced=False)
+
+    def _begin_drain(self) -> None:
+        """This server is being scaled IN. Two phases: mark draining —
+        from here no NEW custody is accepted (push queries refuse,
+        peers' target pickers skip us) — then, once the custody already
+        accepted settles (in-flight SS_PUSH_WORK payloads land),
+        :meth:`_maybe_finish_drain` flushes a FULL-state replication
+        bootstrap to the buddy, announces drain_done behind the stream
+        tail, and exits. The buddy promotes a complete mirror — zero
+        counted losses by construction."""
+        if self._draining_self or self.done:
+            return
+        from adlb_tpu.runtime import replica
+
+        buddy = replica.buddy_of(
+            self.world, self.rank, self._buddy_excluded()
+        )
+        if buddy == self.rank:
+            self.flight.record("drain refused: no live buddy")
+            return
+        self._draining_self = True
+        # bounded: a pusher that died between QUERY_RESP and WORK would
+        # otherwise park this drain on a reservation that never lands
+        self._drain_deadline = time.monotonic() + 5.0
+        self._maybe_finish_drain()
+
+    def _maybe_finish_drain(self) -> None:
+        if not self._draining_self or self.done:
+            return
+        if self._push_reserved and time.monotonic() < self._drain_deadline:
+            return  # accepted pushes still in flight toward us
+        from adlb_tpu.runtime import replica
+
+        buddy = replica.buddy_of(
+            self.world, self.rank, self._buddy_excluded()
+        )
+        if self.spill is not None:
+            self._spill_fault_in_all()
+        for u in self.wq.units():
+            if u.spans is not None:
+                self.journeys.stamp(u, "drain")
+        self._failover = True  # the promote plane is the drain plane
+        self._rebootstrap_repl(buddy)
+        self._flush_repl()
+        note_epoch = self.world.epoch + 1
+        for s in self._live_servers():
+            try:
+                self.ep.send(
+                    s, msg(Tag.SS_MEMBER, self.rank, mop="drain_done",
+                           rank=self.rank, epoch=note_epoch),
+                )
+            except OSError:
+                pass
+        self.flight.record(f"drained to buddy {buddy}; exiting")
+        self._drained_exit = True
+        self.done = True
+
+    def _maybe_autoscale(self, now: float) -> None:
+        """Master, Config(elastic_scaleout='auto'): when any live server
+        crosses the soft memory watermark, add a shard BEFORE the spill
+        tier or backpressure engage."""
+        if (
+            self._scaleout_t0 is not None
+            or self._scale_pending is not None
+            or self._member_terminating()
+            or now < self._elastic_cooldown_until
+        ):
+            return
+        soft = self.cfg.max_malloc_per_server * self.cfg.mem_soft_frac
+        hot = self.rank if self.mem.curr >= soft else None
+        if hot is None:
+            for s, p in self.peers.items():
+                if (
+                    s != self.rank
+                    and s not in self._dead_servers
+                    and p.nbytes >= soft
+                ):
+                    hot = s
+                    break
+        if hot is None:
+            return
+        self._elastic_cooldown_until = now + self.cfg.elastic_cooldown_s
+        self._request_scale_out("mem_watermark", hot_rank=hot)
+
+    def _request_scale_out(self, reason: str,
+                           hot_rank: Optional[int] = None) -> dict:
+        self.flight.record(
+            f"scale_out_requested reason={reason} hot={hot_rank}"
+        )
+        if self.member_spawner is None:
+            # no spawner registered: park the request, visible at /fleet
+            # (the future autoscaler's feed)
+            self._scale_pending = {
+                "reason": reason, "hot_rank": hot_rank,
+                "at": time.time(),
+            }
+            return {"requested": False, "pending": True}
+        self._scaleout_t0 = time.monotonic()
+        try:
+            self.member_spawner({"kind": "server", "reason": reason})
+        except Exception as e:  # noqa: BLE001 — a broken spawner must
+            # not crash the reactor
+            self._scaleout_t0 = None
+            self._scale_pending = {
+                "reason": reason, "error": repr(e), "at": time.time(),
+            }
+            return {"requested": False, "pending": True,
+                    "error": repr(e)}
+        return {"requested": True}
+
+    def fleet_doc(self) -> dict:
+        """GET /fleet: the live topology + per-rank epoch/state view
+        (read by the ops HTTP thread — copies, no mutation). Membership
+        containers are snapshotted with the registry's retry discipline
+        first: the reactor inserts into extra_apps/detached during an
+        attach, and iterating them live would raise RuntimeError exactly
+        when /fleet matters most — mid-churn."""
+        w = self.world
+
+        def stable(container, ctor):
+            for _ in range(8):
+                try:
+                    return ctor(container)
+                except RuntimeError:
+                    continue
+            return ctor(())
+
+        extra_apps = stable(w.extra_apps, dict)
+        detached = stable(w.detached, set)
+        servers = []
+        for s in list(w.server_ranks):
+            if s in self._drained_servers:
+                state = "drained"
+            elif s in self._dead_servers:
+                state = "dead"
+            elif s in self._draining_servers:
+                state = "draining"
+            elif self._is_live_member(s):
+                state = "live"
+            else:
+                state = "joining"
+            servers.append({
+                "rank": s,
+                "state": state,
+                "master": s == w.master_server_rank,
+                "extra": s not in w.spec.server_ranks,
+            })
+        apps = []
+        ranks = [r for r in w.spec.app_ranks if r not in detached]
+        ranks += [r for r in extra_apps if r not in detached]
+        for r in ranks:
+            if r in extra_apps:
+                home = extra_apps[r]
+            else:
+                home = w.home_server(r)
+            if r in self._dead_ranks:
+                state = "dead"
+            elif r in self._finalized:
+                state = "finalized"
+            else:
+                state = "live"
+            apps.append({
+                "rank": r,
+                "home": home,
+                "state": state,
+                "attached": r >= w.spec.num_app_ranks,
+            })
+        return {
+            "epoch": w.epoch,
+            "nservers_live": sum(
+                1 for s in servers if s["state"] == "live"
+            ),
+            "servers": servers,
+            "apps": apps,
+            "detached": sorted(detached),
+            "scale_pending": self._scale_pending,
+        }
 
     # ------------------------------------------------- worker-death reclaim
     # No reference analogue (upstream: any rank failure kills the job,
@@ -4975,11 +6026,14 @@ class Server:
         return [
             s for s in self.world.server_ranks
             if s != self.rank and s not in self._dead_servers
+            and self._is_live_member(s)
         ]
 
     def _ring_next_live(self) -> int:
         nxt = self.world.ring_next(self.rank)
-        while nxt != self.rank and nxt in self._dead_servers:
+        while nxt != self.rank and (
+            nxt in self._dead_servers or not self._is_live_member(nxt)
+        ):
             nxt = self.world.ring_next(nxt)
         return nxt
 
@@ -5031,6 +6085,11 @@ class Server:
     def _note_server_unreachable(self, srv: int) -> None:
         """A send to a supposedly-live server failed: treat it as death
         evidence (the EOF may simply not have reached us yet)."""
+        if self.world.is_server(srv) and not self._is_live_member(srv):
+            # a joined-but-never-live scale-out shard: its absence must
+            # not abort the world it never served
+            self.flight.record(f"joining server {srv} unreachable")
+            return
         plan = getattr(self.ep, "plan", None)
         if plan is not None and getattr(plan, "disconnected", False):
             # OUR endpoint is the dead one (fault-injected server death):
@@ -5140,7 +6199,7 @@ class Server:
         )
 
     def _on_repl(self, m: Msg) -> None:
-        if not self._failover:
+        if not self._failover and m.src not in self._draining_servers:
             return  # a misconfigured peer's stream is ignorable
         from adlb_tpu.runtime import replica
 
@@ -5160,7 +6219,9 @@ class Server:
             return False
         from adlb_tpu.runtime import replica
 
-        return replica.buddy_of(self.world, dead, self._dead_servers) != dead
+        return replica.buddy_of(
+            self.world, dead, self._buddy_excluded()
+        ) != dead
 
     def _on_server_eof(self, src: int) -> None:
         """A server peer's connection closed mid-run (before this server
@@ -5202,7 +6263,7 @@ class Server:
     def _declare_server_dead(self, dead: int) -> None:
         if dead in self._dead_servers or self.done:
             return
-        epoch = self._fo_epoch + 1
+        epoch = self.world.epoch + 1
         for s in self._live_servers():
             if s == dead:
                 continue
@@ -5223,7 +6284,11 @@ class Server:
             return
         from adlb_tpu.runtime import replica
 
-        if not self._can_failover(dead):
+        # clean retire (elastic scale-in drain_done): the shard was
+        # fully shipped to the buddy BEFORE this frame, so the promote
+        # counts no losses and the death-vs-drain metrics split
+        clean = bool(m.data.get("clean")) or dead in self._clean_retire
+        if not clean and not self._can_failover(dead):
             # master death, or no live buddy left: unrecoverable
             aprintf(
                 True, self.rank,
@@ -5235,13 +6300,42 @@ class Server:
             return
         self._dead_servers.add(dead)
         self._suspect_servers.pop(dead, None)
-        self._fo_epoch = max(self._fo_epoch, m.data.get("epoch", 0) or 0)
-        buddy = replica.buddy_of(self.world, dead, self._dead_servers)
+        self._draining_servers.discard(dead)
+        if clean:
+            self._clean_retire.add(dead)
+            self._drained_servers.add(dead)
+        self.world.note_epoch(m.data.get("epoch", 0) or 0)
+        self._g_epoch.set(self.world.epoch)
+        buddy = replica.buddy_of(self.world, dead, self._buddy_excluded())
         self._srv_route[dead] = buddy
-        self._m_server_dead.inc()
+        if clean:
+            self._m_servers_drained.inc()
+        else:
+            self._m_server_dead.inc()
+        # a retired server can never ack a membership fan-out: release
+        # any barrier waiting on it
+        for tok in [
+            t for t, p in self._member_pending.items()
+            if dead in p["need"]
+        ]:
+            p = self._member_pending[tok]
+            p["need"].discard(dead)
+            if not p["need"]:
+                del self._member_pending[tok]
+                self._member_reply(p)
+        # master: the retired shard's obs-gossip snapshots must not
+        # report stale forever on /healthz (/fleet keeps the topology
+        # history; the staleness ledger is for LIVE members)
+        if self.is_master:
+            self._fleet_seen.pop(dead, None)
+            self._fleet_snaps.pop(dead, None)
+            self._prof_fleet.pop(dead, None)
+            self._prof_windows.pop(dead, None)
+            self._member_ready.discard(dead)
         self.flight.record(
-            f"server_dead rank={dead} declared_by={m.src} buddy={buddy} "
-            f"epoch={self._fo_epoch}"
+            f"server_{'drained' if clean else 'dead'} rank={dead} "
+            f"declared_by={m.src} buddy={buddy} "
+            f"epoch={self.world.epoch}"
         )
         # 1) gossip/steal state: forget the dead peer, repoint targeted
         # directory entries at its buddy, release RFR/push state that
@@ -5274,7 +6368,7 @@ class Server:
         # buddy, re-bootstrap toward the next live successor
         if self.repl is not None and self.repl.buddy == dead:
             self._rebootstrap_repl(
-                replica.buddy_of(self.world, self.rank, self._dead_servers)
+                replica.buddy_of(self.world, self.rank, self._buddy_excluded())
             )
         # 4) master: retire the dead server's snapshot so plans stop
         # naming it, and re-kick a possibly-lost END_1 token
@@ -5289,7 +6383,9 @@ class Server:
                 self._finalized >= self.local_apps
             ):
                 self._end1_pending = True
-                self._forward_end1({"origin": self.rank})
+                self._forward_end1(
+                    {"origin": self.rank, "epoch": self.world.epoch}
+                )
         # the topology change is activity: an exhaustion vote must not
         # conclude across it
         self.activity += 1
@@ -5414,17 +6510,26 @@ class Server:
         ranks."""
         if self.done:
             return
+        clean = dead in self._clean_retire
         mirror = self.mirrors.pop(dead, None)
         if mirror is None:
-            # double failure: the shard died with its buddy before any
-            # replication frame reached us — unrecoverable
-            aprintf(
-                True, self.rank,
-                f"server rank {dead} died but no replica of its shard "
-                f"exists here (buddy died before promotion?); aborting",
-            )
-            self._do_abort(-3, broadcast=True)
-            return
+            if clean:
+                # a drained server with nothing to ship (it flushed an
+                # EMPTY full-state bootstrap): promote a blank mirror
+                from adlb_tpu.runtime import replica
+
+                mirror = replica.ReplicaMirror(dead)
+            else:
+                # double failure: the shard died with its buddy before
+                # any replication frame reached us — unrecoverable
+                aprintf(
+                    True, self.rank,
+                    f"server rank {dead} died but no replica of its "
+                    f"shard exists here (buddy died before promotion?); "
+                    f"aborting",
+                )
+                self._do_abort(-3, broadcast=True)
+                return
         mirror.seal()
         t0 = self._server_eof_at.get(dead, time.monotonic())
         # 1) batch-common prefixes first (units reference them)
@@ -5499,9 +6604,11 @@ class Server:
             self.mem.alloc(len(unit.payload))
             if f.get("trace_id"):
                 # the journey survives the takeover with an "adopt" hop
-                # (and rides our own wlog onward via log_put below)
+                # (and rides our own wlog onward via log_put below);
+                # clean drains stamp "drain" instead, so scale-in churn
+                # is visible in /trace/tails
                 self.journeys.adopt(unit, f["trace_id"], f.get("spans"),
-                                    stage="adopt")
+                                    stage="drain" if clean else "adopt")
             self.wq.add(unit)
             if pin_rank >= 0:
                 self.leases.grant(unit.seqno, pin_rank)
@@ -5570,9 +6677,14 @@ class Server:
         # adopted ranks' streams may hold phantom slots (reserves parked
         # at the dead server): their next idle note re-arms them
         self._swept_streams |= newly
-        self._m_failover_promoted.inc()
         mttr_ms = (time.monotonic() - t0) * 1e3
-        self._g_fo_mttr.set(mttr_ms)
+        if not clean:
+            # a drain is not a failover: the promote machinery is shared
+            # but the death metrics (and their acceptance oracles —
+            # "zero failover_lost, zero failovers on a clean scale-in")
+            # stay death-only
+            self._m_failover_promoted.inc()
+            self._g_fo_mttr.set(mttr_ms)
         self.activity += 1
         self._exhaust_held_since = None
         self.flight.record(
@@ -5590,7 +6702,7 @@ class Server:
         # 6) epoch-stamped remap: every live app learns the new home /
         # routing (finished apps' listeners may be gone — best-effort,
         # short connect grace)
-        note = dict(dead=dead, epoch=self._fo_epoch)
+        note = dict(dead=dead, epoch=self.world.epoch)
         for r in self.world.app_ranks:
             if r in self._dead_ranks:
                 continue

@@ -54,6 +54,13 @@ class InProcEndpoint:
     def submit_flush(self) -> None:
         pass
 
+    def close(self) -> None:
+        """Dynamically attached ranks (elastic membership) close their
+        endpoint on exit, exactly like a TCP joiner: the fabric forgets
+        the inbox, so a late frame toward this rank raises OSError at
+        the sender — the in-proc analogue of connection refused."""
+        self._fabric.remove_endpoint(self)
+
     def send(self, dest: int, m: Msg, connect_grace: float = 0.0) -> None:
         # connect_grace is a TCP-endpoint knob; accepted (and ignored)
         # here so role code can pass it transport-agnostically
@@ -73,7 +80,14 @@ class InProcEndpoint:
                 )
             st[0].inc()
             st[1].inc(nbytes)
-        self._fabric.endpoints[dest].inbox.put(m)
+        try:
+            peer = self._fabric.endpoints[dest]
+        except KeyError:
+            # elastic membership: no endpoint (yet/anymore) for this
+            # rank — surface it like TCP's connection refused, which
+            # every sender path already tolerates
+            raise OSError(f"no endpoint for rank {dest}") from None
+        peer.inbox.put(m)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Msg]:
         try:
@@ -98,12 +112,34 @@ class InProcEndpoint:
 
 
 class InProcFabric:
-    """All ranks in one process; message passing via thread-safe queues."""
+    """All ranks in one process; message passing via thread-safe queues.
+
+    Endpoints live in a dict so elastic membership can add ranks to a
+    RUNNING fabric (attach/scale-out); a send to a rank with no endpoint
+    raises OSError, the in-proc analogue of connection refused."""
 
     def __init__(self, nranks: int) -> None:
         self.nranks = nranks
-        self.endpoints = [InProcEndpoint(self, r) for r in range(nranks)]
+        self.endpoints: dict[int, InProcEndpoint] = {
+            r: InProcEndpoint(self, r) for r in range(nranks)
+        }
         self.abort_event = threading.Event()
 
     def endpoint(self, rank: int) -> InProcEndpoint:
         return self.endpoints[rank]
+
+    def add_endpoint(self, rank: int) -> InProcEndpoint:
+        """Elastic membership: an inbox for a newly attached rank (dict
+        assignment is atomic under the GIL, so concurrent senders see
+        either no endpoint — OSError, retried — or the live one)."""
+        ep = InProcEndpoint(self, rank)
+        self.endpoints[rank] = ep
+        return ep
+
+    def remove_endpoint(self, ep: InProcEndpoint) -> None:
+        """The in-proc analogue of closing a TCP listener: subsequent
+        sends toward the rank raise OSError (connection refused). Only
+        dynamically attached ranks close their endpoints; base ranks
+        live for the world."""
+        if self.endpoints.get(ep.rank) is ep:
+            del self.endpoints[ep.rank]

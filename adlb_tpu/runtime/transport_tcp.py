@@ -67,6 +67,7 @@ class TcpEndpoint:
         binary_peers: Optional[set[int]] = None,
         mux: Optional[tuple[str, int]] = None,
         compress_min: int = 0,
+        mux_ranks: Optional[int] = None,
     ) -> None:
         self.rank = rank
         self.addr_map = dict(addr_map)
@@ -102,6 +103,12 @@ class TcpEndpoint:
         # no envelope support) keep direct per-pair connections, which
         # is also why the listener below stays up under the mux.
         self._mux = None
+        # elastic membership: brokers are wired for the STATIC world at
+        # launch (rank -> host routes from the rendezvous), so only
+        # dests BELOW this bound ride the mux — dynamically attached
+        # ranks (ids above the base world) keep per-pair sockets both
+        # ways. None = every python peer rides the broker.
+        self._mux_ranks = mux_ranks
         self._compress_min = int(compress_min)
         self._submit = _SubmitBatch()
         self._g_ch = None       # tcp_channels_open gauge, cached
@@ -281,8 +288,11 @@ class TcpEndpoint:
         # peers (binary TLV, no envelope support) and self keep direct
         # per-pair sockets
         mux = self._mux
-        if mux is not None and (dest == self.rank
-                                or dest in self.binary_peers):
+        if mux is not None and (
+            dest == self.rank
+            or dest in self.binary_peers
+            or (self._mux_ranks is not None and dest >= self._mux_ranks)
+        ):
             mux = None
         if mux is not None and dest in mux.dead:
             # sends to a dead peer must fail like a refused reconnect,
@@ -693,7 +703,8 @@ def _child_main(rank, world, cfg, app_fn, port_q, conn, result_q, abort_event,
     )
     ep = TcpEndpoint(rank, {rank: ("127.0.0.1", 0)},
                      binary_peers=binary_peers, mux=mux_addr,
-                     compress_min=cfg.compress_min_bytes)
+                     compress_min=cfg.compress_min_bytes,
+                     mux_ranks=world.nranks)
     if shm_key:
         # same-host ranks upgrade to the shared-memory ring fabric; the
         # fault shim stacks OUTSIDE it, so injected faults apply to ring

@@ -388,6 +388,18 @@ class Config:
     # plane (zlib level 1, flag bit 0 of the envelope header; the
     # receiver inflates before frame decode). 0 = off.
     compress_min_bytes: int = 0
+    # elastic scale-out trigger (adlb_tpu/runtime/membership.py):
+    # "auto" lets the MASTER request a new server shard when any live
+    # server crosses the soft memory watermark — capacity is added
+    # BEFORE the spill tier or ADLB_BACKOFF backpressure engage (needs
+    # max_malloc_per_server > 0 and a registered member spawner; without
+    # a spawner the request parks, visible at /fleet, feeding the
+    # future autoscaler). "off" = manual scale only (ops POST
+    # /fleet/scale or the harness verbs). Attach/detach and manual
+    # scaling are always available on python servers regardless.
+    elastic_scaleout: str = "off"
+    # cooldown between watermark-triggered scale-out requests
+    elastic_cooldown_s: float = 10.0
     # server reactor implementation (spawn_world / TCP worlds only):
     # "python" runs adlb_tpu.runtime.server.Server per server rank; "native"
     # runs the C++ daemon (adlb_tpu/native/serverd.cpp) — the reference's
@@ -410,6 +422,17 @@ class Config:
             raise ValueError(f"unknown host_ledger {self.host_ledger!r}")
         if self.server_impl not in ("python", "native"):
             raise ValueError(f"unknown server_impl {self.server_impl!r}")
+        if self.elastic_scaleout not in ("off", "auto"):
+            raise ValueError(
+                f"unknown elastic_scaleout {self.elastic_scaleout!r}"
+            )
+        if self.elastic_scaleout == "auto" and self.server_impl == "native":
+            # the C++ daemon keeps the reference's fixed-at-init world
+            raise ValueError(
+                "elastic_scaleout='auto' requires server_impl='python'"
+            )
+        if self.elastic_cooldown_s < 0:
+            raise ValueError("elastic_cooldown_s must be >= 0")
         if self.qmstat_mode not in ("broadcast", "ring"):
             raise ValueError(f"unknown qmstat_mode {self.qmstat_mode!r}")
         if self.fabric not in ("auto", "shm", "tcp"):

@@ -1571,6 +1571,91 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         tail_rows = {"tail_profile_overhead_error": repr(e)[:200]}
 
+    # elastic membership (round 11, ISSUE 15): attach latency — the
+    # rank-allocation + fleet-wide fan-out/ack barrier a joining rank
+    # pays before its first protocol frame can land anywhere — and
+    # scale-out MTTR (scale request -> new shard spawned, bootstrapped
+    # by the donor rebalance, and counted ready by the master; the
+    # master's own scaleout_mttr_ms gauge, so the row measures the
+    # protocol, not the harness). Absolute one-shot latencies, so no
+    # on/off CPU pairing applies — per the bench-box noise policy the
+    # estimator is the median over reps (3 worlds x 3 attaches, one
+    # scale-out each; single draws on the 1-core box are not
+    # certifiable) and the rows are guarded baseline-relative
+    # (bench_guard "member" row, missing-row = fail). Own containment.
+    def membership_bench():
+        import struct as _struct
+        import threading as _th
+
+        from adlb_tpu.runtime.membership import ElasticWorld
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        attach_reps, detach_reps, mttr_reps, wall_reps = [], [], [], []
+        for _ in range(3):
+            ew = ElasticWorld(
+                2, 2, [1],
+                cfg=Config(exhaust_check_interval=0.2), timeout=120.0,
+            )
+            hold = _th.Event()
+
+            def consume(ctx):
+                n = 0
+                while True:
+                    rc, _w = ctx.get_work([1])
+                    if rc != _OK:
+                        return n
+                    n += 1
+
+            def producer(ctx, hold=hold, consume=consume):
+                # a standing backlog so the scale-out's donor rebalance
+                # ships real units, like a production trigger would
+                for i in range(48):
+                    assert ctx.put(
+                        _struct.pack("<q", i) + b"\0" * 56, 1
+                    ) == _OK
+                hold.wait(90)
+                return consume(ctx)
+
+            def holder(ctx, hold=hold, consume=consume):
+                hold.wait(90)
+                return consume(ctx)
+
+            ew.run_app(0, producer)
+            ew.run_app(1, holder)
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jw = ew.attach_ctx()
+                attach_reps.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                assert jw.ctx.detach_world() == _OK
+                detach_reps.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            ew.scale_out()
+            wall_reps.append((time.perf_counter() - t0) * 1e3)
+            mttr = ew.master.metrics.value("scaleout_mttr_ms")
+            mttr_reps.append(mttr if mttr > 0 else wall_reps[-1])
+            hold.set()
+            res = ew.finish(timeout=120)
+            got = sum(v for v in res.values() if isinstance(v, int))
+            assert got == 48, f"membership bench lost work ({got}/48)"
+        return {
+            "attach_ms": round(med(attach_reps), 2),
+            "detach_ms": round(med(detach_reps), 2),
+            "scaleout_mttr_ms": round(med(mttr_reps), 1),
+            "scaleout_wall_ms": round(med(wall_reps), 1),
+            "attach_ms_reps": [round(x, 2) for x in attach_reps],
+            "scaleout_mttr_ms_reps": [round(x, 1) for x in mttr_reps],
+        }
+
+    try:
+        member_rows = membership_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        member_rows = {"membership_error": repr(e)[:200]}
+
     # measurement provenance (the r07 caveat made policy): every record
     # carries the core count + load so cross-round comparisons can tell
     # a real regression from a different (or busy) box — bench_guard
@@ -1700,6 +1785,7 @@ def main() -> None:
             **engine_rows,
             **trace_rows,
             **tail_rows,
+            **member_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -1872,6 +1958,12 @@ def main() -> None:
                 "trace_tail_overhead_ratio"),
             "profile_overhead_ratio": tail_rows.get(
                 "profile_overhead_ratio"),
+            # elastic membership (round 11): attach latency (allocation
+            # + fleet fan-out/ack barrier) and server scale-out MTTR
+            # (request -> shard bootstrapped + rebalanced + ready),
+            # medians over reps — bench_guard "member" row
+            "attach_ms": member_rows.get("attach_ms"),
+            "scaleout_mttr_ms": member_rows.get("scaleout_mttr_ms"),
             "mux_burst8": [mux_rows.get("mux_burst8_batched_ms"),
                            mux_rows.get("mux_burst8_sequential_ms")],
             "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),

@@ -104,6 +104,16 @@ GUARDS = {
         ("prof", "coinop_prof_p50_ms"),
         ("off", "coinop_tailprof_off_p50_ms"),
     ],
+    # elastic membership (r11 metrics; older baselines skip with a
+    # note): attach latency — rank allocation + the fleet-wide
+    # fan-out/ack barrier — and server scale-out MTTR (scale request ->
+    # shard spawned + donor-rebalanced + counted ready by the master).
+    # Once a baseline carries them, a record MISSING either row fails
+    # (the ISSUE 15 missing-row=fail arm).
+    "member": [
+        ("attach", "attach_ms"),
+        ("scaleout", "scaleout_mttr_ms"),
+    ],
 }
 
 # Absolute arms: self-contained bounds checked against the NEW record

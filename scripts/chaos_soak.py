@@ -10,9 +10,11 @@ the world (rank 0 knows the real addresses), a mid-run abort
 cleanly without hanging, `reclaim` must still produce the complete
 answer set), seeded fault-injection delays on every endpoint
 (adlb_tpu/runtime/faults.py — protocol-invisible, timing-hostile),
-or exhaustion vs explicit termination. Any wrong answer, hang
-(timeout), or unexpected exception stops the soak with the seed for
-replay.
+exhaustion vs explicit termination, or elastic-membership CHURN (ranks
+attaching and detaching mid-world plus a server scale-out under a put
+storm — exact coverage and zero counted losses asserted under both
+worker policies). Any wrong answer, hang (timeout), or unexpected
+exception stops the soak with the seed for replay.
 
 Usage: python scripts/chaos_soak.py [--fabric shm|tcp|auto] <minutes> [seed0]
 
@@ -355,6 +357,116 @@ def two_jobs_economy(n_units, poison=True):
     return app
 
 
+def churn_world(rng, apps, servers, mode, policy):
+    """Elastic-membership adversity (adlb_tpu/runtime/membership.py):
+    ranks JOIN and LEAVE mid-world and a server scales OUT under a put
+    storm (the memory-watermark autoscale path, with a manual kick as
+    the deterministic fallback), optionally scaling back IN through the
+    zero-loss drain. Runs on the in-proc ElasticWorld harness — the
+    member spawner lives in the master's process by construction.
+
+    Oracles, under BOTH worker policies: exact id coverage (every put
+    acked before the scale-out fetchable after it, the detacher's puts
+    included), zero counted losses (churn is clean — `failover_lost`
+    and `failover_promoted` both 0), and at least one shard actually
+    joined."""
+    from adlb_tpu.runtime.membership import ElasticWorld
+
+    # sized against the 16 KiB per-server cap below: round-robin spread
+    # puts ~10 KiB on each BASE server — over the 8 KiB soft watermark
+    # (the autoscale trigger), comfortably under the cap (the static
+    # producer cannot route to the new shard, so the storm must fit the
+    # base fleet; what the scale-out relieves is the standing backlog)
+    payload_len = 480
+    n_units = rng.randint(19, 22) * servers
+    cfg = Config(
+        balancer=mode,
+        exhaust_check_interval=0.2,
+        on_worker_failure=policy,
+        on_server_failure="failover",  # scale-in drains over promote
+        elastic_scaleout="auto",
+        elastic_cooldown_s=0.5,
+        max_malloc_per_server=16 * 1024,
+        mem_soft_frac=0.5,
+    )
+    ew = ElasticWorld(apps, servers, [1], cfg=cfg)
+    hold = threading.Event()   # churn done; unleash the consumers
+    stormed = threading.Event()  # every base put acked
+
+    def consume(ctx):
+        got = []
+        while True:
+            rc, w = ctx.get_work([1])
+            if rc != ADLB_SUCCESS:
+                return got
+            got.append(struct.unpack("<q", w.payload[:8])[0])
+
+    def producer(ctx):
+        for i in range(n_units):
+            assert ctx.put(
+                struct.pack("<q", i) + b"x" * (payload_len - 8), 1
+            ) == ADLB_SUCCESS
+        stormed.set()
+        hold.wait(90)
+        return consume(ctx)
+
+    def holder(ctx):
+        hold.wait(90)
+        return consume(ctx)
+
+    ew.run_app(0, producer)
+    for r in range(1, apps):
+        ew.run_app(r, holder)
+    assert stormed.wait(60), "put storm never finished"
+    # ranks JOIN mid-world ...
+    joined = [ew.attach_app(holder) for _ in range(rng.randint(1, 2))]
+    # ... and LEAVE: a joiner that puts its own ids then cleanly detaches
+    jw = ew.attach_ctx()
+    extra = list(range(1000, 1000 + rng.randint(2, 5)))
+    for i in extra:
+        assert jw.ctx.put(
+            struct.pack("<q", i) + b"y" * (payload_len - 8), 1
+        ) == ADLB_SUCCESS
+    assert jw.ctx.detach_world() == ADLB_SUCCESS
+    # server scale-OUT under the storm: the watermark autoscale should
+    # have tripped (0.5 * 16 KiB soft mark vs a ~20-45 KiB storm); kick
+    # manually if the timing missed it, so the oracle stays exact
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not ew.master._member_ready:
+        time.sleep(0.05)
+    if not ew.master._member_ready:
+        ew.scale_out()
+    new_shards = sorted(ew.master._member_ready)
+    # optionally scale back IN (needs >= 3 live servers)
+    drained = None
+    if rng.random() < 0.5 and servers + len(new_shards) >= 3:
+        drained = ew.scale_in()
+    hold.set()
+    results = ew.finish(timeout=120)
+    got = sorted(x for v in results.values() if v for x in v)
+    want = sorted(list(range(n_units)) + extra)
+    assert got == want, (
+        f"coverage broke under churn: missing={set(want) - set(got)} "
+        f"dup={[x for x in got if got.count(x) > 1][:5]}"
+    )
+    # churn is CLEAN: no counted losses, no failover promotions
+    for r, s in ew.servers.items():
+        if r == drained:
+            continue
+        assert s.metrics.value("failover_lost") == 0.0, r
+        assert s.metrics.value("failover_promoted") == 0.0, r
+    assert ew.master.metrics.value("servers_joined") >= 1.0
+    # counted once fleet-wide, at the detacher's home
+    assert sum(
+        s.metrics.value("ranks_detached") for s in ew.servers.values()
+    ) == 1.0
+    return dict(
+        workload="churn", apps=apps, servers=servers, mode=mode,
+        policy=policy, n_units=n_units, joined=len(joined) + 1,
+        shards=new_shards, drained=drained,
+    )
+
+
 def one_iter(seed, fabric=None):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
@@ -406,6 +518,19 @@ def one_iter(seed, fabric=None):
         and not do_skill and not do_stall and not do_poison
         and apps >= 5 and rng.random() < 0.4
     )
+    # elastic-membership churn (ISSUE 15): ranks joining/leaving
+    # mid-world + a server scale-out under a put storm, both worker
+    # policies; python servers only (the daemon keeps the fixed world)
+    do_churn = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and not do_stall and not do_poison
+        and not do_two_jobs and rng.random() < 0.35
+    )
+    if do_churn:
+        return churn_world(
+            rng, apps, servers, mode,
+            policy=rng.choice(["abort", "reclaim"]),
+        )
     g_policy = rng.choice(["abort", "reclaim"]) if (do_stall or do_poison) \
         else None
     # seeded delay faults: protocol-invisible, timing-hostile; applied to

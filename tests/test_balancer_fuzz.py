@@ -29,7 +29,12 @@ Oracles checked continuously:
    unless a snapshot with a newer task view was accepted after the prior
    plan (guards ledger-eviction regressions);
 3. **ack monotonicity** — per (src, dest) channel FIFO implies strictly
-   increasing mig_ids at the destination;
+   increasing mig_ids at the destination (the sim models BOTH FIFOs
+   reality provides: the src->dest unit channel AND the balancer->src
+   plan-command stream — without the latter, two batches the engine
+   legitimately has outstanding on one channel could enact in inverted
+   order under an adversarial due draw and fail this assertion
+   spuriously);
 4. **credit quiescence** — with the TTL and stamp/min-age fallbacks
    pinned OFF, once all transit drains and every server ships a full
    snapshot, a planning round must leave ``_planned_in`` EMPTY: exact
@@ -111,6 +116,7 @@ class CreditFuzzSim:
         self.unit_state = {}  # uid -> ("q", rank)|("transit", mid)|state str
         self.next_uid = 0
         self.msgs = []  # balancer->server plan commands
+        self.cmd_due = {}  # src -> last mig command due (stream FIFO)
         self.chan = {}  # (src, dest) -> FIFO of unit batches
         self.snap_q = {s: [] for s in range(nservers)}
         self.it = 0
@@ -282,8 +288,15 @@ class CreditFuzzSim:
                 seen.add(key)
                 self._check_replan(key, t_before)
                 self.last_plan[key] = t_before
+            # balancer->src is ONE connection: mig commands toward a src
+            # enact in plan order (so per-channel mids stay monotonic
+            # even with two batches outstanding on one channel — the
+            # engine plans that legitimately when a dest's demand grows)
+            due = max(self.it + rng.randrange(0, 6),
+                      self.cmd_due.get(src, -1))
+            self.cmd_due[src] = due
             self.msgs.append({
-                "due": self.it + rng.randrange(0, 6), "kind": "mig",
+                "due": due, "kind": "mig",
                 "src": src, "dest": dest, "uids": list(uids), "mid": mid,
             })
             self.stats["migs_planned"] += 1

@@ -119,3 +119,127 @@ def test_port_clash_check():
     _check_port_clash({0: ("h", 1), 1: ("h", 2), 2: ("h2", 1)})  # ok
     with pytest.raises(RuntimeError, match="duplicate addresses"):
         _check_port_clash({0: ("h", 1), 1: ("h", 2), 2: ("h", 1)})
+
+
+def test_two_launchers_mux_forced(tmp_path):
+    """The channel plane over the rendezvous launcher: ADLB_TCP_MUX=1 on
+    a pure-TCP fabric forces every python<->python frame through the
+    per-launcher brokers (one `broker.<host>.<pid>.addr` each, bridged
+    by the rank routes) — the world must complete identically."""
+    import glob
+
+    app_py = tmp_path / "app.py"
+    app_py.write_text(_APP)
+    rdv = str(tmp_path / "worldmux")
+    common = [
+        sys.executable, "-m", "adlb_tpu.runtime.launch",
+        "--rendezvous", rdv, "--nranks", "6", "--nservers", "2",
+        "--types", "1", "--fabric", "tcp", "--timeout", "60",
+    ]
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               ADLB_TCP_MUX="1")
+    pa = subprocess.Popen(
+        common + ["--ranks", "0,1,4", sys.executable, str(app_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    pb = subprocess.Popen(
+        common + ["--ranks", "2,3,5", sys.executable, str(app_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out_a, err_a = pa.communicate(timeout=120)
+    out_b, err_b = pb.communicate(timeout=120)
+    assert pa.returncode == 0, f"A rc={pa.returncode}\n{out_a}\n{err_a}"
+    assert pb.returncode == 0, f"B rc={pb.returncode}\n{out_b}\n{err_b}"
+    got = []
+    for out in (out_a, out_b):
+        for lst in re.findall(r"APP \d+ GOT (\[[^\]]*\])", out):
+            got.extend(eval(lst))
+    assert sorted(got) == list(range(40)), sorted(got)
+    # both launchers published their broker through the rendezvous
+    assert len(glob.glob(os.path.join(rdv, "broker.*.addr"))) == 2
+
+
+_ELASTIC_BASE = textwrap.dedent(
+    """
+    import os, struct, sys, time
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from adlb_tpu.api import join_world
+    from adlb_tpu.types import ADLB_SUCCESS
+
+    T = 1
+    sentinel = os.environ["TEST_SENTINEL"]
+    with join_world(types=[T]) as ctx:
+        if ctx.rank == 0:
+            for i in range(16):
+                ctx.put(struct.pack("<q", i), T)
+        # hold the world open (off the rq, so exhaustion cannot fire)
+        # until the ATTACHED rank has joined and contributed
+        deadline = time.monotonic() + 60
+        while not os.path.exists(sentinel):
+            assert time.monotonic() < deadline, "attach never happened"
+            time.sleep(0.05)
+        got = []
+        while True:
+            rc, w = ctx.get_work([T])
+            if rc != ADLB_SUCCESS:
+                break
+            got.append(struct.unpack("<q", w.payload)[0])
+        sys.stdout.write("APP {} GOT {!r}\\n".format(ctx.rank, sorted(got)))
+    """
+) % (_REPO,)
+
+_ELASTIC_JOINER = textwrap.dedent(
+    """
+    import os, struct, sys
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from adlb_tpu.api import join_world
+
+    T = 1
+    # ADLB_ATTACH=1 (set by launch.py --attach): join_world negotiates a
+    # fresh rank id from the running world's master
+    with join_world(types=[T]) as ctx:
+        assert ctx.rank >= 4, ctx.rank  # allocated ABOVE the base world
+        for i in range(100, 104):
+            ctx.put(struct.pack("<q", i), T)
+    open(os.environ["TEST_SENTINEL"], "w").write("joined")
+    """
+) % (_REPO,)
+
+
+def test_launcher_attach_grows_running_world(tmp_path):
+    """launch.py --attach: a second launcher invocation adds app ranks
+    to an ALREADY-RUNNING world — the joiner's puts are covered by the
+    base consumers, no restart anywhere."""
+    base_py = tmp_path / "base.py"
+    base_py.write_text(_ELASTIC_BASE)
+    joiner_py = tmp_path / "joiner.py"
+    joiner_py.write_text(_ELASTIC_JOINER)
+    rdv = str(tmp_path / "worldgrow")
+    sentinel = str(tmp_path / "joined.flag")
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               TEST_SENTINEL=sentinel)
+    world = subprocess.Popen(
+        [sys.executable, "-m", "adlb_tpu.runtime.launch",
+         "--rendezvous", rdv, "--nranks", "4", "--nservers", "2",
+         "--types", "1", "--ranks", "0-3", "--timeout", "60",
+         sys.executable, str(base_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    attach = subprocess.Popen(
+        [sys.executable, "-m", "adlb_tpu.runtime.launch",
+         "--rendezvous", rdv, "--nservers", "2", "--types", "1",
+         "--attach", "1", "--timeout", "60",
+         sys.executable, str(joiner_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out_j, err_j = attach.communicate(timeout=120)
+    assert attach.returncode == 0, f"attach rc={attach.returncode}\n{out_j}\n{err_j}"
+    out, err = world.communicate(timeout=120)
+    assert world.returncode == 0, f"world rc={world.returncode}\n{out}\n{err}"
+    got = []
+    for lst in re.findall(r"APP \d+ GOT (\[[^\]]*\])", out):
+        got.extend(eval(lst))
+    assert sorted(got) == sorted(list(range(16)) + [100, 101, 102, 103]), \
+        sorted(got)

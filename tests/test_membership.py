@@ -1,0 +1,565 @@
+"""Elastic membership (adlb_tpu/runtime/membership.py): ranks and
+servers that join and leave a RUNNING world.
+
+Coverage layers:
+
+* **MemberView** — duck-typed WorldSpec surface: behavior-identical
+  delegation with no dynamic members, attach/detach/server-join
+  mutation, snapshot/seed round-trip, the dynamic ring order.
+* **Attach/detach lifecycle** — a rank attached mid-run consumes real
+  work and its puts land in the coverage set; detach is a clean
+  lease-draining exit (counted once fleet-wide, idempotent on re-send,
+  finalize-after-detach a no-op).
+* **Epoch-based termination** — a join racing the exhaustion/END
+  machinery can never freeze the world or lose its work: the
+  membership epoch voids in-flight verdicts (stress-looped).
+* **Scale-out** — a new server shard bootstraps from a donor over the
+  acked migration plane: every put acked before the scale-out is
+  fetchable after it, byte-identically.
+* **Scale-in** — draining a server through the promote path counts
+  ZERO losses and ZERO failovers (the clean/dirty metrics split).
+* **Targeted-put redirection** — a static client's base-modulo route
+  toward an attached rank lands off-home and is redirected through the
+  TargetedDirectory announce plane.
+* **Watermark autoscale** — Config(elastic_scaleout="auto") requests a
+  shard when a server crosses the soft watermark.
+* **Churn observability** — units that crossed a scale-out rebalance /
+  a drain carry `attach`/`drain` journey hops, always promoted under
+  tail mode; /healthz drops a drained server from per-rank staleness.
+* **TCP acceptance** (slow) — a real multi-process world gains a rank
+  over TCP mid-run and serves /fleet.
+"""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from adlb_tpu.runtime.membership import (
+    ElasticWorld,
+    MemberView,
+    attach_app,
+    is_provisional,
+    provisional_rank,
+)
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_SUCCESS, AdlbError
+
+T = 1
+
+
+def _cfg(**kw):
+    kw.setdefault("exhaust_check_interval", 0.2)
+    return Config(**kw)
+
+
+def _consume(ctx, pace=0.002):
+    got = []
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got
+        got.append(w.payload)
+        if pace:
+            time.sleep(pace)
+    return got
+
+
+def _producer(n, base=0, consume=True):
+    def app(ctx):
+        for i in range(n):
+            ctx.put(struct.pack("<q", base + i), T)
+        return _consume(ctx) if consume else []
+    return app
+
+
+def _ids(results):
+    return sorted(
+        struct.unpack("<q", p)[0]
+        for v in results.values() if v for p in v
+    )
+
+
+# ------------------------------------------------------------ MemberView
+
+
+def test_member_view_static_identity():
+    spec = WorldSpec(nranks=7, nservers=3, types=(1, 2))
+    view = MemberView(spec)
+    assert view.nservers == spec.nservers
+    assert list(view.server_ranks) == list(spec.server_ranks)
+    assert list(view.app_ranks) == list(spec.app_ranks)
+    for r in range(7):
+        assert view.is_app(r) == spec.is_app(r)
+        assert view.is_server(r) == spec.is_server(r)
+    for r in spec.app_ranks:
+        assert view.home_server(r) == spec.home_server(r)
+    for s in spec.server_ranks:
+        assert view.local_apps(s) == spec.local_apps(s)
+        assert view.ring_next(s) == spec.ring_next(s)
+    # non-topology attributes delegate to the spec
+    assert view.types == spec.types
+    assert view.master_server_rank == spec.master_server_rank
+    # idempotent wrap
+    assert MemberView.of(view) is view
+
+
+def test_member_view_dynamic_mutation():
+    spec = WorldSpec(nranks=6, nservers=2, types=(1,))
+    view = MemberView(spec)
+    # attach: a new app rank above the base world
+    view.add_app(8, home=5, epoch=3)
+    assert view.is_app(8) and not view.is_server(8)
+    assert view.home_server(8) == 5
+    assert 8 in view.local_apps(5)
+    assert view.epoch == 3
+    # an attached rank the view has NOT learned raises (never the
+    # silent base-modulo misroute)
+    with pytest.raises(KeyError):
+        view.home_server(9)
+    # detach: leaves membership, stays remembered
+    view.remove_app(8, epoch=4)
+    assert not view.is_app(8)
+    assert 8 not in view.local_apps(5)
+    assert view.epoch == 4
+    # server join extends the ring AFTER the base range, in join order
+    view.add_server(7, epoch=5)
+    assert view.is_server(7)
+    assert view.nservers == 3
+    assert list(view.server_ranks) == [4, 5, 7]
+    assert view.ring_next(5) == 7 and view.ring_next(7) == 4
+    # epochs never regress
+    view.note_epoch(2)
+    assert view.epoch == 5
+    # snapshot/seed round-trip seeds a fresh joiner's view
+    other = MemberView(spec)
+    other.seed(view.snapshot())
+    assert other.epoch == 5
+    assert other.is_server(7)
+    assert not other.is_app(8) and 8 in other.detached
+
+
+def test_provisional_ranks_distinct():
+    a, b = provisional_rank(), provisional_rank()
+    assert a != b
+    assert is_provisional(a) and is_provisional(b)
+    spec = WorldSpec(nranks=6, nservers=2, types=(1,))
+    view = MemberView(spec)
+    # provisional ids classify as neither app nor server
+    assert not view.is_app(a) and not view.is_server(a)
+
+
+def test_attach_refused_on_native_cfg():
+    spec = WorldSpec(nranks=4, nservers=2, types=(1,))
+    with pytest.raises(AdlbError, match="python servers"):
+        attach_app(spec, Config(server_impl="native"), fabric=object())
+
+
+# ------------------------------------------------- attach/detach lifecycle
+
+
+def test_attach_detach_lifecycle():
+    n = 20
+    ew = ElasticWorld(2, 2, [T], cfg=_cfg())
+    h0 = ew.run_app(0, _producer(n))
+    ew.run_app(1, _consume)
+    time.sleep(0.2)
+    # a rank attached mid-run consumes real work...
+    attached = ew.attach_app(_consume)
+    assert attached.rank >= ew.world.nranks
+    # ...and another attaches, puts, and detaches cleanly
+    jw = ew.attach_ctx()
+    ctx = jw.ctx
+    ctx.put(struct.pack("<q", 777), T)
+    assert ctx.detach_world() == ADLB_SUCCESS
+    # finalize after detach is a no-op, not a protocol error
+    assert ctx._c.finalize() == ADLB_SUCCESS
+    results = ew.finish(timeout=90)
+    assert _ids(results) == sorted(list(range(n)) + [777])
+    # membership metrics count ONCE fleet-wide; the epoch advanced
+    master = ew.master
+    attached_total = sum(
+        s.metrics.value("ranks_attached") for s in ew.servers.values()
+    )
+    detached_total = sum(
+        s.metrics.value("ranks_detached") for s in ew.servers.values()
+    )
+    assert attached_total == 2.0
+    assert detached_total == 1.0
+    assert master.world.epoch >= 3  # two attaches + one detach
+    assert ctx.rank in master.world.detached
+
+
+def test_detach_idempotent():
+    ew = ElasticWorld(1, 2, [T], cfg=_cfg())
+    ew.run_app(0, _producer(4))
+    jw = ew.attach_ctx()
+    ctx = jw.ctx
+    assert ctx.detach_world() == ADLB_SUCCESS
+    # a re-sent detach (response lost across churn) settles SUCCESS
+    c = ctx._c
+    c._detached = False
+    assert c.detach() == ADLB_SUCCESS
+    ew.finish(timeout=60)
+
+
+def test_fleet_doc_reflects_membership():
+    ew = ElasticWorld(1, 2, [T], cfg=_cfg())
+    ew.run_app(0, _producer(6))
+    jw = ew.attach_ctx()
+    rank = jw.ctx.rank
+    doc = ew.master.fleet_doc()
+    me = [a for a in doc["apps"] if a["rank"] == rank]
+    assert me and me[0]["attached"] and me[0]["state"] == "live"
+    assert doc["epoch"] >= 1
+    assert all(s["state"] == "live" for s in doc["servers"])
+    assert jw.ctx.detach_world() == ADLB_SUCCESS
+    doc = ew.master.fleet_doc()
+    assert rank in doc["detached"]
+    assert all(a["rank"] != rank for a in doc["apps"])
+    ew.finish(timeout=60)
+
+
+# ----------------------------------------------- join vs END-ring racing
+
+
+def test_join_racing_termination_never_hangs():
+    """A rank attaching as the world drains: either the attach lands
+    (its put must be covered — the epoch voids any mid-flight
+    exhaustion/END verdict) or termination was already underway and the
+    attach is REFUSED loudly. A hang or a lost put is the only failure.
+    Stress-looped: the race window is the exhaustion check cadence."""
+    for trial in range(4):
+        n = 6
+        ew = ElasticWorld(2, 2, [T], cfg=_cfg(exhaust_check_interval=0.05))
+        ew.run_app(0, _producer(n))
+        ew.run_app(1, _consume)
+        # no sleep: the attach races bring-up/drain directly
+        extra = None
+        got = []
+        try:
+            jw = ew.attach_ctx()
+            extra = 1000 + trial
+            jw.ctx.put(struct.pack("<q", extra), T)
+            got = _consume(jw.ctx)
+            jw.ctx._c.finalize()  # the joiner gates END until it reports
+        except AdlbError as e:
+            # refused: termination was underway — must be the loud path
+            assert "refused" in str(e) or "terminating" in str(e), e
+        results = ew.finish(timeout=90)
+        ids = _ids(results) + sorted(struct.unpack("<q", p)[0] for p in got)
+        want = list(range(n)) + ([extra] if extra is not None else [])
+        assert sorted(ids) == sorted(want), (trial, sorted(ids), want)
+
+
+# ------------------------------------------------------------- scale-out
+
+
+def test_scaleout_ships_backlog_byte_identically():
+    """Every put acked BEFORE the scale-out is fetchable after it: the
+    donor ships a slice of its backlog to the new shard over the acked
+    migration plane, and consumers drain the lot byte-identically."""
+    n = 40
+    payloads = {struct.pack("<q", i) * 3 for i in range(n)}
+    ew = ElasticWorld(2, 2, [T], cfg=_cfg())
+    acked = threading.Event()  # every put acknowledged
+    go = threading.Event()     # scale-out done; start consuming
+
+    def producer(ctx):
+        for p in sorted(payloads):
+            assert ctx.put(p, T) == ADLB_SUCCESS  # put() acks synchronously
+        acked.set()
+        # membership ops are refused once termination is underway, so
+        # the rank stays live (unfinalized) across the scale-out
+        go.wait(60)
+        return _consume(ctx, pace=0)
+
+    ew.run_app(0, producer)
+    assert acked.wait(30)
+    new = ew.scale_out()
+    assert new not in ew.world.server_ranks  # a genuinely new rank
+    # the donor rebalance lands asynchronously: wait for the new shard
+    # to hold inventory before unleashing the consumers
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if ew.servers[new].wq.count > 0:
+            break
+        time.sleep(0.02)
+    shipped = ew.servers[new].wq.count
+    assert shipped > 0, "scale-out shard received no bootstrap inventory"
+    ew.run_app(1, lambda ctx: _consume(ctx, pace=0))
+    go.set()
+    results = ew.finish(timeout=120)
+    got = [p for v in results.values() if v for p in v]
+    assert sorted(got) == sorted(payloads)  # byte-identical coverage
+    master = ew.master
+    assert master.metrics.value("servers_joined") == 1.0
+    assert new in master._member_ready
+    assert master.world.epoch >= 2  # server_join + server_live
+
+
+def test_scalein_drain_counts_zero_losses():
+    """Scale-in drains through the failover promote path WITHOUT the
+    death accounting: exact coverage, failover_lost == 0 everywhere,
+    and failover_promoted == 0 (a drain is not a failover)."""
+    n = 30
+    ew = ElasticWorld(2, 3, [T],
+                      cfg=_cfg(on_server_failure="failover",
+                               put_routing="round_robin"))
+    acked = threading.Event()
+    go = threading.Event()
+
+    def producer(ctx):
+        for i in range(n):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        acked.set()
+        go.wait(60)
+        return _consume(ctx, pace=0)
+
+    ew.run_app(0, producer)
+    assert acked.wait(30)  # backlog spread over all three servers, acked
+    victim = ew.scale_in()
+    assert victim != ew.world.master_server_rank
+    ew.run_app(1, lambda ctx: _consume(ctx, pace=0))
+    go.set()
+    results = ew.finish(timeout=120)
+    assert _ids(results) == list(range(n))
+    live = [s for r, s in ew.servers.items() if r != victim]
+    assert all(s.metrics.value("failover_lost") == 0.0 for s in live)
+    assert all(s.metrics.value("failover_promoted") == 0.0 for s in live)
+    assert ew.master.metrics.value("servers_drained") == 1.0
+    doc = ew.master.fleet_doc()
+    state = {s["rank"]: s["state"] for s in doc["servers"]}
+    assert state[victim] == "drained"
+
+
+# ------------------------------------------- targeted-put redirection
+
+
+def test_targeted_put_to_attached_rank_redirects():
+    """A static client's route toward an attached rank cannot know its
+    assigned home (the base modulo formula predates the attach): the
+    put lands off-home and the receiving server must announce the
+    inventory to the real home so the rank's reserve finds it."""
+    ew = ElasticWorld(2, 2, [T], cfg=_cfg())
+    jw = ew.attach_ctx()
+    target = jw.ctx.rank
+    box = {}
+    fetched = threading.Event()
+
+    def putter(ctx):
+        # static WorldSpec view: this route is the base-modulo guess
+        assert ctx.put(b"hello-attached", T, target_rank=target) \
+            == ADLB_SUCCESS
+        fetched.wait(40)
+        return []
+
+    ew.run_app(0, putter)
+    ew.run_app(1, lambda ctx: (fetched.wait(40), [])[1])
+
+    def fetch():
+        rc, w = jw.ctx.get_work([T])
+        box["rc"], box["payload"] = rc, (w.payload if w else None)
+        fetched.set()
+
+    t = threading.Thread(target=fetch, daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive(), "targeted fetch never redirected"
+    assert box["rc"] == ADLB_SUCCESS and box["payload"] == b"hello-attached"
+    jw.ctx._c.finalize()
+    ew.finish(timeout=60)
+
+
+# ------------------------------------------------------ watermark autoscale
+
+
+def test_watermark_autoscale_spawns_shard():
+    """Config(elastic_scaleout='auto'): crossing the soft watermark
+    requests a scale-out BEFORE spill/backpressure — with the harness
+    spawner registered, a shard actually joins."""
+    ew = ElasticWorld(
+        2, 2, [T],
+        cfg=_cfg(elastic_scaleout="auto", elastic_cooldown_s=0.5,
+                 max_malloc_per_server=8 * 1024, mem_soft_frac=0.5),
+    )
+    payload = b"x" * 512
+    go = threading.Event()
+
+    def storm(ctx):
+        for _ in range(24):
+            ctx.put(payload, T)
+        go.wait(60)
+        return []
+
+    ew.run_app(0, storm)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if ew.master._member_ready:
+            break
+        time.sleep(0.05)
+    assert ew.master._member_ready, "watermark never requested a shard"
+    ew.run_app(1, lambda ctx: _consume(ctx, pace=0))
+    go.set()
+    ew.finish(timeout=90)
+    assert ew.master.metrics.value("servers_joined") >= 1.0
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError):
+        Config(elastic_scaleout="sideways")
+    with pytest.raises(ValueError):
+        Config(elastic_scaleout="auto", server_impl="native")
+    with pytest.raises(ValueError):
+        Config(elastic_cooldown_s=-1)
+
+
+def test_attach_after_scalein_routes_around_drained():
+    """A rank attaching AFTER a server retirement missed every
+    TA_HOME_TAKEOVER broadcast: the attach reply must seed its
+    client-side route map (retired -> live successor), or its
+    round-robin puts dial the drained listener and die waiting for a
+    takeover note that never re-arrives."""
+    n = 20
+    ew = ElasticWorld(2, 3, [T],
+                      cfg=_cfg(on_server_failure="failover",
+                               put_routing="round_robin"))
+    hold = threading.Event()
+
+    def producer(ctx):
+        for i in range(n):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        hold.wait(60)
+        return _consume(ctx, pace=0)
+
+    ew.run_app(0, producer)
+    ew.run_app(1, lambda ctx: (hold.wait(60), _consume(ctx, pace=0))[1])
+    victim = ew.scale_in()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            victim not in ew.master._drained_servers:
+        time.sleep(0.02)
+    jw = ew.attach_ctx()
+    route = jw.ctx._c._srv_route
+    assert victim in route and route[victim] != victim, route
+    # enough round-robin puts to hit every server slot, the drained
+    # one's included — each must resolve to the live successor at once
+    extra = list(range(1000, 1000 + 2 * len(ew.world.server_ranks)))
+    t0 = time.monotonic()
+    for i in extra:
+        assert jw.ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+    assert time.monotonic() - t0 < 5.0  # no takeover-window stalls
+    assert jw.ctx.detach_world() == ADLB_SUCCESS
+    hold.set()
+    results = ew.finish(timeout=120)
+    assert _ids(results) == list(range(n)) + extra
+
+
+# --------------------------------------------- churn observability
+
+
+def test_churn_hops_promoted_and_healthz_drops_drained():
+    """Churn events are visible in the tracing plane: a unit shipped to
+    a scale-out shard's bootstrap rebalance carries an `attach` hop, a
+    unit that crossed a scale-in drain carries a `drain` hop, and both
+    journeys are ALWAYS promoted (why == churn) under tail mode even
+    though they delivered cleanly in a trace_sample=0 world. The
+    drained server drops out of /healthz per-rank staleness instead of
+    reporting stale forever (/fleet keeps the topology history)."""
+    from adlb_tpu.obs.ops_server import OpsServer
+
+    n = 40
+    ew = ElasticWorld(
+        2, 3, [T],
+        cfg=_cfg(on_server_failure="failover", trace_sample=0.0,
+                 trace_tail="on", put_routing="round_robin"),
+    )
+    acked = threading.Event()
+    go = threading.Event()
+
+    def producer(ctx):
+        for i in range(n):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        acked.set()
+        go.wait(60)
+        return _consume(ctx, pace=0)
+
+    ew.run_app(0, producer)
+    assert acked.wait(30)
+    new = ew.scale_out()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and ew.servers[new].wq.count == 0:
+        time.sleep(0.02)
+    assert ew.servers[new].wq.count > 0
+    victim = ew.scale_in()
+    ew.run_app(1, lambda ctx: _consume(ctx, pace=0))
+    go.set()
+    results = ew.finish(timeout=120)
+    assert _ids(results) == list(range(n))
+    done = [
+        j for s in ew.servers.values() for j in s.journeys.take_done()
+    ]
+    churned = [j for j in done if j["why"] == ["churn"]]
+    hops = {
+        st for j in churned for st, _r, _t in j["spans"]
+        if st in ("attach", "drain")
+    }
+    assert "attach" in hops, f"no attach hop in {len(done)} journeys"
+    assert "drain" in hops, f"no drain hop in {len(done)} journeys"
+    assert all(j["end"] == "delivered" for j in churned)
+    # the drained server must NOT linger in per-rank staleness
+    ops = OpsServer(ew.master, port=0)
+    try:
+        ranks = ops._healthz()["ranks"]
+        assert str(victim) not in ranks
+        assert str(ew.master.rank) in ranks
+    finally:
+        ops.stop()
+
+
+# ------------------------------------------------------- TCP acceptance
+
+
+@pytest.mark.slow
+def test_tcp_world_gains_rank_and_serves_fleet():
+    """Real multi-process acceptance: a spawn-plane TCP world gains an
+    app rank over TCP mid-run (rank 0 attaches it from inside the
+    world, via the master's published address), the joiner's put is
+    covered, and GET /fleet serves the attached topology."""
+    import json
+    import urllib.request
+
+    from adlb_tpu.runtime.transport_tcp import probe_free_ports, spawn_world
+    from adlb_tpu.api import attach_world
+
+    n = 16
+    ops_port = probe_free_ports(1)[0]
+
+    def app(ctx):
+        if ctx.rank != 0:
+            return [struct.unpack("<q", p)[0] for p in _consume(ctx)]
+        ep = ctx._c.ep
+        base = getattr(ep, "_ep", ep)  # unwrap shm/fault shims
+        master = ctx._c.world.master_server_rank
+        addr = base.addr_map[master]
+        world = WorldSpec(nranks=ctx._c.world.nranks,
+                          nservers=ctx._c.world.nservers, types=(T,))
+        with attach_world(world, _cfg(), master_addr=addr) as actx:
+            assert actx.rank >= world.nranks
+            actx.put(struct.pack("<q", 999), T)
+            fleet = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ops_port}/fleet", timeout=10
+            ).read())
+            assert any(
+                a["rank"] == actx.rank and a["attached"]
+                for a in fleet["apps"]
+            ), fleet
+        for i in range(n):
+            ctx.put(struct.pack("<q", i), T)
+        return [struct.unpack("<q", p)[0] for p in _consume(ctx)]
+
+    res = spawn_world(3, 2, [T], app,
+                      cfg=_cfg(ops_port=ops_port), timeout=180.0)
+    got = sorted(x for v in res.app_results.values() for x in v)
+    assert got == sorted(list(range(n)) + [999]), got
