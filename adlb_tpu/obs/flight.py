@@ -132,6 +132,25 @@ class FlightRecorder(_debug.FlightRecorder):
         self.dump_json(reason)
 
 
+def write_incident(
+    out_dir: Optional[str], name: str, doc: dict
+) -> Optional[str]:
+    """Live incident bundle writer (the SLO engine's page-severity
+    FIRING capture): same atomic write + pid-suffix rule as the
+    post-mortem artifacts, but an ``incident-`` prefix so ``/flight``
+    and ``obs_report --index`` can tell dead-world post-mortems from
+    live captures. Within one process, re-fires of the same alert
+    overwrite — a flapping objective cannot fill the disk."""
+    out_dir = resolve_flight_dir(out_dir)
+    if not out_dir:
+        return None
+    return _write_json(
+        out_dir,
+        f"incident-{_slug(name)}-p{os.getpid()}.json",
+        {"schema": SCHEMA, **doc},
+    )
+
+
 def write_artifact(
     out_dir: Optional[str], name: str, doc: dict
 ) -> Optional[str]:

@@ -162,6 +162,7 @@ class JourneyRecorder:
         # expensive part — hold the histogram objects by plain key
         self._hists: dict = {}
         self._totals: dict = {}
+        self._errs: dict = {}
 
     # -- span lifecycle ------------------------------------------------------
 
@@ -295,6 +296,16 @@ class JourneyRecorder:
         ht.counts[bisect_left(ht.bounds, total)] += 1
         ht.sum += total
         ht.n += 1
+        if end != "delivered":
+            # the SLO engine's error-rate numerator: anomalous closes
+            # per (job, type), with the total histogram's count as the
+            # matching denominator (every close folds both)
+            ec = self._errs.get((job, work_type))
+            if ec is None:
+                ec = self._errs[(job, work_type)] = self.registry.counter(
+                    "unit_errors", job=str(job), type=str(work_type)
+                )
+            ec.v += 1  # counter.inc() inlined: every-unit path
         if trace_id > 0:
             # head-sampled: the unbiased per-stage baseline cells
             hists = self._hists

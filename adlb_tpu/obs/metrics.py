@@ -406,6 +406,136 @@ class Registry:
         return out
 
 
+class SnapshotRing:
+    """Bounded ring of timestamped MERGED registry snapshots — the
+    windowed-rate substrate under the SLO engine (obs/slo.py).
+
+    The servers gossip CUMULATIVE counters/histogram cells; a burn-rate
+    objective needs *windowed* rates ("errors over the last 30 s", "p99
+    of the units closed in the last 5 s"). Appending the master's merged
+    view once per evaluation tick makes any window a two-snapshot
+    subtraction: the newest entry minus the newest entry at least
+    ``window_s`` old. Deltas are clamped at zero because membership
+    churn shrinks the merge (a retired server's snapshot is popped, so
+    fleet sums can step DOWN without any event having un-happened).
+
+    A young ring answers with the span it actually covers — ``span_s``
+    rides every delta so the caller can rate-normalize honestly instead
+    of dividing a 3-second delta by a 300-second window."""
+
+    __slots__ = ("_ring",)
+
+    def __init__(self, capacity: int = 600) -> None:
+        self._ring: deque[tuple[float, dict]] = deque(maxlen=capacity)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def grow(self, capacity: int) -> None:
+        """Re-bound the ring (a later objective may need a longer
+        window); shrinking is refused — a live window must not lose its
+        far edge mid-evaluation."""
+        if capacity > (self._ring.maxlen or 0):
+            self._ring = deque(self._ring, maxlen=capacity)
+
+    def append(self, t: float, merged: dict) -> None:
+        self._ring.append((t, merged))
+
+    def latest(self) -> Optional[tuple[float, dict]]:
+        return self._ring[-1] if self._ring else None
+
+    def baseline(self, window_s: float, now: float) -> \
+            Optional[tuple[float, dict]]:
+        """The window's far edge: the NEWEST entry at least ``window_s``
+        old, else the oldest available (young ring). None when empty."""
+        entries = safe_copy(self._ring)
+        if not entries:
+            return None
+        cut = now - window_s
+        best = entries[0]
+        for t, snap in entries:
+            if t <= cut:
+                best = (t, snap)
+            else:
+                break
+        return best
+
+    def counter_delta(self, key: str, window_s: float,
+                      now: float) -> tuple[float, float]:
+        """(delta, span_s) of one merged-counter key over the window;
+        delta clamps at 0 (see class docstring)."""
+        cur = self.latest()
+        base = self.baseline(window_s, now)
+        if cur is None or base is None or cur[0] <= base[0]:
+            return 0.0, 0.0
+        d = cur[1].get("counters", {}).get(key, 0) - \
+            base[1].get("counters", {}).get(key, 0)
+        return max(d, 0.0), cur[0] - base[0]
+
+    def hist_delta(self, key: str, window_s: float, now: float) -> \
+            Optional[tuple[list, list, int, float]]:
+        """(bounds, counts_delta, n_delta, span_s) of one merged
+        histogram over the window — the input quantile_of turns into a
+        windowed p99. Cells clamp at 0 elementwise; None when the
+        histogram never appeared (or changed bucket geometry)."""
+        cur = self.latest()
+        base = self.baseline(window_s, now)
+        if cur is None:
+            return None
+        h = cur[1].get("histograms", {}).get(key)
+        if h is None:
+            return None
+        span = 0.0
+        counts = list(h["counts"])
+        n = h["count"]
+        if base is not None and base[0] < cur[0]:
+            span = cur[0] - base[0]
+            hb = base[1].get("histograms", {}).get(key)
+            if hb is not None and len(hb["counts"]) == len(counts):
+                counts = [max(a - b, 0) for a, b in
+                          zip(counts, hb["counts"])]
+                n = max(n - hb["count"], 0)
+        return list(h["bounds"]), counts, n, span
+
+    def window_delta(self, window_s: float, now: float) -> dict:
+        """The full merged-metrics delta over the window (changed
+        counters + histograms with closes in-window, latest gauges) —
+        the ``metrics_delta`` section of an incident bundle."""
+        cur = self.latest()
+        base = self.baseline(window_s, now)
+        if cur is None:
+            return {"span_s": 0.0, "counters": {}, "gauges": {},
+                    "histograms": {}}
+        bc = base[1].get("counters", {}) if base else {}
+        bh = base[1].get("histograms", {}) if base else {}
+        counters = {}
+        for k, v in cur[1].get("counters", {}).items():
+            d = v - bc.get(k, 0)
+            if d > 0:
+                counters[k] = d
+        hists = {}
+        for k, h in cur[1].get("histograms", {}).items():
+            prev = bh.get(k)
+            counts, n = list(h["counts"]), h["count"]
+            if prev is not None and len(prev["counts"]) == len(counts):
+                counts = [max(a - b, 0) for a, b in
+                          zip(counts, prev["counts"])]
+                n = max(n - prev["count"], 0)
+            if n > 0:
+                hists[k] = {"bounds": list(h["bounds"]),
+                            "counts": counts, "count": n}
+        return {
+            "span_s": round(cur[0] - base[0], 3) if base else 0.0,
+            "counters": counters,
+            "gauges": dict(cur[1].get("gauges", {})),
+            "histograms": hists,
+        }
+
+
 def _prom_key(key: str) -> tuple[str, dict]:
     """Split a snapshot label-key (``name{a=b,c=d}`` / ``name``) back
     into (name, labels) for re-exposition."""

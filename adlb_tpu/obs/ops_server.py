@@ -53,6 +53,16 @@ plane:
   scale request (the autoscaler feed). ``POST /fleet/scale`` with
   ``{"dir": "out"}`` requests a new server shard; ``{"dir": "in"}``
   (optional ``"rank"``) drains one through the zero-loss promote path.
+* ``/slo`` + ``/alerts`` + ``/incidents`` + ``/flight`` — the SLO plane
+  (adlb_tpu/obs/slo.py): ``POST /slo`` adds a declarative objective to
+  the live engine (same schema as ``Config(slo=...)``);
+  ``GET /alerts`` serves the per-objective alert rows (state machine
+  PENDING→FIRING→RESOLVED, fast/slow burn rates, staleness-degraded
+  flag) plus the transition history; ``GET /incidents`` the captured
+  live incident bundles (tails + stacks + metrics delta + topology for
+  each page-severity FIRING); ``GET /flight`` the flight-directory
+  inventory (post-mortem artifacts and incident bundles with rank,
+  reason, size, age) so captures are discoverable without shell access.
 * ``/jobs`` — the service-mode control plane: ``GET /jobs`` lists the
   job table, ``GET /jobs/<id>`` one job's status, ``POST /jobs`` (JSON
   body ``{"name": ..., "quota_bytes": ...}``) submits a namespace, and
@@ -114,6 +124,105 @@ def _world_agg_lines(agg: dict) -> list[str]:
         out.append(f'adlb_server_wq_depth{{rank="{r}"}} {e["wq"]}')
         out.append(f'adlb_server_rq_depth{{rank="{r}"}} {e["rq"]}')
         out.append(f'adlb_server_nbytes{{rank="{r}"}} {e["nbytes"]}')
+    return out
+
+
+def fleet_stage_p50(server) -> dict:
+    """(stage, job, type) -> fleet-typical p50 from the merged
+    unit_stage_s cells — the baseline each tail journey's per-stage
+    deltas are judged against. Module-level so the SLO engine's
+    incident builder (obs/slo.py) shares the exact join the
+    /trace/tails view uses."""
+    from adlb_tpu.obs.metrics import Registry, quantile_of
+
+    s = server
+    merged = Registry.merge(
+        [s.metrics.snapshot()] + list(_stable_dict(s._fleet_snaps).values())
+    )["histograms"]
+    out = {}
+    for key, h in merged.items():
+        if not key.startswith("unit_stage_s{"):
+            continue
+        lab = dict(
+            kv.split("=", 1)
+            for kv in key[len("unit_stage_s{"):-1].split(",")
+        )
+        try:
+            out[(lab["stage"], int(lab["job"]), int(lab["type"]))] = \
+                quantile_of(h["bounds"], h["counts"], h["count"], 0.5)
+        except (KeyError, ValueError):
+            continue
+    return out
+
+
+def rank_windows(server, rank: int) -> list:
+    """A rank's sealed profiler windows: the master's own live from
+    its owned sampler, every other rank's from the gossip ring —
+    with an in-proc fallback: a single-interpreter world runs ONE
+    process profiler whose samples cover every co-located rank's
+    threads but are filed under the owner, so when nothing has ever
+    gossiped windows (the profile plane is entirely local) the
+    process profiler's windows ARE this rank's windows."""
+    from adlb_tpu.obs import profile as _profile
+    from adlb_tpu.obs.metrics import safe_copy
+
+    s = server
+    wins = s._prof_windows.get(rank)
+    if wins is not None:
+        return safe_copy(wins)
+    if rank == s.rank and s._prof is not None:
+        return safe_copy(s._prof.windows)
+    if not s._prof_windows:
+        p = s._prof or _profile.active()
+        if p is not None:
+            return safe_copy(p.windows)
+    return []
+
+
+def annotate_tails(server, journeys: list) -> list:
+    """Annotate tail journeys with the stage their excess attributes to
+    (the stage whose delta most exceeds the fleet-typical p50 —
+    ``slow_stage``/``slow_rank``/``excess_s``) and, when the continuous
+    profiler runs, the dominant folded stacks active on the responsible
+    rank during the window(s) that stage crossed. The body behind
+    ``GET /trace/tails``, shared with the incident bundles."""
+    from adlb_tpu.obs.profile import window_of
+
+    p50 = fleet_stage_p50(server)
+    out = []
+    for j in journeys:
+        j = dict(j)
+        spans = j.get("spans") or []
+        best = None  # (excess, stage, rank, t_prev, t)
+        prev_t = spans[0][2] if spans else 0.0
+        for stage, rank, t in spans[1:]:
+            delta = max(t - prev_t, 0.0)
+            excess = delta - p50.get(
+                (stage, j.get("job", 0), j.get("type", -1)), 0.0
+            )
+            if best is None or excess > best[0]:
+                best = (excess, stage, rank, prev_t, t)
+            prev_t = t
+        if best is not None and best[0] > 0:
+            excess, stage, rank, t_a, t_b = best
+            j["slow_stage"] = stage
+            j["slow_rank"] = rank
+            j["excess_s"] = round(excess, 6)
+            # profiler join: sum the responsible rank's window
+            # stacks over the window ids the slow interval crossed
+            # (window ids are clock-aligned on the shared host
+            # CLOCK_MONOTONIC, so span stamps index them directly)
+            w0, w1 = window_of(t_a), window_of(t_b)
+            stacks: dict = {}
+            for w in rank_windows(server, rank):
+                if w0 <= w["id"] <= w1:
+                    for k, v in w["stacks"].items():
+                        stacks[k] = stacks.get(k, 0) + v
+            if stacks:
+                j["stacks"] = sorted(
+                    stacks.items(), key=lambda kv: -kv[1]
+                )[:5]
+        out.append(j)
     return out
 
 
@@ -180,6 +289,15 @@ class OpsServer:
                     elif path == "/fleet":
                         body = json.dumps(srv.fleet_doc()).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/alerts":
+                        body = json.dumps(ops._alerts()).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/incidents":
+                        body = json.dumps(ops._incidents(q)).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/flight":
+                        body = json.dumps(ops._flight_index()).encode()
+                        self._send(200, body, "application/json")
                     elif path == "/jobs":
                         body = json.dumps(ops._jobs()).encode()
                         self._send(200, body, "application/json")
@@ -215,6 +333,9 @@ class OpsServer:
                         body = json.dumps(
                             ops._fleet_scale(raw)
                         ).encode()
+                        self._send(200, body, "application/json")
+                    elif parts == ["slo"]:
+                        body = json.dumps(ops._slo_post(raw)).encode()
                         self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
@@ -389,101 +510,18 @@ class OpsServer:
 
     # -- tail store + the tail<->profile join --------------------------------
 
-    def _fleet_stage_p50(self) -> dict:
-        """(stage, job, type) -> fleet-typical p50 from the merged
-        unit_stage_s cells — the baseline each tail journey's per-stage
-        deltas are judged against."""
-        from adlb_tpu.obs.metrics import Registry, quantile_of
-
-        s = self.server
-        merged = Registry.merge(
-            [s.metrics.snapshot()] + list(_stable_dict(s._fleet_snaps).values())
-        )["histograms"]
-        out = {}
-        for key, h in merged.items():
-            if not key.startswith("unit_stage_s{"):
-                continue
-            lab = dict(
-                kv.split("=", 1)
-                for kv in key[len("unit_stage_s{"):-1].split(",")
-            )
-            try:
-                out[(lab["stage"], int(lab["job"]), int(lab["type"]))] = \
-                    quantile_of(h["bounds"], h["counts"], h["count"], 0.5)
-            except (KeyError, ValueError):
-                continue
-        return out
-
-    def _rank_windows(self, rank: int) -> list:
-        """A rank's sealed profiler windows: the master's own live from
-        its owned sampler, every other rank's from the gossip ring —
-        with an in-proc fallback: a single-interpreter world runs ONE
-        process profiler whose samples cover every co-located rank's
-        threads but are filed under the owner, so when nothing has ever
-        gossiped windows (the profile plane is entirely local) the
-        process profiler's windows ARE this rank's windows."""
-        from adlb_tpu.obs import profile as _profile
-        from adlb_tpu.obs.metrics import safe_copy
-
-        s = self.server
-        wins = s._prof_windows.get(rank)
-        if wins is not None:
-            return safe_copy(wins)
-        if rank == s.rank and s._prof is not None:
-            return safe_copy(s._prof.windows)
-        if not s._prof_windows:
-            p = s._prof or _profile.active()
-            if p is not None:
-                return safe_copy(p.windows)
-        return []
-
     def _trace_tails(self, q: Optional[dict] = None) -> dict:
-        """The tail store (Config(trace_tail)): promoted journeys, each
-        annotated with the stage its excess attributes to (the stage
-        whose delta most exceeds the fleet-typical p50) and — when the
-        continuous profiler runs — the dominant folded stacks active on
-        the responsible rank during the window(s) that stage crossed."""
+        """The tail store (Config(trace_tail)): promoted journeys
+        through :func:`annotate_tails` (slow-stage attribution + the
+        tail<->profile window join, shared with the incident bundles)."""
         from adlb_tpu.obs.metrics import safe_copy
-        from adlb_tpu.obs.profile import window_of
 
         s = self.server
-        journeys = self._filter_journeys(safe_copy(s._tails_fleet), q)
-        p50 = self._fleet_stage_p50()
-        out = []
-        for j in journeys:
-            j = dict(j)
-            spans = j.get("spans") or []
-            best = None  # (excess, stage, rank, t_prev, t)
-            prev_t = spans[0][2] if spans else 0.0
-            for stage, rank, t in spans[1:]:
-                delta = max(t - prev_t, 0.0)
-                excess = delta - p50.get(
-                    (stage, j.get("job", 0), j.get("type", -1)), 0.0
-                )
-                if best is None or excess > best[0]:
-                    best = (excess, stage, rank, prev_t, t)
-                prev_t = t
-            if best is not None and best[0] > 0:
-                excess, stage, rank, t_a, t_b = best
-                j["slow_stage"] = stage
-                j["slow_rank"] = rank
-                j["excess_s"] = round(excess, 6)
-                # profiler join: sum the responsible rank's window
-                # stacks over the window ids the slow interval crossed
-                # (window ids are clock-aligned on the shared host
-                # CLOCK_MONOTONIC, so span stamps index them directly)
-                w0, w1 = window_of(t_a), window_of(t_b)
-                stacks: dict = {}
-                for w in self._rank_windows(rank):
-                    if w0 <= w["id"] <= w1:
-                        for k, v in w["stacks"].items():
-                            stacks[k] = stacks.get(k, 0) + v
-                if stacks:
-                    j["stacks"] = sorted(
-                        stacks.items(), key=lambda kv: -kv[1]
-                    )[:5]
-            out.append(j)
-        return {"rank": s.rank, "count": len(out), "journeys": out}
+        journeys = annotate_tails(
+            s, self._filter_journeys(safe_copy(s._tails_fleet), q)
+        )
+        return {"rank": s.rank, "count": len(journeys),
+                "journeys": journeys}
 
     # -- continuous profile --------------------------------------------------
 
@@ -559,6 +597,97 @@ class OpsServer:
         doc = s.flight.snapshot_doc(reason="ops")
         path = s.flight.dump_json(reason="ops")
         return {"artifact": path, "record": doc}
+
+    # -- SLO / alerts / incidents --------------------------------------------
+
+    def _alerts(self) -> dict:
+        """The SLO engine's published state: objectives, per-objective
+        alert rows (state, burn rates, degraded flag), and the recent
+        transition history. All publish-by-swap reads — the engine runs
+        on the reactor; this is the HTTP thread."""
+        from adlb_tpu.obs.metrics import safe_copy
+
+        s = self.server
+        eng = s._slo_engine
+        if eng is None:
+            return {"rank": s.rank, "enabled": False, "objectives": [],
+                    "alerts": [], "firing": 0, "history": []}
+        return {
+            "rank": s.rank,
+            "enabled": True,
+            "objectives": list(eng.objectives),
+            "alerts": eng.alerts_pub,
+            "firing": eng.firing,
+            "history": safe_copy(eng.history),
+        }
+
+    def _incidents(self, q: Optional[dict] = None) -> dict:
+        """Captured live incident bundles, newest last (bounded ring;
+        the durable copies live in flight_dir — see /flight).
+        ``?limit=`` keeps the newest n."""
+        from adlb_tpu.obs.metrics import safe_copy
+
+        s = self.server
+        incidents = safe_copy(s._incidents)
+        if q and "limit" in q:
+            n = max(int(q["limit"]), 0)
+            incidents = incidents[-n:] if n else []
+        return {"rank": s.rank, "count": len(incidents),
+                "incidents": incidents}
+
+    def _flight_index(self) -> dict:
+        """Inventory of the flight directory: every post-mortem artifact
+        and incident bundle (filename, kind, rank, reason, size, age) so
+        CI and operators discover captures without shelling into the
+        box. Filenames encode rank/reason/pid (see obs/flight.py); the
+        index parses, never re-reads, the JSON bodies."""
+        import os
+        import re
+        import time
+
+        s = self.server
+        out_dir = s.flight.out_dir
+        entries = []
+        if out_dir and os.path.isdir(out_dir):
+            now = time.time()
+            for fn in sorted(os.listdir(out_dir)):
+                m = re.match(
+                    r"(flight|incident)-(?:rank(\d+)-)?(.+?)-p(\d+)\.json$",
+                    fn,
+                )
+                if m is None:
+                    continue
+                kind, rank, slug, pid = m.groups()
+                try:
+                    st = os.stat(os.path.join(out_dir, fn))
+                except OSError:
+                    continue  # racing a concurrent atomic replace
+                entries.append({
+                    "file": fn,
+                    "kind": "incident" if kind == "incident" else "flight",
+                    "rank": int(rank) if rank is not None else None,
+                    "reason": slug,
+                    "pid": int(pid),
+                    "bytes": st.st_size,
+                    "age_s": round(max(now - st.st_mtime, 0.0), 3),
+                })
+        return {
+            "rank": s.rank,
+            "flight_dir": out_dir,
+            "count": len(entries),
+            "artifacts": entries,
+        }
+
+    def _slo_post(self, raw: bytes) -> dict:
+        """POST /slo — add an objective to the live engine. Validated
+        here first (a malformed body answers 400 from the HTTP thread),
+        then normalized for real on the reactor, where the engine and
+        its evaluation cadence live."""
+        from adlb_tpu.obs.slo import parse_objective
+
+        body = json.loads(raw.decode() or "{}")
+        parse_objective(body)  # 400 gate only; reactor re-normalizes
+        return self.server.ctl_request({"op": "slo", "objective": body})
 
     # -- /jobs control plane -------------------------------------------------
 

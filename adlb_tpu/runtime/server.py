@@ -679,6 +679,28 @@ class Server:
         # jobs whose gauges the last gauge tick set (so a dropped
         # partition's gauges get zeroed exactly once, not left frozen)
         self._job_gauged: set[int] = set()
+        # ---- SLO engine (obs/slo.py) ----
+        # master-only evaluator over the merged fleet registry; created
+        # at init from Config(slo=...) or lazily by the first POST /slo.
+        # _slo_alerts_wire: compact rows riding SS_OBS_SYNC replies
+        # (publish-by-swap — the gossip path reads it mid-reply);
+        # _slo_alerts_remote: what a NON-master last heard from the
+        # master (the fleet-wide agreement surface); _incidents: the
+        # live bundles /incidents serves, newest last.
+        self._slo_engine = None
+        self._slo_alerts_wire: list = []
+        self._slo_alerts_remote: list = []
+        self._next_slo_eval = 0.0  # cadence gate (slo_eval_interval)
+        self._incidents: deque = deque(maxlen=32)
+        self._m_alerts_firing = self.metrics.gauge("alerts_firing")
+        if self._obs_sync_armed and self.is_master and cfg.slo:
+            from adlb_tpu.obs.slo import SloEngine
+
+            eng = SloEngine(cfg.slo_eval_interval
+                            or cfg.obs_sync_interval)
+            for doc in cfg.slo:
+                eng.add(doc)
+            self._slo_engine = eng
 
         # timers
         now = time.monotonic()
@@ -1201,6 +1223,8 @@ class Server:
                         [j, t, v] for (j, t), v in thr.items()
                     ]
                     self.journeys.tail_thr = thr
+                if self._slo_engine is not None:
+                    self._slo_evaluate(now)
             else:
                 self._obs_sync_send()
         if now >= self._next_state_sync:
@@ -1683,6 +1707,12 @@ class Server:
                 self.journeys.tail_thr = {
                     (int(j), int(t)): float(v) for j, t, v in thr
                 }
+            # the master's alert rows ride the same reply (append-only
+            # wire contract: an older server simply never reads the
+            # key) — swapped whole, the fleet-wide agreement surface
+            alerts = m.data.get("alerts")
+            if alerts is not None:
+                self._slo_alerts_remote = alerts
             return
         base = self._fleet_snaps.get(m.src) or {
             "counters": {}, "gauges": {}, "histograms": {},
@@ -1720,14 +1750,18 @@ class Server:
                 )
             for w in pd.get("win") or ():
                 wins.append(w)
+        reply = {}
         if self.journeys.tail and self._tail_thr_cache:
-            # carry the promotion thresholds back on the same plane
-            # (best-effort, 1 small frame per gossip tick per server)
+            reply["thr"] = self._tail_thr_cache
+        if self._slo_alerts_wire:
+            reply["alerts"] = self._slo_alerts_wire
+        if reply:
+            # carry the promotion thresholds + alert rows back on the
+            # same plane (best-effort, 1 small frame per gossip tick
+            # per server)
             try:
                 self.ep.send(
-                    m.src,
-                    msg(Tag.SS_OBS_SYNC, self.rank,
-                        thr=self._tail_thr_cache),
+                    m.src, msg(Tag.SS_OBS_SYNC, self.rank, **reply)
                 )
             except OSError:
                 pass
@@ -1787,6 +1821,68 @@ class Server:
             for key, (bounds, counts, n) in agg.items()
             if n >= TAIL_MIN_COUNT
         }
+
+    def _slo_evaluate(self, now: float) -> None:
+        """One SLO evaluation tick (master reactor, inside the obs-sync
+        tick): merge own registry + every gossiped snapshot, compute
+        which live members are stale per the /healthz rule, run the
+        engine, then act on transitions — flight event each, the
+        ``alerts_firing`` gauge, the wire rows the gossip replies carry
+        fleet-wide, and a live incident bundle on a page FIRING."""
+        if now < self._next_slo_eval:
+            return
+        if self.cfg.slo_eval_interval > 0:
+            self._next_slo_eval = now + self.cfg.slo_eval_interval
+        eng = self._slo_engine
+        eng.note_epoch(self.world.epoch, now)
+        merged = Registry.merge(
+            [self.metrics.snapshot()] + list(self._fleet_snaps.values())
+        )
+        # staleness per the /healthz rule: a gossiping member whose last
+        # snapshot is older than 3 sync intervals has gone quiet — its
+        # last values still sit in _fleet_snaps (merged above), so it
+        # degrades the evaluation rather than silently zeroing it
+        cadence = self.cfg.obs_sync_interval
+        stale = [
+            r for r, (_seq, at) in list(self._fleet_seen.items())
+            if now - at > 3.0 * cadence
+        ]
+        transitions = eng.evaluate(now, merged, stale)
+        self._slo_alerts_wire = eng.wire
+        self._m_alerts_firing.set(eng.firing)
+        for tr in transitions:
+            self.flight.record(
+                f"slo_alert {tr['name']} {tr['from']}->{tr['to']} "
+                f"sev={tr['severity']} burn_fast={tr['burn_fast']} "
+                f"burn_slow={tr['burn_slow']}"
+            )
+            if tr["to"] == "FIRING" and tr["severity"] == "page":
+                self._slo_capture_incident(tr, now)
+
+    def _slo_capture_incident(self, transition: dict, now: float) -> None:
+        """Page-severity FIRING: snapshot the evidence bundle (tails +
+        stacks + metrics delta + topology) while the world is still
+        degraded, write it atomically to flight_dir, and keep it in the
+        ring /incidents serves. Evidence capture must never take the
+        reactor down — a failed bundle is a flight note, not a crash."""
+        from adlb_tpu.obs import flight as _flight
+        from adlb_tpu.obs.slo import build_incident
+
+        try:
+            doc = build_incident(self, self._slo_engine, transition, now)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            self.flight.record(f"incident_build_failed {e!r:.120}")
+            return
+        path = _flight.write_incident(
+            self.flight.out_dir, transition["name"], doc
+        )
+        if path is not None:
+            doc["artifact"] = path
+        self._incidents.append(doc)
+        self.flight.record(
+            f"incident_captured {transition['name']} "
+            f"suspects={doc['suspect_ranks']} artifact={path}"
+        )
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
                         holder: Optional[int] = None,
@@ -4285,6 +4381,11 @@ class Server:
         self.leases.release(seqno)
         self._add_fence(seqno, owner)
         self._m_leases_expired.inc()
+        # owner-labelled expiry counter: the lease OWNER (the stalled
+        # app rank) otherwise appears only in this server's flight ring
+        # — the SLO incident bundles window-delta this cell to name the
+        # suspect rank directly
+        self.metrics.counter("leases_expired_by", owner=str(owner)).inc()
         if self.wlog is not None:
             self.wlog.log_fence(seqno, owner)
         self.flight.record(
@@ -4773,6 +4874,28 @@ class Server:
                 dict(mop="server_drain", rank=rank, epoch=epoch)
             )
             return {"rank": rank, "epoch": epoch}
+        if op == "slo":
+            # POST /slo: add an objective to the live engine (creating
+            # it on first use). Master-only — evaluation runs where the
+            # merged fleet view lives.
+            if not self.is_master:
+                raise RuntimeError("slo objectives live on the master")
+            if not self._obs_sync_armed:
+                raise RuntimeError(
+                    "slo needs the obs plane (ops_port + "
+                    "obs_sync_interval > 0)"
+                )
+            from adlb_tpu.obs.slo import SloEngine
+
+            if self._slo_engine is None:
+                self._slo_engine = SloEngine(
+                    self.cfg.slo_eval_interval
+                    or self.cfg.obs_sync_interval
+                )
+            o = self._slo_engine.add(req.get("objective") or {})
+            self.flight.record(f"slo_objective_added {o['name']}")
+            return {"objective": o,
+                    "n_objectives": len(self._slo_engine.objectives)}
         raise ValueError(f"unknown control op {op!r}")
 
     def _alloc_job_id(self) -> int:

@@ -287,6 +287,20 @@ class Config:
     # to the ADLB_FLIGHT_DIR env var; unset = text dumps only
     # (adlb_tpu/obs/flight.py; summarize with scripts/obs_report.py).
     flight_dir: Optional[str] = None
+    # Declarative SLO objectives (obs/slo.py), evaluated by the MASTER
+    # each obs tick against the merged fleet registry: a tuple of dicts,
+    # each e.g. {"job": 0, "type": 3, "p99_ms": 50, "error_frac": 0.001,
+    # "window_s": 300} (at least one of p99_ms / error_frac; window_s is
+    # the slow burn window — the fast one defaults to window_s/12).
+    # None/empty = no evaluation; objectives can also be added to a live
+    # world via POST /slo. Requires ops_port (the alert surfaces are
+    # ops routes) and obs_sync_interval > 0 (the merged view is the
+    # gossip plane's product).
+    slo: Optional[tuple] = None
+    # SLO evaluation cadence in seconds; 0 (default) evaluates on every
+    # obs-sync tick — the natural cadence, since that is when fresh
+    # fleet snapshots arrive.
+    slo_eval_interval: float = 0.0
     # Live ops endpoint on the MASTER server: serves /metrics (registry
     # exposition + last STAT_APS world aggregate), /healthz, and /dump
     # (flight-record snapshot) on 127.0.0.1:<ops_port>. None = off;
@@ -525,6 +539,20 @@ class Config:
             raise ValueError("profile_hz must be >= 0")
         if self.obs_sync_interval < 0:
             raise ValueError("obs_sync_interval must be >= 0")
+        if self.slo_eval_interval < 0:
+            raise ValueError("slo_eval_interval must be >= 0")
+        if self.slo:
+            # structural gate only (cheap, import-free): full
+            # normalization happens in obs/slo.py parse_objective at
+            # engine creation, where errors carry the objective name
+            for o in self.slo:
+                if not isinstance(o, dict):
+                    raise ValueError("slo entries must be dicts")
+                if o.get("p99_ms") is None and o.get("error_frac") is None:
+                    raise ValueError(
+                        "each slo entry needs p99_ms and/or error_frac")
+                if float(o.get("window_s", 0) or 0) <= 0:
+                    raise ValueError("each slo entry needs window_s > 0")
         if self.wal_dir is not None and self.server_impl == "native":
             # the C++ daemon has no WAL writer; its durability story is
             # the explicit checkpoint ring only

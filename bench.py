@@ -1571,6 +1571,72 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         tail_rows = {"tail_profile_overhead_error": repr(e)[:200]}
 
+    # SLO evaluator overhead (round 12, ISSUE 16): the master-side
+    # burn-rate loop armed with 8 objectives (one per work type, tight
+    # windows so every obs tick appends to the snapshot ring and walks
+    # the full objective list) vs the identical observed world with no
+    # objectives. Both arms carry ops_port=0 + obs gossip so the ratio
+    # isolates the evaluator itself, not the plumbing it rides on.
+    # Same RUN-CPU adjacent-pair method as the tail/profiler rows
+    # (process_time around a 2000-token world, order alternating per
+    # rep, median of per-pair ratios) — the bench-box-noise policy.
+    # Own containment.
+    def slo_overhead_bench():
+        objectives = tuple(
+            {"job": 0, "type": t, "p99_ms": 50.0, "error_frac": 0.01,
+             "window_s": 6.0, "severity": "warn"}
+            for t in range(8)
+        )
+
+        def coin_mode(mode):
+            kw = {}
+            if mode == "slo":
+                kw["slo"] = objectives
+                kw["slo_eval_interval"] = 0.1
+            c0 = time.process_time()
+            r = coinop.run(
+                n_tokens=2000, num_app_ranks=APPS, nservers=SERVERS,
+                cfg=Config(balancer="steal", exhaust_check_interval=0.2,
+                           trace_sample=0.0, ops_port=0,
+                           obs_sync_interval=0.2, **kw),
+                timeout=300.0,
+            )
+            return r, time.process_time() - c0
+
+        coin_mode("off")  # warm (imports, thread pools)
+        p50s = {"slo": [], "off": []}
+        cpus = {"slo": [], "off": []}
+        ratios = []
+        for rep in range(9):
+            order = ("slo", "off") if rep % 2 == 0 else ("off", "slo")
+            pair = {}
+            for m in order:
+                r, c = coin_mode(m)
+                pair[m] = c
+                p50s[m].append(r.latency_p50_ms)
+                cpus[m].append(c)
+            ratios.append(pair["slo"] / pair["off"])
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        return {
+            "coinop_slo_p50_ms": round(med(p50s["slo"]), 3),
+            "coinop_slo_off_p50_ms": round(med(p50s["off"]), 3),
+            "coinop_slo_cpu_s": round(med(cpus["slo"]), 4),
+            "coinop_slo_off_cpu_s": round(med(cpus["off"]), 4),
+            "slo_overhead_ratio": round(med(ratios), 3),
+            "slo_overhead_metric": "run-cpu-adjacent-pair",
+            "slo_objectives_armed": len(objectives),
+            "slo_overhead_ratio_reps": [round(x, 3) for x in ratios],
+        }
+
+    try:
+        slo_rows = slo_overhead_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        slo_rows = {"slo_overhead_error": repr(e)[:200]}
+
     # elastic membership (round 11, ISSUE 15): attach latency — the
     # rank-allocation + fleet-wide fan-out/ack barrier a joining rank
     # pays before its first protocol frame can land anywhere — and
@@ -1785,6 +1851,7 @@ def main() -> None:
             **engine_rows,
             **trace_rows,
             **tail_rows,
+            **slo_rows,
             **member_rows,
         },
     }
@@ -1958,6 +2025,9 @@ def main() -> None:
                 "trace_tail_overhead_ratio"),
             "profile_overhead_ratio": tail_rows.get(
                 "profile_overhead_ratio"),
+            # SLO evaluator (round 12): armed/off coinop run-CPU
+            # adjacent-pair ratio — bench_guard absolute arm at 1.05
+            "slo_overhead_ratio": slo_rows.get("slo_overhead_ratio"),
             # elastic membership (round 11): attach latency (allocation
             # + fleet fan-out/ack barrier) and server scale-out MTTR
             # (request -> shard bootstrapped + rebalanced + ready),

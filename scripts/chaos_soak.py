@@ -204,7 +204,7 @@ def _economy_rank0(ctx, n_pairs, do_abort):
 
 
 def gray_economy(n_units, victim=None, stall_s=0.0, poison=False,
-                 ops_port=None):
+                 ops_port=None, slo=False):
     """Answer-at-cycle-boundary economy for the GRAY adversities: rank 0
     puts ids (plus one poison-typed unit when ``poison``) and collects
     answers until coverage is complete; workers reserve/fetch/answer with
@@ -218,7 +218,13 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False,
     promotion armed by the port): rank 0 polls the master's
     /trace/tails before finishing and returns the doc, so the harness
     can assert the quarantined / lease-expired unit's journey was
-    captured — observability exercised under faults, not happy path."""
+    captured — observability exercised under faults, not happy path.
+
+    With ``slo`` (ISSUE 16) rank 0 additionally polls /alerts until
+    the burn-rate engine has driven a page-severity objective to
+    FIRING (the lease expiry is the burn), returning the alert doc so
+    the harness can assert the incident bundle on disk names the
+    SIGSTOP victim — the fleet pages itself under the adversity."""
     T, T_P, T_ANS = 1, 2, 3
 
     def app(ctx):
@@ -238,20 +244,23 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False,
                 if rc != ADLB_SUCCESS:
                     continue
                 seen.add(struct.unpack("<q", buf)[0])
-            tails = None
+            tails = alerts = None
             if ops_port:
                 import json as _json
                 import urllib.request
+
+                def fetch(route):
+                    return _json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{ops_port}{route}",
+                        timeout=5,
+                    ).read().decode())
 
                 # the adversity's journey closes on a server and rides
                 # the obs gossip to the master — poll for it (bounded)
                 deadline = time.monotonic() + 15.0
                 while time.monotonic() < deadline:
                     try:
-                        tails = _json.loads(urllib.request.urlopen(
-                            f"http://127.0.0.1:{ops_port}/trace/tails",
-                            timeout=5,
-                        ).read().decode())
+                        tails = fetch("/trace/tails")
                     except OSError:
                         time.sleep(0.4)
                         continue
@@ -266,8 +275,28 @@ def gray_economy(n_units, victim=None, stall_s=0.0, poison=False,
                     ):
                         break
                     time.sleep(0.4)
+                if slo:
+                    # the expiry IS the burn: hold the world open until
+                    # the evaluator pages (PENDING -> FIRING needs a
+                    # couple of sustained ticks past the expiry above)
+                    deadline = time.monotonic() + 25.0
+                    while time.monotonic() < deadline:
+                        try:
+                            alerts = fetch("/alerts")
+                        except OSError:
+                            time.sleep(0.4)
+                            continue
+                        if any(
+                            a.get("state") == "FIRING"
+                            for a in alerts.get("alerts") or []
+                        ) or any(
+                            h.get("to") == "FIRING"
+                            for h in alerts.get("history") or []
+                        ):
+                            break
+                        time.sleep(0.4)
             ctx.set_problem_done()
-            return len(seen), tails
+            return len(seen), tails, alerts
         # the SIGSTOP victim never touches the poison type: it must
         # SURVIVE (the adversity under test is the hang, not a kill)
         my_types = [T] if ctx.rank == victim else [T, T_P]
@@ -578,6 +607,26 @@ def one_iter(seed, fabric=None):
         kw["ops_port"] = gray_port
         kw["trace_sample"] = 0.0
         kw["obs_sync_interval"] = 0.25
+        if do_stall:
+            # the fleet pages ITSELF on the adversity (ISSUE 16): a p99
+            # objective on the stalled work type — the expired unit's
+            # total time carries the whole lease wait (>= 0.8 s against
+            # a ~3 ms healthy close), so its close IS the burn even
+            # under "reclaim" where the re-delivery ends the journey
+            # "delivered" (no error close). error_frac rides along for
+            # the quarantine outcomes. The page-severity FIRING must
+            # capture an incident bundle naming the SIGSTOP victim
+            # (the leases_expired_by{owner=} window delta). Unique name
+            # per seed so the harness can find this iteration's bundle
+            # in the shared flight dir.
+            kw["slo"] = ({
+                "name": f"stall-{seed}", "job": 0, "type": 1,
+                "p99_ms": 500.0, "error_frac": 0.05,
+                "window_s": 60.0,
+                "fast_s": max(2.0, 2 * kw["lease_timeout_s"]),
+                "for_s": 0.3, "cooldown_s": 5.0, "min_count": 1,
+                "severity": "page",
+            },)
     if do_two_jobs:
         # both worker policies: "reclaim" must complete BOTH jobs with
         # the poison quarantined; "abort" must classify the first
@@ -618,11 +667,12 @@ def one_iter(seed, fabric=None):
         # under "reclaim", world abort under "abort")
         stall_s = round(rng.uniform(1.3, 2.6) * kw["lease_timeout_s"], 2)
         app_fn = gray_economy(n_units, victim=victim, stall_s=stall_s,
-                              poison=do_poison, ops_port=gray_port)
+                              poison=do_poison, ops_port=gray_port,
+                              slo=do_stall)
         desc = dict(apps=apps, servers=servers, mode=mode, cap=cap,
                     workload="gray", stall=do_stall, poison=do_poison,
                     policy=g_policy, stall_s=stall_s if do_stall else None,
-                    faults=do_faults)
+                    slo=do_stall, faults=do_faults)
         t0 = time.monotonic()
         try:
             res = spawn_world(apps, servers, [1, 2, 3], app_fn,
@@ -638,7 +688,7 @@ def one_iter(seed, fabric=None):
             assert g_policy == "abort", "survival policy aborted"
             return desc
         # the world completed: coverage must be exact
-        n_seen, tails = res.app_results[0]
+        n_seen, tails, g_alerts = res.app_results[0]
         assert n_seen == n_units, res.app_results
         # tail-capture oracle: the adversity's journey reached the
         # master's /trace/tails with an anomalous terminal and hops
@@ -673,6 +723,29 @@ def one_iter(seed, fabric=None):
             # coverage; vanishing without a trace is the only failure.
             assert victim in res.app_results or victim in res.casualties, \
                 "stalled worker vanished"
+            # page oracle (ISSUE 16): the adversity drove the burn-rate
+            # engine to a page-severity FIRING (live /alerts state or
+            # the transition history — the alert may already have
+            # RESOLVED by the time rank 0's poll sampled it) ...
+            ga = g_alerts or {}
+            fired = any(
+                a.get("state") == "FIRING" for a in ga.get("alerts") or []
+            ) or any(
+                h.get("to") == "FIRING" for h in ga.get("history") or []
+            )
+            assert fired, f"stall adversity never paged: {ga}"
+            # ... and the FIRING snapshotted an incident bundle to the
+            # flight dir whose suspect ranks name the SIGSTOP victim
+            import glob as _glob
+            import json as _json
+            bundles = _glob.glob(os.path.join(
+                os.environ.get("ADLB_FLIGHT_DIR", ""),
+                f"incident-stall-{seed}-p*.json"))
+            assert bundles, "page fired but no incident bundle on disk"
+            with open(bundles[0]) as fh:
+                bundle = _json.load(fh)
+            assert victim in (bundle.get("suspect_ranks") or []), \
+                (victim, bundle.get("suspect_ranks"))
         if do_poison:
             assert res.quarantined == 1, res.quarantined
             # poison kills at most budget+1 workers, and someone survives

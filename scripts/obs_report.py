@@ -34,6 +34,19 @@ self/cumulative frame tables of the merged fleet profile
 (``--top N``, default 15) and, with ``--collapsed PATH``, writes the
 flamegraph-compatible collapsed-stack file.
 
+With ``--alerts`` the inputs are ``/alerts`` documents (the SLO engine,
+``Config(slo=...)``): one row per objective's alert state (fast/slow
+burn rates, degraded/churn-held flags) plus the transition history.
+
+With ``--incidents`` the inputs are ``/incidents`` documents or the
+``incident-*.json`` bundles themselves: per incident, the alert that
+fired, the suspect ranks, the burn-window metrics delta, the dominant
+stacks per responsible rank, and the violating tail journeys.
+
+With ``--index`` the inputs are ``/flight`` inventory documents or a
+raw flight directory: one row per post-mortem artifact / incident
+bundle (kind, rank, reason, size, age).
+
 Usage:  python scripts/obs_report.py <flight-dir | flight-*.json ...>
         python scripts/obs_report.py --json <...>   (merged record as JSON)
         python scripts/obs_report.py --journeys trace_units.json
@@ -41,6 +54,9 @@ Usage:  python scripts/obs_report.py <flight-dir | flight-*.json ...>
         python scripts/obs_report.py --tails trace_tails.json
         python scripts/obs_report.py --profile [--top 20]
                                      [--collapsed out.folded] profile.json
+        python scripts/obs_report.py --alerts alerts.json
+        python scripts/obs_report.py --incidents <flight-dir | file ...>
+        python scripts/obs_report.py --index <flight-dir | flight.json>
 """
 
 from __future__ import annotations
@@ -361,6 +377,137 @@ def tails_report(journeys: list[dict], slowest: int = 5) -> list[str]:
     return out
 
 
+# --------------------------------------------- alerts / incidents / index
+
+
+def load_docs(paths: list[str], glob: str = "*.json") -> list[dict]:
+    """Generic JSON doc loader (files or dirs), for the /alerts,
+    /incidents and /flight response shapes."""
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        files.extend(sorted(pp.glob(glob)) if pp.is_dir() else [pp])
+    out: list[dict] = []
+    for f in files:
+        try:
+            doc = json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+            continue
+        if isinstance(doc, dict):
+            doc["_file"] = str(f)
+            out.append(doc)
+    return out
+
+
+def alerts_report(docs: list[dict]) -> list[str]:
+    """Render /alerts documents: one row per objective's alert state
+    (burn rates, degraded flag), then the transition history."""
+    out: list[str] = []
+    for doc in docs:
+        alerts = doc.get("alerts") or []
+        out.append(
+            f"slo engine: enabled={doc.get('enabled', False)} "
+            f"objectives={len(doc.get('objectives') or [])} "
+            f"firing={doc.get('firing', 0)}"
+        )
+        if alerts:
+            out.append(
+                f"\n  {'name':<24} {'state':<9} {'sev':<5} "
+                f"{'burn_fast':>9} {'burn_slow':>9} {'fired':>6} {'flags'}"
+            )
+        for a in alerts:
+            flags = []
+            if a.get("degraded"):
+                flags.append(f"degraded({a.get('stale_ranks')})")
+            if a.get("held"):
+                flags.append("churn-held")
+            out.append(
+                f"  {a.get('name', '?'):<24} {a.get('state', '?'):<9} "
+                f"{a.get('severity', '?'):<5} "
+                f"{a.get('burn_fast', 0.0):>9.3f} "
+                f"{a.get('burn_slow', 0.0):>9.3f} "
+                f"{a.get('fire_count', 0):>6} {' '.join(flags)}"
+            )
+        hist = doc.get("history") or []
+        if hist:
+            out.append("\ntransition history:")
+            for t in hist:
+                out.append(
+                    f"  [{t.get('at', 0.0):.3f}] {t.get('name', '?')} "
+                    f"{t.get('from', '?')} -> {t.get('to', '?')} "
+                    f"sev={t.get('severity')} "
+                    f"burn={t.get('burn_fast')}/{t.get('burn_slow')}"
+                )
+    return out
+
+
+def incidents_report(docs: list[dict], slowest: int = 5) -> list[str]:
+    """Render incident bundles (/incidents docs or incident-*.json
+    artifacts): the alert that fired, the suspect ranks, the violating
+    tails, and the dominant stacks per responsible rank."""
+    bundles: list[dict] = []
+    for doc in docs:
+        if "incidents" in doc:
+            bundles.extend(doc["incidents"])
+        elif "incident" in doc:
+            bundles.append(doc)
+    out = [f"incidents: {len(bundles)}"]
+    for b in bundles:
+        tr = b.get("transition") or {}
+        out.append(
+            f"\nincident {b.get('incident', '?')} "
+            f"sev={b.get('severity', '?')} job={b.get('job')} "
+            f"type={b.get('type')} epoch={b.get('epoch')}"
+        )
+        out.append(
+            f"  fired {tr.get('from', '?')} -> {tr.get('to', '?')} "
+            f"burn={tr.get('burn_fast')}/{tr.get('burn_slow')} "
+            f"degraded={tr.get('degraded', False)}"
+        )
+        out.append(f"  suspect ranks: {b.get('suspect_ranks')}")
+        delta = b.get("metrics_delta") or {}
+        out.append(
+            f"  burn-window delta: span={delta.get('span_s')}s "
+            f"counters={len(delta.get('counters') or {})} "
+            f"histograms={len(delta.get('histograms') or {})}"
+        )
+        for rank, stacks in sorted((b.get("stacks") or {}).items()):
+            out.append(f"  rank {rank} dominant stacks:")
+            for stack, n in stacks[:3]:
+                out.append(f"    [{n:>4} samples] {stack}")
+        tails = b.get("tails") or []
+        if tails:
+            out.append(f"  violating tails ({len(tails)}):")
+            out.extend("  " + ln for ln in
+                       tails_report(tails, slowest=slowest)[2:])
+    return out
+
+
+def index_report(docs: list[dict]) -> list[str]:
+    """Render /flight inventory documents: one row per artifact."""
+    out: list[str] = []
+    for doc in docs:
+        arts = doc.get("artifacts") or []
+        out.append(
+            f"flight dir {doc.get('flight_dir')}: {len(arts)} artifacts"
+        )
+        if arts:
+            out.append(
+                f"  {'kind':<9} {'rank':>4} {'reason':<28} "
+                f"{'bytes':>8} {'age_s':>8}  file"
+            )
+        for a in arts:
+            rank = a.get("rank")
+            out.append(
+                f"  {a.get('kind', '?'):<9} "
+                f"{'-' if rank is None else rank:>4} "
+                f"{a.get('reason', '?'):<28} {a.get('bytes', 0):>8} "
+                f"{a.get('age_s', 0.0):>8.1f}  {a.get('file')}"
+            )
+    return out
+
+
 # ----------------------------------------------------- profile report
 
 
@@ -434,6 +581,61 @@ def main(argv: list[str]) -> int:
             return 0
         rep = tails_report if "--tails" in argv else journey_report
         print("\n".join(rep(journeys, slowest=slowest)))
+        return 0
+    if "--alerts" in argv:
+        docs = load_docs(paths)
+        if as_json:
+            print(json.dumps({"docs": docs}))
+            return 0
+        print("\n".join(alerts_report(docs)))
+        return 0
+    if "--incidents" in argv:
+        docs = load_docs(paths, glob="incident-*.json")
+        if not docs:
+            print("no incident bundles found", file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps({"docs": docs}))
+            return 0
+        print("\n".join(incidents_report(docs, slowest=slowest)))
+        return 0
+    if "--index" in argv:
+        # accept /flight response docs OR a raw flight dir (build the
+        # inventory locally with the same filename contract)
+        docs = []
+        for p in list(paths):
+            pp = Path(p)
+            if pp.is_dir():
+                import re
+                import time
+
+                arts = []
+                now = time.time()
+                for f in sorted(pp.glob("*.json")):
+                    m = re.match(
+                        r"(flight|incident)-(?:rank(\d+)-)?"
+                        r"(.+?)-p(\d+)\.json$", f.name,
+                    )
+                    if m is None:
+                        continue
+                    kind, rank, slug, pid = m.groups()
+                    st = f.stat()
+                    arts.append({
+                        "file": f.name,
+                        "kind": ("incident" if kind == "incident"
+                                 else "flight"),
+                        "rank": int(rank) if rank is not None else None,
+                        "reason": slug, "pid": int(pid),
+                        "bytes": st.st_size,
+                        "age_s": round(max(now - st.st_mtime, 0.0), 3),
+                    })
+                docs.append({"flight_dir": str(pp), "artifacts": arts})
+            else:
+                docs.extend(load_docs([p]))
+        if as_json:
+            print(json.dumps({"docs": docs}))
+            return 0
+        print("\n".join(index_report(docs)))
         return 0
     if "--profile" in argv:
         stacks = load_profiles(paths)
