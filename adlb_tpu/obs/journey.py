@@ -89,6 +89,10 @@ STAGES = (
     "attach",      # 13 shipped to a scale-out shard's bootstrap rebalance
     "drain",       # 14 crossed a detach/scale-in drain (lease drained,
     #                   shard shipped to the buddy, target departed)
+    # tail hedging (append-only — renumbering corrupts old WALs):
+    "hedge",       # 15 a hedge sibling was launched for this unit (the
+    #                   origin stamps it; the sibling's journey inherits
+    #                   the origin's history including this hop)
 )
 STAGE_CODES = {name: i + 1 for i, name in enumerate(STAGES)}
 CODE_STAGES = {v: k for k, v in STAGE_CODES.items()}
@@ -181,8 +185,14 @@ class JourneyRecorder:
         a NEGATIVE trace id (rank in the high bits, like the client's
         positive head ids) so retention can tell the two apart at close
         without any extra per-unit state."""
+        self.begin(unit, self.mint_tail_id(), t)
+
+    def mint_tail_id(self) -> int:
+        """A fresh server-minted (negative) trace id — begin_tail's, and
+        the hedge launcher's for sibling journeys that carry a copy of
+        the origin's span history under their own identity."""
         self._tail_seq += 1
-        self.begin(unit, -((self.rank << 40) | self._tail_seq), t)
+        return -((self.rank << 40) | self._tail_seq)
 
     def adopt(self, unit, trace_id: int, spans, stage: Optional[str] = None,
               t: Optional[float] = None) -> None:
@@ -334,6 +344,12 @@ class JourneyRecorder:
                 if st == "expire":
                     why = ["expired_lease"]
                     break
+                if st == "hedge":
+                    # a hedge race crossed this journey (this copy won
+                    # it — losers are forgotten, never closed): always
+                    # keep, so every hedge outcome lands in /trace/tails
+                    why = ["hedged"]
+                    break
                 if st == "attach" or st == "drain":
                     # membership churn crossed this journey (scale-out
                     # bootstrap / detach / scale-in drain): always keep,
@@ -403,6 +419,13 @@ class JourneyRecorder:
         if self.tail:
             if end != "delivered":
                 why.append(end)
+                for s in spans:
+                    if s[0] == "hedge":
+                        # an anomalous terminal that crossed a hedge
+                        # race still tags it, so /trace/tails answers
+                        # "was hedging in play?" for every outcome
+                        why.append("hedged")
+                        break
             else:
                 # plain loop, not any(genexpr): this runs per close
                 # under tail mode and the generator allocation is a
@@ -412,6 +435,9 @@ class JourneyRecorder:
                     st = s[0]
                     if st == "expire":
                         mark = "expired_lease"
+                        break
+                    if st == "hedge":
+                        mark = "hedged"
                         break
                     if st == "attach" or st == "drain":
                         mark = "churn"

@@ -227,6 +227,24 @@ class Registry:
             items = list(self._counters.items())
         return sum(c.v for (n, _), c in items if n == name)
 
+    def labelled(self, name: str) -> dict[str, float]:
+        """One counter family's current values keyed the snapshot way
+        (``name{a=b}``; the bare cell keys as ``name``) — the hedge
+        trigger's in-window ``leases_expired_by`` growth memo, without
+        paying for a full snapshot per scan."""
+        with self._lock:
+            items = list(self._counters.items())
+        out: dict[str, float] = {}
+        for (n, labels), c in items:
+            if n != name:
+                continue
+            if labels:
+                out[name + "{" + ",".join(
+                    f"{a}={b}" for a, b in labels) + "}"] = c.v
+            else:
+                out[name] = c.v
+        return out
+
     def _stable_items(self) -> tuple[list, list, list, list]:
         """Consistent item lists for cross-thread readers (the ops scrape
         / flight dump): instrument *creation* holds the lock, so copying

@@ -317,6 +317,38 @@ class SloEngine:
         return transitions
 
 
+# ---------------------------------------------------------------- suspects
+
+# the owner-labelled lease-expiry counter's snapshot key prefix (see
+# Server._expire_lease): the window-delta of these cells names the
+# stalled worker directly
+LEASE_EXPIRY_PREFIX = "leases_expired_by{owner="
+
+
+def suspect_ranks(stale_ranks, tails, counter_deltas) -> set[int]:
+    """The stall-signature heuristic, shared by the incident builder
+    below and the hedge trigger (``runtime/server.py::_hedge_suspects``):
+    ranks the evidence points at — members that went quiet (the
+    ``/healthz`` staleness rule), ranks a promoted tail's excess
+    attributes to (``slow_rank`` annotations), and lease-expiry owners
+    whose ``leases_expired_by{owner=}`` cell grew inside the window
+    (the stalled worker itself). Inputs are all optional — each caller
+    feeds what its window actually has."""
+    suspects: set[int] = set()
+    for r in stale_ranks or ():
+        suspects.add(int(r))
+    for j in tails or ():
+        if "slow_rank" in j:
+            suspects.add(j["slow_rank"])
+    for key, v in (counter_deltas or {}).items():
+        if key.startswith(LEASE_EXPIRY_PREFIX) and v > 0:
+            try:
+                suspects.add(int(key[len(LEASE_EXPIRY_PREFIX):-1]))
+            except ValueError:
+                pass
+    return suspects
+
+
 # ---------------------------------------------------------------- incidents
 
 
@@ -340,23 +372,15 @@ def build_incident(server, engine: SloEngine, transition: dict,
         if j.get("job", 0) == job and j.get("type", -1) == typ
     ]
     tails = annotate_tails(server, tails[-16:])  # bounded, newest last
-    # suspect ranks: where the evidence points — stale members (went
-    # quiet), ranks a tail's excess attributes to, and lease-expiry
-    # owners inside the burn window (the stalled worker itself)
+    # suspect ranks: where the evidence points (the shared heuristic —
+    # the hedge trigger consumes the same function per scan window)
     alert_row = next(
         (a for a in engine.alerts_pub if a["name"] == name), {})
-    suspects = set(alert_row.get("stale_ranks") or ())
-    for j in tails:
-        if "slow_rank" in j:
-            suspects.add(j["slow_rank"])
     window_s = float(o.get("window_s") or 60.0)
     delta = engine.ring.window_delta(window_s, now)
-    for key, v in delta.get("counters", {}).items():
-        if key.startswith("leases_expired_by{owner=") and v > 0:
-            try:
-                suspects.add(int(key[len("leases_expired_by{owner="):-1]))
-            except ValueError:
-                pass
+    suspects = suspect_ranks(
+        alert_row.get("stale_ranks"), tails, delta.get("counters")
+    )
     # profiler join: each responsible rank's dominant stacks over the
     # monotonic windows the firing interval crossed (windows are
     # clock-aligned, so alert timestamps index them directly — the same
@@ -391,6 +415,13 @@ def build_incident(server, engine: SloEngine, transition: dict,
         "tails": tails,
         "stacks": stacks,
         "metrics_delta": delta,
+        # burn-window hedge activity (launched/won/fenced/vetoed cells):
+        # a page should show at a glance whether tail hedging was
+        # already absorbing the straggler before the alert fired
+        "hedges": {
+            k: v for k, v in delta.get("counters", {}).items()
+            if k.startswith("hedges_")
+        },
         "epoch": server.world.epoch,
         "fleet": server.fleet_doc(),
     }

@@ -282,6 +282,17 @@ class Client:
         time.sleep(s)
         return s
 
+    def _jitter_hint(self, hint_s: float, cap: float) -> float:
+        """Decorrelate a server-carried retry-after hint. The server's
+        ``retry_after_ms`` is deterministic (the same constant on every
+        ADLB_BACKOFF), so honoring it verbatim re-synchronizes every
+        backpressured client into a retry convoy exactly one hint
+        later. Bounded multiplicative jitter [1.0, 1.5) drawn from this
+        client's own seeded retry RNG (never a shared stream) spreads
+        the wave without ever undercutting the server's ask; the site's
+        cap still wins."""
+        return min(cap, hint_s * (1.0 + 0.5 * self._retry_rng.random()))
+
     def _route(self, dest: int) -> int:
         """Resolve a server destination through the failover map (chains
         of takeovers resolve to the final live buddy)."""
@@ -566,9 +577,10 @@ class Client:
                 # jitter backoff, WITHOUT burning the retry budget:
                 # shedding load, not failing the put.
                 self._m_put_backoffs.inc()
-                hint_s = float(
-                    resp.data.get("retry_after_ms", 25) or 25
-                ) / 1e3
+                hint_s = self._jitter_hint(
+                    float(resp.data.get("retry_after_ms", 25) or 25) / 1e3,
+                    self.cfg.put_retry_cap,
+                )
                 self.flight.record(
                     f"put_backoff server={server} retry_after_s={hint_s}"
                 )
@@ -1283,7 +1295,9 @@ class Client:
             # settles run inline in whatever recv loop the client is
             # blocked in, so one backpressured put must not stall it.
             self._m_put_backoffs.inc()
-            hint_s = min((m.data.get("retry_after_ms") or 0) / 1e3, 0.05)
+            hint_s = self._jitter_hint(
+                (m.data.get("retry_after_ms") or 0) / 1e3, 0.05
+            )
             slept = self._backoff_sleep(req.get("sleep", 0.0), cap=0.05)
             if hint_s > slept:
                 time.sleep(hint_s - slept)

@@ -71,6 +71,16 @@ OP_JOB = 19
 # (trace_id, span list) — logged right behind its OP_PUT so the journey
 # survives failover adoption and WAL cold-restart replay
 OP_TRACE = 20
+# tail hedging (runtime/hedge.py): marks a unit as a speculative hedge
+# SIBLING of an origin unit (body: sibling seqno, origin seqno), logged
+# right behind the sibling's OP_PUT. Failover adoption and WAL replay
+# DISCARD marked siblings and adopt only origins — re-running the
+# origin falls inside the documented lease-expiry at-least-once window,
+# while adopting both copies would put two live duplicates into open
+# matching with nobody left to fence the loser. A fresh OP_PUT of the
+# same seqno supersedes the mark (the race dissolved and the survivor
+# became an ordinary unit). Append-only, as above.
+OP_HEDGE = 21
 
 _HDR = struct.Struct("<BI")       # op, body length
 _SEQ = struct.Struct("<q")        # one seqno
@@ -195,6 +205,11 @@ class ReplicationLog:
     def log_quarantine(self, seqno: int) -> None:
         self._append(OP_QUARANTINE, _SEQ.pack(seqno))
 
+    def log_hedge(self, sib_seqno: int, origin_seqno: int) -> None:
+        """Mark ``sib_seqno`` as a hedge sibling of ``origin_seqno``
+        (logged right behind the sibling's OP_PUT, like OP_TRACE)."""
+        self._append(OP_HEDGE, _SEQ2.pack(sib_seqno, origin_seqno))
+
     def log_job(self, job_id: int, state_code: int, quota_bytes: int,
                 name: str = "") -> None:
         """Job lifecycle entry (service mode): state codes are
@@ -265,6 +280,10 @@ class ReplicaMirror:
         # the failover)
         self.fences: set[tuple[int, int, int]] = set()
         self.quarantined: dict[int, dict] = {}     # seqno -> unit fields
+        # hedge siblings (OP_HEDGE): sibling seqno -> origin seqno.
+        # Promotion / WAL replay discard marked units (see the opcode
+        # comment); any terminal op on the sibling pops its mark.
+        self.hedges: dict[int, int] = {}
         self.finalized: set[int] = set()
         self.dead_ranks: set[int] = set()
         # job-namespace lifecycle: job id -> (state_code, quota, name);
@@ -310,6 +329,9 @@ class ReplicaMirror:
             fields["attempts"] = attempts
             fields["job"] = job
             self.units[seqno] = fields
+            # a re-put of a marked sibling means its race dissolved and
+            # it is an ordinary unit now (see OP_HEDGE comment)
+            self.hedges.pop(seqno, None)
             if pin_rank >= 0:
                 self.pins[seqno] = pin_rank
             if pid >= 0:
@@ -328,11 +350,13 @@ class ReplicaMirror:
             (seqno,) = _SEQ.unpack(body)
             self.units.pop(seqno, None)
             self.pins.pop(seqno, None)
+            self.hedges.pop(seqno, None)
             self._tombstone(seqno)
         elif op == OP_REMOVE:
             (seqno,) = _SEQ.unpack(body)
             self.units.pop(seqno, None)
             self.pins.pop(seqno, None)
+            self.hedges.pop(seqno, None)
         elif op == OP_COMMON_PUT:
             (seqno,) = _SEQ.unpack_from(body, 0)
             self.commons[seqno] = [body[_SEQ.size:], -1, 0, 0]
@@ -385,6 +409,7 @@ class ReplicaMirror:
             (seqno,) = _SEQ.unpack(body)
             f = self.units.pop(seqno, None)
             self.pins.pop(seqno, None)
+            self.hedges.pop(seqno, None)
             if f is not None:
                 self.quarantined[seqno] = f
         elif op == OP_APP_DONE:
@@ -414,6 +439,10 @@ class ReplicaMirror:
                 tid, spans = unpack_spans(body[_SEQ.size:])
                 f["trace_id"] = tid
                 f["spans"] = spans
+        elif op == OP_HEDGE:
+            sib, origin = _SEQ2.unpack(body)
+            if sib in self.units:
+                self.hedges[sib] = origin
         # unknown ops are skipped by construction (op byte + length frame)
 
     def seal(self) -> None:

@@ -38,6 +38,7 @@ from adlb_tpu.obs.flight import FlightRecorder
 from adlb_tpu.obs.journey import TAIL_MIN_COUNT, JourneyRecorder, trace_fields
 from adlb_tpu.obs.metrics import Registry, attach, quantile_of
 from adlb_tpu.runtime.debug import aprintf, self_diagnosis
+from adlb_tpu.runtime.hedge import HedgeManager, should_hedge
 from adlb_tpu.runtime.messages import Msg, Tag, msg
 from adlb_tpu.runtime.trace import PID_SERVER, Tracer
 from adlb_tpu.runtime.queues import (
@@ -573,6 +574,22 @@ class Server:
         self._m_quarantined = self.metrics.counter("quarantined")
         self._m_put_backoffs = self.metrics.counter("put_backoff")
         self._m_heartbeats = self.metrics.counter("heartbeats")
+        # tail-hedging surface (Config(hedge_budget_frac) > 0,
+        # runtime/hedge.py): manager + counters exist ONLY when armed —
+        # an unhedged world's metric snapshots (and therefore its
+        # gossip frames) stay byte-identical to an unhedged build
+        if cfg.hedge_budget_frac > 0:
+            self.hedges = HedgeManager(cfg.hedge_budget_frac)
+            self._m_hedges_launched = self.metrics.counter("hedges_launched")
+            self._m_hedges_won = self.metrics.counter("hedges_won")
+            self._m_hedges_fenced = self.metrics.counter("hedges_fenced")
+        else:
+            self.hedges = None
+        # per-scan memo of the owner-labelled lease-expiry cells (the
+        # local stall-signature window for the hedge trigger), plus the
+        # decaying rank -> deadline suspicion map it feeds
+        self._hedge_expiry_memo: dict[str, float] = {}
+        self._hedge_suspect_until: dict[int, float] = {}
         self._g_leases = self.metrics.gauge("leases_outstanding")
         self._g_lease_age = self.metrics.gauge("lease_age_max_s")
         self._g_quarantined = self.metrics.gauge("quarantined")
@@ -713,6 +730,10 @@ class Server:
         )
         self._next_lease_scan = (
             now + cfg.lease_timeout_s if self._lease_armed else float("inf")
+        )
+        self._next_hedge_scan = (
+            now + cfg.hedge_min_age_ms / 1e3
+            if self.hedges is not None else float("inf")
         )
         self._next_exhaust_check = now + cfg.exhaust_check_interval
         self._next_ds_log = now
@@ -1148,6 +1169,13 @@ class Server:
                 self.cfg.lease_timeout_s / 4.0, 0.01
             )
             self._scan_leases(now)
+        if self.hedges is not None and now >= self._next_hedge_scan:
+            # hedge-trigger scan (runtime/hedge.py): well inside the
+            # age floor, same cadence logic as the lease scan above
+            self._next_hedge_scan = now + max(
+                self.cfg.hedge_min_age_ms / 4e3, 0.01
+            )
+            self._scan_hedges(now)
         if now >= self._next_gauge_sample:
             # queue-depth gauges + bounded timelines, sampled on their
             # OWN cadence (Config(gauge_interval), 0.25 s default),
@@ -1307,6 +1335,13 @@ class Server:
 
     def _consume(self, unit) -> None:
         """Remove a fetched/inlined unit and settle its lease + memory."""
+        if self.hedges is not None:
+            # every delivery funds the per-job hedge bucket, and a
+            # delivery IS the terminal that closes a hedge race (the
+            # universal settle: fused, handle, and relay-confirm paths
+            # all pass through here)
+            self.hedges.credit(unit.job)
+            self._hedge_settle(unit)
         self.wq.remove(unit.seqno)
         self.leases.release(unit.seqno)
         self.mem.free(len(unit.payload))
@@ -2936,6 +2971,13 @@ class Server:
             self.leases.release(unit.seqno)
             if self.wlog is not None:
                 self.wlog.log_unpin(unit.seqno)
+        elif fetch and self.hedges is not None:
+            # defensive: hedge-group members are pinned at launch, so
+            # RFR should never relay one — but the payload has now left
+            # this server, which IS the commit point for the race. If a
+            # member ever does reach here, settle first-wins now rather
+            # than let a sibling deliver a second copy.
+            self._hedge_settle(unit)
 
     def _on_rfr(self, m: Msg) -> None:
         req_types = None if m.req_types is None else frozenset(m.req_types)
@@ -3117,6 +3159,10 @@ class Server:
             # stale — honoring it would steal a live rank's reservation
             return
         self._relay_inflight.pop(m.seqno, None)
+        if self._hedge_member_unpin(unit):
+            # requester handed a racing hedge copy back (shutdown /
+            # shrink): retire it rather than re-match a duplicate
+            return
         self.wq.unpin(m.seqno)
         self.leases.release(m.seqno)
         if self.wlog is not None:
@@ -4400,6 +4446,11 @@ class Server:
         # sweep (at-most-once: the owner is gone, consume), expiry keeps
         # the unit — the documented at-least-once window
         self._relay_inflight.pop(seqno, None)
+        if self._hedge_member_unpin(unit):
+            # a hedge sibling still races for this unit's logical put:
+            # this copy retires instead of re-enqueueing (the fence
+            # above already bars the silent owner)
+            return
         self.wq.unpin(seqno)
         if unit.spans is not None:
             self.journeys.stamp(unit, "expire")
@@ -4429,6 +4480,279 @@ class Server:
         self._fence_order.append(key)
         if len(self._fence_order) > 65536:  # bounded, like tombstones
             self._fences.discard(self._fence_order.popleft())
+
+    # ------------------------------------------------------- tail hedging
+    # Config(hedge_budget_frac) > 0 (runtime/hedge.py holds the pure
+    # bookkeeping; this section owns every queue/lease/WAL side effect).
+    # A straggling leased-but-unfetched unit — age past the live
+    # per-(job, type) p99 the master gossips, or its holder showing the
+    # PR 16 stall signature — gets a hedge SIBLING minted and handed
+    # directly to an already-parked requester on a DIFFERENT rank. The
+    # sibling is pinned at launch and never sits unpinned in the queue,
+    # so migration/push can never move it off-home and the whole race
+    # settles on this reactor. First terminal wins (_hedge_settle, from
+    # _consume / _quarantine_unit / the relay-send site); every losing
+    # sibling is fenced through the (seqno, owner) machinery and
+    # removed — its late fetch answers ADLB_FENCED exactly like an
+    # expired-lease owner's. Members that lose their pin WITHOUT
+    # terminating (expiry / unreserve / rank-death) retire instead of
+    # re-enqueueing while a sibling still races; the LAST live copy
+    # always re-enters service, so work is never lost to hedging.
+
+    def _scan_hedges(self, now: float) -> None:
+        """Walk the lease table for stragglers worth hedging. Rare-path
+        cost: gated on the hedge budget being configured, cadenced well
+        inside the age floor."""
+        thr_map = self.journeys.tail_thr
+        suspects = self._hedge_suspects(now)
+        min_age_s = self.cfg.hedge_min_age_ms / 1e3
+        hm = self.hedges
+        for lease in list(self.leases.leases()):
+            seqno, owner = lease.seqno, lease.owner
+            if owner in self._dead_ranks:
+                continue  # the rank-dead sweep owns those
+            if hm.is_member(seqno) or hm.is_vetoed(seqno):
+                continue
+            if seqno in self._relay_inflight:
+                continue  # payload already committed cross-server
+            unit = self.wq.get(seqno)
+            if unit is None or not unit.pinned or unit.pin_rank != owner:
+                continue
+            if unit.target_rank >= 0 or unit.common_seqno >= 0:
+                # targeted work may not run elsewhere; a fused batch
+                # member shares prefix books a duplicate would corrupt
+                continue
+            if unit.spilled:
+                continue  # payload not resident (defensive: pins unspill)
+            thr = thr_map.get((unit.job, unit.work_type))
+            if should_hedge(now - unit.time_stamp, thr,
+                            owner in suspects, min_age_s):
+                self._try_hedge(unit, owner, now,
+                                why="thr" if thr is not None
+                                and now - unit.time_stamp > thr
+                                else "suspect")
+
+    def _hedge_suspects(self, now: float) -> set:
+        """Stall signatures feeding the trigger — the PR 16 heuristic
+        (obs/slo.py suspect_ranks) over THIS server's scan window:
+        in-window growth of the owner-labelled lease-expiry cells, plus
+        (master only) gossip-stale members under the /healthz rule."""
+        from adlb_tpu.obs.slo import suspect_ranks
+
+        cur = self.metrics.labelled("leases_expired_by")
+        memo = self._hedge_expiry_memo
+        deltas = {k: v - memo.get(k, 0) for k, v in cur.items()}
+        self._hedge_expiry_memo = cur
+        stale = []
+        if self.is_master and self._obs_sync_armed and self._fleet_seen:
+            cut = 3.0 * self.cfg.obs_sync_interval
+            stale = [r for r, (_seq, at) in self._fleet_seen.items()
+                     if now - at > cut]
+        # the expiry-growth evidence is a point event (non-zero in
+        # exactly the one scan window that straddles it) but the stall
+        # it names persists — hold the suspicion for a lease-timeout so
+        # a rank that just expired one lease hedges its NEXT straggler
+        # promptly instead of only during a single 1/4-floor window
+        hold = max(self.cfg.lease_timeout_s,
+                   4.0 * self.cfg.hedge_min_age_ms / 1e3)
+        for r in suspect_ranks(stale, (), deltas):
+            self._hedge_suspect_until[r] = now + hold
+        for r in [r for r, t in self._hedge_suspect_until.items()
+                  if t <= now]:
+            del self._hedge_suspect_until[r]
+        return set(self._hedge_suspect_until)
+
+    def _try_hedge(self, unit, owner: int, now: float, why: str) -> None:
+        """Launch one hedge sibling for ``unit`` — or veto. Veto order
+        matters: backpressure signals veto STICKILY (overload is exactly
+        when a later retry would start a storm); an empty budget or no
+        parked taker only defers to a later scan."""
+        hm = self.hedges
+        seqno = unit.seqno
+        plen = len(unit.payload)
+        job = self.jobs.get(unit.job) if unit.job else None
+        over_quota = False
+        if job is not None and job.quota_bytes > 0:
+            part = self.wq.part(unit.job)
+            used = part.total_bytes if part is not None else 0
+            over_quota = used + plen > job.quota_bytes
+        if self.mem.under_pressure or over_quota:
+            hm.veto(seqno)
+            self.metrics.counter("hedges_vetoed",
+                                 reason="backpressure").inc()
+            self.flight.record(
+                f"hedge_vetoed seqno={seqno} reason=backpressure "
+                f"(pressure={self.mem.under_pressure} quota={over_quota})"
+            )
+            return
+        if not hm.try_debit(unit.job):
+            self.metrics.counter("hedges_vetoed", reason="budget").inc()
+            return  # transient: deliveries refill the bucket
+        # a hedge only launches INTO an already-parked requester on a
+        # different, live rank — no taker means no launch (the sibling
+        # must pin immediately; it never sits unpinned in open matching)
+        entry = None
+        for e in self.rq.entries():
+            if e.world_rank == owner or e.world_rank in self._dead_ranks:
+                continue
+            if e.job != unit.job or not e.wants(unit.work_type):
+                continue
+            entry = e
+            break
+        if entry is None:
+            hm.refund(unit.job)
+            self.metrics.counter("hedges_vetoed", reason="no_taker").inc()
+            return
+        if not self.mem.try_alloc(plen):
+            hm.refund(unit.job)
+            hm.veto(seqno)  # allocation failure IS backpressure
+            self.metrics.counter("hedges_vetoed",
+                                 reason="backpressure").inc()
+            return
+        sib = WorkUnit(
+            seqno=self._next_seqno,
+            work_type=unit.work_type,
+            prio=unit.prio,
+            target_rank=-1,
+            answer_rank=unit.answer_rank,
+            payload=unit.payload,
+            home_server=self.rank,
+            attempts=unit.attempts,
+            job=unit.job,
+        )
+        self._next_seqno += 1
+        hm.open(seqno, sib.seqno, unit.job)
+        self._m_hedges_launched.inc()
+        if unit.spans is not None:
+            # the origin stamps the hedge hop FIRST, then the sibling's
+            # journey starts as a copy of that history under its own
+            # (tail-minted) id — whichever copy terminates, the
+            # promoted journey shows the race (why=["hedged"])
+            self.journeys.stamp(unit, "hedge")
+            self.journeys.adopt(
+                sib, self.journeys.mint_tail_id(), list(unit.spans)
+            )
+        elif self.journeys.tail:
+            self.journeys.begin_tail(sib, now)
+            self.journeys.stamp(sib, "hedge")
+        self.wq.add(sib)
+        if self.wlog is not None:
+            self.wlog.log_put(sib, -1, None)
+            self.wlog.log_hedge(sib.seqno, seqno)
+        self.flight.record(
+            f"hedge_launched origin={seqno} sib={sib.seqno} owner={owner} "
+            f"taker={entry.world_rank} why={why} "
+            f"age_s={now - unit.time_stamp:.3f}"
+        )
+        # a launch is activity: an in-flight exhaustion vote must not
+        # conclude around the race (the fused delivery below settles it
+        # synchronously anyway; the handle path keeps it open)
+        self.activity += 1
+        self._job_activity(unit.job)
+        self._exhaust_held_since = None
+        self._pin(sib.seqno, entry.world_rank)
+        self._satisfy_parked(entry, sib, local=False)
+
+    def _hedge_settle(self, unit) -> None:
+        """First terminal among a hedge group's members: close the race
+        exactly once, BEFORE the winner's own settle proceeds — every
+        other live member is fenced against its pin owner (the loser's
+        late fetch answers ADLB_FENCED through the PR 5 check) and
+        removed from service, on this reactor, so no second payload can
+        ever leave the books."""
+        hm = self.hedges
+        if hm is None:
+            return
+        res = hm.settle(unit.seqno)
+        if res is None:
+            return
+        origin, losers = res
+        if unit.seqno != origin:
+            self._m_hedges_won.inc()
+        removed = 0
+        for s in losers:
+            u = self.wq.get(s)
+            if u is None:
+                continue
+            if u.pinned:
+                self._relay_inflight.pop(s, None)
+                self.leases.release(s)
+                self._add_fence(s, u.pin_rank)
+                if self.wlog is not None:
+                    self.wlog.log_fence(s, u.pin_rank)
+            self._m_hedges_fenced.inc()
+            self._unspill(u)
+            self.wq.remove(s)
+            self.mem.free(len(u.payload))
+            if self.wlog is not None:
+                self.wlog.log_remove(s)
+            # the loser's journey is released, never closed: the winner
+            # carries the hedge hop, and a loser fold would double the
+            # unit in every latency estimator
+            self.journeys.forget(u)
+            removed += 1
+            self.flight.record(
+                f"hedge_fenced loser={s} winner={unit.seqno} "
+                f"origin={origin}"
+            )
+        if removed:
+            self.activity += 1  # inventory changed under the vote
+
+    def _hedge_member_unpin(self, unit) -> bool:
+        """An open hedge-group member lost its pin WITHOUT terminating
+        (lease expiry / unreserve compensation / rank-death reclaim).
+        While a sibling still races, re-enqueueing this copy would put
+        two live duplicates into open matching with nobody left to
+        fence the loser — so it retires (fenced against its old owner,
+        removed, forgotten). Returns True when the caller must skip its
+        normal requeue. The LAST live copy returns False and re-enters
+        service through the caller's standard path: hedging never loses
+        work."""
+        hm = self.hedges
+        if hm is None:
+            return False
+        siblings = hm.survivors_of(unit.seqno)
+        if not any(self.wq.get(s) is not None for s in siblings):
+            if siblings:
+                # the race is over with this copy the survivor: dissolve
+                # the group and supersede the sibling's OP_HEDGE mark so
+                # recovery adopts it like any ordinary unit
+                hm.drop(unit.seqno)
+                self._hedge_relog(unit)
+            return False
+        hm.drop(unit.seqno)
+        self.leases.release(unit.seqno)
+        if unit.pinned and (unit.seqno, unit.pin_rank) not in self._fences:
+            self._add_fence(unit.seqno, unit.pin_rank)
+            if self.wlog is not None:
+                self.wlog.log_fence(unit.seqno, unit.pin_rank)
+        self._unspill(unit)
+        self.wq.remove(unit.seqno)
+        self.mem.free(len(unit.payload))
+        if self.wlog is not None:
+            self.wlog.log_remove(unit.seqno)
+        self.journeys.forget(unit)
+        self.flight.record(
+            f"hedge_member_retired seqno={unit.seqno} "
+            f"(sibling still racing)"
+        )
+        # whoever survives the race may need to dissolve too: if the
+        # retirement left exactly one member, it is an ordinary unit now
+        for s in siblings:
+            if not hm.survivors_of(s):
+                u = self.wq.get(s)
+                if u is not None:
+                    self._hedge_relog(u)
+                break
+        return True
+
+    def _hedge_relog(self, unit) -> None:
+        """A hedge race dissolved with ``unit`` the sole survivor:
+        re-log its OP_PUT so the mirror/WAL's OP_HEDGE mark is
+        superseded — recovery must adopt the survivor as an ordinary
+        unit, not discard it as a speculative sibling."""
+        if self.wlog is not None:
+            self.wlog.log_put(unit, -1, None)
 
     def _bump_attempts(self, unit, in_wq: bool) -> bool:
         """Account one failed delivery attempt; quarantine the unit when
@@ -4481,6 +4805,12 @@ class Server:
         """Move a unit to the dead-letter store: out of the wq (settled
         for exhaustion voting — termination never hangs on a poison
         unit), counted exactly-once, payload retained for retrieval."""
+        # quarantine is a terminal: it must close any hedge race (and
+        # fence the siblings) exactly like a delivery would — without
+        # it, a poisoned origin would leave its sibling racing a unit
+        # the books already settled. No budget credit: only deliveries
+        # fund the bucket.
+        self._hedge_settle(unit)
         self._unspill(unit)  # the dead-letter record keeps the payload
         if in_wq:
             self.wq.remove(unit.seqno)
@@ -4683,6 +5013,14 @@ class Server:
         for u in self.wq.units():
             if u.trace_id and u.spans is not None:
                 log.log_trace(u.seqno, u.trace_id, u.spans)
+        # open hedge races: each live sibling's OP_HEDGE mark must
+        # survive compaction (the fresh segment re-logs the sibling's
+        # OP_PUT above, which would otherwise launder it into an
+        # ordinary unit and recovery would adopt BOTH copies)
+        if self.hedges is not None:
+            for sib, origin in self.hedges.live_siblings():
+                if self.wq.get(sib) is not None:
+                    log.log_hedge(sib, origin)
 
     def _recover_from_wal(self) -> None:
         """Cold restart: replay the on-disk log (snapshot shard + tail)
@@ -4695,7 +5033,19 @@ class Server:
         if mirror is None:
             return
         n_units = 0  # adopted: units, commons, quarantine, job table
+        hedge_dropped = 0
         for seqno in sorted(mirror.units):
+            if seqno in mirror.hedges:
+                # live hedge SIBLING at crash time: a speculative copy
+                # of an origin that also recovers — adopting both would
+                # hand two live duplicates to a restarted world with the
+                # group state gone. Discard the sibling; the origin
+                # re-enqueues, re-execution falls inside the documented
+                # lease-expiry at-least-once window. (A sibling that WON
+                # its race was superseded by OP_CONSUME, and one that
+                # survived a dissolved race by a fresh OP_PUT.)
+                hedge_dropped += 1
+                continue
             f = dict(mirror.units[seqno])
             payload = f.pop("payload")
             trace_id = f.pop("trace_id", 0)
@@ -4757,6 +5107,7 @@ class Server:
                 f"commons={len(mirror.commons)} "
                 f"quarantined={len(mirror.quarantined)} "
                 f"jobs={len(mirror.jobs_meta)} "
+                f"hedge_siblings_dropped={hedge_dropped} "
                 f"torn_tail={self.wal.recovered_torn}"
             )
             aprintf(
@@ -5556,6 +5907,10 @@ class Server:
                 self.journeys.forget(unit)
                 self._consume(unit)
                 continue
+            if self._hedge_member_unpin(unit):
+                # a hedge sibling still races: the leaver's copy retires
+                reclaimed += 1
+                continue
             self.wq.unpin(lease.seqno)
             if self.wlog is not None:
                 self.wlog.log_unpin(lease.seqno)
@@ -5979,6 +6334,12 @@ class Server:
                         f"relay_consumed_on_death seqno={lease.seqno} "
                         f"rank={rank}"
                     )
+                    continue
+                if self._hedge_member_unpin(unit):
+                    # a hedge sibling still races for this logical put:
+                    # the dead owner's copy retires instead of becoming
+                    # a second live duplicate in open matching
+                    reclaimed += 1
                     continue
                 self.wq.unpin(lease.seqno)
                 if self.wlog is not None:
@@ -6669,7 +7030,25 @@ class Server:
         # lease behind a seqno translation (the client's in-flight fetch
         # lands here via the fo_from reroute); everything else re-enqueues
         adopted = pinned_kept = lost = 0
+        hedge_dropped = 0
         for old_seqno in sorted(mirror.units):
+            if old_seqno in mirror.hedges:
+                # live hedge SIBLING at takeover: its origin is in this
+                # same mirror and adopts normally — adopting the sibling
+                # too would hand the new home two live duplicates with
+                # no group state to fence the loser. Drop the sibling
+                # (not a counted loss: the logical put survives via the
+                # origin) and FENCE its pinned owner, so the rerouted
+                # late fetch answers ADLB_FENCED (you lost the race —
+                # re-reserve) instead of a miscounted failover loss.
+                pin_rank = mirror.pins.get(old_seqno, -1)
+                if pin_rank >= 0:
+                    self._adopted_fences.add((dead, old_seqno, pin_rank))
+                    if self.wlog is not None:
+                        self.wlog.log_fence(old_seqno, pin_rank,
+                                            origin=dead)
+                hedge_dropped += 1
+                continue
             f = mirror.units[old_seqno]
             pin_rank = mirror.pins.get(old_seqno, -1)
             target = f["target_rank"]
@@ -6813,6 +7192,7 @@ class Server:
         self.flight.record(
             f"failover_promoted dead={dead} adopted_units={adopted} "
             f"pinned_kept={pinned_kept} lost={lost} "
+            f"hedge_siblings_dropped={hedge_dropped} "
             f"commons={len(mirror.commons)} ranks={sorted(newly)} "
             f"mttr_ms={mttr_ms:.1f}"
         )

@@ -188,6 +188,27 @@ class Config:
     # 0 = unlimited retries (reference-faithful: reclaim re-enqueues
     # forever).
     max_unit_retries: int = 0
+    # tail hedging (runtime/hedge.py): when > 0 the home server
+    # speculatively re-dispatches a leased-but-unfetched unit whose age
+    # crossed the live per-(job, type) p99 threshold the master gossips
+    # (SS_OBS_SYNC `thr`) — or whose lease holder shows a stall
+    # signature (the shared obs/slo.py suspect heuristic) — to a parked
+    # requester on a DIFFERENT rank. First terminal wins and closes the
+    # books exactly once; every losing sibling is fenced through the
+    # (seqno, owner) machinery, so the at-least-once window stays
+    # exactly the documented lease-expiry one. The value doubles as the
+    # per-job token-bucket refill per delivered unit: launches are
+    # bounded by ~frac x deliveries (+ a small burst) by construction,
+    # and any backpressure signal (memory watermark, job quota,
+    # allocation failure) vetoes a launch stickily — hedging always
+    # yields to overload. Requires lease_timeout_s > 0 (the trigger
+    # scans the lease table; fencing IS the lease machinery). 0 = off:
+    # frame-identical to an unhedged world.
+    hedge_budget_frac: float = 0.0
+    # age floor (ms) below which a unit is never hedged regardless of
+    # threshold or suspicion — cold-start p99 noise must not burn the
+    # budget on units that are not stragglers yet
+    hedge_min_age_ms: float = 100.0
     # memory watermarks (fractions of max_malloc_per_server): above SOFT
     # the server engages memory-pressure pushes (the reference's
     # THRESHOLD_TO_START_PUSH, src/adlb.c:93 — 0.95 there and here) and
@@ -507,6 +528,21 @@ class Config:
         if self.max_unit_retries > 0 and self.server_impl == "native":
             raise ValueError(
                 "max_unit_retries > 0 requires server_impl='python'"
+            )
+        if not (0.0 <= self.hedge_budget_frac <= 1.0):
+            raise ValueError("hedge_budget_frac must be in [0, 1]")
+        if self.hedge_min_age_ms < 0:
+            raise ValueError("hedge_min_age_ms must be >= 0")
+        if self.hedge_budget_frac > 0 and self.server_impl == "native":
+            # the C++ daemon has no lease table or hedge bookkeeping
+            raise ValueError(
+                "hedge_budget_frac > 0 requires server_impl='python'"
+            )
+        if self.hedge_budget_frac > 0 and self.lease_timeout_s <= 0:
+            # the trigger scans the lease table and the loser's fence
+            # is the lease-expiry fence — unarmed leases mean neither
+            raise ValueError(
+                "hedge_budget_frac > 0 requires lease_timeout_s > 0"
             )
         if not (0.0 < self.mem_soft_frac <= 1.0):
             raise ValueError("mem_soft_frac must be in (0, 1]")

@@ -1722,6 +1722,171 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         member_rows = {"membership_error": repr(e)[:200]}
 
+    # tail hedging (round 12, ISSUE 17): two rows. hedge_p999 — the
+    # straggler-rescue arm: a worker freezes while holding an unfetched
+    # reservation strictly UNDER the lease timeout, so only the hedge
+    # plane (budgeted speculative sibling, fenced first-wins) can close
+    # the unit early; the row is the answer-economy completion time
+    # with hedging on vs off over the same stall, medians over
+    # interleaved reps. hedge_storm — the budget-subordination arm: a
+    # put-storm shape driven handler-by-handler against one hedging
+    # server with a forced memory-pressure window mid-storm, recording
+    # launches vs the token-bucket bound (frac x deliveries + burst)
+    # and the count of sticky-vetoed origins that later launched — both
+    # structural zeros by construction, guarded absolutely. Own
+    # containment.
+    def hedge_bench():
+        import struct as _struct
+
+        from adlb_tpu.runtime.membership import ElasticWorld
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        T, T_ANS = 1, 3
+        n_units = 8
+        stall_s = 1.2
+
+        def one_world(hedge_on):
+            cfg = Config(
+                exhaust_check_interval=0.2, on_worker_failure="reclaim",
+                lease_timeout_s=4.0,
+                hedge_budget_frac=0.5 if hedge_on else 0.0,
+                hedge_min_age_ms=80.0,
+            )
+            ew = ElasticWorld(3, 1, [T, T_ANS], cfg=cfg)
+            if hedge_on:
+                for s in ew.servers.values():
+                    # what the master's obs gossip would install
+                    s.journeys.tail_thr = {(0, T): 0.25}
+
+            def collector(ctx):
+                for i in range(n_units):
+                    assert ctx.put(_struct.pack("<q", i), T,
+                                   answer_rank=0) == _OK
+                t0 = time.perf_counter()
+                seen = set()
+                while len(seen) < n_units:
+                    rc, r = ctx.reserve([T_ANS])
+                    assert rc == _OK, rc
+                    rc, buf = ctx.get_reserved(r.handle)
+                    if rc != _OK:
+                        continue
+                    seen.add(_struct.unpack("<q", buf)[0])
+                return (time.perf_counter() - t0) * 1e3
+
+            def worker(sleepy):
+                def app(ctx):
+                    n, slept = 0, False
+                    while True:
+                        rc, r = ctx.reserve([T])
+                        if rc != _OK:
+                            return n
+                        if sleepy and not slept:
+                            slept = True
+                            time.sleep(stall_s)  # reserved, unfetched
+                        rc, buf = ctx.get_reserved(r.handle)
+                        if rc != _OK:
+                            continue  # fenced: the sibling won
+                        ctx.put(buf, T_ANS, target_rank=0)
+                        n += 1
+                return app
+
+            ew.run_app(0, collector)
+            ew.run_app(1, worker(True))
+            ew.run_app(2, worker(False))
+            res = ew.finish(timeout=60)
+            done = res[1] + res[2]
+            assert done == n_units, f"hedge bench lost work ({done})"
+            return res[0]
+
+        on_ms, off_ms = [], []
+        for rep in range(3):
+            order = (True, False) if rep % 2 == 0 else (False, True)
+            for m in order:
+                (on_ms if m else off_ms).append(one_world(m))
+
+        # -- hedge_storm: budget subordination under a put storm -------
+        from adlb_tpu.runtime.hedge import BURST_TOKENS
+        from adlb_tpu.runtime.messages import Tag as _Tag
+        from adlb_tpu.runtime.messages import msg as _msg
+        from adlb_tpu.runtime.server import Server as _Server
+        from adlb_tpu.runtime.transport import InProcFabric as _Fab
+        from adlb_tpu.runtime.world import WorldSpec as _WS
+
+        frac, rounds = 0.25, 40
+        world = _WS(nranks=4, nservers=2, types=(T,))
+        fab = _Fab(4)
+        srv = _Server(
+            world,
+            Config(on_worker_failure="reclaim", lease_timeout_s=0.5,
+                   hedge_budget_frac=frac, hedge_min_age_ms=50.0,
+                   max_malloc_per_server=1024, mem_soft_frac=0.6),
+            fab.endpoint(2),
+        )
+        srv.journeys.tail_thr[(0, T)] = 0.01
+
+        def drain(rank):
+            while fab.endpoints[rank].recv(timeout=0.0) is not None:
+                pass
+
+        for i in range(rounds):
+            srv._handle(_msg(_Tag.FA_PUT, 0, payload=b"u%d" % i,
+                             work_type=T, prio=0, target_rank=-1,
+                             answer_rank=-1, common_len=0,
+                             common_server=-1, common_seqno=-1))
+            srv._handle(_msg(_Tag.FA_RESERVE, 0, req_types=[T],
+                             hang=True, rqseqno=2 * i + 1))
+            drain(0)
+            srv._handle(_msg(_Tag.FA_RESERVE, 1, req_types=[T],
+                             hang=True, rqseqno=2 * i + 2))
+            pressured = 10 <= i < 20  # mid-storm overload window
+            if pressured:
+                srv.mem.alloc(800)
+            srv._scan_hedges(time.monotonic() + 1.0)
+            if pressured:
+                srv.mem.free(800)
+            for ls in list(srv.leases.leases()):
+                u = srv.wq.get(ls.seqno)
+                if u is None or not u.pinned:
+                    continue
+                srv._handle(_msg(_Tag.FA_GET_RESERVED, ls.owner,
+                                 seqno=ls.seqno))
+            drain(0)
+            drain(1)
+        assert srv.wq.count == 0, "hedge storm left unsettled inventory"
+        launched_seqs, vetoed_seqs = set(), set()
+        for _, txt in srv.flight.entries():
+            if txt.startswith("hedge_launched"):
+                launched_seqs.add(txt.split("origin=")[1].split()[0])
+            elif txt.startswith("hedge_vetoed") and "backpressure" in txt:
+                vetoed_seqs.add(txt.split("seqno=")[1].split()[0])
+        launched = int(srv.metrics.value("hedges_launched"))
+        bound = frac * rounds + BURST_TOKENS
+        return {
+            "hedge_p999_on_ms": round(med(on_ms), 1),
+            "hedge_p999_off_ms": round(med(off_ms), 1),
+            "hedge_p999_rescue_ratio": round(
+                med(off_ms) / med(on_ms), 2) if med(on_ms) else 0.0,
+            "hedge_p999_on_ms_reps": [round(x, 1) for x in on_ms],
+            "hedge_p999_off_ms_reps": [round(x, 1) for x in off_ms],
+            "hedge_storm_deliveries": rounds,
+            "hedge_storm_launched": launched,
+            "hedge_storm_budget_bound": round(bound, 1),
+            "hedge_storm_launch_excess": round(
+                max(0.0, launched - bound), 1),
+            "hedge_storm_vetoed_backpressure": len(vetoed_seqs),
+            "hedge_storm_veto_breaches": len(
+                launched_seqs & vetoed_seqs),
+        }
+
+    try:
+        hedge_rows = hedge_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        hedge_rows = {"hedge_error": repr(e)[:200]}
+
     # measurement provenance (the r07 caveat made policy): every record
     # carries the core count + load so cross-round comparisons can tell
     # a real regression from a different (or busy) box — bench_guard
@@ -1853,6 +2018,7 @@ def main() -> None:
             **tail_rows,
             **slo_rows,
             **member_rows,
+            **hedge_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -2034,6 +2200,16 @@ def main() -> None:
             # medians over reps — bench_guard "member" row
             "attach_ms": member_rows.get("attach_ms"),
             "scaleout_mttr_ms": member_rows.get("scaleout_mttr_ms"),
+            # tail hedging (round 12): straggler completion with the
+            # hedge plane on vs off over the same sub-lease stall, and
+            # the put-storm budget-subordination counters — bench_guard
+            # "hedge" row + absolute zero-excess/zero-breach arms
+            "hedge_p999": [hedge_rows.get("hedge_p999_on_ms"),
+                           hedge_rows.get("hedge_p999_off_ms")],
+            "hedge_storm_launch_excess": hedge_rows.get(
+                "hedge_storm_launch_excess"),
+            "hedge_storm_veto_breaches": hedge_rows.get(
+                "hedge_storm_veto_breaches"),
             "mux_burst8": [mux_rows.get("mux_burst8_batched_ms"),
                            mux_rows.get("mux_burst8_sequential_ms")],
             "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),

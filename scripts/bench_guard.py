@@ -114,6 +114,14 @@ GUARDS = {
         ("attach", "attach_ms"),
         ("scaleout", "scaleout_mttr_ms"),
     ],
+    # tail hedging (r12 metric; older baselines skip with a note): the
+    # SIGSTOP-straggler arm's completion time with the hedge plane ON —
+    # a regression here means the speculative rescue got slower (or
+    # stopped firing, in which case the value jumps to the stall
+    # length). The off arm rides in the compact pair for reference.
+    "hedge": [
+        ("rescue", "hedge_p999_on_ms"),
+    ],
 }
 
 # Absolute arms: self-contained bounds checked against the NEW record
@@ -141,6 +149,14 @@ ABSOLUTE = [
     # observed-but-unobjectived world
     ("slo_overhead_ratio", 1.05,
      "slo-eval-armed/off coinop run-CPU adjacent-pair ratio"),
+    # ISSUE 17: hedging is budget-bounded and backpressure-subordinate
+    # STRUCTURALLY — the storm arm may never launch past the token
+    # bucket (frac x deliveries + burst) and a sticky-vetoed origin may
+    # never launch a sibling afterwards; both bounds are exact zeros
+    ("hedge_storm_launch_excess", 0.0,
+     "hedge launches over the token-bucket bound under a put storm"),
+    ("hedge_storm_veto_breaches", 0.0,
+     "sticky-vetoed origins that later launched a sibling"),
 ]
 
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?)"

@@ -496,6 +496,108 @@ def churn_world(rng, apps, servers, mode, policy):
     )
 
 
+def hedge_world(rng, apps, mode, policy, fabric=None):
+    """Tail-hedging adversity (ISSUE 17): hedging armed, one worker
+    SIGSTOPs while holding an unfetched reservation WITHOUT crossing
+    the lease timeout — only the hedge plane can rescue the straggler
+    early (the p99 trigger: once the rest of the pool drains, the
+    frozen unit's age walks past the gossiped tail threshold and the
+    home server speculatively re-dispatches it to a parked worker).
+
+    One server on purpose: the sibling targets a parked requester at
+    the straggler's HOME, so a single roof makes the launch
+    deterministic. The oracle is zero double-count under both worker
+    policies: every id answered exactly once at rank 0 AND executed
+    exactly once across the pool (the fenced loser's fetch answers a
+    retry, never a second payload), with the launch itself asserted
+    through the merged /metrics view so the adversity can't pass
+    vacuously."""
+    T, T_ANS = 1, 3
+    n_units = 120
+    victim = rng.randrange(1, apps)
+    # stall once the fleet has closed ~70 units: past TAIL_MIN_COUNT
+    # (the p99 threshold exists) with plenty of pool left to drain
+    stall_after = max(1, 70 // max(apps - 1, 1))
+    lease_s = round(2.0 * load_factor(), 2)
+    stall_s = round(0.45 * lease_s, 2)  # strictly under expiry
+    port = probe_free_ports(1)[0]
+
+    def app(ctx):
+        from adlb_tpu.runtime.faults import sigstop_self
+
+        if ctx.rank == 0:
+            for i in range(n_units):
+                rc = ctx.put(struct.pack("<q", i), T, answer_rank=0)
+                assert rc == ADLB_SUCCESS, rc
+            seen = set()
+            while len(seen) < n_units:
+                rc, r = ctx.reserve([T_ANS])
+                assert rc == ADLB_SUCCESS, rc
+                rc, buf = ctx.get_reserved(r.handle)
+                if rc != ADLB_SUCCESS:
+                    continue
+                seen.add(struct.unpack("<q", buf)[0])
+            # hold the world open until the launch is visible in the
+            # merged fleet metrics (bounded — the rescue already
+            # happened or rank 0 would still be short an answer)
+            import urllib.request
+            launched = False
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and not launched:
+                try:
+                    text = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5,
+                    ).read().decode()
+                except OSError:
+                    time.sleep(0.4)
+                    continue
+                for ln in text.splitlines():
+                    if ln.startswith("#") or "hedges_launched" not in ln:
+                        continue
+                    try:
+                        launched = launched or float(ln.split()[-1]) > 0
+                    except ValueError:
+                        pass
+                if not launched:
+                    time.sleep(0.4)
+            ctx.set_problem_done()
+            return len(seen), launched
+        n, retries, stopped = 0, 0, False
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return n, retries, stopped
+            if ctx.rank == victim and n >= stall_after and not stopped:
+                stopped = True
+                sigstop_self(stall_s)  # reserved, unfetched, frozen
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc != ADLB_SUCCESS:
+                retries += 1  # fenced: the hedge sibling won the race
+                continue
+            ctx.put(buf, T_ANS, target_rank=0)
+            n += 1
+            time.sleep(0.003)
+
+    kw = dict(balancer=mode, exhaust_check_interval=0.2,
+              on_worker_failure=policy, lease_timeout_s=lease_s,
+              hedge_budget_frac=0.5, hedge_min_age_ms=150.0,
+              ops_port=port, obs_sync_interval=0.25, trace_sample=0.0)
+    if fabric:
+        kw["fabric"] = fabric
+    res = spawn_world(apps, 1, [T, T_ANS], app, cfg=Config(**kw),
+                      timeout=150.0)
+    seen, launched = res.app_results[0]
+    assert seen == n_units, res.app_results
+    executed = sum(res.app_results[r][0] for r in range(1, apps))
+    assert executed == n_units, (
+        f"double count under hedging: executed={executed} want={n_units}"
+    )
+    assert launched, "hedge adversity never launched a sibling"
+    assert victim in res.app_results, "stalled worker vanished"
+    return dict(workload="hedge", apps=apps, servers=1, mode=mode,
+                policy=policy, stall_s=stall_s, n_units=n_units)
+
+
 def one_iter(seed, fabric=None):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
@@ -547,14 +649,28 @@ def one_iter(seed, fabric=None):
         and not do_skill and not do_stall and not do_poison
         and apps >= 5 and rng.random() < 0.4
     )
+    # tail-hedging adversity (ISSUE 17): a straggler frozen strictly
+    # under the lease timeout — only a speculative sibling can rescue
+    # it early; zero double-count asserted under both worker policies
+    do_hedge = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and not do_stall and not do_poison
+        and not do_two_jobs and apps >= 3 and rng.random() < 0.3
+    )
     # elastic-membership churn (ISSUE 15): ranks joining/leaving
     # mid-world + a server scale-out under a put storm, both worker
     # policies; python servers only (the daemon keeps the fixed world)
     do_churn = (
         workload == "economy" and not do_abort and not do_kill
         and not do_skill and not do_stall and not do_poison
-        and not do_two_jobs and rng.random() < 0.35
+        and not do_two_jobs and not do_hedge and rng.random() < 0.35
     )
+    if do_hedge:
+        return hedge_world(
+            rng, apps, mode,
+            policy=rng.choice(["abort", "reclaim"]),
+            fabric=fabric,
+        )
     if do_churn:
         return churn_world(
             rng, apps, servers, mode,

@@ -1,0 +1,796 @@
+"""Tail hedging: budgeted speculative re-dispatch of p99 stragglers,
+fenced first-wins (ISSUE 17 tentpole, adlb_tpu/runtime/hedge.py).
+
+Coverage layers:
+
+* **Trigger + bucket mechanics** — the pure `should_hedge` predicate
+  (age floor, threshold crossing, suspect-owner fallback) and the
+  per-job token bucket (initial grant, burst cap, credit-per-delivery,
+  refund, sticky-vs-transient vetoes), plus the group bookkeeping that
+  the server's settle path drives.
+* **Shared stall heuristic** (satellite: PR 16 extraction) — the
+  module-level `suspect_ranks` consumed by BOTH the incident builder
+  and the hedge trigger, tested directly over all three signals.
+* **Server race lattice** — handler-driven Servers: a straggling lease
+  past the gossiped p99 launches ONE pinned sibling at an
+  already-parked different rank; first terminal wins on BOTH orderings
+  with the loser fenced (ADLB_FENCED at the fetch, never a second
+  payload); budget and backpressure vetoes (sticky where overload is
+  the cause, structural "no vetoed-then-launched"); expiry/rank-death
+  of a racing member retires the copy while the LAST live copy always
+  re-enters service; quarantine terminals settle the race too.
+* **Durability** — OP_HEDGE rides replication + WAL append-only:
+  mirror lifecycle (OP_PUT supersedes the mark; consume/remove/
+  quarantine pop it), failover adoption drops live siblings and FENCES
+  their owners (no miscounted loss), cold restart re-executes only the
+  origin, compaction re-seeds marks for open races.
+* **Observability** — hedged journeys ALWAYS promote to the tail store
+  with the `hedge` hop and `why=["hedged"]`; SLO incident bundles
+  carry the burn-window hedge counter delta; unconfigured worlds are
+  frame-identical (no hedge counters exist to gossip).
+* **End-to-end** — an in-proc ElasticWorld where a sleeping worker's
+  straggler is rescued by a hedge long before its (long) lease could
+  expire, with exact exactly-once conservation; and the slow-marked
+  TCP acceptance world: a SIGSTOP'd worker under hedging completes
+  materially faster than the lease-expiry-only world.
+"""
+
+import struct
+import time
+
+import pytest
+
+from adlb_tpu.obs.slo import suspect_ranks
+from adlb_tpu.runtime.hedge import (
+    BURST_TOKENS,
+    HedgeManager,
+    INITIAL_TOKENS,
+    should_hedge,
+)
+from adlb_tpu.runtime.membership import ElasticWorld
+from adlb_tpu.runtime.messages import Msg, Tag, msg
+from adlb_tpu.runtime.queues import WorkUnit
+from adlb_tpu.runtime.replica import ReplicaMirror, ReplicationLog
+from adlb_tpu.runtime.server import Server
+from adlb_tpu.runtime.transport import InProcFabric
+from adlb_tpu.runtime.transport_tcp import spawn_world
+from adlb_tpu.runtime.world import Config, WorldSpec
+from adlb_tpu.types import ADLB_FENCED, ADLB_RETRY, ADLB_SUCCESS
+
+T = 1
+
+
+# ----------------------------------------------- trigger + bucket mechanics
+
+
+def test_should_hedge_trigger_matrix():
+    # below the age floor nothing fires, whatever the evidence
+    assert not should_hedge(0.04, 0.01, True, min_age_s=0.05)
+    # past the floor: the gossiped p99 crossing fires
+    assert should_hedge(0.30, 0.25, False, min_age_s=0.05)
+    assert not should_hedge(0.20, 0.25, False, min_age_s=0.05)
+    # no threshold armed: the suspect-owner signature is the fallback
+    assert should_hedge(0.30, None, True, min_age_s=0.05)
+    assert not should_hedge(0.30, None, False, min_age_s=0.05)
+
+
+def test_budget_token_bucket():
+    hm = HedgeManager(0.25)
+    assert hm.tokens(0) == INITIAL_TOKENS
+    assert hm.try_debit(0)  # the initial grant funds one launch
+    assert hm.tokens(0) == 0.0
+    assert not hm.try_debit(0)  # empty: vetoed until deliveries refill
+    for _ in range(3):
+        hm.credit(0)
+    assert not hm.try_debit(0)  # 0.75 < 1.0
+    hm.credit(0)
+    assert hm.try_debit(0)  # 4 deliveries bought 1 launch: frac exact
+    hm.refund(0)
+    assert hm.tokens(0) == 1.0  # a launch that found no taker undoes
+    for _ in range(100):
+        hm.credit(0)
+    assert hm.tokens(0) == BURST_TOKENS  # bounded burst, not unbounded
+    # per-job isolation: job 7's bucket is its own
+    assert hm.tokens(7) == INITIAL_TOKENS
+
+
+def test_veto_stickiness_is_bounded():
+    hm = HedgeManager(0.5)
+    hm.veto(5)
+    assert hm.is_vetoed(5) and not hm.is_vetoed(6)
+    for s in range(100000):  # far past MAX_VETOED: bounded, FIFO evict
+        hm.veto(1000 + s)
+    assert not hm.is_vetoed(5)
+    assert len(hm._vetoed) <= 65536
+
+
+def test_group_settle_both_orders_and_drop():
+    hm = HedgeManager(0.5)
+    hm.open(10, 11, job=0)
+    assert hm.is_member(10) and hm.is_member(11)
+    assert hm.group_of(11).origin == 10
+    assert sorted(hm.survivors_of(10)) == [11]
+    # sibling terminates first: origin is the loser
+    assert hm.settle(11) == (10, [10])
+    assert hm.settle(11) is None  # exactly once: the group dissolved
+    assert not hm.is_member(10)
+    # origin terminates first: sibling is the loser
+    hm.open(20, 21, job=0)
+    assert hm.settle(20) == (20, [21])
+    # drop dissolves when one member remains
+    hm.open(30, 31, job=0)
+    hm.drop(30)
+    assert not hm.is_member(31), "sole survivor is an ordinary unit"
+    assert list(hm.live_siblings()) == []
+
+
+# ------------------------------------- shared stall heuristic (satellite)
+
+
+def test_suspect_ranks_unions_three_signals():
+    tails = [{"slow_rank": 5, "why": ["slow"]}, {"why": ["slow"]}]
+    deltas = {
+        "leases_expired_by{owner=7}": 2.0,  # grew: suspect
+        "leases_expired_by{owner=8}": 0.0,  # flat: not
+        "leases_expired_by{owner=bogus}": 3.0,  # unparseable: ignored
+        "puts": 9.0,  # unrelated cell: ignored
+    }
+    assert suspect_ranks(["3"], tails, deltas) == {3, 5, 7}
+    # every input is optional — each caller feeds what its window has
+    assert suspect_ranks(None, None, None) == set()
+    assert suspect_ranks((), (), {}) == set()
+
+
+# ------------------------------------------------- server race lattice
+
+
+def _srv(**cfg_kw):
+    """A hedging Server on an in-proc fabric, driven handler-by-handler.
+    world: apps 0..1, servers 2..3 (we drive rank 2)."""
+    cfg_kw.setdefault("on_worker_failure", "reclaim")
+    cfg_kw.setdefault("lease_timeout_s", 0.5)
+    cfg_kw.setdefault("hedge_budget_frac", 0.5)
+    cfg_kw.setdefault("hedge_min_age_ms", 50.0)
+    world = WorldSpec(nranks=4, nservers=2, types=(T,))
+    fabric = InProcFabric(4)
+    return Server(world, Config(**cfg_kw), fabric.endpoint(2)), fabric
+
+
+def _drain(fabric, rank):
+    out = []
+    while True:
+        m = fabric.endpoints[rank].recv(timeout=0.0)
+        if m is None:
+            return out
+        out.append(m)
+
+
+def _put(srv, src=0, payload=b"unit", work_type=T, target=-1):
+    srv._handle(msg(Tag.FA_PUT, src, payload=payload, work_type=work_type,
+                    prio=0, target_rank=target, answer_rank=-1,
+                    common_len=0, common_server=-1, common_seqno=-1))
+
+
+def _reserve(srv, src, rqseqno=1, types=(T,)):
+    srv._handle(msg(Tag.FA_RESERVE, src, req_types=list(types), hang=True,
+                    rqseqno=rqseqno))
+
+
+def _hedge_setup(srv, fabric, thr=0.2, age=1.0):
+    """put -> rank 0 pins -> rank 1 parks -> scan launches the sibling.
+    Returns (origin_seqno, sibling_seqno)."""
+    _put(srv)
+    [u] = list(srv.wq.units())
+    origin = u.seqno
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    _reserve(srv, 1)
+    assert not [m for m in _drain(fabric, 1)
+                if m.tag is Tag.TA_RESERVE_RESP], "rank 1 did not park"
+    srv.journeys.tail_thr[(0, T)] = thr
+    srv._scan_hedges(time.monotonic() + age)
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_RESERVE_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS
+    return origin, resp.handle[0]
+
+
+def _fetch(srv, fabric, rank, seqno):
+    srv._handle(msg(Tag.FA_GET_RESERVED, rank, seqno=seqno))
+    return [m for m in _drain(fabric, rank)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+
+
+def test_hedge_launches_pinned_sibling_at_parked_rank():
+    srv, fabric = _srv()
+    origin, sib = _hedge_setup(srv, fabric)
+    assert sib != origin
+    assert srv.metrics.value("hedges_launched") == 1
+    # both copies pinned under DISTINCT lease identities (no sibling
+    # ever sits unpinned where migration/push/RFR could move it)
+    assert srv.wq.count == 2 and len(srv.leases) == 2
+    o, s = srv.wq.get(origin), srv.wq.get(sib)
+    assert o.pinned and o.pin_rank == 0
+    assert s.pinned and s.pin_rank == 1
+    assert srv.hedges.is_member(origin) and srv.hedges.is_member(sib)
+    texts = [t for _, t in srv.flight.entries()]
+    assert any(t.startswith("hedge_launched") and "why=thr" in t
+               for t in texts)
+    # the budget paid for it
+    assert srv.hedges.tokens(0) == 0.0
+
+
+@pytest.mark.parametrize("winner", ["sibling", "origin"])
+def test_first_terminal_wins_loser_fenced(winner):
+    srv, fabric = _srv()
+    origin, sib = _hedge_setup(srv, fabric)
+    first = (1, sib) if winner == "sibling" else (0, origin)
+    second = (0, origin) if winner == "sibling" else (1, sib)
+    resp = _fetch(srv, fabric, *first)
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"unit"
+    # the first terminal dissolved the race: the loser is OUT of the
+    # books before any second payload could leave
+    assert srv.wq.count == 0 and len(srv.leases) == 0
+    loser_rank, loser_seqno = second
+    assert (loser_seqno, loser_rank) in srv._fences
+    resp = _fetch(srv, fabric, *second)
+    assert resp.rc == ADLB_FENCED, "second delivery left the books"
+    assert srv.metrics.value("hedges_fenced") == 1
+    assert srv.metrics.value("hedges_won") == \
+        (1 if winner == "sibling" else 0)
+    # books conserved: one put, one delivery, nothing queued or leased
+    assert srv.wq.num_unpinned() == 0
+
+
+def test_min_age_floor_and_skip_rules():
+    srv, fabric = _srv(hedge_min_age_ms=200.0)
+    _put(srv)
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    _reserve(srv, 1)
+    srv.journeys.tail_thr[(0, T)] = 0.01
+    # under the floor: nothing, whatever the threshold says
+    srv._scan_hedges(time.monotonic() + 0.1)
+    assert srv.metrics.value("hedges_launched") == 0
+    # a TARGETED straggler never hedges (may not run elsewhere)
+    srv2, fabric2 = _srv()
+    _put(srv2, target=1)
+    _reserve(srv2, 1)
+    _drain(fabric2, 1)
+    _reserve(srv2, 0)
+    srv2.journeys.tail_thr[(0, T)] = 0.01
+    srv2._scan_hedges(time.monotonic() + 1.0)
+    assert srv2.metrics.value("hedges_launched") == 0
+
+
+def test_budget_veto_then_deliveries_refill():
+    srv, fabric = _srv(hedge_budget_frac=0.5)
+    origin, sib = _hedge_setup(srv, fabric)  # spent the initial token
+    # a second straggler with an empty bucket: transient budget veto
+    _put(srv, payload=b"second")
+    second = [u.seqno for u in srv.wq.units()
+              if u.seqno not in (origin, sib)][0]
+    _reserve(srv, 0, rqseqno=2)  # rank 0 leases "second": a straggler
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_vetoed", reason="budget") >= 1
+    assert not srv.hedges.is_vetoed(second), "budget veto must not stick"
+    # two deliveries at frac=0.5 fund the next launch
+    resp = _fetch(srv, fabric, 1, sib)
+    assert resp.rc == ADLB_SUCCESS
+    assert srv.hedges.tokens(0) == 0.5
+    srv.hedges.credit(0)  # the second delivery's credit
+    _reserve(srv, 1, rqseqno=3)  # a fresh parked taker for the launch
+    before = srv.metrics.value("hedges_launched")
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_launched") == before + 1
+
+
+def test_backpressure_veto_is_sticky():
+    srv, fabric = _srv(max_malloc_per_server=100, mem_soft_frac=0.5)
+    _put(srv, payload=b"x" * 60)  # 60/100: above the soft watermark
+    [u] = list(srv.wq.units())
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    _reserve(srv, 1)
+    srv.journeys.tail_thr[(0, T)] = 0.01
+    assert srv.mem.under_pressure
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_launched") == 0
+    assert srv.metrics.value("hedges_vetoed", reason="backpressure") == 1
+    assert srv.hedges.is_vetoed(u.seqno)
+    # pressure relieved later: the veto STAYS — overload was the moment
+    # a retry would have started the storm (structural no-storm)
+    srv.mem.free(50)
+    assert not srv.mem.under_pressure
+    srv._scan_hedges(time.monotonic() + 2.0)
+    assert srv.metrics.value("hedges_launched") == 0, \
+        "vetoed-then-launched must be impossible"
+    srv.mem.alloc(50)  # restore the books for teardown
+
+
+def test_no_taker_refunds_budget_not_sticky():
+    srv, fabric = _srv()
+    _put(srv)
+    [u] = list(srv.wq.units())
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    # nobody parked: no launch, token refunded, veto transient
+    srv.journeys.tail_thr[(0, T)] = 0.01
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_launched") == 0
+    assert srv.metrics.value("hedges_vetoed", reason="no_taker") == 1
+    assert srv.hedges.tokens(0) == INITIAL_TOKENS
+    assert not srv.hedges.is_vetoed(u.seqno)
+    # the straggler's OWN rank parking again must not count as a taker
+    _reserve(srv, 0, rqseqno=2)
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_launched") == 0
+
+
+def test_suspect_owner_trigger_with_decay_hold():
+    srv, fabric = _srv()  # NO threshold armed anywhere
+    _put(srv)
+    _reserve(srv, 0)
+    _drain(fabric, 0)
+    _reserve(srv, 1)
+    # the PR 16 stall signature: rank 0's lease-expiry cell grew inside
+    # the scan window (as _expire_lease would have bumped it)
+    srv.metrics.counter("leases_expired_by", owner="0").inc()
+    srv._scan_hedges(time.monotonic() + 1.0)
+    assert srv.metrics.value("hedges_launched") == 1
+    texts = [t for _, t in srv.flight.entries()]
+    assert any("why=suspect" in t for t in texts)
+    # the point event decays into a held suspicion window, then expires
+    assert 0 in srv._hedge_suspect_until
+    far = time.monotonic() + 3600.0
+    assert srv._hedge_suspects(far) == set(), "suspicion never decayed"
+
+
+def test_racing_member_expiry_retires_copy_survivor_delivers():
+    srv, fabric = _srv()
+    origin, sib = _hedge_setup(srv, fabric)
+    # the origin's lease expires (owner silent 1.5x the timeout) while
+    # the sibling still races: the copy RETIRES — re-enqueueing it
+    # would put two live duplicates into open matching
+    for ls in list(srv.leases.leases()):
+        if ls.seqno == origin:
+            ls.granted_at -= 0.75
+    srv._last_heard[0] -= 0.75
+    srv._scan_leases(time.monotonic())
+    assert srv.wq.get(origin) is None, "racing member re-enqueued"
+    assert srv.wq.count == 1
+    assert (origin, 0) in srv._fences
+    # the surviving sibling dissolved into an ordinary unit and delivers
+    assert not srv.hedges.is_member(sib)
+    resp = _fetch(srv, fabric, 1, sib)
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"unit"
+    assert srv.wq.count == 0
+
+
+def test_last_live_copy_always_reenters_service():
+    srv, fabric = _srv()
+    origin, sib = _hedge_setup(srv, fabric)
+    # BOTH owners go quiet past expiry (1.5x the timeout — short of the
+    # 2x rank-HUNG cut): whichever copy unpins last must re-enter
+    # service — hedging never loses work
+    for ls in list(srv.leases.leases()):
+        ls.granted_at -= 0.75
+    srv._last_heard[0] -= 0.75
+    srv._last_heard[1] -= 0.75
+    srv._scan_leases(time.monotonic())
+    assert srv.wq.count == 1
+    assert srv.wq.find_match(0, frozenset([T])) is not None
+    # a fresh consumer settles it exactly once
+    _reserve(srv, 0, rqseqno=9)
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_RESERVE_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS
+    resp = _fetch(srv, fabric, 0, resp.handle[0])
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"unit"
+    assert srv.wq.count == 0
+
+
+def test_quarantine_terminal_settles_race():
+    srv, fabric = _srv()
+    origin, sib = _hedge_setup(srv, fabric)
+    # a terminal that is NOT a delivery must still close the race:
+    # quarantine the origin directly (the dead-letter path)
+    srv._quarantine_unit(srv.wq.get(origin), in_wq=True)
+    assert srv.wq.get(sib) is None, "sibling outlived the terminal"
+    assert (sib, 1) in srv._fences
+    assert srv.metrics.value("hedges_fenced") == 1
+    assert len(srv.quarantine) == 1
+    resp = _fetch(srv, fabric, 1, sib)
+    assert resp.rc == ADLB_FENCED
+
+
+def test_unconfigured_world_is_frame_identical():
+    """hedge_budget_frac=0 (the default): no manager, no scan timer
+    ticking, and — critically — no hedge counters in the registry, so
+    metric snapshots (and the gossip frames built from them) carry no
+    new keys versus a pre-hedge build."""
+    world = WorldSpec(nranks=4, nservers=2, types=(T,))
+    fabric = InProcFabric(4)
+    srv = Server(world, Config(), fabric.endpoint(2))
+    assert srv.hedges is None
+    assert srv._next_hedge_scan == float("inf")
+    snap = srv.metrics.snapshot()["counters"]
+    assert not any(k.startswith("hedge") for k in snap), list(snap)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        Config(hedge_budget_frac=1.5)
+    with pytest.raises(ValueError):
+        Config(hedge_budget_frac=0.5, hedge_min_age_ms=-1)
+    with pytest.raises(ValueError):
+        Config(hedge_budget_frac=0.5)  # needs lease_timeout_s > 0
+    Config(hedge_budget_frac=0.5, lease_timeout_s=1.0)
+
+
+# ------------------------------------------------------------- durability
+
+
+def _wu(seqno, payload):
+    return WorkUnit(seqno=seqno, work_type=T, prio=0, target_rank=-1,
+                    answer_rank=-1, payload=payload)
+
+
+def test_op_hedge_mirror_lifecycle():
+    log = ReplicationLog(buddy=3)
+    log.log_put(_wu(5, b"origin"), 0, None)
+    log.log_put(_wu(6, b"sib"), -1, None)
+    log.log_hedge(6, 5)
+    mirror = ReplicaMirror(primary=2)
+    mirror.apply(log.take())
+    assert mirror.hedges == {6: 5}
+    # a fresh OP_PUT of the same seqno supersedes the mark (the race
+    # dissolved with the sibling the survivor)
+    log.log_put(_wu(6, b"sib"), -1, None)
+    mirror.apply(log.take())
+    assert mirror.hedges == {}
+    # consume pops it (the race settled with the sibling the winner)
+    log.log_hedge(6, 5)
+    log.log_consume(6)
+    mirror.apply(log.take())
+    assert 6 not in mirror.hedges and 6 not in mirror.units
+    # remove and quarantine pop it too
+    log.log_put(_wu(7, b"s2"), -1, None)
+    log.log_hedge(7, 5)
+    log.log_remove(7)
+    log.log_put(_wu(8, b"s3"), -1, None)
+    log.log_hedge(8, 5)
+    log.log_quarantine(8)
+    mirror.apply(log.take())
+    assert mirror.hedges == {}
+    # a mark for a unit the mirror never saw is ignored (lag-safe)
+    log.log_hedge(99, 5)
+    mirror.apply(log.take())
+    assert 99 not in mirror.hedges
+
+
+def test_failover_drops_sibling_adopts_origin_fences_owner():
+    """Buddy takeover of a home that died mid-race: the origin adopts
+    normally (pinned, translated); the live sibling is DROPPED — not a
+    counted loss — and its owner's rerouted fetch answers ADLB_FENCED
+    (you lost the race: re-reserve), exactly like a live settle."""
+    world = WorldSpec(nranks=5, nservers=3, types=(T,))
+    fabric = InProcFabric(5)
+    srv = Server(world, Config(on_server_failure="failover"),
+                 fabric.endpoint(4))
+    log = ReplicationLog(buddy=4)
+    log.log_put(_wu(100, b"origin"), 1, 7)
+    log.log_pin(100, 1)
+    log.log_put(_wu(101, b"sib"), -1, None)
+    log.log_hedge(101, 100)
+    log.log_pin(101, 0)
+    srv._handle(msg(Tag.SS_REPL, 3, blob=log.take(), seq=1))
+    srv._handle(msg(Tag.SS_SERVER_DEAD, 2, rank=3, epoch=1))
+    srv._handle(Msg(tag=Tag.PEER_EOF, src=3))
+    assert srv.wq.count == 1, "sibling adopted alongside its origin"
+    assert len(srv.leases.owned_by(1)) == 1  # origin's pin survived
+    texts = [t for _, t in srv.flight.entries()]
+    assert any("hedge_siblings_dropped=1" in t for t in texts)
+    # the sibling owner's rerouted fetch: fenced, NOT a counted loss
+    before = srv.metrics.value("failover_lost")
+    srv._handle(msg(Tag.FA_GET_RESERVED, 0, seqno=101, fo_from=3))
+    resp = [m for m in _drain(fabric, 0)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_FENCED
+    assert srv.metrics.value("failover_lost") == before
+    # the origin owner's rerouted fetch serves through translation
+    srv._handle(msg(Tag.FA_GET_RESERVED, 1, seqno=100, fo_from=3))
+    resp = [m for m in _drain(fabric, 1)
+            if m.tag is Tag.TA_GET_RESERVED_RESP][-1]
+    assert resp.rc == ADLB_SUCCESS and resp.payload == b"origin"
+
+
+def test_wal_cold_restart_discards_live_sibling(tmp_path):
+    """Crash mid-race: recovery adopts the origin (re-executes inside
+    the documented lease-expiry at-least-once window) and DISCARDS the
+    speculative sibling — never two live duplicates after restart."""
+    cfg = dict(wal_dir=str(tmp_path), wal_fsync_ms=0.0)
+    srv, fabric = _srv(**cfg)
+    origin, sib = _hedge_setup(srv, fabric)
+    srv._flush_wal(force=True)
+    srv.wal.close()
+    srv2, fabric2 = _srv(**cfg)
+    assert srv2.wal_recovered == 1
+    [u] = list(srv2.wq.units())
+    assert u.payload == b"unit" and not u.pinned
+    texts = [t for _, t in srv2.flight.entries()]
+    assert any("hedge_siblings_dropped=1" in t for t in texts)
+    srv2.wal.close()
+
+
+def test_wal_dissolved_race_survivor_recovers(tmp_path):
+    """The origin retires (expiry during the race) leaving the sibling
+    the sole survivor: the server re-logs the survivor's OP_PUT, which
+    supersedes the OP_HEDGE mark — a crash after that must recover the
+    SIBLING as an ordinary unit (the logical put is never lost)."""
+    cfg = dict(wal_dir=str(tmp_path), wal_fsync_ms=0.0)
+    srv, fabric = _srv(**cfg)
+    origin, sib = _hedge_setup(srv, fabric)
+    for ls in list(srv.leases.leases()):
+        if ls.seqno == origin:
+            ls.granted_at -= 0.75
+    srv._last_heard[0] -= 0.75
+    srv._scan_leases(time.monotonic())
+    assert srv.wq.get(origin) is None and srv.wq.get(sib) is not None
+    srv._flush_wal(force=True)
+    srv.wal.close()
+    srv2, fabric2 = _srv(**cfg)
+    assert srv2.wal_recovered == 1, "surviving sibling was discarded"
+    [u] = list(srv2.wq.units())
+    assert u.payload == b"unit"
+    srv2.wal.close()
+
+
+def test_wal_compaction_preserves_open_race_marks(tmp_path):
+    """Compaction snapshots the pool into an ACK2 shard (both race
+    members ride it as plain units) — the fresh segment's seed must
+    re-install the OP_HEDGE marks, or a post-compaction crash would
+    recover two live duplicates."""
+    cfg = dict(wal_dir=str(tmp_path), wal_fsync_ms=0.0)
+    srv, fabric = _srv(**cfg)
+    origin, sib = _hedge_setup(srv, fabric)
+    srv._flush_wal(force=True)
+    srv.wal.compact(srv)
+    srv.wal.close()
+    srv2, fabric2 = _srv(**cfg)
+    assert srv2.wal_recovered == 1, "compaction laundered the sibling"
+    [u] = list(srv2.wq.units())
+    assert u.payload == b"unit"
+    srv2.wal.close()
+
+
+# ---------------------------------------------------------- observability
+
+
+def test_hedged_journey_always_promotes_with_hedge_hop():
+    srv, fabric = _srv()
+    srv.journeys.tail = True  # as Config(trace_tail="on") arms it
+    origin, sib = _hedge_setup(srv, fabric)
+    resp = _fetch(srv, fabric, 1, sib)
+    assert resp.rc == ADLB_SUCCESS
+    done = srv.journeys.take_done()
+    hedged = [j for j in done if j["why"] == ["hedged"]]
+    assert len(hedged) == 1, done
+    [j] = hedged
+    stages = [s[0] for s in j["spans"]]
+    assert "hedge" in stages and j["end"] == "delivered"
+    # the loser was FORGOTTEN, never closed: exactly one journey tells
+    # the race (a loser fold would double every latency estimator)
+    assert len(done) == 1
+
+
+def test_incident_bundle_carries_hedge_window_delta():
+    from adlb_tpu.obs.metrics import Registry
+    from adlb_tpu.obs.slo import SloEngine, build_incident, parse_objective
+
+    srv, fabric = _srv()
+    eng = SloEngine(0.5)
+    eng.objectives = [parse_objective(
+        {"name": "inj", "job": 0, "type": T, "p99_ms": 5, "window_s": 4}
+    )]
+    eng.alerts_pub = [{"name": "inj", "state": "FIRING",
+                       "stale_ranks": []}]
+    now = time.monotonic()
+    reg = Registry(srv.rank)
+    reg.counter("hedges_launched").inc(0)
+    eng.ring.append(now - 3.0,
+                    {"counters": dict(reg.snapshot()["counters"]),
+                     "gauges": {}, "histograms": {}})
+    reg.counter("hedges_launched").inc(3)
+    reg.counter("hedges_won").inc(2)
+    eng.ring.append(now,
+                    {"counters": dict(reg.snapshot()["counters"]),
+                     "gauges": {}, "histograms": {}})
+    bundle = build_incident(
+        srv, eng, {"name": "inj", "job": 0, "type": T}, now,
+    )
+    assert bundle["hedges"].get("hedges_launched") == 3.0
+    assert bundle["hedges"].get("hedges_won") == 2.0
+
+
+def test_hedge_storm_structurally_impossible():
+    """Put-storm shape: many stragglers, many scans. The launch count
+    stays under frac x deliveries + burst and no sticky-vetoed origin
+    ever launches — both structural, not tuned."""
+    srv, fabric = _srv(hedge_budget_frac=0.25)
+    deliveries = 0
+    launches_seen = set()
+    vetoed_seen = set()
+    srv.journeys.tail_thr[(0, T)] = 0.01
+    for round_ in range(30):
+        _put(srv, payload=b"u%d" % round_)
+        _reserve(srv, 0, rqseqno=2 * round_ + 1)
+        _drain(fabric, 0)
+        _reserve(srv, 1, rqseqno=2 * round_ + 2)
+        srv._scan_hedges(time.monotonic() + 1.0)
+        # settle everything currently leased (deliveries refill)
+        for ls in list(srv.leases.leases()):
+            u = srv.wq.get(ls.seqno)
+            if u is None or not u.pinned:
+                continue
+            resp = _fetch(srv, fabric, ls.owner, ls.seqno)
+            if resp.rc == ADLB_SUCCESS:
+                deliveries += 1
+        _drain(fabric, 0), _drain(fabric, 1)
+    for _, t in srv.flight.entries():
+        if t.startswith("hedge_launched"):
+            launches_seen.add(t.split("origin=")[1].split()[0])
+        if t.startswith("hedge_vetoed") and "backpressure" in t:
+            vetoed_seen.add(t.split("seqno=")[1].split()[0])
+    launched = srv.metrics.value("hedges_launched")
+    assert launched <= 0.25 * deliveries + BURST_TOKENS
+    assert not (launches_seen & vetoed_seen), \
+        "a sticky-vetoed origin launched"
+    assert srv.wq.count == 0, "storm left unsettled inventory"
+
+
+# ------------------------------------------------------------- end-to-end
+
+
+def test_elastic_world_hedge_rescues_straggler():
+    """A worker goes quiet for 1 s holding an unfetched reservation
+    under a 3 s lease: only hedging can rescue the unit early. The
+    world completes with exact exactly-once conservation, the hedge
+    won, and the sleeper's late fetch was fenced."""
+    n_units = 6
+    cfg = Config(
+        exhaust_check_interval=0.2, on_worker_failure="reclaim",
+        lease_timeout_s=3.0, hedge_budget_frac=0.5,
+        hedge_min_age_ms=100.0,
+    )
+    # one server: hedging is a home-server-local decision (the taker
+    # must be parked at the straggler's home), so the rescue world
+    # keeps both ranks and the unit under one roof
+    ew = ElasticWorld(2, 1, [T], cfg=cfg)
+    for s in ew.servers.values():
+        # exactly what the master's SS_OBS_SYNC reply would install
+        s.journeys.tail_thr = {(0, T): 0.3}
+
+    def producer(ctx):
+        for i in range(n_units):
+            assert ctx.put(struct.pack("<q", i), T) == ADLB_SUCCESS
+        got = []
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return got
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                continue
+            got.append(struct.unpack("<q", buf)[0])
+
+    def sleeper(ctx):
+        got, fenced = [], 0
+        slept = False
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return got, fenced
+            if not slept:
+                slept = True
+                time.sleep(1.0)  # the straggler: reserved, unfetched
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                fenced += 1
+                continue
+            got.append(struct.unpack("<q", buf)[0])
+
+    t0 = time.monotonic()
+    ew.run_app(0, producer)
+    ew.run_app(1, sleeper)
+    res = ew.finish(timeout=60)
+    wall = time.monotonic() - t0
+    done = sorted(res[0] + res[1][0])
+    assert done == list(range(n_units)), done  # exactly once
+    won = sum(s.metrics.value("hedges_won") for s in ew.servers.values())
+    launched = sum(s.metrics.value("hedges_launched")
+                   for s in ew.servers.values())
+    assert launched >= 1 and won >= 1, (launched, won)
+    assert res[1][1] >= 1, "sleeper's late fetch was never fenced"
+    assert wall < 3.0, f"rescue waited for the lease ({wall:.1f}s)"
+
+
+N_ACC = 40
+
+
+def _acceptance_app(hedge_on):
+    def app(ctx):
+        from adlb_tpu.runtime.faults import sigstop_self
+
+        if ctx.rank == 0:
+            for i in range(N_ACC):
+                assert ctx.put(struct.pack("<q", i) + b"\0" * 24, T,
+                               answer_rank=0) == ADLB_SUCCESS
+            seen = set()
+            while len(seen) < N_ACC:
+                rc, r = ctx.reserve([3])
+                assert rc == ADLB_SUCCESS, rc
+                rc, buf = ctx.get_reserved(r.handle)
+                if rc == ADLB_RETRY:
+                    continue
+                seen.add(struct.unpack("<q", buf)[0])
+            ctx.set_problem_done()
+            return {"distinct": len(seen)}
+        n, retries, stalls = 0, 0, 0
+        while True:
+            rc, r = ctx.reserve([T])
+            if rc != ADLB_SUCCESS:
+                return {"n": n, "retries": retries, "stalls": stalls}
+            if ctx.rank == 1 and n >= 1 and stalls < 2:
+                stalls += 1
+                # first SIGSTOP outlives the lease (expiry marks this
+                # rank suspect); the second is the p999 straggler the
+                # suspect-window hedge must rescue early
+                sigstop_self(2.6 if stalls == 1 else 2.0)
+            rc, buf = ctx.get_reserved(r.handle)
+            if rc == ADLB_RETRY:
+                retries += 1
+                continue
+            assert rc == ADLB_SUCCESS, rc
+            ctx.put(buf[:8], 3, target_rank=0)
+            n += 1
+            time.sleep(0.005)
+    return app
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("hedge_on", [True, False])
+def test_tcp_sigstop_acceptance_conserves(hedge_on, tmp_path):
+    """The slow-TCP acceptance world: a SIGSTOP'd worker under a 2 s
+    lease, with and without hedging. Both conserve exactly once; the
+    hedged world's makespan records to a file so the paired run can
+    assert the p999 rescue was materially faster (the bench's
+    hedge_p999 row measures the same arm continuously)."""
+    cfg = Config(
+        on_worker_failure="reclaim", lease_timeout_s=2.0,
+        exhaust_check_interval=0.2,
+        hedge_budget_frac=0.5 if hedge_on else 0.0,
+        hedge_min_age_ms=150.0,
+    )
+    t0 = time.monotonic()
+    res = spawn_world(4, 1, [T, 3], _acceptance_app(hedge_on),
+                      cfg=cfg, timeout=240.0)
+    wall = time.monotonic() - t0
+    assert res.app_results[0]["distinct"] == N_ACC
+    done = sum(res.app_results[r]["n"] for r in (1, 2, 3))
+    assert done >= N_ACC, "answered units under-counted"
+    # the stalled rank survived both freezes and the world conserved;
+    # record the makespan so the on/off pair is comparable in CI logs
+    marker = tmp_path.parent / f"hedge_makespan_{int(hedge_on)}.txt"
+    try:
+        marker.write_text(f"{wall:.2f}\n")
+    except OSError:
+        pass
+    other = tmp_path.parent / f"hedge_makespan_{int(not hedge_on)}.txt"
+    if other.exists():
+        on_s, off_s = (wall, float(other.read_text())) if hedge_on else \
+            (float(other.read_text()), wall)
+        assert on_s < off_s + 1.0, (
+            f"hedging made the straggler world slower: on={on_s:.1f}s "
+            f"off={off_s:.1f}s"
+        )
