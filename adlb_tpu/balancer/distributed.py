@@ -8,25 +8,46 @@ snapshot deltas (only changed rows ship; unchanged servers are skipped by
 a stamp fast path). Each planning round is three fixed-shape steps:
 
 1. **sharded candidate generation** (on the mesh) — every device presorts
-   its task shard by (type, priority desc, seqno) — two composed stable
+   its task shard by (type, priority desc, gid) — three composed stable
    single-key sorts; the multi-key comparator sort is ~10x slower on CPU
    backends — and slices each type's top-D candidates, D = C + m + 1.
    This is the only work that scales with table size, which is exactly
    what the mesh parallelizes; it never retraces (fixed [S, K] shapes).
-2. **one cross-shard gather** — the [ndev, T, 2D] winner tuples collapse
-   to the planner host in a single transfer (a few hundred KB at 1,000
-   servers). This is the round's entire communication: no per-round
-   collectives, no O(requesters) device state.
-3. **auction rounds at the planner** — pure head-pointer logic over the
-   merged per-type candidate lists and the [T, C] requester-slot tables
-   (O(plan size), numpy): rank-k candidate pairs with the k-th open
-   accepting requester, cross-type conflicts resolve by (prio, -seqno),
-   a global threshold defers any winner that a displaced higher-priority
-   task could cascade into, and prefix commits keep every shard's
-   consumed tasks a prefix of its sorted type segment (which is what
-   makes step 1's head slices exact). The merge itself is ONE stable
-   sort: shard-major concatenation is already seqno-ascending within
-   every equal-priority run.
+2. **cross-shard merge** of the [ndev, T, 2D] per-device winner tuples
+   into global per-type candidate lists ordered by (prio desc, gid asc)
+   — two composed stable single-key sorts (gid, then prio): the elastic
+   slot map decouples device row order from rank order, so gid-ascending
+   is restored explicitly before the priority sort.
+3. **auction rounds** — pure head-pointer logic over the merged per-type
+   candidate lists and the [T, C] requester-slot tables (O(plan size)):
+   rank-k candidate pairs with the k-th open accepting requester,
+   cross-type conflicts resolve by (prio, -gid), a global threshold
+   defers any winner that a displaced higher-priority task could cascade
+   into, and prefix commits keep every shard's consumed tasks a prefix
+   of its sorted type segment (which is what makes step 1's head slices
+   exact).
+
+The solver runs one of two tiers over those steps:
+
+- ``auction="device"`` (default): all three steps fuse into ONE jitted
+  ``shard_map`` program (:func:`_build_plan_fn`) — candidate generation
+  per shard, a ``lax.all_gather`` over the ``"s"`` axis, the replicated
+  merge, and the auction as a fixed-shape ``lax.while_loop`` over
+  host-compacted requester ids (U = T*C distinct ids at most, so the
+  per-round scatters never touch O(requesters) state). A planning round
+  is one device dispatch plus one [T, C+1] commit-table readback — no
+  per-round host merge of the [ndev, T, 2D] gather, no O(S) host work.
+- ``auction="host"``: the PR 7 twin, retained verbatim — steps 2-3 on
+  the planner host (numpy), with the merged candidate lists cached and
+  patched in place between device sweeps. The twin is what the device
+  tier is fuzz-checked against (exact same commits from the same state).
+
+Task ids are **rank-keyed**: gid = rank * K + ki (``row_rank`` maps the
+resident row to its server rank; int32 on device, so rank * K must stay
+under 2**31 — enforced at registration). Because the greedy tie-break is
+the gid order itself, slot assignment is free-listed: an elastic join or
+leave (PR 15 epoch bump) patches exactly one row and never remaps the
+world — no full mesh re-sweep on churn.
 
 The auction reproduces the exact sequential greedy matching of
 :func:`adlb_tpu.balancer.solve._host_greedy` — same matched requester
@@ -59,67 +80,199 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from adlb_tpu.balancer.solve import _NEG, _PRIO_CLIP
+from adlb_tpu.balancer.solve import (
+    _I32MAX, _NEG, _PRIO_CLIP, _stable_argsort3)
 
-_I32MAX = 2**31 - 1
 
-
-def _stable_argsort2(primary, secondary):
-    """argsort by (primary asc, secondary asc, index asc) — the
-    lexsort((secondary, primary)) order — composed from two single-key
-    stable sorts (XLA's variadic comparator sort is ~10x slower on CPU
-    hosts than its single-key fast path)."""
-    o1 = jnp.argsort(secondary, stable=True)
-    o2 = jnp.argsort(primary[o1], stable=True)
-    return o1[o2]
+def _shard_candidates(tp, tt, rk, T: int, D: int):
+    """Per-shard candidate generation (traced inside shard_map): presort
+    the local [Sl, K] task block by (type, prio desc, gid) and slice each
+    type's top-D window. gid = rank * K + ki — rank-keyed, NOT row-keyed,
+    so the candidate identity (and hence the greedy tie-break) survives
+    elastic slot reuse. Returns (cand_prio, cand_gid) [T, D]."""
+    Sl, K = tp.shape
+    Kl = Sl * K
+    tp, tt = tp.reshape(-1), tt.reshape(-1)
+    gids = (rk[:, None].astype(jnp.int32) * K
+            + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+    live = (tp > _NEG) & (tt >= 0)
+    prio = jnp.clip(tp, -_PRIO_CLIP, _PRIO_CLIP)
+    sort_t = jnp.where(live, tt, T).astype(jnp.int32)
+    order = _stable_argsort3(sort_t, -prio, gids)
+    s_prio = prio[order]
+    s_gid = gids[order]
+    scount = jnp.zeros((T + 1,), jnp.int32).at[sort_t].add(
+        1, mode="drop")
+    seg_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(scount[:T])])
+    idx = seg_off[:T, None] + jnp.arange(D, dtype=jnp.int32)[None, :]
+    ok = idx < seg_off[1:, None]
+    idc = jnp.clip(idx, 0, Kl - 1)
+    cp = jnp.where(ok, s_prio[idc], _NEG)
+    cg = jnp.where(ok, s_gid[idc], _I32MAX)
+    return cp, cg
 
 
 def _build_gather_fn(mesh: Mesh, T: int, D: int, axis: str = "s"):
-    """Sharded candidate generation: fn(task_prio [S,K], task_type [S,K])
-    -> (cand_prio, cand_gid) [ndev, T, D] — each device's per-type top-D
-    (prio desc, gid asc) candidates. gid is the global flat task id
-    (si * K + ki), so shard-major order is gid order."""
+    """Sharded candidate generation: fn(task_prio [S,K], task_type [S,K],
+    row_rank [S]) -> (cand_prio, cand_gid) [ndev, T, D] — each device's
+    per-type top-D (prio desc, gid asc) candidates, gid = rank * K + ki.
+    This is the device leg of the ``auction="host"`` twin tier."""
 
-    def shard_fn(tp, tt):
-        Sl, K = tp.shape
-        Kl = Sl * K
-        my = jax.lax.axis_index(axis)
-        tp, tt = tp.reshape(-1), tt.reshape(-1)
-        gids = my.astype(jnp.int32) * Kl + jnp.arange(Kl, dtype=jnp.int32)
-        live = (tp > _NEG) & (tt >= 0)
-        prio = jnp.clip(tp, -_PRIO_CLIP, _PRIO_CLIP)
-        sort_t = jnp.where(live, tt, T).astype(jnp.int32)
-        # (type asc, prio desc, gid asc): argsort(-prio) is stable, so
-        # equal priorities keep index order = gid order
-        order = _stable_argsort2(sort_t, -prio)
-        s_prio = prio[order]
-        s_gid = gids[order]
-        scount = jnp.zeros((T + 1,), jnp.int32).at[sort_t].add(
-            1, mode="drop")
-        seg_off = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(scount[:T])])
-        idx = seg_off[:T, None] + jnp.arange(D, dtype=jnp.int32)[None, :]
-        ok = idx < seg_off[1:, None]
-        idc = jnp.clip(idx, 0, Kl - 1)
-        cp = jnp.where(ok, s_prio[idc], _NEG)
-        cg = jnp.where(ok, s_gid[idc], _I32MAX)
+    def shard_fn(tp, tt, rk):
+        cp, cg = _shard_candidates(tp, tt, rk, T, D)
         return cp[None], cg[None]
 
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None)),
+        in_specs=(P(axis, None), P(axis, None), P(axis)),
         out_specs=(P(axis, None, None), P(axis, None, None)),
         check_rep=False,
     )
     return jax.jit(fn)
 
 
-def _reqwin(req_mask, req_valid, T: int, C: int):
+def _build_plan_fn(mesh: Mesh, T: int, D: int, C: int, rounds: int,
+                   m: int, axis: str = "s"):
+    """The fully on-device planning round: ONE jitted shard_map program
+    fusing candidate generation, the cross-shard merge, and the auction.
+
+    fn(task_prio [S,K], task_type [S,K], row_rank [S],  -- mesh-sharded
+       reqwin_c [T,C], lens [T], open0 [U+1])           -- replicated
+    -> assigned [ndev, T, C+1] of committed gids (-1 = none; column C is
+    the scatter dump for non-commits). Every shard computes the same
+    replicated answer after the all_gather; the caller reads shard 0.
+
+    ``reqwin_c`` is the requester slot table over HOST-COMPACTED ids
+    (np.unique of the reqwin row ids; U = T*C is the static id-space
+    bound and doubles as the dump id), so the per-round winner/open
+    scatters touch [U+1] arrays — a few KB — never O(requesters) state.
+    ``open0[u]`` is True for every real compacted id, False at the dump.
+
+    The auction body is the exact device transcription of
+    :func:`_host_auction` — same head slices, same (prio, -gid) conflict
+    winner (two int32 scatter passes: max prio per requester, then min
+    gid among prio-ties), same global commit threshold including each
+    type's truncation sentinel, same prefix commits (a loss blocks every
+    later rank via an exclusive cumsum — keys descend in rank, so the
+    host's sequential break is exactly this mask), same zero-commit
+    early exit (the while_loop condition). Fuzz-pinned against the host
+    twin by tests/test_device_auction.py and tests/test_sharded_parity.py."""
+    ndev = mesh.devices.size
+    L = ndev * D
+    U = T * C
+
+    def shard_fn(tp, tt, rk, rwc, lens, open0):
+        cp, cg = _shard_candidates(tp, tt, rk, T, D)
+        # cross-shard merge, replicated on every device: restore gid
+        # order, then stable-sort by prio desc (ties keep gid asc)
+        ap = jax.lax.all_gather(cp, axis)  # [ndev, T, D]
+        ag = jax.lax.all_gather(cg, axis)
+        ap = ap.transpose(1, 0, 2).reshape(T, L)
+        ag = ag.transpose(1, 0, 2).reshape(T, L)
+        o = jnp.argsort(ag, axis=1, stable=True)
+        ap = jnp.take_along_axis(ap, o, axis=1)
+        ag = jnp.take_along_axis(ag, o, axis=1)
+        o = jnp.argsort(-ap, axis=1, stable=True)
+        gp = jnp.take_along_axis(ap, o, axis=1)
+        gg = jnp.take_along_axis(ag, o, axis=1)
+        # ---- auction rounds (fixed shapes; replicated) ----
+        nlive = (gp > _NEG).sum(axis=1).astype(jnp.int32)
+        slot_valid = jnp.arange(C, dtype=jnp.int32)[None, :] < lens[:, None]
+        trange = jnp.arange(T, dtype=jnp.int32)
+        rows_c = jnp.broadcast_to(trange[:, None], (T, C))
+        cols_c = jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32)[None, :], (T, C))
+        rows_m = jnp.broadcast_to(trange[:, None], (T, m))
+        arange_m1 = jnp.arange(m + 1, dtype=jnp.int32)
+
+        def body(state):
+            head, open_, assigned, rnd, _last = state
+            # next m+1 untaken candidates per type (head slice)
+            cidx = head[:, None] + arange_m1[None, :]
+            okc = cidx < nlive[:, None]
+            cl = jnp.minimum(cidx, L - 1)
+            mp_full = jnp.where(okc, gp[trange[:, None], cl], _NEG)
+            mg_full = jnp.where(okc, gg[trange[:, None], cl], _I32MAX)
+            mp, mg = mp_full[:, :m], mg_full[:, :m]
+            trunc_p, trunc_g = mp_full[:, m], mg_full[:, m]
+            # first m open slots per type: scatter-min each open slot's
+            # column at its open-rank (ranks >= m and closed slots fall
+            # off the [T, m] table via mode="drop")
+            slot_open = slot_valid & open_[rwc]
+            sr = jnp.cumsum(slot_open, axis=1)
+            nopen = sr[:, -1]
+            jrank = jnp.where(slot_open, sr - 1, m).astype(jnp.int32)
+            pair_slot = jnp.full((T, m), C, jnp.int32).at[
+                rows_c, jrank].min(cols_c, mode="drop")
+            valid = (mp > _NEG) & (pair_slot < C)
+            psc = jnp.clip(pair_slot, 0, C - 1)
+            rid = jnp.where(valid, rwc[trange[:, None], psc], U)
+            # cross-type conflicts: winner per requester by (prio, -gid)
+            bp = jnp.full((U + 1,), _NEG, jnp.int32).at[rid].max(
+                jnp.where(valid, mp, _NEG))
+            is_pmax = valid & (mp == bp[rid])
+            bg = jnp.full((U + 1,), _I32MAX, jnp.int32).at[rid].min(
+                jnp.where(is_pmax, mg, _I32MAX))
+            win = is_pmax & (mg == bg[rid])
+            lose = valid & ~win
+            # global commit threshold: best key among losers and each
+            # type's truncation sentinel (only while it has an open
+            # slot); lexicographic max as (max prio, min gid among ties)
+            sent = (nopen > 0) & (trunc_p > _NEG)
+            lp = jnp.maximum(
+                jnp.max(jnp.where(lose, mp, _NEG)),
+                jnp.max(jnp.where(sent, trunc_p, _NEG)))
+            lg = jnp.minimum(
+                jnp.min(jnp.where(lose & (mp == lp), mg, _I32MAX)),
+                jnp.min(jnp.where(sent & (trunc_p == lp), trunc_g,
+                                  _I32MAX)))
+            keygt = (mp > lp) | ((mp == lp) & (mg < lg))
+            lose_before = (jnp.cumsum(lose, axis=1) - lose) > 0
+            commit = win & keygt & ~lose_before
+            assigned = assigned.at[
+                rows_m, jnp.where(commit, psc, C)].max(
+                jnp.where(commit, mg, -1))
+            open_ = open_.at[jnp.where(commit, rid, U)].set(False)
+            head = head + commit.sum(axis=1).astype(jnp.int32)
+            return (head, open_, assigned, rnd + 1,
+                    commit.sum().astype(jnp.int32))
+
+        def cond(state):
+            return (state[3] < rounds) & (state[4] > 0)
+
+        init = (
+            jnp.zeros((T,), jnp.int32),
+            open0,
+            jnp.full((T, C + 1), -1, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(1, jnp.int32),
+        )
+        assigned = jax.lax.while_loop(cond, body, init)[2]
+        return assigned[None]
+
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis),
+                  P(None, None), P(None), P(None)),
+        out_specs=P(axis, None, None),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def _reqwin(req_mask, req_valid, T: int, C: int, perm=None):
     """Requester slot tables: ``reqwin [T, C]`` — the first C valid
-    requester row ids accepting each type, in row order (the greedy
+    requester row ids accepting each type, in scan order (the greedy
     "first open compatible requester" order) — plus per-type lengths.
 
-    Chunked early-exit scan: with deep requester tables (100k parked)
+    ``perm`` (a full row permutation) sets the scan order; the stateful
+    solver passes its rank-sorted row order so the windows match the
+    single-device packer's sorted-rank rows exactly even though the
+    elastic slot map free-lists physical rows. The WINDOW ENTRIES stay
+    physical row ids (extraction indexes the resident refs).
+
+    Chunked early-exit scan: with deep requester tables (1M parked)
     the window is filled from the first few thousand rows, so the
     common-case cost is O(chunk * T), not O(NR * T)."""
     NR = req_valid.shape[0]
@@ -128,7 +281,11 @@ def _reqwin(req_mask, req_valid, T: int, C: int):
     CHUNK = 16384
     for a in range(0, NR, CHUNK):
         b = min(a + CHUNK, NR)
-        vm = req_mask[a:b] & req_valid[a:b, None]  # [chunk, T]
+        if perm is None:
+            vm = req_mask[a:b] & req_valid[a:b, None]  # [chunk, T]
+        else:
+            rows = perm[a:b]
+            vm = req_mask[rows] & req_valid[rows][:, None]
         done = True
         for t in range(T):
             n = int(lens[t])
@@ -136,7 +293,8 @@ def _reqwin(req_mask, req_valid, T: int, C: int):
                 continue
             idx = np.flatnonzero(vm[:, t])[: C - n]
             if idx.size:
-                reqwin[t, n: n + idx.size] = idx + a
+                reqwin[t, n: n + idx.size] = (
+                    idx + a if perm is None else rows[idx])
                 lens[t] = n + idx.size
             if lens[t] < C:
                 done = False
@@ -256,12 +414,18 @@ def _slot_sizes(slots_per_type: Optional[int], cand_width: int,
 
 def _merge_shard_major(cp, cg):
     """Merge [ndev, T, D] per-shard candidate tables into exact global
-    (prio desc, gid asc) lists [T, ndev*D]: ONE stable sort suffices —
-    the shard-major concatenation is already gid-ascending within every
-    equal-priority run (gid = shard block + in-block presorted order)."""
+    (prio desc, gid asc) lists [T, ndev*D]: two composed stable
+    single-key sorts — gid first, then prio desc. (Rank-keyed gids are
+    NOT monotone across the shard-major concatenation once the elastic
+    slot map reuses rows, so gid order must be restored explicitly
+    before the priority sort; padding gids are _I32MAX and sort last
+    within the _NEG-priority run, as before.)"""
     T = cp.shape[1]
     ap = cp.transpose(1, 0, 2).reshape(T, -1)
     ag = cg.transpose(1, 0, 2).reshape(T, -1)
+    o = np.argsort(ag, axis=1, kind="stable")
+    ap = np.take_along_axis(ap, o, axis=1)
+    ag = np.take_along_axis(ag, o, axis=1)
     mi = np.argsort(-ap, axis=1, kind="stable")
     return (
         np.take_along_axis(ap, mi, axis=1),
@@ -306,7 +470,12 @@ def build_distributed_solver(mesh: Mesh, rounds: int = 16, axis: str = "s",
         shard = NamedSharding(mesh, P(axis, None))
         tp = jax.device_put(jnp.asarray(task_prio), shard)
         tt = jax.device_put(jnp.asarray(task_type), shard)
-        cp, cg = gather_fn(tp, tt)
+        # row index as rank: the functional path has no slot reuse, so
+        # gid = si * K + ki exactly as before
+        rk = jax.device_put(
+            jnp.arange(task_prio.shape[0], dtype=jnp.int32),
+            NamedSharding(mesh, P(axis)))
+        cp, cg = gather_fn(tp, tt, rk)
         gp, gg = _merge_shard_major(_sharded_to_host(cp),
                                     _sharded_to_host(cg))
         rw, lens = _reqwin(req_mask, req_valid, T, C)
@@ -365,7 +534,12 @@ class DistributedAssignmentSolver:
         servers_per_device: int = 1,
         cand_width: int = 32,
         slots_per_type: Optional[int] = None,
+        auction: str = "device",
     ) -> None:
+        if auction not in ("device", "host"):
+            raise ValueError(
+                f"auction must be 'device' or 'host', got {auction!r}")
+        self.auction = auction
         self.types = tuple(types)
         self.type_index = {t: i for i, t in enumerate(self.types)}
         self.K = max_tasks_per_server
@@ -390,8 +564,21 @@ class DistributedAssignmentSolver:
         self._req_cache: dict[int, tuple] = {}
         self._task_stamp: dict[int, float] = {}
         self._req_stamp: dict[int, float] = {}
-        self._servers: list = []  # sorted ranks; index = si
+        self._servers: list = []  # registered ranks (slot order free)
         self._si: dict[int, int] = {}
+        # rank behind each resident row (-1 = free): the gid key space.
+        # Slots are free-listed, never remapped — the auction tie-break
+        # is the rank-keyed gid, not the row index
+        self._row_rank = np.full((self.S,), -1, dtype=np.int64)
+        self._free_si: list[int] = []
+        self._next_si = 0
+        # ranks whose candidate entries the next host-tier patch must
+        # drop (a freed slot's row_rank is already recycled by then)
+        self._dropped_ranks: set = set()
+        # rank-sorted requester row order (see _reqwin): rebuilt only
+        # when membership changes — the requester tie-break, like the
+        # task gid, must follow rank order, not physical slot order
+        self._row_perm: Optional[np.ndarray] = None
         self._task_ref: list = [[None] * self.K for _ in range(self.S)]
         self._req_ref: list = [None] * NR
         self._reqs_dirty = True
@@ -399,17 +586,25 @@ class DistributedAssignmentSolver:
         # servers whose tasks/reqs our own last plan consumed: their
         # ledger-filtered snapshot content changes without a stamp bump
         self._planned_servers: set = set()
-        # view-ingest bookkeeping: last consumed ledger generation per
-        # server (rank-keyed; generations are globally monotonic so a
-        # slot reused for a new rank can never alias)
-        self._vgen_t: dict[int, int] = {}
-        self._vgen_r: dict[int, int] = {}
+        # view-ingest bookkeeping: the ledger membership generation and
+        # per-slot task/req generations last consumed (slot-indexed
+        # arrays, diffed vectorized; generations are globally monotonic
+        # so a slot reused for a new rank can never alias)
+        self._seen_member_gen = None
+        self._seen_tgen: Optional[np.ndarray] = None
+        self._seen_rgen: Optional[np.ndarray] = None
 
         # device state & jitted fns, built lazily (constructing a solver
         # must not force accelerator init before first use)
         self._dev_tp = None
         self._dev_tt = None
+        self._dev_rk = None
         self._gather_fn = None
+        self._plan_fn = None
+        # device-tier requester tables (rebuilt when reqs change):
+        # compacted reqwin + initial open vector (see _build_plan_fn)
+        self._rwc: Optional[np.ndarray] = None
+        self._open0: Optional[np.ndarray] = None
         # merged per-type candidate lists [T, ndev*D] (prio desc, gid
         # asc, _NEG-padded): materialized by the device sweep, patched
         # in place for small deltas (exactly what a sweep would produce
@@ -420,6 +615,10 @@ class DistributedAssignmentSolver:
         self._cand_dirty = True
         self._plans_since_sweep = 0
         self.sweep_count = 0
+        # why each host-tier re-sweep ran (obs: solver_resweeps counter;
+        # the device tier regenerates candidates every plan on-device,
+        # so it never re-sweeps and these stay zero)
+        self.sweep_reasons: dict = {"cold": 0, "delta": 0, "cadence": 0}
         self.last_sweep_ms = 0.0
 
         self.last_ingest_ms = 0.0
@@ -433,6 +632,7 @@ class DistributedAssignmentSolver:
             return
         self._gather_fn = _build_gather_fn(self.mesh, self.T, self.D)
         self._shard = NamedSharding(self.mesh, P("s", None))
+        self._shard1 = NamedSharding(self.mesh, P("s"))
         self._devices = list(self.mesh.devices.reshape(-1))
         self._Sl = self.S // self.ndev
         # the resident table is kept as per-device shard pieces: a delta
@@ -442,6 +642,7 @@ class DistributedAssignmentSolver:
         # update args to every device
         self._piece_p = [None] * self.ndev
         self._piece_t = [None] * self.ndev
+        self._piece_r = [None] * self.ndev
         self._reload_devices(range(self.ndev))
 
     def _reload_devices(self, devs) -> None:
@@ -452,51 +653,77 @@ class DistributedAssignmentSolver:
                 self._tp[blk], self._devices[d])
             self._piece_t[d] = jax.device_put(
                 self._tt[blk], self._devices[d])
+            # free rows upload rank 0: they are dead (priority floor),
+            # so their gids can never surface as candidates
+            self._piece_r[d] = jax.device_put(
+                np.maximum(self._row_rank[blk], 0).astype(np.int32),
+                self._devices[d])
         shape = (self.S, self.K)
         self._dev_tp = jax.make_array_from_single_device_arrays(
             shape, self._shard, self._piece_p)
         self._dev_tt = jax.make_array_from_single_device_arrays(
             shape, self._shard, self._piece_t)
+        self._dev_rk = jax.make_array_from_single_device_arrays(
+            (self.S,), self._shard1, self._piece_r)
 
     def _map_server(self, s) -> Optional[int]:
         si = self._si.get(s)
         if si is not None:
             return si
-        if len(self._servers) >= self.S:
-            # beyond capacity: unmapped until a registered server dies
-            # (slots are first-registered; ingest still re-diffs every
-            # REGISTERED server each round, so capacity overflow never
-            # leaves stale resident rows — only unplanned extras)
-            return None
-        # si assignment keeps sorted-rank order (matches the
-        # single-device packer, so requester row order — the greedy
-        # tie-break — is identical); a server sorting before existing
-        # ones forces a remap + full reload (failover-rare)
-        self._servers.append(s)
-        if self._servers != sorted(self._servers):
-            self._servers.sort()
-            self._si = {r: i for i, r in enumerate(self._servers)}
-            self._remap_all()
+        if self._free_si:
+            si = self._free_si.pop()
+        elif self._next_si < self.S:
+            si = self._next_si
+            self._next_si += 1
         else:
-            self._si[s] = len(self._servers) - 1
-        return self._si[s]
+            # beyond capacity: unmapped until a registered server dies
+            # (ingest still re-diffs every REGISTERED server each
+            # round, so capacity overflow never leaves stale resident
+            # rows — only unplanned extras)
+            return None
+        if s * self.K + self.K - 1 > _I32MAX:
+            raise ValueError(
+                f"server rank {s} overflows the int32 gid space "
+                f"(rank * max_tasks_per_server must stay under 2**31)")
+        # slots are free-listed and NEVER remapped: the auction
+        # tie-break is the rank-keyed gid (rank * K + ki), not the row
+        # index, so an elastic join patches one row instead of
+        # re-packing the world
+        self._servers.append(s)
+        self._si[s] = si
+        self._row_rank[si] = s
+        self._row_perm = None  # rank order changed: rebuild lazily
+        return si
 
-    def _remap_all(self) -> None:
-        task_cache, req_cache = self._task_cache, self._req_cache
-        self._tp.fill(int(_NEG))
-        self._tt.fill(-1)
-        self._req_valid.fill(False)
-        self._req_mask.fill(False)
-        self._task_ref = [[None] * self.K for _ in range(self.S)]
-        self._req_ref = [None] * (self.S * self.R)
-        self._task_cache = {}
-        self._req_cache = {}
-        for s in self._servers:
-            if s in task_cache:
-                self._pack_tasks(s, task_cache[s])
-            if s in req_cache:
-                self._pack_reqs(s, req_cache[s])
-        self._full_reload = True
+    def _unregister(self, s, changed: list) -> None:
+        """A vanished server (drain/failover): clear its resident rows
+        and recycle the slot. Rank-keyed gids make this purely local —
+        no other row moves, and the slot's next tenant brings its own
+        gid range."""
+        si = self._si.pop(s)
+        self._servers.remove(s)
+        if (self._tp[si] > int(_NEG)).any():
+            changed.append(si)
+            # the host-tier candidate patch must drop this rank's
+            # entries even after row_rank forgets it
+            self._dropped_ranks.add(int(s))
+        self._tp[si, :] = int(_NEG)
+        self._tt[si, :] = -1
+        self._task_ref[si] = [None] * self.K
+        base = si * self.R
+        if self._req_valid[base:base + self.R].any():
+            self._req_valid[base:base + self.R] = False
+            self._req_mask[base:base + self.R, :] = False
+            for i in range(self.R):
+                self._req_ref[base + i] = None
+            self._reqs_dirty = True
+        self._task_cache.pop(s, None)
+        self._req_cache.pop(s, None)
+        self._task_stamp.pop(s, None)
+        self._req_stamp.pop(s, None)
+        self._row_rank[si] = -1
+        self._free_si.append(si)
+        self._row_perm = None  # rank order changed: rebuild lazily
 
     def _pack_tasks(self, s: int, tasks: tuple) -> None:
         si = self._si[s]
@@ -592,33 +819,33 @@ class DistributedAssignmentSolver:
                 if rstamp is not None:
                     self._req_stamp[s] = rkey
         planned.clear()
-        # servers that vanished (failover): clear their rows. Checked
-        # every ingest (O(S) dict lookups) — gating on a shrinking
-        # snapshot COUNT missed a death that coincides with another
-        # server joining, or a world larger than capacity S, leaving a
-        # dead server's resident rows winning auctions forever
-        for s in self._servers:
-            if s not in snapshots:
-                if self._task_cache.get(s):
-                    self._pack_tasks(s, ())
-                    changed.append(self._si[s])
-                if self._req_cache.get(s):
-                    self._pack_reqs(s, ())
+        # servers that vanished (failover): unregister — clear their
+        # rows AND free the slot for the next join. Checked every
+        # ingest (O(S) dict lookups) — gating on a shrinking snapshot
+        # COUNT missed a death that coincides with another server
+        # joining, or a world larger than capacity S, leaving a dead
+        # server's resident rows winning auctions forever
+        for s in [r for r in self._servers if r not in snapshots]:
+            self._unregister(s, changed)
         self._finish_ingest(changed)
         self.last_ingest_ms = (time.perf_counter() - t0) * 1e3
         return len(changed)
 
     def _finish_ingest(self, changed: list) -> None:
         """Shared ingest tail (tuple and view paths): ship changed
-        device blocks, patch or dirty the merged candidate lists,
-        rebuild the requester slot windows."""
+        device blocks, patch or dirty the host tier's merged candidate
+        lists, rebuild the requester slot windows."""
         if self._full_reload:
             self._reload_devices(range(self.ndev))
             self._full_reload = False
             self._cand_dirty = True
         elif changed:
             self._reload_devices(sorted({si // self._Sl for si in changed}))
-            if (
+            if self.auction == "device":
+                # the device tier regenerates candidates from the
+                # resident table every plan — nothing to patch
+                self._dropped_ranks.clear()
+            elif (
                 self._gp is None
                 or len(changed) > max(self.DELTA_RESYNC_ROWS, self.ndev)
             ):
@@ -626,73 +853,119 @@ class DistributedAssignmentSolver:
             else:
                 self._patch_candidates(changed)
         if self._reqs_dirty:
+            if self._row_perm is None:
+                # rank-sorted slots first, then the unused slots (all
+                # their rows invalid — order among them is irrelevant)
+                used = sorted(self._si.items())  # (rank, si) rank-asc
+                rest = sorted(
+                    set(range(self.S)) - {si for _, si in used})
+                slot_seq = np.asarray(
+                    [si for _, si in used] + rest, dtype=np.int64)
+                self._row_perm = (
+                    slot_seq[:, None] * self.R
+                    + np.arange(self.R, dtype=np.int64)[None, :]
+                ).reshape(-1)
             self._rw, self._lens = _reqwin(
-                self._req_mask, self._req_valid, self.T, self.C)
+                self._req_mask, self._req_valid, self.T, self.C,
+                self._row_perm)
+            if self.auction == "device":
+                self._build_req_tables()
             self._reqs_dirty = False
+
+    def _build_req_tables(self) -> None:
+        """Device-tier requester tables: compact the reqwin row ids to
+        a dense [0, U) id space (U = T*C static; U itself is the dump
+        id) so the on-device auction's winner/open scatters are a few
+        KB, independent of the requester-table depth."""
+        U = self.T * self.C
+        flat = self._rw.reshape(-1)
+        pos = np.flatnonzero(flat >= 0)
+        uniq, inv = np.unique(flat[pos], return_inverse=True)
+        rwc = np.full((self.T * self.C,), U, dtype=np.int32)
+        rwc[pos] = inv.astype(np.int32)
+        self._rwc = rwc.reshape(self.T, self.C)
+        open0 = np.zeros((U + 1,), dtype=bool)
+        open0[: uniq.size] = True
+        self._open0 = open0
 
     def _ingest_view(self, view) -> int:
         """Delta ingest from the engine's array-resident host ledger:
-        copy the packed rows of every server whose ledger generation
+        copy the packed rows of every slot whose ledger generation
         moved since we last consumed it. The ledger already applied the
         plan-mark/suppression filtering, so there is no stamp-key
         bookkeeping and no tuple compare here — the generation counters
         ARE the change signal (they cover in-place deltas, dead-rank
-        patches, and the engine's own plan touches alike)."""
+        patches, and the engine's own plan touches alike).
+
+        Fully vectorized: the changed-slot set is two numpy compares
+        against the seen-generation mirrors, and the O(S) membership
+        walk runs only when the ledger's ``member_gen`` moved (churn) —
+        a steady-state round does O(changed) python work, which is what
+        holds the idle planning round flat at 10k servers."""
         t0 = time.perf_counter()
         self._ensure_built()
         # layout agreement is load-bearing: refs index [K]/[R] rows
         assert (view.K, view.R, tuple(view.types)) == (
             self.K, self.R, self.types)
-        servers = view.servers
-        for s in servers:
-            self._map_server(s)  # may remap + flag a full reload
-        full = self._full_reload
         changed: list[int] = []
+        ncap = view.t_gen.shape[0]
+        if (
+            view.member_gen != self._seen_member_gen
+            or self._seen_tgen is None
+            or self._seen_tgen.shape[0] != ncap
+        ):
+            # membership walk (cold start / churn / ledger realloc):
+            # register joins, unregister vanished ranks (a death may
+            # coincide with a join or a beyond-capacity world, so the
+            # check is membership-exact, not count-based), grow the
+            # seen-generation mirrors
+            fresh: list = []
+            for s in view.servers:
+                if s not in self._si and self._map_server(s) is not None:
+                    fresh.append(s)
+            sset = set(view.servers)
+            for s in [r for r in self._servers if r not in sset]:
+                self._unregister(s, changed)
+            old_t, old_r = self._seen_tgen, self._seen_rgen
+            self._seen_tgen = np.zeros(ncap, np.int64)
+            self._seen_rgen = np.zeros(ncap, np.int64)
+            if old_t is not None:
+                n = min(old_t.shape[0], ncap)
+                self._seen_tgen[:n] = old_t[:n]
+                self._seen_rgen[:n] = old_r[:n]
+            for s in fresh:
+                # a rank we just registered (join, or an extra that
+                # finally got capacity): its slot gens may predate our
+                # mirror — force the copy (gen 0 precedes every bump)
+                slot = view.slot_of(s)
+                self._seen_tgen[slot] = 0
+                self._seen_rgen[slot] = 0
+            self._seen_member_gen = view.member_gen
         R = self.R
-        for s in servers:
-            si = self._si.get(s)
+        slot_rank = view.slot_rank
+        for slot in np.flatnonzero(
+                view.t_gen != self._seen_tgen).tolist():
+            self._seen_tgen[slot] = view.t_gen[slot]
+            si = self._si.get(int(slot_rank[slot]))
             if si is None:
-                continue  # beyond capacity: unplanned extras (as ever)
-            slot = view.slot_of(s)
-            tg = view.t_gen_of(s)
-            if full or self._vgen_t.get(s) != tg:
-                self._tp[si, :] = view.pk_tp[slot]
-                self._tt[si, :] = view.pk_tt[slot]
-                self._task_ref[si] = list(view.pk_trefs[slot])
-                self._vgen_t[s] = tg
-                changed.append(si)
-            rg = view.r_gen_of(s)
-            if full or self._vgen_r.get(s) != rg:
-                base = si * R
-                self._req_valid[base:base + R] = view.pk_rv[slot]
-                self._req_mask[base:base + R, :] = view.pk_rm[slot]
-                rrefs = view.pk_rrefs[slot]
-                for i in range(R):
-                    self._req_ref[base + i] = rrefs[i]
-                self._vgen_r[s] = rg
-                self._reqs_dirty = True
-        # vanished servers: clear their resident rows (unconditional
-        # membership check, same rationale as the tuple path — a death
-        # may coincide with a join or a beyond-capacity world)
-        sset = set(servers)
-        for s in self._servers:
-            if s in sset:
+                continue  # freed slot, or beyond-capacity extra
+            self._tp[si, :] = view.pk_tp[slot]
+            self._tt[si, :] = view.pk_tt[slot]
+            self._task_ref[si] = list(view.pk_trefs[slot])
+            changed.append(si)
+        for slot in np.flatnonzero(
+                view.r_gen != self._seen_rgen).tolist():
+            self._seen_rgen[slot] = view.r_gen[slot]
+            si = self._si.get(int(slot_rank[slot]))
+            if si is None:
                 continue
-            si = self._si[s]
-            if (self._tp[si] > int(_NEG)).any():
-                self._tp[si, :] = int(_NEG)
-                self._tt[si, :] = -1
-                self._task_ref[si] = [None] * self.K
-                changed.append(si)
             base = si * R
-            if self._req_valid[base:base + R].any():
-                self._req_valid[base:base + R] = False
-                self._req_mask[base:base + R, :] = False
-                for i in range(R):
-                    self._req_ref[base + i] = None
-                self._reqs_dirty = True
-            self._vgen_t.pop(s, None)
-            self._vgen_r.pop(s, None)
+            self._req_valid[base:base + R] = view.pk_rv[slot]
+            self._req_mask[base:base + R, :] = view.pk_rm[slot]
+            rrefs = view.pk_rrefs[slot]
+            for i in range(R):
+                self._req_ref[base + i] = rrefs[i]
+            self._reqs_dirty = True
         # plan() keeps recording its touches for the tuple path; the
         # view path's generations already carry them — drop so the set
         # cannot grow unboundedly
@@ -734,11 +1007,20 @@ class DistributedAssignmentSolver:
             | {r for d in heavy for r in range(d * Sl, (d + 1) * Sl)}
         )
         rows = np.asarray(row_set, dtype=np.int64)
-        drop = np.isin(gg // K, rows) & (gp > int(_NEG))
+        # entries are dropped by the RANK their gid carries — the
+        # affected rows' current tenants plus any rank whose slot was
+        # freed since the last patch (its row_rank is already recycled)
+        ranks = {int(r) for r in self._row_rank[rows] if r >= 0}
+        ranks |= self._dropped_ranks
+        self._dropped_ranks = set()
+        drop = np.isin(
+            gg // K, np.asarray(sorted(ranks), dtype=np.int64)
+        ) & (gp > int(_NEG))
         for d in heavy:
             self._shard_trunc[d] = False
         # fresh entries: the affected rows' blocks from the host mirror
-        new_gid = (rows[:, None] * K
+        # (freed rows carry rank -1 — negative gids, excluded by `live`)
+        new_gid = (self._row_rank[rows][:, None] * K
                    + np.arange(K, dtype=np.int64)[None, :]).reshape(-1)
         new_p = self._tp[rows].reshape(-1)
         new_t = self._tt[rows].reshape(-1)
@@ -768,12 +1050,14 @@ class DistributedAssignmentSolver:
         mesh plus the ONE device->host transfer of the planning round,
         re-materializing the merged candidate lists."""
         t0 = time.perf_counter()
-        cp, cg = self._gather_fn(self._dev_tp, self._dev_tt)
+        cp, cg = self._gather_fn(self._dev_tp, self._dev_tt,
+                                 self._dev_rk)
         # read shard-by-shard: the sharded array's own __array__
         # assembly is an order of magnitude slower on host-platform
         # meshes
         self._gp, self._gg = _merge_shard_major(
             _sharded_to_host(cp), _sharded_to_host(cg))
+        self._dropped_ranks.clear()  # re-materialized from live rows
         self._gg = self._gg.astype(np.int64)
         self._gp = self._gp.astype(np.int64)
         # which shards' top-D windows truncated anything: per-(shard,
@@ -791,22 +1075,43 @@ class DistributedAssignmentSolver:
         self.sweep_count += 1
         self.last_sweep_ms = (time.perf_counter() - t0) * 1e3
 
+    def _device_plan(self) -> np.ndarray:
+        """The device-tier planning round: one jitted dispatch of the
+        fused candidate-gen/merge/auction program, one [T, C+1]
+        readback (shard 0 — every shard holds the replicated answer)."""
+        if self._plan_fn is None:
+            self._plan_fn = _build_plan_fn(
+                self.mesh, self.T, self.D, self.C, self.rounds, self.m)
+        out = self._plan_fn(
+            self._dev_tp, self._dev_tt, self._dev_rk,
+            self._rwc, self._lens.astype(np.int32), self._open0)
+        shard = min(out.addressable_shards,
+                    key=lambda sh: sh.index[0].start or 0)
+        return np.asarray(shard.data)[0, :, : self.C]
+
     def plan(self) -> list:
         """One fixed-shape planning round over the resident state."""
         if not self._req_valid.any():
             return []
         t0 = time.perf_counter()
         self._ensure_built()
-        if (
-            self._cand_dirty
-            or self._plans_since_sweep >= self.RESYNC_INTERVAL
-        ):
-            self._sweep()
-        self._plans_since_sweep += 1
-        req_open = self._req_valid.copy()
-        assigned = _host_auction(
-            self._gp, self._gg, self._rw, self._lens, req_open,
-            self.rounds, self.m)
+        if self.auction == "device":
+            assigned = self._device_plan()
+        else:
+            if (
+                self._cand_dirty
+                or self._plans_since_sweep >= self.RESYNC_INTERVAL
+            ):
+                self.sweep_reasons[
+                    "cold" if self._gp is None
+                    else "delta" if self._cand_dirty
+                    else "cadence"] += 1
+                self._sweep()
+            self._plans_since_sweep += 1
+            req_open = self._req_valid.copy()
+            assigned = _host_auction(
+                self._gp, self._gg, self._rw, self._lens, req_open,
+                self.rounds, self.m)
         t1 = time.perf_counter()
         self.last_solve_ms = (t1 - t0) * 1e3
         pairs = []
@@ -815,8 +1120,9 @@ class DistributedAssignmentSolver:
         rids = self._rw[t_idx, c_idx].tolist()
         K = self.K
         for g, rid in zip(gids, rids):
-            si, ki = divmod(g, K)
-            tref = self._task_ref[si][ki] if si < self.S else None
+            rank, ki = divmod(int(g), K)
+            si = self._si.get(rank)
+            tref = self._task_ref[si][ki] if si is not None else None
             rref = self._req_ref[rid]
             if tref is None or rref is None:
                 continue

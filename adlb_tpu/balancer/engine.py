@@ -76,6 +76,7 @@ class PlanEngine:
         inflow_ttl: Optional[float] = None,
         inflow_min_age: Optional[float] = None,
         host_ledger: str = "array",
+        auction: str = "device",
         metrics=None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
@@ -84,6 +85,12 @@ class PlanEngine:
         # plan age, and pairs/migrations emitted — attached by the
         # in-server balancer thread (and the sidecar, which owns its own)
         self.metrics = metrics
+        # last-seen reason totals for the ledger's cadence resyncs and
+        # the sharded solver's full shard re-sweeps; diffed per round so
+        # /metrics carries monotone labelled counters (ledger_resyncs /
+        # solver_resweeps) without the engine owning the source counts
+        self._obs_resync: dict[str, int] = {}
+        self._obs_resweep: dict[str, int] = {}
 
         self.solver = None
         if use_mesh:
@@ -114,6 +121,7 @@ class PlanEngine:
                         max_requesters=max_requesters,
                         mesh=Mesh(np.array(devs), axis_names=("s",)),
                         servers_per_device=spd,
+                        auction=auction,
                     )
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 import sys
@@ -417,6 +425,24 @@ class PlanEngine:
                 self.metrics.gauge("ledger_rows").set(led.rows_resident())
                 self.metrics.gauge("ledger_patch_us").set(
                     round(led.last_sync_us, 1))
+            # O(Δ)-steady-state monitors: full ledger rebuilds and full
+            # shard re-sweeps, labelled by why they happened. Emitted as
+            # deltas of the source dicts so the counters stay monotone
+            # across solver/ledger swaps (force_host_path).
+            for fam, src, seen in (
+                ("ledger_resyncs",
+                 getattr(led, "resync_reasons", None), self._obs_resync),
+                ("solver_resweeps",
+                 getattr(self.solver, "sweep_reasons", None),
+                 self._obs_resweep),
+            ):
+                if src:
+                    for reason, total in src.items():
+                        d = total - seen.get(reason, 0)
+                        if d > 0:
+                            self.metrics.counter(
+                                fam, reason=reason).inc(d)
+                            seen[reason] = total
             if matches:
                 self.metrics.counter("balancer_pairs").inc(len(matches))
             if migrations:

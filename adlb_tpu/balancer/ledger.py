@@ -53,6 +53,7 @@ always-eligible semantics the Python filter gives them.
 
 from __future__ import annotations
 
+import bisect
 import time
 from typing import Optional
 
@@ -94,6 +95,95 @@ class _Marks(dict):
         if had and self._on_del is not None:
             self._on_del(key)
         return out
+
+
+class SnapshotStore(dict):
+    """A snapshot dict that *narrates its own changes*: every mutation
+    bumps a monotonic version and appends ``(ver, rank)`` to a
+    dedup-compacted change log, and membership changes (new rank, death)
+    additionally bump ``member_ver``.  :meth:`ArrayLedger.sync` uses
+    these to touch only the ranks that changed since its last sync —
+    killing the per-round O(servers) compare scan that was the 1k-parked
+    admission floor (MULTICHIP_r07) — while staying a plain dict for
+    every other consumer (the ``host_ledger="py"`` twin, the sharded
+    solver's stamp path, tests).
+
+    In-place snapshot mutations that bypass ``__setitem__`` (the
+    task-delta append, dead-rank requester patches) must call
+    :meth:`bump`; the producers do (``server._merge_task_delta`` /
+    ``_patch_snapshots_for_dead``, the sidecar's delta merge).  A missed
+    bump is caught by the ledger's cadence resync, same contract as the
+    stamp fast paths.
+
+    :meth:`fork` takes the balancer round's shallow copy (the same
+    ``dict(snapshots)`` the worker always took) carrying the version
+    counters along, so a concurrently-mutating producer never tears a
+    round: the consumer reads the log only up to the fork's ``ver``.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        self.ver = 1
+        self.member_ver = 1
+        # lineage token: forks share it, distinct stores never do — a
+        # consumer's seen-version marks are only meaningful against the
+        # same version sequence
+        self.lineage = id(self)
+        self._log: list = []  # (ver, rank) ascending, dedup-compacted
+        if args or kwargs:
+            for rank, snap in dict(*args, **kwargs).items():
+                self[rank] = snap
+
+    def _touch(self, rank) -> None:
+        self.ver += 1
+        self._log.append((self.ver, rank))
+        if len(self._log) > max(256, 2 * len(self) + 8):
+            # lossless dedup-compaction: keep only each rank's LAST
+            # entry — any consumer position either already processed the
+            # dropped older entries or still sees the survivor
+            last: dict = {}
+            for v, r in self._log:
+                last[r] = v
+            self._log = sorted((v, r) for r, v in last.items())
+
+    def __setitem__(self, rank, snap) -> None:
+        if rank not in self:
+            self.member_ver = self.ver + 1
+        dict.__setitem__(self, rank, snap)
+        self._touch(rank)
+
+    def bump(self, rank) -> None:
+        """Record an in-place mutation of ``self[rank]``."""
+        if rank in self:
+            self._touch(rank)
+
+    def __delitem__(self, rank) -> None:
+        dict.__delitem__(self, rank)
+        self.ver += 1
+        self.member_ver = self.ver
+
+    def pop(self, rank, *default):
+        had = rank in self
+        out = dict.pop(self, rank, *default)
+        if had:
+            self.ver += 1
+            self.member_ver = self.ver
+        return out
+
+    def fork(self) -> "SnapshotStore":
+        """Shallow round-scoped copy sharing the (append-only) change
+        log; snapshot values are shared, as the worker's ``dict()`` copy
+        always did."""
+        f = SnapshotStore()
+        # counters first, content second: a producer racing the fork can
+        # only make the copy NEWER than its version marks, so the reader
+        # at worst re-processes a rank next round — never misses one
+        f.ver = self.ver
+        f.member_ver = self.member_ver
+        f.lineage = self.lineage
+        f._log = self._log
+        dict.update(f, self)
+        return f
 
 
 class PyLedger:
@@ -267,10 +357,32 @@ class ArrayLedger:
         self._sup_touched: set = set()
         self._round_kept = 0
         self._any_unknown_req = False
+        self._unknown_n = 0
         self._parked: list = []
+        # SnapshotStore consumption state: the store lineage plus the
+        # version and membership version this ledger has fully absorbed
+        self._seen_ver = 0
+        self._seen_member_ver = None
+        self._seen_lineage = None
+        # ranks whose snapshots carry no stamp: re-derived every round
+        # (the Python filter's "stamp defaults to now" semantics), so
+        # the store fast path must visit them even when unchanged
+        self._stampless: set = set()
+        # membership generation for stateful view consumers (the
+        # sharded solver's vectorized ingest): bumped whenever a slot
+        # is taken or dropped, so a consumer can skip its own O(S)
+        # membership walk on the (vastly common) no-churn round
+        self.member_gen = 1
         # stats surfaced by bench / CI smoke / obs gauges
         self.patch_count = 0     # incremental per-server (re)builds
         self.resync_count = 0    # full rebuilds (cold + cadence)
+        # why each full pass ran — "cadence" is the periodic safety
+        # rebuild; store-backed rounds also classify "cold" (new store
+        # lineage / first sync) and "membership" (join/drain/failover
+        # moved member_ver). Steady state must show only cadence growth;
+        # the engine mirrors these onto /metrics as ledger_resyncs.
+        self.resync_reasons: dict = {"cadence": 0, "cold": 0,
+                                     "membership": 0}
         self.last_sync_us = 0.0
         self._alloc(16)
 
@@ -302,6 +414,7 @@ class ArrayLedger:
             self.pk_rm = np.zeros((cap, R, T), bool)
             self.t_gen = np.zeros(cap, np.int64)
             self.r_gen = np.zeros(cap, np.int64)
+            self.slot_rank = np.full(cap, -1, np.int64)
             self.pk_trefs = [[None] * K for _ in range(cap)]
             self.pk_rrefs = [[None] * R for _ in range(cap)]
         else:
@@ -311,7 +424,7 @@ class ArrayLedger:
                 ("g_planned_away", True), ("g_hasreqs", False),
                 ("g_consumers", 0), ("pk_tp", _NEG), ("pk_tt", -1),
                 ("pk_rv", False), ("pk_rm", False), ("t_gen", 0),
-                ("r_gen", 0),
+                ("r_gen", 0), ("slot_rank", -1),
             ):
                 a = getattr(self, name)
                 n = np.full((cap,) + a.shape[1:], fill, a.dtype)
@@ -327,6 +440,8 @@ class ArrayLedger:
             self._alloc(self._cap * 2)
         srv = _Srv(rank, self._free.pop())
         self._srv[rank] = srv
+        self.slot_rank[srv.slot] = rank
+        self.member_gen += 1
         self._order_stale = True
         return srv
 
@@ -350,11 +465,16 @@ class ArrayLedger:
         self.pk_rrefs[s] = [None] * self.R
         self.t_gen[s] = self._bump()
         self.r_gen[s] = self._bump()
+        self.slot_rank[s] = -1
+        self.member_gen += 1
         self._free.append(s)
         self._order_stale = True
         self._stale_rq.discard(rank)
         self._stale_tk.discard(rank)
         self._sup_touched.discard(rank)
+        self._stampless.discard(rank)
+        if srv.r_unknown:
+            self._unknown_n -= 1
 
     def _bump(self) -> int:
         self._gen += 1
@@ -369,46 +489,58 @@ class ArrayLedger:
         resync = self._rounds % self.LEDGER_RESYNC_INTERVAL == 0
         if resync:
             self.resync_count += 1
-        srv_get = self._srv.get
-        for rank, snap in snapshots.items():
-            srv = srv_get(rank)
-            if srv is None:
-                srv = self._take_slot(rank)
-            # stampless snapshots re-derive every round (the Python
-            # filter's "stamp defaults to now" semantics); the length
-            # check catches a key-less in-place append (belt-and-braces
-            # next to the resync cadence). Keys are compared component-
-            # wise — this loop is the whole per-round O(servers) floor,
-            # so no tuple allocations on the unchanged fast path.
-            stamp = snap.get("stamp")
-            if (
-                resync
-                or stamp is None
-                or srv.r_stamp != stamp
-                or srv.r_key != snap.get("req_seq", 0)
-                or srv.r_n != len(snap["reqs"])
-            ):
-                self._rebuild_reqs(srv, snap, stamp,
-                                   snap.get("req_seq", 0), now)
-                self.patch_count += 1
-            tstamp = snap.get("task_stamp", stamp)
-            if (
-                resync
-                or tstamp is None
-                or srv.t_stamp != tstamp
-                or srv.t_key != snap.get("delta_seq", 0)
-                or srv.t_n != len(snap["tasks"])
-            ):
-                self._rebuild_tasks(srv, snap, tstamp,
-                                    snap.get("delta_seq", 0), now)
-                self.patch_count += 1
-            c = snap.get("consumers", 0)
-            if srv.consumers != c:
-                srv.consumers = c
-                self.g_consumers[srv.slot] = c
-        if len(self._srv) != len(snapshots):
-            for rank in [r for r in self._srv if r not in snapshots]:
-                self._drop(rank)
+            self.resync_reasons["cadence"] += 1
+        ver = getattr(snapshots, "ver", None)
+        if (
+            ver is not None
+            and not resync
+            and getattr(snapshots, "lineage", None) == self._seen_lineage
+            and snapshots.member_ver == self._seen_member_ver
+        ):
+            # store fast path — membership unchanged since the last
+            # sync, so only the change log's tail (ranks whose store
+            # version moved past our seen mark) plus the stampless set
+            # (re-derived every round by contract) are visited. An idle
+            # round touches nothing: O(changed), not O(servers).
+            seen = self._seen_ver
+            if ver != seen:
+                log = snapshots._log
+                done: set = set()
+                for v, rank in log[bisect.bisect_left(log, (seen + 1,)):]:
+                    if v > ver:
+                        break  # appended after our fork was taken
+                    if rank in done:
+                        continue
+                    done.add(rank)
+                    snap = snapshots.get(rank)
+                    if snap is not None:
+                        self._sync_one(rank, snap, False, now)
+                for rank in tuple(self._stampless):
+                    if rank not in done and rank in snapshots:
+                        self._sync_one(rank, snapshots[rank], False, now)
+            elif self._stampless:
+                for rank in tuple(self._stampless):
+                    if rank in snapshots:
+                        self._sync_one(rank, snapshots[rank], False, now)
+            self._seen_ver = ver
+        else:
+            # full pass: plain dicts (unit tests, hand-built harnesses),
+            # the cadence resync, and any store membership change (join,
+            # drain, failover — the O(S) walk is paid only on churn)
+            if ver is not None and not resync:
+                if getattr(snapshots, "lineage", None) != self._seen_lineage:
+                    self.resync_reasons["cold"] += 1
+                else:
+                    self.resync_reasons["membership"] += 1
+            for rank, snap in snapshots.items():
+                self._sync_one(rank, snap, resync, now)
+            if len(self._srv) != len(snapshots):
+                for rank in [r for r in self._srv if r not in snapshots]:
+                    self._drop(rank)
+            if ver is not None:
+                self._seen_ver = ver
+                self._seen_member_ver = snapshots.member_ver
+                self._seen_lineage = getattr(snapshots, "lineage", None)
         if self._order_stale:
             self.servers = sorted(self._srv)
             self._order = np.fromiter(
@@ -416,8 +548,50 @@ class ArrayLedger:
                 len(self.servers),
             )
             self._order_stale = False
-        self._any_unknown_req = any(s.r_unknown for s in self._srv.values())
+        self._any_unknown_req = self._unknown_n > 0
         self.last_sync_us = (time.perf_counter() - t0) * 1e6
+
+    def _sync_one(self, rank: int, snap: dict, resync: bool,
+                  now: float) -> None:
+        srv = self._srv.get(rank)
+        if srv is None:
+            srv = self._take_slot(rank)
+        # stampless snapshots re-derive every round (the Python
+        # filter's "stamp defaults to now" semantics); the length
+        # check catches a key-less in-place append (belt-and-braces
+        # next to the resync cadence). Keys are compared component-
+        # wise — this body is the per-rank compare floor, so no tuple
+        # allocations on the unchanged fast path.
+        stamp = snap.get("stamp")
+        if (
+            resync
+            or stamp is None
+            or srv.r_stamp != stamp
+            or srv.r_key != snap.get("req_seq", 0)
+            or srv.r_n != len(snap["reqs"])
+        ):
+            self._rebuild_reqs(srv, snap, stamp,
+                               snap.get("req_seq", 0), now)
+            self.patch_count += 1
+        tstamp = snap.get("task_stamp", stamp)
+        if (
+            resync
+            or tstamp is None
+            or srv.t_stamp != tstamp
+            or srv.t_key != snap.get("delta_seq", 0)
+            or srv.t_n != len(snap["tasks"])
+        ):
+            self._rebuild_tasks(srv, snap, tstamp,
+                                snap.get("delta_seq", 0), now)
+            self.patch_count += 1
+        c = snap.get("consumers", 0)
+        if srv.consumers != c:
+            srv.consumers = c
+            self.g_consumers[srv.slot] = c
+        if stamp is None or tstamp is None:
+            self._stampless.add(rank)
+        else:
+            self._stampless.discard(rank)
 
     def _rebuild_reqs(self, srv: _Srv, snap: dict, stamp, rseq,
                       now: float) -> None:
@@ -472,6 +646,8 @@ class ArrayLedger:
         srv.r_any, srv.r_mask, srv.r_planned = r_any, r_mask, r_planned
         srv.r_index = index
         srv.r_dups = dups
+        if unknown != srv.r_unknown:
+            self._unknown_n += 1 if unknown else -1
         srv.r_unknown = unknown
         srv.r_elig = r_planned < (now if stamp is None else stamp)
         srv.round_sup = _EMPTY_I8

@@ -116,8 +116,15 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         inflow_ttl=cfg.balancer_inflow_ttl,
         inflow_min_age=cfg.balancer_inflow_min_age,
         host_ledger=cfg.host_ledger,
+        auction=cfg.balancer_auction,
     )
-    snapshots: dict[int, dict] = {}
+    # versioned snapshot table (balancer/ledger.py): the ledger's sync
+    # touches only ranks whose snapshots changed since the last round.
+    # The sidecar loop is single-threaded, so the engine reads the live
+    # store (no fork needed); in-place merges below bump() it.
+    from adlb_tpu.balancer.ledger import SnapshotStore
+
+    snapshots: SnapshotStore = SnapshotStore()
     ended: set[int] = set()
     servers = set(world.server_ranks)
     rounds = 0
@@ -200,6 +207,7 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                         # _merge_task_delta has always bumped it; the
                         # sidecar merge was the one spot that didn't)
                         snap["delta_seq"] = snap.get("delta_seq", 0) + 1
+                        snapshots.bump(m.src)  # in-place append
                         dirty = True
                 elif m.tag is Tag.DS_END:
                     ended.add(m.src)
@@ -233,6 +241,7 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                         kept = [r for r in snap["reqs"] if r[0] != dead]
                         if len(kept) != len(snap["reqs"]):
                             snap["reqs"] = kept
+                            snapshots.bump(src)  # in-place patch
                             dirty = True
                             broadcast(tracker.update(src, kept))
                 m = ep.recv(timeout=0.0)

@@ -33,6 +33,27 @@ import jax.numpy as jnp
 # the numpy host path (and a wedged accelerator tunnel would hang them).
 _NEG = -(2**31) + 1
 _PRIO_CLIP = 10**9
+_I32MAX = 2**31 - 1
+
+
+def _stable_argsort2(primary, secondary):
+    """argsort by (primary asc, secondary asc, index asc) — the
+    lexsort((secondary, primary)) order — composed from two single-key
+    stable sorts (XLA's variadic comparator sort is ~10x slower on CPU
+    hosts than its single-key fast path).  Shared by the sharded
+    candidate generation and the on-device auction
+    (balancer/distributed.py)."""
+    o1 = jnp.argsort(secondary, stable=True)
+    o2 = jnp.argsort(primary[o1], stable=True)
+    return o1[o2]
+
+
+def _stable_argsort3(primary, secondary, tertiary):
+    """argsort by (primary asc, secondary asc, tertiary asc) from three
+    composed single-key stable sorts — innermost key first."""
+    o = jnp.argsort(tertiary, stable=True)
+    o = o[jnp.argsort(secondary[o], stable=True)]
+    return o[jnp.argsort(primary[o], stable=True)]
 
 
 @jax.jit

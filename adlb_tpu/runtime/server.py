@@ -116,6 +116,7 @@ class _BalancerWorker(threading.Thread):
             inflow_ttl=s.cfg.balancer_inflow_ttl,
             inflow_min_age=s.cfg.balancer_inflow_min_age,
             host_ledger=s.cfg.host_ledger,
+            auction=s.cfg.balancer_auction,
             metrics=s.metrics,
         )
         s._solver = engine.solver
@@ -123,15 +124,34 @@ class _BalancerWorker(threading.Thread):
 
         _profile.register_thread("balancer")
         prof = _profile.active()
+        # Event-gated loop: sleep on the doorbell (armed by parks, task
+        # deltas, qmstat/hungry changes and failover patches) and fall
+        # back to a slow insurance tick — an idle world runs ~4 rounds/s
+        # instead of spinning through wake/solve cycles, and the sampler
+        # attributes waiting to "balancer_idle" so the parity profile's
+        # balancer_tick share measures ROUNDS, not thread lifetime.
+        idle = s.cfg.balancer_idle_interval
         while True:
-            self.wake.wait(timeout=0.25)
+            if prof is not None:
+                prof.set_phase("balancer_idle")
+            self.wake.wait(timeout=idle if idle > 0 else None)
             self.wake.clear()
             if self.stopped or s.done:
                 return
             try:
                 if prof is not None:
                     prof.set_phase("balancer_tick")
-                self._one_round(engine)
+                gap, produced = self._one_round(engine)
+                if prof is not None:
+                    prof.set_phase("balancer_idle")
+                if gap > 0:
+                    time.sleep(gap)
+                if produced:
+                    # a plan-bearing round usually uncovers follow-on
+                    # work (the drained holder's next snapshot may lag
+                    # the insurance tick); re-arm so the next round runs
+                    # right after the rate-limit gap
+                    self.wake.set()
             except Exception as e:  # noqa: BLE001
                 # The balancer must survive solver/backend errors — in tpu
                 # mode there is no other cross-server matching mechanism.
@@ -147,11 +167,13 @@ class _BalancerWorker(threading.Thread):
                 engine.force_host_path()
                 time.sleep(0.05)
 
-    def _one_round(self, engine) -> None:
+    def _one_round(self, engine) -> tuple:
         s = self.server
-        snaps = dict(s._snapshots)  # one copy: the round AND the fetch
+        snaps = s._snapshots.fork()  # one copy: the round AND the fetch
         # lookup below must see the same view, or a reactor-thread
-        # snapshot swap mid-round could silently drop a match's flag
+        # snapshot swap mid-round could silently drop a match's flag.
+        # fork() carries the store's version marks so the ledger's sync
+        # only touches ranks that changed since the previous round
         if s.tracer is not None:
             with s.tracer.span("balancer:round"):
                 matches, migrations = engine.round(snaps, s.world)
@@ -203,12 +225,16 @@ class _BalancerWorker(threading.Thread):
                 )
             except OSError:
                 continue
+        gap = 0.0
         if s.cfg.balancer_min_gap > 0:
             # module already cached by run()'s deferred import; this stays
             # a plain lookup, not a fresh module load
             from adlb_tpu.balancer.engine import round_gap
 
-            time.sleep(round_gap(s.cfg.balancer_min_gap, matches, migrations))
+            gap = round_gap(s.cfg.balancer_min_gap, matches, migrations)
+        # the caller sleeps the gap (under the idle phase marker) and
+        # re-arms the doorbell after plan-bearing rounds
+        return gap, bool(matches or migrations)
 
 
 class _PeerState:
@@ -513,8 +539,13 @@ class Server:
         self._exhaust_token_id = 0
         self.activity = 0  # puts accepted + reservations handed out
 
-        # balancer state (master only, tpu mode)
-        self._snapshots: dict[int, dict] = {}
+        # balancer state (master only, tpu mode). The snapshot table is a
+        # SnapshotStore (a dict that versions its own mutations) so the
+        # ledger's sync touches only changed ranks instead of walking all
+        # S snapshots every round; in-place mutations below bump() it.
+        from adlb_tpu.balancer.ledger import SnapshotStore
+
+        self._snapshots: SnapshotStore = SnapshotStore()
         self._solver = None
         self._balancer: Optional[_BalancerWorker] = None
         if cfg.balancer == "tpu" and self.is_master:
@@ -3691,6 +3722,7 @@ class Server:
         # fast path notice the in-place append without a stamp bump
         # (bumping task_stamp here would re-eligibilize planned tasks).
         snap["delta_seq"] = snap.get("delta_seq", 0) + 1
+        self._snapshots.bump(src)  # in-place append: version it
         if self._balancer is not None:
             self._balancer.wake.set()
 
@@ -6416,6 +6448,7 @@ class Server:
                 # the sequence carries the in-place patch to the
                 # sharded solver's unchanged-server fast path
                 snap["req_seq"] = snap.get("req_seq", 0) + 1
+                self._snapshots.bump(src)  # in-place patch: version it
                 self._req_sigs[src] = tuple(
                     sorted((r[0], r[1]) for r in kept)
                 )

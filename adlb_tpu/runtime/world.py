@@ -105,6 +105,13 @@ class Config:
     # min gap between event-driven solves (a park triggers an immediate
     # snapshot+solve; this bounds solve rate under churn)
     balancer_min_gap: float = 0.002
+    # the balancer worker is event-gated: it sleeps on its doorbell
+    # (armed by puts, requester parks and qmstat deltas) and only falls
+    # back to this slow insurance tick when no work signal arrives —
+    # an idle world pays ~4 ticks/s instead of 50 (the 20 ms tick was
+    # 8.3% of single-core samples on the tsp parity bench). 0 disables
+    # the insurance tick entirely (pure event-driven; not recommended)
+    balancer_idle_interval: float = 0.25
     # untargeted put routing: "round_robin" spreads over servers (reference
     # src/adlb.c:2771-2773); "home" keeps work at the putter's home server
     # (data locality; relies on the balancer to redistribute)
@@ -254,6 +261,13 @@ class Config:
     # balancer's task table over a jax.sharding.Mesh (one shard per device,
     # balancer/distributed.py); "off" = single-device solve
     balancer_mesh: str = "off"
+    # auction tier of the sharded solver (balancer/distributed.py):
+    # "device" runs merge + auction rounds + commit threshold as one
+    # jitted shard_map program (no per-round host merge of the gather);
+    # "host" is the retained reference twin the device tier is
+    # fuzz-proven exactly equal to. Only consulted when the mesh
+    # solver is active (balancer_mesh="auto" on a multi-device host)
+    balancer_auction: str = "device"
     # host tier of the plan engine (balancer/ledger.py): "array" keeps
     # parked requesters / snapshot tasks resident in numpy columns so
     # round admission costs O(changed rows); "py" is the pure-Python
@@ -645,6 +659,12 @@ class Config:
             raise ValueError("balancer_max_requesters must be in 1..2048")
         if self.balancer_mesh not in ("off", "auto"):
             raise ValueError(f"unknown balancer_mesh {self.balancer_mesh!r}")
+        if self.balancer_auction not in ("device", "host"):
+            raise ValueError(
+                f"unknown balancer_auction {self.balancer_auction!r}"
+            )
+        if self.balancer_idle_interval < 0:
+            raise ValueError("balancer_idle_interval must be >= 0")
 
 
 def normalize_req_types(

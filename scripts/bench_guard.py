@@ -46,11 +46,26 @@ GUARDS = {
     "solve_ms": [
         ("4096x512", "solve_4096x512_ms"),
     ],
-    # the multichip planning round at 1,000 servers / 100k parked
-    # requesters on the 8-way simulated mesh (r06 metric; baselines
-    # older than r06 skip it with a note, per the missing-baseline rule)
+    # the multichip planning round on the 8-way simulated mesh: 1,000
+    # servers / 100k parked (r06 metric) and 10,000 servers / 1M parked
+    # (first carried by the post-r10 record; older baselines skip it
+    # with a note, per the missing-baseline rule). Both cells measure
+    # the HOST auction tier — on a host-SIMULATED mesh the on-device
+    # tier is dominated by the fixed 8-way virtual-device
+    # dispatch/rendezvous cost (~90 ms/call at any scale, see
+    # MULTICHIP_r08), which would drown real regressions; the device
+    # tier is pair-list-fuzzed in CI and its host-sim latency recorded
+    # per MULTICHIP round instead.
     "plan_round": [
         ("1k", "plan_round_1k_ms"),
+        ("10k", "plan_round_10k_ms"),
+    ],
+    # host-tier round admission at 1k parked — the r07 2.4x floor the
+    # stamp-keyed SnapshotStore sync removed (first carried by the
+    # post-r10 record; older baselines skip with a note).
+    # MILLISECONDS, array ledger arm.
+    "admission": [
+        ("1k", "admission_1k_ms"),
     ],
     # host-tier round admission at 100k parked requesters (r08 metric;
     # older baselines skip with a note): engine.round() p50 in

@@ -1,0 +1,160 @@
+"""On-device auction tier, unit-level: the fused candidate-gen -> merge
+-> auction shard_map program (`_build_plan_fn`) against the retained
+host twin, on the SAME resident solver state.
+
+tests/test_sharded_parity.py proves both tiers against the single-device
+greedy through the full ingest path; these tests pin the tighter
+contract the twins share — the device tier's committed [T, C+1]
+assignment matrix and extracted pair list must equal the host tier's
+EXACTLY (not just matched-set-and-score: both tiers rank the same
+rank-keyed gids over the same requester windows, so any divergence at
+all is a commit-threshold or tie-break bug) — plus the fixed-shape
+guarantee at the 10,000-server shape: live counts, task deltas and
+churn must never retrace the one compiled program.
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU platform)
+
+import jax
+from jax.sharding import Mesh
+
+from adlb_tpu.balancer.distributed import DistributedAssignmentSolver
+
+TYPES = (1, 2, 3, 4)
+
+
+def _mesh(ndev):
+    return Mesh(np.array(jax.devices()[:ndev]), axis_names=("s",))
+
+
+def _random_snapshots(rng, nservers, ntasks, nreqs, ntypes):
+    types = TYPES[:ntypes]
+    snapshots = {}
+    seq = 0
+    for s in range(100, 100 + nservers):
+        tasks = []
+        for _ in range(rng.integers(0, ntasks + 1)):
+            seq += 1
+            tasks.append(
+                (seq, int(rng.choice(types)), int(rng.integers(-9, 10)), 8)
+            )
+        tasks.sort(key=lambda t: -t[2])
+        reqs = []
+        for r in range(rng.integers(0, nreqs + 1)):
+            reqs.append(
+                (
+                    (s - 100) * 50 + r,
+                    int(rng.integers(1, 1000)),
+                    None if rng.random() < 0.25
+                    else sorted({int(rng.choice(types))
+                                 for _ in range(rng.integers(1, 3))}),
+                )
+            )
+        snapshots[s] = {"tasks": tasks, "reqs": reqs}
+    return snapshots
+
+
+def _twin_solvers(mesh, ntypes, nservers, rounds=64):
+    kw = dict(
+        types=TYPES[:ntypes], max_tasks_per_server=10, max_requesters=5,
+        mesh=mesh, rounds=rounds,
+        servers_per_device=max(1, -(-nservers // mesh.devices.size)),
+    )
+    return (DistributedAssignmentSolver(auction="device", **kw),
+            DistributedAssignmentSolver(auction="host", **kw))
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 8])
+def test_device_pairs_equal_host_pairs_exactly(ndev):
+    """Same snapshots through both tiers: the extracted pair LISTS are
+    identical — stronger than the matched-set-and-score parity bar."""
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(7000 + ndev)
+    for trial in range(6):
+        ntypes = int(rng.integers(1, len(TYPES) + 1))
+        nservers = max(ndev, int(rng.integers(1, 4)) * ndev)
+        dev, host = _twin_solvers(mesh, ntypes, nservers)
+        snaps = _random_snapshots(
+            rng, nservers=nservers, ntasks=8, nreqs=4, ntypes=ntypes)
+        assert dev.solve(snaps, None) == host.solve(snaps, None)
+
+
+@pytest.mark.parametrize("ndev", [2, 8])
+def test_device_tier_tracks_host_across_mutating_rounds(ndev):
+    """Incremental rounds — task deltas, req churn, a vanished server —
+    keep the tiers pair-identical round after round (the device tier
+    re-derives from resident state; the host tier patches its merged
+    candidate lists). Also pins zero-commit rounds: when every
+    requester is satisfied or incompatible, both return empty."""
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(8100 + ndev)
+    nservers = 2 * ndev
+    dev, host = _twin_solvers(mesh, len(TYPES), nservers)
+    snaps = _random_snapshots(
+        rng, nservers=nservers, ntasks=6, nreqs=3, ntypes=len(TYPES))
+    seq = [10**6]
+    for rnd in range(6):
+        assert dev.solve(snaps, None) == host.solve(snaps, None)
+        # mutate: one server gains a task burst, one loses its reqs,
+        # and on round 3 a server vanishes entirely (elastic drain)
+        ranks = sorted(snaps)
+        burst_at = snaps[ranks[rnd % len(ranks)]]
+        for _ in range(3):
+            seq[0] += 1
+            burst_at["tasks"].append(
+                (seq[0], int(rng.choice(TYPES)),
+                 int(rng.integers(-9, 10)), 8))
+        burst_at["tasks"].sort(key=lambda t: -t[2])
+        snaps[ranks[(rnd + 1) % len(ranks)]]["reqs"] = []
+        if rnd == 3 and len(snaps) > 1:
+            del snaps[ranks[-1]]
+    # zero-requester world: both tiers plan nothing
+    for snap in snaps.values():
+        snap["reqs"] = []
+    assert dev.solve(snaps, None) == []
+    assert host.solve(snaps, None) == []
+
+
+def test_no_retrace_at_10k_shape():
+    """The 10,000-server shape (ISSUE 18 acceptance): the fused device
+    program compiles ONCE and every subsequent plan — different live
+    counts, deltas, churn — reuses it (`_cache_size() == 1`)."""
+    mesh = _mesh(8)
+    rng = np.random.default_rng(99)
+    sol = DistributedAssignmentSolver(
+        types=TYPES, max_tasks_per_server=4, max_requesters=2,
+        mesh=mesh, rounds=16, servers_per_device=1250, auction="device",
+    )
+    assert sol.S == 10000
+    # sparse world: most rows empty (the fixed shape covers them), a
+    # couple hundred live servers — the SHAPE is what is under test
+    snaps = {}
+    seq = 0
+    for s in range(100, 100 + 256):
+        seq += 4
+        snaps[s] = {
+            "tasks": [(seq, int(rng.choice(TYPES)),
+                       int(rng.integers(-9, 10)), 8)],
+            "reqs": [(s * 50, 1,
+                      [int(rng.choice(TYPES))])] if s % 2 else [],
+        }
+    sol.solve(snaps, None)
+    for rnd in range(3):
+        # churn: drop one server, add a fresh high rank, burst a third
+        victim = sorted(snaps)[rnd]
+        del snaps[victim]
+        fresh = 20000 + rnd
+        snaps[fresh] = {
+            "tasks": [(10**7 + rnd, int(rng.choice(TYPES)), 5, 8)],
+            "reqs": [(fresh * 50, 1, None)],
+        }
+        seq += 1
+        first = snaps[sorted(snaps)[0]]
+        first["tasks"] = (first["tasks"] + [
+            (seq, int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+        ])[: sol.K]
+        sol.solve(snaps, None)
+    assert sol._plan_fn._cache_size() == 1

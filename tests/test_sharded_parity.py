@@ -3,9 +3,12 @@ sequential greedy matching of the single-device solver.
 
 Contract (see balancer/distributed.py docstring): same matched requester
 set AND same total committed score, fuzz-checked at mesh sizes 1, 2 and
-8 — plus a recompile guard (fixed shapes: varying live task/requester
-counts must never retrace the jitted sweep) and the auto-padding of
-server rows that are not a multiple of the mesh size."""
+8 and at BOTH auction tiers (the on-device fused plan and its host
+twin) — plus recompile guards (fixed shapes: varying live
+task/requester counts must never retrace the jitted sweep or the fused
+device plan), elastic churn mid-planning (joins/leaves patch rows, no
+full re-sweep), and the auto-padding of server rows that are not a
+multiple of the mesh size."""
 
 import numpy as np
 import pytest
@@ -87,9 +90,14 @@ def _check_parity(p_dist, p_single, snapshots):
         assert mask is None or type_of[(holder, seqno)] in mask
 
 
-def test_parity_fuzz(mesh):
+@pytest.fixture(params=["device", "host"])
+def auction(request):
+    return request.param
+
+
+def test_parity_fuzz(mesh, auction):
     """Random instances: matched requester set AND total score equal the
-    single-device greedy, at every mesh size."""
+    single-device greedy, at every mesh size, at both auction tiers."""
     ndev = mesh.devices.size
     rng = np.random.default_rng(1000 + ndev)
     for trial in range(8):
@@ -99,6 +107,7 @@ def test_parity_fuzz(mesh):
             types=TYPES[:ntypes], max_tasks_per_server=12,
             max_requesters=6, mesh=mesh, rounds=64,
             servers_per_device=max(1, nservers // ndev),
+            auction=auction,
         )
         single = AssignmentSolver(
             types=TYPES[:ntypes], max_tasks=12, max_requesters=6)
@@ -108,7 +117,7 @@ def test_parity_fuzz(mesh):
                       single.solve(snaps, None), snaps)
 
 
-def test_parity_across_incremental_rounds(mesh):
+def test_parity_across_incremental_rounds(mesh, auction):
     """The stateful delta-ingest path must keep producing the same plans
     a stateless single-device solve of the same snapshots would — across
     rounds that add, consume and re-park work (the candidate-list patch
@@ -117,7 +126,7 @@ def test_parity_across_incremental_rounds(mesh):
     ndev = mesh.devices.size
     dist = DistributedAssignmentSolver(
         types=TYPES, max_tasks_per_server=12, max_requesters=6,
-        mesh=mesh, rounds=64, servers_per_device=2,
+        mesh=mesh, rounds=64, servers_per_device=2, auction=auction,
     )
     single = AssignmentSolver(types=TYPES, max_tasks=12, max_requesters=6)
     nservers = 2 * ndev
@@ -159,13 +168,14 @@ def test_parity_across_incremental_rounds(mesh):
 
 def test_no_retrace_across_rounds():
     """Varying live task/requester counts must hit the cached executable:
-    the jitted sweep compiles exactly once for a solver's fixed shapes."""
+    the jitted sweep compiles exactly once for a solver's fixed shapes
+    (host tier: the sweep is what calls the gather fn)."""
     devs = np.array(jax.devices()[:8])
     mesh = Mesh(devs, axis_names=("s",))
     rng = np.random.default_rng(3)
     dist = DistributedAssignmentSolver(
         types=TYPES, max_tasks_per_server=8, max_requesters=4, mesh=mesh,
-        rounds=16,
+        rounds=16, auction="host",
     )
     dist.RESYNC_INTERVAL = 1  # sweep every plan: exercise the jit path
     for trial in range(4):
@@ -174,6 +184,74 @@ def test_no_retrace_across_rounds():
         dist.solve(snaps, None)
     assert dist._gather_fn._cache_size() == 1
     assert dist.sweep_count >= 3
+
+
+def test_no_retrace_device_tier():
+    """The fused on-device plan compiles exactly once for a solver's
+    fixed shapes, across varying live counts AND elastic churn."""
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, axis_names=("s",))
+    rng = np.random.default_rng(4)
+    dist = DistributedAssignmentSolver(
+        types=TYPES, max_tasks_per_server=8, max_requesters=4, mesh=mesh,
+        rounds=16, servers_per_device=2,
+    )
+    for trial in range(4):
+        # churn: the membership shifts by one server every trial
+        snaps = _random_snapshots(
+            rng, nservers=10 + trial, ntasks=4, nreqs=2, ntypes=4)
+        for s in list(snaps)[:trial]:
+            del snaps[s]
+        dist.solve(snaps, None)
+    assert dist._plan_fn._cache_size() == 1
+
+
+def test_churn_during_planning_no_resweep(mesh, auction):
+    """Elastic churn landing between planning rounds (a PR 15 epoch
+    bump: joins + drains) must patch only the affected rows — never a
+    full re-sweep of the host tier's candidate lists — and keep exact
+    single-solver parity every round."""
+    rng = np.random.default_rng(21)
+    ndev = mesh.devices.size
+    dist = DistributedAssignmentSolver(
+        types=TYPES, max_tasks_per_server=10, max_requesters=5,
+        mesh=mesh, rounds=64, servers_per_device=4, auction=auction,
+    )
+    single = AssignmentSolver(types=TYPES, max_tasks=10, max_requesters=5)
+    nservers = 2 * ndev
+    snaps = _random_snapshots(
+        rng, nservers=nservers, ntasks=6, nreqs=3, ntypes=4)
+    stamp = [1.0]
+    for s in snaps:
+        snaps[s]["stamp"] = snaps[s]["task_stamp"] = stamp[0]
+    next_rank = [100 + nservers]
+    seq = [10**6]
+    dist.solve(snaps, None)  # cold sweep; churn rounds start counted
+    sweeps0 = dist.sweep_count
+    for _round in range(5):
+        # drain one server, attach one new one (fresh rank)
+        victim = sorted(snaps)[_round % len(snaps)]
+        del snaps[victim]
+        rank = next_rank[0]
+        next_rank[0] += 1
+        stamp[0] += 1
+        tasks = []
+        for _ in range(int(rng.integers(1, 6))):
+            seq[0] += 1
+            tasks.append((seq[0], int(rng.choice(TYPES)),
+                          int(rng.integers(-9, 10)), 8))
+        tasks.sort(key=lambda t: -t[2])
+        snaps[rank] = {
+            "tasks": tasks,
+            "reqs": [(rank * 50, 1, [int(rng.choice(TYPES))])],
+            "stamp": stamp[0], "task_stamp": stamp[0],
+        }
+        _check_parity(dist.solve(snaps, None),
+                      single.solve(snaps, None), snaps)
+    # a join/drain pair is a 2-row delta: the host tier patches in
+    # place (no delta/cadence re-sweep), the device tier never sweeps
+    assert dist.sweep_count == sweeps0
+    assert dist.sweep_reasons["delta"] == 0
 
 
 def test_auto_pads_non_multiple_server_rows():
@@ -219,7 +297,7 @@ def test_patch_survives_deep_single_type_burst():
     K = 256
     dist = DistributedAssignmentSolver(
         types=(1,), max_tasks_per_server=K, max_requesters=4, mesh=mesh,
-        rounds=16, servers_per_device=8,
+        rounds=16, servers_per_device=8, auction="host",
     )
     stamp = [1.0]
     snaps = {
@@ -259,7 +337,7 @@ def test_patch_resurfaces_shard_mate_tasks_beyond_sweep_window():
     K = 48
     dist = DistributedAssignmentSolver(
         types=(1,), max_tasks_per_server=K, max_requesters=2, mesh=mesh,
-        rounds=16, servers_per_device=2,
+        rounds=16, servers_per_device=2, auction="host",
     )
     # shard 0 = servers 100 (hot) + 101 (two low-prio tasks beyond the
     # sweep window: D = C + m + 1 with C = min(64-floor, NR=8) -> small)
