@@ -80,6 +80,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+from adlb_tpu.balancer.jobdim import bias_vector, expand_types
 from adlb_tpu.balancer.solve import (
     _I32MAX, _NEG, _PRIO_CLIP, _stable_argsort3)
 
@@ -535,12 +536,21 @@ class DistributedAssignmentSolver:
         cand_width: int = 32,
         slots_per_type: Optional[int] = None,
         auction: str = "device",
+        max_jobs: int = 1,
+        job_weights: Optional[dict] = None,
     ) -> None:
         if auction not in ("device", "host"):
             raise ValueError(
                 f"auction must be 'device' or 'host', got {auction!r}")
         self.auction = auction
-        self.types = tuple(types)
+        self.base_types = tuple(types)
+        self.base_T = max(len(self.base_types), 1)
+        self.max_jobs = max(int(max_jobs), 1)
+        # composite (job, type) axis under multi-job planning — the
+        # base types verbatim when single-job (balancer/jobdim.py);
+        # the mesh kernels see T' generic types and stay untouched
+        self.types = expand_types(self.base_types, self.max_jobs)
+        self.job_bias = bias_vector(job_weights, self.max_jobs)
         self.type_index = {t: i for i, t in enumerate(self.types)}
         self.K = max_tasks_per_server
         self.R = max_requesters
@@ -627,6 +637,22 @@ class DistributedAssignmentSolver:
         self.solve_count = 0
 
     # ------------------------------------------------------------------
+    def set_job_bias(self, job_weights: Optional[dict]) -> bool:
+        """Install new fair-share biases and invalidate every cached
+        task row (packed prios embed the bias; the stamp/tuple caches
+        compare RAW snapshot tuples, which a weight change does not
+        touch — so they must be dropped, not diffed). The view path
+        needs no flush here: a weight change forces the ledger's own
+        full rebuild, which bumps every slot generation."""
+        bias = bias_vector(job_weights, self.max_jobs)
+        if bias == self.job_bias:
+            return False
+        self.job_bias = bias
+        self._task_cache.clear()
+        self._task_stamp.clear()
+        self._cand_dirty = True
+        return True
+
     def _ensure_built(self) -> None:
         if self._gather_fn is not None:
             return
@@ -734,9 +760,18 @@ class DistributedAssignmentSolver:
         ref = self._task_ref[si]
         for ki in range(self.K):
             ref[ki] = None
-        for ki, (seqno, wtype, prio, _len) in enumerate(tasks[: self.K]):
-            row_p[ki] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio))
-            row_t[ki] = self.type_index.get(wtype, -1)
+        # task tuples are (seqno, type, prio, len) — a 5th (job)
+        # element rides along under multi-job planning; index, don't
+        # unpack. The composite index / weight bias handling is the
+        # exact twin of solve.py's dict packer and ledger._rebuild_tasks
+        J, bias, nb = self.max_jobs, self.job_bias, len(self.job_bias)
+        for ki, tk in enumerate(tasks[: self.K]):
+            seqno, wtype, prio = tk[0], tk[1], tk[2]
+            jb = (tk[4] if len(tk) > 4 else 0) if J > 1 else 0
+            b = bias[jb] if 0 <= jb < nb else 0
+            row_p[ki] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio)) + b
+            row_t[ki] = self.type_index.get(
+                wtype if J <= 1 else (jb, wtype), -1)
             ref[ki] = (s, seqno)
         self._task_cache[s] = tasks
 
@@ -748,18 +783,28 @@ class DistributedAssignmentSolver:
         self._req_mask[base: base + R, :] = False
         for ri in range(R):
             self._req_ref[base + ri] = None
+        J, T0 = self.max_jobs, self.base_T
         for ri, req in enumerate(reqs[:R]):
             # req tuples are (rank, rqseqno, types|None) — a 4th
             # (fused-reserve) element may ride along since the
-            # remote-fused-fetch change; index, don't unpack
+            # remote-fused-fetch change, and a 5th (job) since
+            # multi-job planning; index, don't unpack. Job handling
+            # twins ledger._rebuild_reqs exactly: any-type = job-block
+            # mask, overflow job = empty mask
             rank, rqseqno, req_types = req[0], req[1], req[2]
+            jb = (req[4] if len(req) > 4 else 0) if J > 1 else 0
             i = base + ri
             self._req_valid[i] = True
-            if req_types is None:
-                self._req_mask[i, :] = True
+            if J > 1 and not 0 <= jb < J:
+                pass  # overflow job: planner-invisible
+            elif req_types is None:
+                if J <= 1:
+                    self._req_mask[i, :] = True
+                else:
+                    self._req_mask[i, jb * T0:(jb + 1) * T0] = True
             else:
                 for t in req_types:
-                    ti = self.type_index.get(t)
+                    ti = self.type_index.get(t if J <= 1 else (jb, t))
                     if ti is not None:
                         self._req_mask[i, ti] = True
             self._req_ref[i] = (s, rank, rqseqno)

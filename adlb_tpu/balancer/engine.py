@@ -33,6 +33,8 @@ import collections
 import time
 from typing import Optional
 
+from adlb_tpu.balancer.jobdim import req_job, task_job
+
 # Plan-age samples: for every round that produced output, the age of the
 # OLDEST snapshot the plan was computed from (seconds between that
 # state's capture and the plan being handed to the transport). This is
@@ -77,9 +79,19 @@ class PlanEngine:
         inflow_min_age: Optional[float] = None,
         host_ledger: str = "array",
         auction: str = "device",
+        max_jobs: int = 1,
+        job_weights: Optional[dict] = None,
         metrics=None,
     ) -> None:
         from adlb_tpu.balancer.solve import AssignmentSolver
+
+        # multi-job planning (balancer/jobdim.py): how many namespaces
+        # the solvers/ledger plan (1 = historical job-0-only, exact),
+        # and the live fair-share weights the packers fold into the
+        # assignment score as priority biases
+        self.max_jobs = max(int(max_jobs), 1)
+        self.base_types = tuple(types)
+        self._job_weights = dict(job_weights) if job_weights else {}
 
         # optional obs registry (adlb_tpu/obs/metrics.py): round duration,
         # plan age, and pairs/migrations emitted — attached by the
@@ -122,6 +134,8 @@ class PlanEngine:
                         mesh=Mesh(np.array(devs), axis_names=("s",)),
                         servers_per_device=spd,
                         auction=auction,
+                        max_jobs=self.max_jobs,
+                        job_weights=self._job_weights,
                     )
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 import sys
@@ -141,6 +155,8 @@ class PlanEngine:
                 max_tasks=max_tasks,
                 max_requesters=max_requesters,
                 backend=backend,
+                max_jobs=self.max_jobs,
+                job_weights=self._job_weights,
                 **kw,
             )
         self.max_malloc_per_server = max_malloc_per_server
@@ -176,7 +192,9 @@ class PlanEngine:
         self._planned_reqs: dict[tuple, float] = {}
         self._planned_tasks: dict[tuple, float] = {}
         if host_ledger == "array":
-            led = ArrayLedger(self, tuple(types), max_tasks, max_requesters)
+            led = ArrayLedger(self, tuple(types), max_tasks, max_requesters,
+                              max_jobs=self.max_jobs,
+                              job_weights=self._job_weights)
             self._planned_reqs = _Marks(led.on_req_mark, led.on_req_mark)
             self._planned_tasks = _Marks(led.on_task_mark, led.on_task_mark)
             self._ledger = led
@@ -210,6 +228,23 @@ class PlanEngine:
         # gated on (see _plan_migrations)
         self._last_parked: dict[int, float] = {}
 
+    def set_job_weights(self, job_weights: Optional[dict]) -> bool:
+        """Live fair-share update (controller / POST /jobs/<id>): fold
+        the new biases into every packer twin — the ledger's resident
+        columns (forced full rebuild) and the solver's own dict-path
+        bias copy (cache flush where the packed prios embed it).
+        Returns True when anything actually changed."""
+        weights = dict(job_weights) if job_weights else {}
+        if weights == self._job_weights:
+            return False
+        self._job_weights = weights
+        changed = False
+        if hasattr(self._ledger, "set_job_bias"):
+            changed |= self._ledger.set_job_bias(weights)
+        if hasattr(self.solver, "set_job_bias"):
+            changed |= self.solver.set_job_bias(weights)
+        return changed
+
     def force_host_path(self) -> None:
         """After a device/backend failure: keep planning on numpy — for the
         mesh solver, by swapping in a single-device host-path solver."""
@@ -219,10 +254,14 @@ class PlanEngine:
             from adlb_tpu.balancer.solve import AssignmentSolver
 
             self.solver = AssignmentSolver(
-                types=self.solver.types,
+                # BASE types: the replacement re-expands the composite
+                # axis itself from (base types, max_jobs)
+                types=getattr(self.solver, "base_types", self.solver.types),
                 max_tasks=self.solver.K,
                 max_requesters=self.solver.R,
                 host_threshold_reqs=10**9,
+                max_jobs=self.max_jobs,
+                job_weights=self._job_weights,
             )
 
     def _prune_credits(self, snapshots: dict, now: float) -> None:
@@ -492,30 +531,55 @@ class PlanEngine:
             }
         return filtered
 
-    @staticmethod
-    def _cross_feasible(freqs: dict, snapshots: dict) -> bool:
+    def _cross_feasible(self, freqs: dict, snapshots: dict) -> bool:
         """True if some parked requester could be served from another
         server's inventory (the only matches the solve can contribute).
         Demand first (reqs are few), then scan tasks with an early exit —
         a round that can plan nothing must stay cheap even when queues
         are deep."""
-        demand: dict[int, set] = {}  # work type -> demander home ranks
-        any_dem: set = set()  # homes of any-type requesters
+        if self.max_jobs <= 1:
+            demand: dict[int, set] = {}  # work type -> demander homes
+            any_dem: set = set()  # homes of any-type requesters
+            for r, reqs in freqs.items():
+                for req in reqs:
+                    if req[2] is None:
+                        any_dem.add(r)
+                    else:
+                        for t in req[2]:
+                            demand.setdefault(t, set()).add(r)
+            if not demand and not any_dem:
+                return False
+            for rank, snap in snapshots.items():
+                for t in snap["tasks"]:
+                    dem = demand.get(t[1])
+                    if dem and (len(dem) > 1 or rank not in dem):
+                        return True
+                    if any_dem and (
+                        len(any_dem) > 1 or rank not in any_dem
+                    ):
+                        return True
+            return False
+        # Multi-job worlds: demand is keyed (job, type) — a requester
+        # only ever matches units of its own namespace, so an any-type
+        # req expands over its OWN job's base types, not everyone's.
+        # Overflow jobs (id >= max_jobs) plan via the qmstat fallback,
+        # never the solve: skip them on both sides.
+        J = self.max_jobs
+        jdemand: dict[tuple, set] = {}  # (job, type) -> demander homes
         for r, reqs in freqs.items():
             for req in reqs:
-                if req[2] is None:
-                    any_dem.add(r)
-                else:
-                    for t in req[2]:
-                        demand.setdefault(t, set()).add(r)
-        if not demand and not any_dem:
+                jb = req_job(req)
+                if not 0 <= jb < J:
+                    continue
+                types = self.base_types if req[2] is None else req[2]
+                for t in types:
+                    jdemand.setdefault((jb, t), set()).add(r)
+        if not jdemand:
             return False
         for rank, snap in snapshots.items():
             for t in snap["tasks"]:
-                dem = demand.get(t[1])
+                dem = jdemand.get((task_job(t), t[1]))
                 if dem and (len(dem) > 1 or rank not in dem):
-                    return True
-                if any_dem and (len(any_dem) > 1 or rank not in any_dem):
                     return True
         return False
 
@@ -688,9 +752,12 @@ class PlanEngine:
                     if matched_reqs and (rank, req[0], req[1]) in matched_reqs:
                         continue
                     types = req[2]
+                    rj = req_job(req)
                     for t in avail:
-                        if t[0] not in withheld and (
-                            types is None or t[1] in types
+                        if (
+                            t[0] not in withheld
+                            and task_job(t) == rj
+                            and (types is None or t[1] in types)
                         ):
                             withheld.add(t[0])
                             break

@@ -59,9 +59,11 @@ from typing import Optional
 
 import numpy as np
 
+from adlb_tpu.balancer.jobdim import bias_vector, expand_types
+
 # priority clip shared with the solvers (import kept lazy-free: solve.py
 # imports jax; the ledger must stay importable on accelerator-less hosts
-# without touching it)
+# without touching it — jobdim above is numpy-free pure Python)
 _NEG = -(2**31) + 1
 _PRIO_CLIP = 10**9
 
@@ -335,11 +337,18 @@ class ArrayLedger:
     LEDGER_RESYNC_INTERVAL = 256
 
     def __init__(self, engine, types, max_tasks: int,
-                 max_requesters: int) -> None:
+                 max_requesters: int, max_jobs: int = 1,
+                 job_weights: Optional[dict] = None) -> None:
         self.engine = engine
-        self.types = tuple(types)
+        self.base_types = tuple(types)
+        self.base_T = max(len(self.base_types), 1)
+        self.max_jobs = max(int(max_jobs), 1)
+        # composite (job, type) axis — the base types themselves when
+        # single-job (exact back-compat); see balancer/jobdim.py
+        self.types = expand_types(self.base_types, self.max_jobs)
         self.tix = {t: i for i, t in enumerate(self.types)}
         self.T = max(len(self.types), 1)
+        self.job_bias = bias_vector(job_weights, self.max_jobs)
         self.K = max_tasks
         self.R = max_requesters
         self._srv: dict[int, _Srv] = {}
@@ -382,9 +391,23 @@ class ArrayLedger:
         # moved member_ver). Steady state must show only cadence growth;
         # the engine mirrors these onto /metrics as ledger_resyncs.
         self.resync_reasons: dict = {"cadence": 0, "cold": 0,
-                                     "membership": 0}
+                                     "membership": 0, "weights": 0}
         self.last_sync_us = 0.0
+        # a pending forced full rebuild and its reason key (a weight
+        # change re-biases every resident priority column)
+        self._force_resync: Optional[str] = None
         self._alloc(16)
+
+    def set_job_bias(self, job_weights: Optional[dict]) -> bool:
+        """Install new per-job priority biases; a change forces a full
+        rebuild at the next sync (every packed prio column embeds the
+        bias). Returns True when the bias actually changed."""
+        bias = bias_vector(job_weights, self.max_jobs)
+        if bias == self.job_bias:
+            return False
+        self.job_bias = bias
+        self._force_resync = "weights"
+        return True
 
     # -- storage -----------------------------------------------------------
 
@@ -487,9 +510,13 @@ class ArrayLedger:
         self._round_token = id(snapshots)
         self._rounds += 1
         resync = self._rounds % self.LEDGER_RESYNC_INTERVAL == 0
-        if resync:
+        reason = "cadence" if resync else self._force_resync
+        if reason is not None:
+            resync = True
+            self._force_resync = None
             self.resync_count += 1
-            self.resync_reasons["cadence"] += 1
+            self.resync_reasons[reason] = \
+                self.resync_reasons.get(reason, 0) + 1
         ver = getattr(snapshots, "ver", None)
         if (
             ver is not None
@@ -623,17 +650,29 @@ class ArrayLedger:
         # distributed._pack_reqs (which silently drop unknown types;
         # here they flag r_unknown so cross_feasible can fall back
         # exactly). A change to req-type semantics must touch all
-        # three — the parity fuzz pins them together.
+        # three — the parity fuzz pins them together. Multi-job: the
+        # job column selects the composite (job, type) slots; any-type
+        # reqs become full job-BLOCK masks (never r_any, so the
+        # vectorized paths stay job-exact) and overflow namespaces get
+        # an empty mask — present but never matched (jobdim.py).
+        J = self.max_jobs
+        T0 = self.base_T
         for i, r in enumerate(reqs):
             fr, sq, types = r[0], r[1], r[2]
             r_rank[i] = fr
             r_seq[i] = sq
-            if types is None:
-                r_any[i] = True
-                r_mask[i, :] = True
+            jb = (r[4] if len(r) > 4 else 0) if J > 1 else 0
+            if J > 1 and not 0 <= jb < J:
+                pass  # overflow job: qmstat-RFR fallback territory
+            elif types is None:
+                if J <= 1:
+                    r_any[i] = True
+                    r_mask[i, :] = True
+                else:
+                    r_mask[i, jb * T0:(jb + 1) * T0] = True
             else:
                 for t in types:
-                    ti = tix.get(t)
+                    ti = tix.get(t if J <= 1 else (jb, t))
                     if ti is None:
                         unknown = True
                     else:
@@ -672,11 +711,19 @@ class ArrayLedger:
         t_planned = np.empty(n, np.float64)
         index: dict = {}
         dups = False
+        J = self.max_jobs
+        bias = self.job_bias
+        nb = len(bias)
         for i, t in enumerate(tasks):
             sq = t[0]
             t_seq[i] = sq
-            t_tix[i] = tix.get(t[1], -1)
-            t_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, t[2]))
+            jb = (t[4] if len(t) > 4 else 0) if J > 1 else 0
+            t_tix[i] = tix.get(t[1] if J <= 1 else (jb, t[1]), -1)
+            # weight bias folds into the clipped prio at pack time —
+            # identically in every packer twin (jobdim.weight_bias
+            # keeps the sum int32-safe and above the _NEG sentinel)
+            b = bias[jb] if 0 <= jb < nb else 0
+            t_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, t[2])) + b
             if sq in index:
                 dups = True
             index[sq] = i

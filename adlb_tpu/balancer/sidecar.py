@@ -117,6 +117,12 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
         inflow_min_age=cfg.balancer_inflow_min_age,
         host_ledger=cfg.host_ledger,
         auction=cfg.balancer_auction,
+        # job axis: the native plane advertises only the default
+        # namespace today (4-wide flat tasks), but the engine kwargs
+        # stay in lockstep with the in-server master so a multi-job
+        # config plans identically on either plane
+        max_jobs=cfg.balancer_max_jobs,
+        job_weights=cfg.job_weights,
     )
     # versioned snapshot table (balancer/ledger.py): the ledger's sync
     # touches only ranks whose snapshots changed since the last round.
@@ -192,14 +198,23 @@ def run_sidecar(world, cfg, ep, abort_event=None) -> int:
                     snap = snapshots.get(m.src)
                     if snap is not None:
                         if m.data.get("seqnos") is not None:
+                            # "jobs" (field 106) rides only when some
+                            # unit is non-default; absent -> all job 0
+                            jbs = m.data.get("jobs") or [0] * len(m.seqnos)
                             units = zip(m.seqnos, m.work_types, m.prios,
-                                        m.work_lens)
+                                        m.work_lens, jbs)
                         else:
-                            units = [(m.seqno, m.work_type, m.prio, m.work_len)]
-                        for sq, wt, pr, ln in units:
+                            units = [(m.seqno, m.work_type, m.prio,
+                                      m.work_len, 0)]
+                        for sq, wt, pr, ln, jb in units:
                             if len(snap["tasks"]) >= cfg.balancer_max_tasks:
                                 break
-                            snap["tasks"].append((sq, wt, pr, ln))
+                            if jb:
+                                if not 0 <= jb < cfg.balancer_max_jobs:
+                                    continue  # overflow namespace
+                                snap["tasks"].append((sq, wt, pr, ln, jb))
+                            else:
+                                snap["tasks"].append((sq, wt, pr, ln))
                         snap["nbytes"] = m.data.get("nbytes", snap["nbytes"])
                         # in-place append with no stamp bump: the delta
                         # sequence is the change signal the resident

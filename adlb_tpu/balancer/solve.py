@@ -26,6 +26,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from adlb_tpu.balancer.jobdim import bias_vector, expand_types
+
 # Sentinel far below any real priority (int32-safe; real priorities are
 # clipped to +/-1e9, reference priorities are C ints). A plain int, NOT a
 # jnp scalar: materializing a device array at import would initialize the
@@ -164,7 +166,8 @@ class AssignmentSolver:
     def __init__(
         self, types: Sequence[int], max_tasks: int, max_requesters: int,
         rounds: int = 6, host_threshold_reqs: Optional[int] = 64,
-        backend: str = "xla",
+        backend: str = "xla", max_jobs: int = 1,
+        job_weights: Optional[dict] = None,
     ) -> None:
         """backend: "xla" = the jitted lax.scan greedy; "pallas" = the
         VMEM-resident Pallas sweep kernel (adlb_tpu.balancer.pallas_solve),
@@ -178,7 +181,13 @@ class AssignmentSolver:
         error-recovery loop)."""
         if backend not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown solver backend {backend!r}")
-        self.types = tuple(types)
+        self.base_types = tuple(types)
+        self.base_T = max(len(self.base_types), 1)
+        self.max_jobs = max(int(max_jobs), 1)
+        # composite (job, type) axis under multi-job planning — the
+        # base types verbatim when single-job (balancer/jobdim.py)
+        self.types = expand_types(self.base_types, self.max_jobs)
+        self.job_bias = bias_vector(job_weights, self.max_jobs)
         self.type_index = {t: i for i, t in enumerate(self.types)}
         self.K = max_tasks
         self.R = max_requesters
@@ -188,6 +197,16 @@ class AssignmentSolver:
         self._device_fn = None  # lazily resolved (pallas import is deferred)
         self.solve_count = 0
         self.host_solve_count = 0
+
+    def set_job_bias(self, job_weights: Optional[dict]) -> bool:
+        """Install new fair-share biases for the dict-path packers (the
+        view path inherits the ledger's — the engine keeps both in
+        step). Returns True when the bias changed."""
+        bias = bias_vector(job_weights, self.max_jobs)
+        if bias == self.job_bias:
+            return False
+        self.job_bias = bias
+        return True
 
     def _device_assign(self):
         if self._device_fn is None:
@@ -218,19 +237,29 @@ class AssignmentSolver:
         req_mask = np.zeros((S * R, T), dtype=bool)
         req_valid = np.zeros((S * R,), dtype=bool)
         req_ref: list = [None] * (S * R)
+        J, T0 = self.max_jobs, self.base_T
         for si, s in enumerate(servers):
             # req tuples are (rank, rqseqno, types) — a 4th element
             # (fused-reserve flag, consumed by the plan-match sender)
-            # may ride along since the remote-fused-fetch change
+            # may ride along since the remote-fused-fetch change, and a
+            # 5th (job) since multi-job planning. Job handling is the
+            # exact twin of ledger._rebuild_reqs: any-type becomes a
+            # job-block mask, overflow jobs pack an empty mask.
             for ri, req in enumerate(snapshots[s]["reqs"][:R]):
                 rank, rqseqno, req_types = req[0], req[1], req[2]
+                jb = (req[4] if len(req) > 4 else 0) if J > 1 else 0
                 i = si * R + ri
                 req_valid[i] = True
-                if req_types is None:
-                    req_mask[i, :] = True
+                if J > 1 and not 0 <= jb < J:
+                    pass  # overflow job: planner-invisible
+                elif req_types is None:
+                    if J <= 1:
+                        req_mask[i, :] = True
+                    else:
+                        req_mask[i, jb * T0:(jb + 1) * T0] = True
                 else:
                     for t in req_types:
-                        ti = self.type_index.get(t)
+                        ti = self.type_index.get(t if J <= 1 else (jb, t))
                         if ti is not None:
                             req_mask[i, ti] = True
                 req_ref[i] = (s, rank, rqseqno)
@@ -250,12 +279,18 @@ class AssignmentSolver:
             prios: list = []
             ttypes: list = []
             task_ref = []
+            bias, nb = self.job_bias, len(self.job_bias)
             for si, s in enumerate(servers):
-                for seqno, wtype, prio, _len in snapshots[s]["tasks"][:K]:
-                    ti = self.type_index.get(wtype, -1)
+                for tk in snapshots[s]["tasks"][:K]:
+                    seqno, wtype, prio = tk[0], tk[1], tk[2]
+                    jb = (tk[4] if len(tk) > 4 else 0) if J > 1 else 0
+                    ti = self.type_index.get(
+                        wtype if J <= 1 else (jb, wtype), -1)
                     if ti < 0 or not wanted[ti]:
                         continue
-                    prios.append(max(-_PRIO_CLIP, min(_PRIO_CLIP, prio)))
+                    b = bias[jb] if 0 <= jb < nb else 0
+                    prios.append(
+                        max(-_PRIO_CLIP, min(_PRIO_CLIP, prio)) + b)
                     ttypes.append(ti)
                     task_ref.append((s, seqno))
             if not task_ref:
@@ -268,13 +303,17 @@ class AssignmentSolver:
             task_prio = np.full((S * K,), int(_NEG), dtype=np.int32)
             task_type = np.full((S * K,), -1, dtype=np.int32)
             task_ref = [None] * (S * K)
+            bias, nb = self.job_bias, len(self.job_bias)
             for si, s in enumerate(servers):
-                for ki, (seqno, wtype, prio, _len) in enumerate(
-                    snapshots[s]["tasks"][:K]
-                ):
+                for ki, tk in enumerate(snapshots[s]["tasks"][:K]):
+                    seqno, wtype, prio = tk[0], tk[1], tk[2]
+                    jb = (tk[4] if len(tk) > 4 else 0) if J > 1 else 0
                     i = si * K + ki
-                    task_prio[i] = max(-_PRIO_CLIP, min(_PRIO_CLIP, prio))
-                    task_type[i] = self.type_index.get(wtype, -1)
+                    b = bias[jb] if 0 <= jb < nb else 0
+                    task_prio[i] = \
+                        max(-_PRIO_CLIP, min(_PRIO_CLIP, prio)) + b
+                    task_type[i] = self.type_index.get(
+                        wtype if J <= 1 else (jb, wtype), -1)
                     task_ref[i] = (s, seqno)
             if (task_type < 0).all():
                 return []

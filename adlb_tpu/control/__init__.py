@@ -1,0 +1,9 @@
+"""The fleet brain (PR 19): closed-loop control over the actuators.
+
+See :mod:`adlb_tpu.control.controller` for the decision engine the
+master's obs tick drives when ``Config(control=True)``.
+"""
+
+from adlb_tpu.control.controller import Controller, parse_policy
+
+__all__ = ["Controller", "parse_policy"]

@@ -298,6 +298,9 @@ class OpsServer:
                     elif path == "/flight":
                         body = json.dumps(ops._flight_index()).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/control":
+                        body = json.dumps(ops._control()).encode()
+                        self._send(200, body, "application/json")
                     elif path == "/jobs":
                         body = json.dumps(ops._jobs()).encode()
                         self._send(200, body, "application/json")
@@ -336,6 +339,9 @@ class OpsServer:
                         self._send(200, body, "application/json")
                     elif parts == ["slo"]:
                         body = json.dumps(ops._slo_post(raw)).encode()
+                        self._send(200, body, "application/json")
+                    elif parts == ["control"]:
+                        body = json.dumps(ops._control_post(raw)).encode()
                         self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
@@ -689,6 +695,45 @@ class OpsServer:
         parse_objective(body)  # 400 gate only; reactor re-normalizes
         return self.server.ctl_request({"op": "slo", "objective": body})
 
+    # -- /control: the closed-loop controller --------------------------------
+
+    def _control(self) -> dict:
+        """The fleet controller's published state (adlb_tpu/control):
+        live policy, hold/cooldown status, and the decision history —
+        every decision as inputs -> rule -> action -> outcome. All
+        publish-by-swap reads (the controller runs on the reactor's obs
+        tick; this is the HTTP thread), mirroring /alerts."""
+        from adlb_tpu.obs.metrics import safe_copy
+
+        s = self.server
+        ctl = getattr(s, "_controller", None)
+        if ctl is None:
+            return {"rank": s.rank, "enabled": False, "policy": {},
+                    "decisions": [], "actions": 0}
+        return {
+            "rank": s.rank,
+            "enabled": True,
+            "dry_run": ctl.dry_run,
+            "policy": ctl.policy_doc(),
+            "status": ctl.status_pub,
+            "actions": ctl.actions_total,
+            "decisions": safe_copy(ctl.history),
+        }
+
+    def _control_post(self, raw: bytes) -> dict:
+        """POST /control — live policy tweaks (cooldown, pressure
+        thresholds, server bounds, dry_run). Validated and applied on
+        the reactor, where the controller lives."""
+        from adlb_tpu.control.controller import parse_policy
+
+        if getattr(self.server, "_controller", None) is None:
+            raise ValueError(
+                "controller not configured (Config(control=True))"
+            )
+        body = json.loads(raw.decode() or "{}")
+        parse_policy(body)  # 400 gate only; reactor merges onto the live base
+        return self.server.ctl_request({"op": "control", "policy": body})
+
     # -- /jobs control plane -------------------------------------------------
 
     def _jobs(self) -> dict:
@@ -719,14 +764,17 @@ class OpsServer:
 
         now = time.monotonic()
         part = s.wq.part(jid)
+        job = s.jobs.get(jid)
         depth = part.count if part is not None else 0
         nbytes = part.total_bytes if part is not None else 0
         age = max(
             (now - u.time_stamp for u in part.units()), default=0.0
         ) if part is not None else 0.0
+        backoffs = job.backoffs if job is not None else 0
         per_rank = {
             str(s.rank): {
-                "depth": depth, "bytes": nbytes, "age_s": round(age, 3)
+                "depth": depth, "bytes": nbytes, "age_s": round(age, 3),
+                "backoffs": backoffs,
             }
         }
         jl = f"job={jid}"
@@ -741,12 +789,15 @@ class OpsServer:
             d = cell("job_wq_depth")
             b = cell("job_wq_bytes")
             a = cell("job_oldest_age_s")
+            bk = cell("job_backoffs")
             per_rank[str(r)] = {
-                "depth": int(d), "bytes": int(b), "age_s": round(a, 3)
+                "depth": int(d), "bytes": int(b), "age_s": round(a, 3),
+                "backoffs": int(bk),
             }
             depth += int(d)
             nbytes += int(b)
             age = max(age, a)
+            backoffs += int(bk)
         # stage latencies: Registry.merge sums the unit_stage_s cells
         # across ranks (per full label set); what remains here is only
         # restricting to this job's label and folding the TYPE label
@@ -780,10 +831,22 @@ class OpsServer:
                 ]
                 agg["sum"] += h["sum"]
                 agg["count"] += h["count"]
+        quota = job.quota_bytes if job is not None else 0
         return {
             "queue_depth": depth,
             "queued_bytes": nbytes,
             "oldest_age_s": round(age, 3),
+            # quota state (PR 19): the cap is PER SERVER, so pressure is
+            # the WORST rank's used/quota — the signal the controller's
+            # throttle rules and an operator's eyeball both want
+            "quota_bytes": quota,
+            "quota_used_frac": round(
+                max(
+                    (e["bytes"] / quota for e in per_rank.values()),
+                    default=0.0,
+                ), 4,
+            ) if quota > 0 else 0.0,
+            "backoffs_fleet": backoffs,
             "per_rank": per_rank,
             "stage_latency_s": {
                 stage: {
@@ -816,8 +879,10 @@ class OpsServer:
         raise ValueError('scale needs {"dir": "out"|"in"}')
 
     def _jobs_post(self, parts: list, raw: bytes) -> dict:
-        """POST /jobs (submit) and POST /jobs/<id>/{drain,kill}: build a
-        control request and hand it to the reactor thread."""
+        """POST /jobs (submit), POST /jobs/<id> (live update: fair-share
+        ``weight``, ``quota_bytes`` with -1 = unlimited), and
+        POST /jobs/<id>/{drain,kill}: build a control request and hand
+        it to the reactor thread."""
         s = self.server
         if not parts:  # POST /jobs — submit
             body = json.loads(raw.decode() or "{}")
@@ -827,6 +892,13 @@ class OpsServer:
                 "quota_bytes": int(body.get("quota_bytes", 0) or 0),
             })
         jid, action = int(parts[0]), (parts[1] if len(parts) > 1 else "")
+        if not action:  # POST /jobs/<id> — policy update
+            body = json.loads(raw.decode() or "{}")
+            req = {"op": "update", "job_id": jid,
+                   "quota_bytes": int(body.get("quota_bytes", 0) or 0)}
+            if body.get("weight") is not None:
+                req["weight"] = float(body["weight"])
+            return s.ctl_request(req)
         if action not in ("drain", "kill"):
             raise ValueError(f"unknown job action {action!r}")
         return s.ctl_request({"op": action, "job_id": jid})

@@ -364,6 +364,12 @@ FIELDS: dict[str, tuple[int, int]] = {
     "member_tok": (103, _KIND_I64),
     "home": (104, _KIND_I64),
     "kind": (105, _KIND_BYTES),
+    # multi-job planning (SS_STATE_DELTA): per-unit job ids for a
+    # batched task delta whose units are not all in the default
+    # namespace. Omitted when every unit is job 0, so single-job worlds
+    # stay byte-identical; native daemons parse-and-ignore it (the
+    # native plane advertises only the default namespace today).
+    "jobs": (106, _KIND_LIST),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

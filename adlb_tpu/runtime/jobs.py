@@ -61,6 +61,13 @@ class Job:
     # ADLB_BACKOFF with a retry-after hint, exactly the overload
     # backpressure discipline, scoped to the tenant
     quota_bytes: int = 0
+    # fair-share weight (1.0 = neutral): folded into the balancer's
+    # assignment score as a priority bias (balancer/jobdim.py) so a
+    # heavy tenant cannot starve a light one. Fans out on SS_JOB_CTL;
+    # deliberately NOT WAL-persisted (OP_JOB's fixed header predates
+    # it) — a restarted fleet comes back neutral and the controller /
+    # Config(job_weights) re-arms it.
+    weight: float = 1.0
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     done_at: Optional[float] = None
     # per-job activity (puts admitted + reservations matched), the
@@ -91,6 +98,7 @@ class Job:
             "name": self.name,
             "state": self.state,
             "quota_bytes": self.quota_bytes,
+            "weight": self.weight,
             "submitted_at": self.submitted_at,
             "done_at": self.done_at,
             "puts": self.puts,
@@ -120,13 +128,27 @@ class JobTable:
         return job
 
     def apply(self, op: str, job_id: int, name: str = "",
-              quota_bytes: int = 0) -> Job:
+              quota_bytes: int = 0,
+              weight: Optional[float] = None) -> Job:
         """One SS_JOB_CTL/OP_JOB state transition; idempotent."""
         job = self.ensure(job_id, name=name, quota_bytes=quota_bytes)
+        if weight is not None:
+            job.weight = float(weight)
         if op == "submit":
             # re-announce of a live job refreshes quota/name only
             job.name = name or job.name
             if quota_bytes:
+                job.quota_bytes = quota_bytes
+        elif op == "update":
+            # live policy tweak (POST /jobs/<id> or the controller):
+            # weight handled above; quota 0 means "leave unchanged"
+            # here (use kill/drain to end a tenant, not quota 0) —
+            # the controller clears a throttle by restoring the
+            # remembered pre-throttle quota, which is never 0 unless
+            # it was unlimited, in which case -1 encodes "unlimited"
+            if quota_bytes == -1:
+                job.quota_bytes = 0
+            elif quota_bytes:
                 job.quota_bytes = quota_bytes
         elif op == "drain":
             if not job.closed:
@@ -164,6 +186,14 @@ class JobTable:
         post-restart submit would reuse (and inherit the state of) a
         prior tenant's namespace."""
         return max(self._jobs, default=0)
+
+    def weights(self) -> dict[int, float]:
+        """Non-neutral fair-share weights, {job_id: weight} — the
+        balancer's bias input (balancer/jobdim.bias_vector)."""
+        return {
+            j.job_id: j.weight for j in self._jobs.values()
+            if j.weight != 1.0
+        }
 
     def any_jobs(self) -> bool:
         """True once any non-default namespace exists — the switch that

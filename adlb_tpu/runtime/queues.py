@@ -335,8 +335,12 @@ class PartitionedWorkQueue:
         """Non-default jobs with a (possibly empty) partition."""
         return [j for j in self._parts if j != 0]
 
-    def has_job_units(self) -> bool:
-        return any(p.count for j, p in self._parts.items() if j != 0)
+    def has_job_units(self, min_job: int = 1) -> bool:
+        """Any units queued in namespaces >= ``min_job``? The default 1
+        asks about ALL non-default jobs; the tpu balancer passes its
+        ``balancer_max_jobs`` so only OVERFLOW namespaces (beyond the
+        planner's horizon, served by the qmstat/RFR fallback) count."""
+        return any(p.count for j, p in self._parts.items() if j >= min_job)
 
     def drop_job(self, job: int) -> list[WorkUnit]:
         """Remove a killed job's whole partition; returns its units so

@@ -118,8 +118,11 @@ class _BalancerWorker(threading.Thread):
             host_ledger=s.cfg.host_ledger,
             auction=s.cfg.balancer_auction,
             metrics=s.metrics,
+            max_jobs=s.cfg.balancer_max_jobs,
+            job_weights=s.cfg.job_weights,
         )
         s._solver = engine.solver
+        s._engine = engine  # weights fan-out (set_job_weights) target
         from adlb_tpu.obs import profile as _profile
 
         _profile.register_thread("balancer")
@@ -169,6 +172,13 @@ class _BalancerWorker(threading.Thread):
 
     def _one_round(self, engine) -> tuple:
         s = self.server
+        # live fair-share weight change (POST /jobs/<id> or controller):
+        # applied here, not on the reactor — solver caches are this
+        # thread's to flush. dict.pop is atomic, so a concurrent set
+        # either lands now or wakes the next round.
+        pw = s.__dict__.pop("_pending_job_weights", None)
+        if pw is not None:
+            engine.set_job_weights(pw)
         snaps = s._snapshots.fork()  # one copy: the round AND the fetch
         # lookup below must see the same view, or a reactor-thread
         # snapshot swap mid-round could silently drop a match's flag.
@@ -750,6 +760,29 @@ class Server:
                 eng.add(doc)
             self._slo_engine = eng
 
+        # ---- fleet controller (control/controller.py) ----
+        # master-only closed loop over the existing actuators (scale
+        # plane + job quotas), riding the obs tick like the SLO engine.
+        # Unconfigured worlds carry only this None — no thread, no
+        # counters, no per-tick work.
+        self._controller = None
+        self._next_control = 0.0  # cadence gate (control_interval)
+        if self._obs_sync_armed and self.is_master and cfg.control:
+            from adlb_tpu.control import Controller
+
+            self._controller = Controller(
+                {
+                    "dry_run": cfg.control_dry_run,
+                    "min_servers": cfg.control_min_servers,
+                    "max_servers": cfg.control_max_servers,
+                    "cooldown_s": cfg.control_cooldown_s,
+                    "scaleout_pressure": cfg.control_scaleout_pressure,
+                    "scalein_pressure": cfg.control_scalein_pressure,
+                },
+                eval_interval=(cfg.control_interval
+                               or cfg.obs_sync_interval),
+            )
+
         # timers
         now = time.monotonic()
         self._next_state_sync = now
@@ -1266,6 +1299,14 @@ class Server:
                 m.gauge("job_wq_bytes", job=jl).set(0)
                 m.gauge("job_oldest_age_s", job=jl).set(0.0)
             self._job_gauged = gauged
+            # quota-backoff totals ride the same gossip so /jobs/<id>
+            # (and the controller) sees the FLEET's admission pressure,
+            # not just the master's shard; cumulative, so no zeroing
+            for job in self.jobs.values():
+                if job.job_id and job.backoffs:
+                    m.gauge(
+                        "job_backoffs", job=str(job.job_id)
+                    ).set(job.backoffs)
         if self._obs_sync_armed and now >= self._next_obs_sync:
             self._next_obs_sync = now + self.cfg.obs_sync_interval
             if self.is_master:
@@ -1284,6 +1325,8 @@ class Server:
                     self.journeys.tail_thr = thr
                 if self._slo_engine is not None:
                     self._slo_evaluate(now)
+                if self._controller is not None:
+                    self._control_evaluate(now)
             else:
                 self._obs_sync_send()
         if now >= self._next_state_sync:
@@ -1311,11 +1354,23 @@ class Server:
                 ):
                     self._next_idle_snap = now + 0.25
                     self._send_snapshot()
-                if self.wq.has_job_units():
-                    # non-default namespaces stay out of balancer
-                    # snapshots; their cross-server path is the RFR
-                    # pull, driven by the same per-job qmstat gossip
-                    # the steal mode uses
+                if (
+                    self.wq.has_job_units(
+                        min_job=max(self.cfg.balancer_max_jobs, 1)
+                    )
+                    and now - self._last_qmstat_event
+                    >= self.cfg.qmstat_event_gap
+                ):
+                    # DOCUMENTED FALLBACK: namespaces the planner does
+                    # not cover — ALL non-default jobs when
+                    # balancer_max_jobs is 1 (the pre-PR 19 world), else
+                    # only OVERFLOW jobs (id >= balancer_max_jobs) —
+                    # reach across servers via the RFR pull, driven by
+                    # the same per-job qmstat gossip steal mode uses.
+                    # Rate-limited by the steal-mode event limiter: this
+                    # used to fire every balancer-cadence tick, an S-1
+                    # fan-out each time.
+                    self._last_qmstat_event = now
                     self._broadcast_qmstat()
             else:
                 self._broadcast_qmstat()
@@ -1949,6 +2004,126 @@ class Server:
             f"incident_captured {transition['name']} "
             f"suspects={doc['suspect_ranks']} artifact={path}"
         )
+
+    def _control_evaluate(self, now: float) -> None:
+        """One controller tick (master reactor, inside the obs-sync
+        tick, right after the SLO evaluation whose ``firing`` count it
+        consumes): assemble the sensor frame, run the decision rules,
+        enact what came back ``act`` (rewriting the outcome to
+        ``enacted``/``error`` in place — the controller's history holds
+        the same dicts, so GET /control shows what actually happened),
+        flight-record every new decision, and swap the published status
+        doc the ops thread serves."""
+        if now < self._next_control:
+            return
+        ctl = self._controller
+        self._next_control = now + ctl.eval_interval
+        inputs = self._control_inputs(now)
+        for d in ctl.evaluate(now, inputs):
+            if d["outcome"] == "act":
+                self._control_enact(d)
+            a = d["action"]
+            self.flight.record(
+                f"control {d['rule']} kind={a['kind']} "
+                f"outcome={d['outcome']}"
+            )
+        ctl.publish(now, inputs)
+
+    def _control_enact(self, d: dict) -> None:
+        """Drive the actuator an ``act`` decision names. An actuator
+        error never takes the reactor down — it lands in the decision
+        record (outcome ``error``) and the rule retries after its
+        cooldown window."""
+        a = d["action"]
+        kind = a["kind"]
+        try:
+            if kind == "scale_out":
+                # spawnerless worlds park the request (satellite: the
+                # registration drain services it) — still an action
+                res = self._request_scale_out(
+                    f"controller:{d['rule']}",
+                    hot_rank=a.get("hot_rank"),
+                )
+                d["result"] = res
+                if res.get("error"):
+                    raise RuntimeError(res["error"])
+            elif kind == "scale_in":
+                d["result"] = self._handle_ctl({"op": "scale_in"})
+            elif kind in ("throttle", "unthrottle"):
+                # quota -1 restores unlimited (jobs.apply's update
+                # encoding); the fanout reaches every server's admission
+                # gate, not just the master's shard
+                self._job_ctl_fanout(
+                    "update", int(a["job"]), quota=int(a["quota_bytes"])
+                )
+            else:
+                raise ValueError(f"unknown action kind {kind!r}")
+        except Exception as e:  # noqa: BLE001 — record, don't crash
+            d["outcome"] = "error"
+            d["error"] = repr(e)
+            return
+        d["outcome"] = "enacted"
+        self._controller.actions_total += 1
+        self.metrics.counter("control_actions", kind=kind).inc()
+
+    def _control_inputs(self, now: float) -> dict:
+        """The controller's sensor frame, assembled from state the
+        master reactor already holds: live membership, per-rank memory
+        pressure (own meter + peer-advertised nbytes over cap), per-job
+        fleet totals (own partitions + the gossiped ``job_*`` gauges),
+        the SLO engine's firing count, quota backoffs, oldest lease."""
+        cap = float(self.cfg.max_malloc_per_server)
+        live = [
+            s for s in self.world.server_ranks
+            if s not in self._dead_servers
+            and s not in self._draining_servers
+            and self._is_live_member(s)
+        ]
+        pressure: dict = {}
+        if cap > 0:
+            pressure[self.rank] = self.mem.curr / cap
+            for s in live:
+                if s == self.rank:
+                    continue
+                p = self.peers.get(s)
+                if p is not None:
+                    pressure[s] = p.nbytes / cap
+        jobs: dict = {}
+        snaps = list(self._fleet_snaps.values())
+        for job in self.jobs.values():
+            jid = job.job_id
+            if jid == 0:
+                continue
+            part = self.wq.part(jid)
+            depth = part.count if part is not None else 0
+            nbytes = part.total_bytes if part is not None else 0
+            age = max(
+                (now - u.time_stamp for u in part.units()), default=0.0
+            ) if part is not None else 0.0
+            backoffs = job.backoffs
+            jl = f"job={jid}"
+            for snap in snaps:
+                g = snap.get("gauges") or {}
+                depth += int(g.get(f"job_wq_depth{{{jl}}}", 0) or 0)
+                nbytes += int(g.get(f"job_wq_bytes{{{jl}}}", 0) or 0)
+                age = max(age, float(
+                    g.get(f"job_oldest_age_s{{{jl}}}", 0.0) or 0.0))
+                backoffs += int(g.get(f"job_backoffs{{{jl}}}", 0) or 0)
+            jobs[jid] = {
+                "depth": depth, "bytes": nbytes,
+                "oldest_age_s": round(age, 3), "backoffs": backoffs,
+                "quota_bytes": job.quota_bytes, "state": job.state,
+            }
+        return {
+            "live_servers": len(live),
+            "pressure": pressure,
+            "firing": (self._slo_engine.firing
+                       if self._slo_engine is not None else 0),
+            "jobs": jobs,
+            "backoffs": sum(j["backoffs"] for j in jobs.values()),
+            "oldest_lease_s": self.leases.oldest_age(now),
+            "epoch": self.world.epoch,
+        }
 
     def _satisfy_parked(self, entry: RqEntry, unit: WorkUnit,
                         holder: Optional[int] = None,
@@ -2881,10 +3056,14 @@ class Server:
             server, wtype = hit
             self._send_rfr(entry, server, targeted_lookup=True, lookup_type=wtype)
             return
-        if self.cfg.balancer == "tpu" and entry.job == 0:
-            return  # untargeted stealing is the planner's job
-            # (non-default jobs stay OUT of balancer snapshots, so their
-            # cross-server matching is the RFR pull below in both modes)
+        if self.cfg.balancer == "tpu" and \
+                0 <= entry.job < self.cfg.balancer_max_jobs:
+            return  # untargeted matching is the planner's job — and an
+            # outstanding RFR would HIDE this requester from balancer
+            # snapshots (the _rfr_out filter), starving the planned
+            # path. Only OVERFLOW namespaces (id >= balancer_max_jobs)
+            # fall through to the qmstat/RFR pull; in steal mode every
+            # job rides it.
         # 2) best advertised priority among peers for the requested types
         best_server, best_prio = -1, ADLB_LOWEST_PRIO
         for s, st in self.peers.items():
@@ -3552,6 +3731,8 @@ class Server:
                     ),
                 )
                 tasks = [(s, t, -np_, ln) for np_, s, t, ln in tasks]
+            tasks = self._merge_job_tasks(tasks, K)
+        J = self.cfg.balancer_max_jobs
         reqs = [
             (
                 e.world_rank,
@@ -3559,11 +3740,20 @@ class Server:
                 None if e.req_types is None else sorted(e.req_types),
                 # 4th element: fused reserve? drives remote fused fetch
                 # on the plan path (3-tuples from native planes read as
-                # False — handle delivery, as before)
+                # False — handle delivery, as before). 5th (only when
+                # non-zero): the requester's job namespace — the planner
+                # only matches within a job, and single-job worlds stay
+                # byte-identical on the wire without it.
                 bool(e.fetch),
+            ) if e.job == 0 else (
+                e.world_rank,
+                e.rqseqno,
+                None if e.req_types is None else sorted(e.req_types),
+                bool(e.fetch),
+                e.job,
             )
             for e in self.rq.entries()
-            if e.world_rank not in self._rfr_out and e.job == 0
+            if e.world_rank not in self._rfr_out and 0 <= e.job < J
         ][: self.cfg.balancer_max_requesters]
         snap = {
             "tasks": tasks,
@@ -3605,6 +3795,49 @@ class Server:
                 if not self._failover:
                     raise
                 self._note_server_unreachable(self.world.master_server_rank)
+
+    def _merge_job_tasks(self, tasks: list, K: int) -> list:
+        """Fold non-default namespaces' untargeted inventory into the
+        balancer snapshot as 5-tuples carrying the job id (PR 19
+        multi-job planning). Job 0 keeps the C++ top-K fast path; the
+        other partitions only exist in service mode and are walked in
+        Python. The merged list is re-capped at K by EFFECTIVE priority
+        (clipped prio + fair-share bias, the planner's own ordering,
+        jobdim.weight_bias) so one tenant's flood cannot silently push
+        another below the planner's horizon. Identity — and no 5th
+        element anywhere — in single-job worlds."""
+        J = self.cfg.balancer_max_jobs
+        if J <= 1 or not self.wq.has_job_units():
+            return tasks
+        from adlb_tpu.balancer.jobdim import weight_bias
+
+        extra = []
+        for jid in self.wq.job_ids():
+            if jid == 0 or not 0 <= jid < J:
+                continue  # overflow namespaces keep the qmstat/RFR path
+            part = self.wq.part(jid)
+            if part is None:
+                continue
+            for u in part.units():
+                if not u.pinned and u.target_rank < 0:
+                    extra.append(
+                        (u.seqno, u.work_type, u.prio, u.payload_len, jid)
+                    )
+        if not extra:
+            return tasks
+        merged = list(tasks) + extra
+        if len(merged) > K:
+            bias = {
+                j: weight_bias(w) for j, w in self.jobs.weights().items()
+            }
+
+            def eff(t):
+                b = bias.get(t[4], 0) if len(t) > 4 else bias.get(0, 0)
+                return max(-(10 ** 9), min(10 ** 9, t[2])) + b
+
+            merged.sort(key=eff, reverse=True)  # stable: ties keep order
+            del merged[K:]
+        return merged
 
     def _accept_snapshot(self, src: int, snap: dict) -> None:
         """Master-side snapshot intake, shared by the local and remote
@@ -3660,11 +3893,11 @@ class Server:
         if self.is_master:
             self._merge_task_delta(
                 self.rank, [unit.seqno], [unit.work_type], [unit.prio],
-                [nlen], self.mem.curr,
+                [nlen], self.mem.curr, jobs=[unit.job],
             )
             return
         self._pending_delta.append(
-            (unit.seqno, unit.work_type, unit.prio, nlen)
+            (unit.seqno, unit.work_type, unit.prio, nlen, unit.job)
         )
         now = time.monotonic()
         if now - self._last_event_snap >= self.cfg.balancer_min_gap:
@@ -3682,9 +3915,14 @@ class Server:
         self._delta_deadline = float("inf")
         if not self._pending_delta:
             return
-        seqnos, wtypes, prios, lens = zip(*self._pending_delta)
+        seqnos, wtypes, prios, lens, jobs = zip(*self._pending_delta)
         self._pending_delta.clear()
         self._last_event_snap = now
+        extra = {}
+        if any(jobs):
+            # per-unit namespaces ride only when some unit is non-default
+            # — single-job deltas stay byte-identical on the wire
+            extra["jobs"] = list(jobs)
         try:
             self.ep.send(
                 self.world.master_server_rank,
@@ -3696,6 +3934,7 @@ class Server:
                     prios=list(prios),
                     work_lens=list(lens),
                     nbytes=self.mem.curr,
+                    **extra,
                 ),
             )
         except OSError:
@@ -3705,15 +3944,28 @@ class Server:
 
     def _merge_task_delta(
         self, src: int, seqnos, work_types, prios, work_lens, nbytes: int,
+        jobs=None,
     ) -> None:
         snap = self._snapshots.get(src)
         if snap is None:
             return  # no baseline yet; the next full snapshot delivers it
+        J = self.cfg.balancer_max_jobs
         room = self.cfg.balancer_max_tasks - len(snap["tasks"])
         for i in range(min(room, len(seqnos))):
-            snap["tasks"].append(
-                (seqnos[i], work_types[i], prios[i], work_lens[i])
-            )
+            j = int(jobs[i]) if jobs is not None else 0
+            if j:
+                # same 5th-element rule as full snapshots: job carried
+                # only when non-default; overflow namespaces (beyond the
+                # planner's job axis) stay off the ledger entirely
+                if not 0 <= j < J:
+                    continue
+                snap["tasks"].append(
+                    (seqnos[i], work_types[i], prios[i], work_lens[i], j)
+                )
+            else:
+                snap["tasks"].append(
+                    (seqnos[i], work_types[i], prios[i], work_lens[i])
+                )
         snap["nbytes"] = nbytes
         # NOTE: snap["stamp"] is NOT bumped — requester (re-)eligibility in
         # the plan ledger must only come from full snapshots that re-observe
@@ -3730,7 +3982,7 @@ class Server:
         if m.data.get("seqnos") is not None:  # batched (round 4+)
             self._merge_task_delta(
                 m.src, m.seqnos, m.work_types, m.prios, m.work_lens,
-                m.nbytes,
+                m.nbytes, jobs=m.data.get("jobs"),
             )
         else:  # single-unit shape (native daemons predating the batch)
             self._merge_task_delta(
@@ -3862,6 +4114,10 @@ class Server:
                 "time_stamp": unit.time_stamp,
                 "attempts": unit.attempts,
             }
+            if getattr(unit, "job", 0):
+                # namespace rides the move (omitted = job 0, so
+                # single-job batches stay byte-identical on the wire)
+                shipped["job"] = unit.job
             tf = trace_fields(unit)
             if tf is not None:  # untraced batches stay byte-identical
                 shipped["trace"] = tf
@@ -3938,6 +4194,7 @@ class Server:
                 common_seqno=u["common_seqno"],
                 time_stamp=u["time_stamp"],
                 attempts=int(u.get("attempts", 0) or 0),
+                job=int(u.get("job", 0) or 0),
             )
             self._next_seqno += 1
             tf = u.get("trace")
@@ -5195,6 +5452,23 @@ class Server:
                 raise KeyError(f"unknown job {jid}")
             self._job_ctl_fanout(op, jid)
             return {"job_id": jid, "state": self.jobs.get(jid).state}
+        if op == "update":
+            # POST /jobs/<id>: live policy tweak — fair-share weight
+            # and/or quota (0 = leave unchanged, -1 = unlimited)
+            jid = int(req["job_id"])
+            if self.jobs.get(jid) is None:
+                raise KeyError(f"unknown job {jid}")
+            weight = req.get("weight")
+            if weight is not None:
+                weight = float(weight)
+                if not weight > 0.0:
+                    raise ValueError("weight must be > 0")
+            self._job_ctl_fanout(
+                "update", jid,
+                quota=int(req.get("quota_bytes", 0) or 0),
+                weight=weight,
+            )
+            return self.jobs.get(jid).summary()
         if op == "fleet":
             return self.fleet_doc()
         if op == "scale_out":
@@ -5279,6 +5553,23 @@ class Server:
             self.flight.record(f"slo_objective_added {o['name']}")
             return {"objective": o,
                     "n_objectives": len(self._slo_engine.objectives)}
+        if op == "control":
+            # POST /control: live policy tweak on the fleet controller
+            # (thresholds, bounds, cooldown, dry_run) — no restart
+            if not self.is_master:
+                raise RuntimeError("the controller lives on the master")
+            if self._controller is None:
+                raise RuntimeError(
+                    "controller not configured (Config(control=True))"
+                )
+            pol = self._controller.update_policy(
+                req.get("policy") or {}
+            )
+            self.flight.record(
+                "control_policy_updated "
+                + " ".join(f"{k}={v}" for k, v in sorted(pol.items()))
+            )
+            return {"policy": pol}
         raise ValueError(f"unknown control op {op!r}")
 
     def _alloc_job_id(self) -> int:
@@ -5292,7 +5583,8 @@ class Server:
         return jid
 
     def _job_ctl_fanout(self, op: str, jid: int, name: str = "",
-                        quota: int = 0) -> None:
+                        quota: int = 0,
+                        weight: Optional[float] = None) -> None:
         """Master: apply a job lifecycle change and broadcast it."""
         for srv in self._live_servers():
             if srv == self.rank:
@@ -5301,25 +5593,42 @@ class Server:
                 self.ep.send(
                     srv,
                     msg(Tag.SS_JOB_CTL, self.rank, op=op, job_id=jid,
-                        job_name=name, quota=quota),
+                        job_name=name, quota=quota, weight=weight),
                 )
             except OSError:
                 if not self._failover:
                     raise
                 self._note_server_unreachable(srv)
-        self._apply_job_ctl(op, jid, name, quota)
+        self._apply_job_ctl(op, jid, name, quota, weight)
 
     def _on_ss_job_ctl(self, m: Msg) -> None:
         self._apply_job_ctl(
             m.data["op"], m.job_id, m.data.get("job_name", ""),
-            m.data.get("quota", 0),
+            m.data.get("quota", 0), m.data.get("weight"),
         )
 
     def _apply_job_ctl(self, op: str, jid: int, name: str = "",
-                       quota: int = 0) -> None:
+                       quota: int = 0,
+                       weight: Optional[float] = None) -> None:
         from adlb_tpu.runtime.jobs import STATE_CODES
 
-        job = self.jobs.apply(op, jid, name=name, quota_bytes=quota)
+        if weight is None and op == "submit" and self.cfg.job_weights:
+            # Config(job_weights) pre-names ids the allocator will hand
+            # out: stamp the weight onto the Job at birth so later
+            # weights() fan-outs (and /jobs summaries) carry it
+            weight = self.cfg.job_weights.get(jid)
+        job = self.jobs.apply(op, jid, name=name, quota_bytes=quota,
+                              weight=weight)
+        if weight is not None:
+            # hand the new fair-share map to the balancer thread; it
+            # applies set_job_weights() at its next round top (the
+            # engine's caches are not safe to flush from the reactor)
+            self._pending_job_weights = self._effective_job_weights()
+            if self._balancer is not None:
+                self._balancer.wake.set()
+            self.flight.record(
+                f"job_weight job={jid} weight={job.weight:g}"
+            )
         if self.wlog is not None:
             self.wlog.log_job(jid, STATE_CODES[job.state],
                               job.quota_bytes, job.name)
@@ -5352,6 +5661,18 @@ class Server:
                 f"job_killed job={jid} dropped={len(dropped)}"
             )
             self._flush_rq_job(jid, ADLB_NO_MORE_WORK)
+
+    def _effective_job_weights(self) -> dict:
+        """Config(job_weights) as the base layer (ids the allocator may
+        not have issued yet), overridden by every job the table actually
+        knows — including explicit resets back to neutral."""
+        w = dict(self.cfg.job_weights or {})
+        for j in self.jobs.values():
+            if j.weight != 1.0:
+                w[j.job_id] = j.weight
+            else:
+                w.pop(j.job_id, None)
+        return w
 
     def _on_fa_job_ctl(self, m: Msg) -> None:
         op = m.data["op"]
@@ -6095,6 +6416,10 @@ class Server:
                 "time_stamp": unit.time_stamp,
                 "attempts": unit.attempts,
             }
+            if getattr(unit, "job", 0):
+                # namespace rides the move (omitted = job 0, so
+                # single-job batches stay byte-identical on the wire)
+                shipped["job"] = unit.job
             tf = trace_fields(unit)
             if tf is not None:
                 shipped["trace"] = tf
@@ -6190,6 +6515,37 @@ class Server:
             return
         self._elastic_cooldown_until = now + self.cfg.elastic_cooldown_s
         self._request_scale_out("mem_watermark", hot_rank=hot)
+
+    @property
+    def member_spawner(self):
+        """Harness hook: callable(alloc) that spawns a new server shard
+        (in-proc thread, subprocess, k8s pod — the harness's business)."""
+        return self._member_spawner
+
+    @member_spawner.setter
+    def member_spawner(self, fn) -> None:
+        self._member_spawner = fn
+        if fn is None:
+            return
+        # Drain the parked scale request on registration (PR 19): a
+        # watermark/controller scale-out that arrived spawnerless parks
+        # in the single _scale_pending slot (dedup-collapsed — each new
+        # request overwrites, newest wins). A late-registering spawner
+        # must service it now, not leave it to rot at /fleet until the
+        # next trigger re-fires.
+        pending = getattr(self, "_scale_pending", None)
+        if pending is None:
+            return
+        if self._scaleout_t0 is not None or self._member_terminating():
+            return
+        self._scale_pending = None
+        self.flight.record(
+            f"scale_pending_drained reason={pending.get('reason')}"
+        )
+        self._request_scale_out(
+            str(pending.get("reason") or "pending"),
+            hot_rank=pending.get("hot_rank"),
+        )
 
     def _request_scale_out(self, reason: str,
                            hot_rank: Optional[int] = None) -> dict:
@@ -7008,6 +7364,7 @@ class Server:
             common_seqno=u["common_seqno"],
             time_stamp=u["time_stamp"],
             attempts=int(u.get("attempts", 0) or 0),
+            job=int(u.get("job", 0) or 0),
         )
         self._next_seqno += 1
         tf = u.get("trace")

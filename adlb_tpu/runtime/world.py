@@ -237,6 +237,23 @@ class Config:
     # snapshot (fixed shapes for the jitted solve).
     balancer_max_tasks: int = 256
     balancer_max_requesters: int = 64
+    # ---- multi-job planning (balancer/jobdim.py) ----
+    # how many job namespaces the tpu balancer plans: 1 (default)
+    # reproduces the historical job-0-only planner exactly — same
+    # shapes, same compiled programs, same pairs — with non-default
+    # jobs riding the qmstat RFR fallback; > 1 widens the solver's
+    # type axis to max_jobs * len(types) composite (job, type) slots
+    # so every namespace below the cap is planned (jobs at or above
+    # the cap keep the fallback). Auto-raised to cover job_weights.
+    balancer_max_jobs: int = 1
+    # per-job weights/shares folded into the assignment score as an
+    # int32-safe priority bias (eff_prio = clip(prio) + (w-1)*1e6,
+    # see balancer/jobdim.py): {job_id: weight}, 1.0 = neutral. A
+    # heavier tenant outranks a lighter one at equal native priority
+    # without letting priorities cross job isolation — weights are
+    # shares, priorities stay the intra-job ordering. Live updates
+    # ride POST /jobs/<id> {"weight": w}. None = all jobs neutral.
+    job_weights: Optional[dict] = None
     # Adaptive migration-pump knobs (balancer/engine.py): a server holding
     # >= lookahead ready units per local consumer is never
     # migration-deficient; a destination that re-triggers its deficit
@@ -336,6 +353,35 @@ class Config:
     # obs-sync tick — the natural cadence, since that is when fresh
     # fleet snapshots arrive.
     slo_eval_interval: float = 0.0
+    # ---- closed-loop controller (adlb_tpu/control/) ----
+    # the fleet brain: a MASTER-side policy loop riding the obs tick
+    # (like the SLO engine) that watches the merged registry + alert
+    # table (mem_pressure, put_backoff, per-job depth/age, FIRING
+    # alerts) and drives the existing actuators — server scale-out/in
+    # through the membership plane and per-tenant throttling through
+    # job quotas — under explicit hysteresis (per-action cooldowns,
+    # min/max bounds, epoch-churn hold). False = no controller thread,
+    # no counters, frame-identical to a pre-controller world. Requires
+    # obs_sync_interval > 0 (the merged view is the gossip plane's
+    # product) and server_impl="python".
+    control: bool = False
+    # controller evaluation cadence; 0 = every obs-sync tick
+    control_interval: float = 0.0
+    # log decisions (visible at GET /control) without acting
+    control_dry_run: bool = False
+    # fleet-size bounds the controller must respect; max 0 = unbounded
+    control_min_servers: int = 1
+    control_max_servers: int = 0
+    # per-action cooldown: after the controller acts (scale/throttle),
+    # the same action class is held for this long — a flapping metric
+    # produces at most one action per window
+    control_cooldown_s: float = 10.0
+    # fleet max mem_pressure above which the controller requests a
+    # scale-out (and considers throttling the heaviest non-default
+    # tenant), and below which — held for a full cooldown window with
+    # idle queues — it drains the newest shard back in
+    control_scaleout_pressure: float = 0.85
+    control_scalein_pressure: float = 0.30
     # Live ops endpoint on the MASTER server: serves /metrics (registry
     # exposition + last STAT_APS world aggregate), /healthz, and /dump
     # (flight-record snapshot) on 127.0.0.1:<ops_port>. None = off;
@@ -652,6 +698,56 @@ class Config:
         if age > ttl:
             raise ValueError(
                 "balancer_inflow_min_age must be <= balancer_inflow_ttl"
+            )
+        if not (0 < self.balancer_max_jobs <= 16):
+            # the composite type axis is max_jobs * len(types) solver
+            # columns; 16 namespaces keeps the widened axis far from
+            # the u16 wire limits and the one-compile shape reasonable
+            raise ValueError("balancer_max_jobs must be in 1..16")
+        if self.job_weights is not None:
+            for j, w in self.job_weights.items():
+                if int(j) < 0:
+                    raise ValueError("job_weights keys must be >= 0")
+                if not (float(w) > 0.0):
+                    raise ValueError("job_weights values must be > 0")
+            # weights on jobs the planner cannot see would silently do
+            # nothing — widen the planning axis to cover them
+            hi = max((int(j) for j in self.job_weights), default=0)
+            if hi + 1 > self.balancer_max_jobs:
+                if hi + 1 > 16:
+                    raise ValueError(
+                        "job_weights names a job beyond the planner's "
+                        "16-namespace cap"
+                    )
+                self.balancer_max_jobs = hi + 1
+        if self.control:
+            if self.server_impl != "python":
+                raise ValueError("control=True requires server_impl='python'")
+            if self.obs_sync_interval <= 0:
+                # the controller's inputs are the merged obs registry
+                # and alert table — products of the gossip plane
+                raise ValueError("control=True requires obs_sync_interval > 0")
+        if self.control_interval < 0:
+            raise ValueError("control_interval must be >= 0")
+        if self.control_cooldown_s < 0:
+            raise ValueError("control_cooldown_s must be >= 0")
+        if self.control_min_servers < 1:
+            raise ValueError("control_min_servers must be >= 1")
+        if self.control_max_servers < 0:
+            raise ValueError("control_max_servers must be >= 0")
+        if self.control_max_servers and \
+                self.control_max_servers < self.control_min_servers:
+            raise ValueError(
+                "control_max_servers, when bounded, must be >= "
+                "control_min_servers"
+            )
+        if not (0.0 < self.control_scaleout_pressure <= 1.0):
+            raise ValueError("control_scaleout_pressure must be in (0, 1]")
+        if not (0.0 <= self.control_scalein_pressure
+                < self.control_scaleout_pressure):
+            raise ValueError(
+                "control_scalein_pressure must be in "
+                "[0, control_scaleout_pressure)"
             )
         if not (0 < self.balancer_max_tasks <= 8192):
             raise ValueError("balancer_max_tasks must be in 1..8192")

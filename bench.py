@@ -1905,6 +1905,182 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         hedge_rows = {"hedge_error": repr(e)[:200]}
 
+    # multi-job fairness (round 13, ISSUE 19): a light tenant (8 units,
+    # 4:1 fair-share weight) rides the PLANNED path while a heavy
+    # tenant floods 40 units against a squeezed snapshot horizon
+    # (balancer_max_tasks=16) — the weight bias decides whether the
+    # light job's units make the horizon and win solve slots while the
+    # flood drains, or wait behind it. The row is the light job's p99
+    # put->deliver sojourn with weights on vs off (same worlds,
+    # interleaved reps); < 1 means weighting shielded the tenant.
+    # Guarded baseline-relative (bench_guard "fairness" row, r08
+    # skip-with-note policy until a baseline carries it). Own
+    # containment.
+    def fairness_bench():
+        import struct as _struct
+
+        from adlb_tpu.runtime.membership import ElasticWorld
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        T = 1
+        n_heavy, n_light = 40, 8
+
+        def one_world(weighted):
+            cfg = Config(
+                balancer="tpu", balancer_max_jobs=3,
+                job_weights={2: 4.0} if weighted else None,
+                balancer_max_tasks=16, put_routing="home",
+                exhaust_check_interval=0.2,
+            )
+            ew = ElasticWorld(3, 2, [T], cfg=cfg, timeout=90.0)
+
+            def producer(ctx):
+                rc, ja = ctx.submit_job("heavy")
+                assert (rc, ja) == (_OK, 1)
+                rc, jb = ctx.submit_job("light")
+                assert (rc, jb) == (_OK, 2)
+                ctx.attach(1)
+                for _ in range(n_heavy):
+                    assert ctx.put(
+                        _struct.pack("<d", time.perf_counter())
+                        + b"\0" * 48, T) == _OK
+                ctx.attach(2)
+                for _ in range(n_light):
+                    assert ctx.put(
+                        _struct.pack("<d", time.perf_counter())
+                        + b"\0" * 48, T) == _OK
+                ctx.drain_job(1)
+                ctx.drain_job(2)
+                return []
+
+            def consumer(jid):
+                def app(ctx):
+                    time.sleep(0.2)
+                    ctx.attach(jid)
+                    sojourns = []
+                    while True:
+                        rc, w = ctx.get_work([T])
+                        if rc != _OK:
+                            return sojourns
+                        sojourns.append(
+                            (time.perf_counter()
+                             - _struct.unpack("<d", w.payload[:8])[0])
+                            * 1e3)
+                        time.sleep(0.005)  # per-unit work: a standing
+                        # backlog, so horizon ordering matters
+                return app
+
+            # home placement (world.home_server: rank % nservers):
+            # producer rank 0 and the HEAVY consumer rank 2 share
+            # server 0, so the flood drains by local matching; the
+            # LIGHT consumer rank 1 parks on server 1, so every light
+            # unit must cross through the planner — the path the
+            # weight bias arbitrates
+            ew.run_app(0, producer)
+            ew.run_app(1, consumer(2))
+            ew.run_app(2, consumer(1))
+            res = ew.finish(timeout=90)
+            assert len(res[2]) == n_heavy and len(res[1]) == n_light
+            light = sorted(res[1])
+            return light[min(len(light) - 1,
+                             int(0.99 * len(light)))]
+
+        on_ms, off_ms = [], []
+        for rep in range(3):
+            order = (True, False) if rep % 2 == 0 else (False, True)
+            for m in order:
+                (on_ms if m else off_ms).append(one_world(m))
+        return {
+            "fairness_weighted_p99_ms": round(med(on_ms), 1),
+            "fairness_unweighted_p99_ms": round(med(off_ms), 1),
+            "fairness_p99_ratio": round(
+                med(on_ms) / med(off_ms), 3) if med(off_ms) else 0.0,
+            "fairness_weighted_p99_ms_reps": [
+                round(x, 1) for x in on_ms],
+            "fairness_unweighted_p99_ms_reps": [
+                round(x, 1) for x in off_ms],
+        }
+
+    try:
+        fairness_rows = fairness_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        fairness_rows = {"fairness_error": repr(e)[:200]}
+
+    # fleet controller (round 13, ISSUE 19): autoscale reaction — a
+    # put burst drives one server past the scale-out pressure band and
+    # the clock runs from the last put acked to the controller-spawned
+    # shard LIVE in the membership table (decision latency + the §12
+    # scale-out machine, end to end through the closed loop). Median
+    # over reps; guarded baseline-relative (bench_guard "control" row,
+    # r08 skip-with-note policy). Own containment.
+    def control_bench():
+        import struct as _struct
+        import threading as _th
+
+        from adlb_tpu.runtime.membership import ElasticWorld
+        from adlb_tpu.types import ADLB_SUCCESS as _OK
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        T = 1
+        reps = []
+        for _ in range(3):
+            cfg = Config(
+                exhaust_check_interval=0.2, ops_port=0,
+                obs_sync_interval=0.1, control=True,
+                control_cooldown_s=5.0, control_min_servers=2,
+                control_scaleout_pressure=0.25,
+                control_scalein_pressure=0.05,
+                max_malloc_per_server=256 * 1024,
+            )
+            ew = ElasticWorld(1, 2, [T], cfg=cfg, timeout=90.0)
+            pressured = _th.Event()
+            grown = _th.Event()
+
+            def app(ctx, pressured=pressured, grown=grown):
+                for i in range(20):
+                    assert ctx.put(
+                        _struct.pack("<q", i) + b"\0" * 8192, T) == _OK
+                ctx._c.flush_puts()
+                pressured.set()
+                grown.wait(60)
+                n = 0
+                while True:
+                    rc, _w = ctx.get_work([T])
+                    if rc != _OK:
+                        return n
+                    n += 1
+
+            ew.run_app(0, app)
+            assert pressured.wait(60)
+            t0 = time.perf_counter()
+            deadline = t0 + 60.0
+            while len(ew.servers) <= 2 and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            assert len(ew.servers) > 2, "controller never scaled out"
+            reps.append((time.perf_counter() - t0) * 1e3)
+            grown.set()
+            res = ew.finish(timeout=90)
+            assert res[0] == 20, f"autoscale bench lost work ({res[0]})"
+            acts = ew.master.metrics.value(
+                "control_actions", kind="scale_out")
+            assert acts >= 1, "scale-out was not controller-driven"
+        return {
+            "autoscale_react_ms": round(med(reps), 1),
+            "autoscale_react_ms_reps": [round(x, 1) for x in reps],
+        }
+
+    try:
+        control_rows = control_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        control_rows = {"control_error": repr(e)[:200]}
+
     # measurement provenance (the r07 caveat made policy): every record
     # carries the core count + load so cross-round comparisons can tell
     # a real regression from a different (or busy) box — bench_guard
@@ -2037,6 +2213,8 @@ def main() -> None:
             **slo_rows,
             **member_rows,
             **hedge_rows,
+            **fairness_rows,
+            **control_rows,
         },
     }
     # full record first (audit trail for humans / in-tree rehearsal logs)
@@ -2228,6 +2406,14 @@ def main() -> None:
                 "hedge_storm_launch_excess"),
             "hedge_storm_veto_breaches": hedge_rows.get(
                 "hedge_storm_veto_breaches"),
+            # multi-job fairness + fleet controller (round 13): the
+            # light tenant's weighted/unweighted p99 sojourn ratio and
+            # the closed-loop scale-out reaction — bench_guard
+            # "fairness" / "control" rows (r08 skip-with-note arms)
+            "fairness_p99": [fairness_rows.get("fairness_weighted_p99_ms"),
+                             fairness_rows.get("fairness_unweighted_p99_ms")],
+            "fairness_p99_ratio": fairness_rows.get("fairness_p99_ratio"),
+            "autoscale_react_ms": control_rows.get("autoscale_react_ms"),
             "mux_burst8": [mux_rows.get("mux_burst8_batched_ms"),
                            mux_rows.get("mux_burst8_sequential_ms")],
             "coinop_shm": [shm_rows.get("coinop_shm_p50_ms"),
@@ -2281,6 +2467,14 @@ def main() -> None:
     if "device_solve_error" in device_rows:
         compact["detail"]["device_error"] = (
             device_rows["device_solve_error"][:120]
+        )
+    if "fairness_error" in fairness_rows:
+        compact["detail"]["fairness_error"] = (
+            fairness_rows["fairness_error"][:120]
+        )
+    if "control_error" in control_rows:
+        compact["detail"]["control_error"] = (
+            control_rows["control_error"][:120]
         )
     line = json.dumps(compact, separators=(",", ":"))
     if len(line) > 1900:  # belt-and-braces: the tail window is ~2000

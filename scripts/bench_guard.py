@@ -137,6 +137,22 @@ GUARDS = {
     "hedge": [
         ("rescue", "hedge_p999_on_ms"),
     ],
+    # multi-job fairness (r13 metric; older baselines skip with a note,
+    # the r08 policy): the light tenant's weighted-arm p99 sojourn
+    # under a heavy flood through the planned path — a regression means
+    # the fair-share bias stopped shielding the tenant. The unweighted
+    # arm rides the compact pair for reference (it IS the number the
+    # weights exist to beat), and the ratio is recorded alongside.
+    "fairness": [
+        ("weighted", "fairness_weighted_p99_ms"),
+    ],
+    # fleet controller (r13 metric; older baselines skip with a note):
+    # closed-loop scale-out reaction — pressure step to the
+    # controller-spawned shard live in the membership table. Once a
+    # baseline carries it, a record missing the row fails.
+    "control": [
+        ("autoscale", "autoscale_react_ms"),
+    ],
 }
 
 # Absolute arms: self-contained bounds checked against the NEW record

@@ -496,6 +496,95 @@ def churn_world(rng, apps, servers, mode, policy):
     )
 
 
+def control_world(rng, apps, policy):
+    """Fleet-brain adversity (adlb_tpu/control/controller.py): the
+    closed-loop controller rides the obs tick over a live ElasticWorld
+    while a put storm drives memory pressure past the scale-out rule's
+    threshold. The CONTROLLER — no manual kick anywhere — must grow
+    the fleet, and the growth must be clean under BOTH worker
+    policies: exact id coverage across the scale-out, `failover_lost`
+    0 on every server, at least one enacted scale_out action, and the
+    hysteresis rail held (enacted scale actions bounded by the elapsed
+    cooldown windows)."""
+    from adlb_tpu.runtime.membership import ElasticWorld
+
+    payload_len = 2048
+    n_units = rng.randint(30, 40)
+    cooldown = 3.0
+    cfg = Config(
+        exhaust_check_interval=0.2,
+        on_worker_failure=policy,
+        ops_port=0,
+        obs_sync_interval=0.1,
+        control=True,
+        control_cooldown_s=cooldown,
+        control_min_servers=2,
+        control_max_servers=4,
+        control_scaleout_pressure=0.25,
+        control_scalein_pressure=0.05,
+        max_malloc_per_server=128 * 1024,
+    )
+    t0 = time.monotonic()
+    ew = ElasticWorld(apps, 2, [1], cfg=cfg)
+    hold = threading.Event()     # storm parked; unleash the consumers
+    stormed = threading.Event()  # every put acked
+
+    def consume(ctx):
+        got = []
+        while True:
+            rc, w = ctx.get_work([1])
+            if rc != ADLB_SUCCESS:
+                return got
+            got.append(struct.unpack("<q", w.payload[:8])[0])
+
+    def producer(ctx):
+        for i in range(n_units):
+            assert ctx.put(
+                struct.pack("<q", i) + b"x" * (payload_len - 8), 1
+            ) == ADLB_SUCCESS
+        ctx._c.flush_puts()
+        stormed.set()
+        hold.wait(90)
+        return consume(ctx)
+
+    def holder(ctx):
+        hold.wait(90)
+        return consume(ctx)
+
+    ew.run_app(0, producer)
+    for r in range(1, apps):
+        ew.run_app(r, holder)
+    assert stormed.wait(60), "put storm never finished"
+    # the controller — not a manual kick — grows the fleet
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(ew.servers) <= 2:
+        time.sleep(0.05)
+    assert len(ew.servers) > 2, "controller never scaled out"
+    hold.set()
+    results = ew.finish(timeout=120)
+    elapsed = time.monotonic() - t0
+    got = sorted(x for v in results.values() if v for x in v)
+    want = list(range(n_units))
+    assert got == want, (
+        f"coverage broke across controller scale-out: "
+        f"missing={set(want) - set(got)} "
+        f"dup={[x for x in got if got.count(x) > 1][:5]}"
+    )
+    # controller-driven growth is CLEAN: no counted losses anywhere
+    for r, s in ew.servers.items():
+        assert s.metrics.value("failover_lost") == 0.0, r
+    acts = ew.master.metrics.value("control_actions", kind="scale_out")
+    assert acts >= 1.0, "scale-out happened without an enacted action"
+    # hysteresis rail: at most one enacted scale action per cooldown
+    # window over the world's whole life
+    windows = int(elapsed / cooldown) + 1
+    assert acts <= windows, (acts, windows, elapsed)
+    return dict(
+        workload="control", apps=apps, policy=policy, n_units=n_units,
+        servers=len(ew.servers), actions=int(acts), windows=windows,
+    )
+
+
 def hedge_world(rng, apps, mode, policy, fabric=None):
     """Tail-hedging adversity (ISSUE 17): hedging armed, one worker
     SIGSTOPs while holding an unfetched reservation WITHOUT crossing

@@ -1,0 +1,425 @@
+"""The fleet controller (adlb_tpu/control/): closed-loop sense→decide→act.
+
+Coverage layers:
+
+* **Policy gate** — ``parse_policy`` defaults, validation, and the
+  POST /control merge semantics (unknown keys and bad values 400).
+* **Decision rules** — each rule as a pure function of ``(now,
+  inputs)``: mem_pressure / slo_firing scale-out, tenant_hog throttle
+  with the pressure_recovered release, fleet_idle scale-in, min/max
+  server rails.
+* **Hysteresis** — a flapping signal produces at most ONE action per
+  cooldown window; scale_out/scale_in share a cooldown key (no
+  out-then-in bounce); an epoch bump freezes actions for the churn
+  grace; dry-run records and paces but acts nothing.
+* **History discipline** — a rule stuck in the same suppressed outcome
+  is recorded once, not every tick.
+* **Frame identity** — an unconfigured world (`control=False`)
+  constructs no Controller and mints no controller metrics;
+  GET /control answers ``enabled: false``.
+* **End-to-end** — an ElasticWorld under real memory pressure: the
+  controller requests the scale-out, the shard joins through the
+  membership plane with ``failover_lost == 0``, the decision surfaces
+  at GET /control as ``enacted``, and POST /control live-tweaks the
+  policy.
+"""
+
+import json
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from adlb_tpu.control import Controller, parse_policy
+from adlb_tpu.control.controller import (
+    ACT,
+    BOUNDED,
+    COOLDOWN,
+    DRY_RUN,
+    HELD,
+)
+from adlb_tpu.runtime.membership import ElasticWorld
+from adlb_tpu.runtime.world import Config
+from adlb_tpu.types import ADLB_SUCCESS
+
+T = 1
+
+
+# ------------------------------------------------------------ policy gate
+
+
+def test_parse_policy_defaults():
+    pol = parse_policy({})
+    assert pol == {
+        "dry_run": False, "min_servers": 1, "max_servers": 0,
+        "cooldown_s": 10.0, "scaleout_pressure": 0.85,
+        "scalein_pressure": 0.30, "throttle_frac": 0.5,
+    }
+
+
+@pytest.mark.parametrize("bad", [
+    {"nope": 1},
+    {"min_servers": 0},
+    {"max_servers": -1},
+    {"min_servers": 3, "max_servers": 2},
+    {"cooldown_s": -1},
+    {"scaleout_pressure": 0.0},
+    {"scaleout_pressure": 1.5},
+    {"scalein_pressure": 0.9},      # >= scaleout default
+    {"throttle_frac": 0.0},
+    "not-a-dict",
+])
+def test_parse_policy_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_policy(bad)
+
+
+def test_update_policy_merges_and_swaps():
+    ctl = Controller({"cooldown_s": 5.0}, now=0.0)
+    old = ctl.policy
+    pol = ctl.update_policy({"dry_run": True})
+    assert pol["dry_run"] is True and pol["cooldown_s"] == 5.0
+    assert ctl.policy is not old  # swap-published, never mutated
+    with pytest.raises(ValueError):
+        ctl.update_policy({"bogus": 1})
+    assert ctl.policy["dry_run"] is True  # rejected tweak changed nothing
+
+
+# ------------------------------------------------------- decision rules
+
+
+def _frame(**kw):
+    base = {
+        "live_servers": 3, "pressure": {}, "firing": 0, "jobs": {},
+        "backoffs": 0, "oldest_lease_s": 0.0, "epoch": 0,
+    }
+    base.update(kw)
+    return base
+
+
+def _ctl(**policy):
+    policy.setdefault("cooldown_s", 10.0)
+    return Controller(policy, eval_interval=1.0, now=0.0)
+
+
+def test_mem_pressure_scale_out_names_hot_rank():
+    ctl = _ctl()
+    out = ctl.evaluate(1.0, _frame(pressure={4: 0.2, 5: 0.91}))
+    assert len(out) == 1
+    d = out[0]
+    assert d["rule"] == "mem_pressure"
+    assert d["action"] == {"kind": "scale_out", "hot_rank": 5}
+    assert d["outcome"] == ACT
+    assert d["inputs"]["worst_pressure"] == 0.91
+
+
+def test_slo_firing_scale_out_needs_backlog():
+    ctl = _ctl()
+    # firing without backlog: nothing to scale for
+    assert ctl.evaluate(1.0, _frame(firing=1)) == []
+    out = ctl.evaluate(2.0, _frame(
+        firing=1, jobs={1: {"depth": 7, "bytes": 10}},
+    ))
+    assert [d["rule"] for d in out] == ["slo_firing"]
+    assert out[0]["action"]["kind"] == "scale_out"
+    assert out[0]["outcome"] == ACT
+
+
+def test_max_servers_rail_bounds_scale_out():
+    ctl = _ctl(max_servers=3)
+    out = ctl.evaluate(1.0, _frame(
+        live_servers=3, pressure={4: 0.95},
+    ))
+    assert out[0]["outcome"] == BOUNDED
+    assert out[0]["bound"] == "max_servers"
+    # a bounded decision stamps NO cooldown: raising the rail frees the
+    # rule immediately
+    ctl.update_policy({"max_servers": 4})
+    out = ctl.evaluate(2.0, _frame(live_servers=3, pressure={4: 0.95}))
+    assert out[0]["outcome"] == ACT
+
+
+def test_fleet_idle_scale_in_floor():
+    ctl = _ctl(min_servers=1)
+    # at the drain-safety floor of 2 the rule does not trigger at all
+    assert ctl.evaluate(1.0, _frame(live_servers=2)) == []
+    out = ctl.evaluate(2.0, _frame(live_servers=3))
+    assert [d["rule"] for d in out] == ["fleet_idle"]
+    assert out[0]["action"] == {"kind": "scale_in"}
+    assert out[0]["outcome"] == ACT
+    # min_servers above the floor is respected too
+    ctl2 = _ctl(min_servers=4)
+    assert ctl2.evaluate(1.0, _frame(live_servers=4)) == []
+
+
+def test_tenant_hog_throttle_then_pressure_recovered():
+    ctl = _ctl()
+    jobs = {
+        1: {"depth": 9, "bytes": 800, "quota_bytes": 0,
+            "state": "running"},
+        2: {"depth": 1, "bytes": 100, "quota_bytes": 0,
+            "state": "running"},
+    }
+    out = ctl.evaluate(1.0, _frame(pressure={4: 0.9}, jobs=jobs))
+    rules = {d["rule"]: d for d in out}
+    # mem_pressure fires too (separate cooldown key); the hog throttle
+    # caps job 1 at its current footprint
+    assert set(rules) == {"mem_pressure", "tenant_hog"}
+    th = rules["tenant_hog"]
+    assert th["action"] == {"kind": "throttle", "job": 1,
+                            "quota_bytes": 800}
+    assert th["outcome"] == ACT
+    # pressure recedes: the tenant is released; pre-throttle quota 0
+    # (unlimited) restores as -1, the update op's "unlimited" encoding
+    out = ctl.evaluate(30.0, _frame(pressure={4: 0.1}, jobs=jobs))
+    rec = [d for d in out if d["rule"] == "pressure_recovered"]
+    assert rec and rec[0]["action"] == {
+        "kind": "unthrottle", "job": 1, "quota_bytes": -1,
+    }
+    assert rec[0]["outcome"] == ACT
+
+
+def test_tenant_hog_skips_quotad_and_default_jobs():
+    ctl = _ctl()
+    jobs = {
+        0: {"depth": 1, "bytes": 900, "quota_bytes": 0,
+            "state": "running"},          # default namespace: never
+        1: {"depth": 1, "bytes": 80, "quota_bytes": 64,
+            "state": "running"},          # already quota'd: never
+    }
+    out = ctl.evaluate(1.0, _frame(pressure={4: 0.9}, jobs=jobs))
+    assert [d["rule"] for d in out] == ["mem_pressure"]
+
+
+# ---------------------------------------------------------- hysteresis
+
+
+def test_flapping_pressure_one_action_per_cooldown_window():
+    """Pressure oscillating across the threshold every tick: the acts
+    the controller emits are spaced >= cooldown_s apart — at most one
+    per window."""
+    ctl = _ctl(cooldown_s=10.0)
+    acts = []
+    for i in range(31):
+        now = float(i)
+        p = 0.95 if i % 2 == 0 else 0.05
+        for d in ctl.evaluate(now, _frame(pressure={4: p})):
+            if d["outcome"] == ACT:
+                acts.append(now)
+    assert len(acts) <= 4  # 31 s of flapping, 10 s windows
+    assert all(b - a >= 10.0 for a, b in zip(acts, acts[1:]))
+
+
+def test_scale_out_and_in_share_one_cooldown_key():
+    """After a scale-out act, a fleet-idle scale-in inside the window is
+    refused by the SHARED cooldown — the controller can never bounce a
+    shard out and straight back in."""
+    ctl = _ctl(cooldown_s=10.0)
+    out = ctl.evaluate(1.0, _frame(pressure={4: 0.95}))
+    assert out[0]["outcome"] == ACT
+    out = ctl.evaluate(2.0, _frame(live_servers=4, pressure={4: 0.05}))
+    assert [d["rule"] for d in out] == ["fleet_idle"]
+    assert out[0]["outcome"] == COOLDOWN
+
+
+def test_epoch_churn_hold_freezes_actions():
+    ctl = _ctl()
+    # mid-band pressure: no rule triggers, the epoch is just noted
+    ctl.evaluate(1.0, _frame(epoch=0, pressure={4: 0.5}))
+    # epoch bump: hold = max(4 * eval_interval, 2.0) = 4 s
+    out = ctl.evaluate(2.0, _frame(epoch=1, pressure={4: 0.95}))
+    assert out[0]["outcome"] == HELD
+    out = ctl.evaluate(3.0, _frame(epoch=1, pressure={4: 0.95}))
+    assert out == []  # same suppressed outcome: recorded once
+    out = ctl.evaluate(6.5, _frame(epoch=1, pressure={4: 0.95}))
+    assert out[0]["outcome"] == ACT
+
+
+def test_dry_run_paces_but_acts_nothing():
+    ctl = _ctl(dry_run=True, cooldown_s=10.0)
+    out = ctl.evaluate(1.0, _frame(pressure={4: 0.95}))
+    assert out[0]["outcome"] == DRY_RUN
+    assert ctl.actions_total == 0
+    # the would-act stamped its cooldown: the stream paces like live
+    out = ctl.evaluate(2.0, _frame(pressure={4: 0.95}))
+    assert out[0]["outcome"] == COOLDOWN
+    assert ctl.actions_total == 0
+
+
+def test_history_dedup_and_bound():
+    ctl = _ctl(max_servers=2)
+    for i in range(50):
+        ctl.evaluate(float(i), _frame(live_servers=2,
+                                      pressure={4: 0.95}))
+    bounded = [d for d in ctl.history if d["outcome"] == BOUNDED]
+    assert len(bounded) == 1  # stuck outcome recorded once
+    assert ctl.history.maxlen == 256
+
+
+def test_publish_swaps_status():
+    ctl = _ctl()
+    frame = _frame(live_servers=3, pressure={4: 0.4}, backoffs=7)
+    ctl.evaluate(1.0, frame)
+    ctl.publish(1.0, frame)
+    st = ctl.status_pub
+    assert st["live_servers"] == 3
+    assert st["worst_pressure"] == 0.4
+    assert st["backoffs"] == 7
+    assert st["held"] is False
+
+
+# ------------------------------------------------- world-level plumbing
+
+
+def _wait(pred, timeout=30.0, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(tick)
+    return None
+
+
+def _get(port, route):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/{route}", timeout=10).read().decode())
+
+
+def _post(port, route, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{route}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=10)
+                      .read().decode())
+
+
+def _consume(ctx, pace=0.002):
+    got = []
+    while True:
+        rc, w = ctx.get_work([T])
+        if rc != ADLB_SUCCESS:
+            return got
+        got.append(w.payload)
+        if pace:
+            time.sleep(pace)
+
+
+def test_unconfigured_world_frame_identity():
+    """control=False (the default): no Controller object, no controller
+    metrics, and GET /control answers enabled=false — frame-identical
+    to a pre-controller build."""
+    cfg = Config(exhaust_check_interval=0.2, ops_port=0,
+                 obs_sync_interval=0.1)
+    ew = ElasticWorld(1, 2, [T], cfg=cfg)
+
+    def app(ctx):
+        for i in range(4):
+            ctx.put(struct.pack("<q", i), T)
+        return _consume(ctx)
+
+    ew.run_app(0, app)
+    try:
+        master = ew.master
+        assert master._controller is None
+        assert _wait(lambda: master.ops is not None)
+        doc = _get(master.ops.port, "control")
+        assert doc["enabled"] is False
+        assert doc["decisions"] == []
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(master.ops.port, "control", {"dry_run": True})
+        assert ei.value.code == 400  # controller not configured
+        snap = master.metrics.snapshot()
+        assert not any("control" in k for k in snap["counters"])
+    finally:
+        ew.finish(timeout=60)
+
+
+def test_config_gate():
+    with pytest.raises(ValueError, match="obs_sync_interval"):
+        Config(control=True, ops_port=0, obs_sync_interval=0.0)
+    with pytest.raises(ValueError, match="python"):
+        Config(control=True, ops_port=0, obs_sync_interval=0.1,
+               server_impl="native")
+
+
+def test_e2e_controller_scaleout_zero_loss(tmp_path):
+    """Real memory pressure drives the mem_pressure rule end to end:
+    the controller requests the scale-out, the ElasticWorld spawner
+    services it through the membership plane, the decision surfaces at
+    GET /control as ``enacted`` with the action counter minted, the
+    join's epoch bump self-holds the controller, and the rebalance
+    counts failover_lost == 0. POST /control then live-flips dry_run."""
+    cap = 256 * 1024
+    cfg = Config(
+        exhaust_check_interval=0.2, ops_port=0, obs_sync_interval=0.1,
+        control=True, control_cooldown_s=5.0,
+        control_scaleout_pressure=0.25, control_scalein_pressure=0.05,
+        control_min_servers=2,
+        max_malloc_per_server=cap, flight_dir=str(tmp_path),
+    )
+    ew = ElasticWorld(2, 2, [T], cfg=cfg)
+    import threading
+    drain = threading.Event()
+
+    def producer(ctx):
+        # ~160 KB split across two 256 KB servers: per-server pressure
+        # crosses 0.25 while staying under the 0.95 spill watermark
+        for i in range(20):
+            ctx.put(struct.pack("<q", i) + b"p" * 8192, T)
+        ctx._c.flush_puts()
+        drain.wait(60)
+        return _consume(ctx)
+
+    def consumer(ctx):
+        drain.wait(60)
+        return _consume(ctx)
+
+    ew.run_app(0, producer)
+    ew.run_app(1, consumer)
+    try:
+        master = ew.master
+        assert master._controller is not None
+        # the controller saw the pressure and the spawner serviced it
+        assert _wait(lambda: len(ew.servers) == 3, timeout=30.0), \
+            "controller never scaled out"
+        assert master.metrics.value(
+            "control_actions", kind="scale_out") >= 1
+        assert master._controller.actions_total >= 1
+        assert _wait(lambda: master.ops is not None)
+        port = master.ops.port
+        doc = _get(port, "control")
+        assert doc["enabled"] is True
+        enacted = [d for d in doc["decisions"]
+                   if d["rule"] == "mem_pressure"
+                   and d["outcome"] == "enacted"]
+        assert enacted, doc["decisions"]
+        assert enacted[0]["action"]["kind"] == "scale_out"
+        # the join bumped the epoch: the controller noted the churn at
+        # its next tick
+        assert _wait(
+            lambda: master._controller._epoch == master.world.epoch
+        )
+        # live policy tweak over POST /control
+        out = _post(port, "control", {"dry_run": True})
+        assert out["policy"]["dry_run"] is True
+        assert master._controller.dry_run is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "control", {"scaleout_pressure": 7})
+        assert ei.value.code == 400
+    finally:
+        drain.set()
+        results = ew.finish(timeout=90)
+    # zero-loss bar: nothing the rebalance shipped was lost
+    assert sum(
+        s.metrics.value("failover_lost") for s in ew.servers.values()
+    ) == 0
+    got = sorted(
+        struct.unpack("<q", p[:8])[0]
+        for v in results.values() if v for p in v
+    )
+    assert got == list(range(20))

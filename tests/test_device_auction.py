@@ -25,12 +25,28 @@ from adlb_tpu.balancer.distributed import DistributedAssignmentSolver
 
 TYPES = (1, 2, 3, 4)
 
+# the multi-job arm: 3 planned namespaces, skewed weights — the bias
+# lands in the packed priorities (jobdim.weight_bias), so it is part of
+# the exact-pair-list bar below, not a separate score check
+MAX_JOBS = 3
+JOB_WEIGHTS = {1: 4.0, 2: 0.2}
+
 
 def _mesh(ndev):
     return Mesh(np.array(jax.devices()[:ndev]), axis_names=("s",))
 
 
-def _random_snapshots(rng, nservers, ntasks, nreqs, ntypes):
+def _rand_job(rng, J):
+    """Mostly default namespace, a spread over planned jobs, and a rare
+    overflow id (== J) exercising the planner-invisible pack skip."""
+    if J <= 1 or rng.random() < 0.4:
+        return 0
+    if rng.random() < 0.08:
+        return J
+    return int(rng.integers(1, J))
+
+
+def _random_snapshots(rng, nservers, ntasks, nreqs, ntypes, J=1):
     types = TYPES[:ntypes]
     snapshots = {}
     seq = 0
@@ -38,30 +54,32 @@ def _random_snapshots(rng, nservers, ntasks, nreqs, ntypes):
         tasks = []
         for _ in range(rng.integers(0, ntasks + 1)):
             seq += 1
-            tasks.append(
-                (seq, int(rng.choice(types)), int(rng.integers(-9, 10)), 8)
-            )
+            tk = (seq, int(rng.choice(types)), int(rng.integers(-9, 10)), 8)
+            jb = _rand_job(rng, J)
+            tasks.append(tk + (jb,) if jb else tk)
         tasks.sort(key=lambda t: -t[2])
         reqs = []
         for r in range(rng.integers(0, nreqs + 1)):
-            reqs.append(
-                (
-                    (s - 100) * 50 + r,
-                    int(rng.integers(1, 1000)),
-                    None if rng.random() < 0.25
-                    else sorted({int(rng.choice(types))
-                                 for _ in range(rng.integers(1, 3))}),
-                )
+            rq = (
+                (s - 100) * 50 + r,
+                int(rng.integers(1, 1000)),
+                None if rng.random() < 0.25
+                else sorted({int(rng.choice(types))
+                             for _ in range(rng.integers(1, 3))}),
             )
+            jb = _rand_job(rng, J)
+            reqs.append(rq + (0, jb) if jb else rq)
         snapshots[s] = {"tasks": tasks, "reqs": reqs}
     return snapshots
 
 
-def _twin_solvers(mesh, ntypes, nservers, rounds=64):
+def _twin_solvers(mesh, ntypes, nservers, rounds=64, max_jobs=1,
+                  job_weights=None):
     kw = dict(
         types=TYPES[:ntypes], max_tasks_per_server=10, max_requesters=5,
         mesh=mesh, rounds=rounds,
         servers_per_device=max(1, -(-nservers // mesh.devices.size)),
+        max_jobs=max_jobs, job_weights=job_weights,
     )
     return (DistributedAssignmentSolver(auction="device", **kw),
             DistributedAssignmentSolver(auction="host", **kw))
@@ -116,6 +134,101 @@ def test_device_tier_tracks_host_across_mutating_rounds(ndev):
         snap["reqs"] = []
     assert dev.solve(snaps, None) == []
     assert host.solve(snaps, None) == []
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 8])
+def test_device_pairs_equal_host_pairs_multi_job(ndev):
+    """Weighted multi-job worlds through both tiers: the composite
+    (job, type) axis, the weight bias folded into packed priorities,
+    and overflow-id skips must all reproduce EXACTLY — pair lists, not
+    just matched sets."""
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(9300 + ndev)
+    for trial in range(6):
+        ntypes = int(rng.integers(1, len(TYPES) + 1))
+        nservers = max(ndev, int(rng.integers(1, 4)) * ndev)
+        dev, host = _twin_solvers(mesh, ntypes, nservers,
+                                  max_jobs=MAX_JOBS,
+                                  job_weights=JOB_WEIGHTS)
+        snaps = _random_snapshots(
+            rng, nservers=nservers, ntasks=8, nreqs=4, ntypes=ntypes,
+            J=MAX_JOBS)
+        assert dev.solve(snaps, None) == host.solve(snaps, None)
+
+
+@pytest.mark.parametrize("ndev", [2, 8])
+def test_multi_job_tiers_track_across_churn_and_reweight(ndev):
+    """Churn mid-sweep on the job arm: task bursts land in random
+    namespaces, a server vanishes at round 3, and round 4 swaps the
+    live bias vector on BOTH tiers (the set_job_bias fan-out) — every
+    round's pair lists stay identical."""
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(9400 + ndev)
+    nservers = 2 * ndev
+    dev, host = _twin_solvers(mesh, len(TYPES), nservers,
+                              max_jobs=MAX_JOBS, job_weights=JOB_WEIGHTS)
+    snaps = _random_snapshots(
+        rng, nservers=nservers, ntasks=6, nreqs=3, ntypes=len(TYPES),
+        J=MAX_JOBS)
+    seq = [10**6]
+    for rnd in range(6):
+        assert dev.solve(snaps, None) == host.solve(snaps, None)
+        ranks = sorted(snaps)
+        burst_at = snaps[ranks[rnd % len(ranks)]]
+        for _ in range(3):
+            seq[0] += 1
+            tk = (seq[0], int(rng.choice(TYPES)),
+                  int(rng.integers(-9, 10)), 8)
+            jb = _rand_job(rng, MAX_JOBS)
+            burst_at["tasks"].append(tk + (jb,) if jb else tk)
+        burst_at["tasks"].sort(key=lambda t: -t[2])
+        snaps[ranks[(rnd + 1) % len(ranks)]]["reqs"] = []
+        if rnd == 3 and len(snaps) > 1:
+            del snaps[ranks[-1]]
+        if rnd == 4:
+            for sol in (dev, host):
+                assert sol.set_job_bias({1: 0.5, 2: 6.0})
+
+
+def test_no_retrace_at_10k_shape_multi_job():
+    """The job column must not cost compiles either: at the 10k-server
+    shape with a composite (job, type) axis, deltas, churn and
+    namespace-hopping bursts reuse the ONE compiled program."""
+    mesh = _mesh(8)
+    rng = np.random.default_rng(199)
+    sol = DistributedAssignmentSolver(
+        types=TYPES, max_tasks_per_server=4, max_requesters=2,
+        mesh=mesh, rounds=16, servers_per_device=1250, auction="device",
+        max_jobs=2, job_weights={1: 3.0},
+    )
+    assert sol.S == 10000
+    snaps = {}
+    seq = 0
+    for s in range(100, 100 + 256):
+        seq += 4
+        jb = _rand_job(rng, 2)
+        tk = (seq, int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+        rq = (s * 50, 1, [int(rng.choice(TYPES))])
+        snaps[s] = {
+            "tasks": [tk + (jb,) if jb else tk],
+            "reqs": [rq + (0, jb) if jb else rq] if s % 2 else [],
+        }
+    sol.solve(snaps, None)
+    for rnd in range(3):
+        victim = sorted(snaps)[rnd]
+        del snaps[victim]
+        fresh = 20000 + rnd
+        snaps[fresh] = {
+            "tasks": [(10**7 + rnd, int(rng.choice(TYPES)), 5, 8, 1)],
+            "reqs": [(fresh * 50, 1, None, 0, 1)],
+        }
+        seq += 1
+        first = snaps[sorted(snaps)[0]]
+        first["tasks"] = (first["tasks"] + [
+            (seq, int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+        ])[: sol.K]
+        sol.solve(snaps, None)
+    assert sol._plan_fn._cache_size() == 1
 
 
 def test_no_retrace_at_10k_shape():

@@ -34,10 +34,18 @@ from adlb_tpu.balancer.engine import PlanEngine
 
 TYPES = (1, 2, 3, 4)
 
+# the multi-job fuzz arm: 3 planned namespaces with deliberately
+# lopsided fair-share weights, so the weighted-score path (priority
+# bias folded at pack time, jobdim.weight_bias) is part of the parity
+# bar, not just the job-isolation masks
+MAX_JOBS = 3
+JOB_WEIGHTS = {1: 3.0, 2: 0.25}
 
-def _mk_engine(host_ledger, solver=None):
+
+def _mk_engine(host_ledger, solver=None, max_jobs=1, job_weights=None):
     eng = PlanEngine(types=TYPES, max_tasks=12, max_requesters=6,
-                     host_ledger=host_ledger)
+                     host_ledger=host_ledger, max_jobs=max_jobs,
+                     job_weights=job_weights)
     if solver is not None:
         eng.solver = solver
     eng.PUMP_INTERVAL = 0.0
@@ -49,25 +57,43 @@ def _mk_engine(host_ledger, solver=None):
     return eng
 
 
-def _rand_snaps(rng, nservers, seq, stamp):
+def _rand_job(rng, J):
+    """Job column draw: mostly the default namespace, a spread over the
+    planned ones, and a rare overflow id (== J, i.e. >= max_jobs) to
+    exercise the planner-invisible skip identically on both arms."""
+    if J <= 1 or rng.random() < 0.4:
+        return 0
+    if rng.random() < 0.08:
+        return J
+    return int(rng.integers(1, J))
+
+
+def _job_task(rng, seqno, J):
+    """A task tuple honoring the wire rule: the 5th (job) element is
+    present ONLY when the unit is outside the default namespace."""
+    tk = (seqno, int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+    jb = _rand_job(rng, J)
+    return tk + (jb,) if jb else tk
+
+
+def _rand_snaps(rng, nservers, seq, stamp, J=1):
     snaps = {}
     for s in range(100, 100 + nservers):
         tasks = []
         for _ in range(int(rng.integers(0, 10))):
             seq[0] += 1
-            tasks.append(
-                (seq[0], int(rng.choice(TYPES)), int(rng.integers(-9, 10)),
-                 8)
-            )
+            tasks.append(_job_task(rng, seq[0], J))
         tasks.sort(key=lambda t: -t[2])
         reqs = []
         for r in range(int(rng.integers(0, 5))):
-            reqs.append(
-                ((s - 100) * 50 + r, int(rng.integers(1, 1000)),
-                 None if rng.random() < 0.2
-                 else sorted({int(rng.choice(TYPES))
-                              for _ in range(int(rng.integers(1, 3)))}))
-            )
+            rq = ((s - 100) * 50 + r, int(rng.integers(1, 1000)),
+                  None if rng.random() < 0.2
+                  else sorted({int(rng.choice(TYPES))
+                               for _ in range(int(rng.integers(1, 3)))}))
+            jb = _rand_job(rng, J)
+            if jb:
+                rq = rq + (0, jb)
+            reqs.append(rq)
         snaps[s] = {"tasks": tasks, "reqs": reqs,
                     "consumers": int(rng.integers(0, 3)),
                     "stamp": stamp, "task_stamp": stamp}
@@ -82,7 +108,7 @@ def _bump(snaps, rank):
         b(rank)
 
 
-def _mutate(rng, pair, seq, rnd, matches):
+def _mutate(rng, pair, seq, rnd, matches, J=1):
     """One randomized world step applied identically to both engines'
     snapshot dicts: consume the plan, then a mix of delta appends,
     req-seq patches, death/rejoin, and fresh restamps."""
@@ -109,7 +135,7 @@ def _mutate(rng, pair, seq, rnd, matches):
     if rng.random() < 0.7:
         tgt = int(rng.choice(ranks))
         seq[0] += 1
-        unit = (seq[0], int(rng.choice(TYPES)), int(rng.integers(-9, 10)), 8)
+        unit = _job_task(rng, seq[0], J)
         for snaps in pair:
             snaps[tgt]["tasks"].append(unit)
             snaps[tgt]["delta_seq"] = snaps[tgt].get("delta_seq", 0) + 1
@@ -136,11 +162,12 @@ def _mutate(rng, pair, seq, rnd, matches):
         tasks = []
         for _ in range(int(rng.integers(0, 10))):
             seq[0] += 1
-            tasks.append((seq[0], int(rng.choice(TYPES)),
-                          int(rng.integers(-9, 10)), 8))
+            tasks.append(_job_task(rng, seq[0], J))
         tasks.sort(key=lambda x: -x[2])
-        reqs = [((tgt - 100) * 50 + 20 + rnd, int(rng.integers(1, 1000)),
-                 [int(rng.choice(TYPES))])]
+        rq = ((tgt - 100) * 50 + 20 + rnd, int(rng.integers(1, 1000)),
+              [int(rng.choice(TYPES))])
+        jb = _rand_job(rng, J)
+        reqs = [rq + (0, jb) if jb else rq]
         cons = int(rng.integers(0, 3))  # drawn ONCE: both dicts identical
         for snaps in pair:
             snaps[tgt] = {"tasks": list(tasks), "reqs": list(reqs),
@@ -161,10 +188,10 @@ def _assert_filter_parity(a, p, snapsA, snapsP):
         assert a._ledger.elig_tasks(rank) == p._ledger.elig_tasks(rank), rank
 
 
-def _drive(a, p, seed, rounds=14, nservers=8):
+def _drive(a, p, seed, rounds=14, nservers=8, J=1, reweight=None):
     rng = np.random.default_rng(seed)
     seq = [0]
-    snapsA = _rand_snaps(rng, nservers, seq, time.monotonic())
+    snapsA = _rand_snaps(rng, nservers, seq, time.monotonic(), J=J)
     snapsP = copy.deepcopy(snapsA)
     pair = (snapsA, snapsP)
     for rnd in range(rounds):
@@ -175,11 +202,17 @@ def _drive(a, p, seed, rounds=14, nservers=8):
             for e in (a, p):
                 e._planned_in.setdefault(102, []).append(
                     (far, 2, 10**6, 100, frozenset({1, 2})))
+        if rnd == 7 and reweight is not None:
+            # live reweight mid-drive: both engines swap the same bias
+            # vector (the POST /jobs/<id> weight path) and must keep
+            # producing identical pair lists afterwards
+            for e in (a, p):
+                assert e.set_job_weights(reweight)
         mA = a.round(snapsA, None)
         mP = p.round(snapsP, None)
         assert mA == mP, (rnd, mA, mP)
         _assert_filter_parity(a, p, snapsA, snapsP)
-        _mutate(rng, pair, seq, rnd, mA[0])
+        _mutate(rng, pair, seq, rnd, mA[0], J=J)
 
 
 def test_parity_single_device_solver():
@@ -187,6 +220,18 @@ def test_parity_single_device_solver():
         a = _mk_engine("array")
         p = _mk_engine("py")
         _drive(a, p, seed)
+
+
+def test_parity_single_device_solver_multi_job():
+    """Job-column parity: snapshots carry a mixed job population
+    (default, weighted namespaces, rare overflow ids) and both engines
+    plan with lopsided fair-share weights plus a live mid-drive
+    reweight — matches and kept/eligible sets must stay identical."""
+    for seed in range(4):
+        a = _mk_engine("array", max_jobs=MAX_JOBS, job_weights=JOB_WEIGHTS)
+        p = _mk_engine("py", max_jobs=MAX_JOBS, job_weights=JOB_WEIGHTS)
+        _drive(a, p, 50 + seed, J=MAX_JOBS,
+               reweight={1: 0.5, 2: 2.0})
 
 
 @pytest.fixture(scope="module", params=[1, 2, 8])
@@ -211,6 +256,29 @@ def test_parity_sharded_solver(mesh):
     a = _mk_engine("array", dist())
     p = _mk_engine("py", dist())
     _drive(a, p, 1000 + ndev, nservers=nservers)
+
+
+def test_parity_sharded_solver_multi_job(mesh):
+    """The sharded solver's composite (job, type) axis vs the py twin,
+    at mesh 1/2/8 — the death/rejoin churn in _mutate rides along, so
+    the job column survives restamps and membership changes too."""
+    ndev = mesh.devices.size
+    nservers = 2 * ndev if ndev > 4 else 8
+
+    def dist():
+        return DistributedAssignmentSolver(
+            types=TYPES, max_tasks_per_server=12, max_requesters=6,
+            mesh=mesh, rounds=64,
+            servers_per_device=-(-nservers // ndev),
+            max_jobs=MAX_JOBS, job_weights=JOB_WEIGHTS,
+        )
+
+    a = _mk_engine("array", dist(), max_jobs=MAX_JOBS,
+                   job_weights=JOB_WEIGHTS)
+    p = _mk_engine("py", dist(), max_jobs=MAX_JOBS,
+                   job_weights=JOB_WEIGHTS)
+    _drive(a, p, 2000 + ndev, nservers=nservers, J=MAX_JOBS,
+           reweight={1: 1.0, 2: 5.0})
 
 
 def test_no_realloc_and_no_retrace_steady_state():

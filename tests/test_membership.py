@@ -406,6 +406,58 @@ def test_watermark_autoscale_spawns_shard():
     assert ew.master.metrics.value("servers_joined") >= 1.0
 
 
+def test_scale_pending_drained_on_spawner_registration():
+    """A watermark scale-out arriving SPAWNERLESS parks in the
+    single-slot _scale_pending (dedup-collapsed — each new request
+    overwrites, newest wins) and is visible at /fleet; a spawner
+    registering later must service the parked request immediately —
+    the shard joins WITHOUT the trigger having to re-fire."""
+    ew = ElasticWorld(
+        2, 2, [T],
+        cfg=_cfg(elastic_scaleout="auto", elastic_cooldown_s=0.5,
+                 max_malloc_per_server=8 * 1024, mem_soft_frac=0.5),
+    )
+    master = ew.master
+    spawner = master.member_spawner
+    master.member_spawner = None  # the harness has not registered yet
+    payload = b"x" * 512
+    go = threading.Event()
+
+    def storm(ctx):
+        for _ in range(24):
+            ctx.put(payload, T)
+        go.wait(60)
+        return []
+
+    ew.run_app(0, storm)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if master._scale_pending is not None:
+            break
+        time.sleep(0.05)
+    assert master._scale_pending is not None, "request never parked"
+    doc = master.fleet_doc()
+    assert doc["scale_pending"]["reason"] == "mem_watermark"
+    # dedup-collapse: a second spawnerless request overwrites the slot
+    master._request_scale_out("manual_probe", hot_rank=None)
+    assert master._scale_pending["reason"] == "manual_probe"
+    nservers = len(ew.servers)
+    # registration drains the parked slot synchronously...
+    master.member_spawner = spawner
+    assert master._scale_pending is None
+    # ...and the shard actually joins, with no trigger re-firing
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if len(ew.servers) > nservers:
+            break
+        time.sleep(0.05)
+    assert len(ew.servers) > nservers, "parked request never serviced"
+    ew.run_app(1, lambda ctx: _consume(ctx, pace=0))
+    go.set()
+    ew.finish(timeout=90)
+    assert ew.master.metrics.value("servers_joined") >= 1.0
+
+
 def test_autoscale_config_validation():
     with pytest.raises(ValueError):
         Config(elastic_scaleout="sideways")

@@ -411,6 +411,59 @@ def test_two_concurrent_jobs_independent_termination():
     assert sorted(by_job[2]) == [200 + i for i in range(8)]
 
 
+def test_multi_job_planned_path_cross_server_zero_rfr():
+    """PR 19 multi-job planning, end to end: two weighted jobs' units
+    produced home-routed onto one server reach consumers parked on the
+    other purely through the snapshot -> solve -> ship path — both jobs
+    complete exactly, and no server ever fires the qmstat/RFR fallback
+    (planned namespaces are the balancer's, id >= balancer_max_jobs
+    keeps the pull)."""
+    from adlb_tpu.runtime.membership import ElasticWorld
+
+    cfg = Config(balancer="tpu", balancer_max_jobs=3,
+                 job_weights={2: 4.0}, put_routing="home",
+                 exhaust_check_interval=0.2)
+    ew = ElasticWorld(3, 2, [T], cfg=cfg, timeout=90.0)
+
+    def producer(ctx):
+        rc, ja = ctx.submit_job("heavy")
+        assert (rc, ja) == (ADLB_SUCCESS, 1)
+        rc, jb = ctx.submit_job("light")
+        assert (rc, jb) == (ADLB_SUCCESS, 2)
+        for jid in (1, 2):
+            ctx.attach(jid)
+            for i in range(6):
+                rc = ctx.put(struct.pack("<q", 100 * jid + i), T)
+                assert rc == ADLB_SUCCESS
+        ctx.drain_job(1)
+        ctx.drain_job(2)
+        return ("prod",)
+
+    def consumer(jid):
+        def app(ctx):
+            time.sleep(0.3)  # let the submits land (ids deterministic)
+            ctx.attach(jid)
+            got = []
+            while True:
+                rc, w = ctx.get_work([T])
+                if rc != ADLB_SUCCESS:
+                    return (jid, rc, got)
+                got.append(struct.unpack("<q", w.payload)[0])
+        return app
+
+    ew.run_app(0, producer)
+    ew.run_app(1, consumer(1))
+    ew.run_app(2, consumer(2))
+    res = ew.finish(timeout=90)
+    for jid in (1, 2):
+        row = res[jid]
+        assert row[1] == ADLB_DONE_BY_EXHAUSTION
+        assert sorted(row[2]) == [100 * jid + i for i in range(6)]
+    assert sum(
+        s.metrics.value("rfrs") for s in ew.servers.values()
+    ) == 0, "a planned namespace took the RFR fallback"
+
+
 def test_job_quota_backpressures_one_tenant_not_the_other():
     """Job A (tiny per-server quota) is backpressured at its watermark
     while job B keeps accepting puts unimpeded — per-tenant admission."""
