@@ -904,20 +904,23 @@ class OpsServer:
         return s.ctl_request({"op": action, "job_id": jid})
 
 
-def maybe_start(server, cfg) -> Optional[OpsServer]:
+def maybe_start(server, cfg, port=None) -> Optional[OpsServer]:
     """Start the ops endpoint iff this server is the master and a port is
-    configured. Bind failures degrade to a warning — observability must
-    never take the data plane down with it."""
-    if not server.is_master or cfg.ops_port is None:
+    configured. ``port`` overrides ``cfg.ops_port`` — a promoted deputy
+    rebinds on an ephemeral port (0) because the dead master's HTTP
+    thread may still hold the configured one. Bind failures degrade to a
+    warning — observability must never take the data plane down with it."""
+    p = cfg.ops_port if port is None else port
+    if not server.is_master or p is None:
         return None
     try:
-        return OpsServer(server, cfg.ops_port).start()
+        return OpsServer(server, p).start()
     except OSError as e:
         import sys
 
         print(
             f"[adlb ops] could not bind ops endpoint on port "
-            f"{cfg.ops_port}: {e!r}; continuing without it",
+            f"{p}: {e!r}; continuing without it",
             file=sys.stderr,
         )
         return None

@@ -151,6 +151,11 @@ class Client:
         # holds out for the takeover note.
         self._srv_route: dict[int, int] = {}
         self._fo_epoch = 0
+        # master succession: TA_HOME_TAKEOVER notes for a dead MASTER
+        # carry new_master (the promoted deputy); job control and detach
+        # re-point through _master(). None = the spec's static master.
+        # Per-instance on purpose — in-proc clients SHARE the WorldSpec.
+        self._master_rank: Optional[int] = None
         # elastic membership: True once this rank cleanly detached (a
         # detached rank's finalize is a no-op); attached_member marks a
         # rank that JOINED a running world (membership.attach_app)
@@ -1185,6 +1190,11 @@ class Client:
             f"home_takeover dead={dead} buddy={buddy} epoch={epoch}"
         )
         home_moved = self._route(self.home) != old_home
+        # master succession rides the same note: the promoted deputy
+        # stamps new_master so job control / detach re-point to it
+        nm = m.data.get("new_master")
+        if nm is not None:
+            self._master_rank = int(nm)
         # pipelined puts parked on the dead server's ack: re-send (same
         # put_id — the replicated per-sender window makes this idempotent
         # when the original was accepted before the death)
@@ -1378,12 +1388,19 @@ class Client:
 
     # -- job control plane (service mode) ------------------------------------
 
+    def _master(self) -> int:
+        """The CURRENT master: the promoted deputy once a
+        TA_HOME_TAKEOVER note stamped new_master, else the spec's."""
+        if self._master_rank is not None:
+            return self._master_rank
+        return self.world.master_server_rank
+
     def _job_ctl(self, op: str, job_id: int = 0, name: str = "",
                  quota_bytes: int = 0, dest=None) -> Msg:
         """One FA_JOB_CTL round trip: attach goes to the HOME server
         (which owns this rank's exhaustion vote); submit/drain/kill/
         status go to the MASTER (which owns the job table and fan-out)."""
-        dest = self.world.master_server_rank if dest is None else dest
+        dest = self._master() if dest is None else dest
         fields = dict(op=op, job_id=job_id)
         if name:
             fields["job_name"] = name
@@ -1412,7 +1429,7 @@ class Client:
                     self._active_stream = None
             if self._pending_puts:
                 self.flush_puts()
-            master = self.world.master_server_rank
+            master = self._master()
             pm = msg(Tag.FA_MEMBER, self.rank, mop="detach")
             self._send_retry(master, pm)
             resp = self._wait(Tag.TA_MEMBER_RESP, dest=master, m_req=pm)

@@ -187,6 +187,10 @@ WIRE_TAG: dict[Tag, int] = {
     # the id exists so the codec table stays total and a native plane
     # could one day join the protocol)
     Tag.SS_MEMBER: 1141,
+    # master failover (on_server_failure="failover"; python-only —
+    # master succession fan-out from the promoted deputy, appended to
+    # the registry like every wire change)
+    Tag.SS_MASTER_TAKEOVER: 1142,
     # shm-fabric pair announcement (rides the TCP plane once per
     # connected pair; swallowed by the transport reader)
     Tag.SHM_HELLO: 1998,
@@ -370,6 +374,11 @@ FIELDS: dict[str, tuple[int, int]] = {
     # stay byte-identical; native daemons parse-and-ignore it (the
     # native plane advertises only the default namespace today).
     "jobs": (106, _KIND_LIST),
+    # master failover (SS_MASTER_TAKEOVER, wire tag 1142): the promoted
+    # deputy's rank. Rides the succession fan-out (and the extended
+    # TA_HOME_TAKEOVER note) alongside the reused epoch/mop/host/port/
+    # member_tok ids above. Append-only; native daemons parse-and-ignore.
+    "new_master": (107, _KIND_I64),
 }
 FIELD_FOR_WIRE = {v[0]: (k, v[1]) for k, v in FIELDS.items()}
 

@@ -34,7 +34,15 @@ function of ``(seed, rank, outbound frame number)``:
   marked work type dies on the spot (SIGKILL), the deterministic
   poison-unit: the reserve leaves a lease behind, reclaim re-enqueues
   the unit, and it serially kills every worker that touches it until a
-  retry budget (``Config(max_unit_retries)``) quarantines it.
+  retry budget (``Config(max_unit_retries)``) quarantines it;
+* ``partition`` — ASYMMETRIC one-way partition: frames from ``src`` to
+  ``dst`` on each listed ``(src, dst)`` pair are silently dropped while
+  the reverse direction (and every connection) stays up — the gray link
+  where A can hear B but B never hears A, which ack barriers and death
+  ladders must survive without a raced verdict. Schedulable at a frame
+  (``at_frame``), at a wall-clock offset (``at``), immediately (neither),
+  or mid-run via :meth:`FaultPlan.partition_now` / ``heal_now``; bounded
+  by ``for_s`` (0 = until healed).
 
 Probabilistic faults (drop/delay/duplicate) draw from a per-rank
 ``random.Random`` in frame order, so the injected-event log — a list of
@@ -67,6 +75,8 @@ KILL = "kill"
 STALL = "stall"
 RESUME = "resume"
 POISON = "poison"
+PARTITION = "partition"
+HEAL = "heal"
 
 
 def _mix(seed: int, rank: int) -> int:
@@ -107,6 +117,20 @@ class FaultPlan:
         # stall duration; 0 = stalled forever (the never-resuming hang)
         self.stall_for_s = float(spec.get("stall_for_s", 0.0) or 0.0)
         self.poison_types = frozenset(spec.get("poison_types") or ())
+        # asymmetric one-way partition: {"pairs": [[src, dst], ...],
+        # "at_frame": N | "at": seconds | neither (immediate),
+        # "for_s": duration (0 = until healed)}. Only this rank's
+        # OUTBOUND legs matter to this plan — the reverse direction is
+        # the other rank's plan (or flows freely: that is the asymmetry).
+        part = dict(spec.get("partition") or {})
+        self._part_sched = [
+            (int(p[0]), int(p[1]))
+            for p in (part.get("pairs") or ())
+            if int(p[0]) == rank
+        ]
+        self.part_at_frame = int(part.get("at_frame", 0) or 0)
+        self.part_at = float(part.get("at", 0.0) or 0.0)
+        self.part_for_s = float(part.get("for_s", 0.0) or 0.0)
         self.log_dir = spec.get("log_dir") or os.environ.get(
             "ADLB_FAULT_LOG_DIR"
         )
@@ -121,6 +145,14 @@ class FaultPlan:
         # re-stall on its next frame)
         self.stalled_until: Optional[float] = None
         self._stall_done = False
+        # active one-way drops (src is always this rank) + expiry; the
+        # frame/timer trigger fires once, explicit partition_now re-arms
+        self._part_pairs: set[tuple[int, int]] = set()
+        self._part_until: Optional[float] = None
+        self._part_done = False
+        if self._part_sched and not self.part_at_frame and not self.part_at:
+            # no trigger given: the partition exists from frame one
+            self._begin_partition_locked(0)
 
     # -- decisions -----------------------------------------------------------
 
@@ -152,6 +184,14 @@ class FaultPlan:
             ):
                 self._begin_stall_locked(n, m.tag.name, dest)
                 return STALL
+            if (
+                self.part_at_frame
+                and n >= self.part_at_frame
+                and not self._part_done
+            ):
+                self._begin_partition_locked(n)
+            if self._partitioned_locked(n, m.tag.name, dest):
+                return PARTITION
             if not self.active:
                 return ""
             # one draw per probabilistic knob per frame, in fixed order:
@@ -212,6 +252,73 @@ class FaultPlan:
         with self._lock:
             return self._stalled_locked(self.frame, "<recv>", -1)
 
+    # -- asymmetric partition (one-way gray link) ----------------------------
+
+    def _begin_partition_locked(self, frame: int) -> None:
+        self._part_pairs = set(self._part_sched)
+        self._part_until = (
+            time.monotonic() + self.part_for_s
+            if self.part_for_s > 0
+            else float("inf")
+        )
+        self._part_done = True
+        self.events.append((frame, PARTITION, "<engage>", -1))
+        self._flush_log()
+
+    def _partitioned_locked(self, frame: int, tag: str, dest: int) -> bool:
+        """Is the (self.rank -> dest) leg inside an active one-way drop?
+        Heals (recording HEAL) the first time it is consulted past a
+        bounded window's end — the reverse direction was never touched,
+        so only the send-side decision needs the check."""
+        if not self._part_pairs:
+            return False
+        if (
+            self._part_until is not None
+            and time.monotonic() >= self._part_until
+        ):
+            self._part_pairs = set()
+            self._part_until = None
+            self.events.append((frame, HEAL, tag, dest))
+            return False
+        if (self.rank, dest) in self._part_pairs:
+            self.events.append((frame, PARTITION, tag, dest))
+            return True
+        return False
+
+    def partition_now(self, pairs=None) -> None:
+        """Engage (or extend) a one-way partition immediately: outbound
+        frames on each ``(src, dst)`` pair are silently dropped while
+        every connection stays up — peers observe no EOF, only one-way
+        silence. ``pairs`` defaults to the spec's schedule; explicit
+        calls RE-ARM and may swap the pair set, so a test can drive a
+        partition mid-run (e.g. isolate the deputy from the master's
+        acks during a takeover barrier) and later :meth:`heal_now` it."""
+        with self._lock:
+            add = (
+                self._part_sched
+                if pairs is None
+                else [(int(p[0]), int(p[1])) for p in pairs]
+            )
+            self._part_pairs |= {p for p in add if p[0] == self.rank}
+            self._part_until = (
+                time.monotonic() + self.part_for_s
+                if self.part_for_s > 0
+                else float("inf")
+            )
+            self.events.append((self.frame, PARTITION, "<engage>", -1))
+            self._flush_log()
+
+    def heal_now(self) -> None:
+        """Drop every active one-way partition leg: subsequent frames
+        flow again (nothing buffered — a partitioned frame is LOST, as
+        on a real lossy link, unlike a stall's kernel-buffer flush)."""
+        with self._lock:
+            if self._part_pairs:
+                self._part_pairs = set()
+                self._part_until = None
+                self.events.append((self.frame, HEAL, "<heal>", -1))
+                self._flush_log()
+
     # -- log -----------------------------------------------------------------
 
     def event_log(self) -> list[tuple[int, str, str, int]]:
@@ -248,7 +355,7 @@ class FaultyEndpoint:
     """
 
     _OWN = ("_ep", "plan", "rank", "_contacted", "_killer", "_staller",
-            "_stall_buf")
+            "_stall_buf", "_parter")
 
     def __init__(self, ep, plan: FaultPlan) -> None:
         object.__setattr__(self, "_ep", ep)
@@ -267,6 +374,12 @@ class FaultyEndpoint:
             t = threading.Timer(plan.stall_at, plan.stall_now)
             t.daemon = True
             object.__setattr__(self, "_staller", t)
+            t.start()
+        object.__setattr__(self, "_parter", None)
+        if plan.part_at > 0 and plan._part_sched:
+            t = threading.Timer(plan.part_at, plan.partition_now)
+            t.daemon = True
+            object.__setattr__(self, "_parter", t)
             t.start()
 
     def __getattr__(self, name):
@@ -371,6 +484,8 @@ class FaultyEndpoint:
             with self.plan._lock:  # vs a concurrent resume's buffer swap
                 self._stall_buf.append((dest, m, kw))
             return
+        if act == PARTITION:
+            return  # one-way lost frame: connection alive, no buffering
         self._flush_stalled()  # a resume flushes before new traffic
         if act == DROP:
             return
@@ -409,8 +524,10 @@ def resolve_spec(spec: dict, world) -> dict:
     are keyed by SERVER INDEX (0 = the master, i = the i-th server rank)
     so a spec need not hard-code the world shape; with a ``world`` they
     translate into the corresponding ``kill_at_frame`` / ``kill_at`` /
-    ``disconnect_at`` world-rank entries. Idempotent and copy-on-write —
-    the input spec is never mutated."""
+    ``disconnect_at`` world-rank entries. A ``partition`` spec's
+    ``server_pairs`` translate the same way into world-rank ``pairs``
+    (one-way: ``[0, 1]`` drops master->server1 only). Idempotent and
+    copy-on-write — the input spec is never mutated."""
     if world is None or not spec:
         return spec
     pairs = (
@@ -420,9 +537,25 @@ def resolve_spec(spec: dict, world) -> dict:
         ("stall_server_at_frame", "stall_at_frame"),
         ("stall_server_at", "stall_at"),
     )
-    if not any(spec.get(sk) for sk, _ in pairs):
+    part_srv = (dict(spec.get("partition") or {})).get("server_pairs")
+    if not any(spec.get(sk) for sk, _ in pairs) and not part_srv:
         return spec
     out = dict(spec)
+    if part_srv:
+        part = dict(out["partition"])
+        rank_pairs = [list(p) for p in (part.get("pairs") or ())]
+        for a, b in part.pop("server_pairs"):
+            for i in (int(a), int(b)):
+                if not (0 <= i < world.nservers):
+                    raise ValueError(
+                        f"partition server_pairs: server index {i} "
+                        f"outside 0..{world.nservers - 1}"
+                    )
+            rank_pairs.append([
+                world.num_app_ranks + int(a), world.num_app_ranks + int(b),
+            ])
+        part["pairs"] = rank_pairs
+        out["partition"] = part
     for srv_key, rank_key in pairs:
         by_idx = out.pop(srv_key, None)
         if not by_idx:

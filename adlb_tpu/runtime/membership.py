@@ -115,6 +115,12 @@ class MemberView:
         # cleanly departed app ranks (detach): no longer members, but
         # remembered so a late frame/EOF from one is ignorable
         self.detached: set[int] = set()
+        # master succession (on_server_failure="failover" covering the
+        # master): None means the spec's static master — the common
+        # case, and the snapshot() byte-identity case. Set (with the
+        # epoch of the promotion) when a deputy takes over.
+        self._master_rank: Optional[int] = None
+        self._master_epoch = 0
 
     @classmethod
     def of(cls, world) -> "MemberView":
@@ -150,6 +156,26 @@ class MemberView:
             return self.spec.app_ranks
         base = [r for r in self.spec.app_ranks if r not in self.detached]
         return base + [r for r in self.extra_apps if r not in self.detached]
+
+    @property
+    def master_server_rank(self) -> int:
+        """The CURRENT master: the spec's static choice until a master
+        failover promoted a deputy (set_master). Everything that
+        addresses 'the master' — job control, attach RPCs, obs gossip,
+        exhaustion init — reads this dynamically."""
+        if self._master_rank is not None:
+            return self._master_rank
+        return self.spec.master_server_rank
+
+    def set_master(self, rank: int, epoch: int = 0) -> None:
+        """Master succession (SS_MASTER_TAKEOVER): epoch-guarded, so a
+        late frame from an older succession can never roll the fleet
+        back to a dead brain."""
+        if self._master_rank is not None and epoch < self._master_epoch:
+            return
+        self._master_rank = rank
+        self._master_epoch = epoch
+        self.note_epoch(epoch)
 
     def is_server(self, rank: int) -> bool:
         return self.spec.is_server(rank) or rank in self.extra_servers
@@ -215,12 +241,18 @@ class MemberView:
 
     def snapshot(self) -> dict:
         """The seed a newly attached member receives in TA_MEMBER_RESP."""
-        return {
+        snap = {
             "epoch": self.epoch,
             "extra_apps": dict(self.extra_apps),
             "extra_servers": list(self.extra_servers),
             "detached": sorted(self.detached),
         }
+        if self._master_rank is not None:
+            # only after a succession: a never-failed-over world's
+            # snapshot stays byte-identical to pre-succession builds
+            snap["master"] = self._master_rank
+            snap["master_epoch"] = self._master_epoch
+        return snap
 
     def seed(self, snap: dict) -> None:
         self.extra_apps.update(snap.get("extra_apps") or {})
@@ -228,6 +260,11 @@ class MemberView:
             if s not in self.extra_servers and not self.spec.is_server(s):
                 self.extra_servers.append(s)
         self.detached.update(snap.get("detached") or ())
+        m = snap.get("master")
+        if m is not None:
+            self.set_master(
+                int(m), int(snap.get("master_epoch", 0) or 0)
+            )
         self.note_epoch(snap.get("epoch", 0) or 0)
 
 
@@ -296,7 +333,9 @@ def attach_app(
         )
     base = world.spec if isinstance(world, MemberView) else world
     prov = provisional_rank()
-    master = base.master_server_rank
+    # the CURRENT master: after a master failover a MemberView resolves
+    # the promoted deputy — a joiner dialing the corpse would time out
+    master = world.master_server_rank
     if fabric is not None:
         ep = fabric.add_endpoint(prov)
         fields = dict(mop="attach", kind="app")
@@ -370,7 +409,7 @@ def attach_server(
         raise AdlbError("elastic scale-out requires python servers")
     base = world.spec if isinstance(world, MemberView) else world
     prov = provisional_rank()
-    master = base.master_server_rank
+    master = world.master_server_rank  # succession-aware (MemberView)
     if fabric is not None:
         ep = fabric.add_endpoint(prov)
         fields = dict(mop="attach", kind="server")
@@ -497,6 +536,17 @@ class ElasticWorld:
         self.master = self.servers[self.world.master_server_rank]
         self.master.member_spawner = self._spawn_server
 
+    @property
+    def current_master(self):
+        """The server currently holding the master role. After a master
+        failover the static ``self.master`` is a corpse; anything that
+        polls 'the master' (scale_out readiness, ctl asks) must resolve
+        the live brain instead."""
+        for s in self.servers.values():
+            if s.is_master and not s.done and not s.died:
+                return s
+        return self.master
+
     # -- server plumbing ------------------------------------------------------
 
     def _server_main(self, rank, server) -> None:
@@ -583,7 +633,10 @@ class ElasticWorld:
     def attach_ctx(self):
         """Attach a new dynamic rank; returns the JoinedWorld handle
         (use as a context manager, or call .ctx / detach explicitly)."""
-        jw = attach_app(self.world, self.cfg, fabric=self.fabric,
+        # dial through the live brain's MemberView: after a master
+        # failover the static spec names a corpse
+        jw = attach_app(self.current_master.world, self.cfg,
+                        fabric=self.fabric,
                         abort_event=self.fabric.abort_event)
         self._attached.append(jw)
         return jw
@@ -607,11 +660,13 @@ class ElasticWorld:
     def scale_out(self, timeout: float = 30.0) -> int:
         """Spawn + attach + bootstrap one new server shard; returns its
         rank once the master has seen it ready."""
-        before = set(self.master.world.extra_servers)
-        self.master.ctl_request({"op": "scale_out"}, timeout=10.0)
+        master = self.current_master
+        before = set(master.world.extra_servers)
+        master.ctl_request({"op": "scale_out"}, timeout=10.0)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            ready = getattr(self.master, "_member_ready", set())
+            master = self.current_master
+            ready = getattr(master, "_member_ready", set())
             new = [s for s in ready if s not in before]
             if new:
                 return new[0]
@@ -625,7 +680,7 @@ class ElasticWorld:
         req = {"op": "scale_in"}
         if rank is not None:
             req["rank"] = rank
-        res = self.master.ctl_request(req, timeout=10.0)
+        res = self.current_master.ctl_request(req, timeout=10.0)
         drained = res["rank"]
         t = self._server_threads.get(drained)
         if t is not None:

@@ -158,8 +158,16 @@ class Tag(enum.Enum):
     SS_SERVER_DEAD = enum.auto()
     # TA_HOME_TAKEOVER — buddy -> app ranks: epoch-stamped remap (dead
     # server -> this server); clients reroute handles, common fetches,
-    # round-robin puts, and their home-server traffic
+    # round-robin puts, and their home-server traffic. When the dead
+    # server was the MASTER the note also carries new_master (the
+    # promoted deputy), so clients re-point job control and detach.
     TA_HOME_TAKEOVER = enum.auto()
+    # SS_MASTER_TAKEOVER — promoted deputy -> servers: epoch-stamped
+    # master succession (new_master, epoch, the rebound ops endpoint's
+    # host/port) behind a member_tok ack barrier; exhaustion/END
+    # verdicts defer until the barrier resolves so no termination
+    # verdict races the succession. Append-only wire tag (1142).
+    SS_MASTER_TAKEOVER = enum.auto()
 
     # balancer (TPU path; no reference analogue — replaces qmstat+RFR)
     SS_STATE = enum.auto()

@@ -81,6 +81,18 @@ OP_TRACE = 20
 # same seqno supersedes the mark (the race dissolved and the survivor
 # became an ordinary unit). Append-only, as above.
 OP_HEDGE = 21
+# master brain state (master failover): the master-only durable control
+# plane streams to the master's ring buddy — the standing DEPUTY — over
+# the same plane, so promotion rebuilds a fully functioning brain
+# without a cold start. Append-only like everything above; a non-master
+# primary never emits these, so unconfigured worlds stay frame-identical.
+OP_MEMBER = 22     # membership snapshot: epoch, master rank, provisional
+#                    watermark, retired srv-route map, addrs, live/ready/
+#                    dead/drained sets, ops-armed flag (newest wins)
+OP_SLO = 23        # one live SLO objective doc (POST /slo; keyed by name)
+OP_CONTROL = 24    # controller policy doc (POST /control; newest wins)
+OP_SCALE = 25      # parked scale request, or its clearing (newest wins)
+OP_JOB_WEIGHT = 26  # a job's fair-share weight changed (job id + f64)
 
 _HDR = struct.Struct("<BI")       # op, body length
 _SEQ = struct.Struct("<q")        # one seqno
@@ -89,6 +101,7 @@ _SEQ3 = struct.Struct("<qqq")     # seqno + src + request id (common ops)
 # seqno, src, put_id, pinned(pin_rank|-1), attempts, job
 _PUTHDR = struct.Struct("<qqqiii")
 _JOBHDR = struct.Struct("<qqB")   # job id, quota bytes, state code
+_JOBW = struct.Struct("<qd")      # job id, fair-share weight
 
 # flush the buffered log at this many entries even mid-pass
 MAX_BUFFER = 256
@@ -226,6 +239,42 @@ class ReplicationLog:
     def log_rank_dead(self, rank: int) -> None:
         self._append(OP_RANK_DEAD, _SEQ.pack(rank))
 
+    # -- master brain state (deputy stream) ----------------------------------
+    # Bodies are pickled dicts: these are rare control-plane events (a
+    # membership change, an operator POST), not per-unit hot-path ops,
+    # and SS_REPL bodies are opaque blobs end to end.
+
+    def log_member(self, doc: dict) -> None:
+        """Full membership/brain snapshot, newest wins (epoch, master
+        rank, provisional-id watermark, retired-route map, addrs,
+        live/ready/dead/drained sets, ops-armed flag)."""
+        import pickle
+
+        self._append(OP_MEMBER, pickle.dumps(doc, protocol=4))
+
+    def log_slo(self, doc: dict) -> None:
+        """One live SLO objective (the POST /slo body after engine
+        normalization), keyed by name at the mirror."""
+        import pickle
+
+        self._append(OP_SLO, pickle.dumps(doc, protocol=4))
+
+    def log_control(self, policy: dict) -> None:
+        """The controller policy doc (POST /control), newest wins."""
+        import pickle
+
+        self._append(OP_CONTROL, pickle.dumps(policy, protocol=4))
+
+    def log_scale(self, parked) -> None:
+        """The parked scale request (spawnerless scale-out), or None
+        when the park is serviced/cleared. Newest wins."""
+        import pickle
+
+        self._append(OP_SCALE, pickle.dumps(parked, protocol=4))
+
+    def log_job_weight(self, job_id: int, weight: float) -> None:
+        self._append(OP_JOB_WEIGHT, _JOBW.pack(job_id, weight))
+
     def log_seen_puts(self, src: int, put_ids) -> None:
         """Re-bootstrap: ship a sender's whole accepted-put window so a
         put acked by THIS server and re-sent after its death is answered
@@ -289,6 +338,15 @@ class ReplicaMirror:
         # job-namespace lifecycle: job id -> (state_code, quota, name);
         # replayed into the taker-over's / restarted server's job table
         self.jobs_meta: dict[int, tuple[int, int, str]] = {}
+        # master brain state (deputy stream): only populated when the
+        # primary is the master under failover. ``brain`` is the newest
+        # OP_MEMBER snapshot; slo docs are keyed by objective name;
+        # weights by job id; policy / scale_pending are newest-wins.
+        self.brain: Optional[dict] = None
+        self.slo_docs: dict[str, dict] = {}
+        self.control_policy: Optional[dict] = None
+        self.scale_pending = None
+        self.job_weights: dict[int, float] = {}
         self.entries_applied = 0
         self.frames_applied = 0
         self.sealed = False
@@ -443,6 +501,28 @@ class ReplicaMirror:
             sib, origin = _SEQ2.unpack(body)
             if sib in self.units:
                 self.hedges[sib] = origin
+        elif op == OP_MEMBER:
+            import pickle
+
+            self.brain = pickle.loads(body)
+        elif op == OP_SLO:
+            import pickle
+
+            doc = pickle.loads(body)
+            name = str(doc.get("name", ""))
+            if name:
+                self.slo_docs[name] = doc
+        elif op == OP_CONTROL:
+            import pickle
+
+            self.control_policy = pickle.loads(body)
+        elif op == OP_SCALE:
+            import pickle
+
+            self.scale_pending = pickle.loads(body)
+        elif op == OP_JOB_WEIGHT:
+            job_id, weight = _JOBW.unpack(body)
+            self.job_weights[job_id] = weight
         # unknown ops are skipped by construction (op byte + length frame)
 
     def seal(self) -> None:

@@ -353,6 +353,18 @@ class Server:
             from adlb_tpu.runtime import replica
 
             self.repl = replica.ReplicationLog(world.ring_next(self.rank))
+        # ---- master failover (the brain survives its own death) ----
+        # The master's ring buddy is the standing DEPUTY: the master's
+        # durable control-plane state (membership/epoch/watermark, live
+        # SLO objectives, controller policy, parked scale requests, job
+        # weights) rides the SAME replication stream as the pool shard,
+        # so a promoted deputy is a fully functioning brain. Succession
+        # fans SS_MASTER_TAKEOVER behind an ack barrier (same shape as
+        # the membership barrier): exhaustion/END verdicts defer while
+        # it is open, so no termination verdict races the new epoch.
+        # Plain attrs only — an unconfigured world mints no counters.
+        self._takeover_tok = 0
+        self._takeover_pending: Optional[dict] = None
 
         # ---- durable service mode (Config(wal_dir), runtime/wal.py) ----
         # the replica op stream teed to an append-only on-disk log with
@@ -494,7 +506,14 @@ class Server:
         }
 
         # stealing state
-        self._rfr_out: set[int] = set()  # ranks with an outstanding RFR
+        # ranks with an outstanding RFR -> send time. The timestamp is
+        # the loss-recovery hook: an SS_RFR (or its response) eaten by a
+        # one-way partition or a dying link would otherwise hide the
+        # requester from every later match pass forever — _periodic
+        # re-arms entries older than _rfr_timeout (stray late responses
+        # are already handled by the rqseqno match in _on_rfr_resp)
+        self._rfr_out: dict[int, float] = {}
+        self._rfr_timeout = max(5.0, 20.0 * cfg.qmstat_interval)
         self._rfr_excluded: dict[int, set[int]] = {}  # rank -> servers struck out
         # remote fused fetch: units whose payload left in a
         # payload-carrying SS_RFR_RESP but whose SS_DELIVERED/UNRESERVE
@@ -899,6 +918,7 @@ class Server:
             Tag.SS_COMMON_FORFEIT: self._on_common_forfeit,
             Tag.SS_REPL: self._on_repl,
             Tag.SS_SERVER_DEAD: self._on_server_dead,
+            Tag.SS_MASTER_TAKEOVER: self._on_master_takeover,
             Tag.SS_OBS_SYNC: self._on_obs_sync,
         }
 
@@ -937,6 +957,18 @@ class Server:
                         self.cfg.aprintf_flag, self.rank,
                         f"ops endpoint on 127.0.0.1:{self.ops.port}",
                     )
+                    self._announce_ops_endpoint()
+            # standing deputy bootstrap: the master's FIRST replication
+            # flush already carries the brain, so a death at any point
+            # after startup finds a promotable deputy (the config-borne
+            # SLO/control state rides it; live POSTs stream deltas)
+            if self.is_master and self._failover and self.repl is not None:
+                self._repl_brain()
+                if self._slo_engine is not None:
+                    for o in self._slo_engine.objectives:
+                        self.repl.log_slo(dict(o))
+                if self._controller is not None:
+                    self.repl.log_control(self._controller.policy_doc())
             if self.cfg.profile_hz > 0:
                 # per-PROCESS singleton: in-proc worlds run many server
                 # threads in one interpreter and the sampler sees them
@@ -1155,6 +1187,7 @@ class Server:
         if (
             self.is_master and self._end1_pending and not self.done
             and not self._aborted and not self._member_pending
+            and not self._takeover_pending
             and self._finalized >= self.local_apps
             and now - self._end1_sent_at
             > 10 * self.cfg.exhaust_check_interval
@@ -1166,6 +1199,33 @@ class Server:
             self._forward_end1(
                 {"origin": self.rank, "epoch": self.world.epoch}
             )
+        if (
+            self._takeover_pending
+            and now >= self._takeover_pending["deadline"]
+        ):
+            # succession barrier timeout: a wedged survivor must not
+            # park termination forever — it is on its way to an EOF-
+            # declared death, which releases the barrier anyway
+            self.flight.record(
+                "master takeover barrier timeout unacked="
+                f"{sorted(self._takeover_pending['need'])}"
+            )
+            self._master_takeover_done()
+        if self._rfr_out:
+            # RFR loss recovery: a request (or its response) lost to a
+            # one-way partition / dying link has no acker — re-arm the
+            # requester and re-match immediately instead of hiding it
+            # from the balancer until the end of time
+            stale = [
+                r for r, t0 in self._rfr_out.items()
+                if now - t0 > self._rfr_timeout
+            ]
+            for r in stale:
+                del self._rfr_out[r]
+                self.flight.record(f"rfr timeout for rank {r}: re-armed")
+            for entry in self.rq.entries() if stale else ():
+                if entry.world_rank in stale:
+                    self._try_rfr(entry)
         if self._member_pending:
             # membership fan-out/ack barrier timeout: a wedged server
             # must not park a joiner forever. The change already applied
@@ -3098,7 +3158,7 @@ class Server:
     def _send_rfr(
         self, entry: RqEntry, server: int, targeted_lookup: bool, lookup_type: int
     ) -> None:
-        self._rfr_out.add(entry.world_rank)
+        self._rfr_out[entry.world_rank] = time.monotonic()
         self._m_rfrs.inc()
         self.flight.record(
             f"rfr -> server {server} for rank {entry.world_rank} "
@@ -3216,7 +3276,7 @@ class Server:
 
     def _on_rfr_resp(self, m: Msg) -> None:
         app = m.for_rank
-        self._rfr_out.discard(app)
+        self._rfr_out.pop(app, None)
         if not m.found:
             self._n_rfr_failed += 1
         if m.found:
@@ -4327,6 +4387,10 @@ class Server:
         ring confirmation (reference ``src/adlb.c:754-785,1575-1650``)."""
         if self.no_more_work or self.done_by_exhaustion:
             return
+        if self._takeover_pending:
+            # succession mid-barrier: a verdict started now could reach
+            # a server that has not seen the new epoch yet
+            return
         if self.jobs.any_jobs():
             # service mode: once any namespace exists, termination is
             # per-job (_check_job_exhaustion) and the FLEET idles
@@ -4486,10 +4550,12 @@ class Server:
         whose last straggler was a casualty still ends cleanly."""
         if not (self._finalized >= self.local_apps):
             return
-        if self.is_master and self._member_pending:
-            # a membership fan-out is mid-barrier: kicking the END ring
-            # now would stamp an epoch some server has not reached yet.
-            # The barrier's completion re-calls this.
+        if self.is_master and (
+            self._member_pending or self._takeover_pending
+        ):
+            # a membership fan-out or master succession is mid-barrier:
+            # kicking the END ring now would stamp an epoch some server
+            # has not reached yet. The barrier's completion re-calls this.
             return
         held = getattr(self, "_held_end1", None)
         if self._end1_pending and held is not None:
@@ -5551,6 +5617,10 @@ class Server:
                 )
             o = self._slo_engine.add(req.get("objective") or {})
             self.flight.record(f"slo_objective_added {o['name']}")
+            if self._failover and self.repl is not None:
+                # live-POSTed objectives are brain state: without this
+                # the promoted deputy's /slo would silently forget them
+                self.repl.log_slo(dict(o))
             return {"objective": o,
                     "n_objectives": len(self._slo_engine.objectives)}
         if op == "control":
@@ -5569,6 +5639,8 @@ class Server:
                 "control_policy_updated "
                 + " ".join(f"{k}={v}" for k, v in sorted(pol.items()))
             )
+            if self._failover and self.repl is not None:
+                self.repl.log_control(dict(pol))
             return {"policy": pol}
         raise ValueError(f"unknown control op {op!r}")
 
@@ -5629,6 +5701,11 @@ class Server:
             self.flight.record(
                 f"job_weight job={jid} weight={job.weight:g}"
             )
+            if self.is_master and self._failover and self.repl is not None:
+                # fair-share weights don't ride wlog.log_job (state/
+                # quota/name only): stream them so a promoted deputy's
+                # planner starts from the live weight map
+                self.repl.log_job_weight(jid, job.weight)
         if self.wlog is not None:
             self.wlog.log_job(jid, STATE_CODES[job.state],
                               job.quota_bytes, job.name)
@@ -6222,6 +6299,9 @@ class Server:
         self.activity += 1
         self._exhaust_held_since = None
         self._g_epoch.set(self.world.epoch)
+        # master: the deputy's brain mirror tracks every membership
+        # mutation (epoch, watermark, homes, live/drained sets)
+        self._repl_brain()
 
     def _apply_detach(self, rank: int, epoch: int) -> None:
         """A clean lease-draining rank-dead: the rank leaves membership
@@ -6239,7 +6319,7 @@ class Server:
         self.rq.remove_rank(rank)
         self._stream_idle.discard(rank)
         self._swept_streams.discard(rank)
-        self._rfr_out.discard(rank)
+        self._rfr_out.pop(rank, None)
         self._rfr_excluded.pop(rank, None)
         self._park_res_local.pop(rank, None)
         self._seen_rqseqnos.pop(rank, None)
@@ -6539,6 +6619,8 @@ class Server:
         if self._scaleout_t0 is not None or self._member_terminating():
             return
         self._scale_pending = None
+        if self.is_master and self._failover and self.repl is not None:
+            self.repl.log_scale(None)  # the clearing replicates too
         self.flight.record(
             f"scale_pending_drained reason={pending.get('reason')}"
         )
@@ -6559,6 +6641,11 @@ class Server:
                 "reason": reason, "hot_rank": hot_rank,
                 "at": time.time(),
             }
+            if self.is_master and self._failover and self.repl is not None:
+                # a parked request is brain state: the deputy's /fleet
+                # must show it (and its spawner must drain it) after a
+                # takeover
+                self.repl.log_scale(dict(self._scale_pending))
             return {"requested": False, "pending": True}
         self._scaleout_t0 = time.monotonic()
         try:
@@ -6569,6 +6656,8 @@ class Server:
             self._scale_pending = {
                 "reason": reason, "error": repr(e), "at": time.time(),
             }
+            if self.is_master and self._failover and self.repl is not None:
+                self.repl.log_scale(dict(self._scale_pending))
             return {"requested": False, "pending": True,
                     "error": repr(e)}
         return {"requested": True}
@@ -6632,6 +6721,7 @@ class Server:
             })
         return {
             "epoch": w.epoch,
+            "master": w.master_server_rank,
             "nservers_live": sum(
                 1 for s in servers if s["state"] == "live"
             ),
@@ -6688,7 +6778,7 @@ class Server:
         # post-death (post-resurrection) traffic
         self._seen_rqseqnos.pop(rank, None)
         self._stream_idle.discard(rank)
-        self._rfr_out.discard(rank)
+        self._rfr_out.pop(rank, None)
         self._rfr_excluded.pop(rank, None)
         self._park_res_local.pop(rank, None)
         # 2) reclaim leases: pinned-but-unfetched units return to the queue
@@ -7008,6 +7098,40 @@ class Server:
             self.flight.record("replication flush failed (buddy gone?)")
             self._note_server_unreachable(r.buddy)
 
+    def _brain_doc(self) -> dict:
+        """The master-only durable control-plane state, as one pickled
+        snapshot for the deputy's mirror (OP_MEMBER, newest wins). Soft
+        state — merged obs registry, p99 thresholds, alert lifecycle,
+        profiler stacks — is deliberately NOT here: gossip snapshots are
+        cumulative, so the fleet view reconstructs at the new master
+        within one sync interval."""
+        return {
+            "master": self.rank,
+            "epoch": self.world.epoch,
+            "next_rank": self._member_next_rank,
+            "member": self.world.snapshot(),
+            "addrs": dict(self._member_addrs),
+            "live": sorted(self._member_live),
+            "ready": sorted(self._member_ready),
+            "dead": sorted(self._dead_servers),
+            "drained": sorted(self._drained_servers),
+            "srv_route": self._member_srv_route(),
+            "job_next_id": self._job_next_id,
+            # whether this world is observed: the deputy has ops_port
+            # stripped from its own cfg (scale-out shards) or may share
+            # the port in-proc — promotion rebinds ephemeral when armed
+            "ops_armed": self.cfg.ops_port is not None or (
+                self.ops is not None
+            ),
+        }
+
+    def _repl_brain(self) -> None:
+        """Master: stream the brain snapshot to the deputy. Called on
+        every membership/route mutation; a non-master (or unconfigured)
+        world never emits these, keeping frame identity."""
+        if self.is_master and self._failover and self.repl is not None:
+            self.repl.log_member(self._brain_doc())
+
     def _rebootstrap_repl(self, new_buddy: int) -> None:
         """Our buddy died: re-target the replication stream at the next
         live successor, seeding it with a full-state bootstrap (the
@@ -7064,6 +7188,20 @@ class Server:
         for src, (_ids, order) in self._seen_forfeits.items():
             for fid in order:
                 r.log_common_op(-1, "forfeit", src, fid)
+        if self.is_master:
+            # the new buddy is the new DEPUTY: bootstrap the whole brain
+            # (the per-event streaming below only ships changes)
+            r.log_member(self._brain_doc())
+            if self._slo_engine is not None:
+                for o in self._slo_engine.objectives:
+                    r.log_slo(dict(o))
+            if self._controller is not None:
+                r.log_control(self._controller.policy_doc())
+            if self._scale_pending is not None:
+                r.log_scale(dict(self._scale_pending))
+            for j in self.jobs.values():
+                if j.weight != 1.0:
+                    r.log_job_weight(j.job_id, j.weight)
         self.repl = r
         self._refresh_wlog()
         self.flight.record(
@@ -7083,12 +7221,11 @@ class Server:
     # -- death detection & fan-out ------------------------------------------
 
     def _can_failover(self, dead: int) -> bool:
-        """Only a NON-master server with a live buddy candidate can fail
-        over; the master (balancer brain, exhaustion/END initiator) and
-        the no-live-peer case still abort."""
+        """A server with a live buddy candidate can fail over — the
+        MASTER included: its ring buddy is the standing deputy, holding
+        the replicated brain (see _promote_master). Only the no-live-
+        peer case (last pair dying together) still aborts."""
         if not self._failover:
-            return False
-        if dead == self.world.master_server_rank:
             return False
         from adlb_tpu.runtime import replica
 
@@ -7162,12 +7299,12 @@ class Server:
         # counts no losses and the death-vs-drain metrics split
         clean = bool(m.data.get("clean")) or dead in self._clean_retire
         if not clean and not self._can_failover(dead):
-            # master death, or no live buddy left: unrecoverable
+            # no live buddy left (the last pair died together, or the
+            # policy is off): unrecoverable
             aprintf(
                 True, self.rank,
                 f"server rank {dead} died and cannot fail over "
-                f"(master={dead == self.world.master_server_rank}); "
-                f"aborting",
+                f"(no live buddy); aborting",
             )
             self._do_abort(-3, broadcast=True)
             return
@@ -7185,6 +7322,9 @@ class Server:
             self._m_servers_drained.inc()
         else:
             self._m_server_dead.inc()
+        # master: the retired-route map just changed — the deputy's
+        # brain must carry it (a promoted master seeds joiners from it)
+        self._repl_brain()
         # a retired server can never ack a membership fan-out: release
         # any barrier waiting on it
         for tok in [
@@ -7196,6 +7336,11 @@ class Server:
             if not p["need"]:
                 del self._member_pending[tok]
                 self._member_reply(p)
+        # ... and a dead server can never ack the succession barrier
+        if self._takeover_pending is not None:
+            self._takeover_pending["need"].discard(dead)
+            if not self._takeover_pending["need"]:
+                self._master_takeover_done()
         # master: the retired shard's obs-gossip snapshots must not
         # report stale forever on /healthz (/fleet keeps the topology
         # history; the staleness ledger is for LIVE members)
@@ -7406,6 +7551,9 @@ class Server:
                 return
         mirror.seal()
         t0 = self._server_eof_at.get(dead, time.monotonic())
+        # computed BEFORE any mutation: succession (set_master below)
+        # rewrites what master_server_rank answers
+        was_master = dead == self.world.master_server_rank
         # 1) batch-common prefixes first (units reference them)
         for old_cseq, (buf, refcnt, ngets, credits) in sorted(
             mirror.commons.items()
@@ -7569,6 +7717,12 @@ class Server:
         # adopted ranks' streams may hold phantom slots (reserves parked
         # at the dead server): their next idle note re-arms them
         self._swept_streams |= newly
+        if was_master and not clean:
+            # the dead server was the BRAIN: restore the replicated
+            # control plane, take the master role under a bumped epoch,
+            # and fan the succession before any termination verdict can
+            # conclude (the takeover barrier gates exhaustion/END)
+            self._promote_master(dead, mirror, t0)
         mttr_ms = (time.monotonic() - t0) * 1e3
         if not clean:
             # a drain is not a failover: the promote machinery is shared
@@ -7596,6 +7750,11 @@ class Server:
         # routing (finished apps' listeners may be gone — best-effort,
         # short connect grace)
         note = dict(dead=dead, epoch=self.world.epoch)
+        if was_master and not clean:
+            # clients re-point job control / detach / checkpoint asks at
+            # the promoted deputy (the srv_route reroute alone would
+            # only cover traffic addressed to the DEAD rank)
+            note["new_master"] = self.rank
         for r in self.world.app_ranks:
             if r in self._dead_ranks:
                 continue
@@ -7619,6 +7778,276 @@ class Server:
         self._maybe_complete_finalize()
         if self.cfg.balancer == "tpu":
             self._send_snapshot()
+
+    # -- master succession (deputy side) --------------------------------------
+
+    def _promote_master(self, dead: int, mirror, t0: float) -> None:
+        """The dead server was the MASTER and this buddy is its standing
+        deputy. Restore the replicated brain (durable control plane),
+        take the master role under a bumped fleet epoch, rebuild the
+        reconstructed engines (SLO/controller under a churn hold, so
+        pre-death alerts re-enter without re-firing), restart the
+        balancer, rebind the ops endpoint, and fan the epoch-stamped
+        succession behind an ack barrier (exhaustion/END defer on it)."""
+        now = time.monotonic()
+        brain = getattr(mirror, "brain", None) or {}
+        # 1) durable brain state — applied BEFORE set_master, since the
+        # snapshot still names the dead master (epoch-guarded)
+        self.world.seed(brain.get("member") or {})
+        self._member_next_rank = max(
+            self._member_next_rank, int(brain.get("next_rank", 0) or 0)
+        )
+        for r, a in (brain.get("addrs") or {}).items():
+            r = int(r)
+            self._member_addrs.setdefault(r, tuple(a))
+            if hasattr(self.ep, "addr_map"):
+                self.ep.addr_map.setdefault(r, tuple(a))
+        for s in brain.get("live") or ():
+            if s != self.rank and s not in self._dead_servers:
+                self._member_live.add(int(s))
+        for s in brain.get("ready") or ():
+            if s not in self._dead_servers:
+                self._member_ready.add(int(s))
+        for s in brain.get("drained") or ():
+            self._drained_servers.add(int(s))
+            self._dead_servers.add(int(s))
+            self._clean_retire.add(int(s))
+        for r, b in (brain.get("srv_route") or {}).items():
+            self._srv_route.setdefault(int(r), int(b))
+        self._job_next_id = max(
+            self._job_next_id, int(brain.get("job_next_id", 1) or 1)
+        )
+        weights = dict(getattr(mirror, "job_weights", None) or {})
+        for jid, w in weights.items():
+            self.jobs.apply("update", int(jid), weight=float(w))
+        if weights:
+            self._pending_job_weights = self._effective_job_weights()
+        # 2) succession under a bumped epoch: every in-flight
+        # exhaustion/END token (the dead master's included) now carries
+        # a stale epoch and voids at the first live hop
+        epoch = max(self.world.epoch, int(brain.get("epoch", 0) or 0)) + 1
+        self.world.set_master(self.rank, epoch)
+        self.is_master = True
+        self.flight.context["is_master"] = True
+        self._g_epoch.set(self.world.epoch)
+        # 3) reconstructed engines. The obs plane heals itself: every
+        # server's next SS_OBS_SYNC targets master_server_rank — us —
+        # and gossip snapshots are cumulative, so the merged fleet view
+        # converges within one sync interval.
+        armed = bool(brain.get("ops_armed")) or (
+            self.cfg.ops_port is not None
+        )
+        if armed and self.cfg.obs_sync_interval > 0:
+            if not self._obs_sync_armed:
+                self._obs_sync_armed = True
+                self._next_obs_sync = now + self.cfg.obs_sync_interval
+            slo_docs = list(
+                (getattr(mirror, "slo_docs", None) or {}).values()
+            )
+            if slo_docs or self.cfg.slo or self._slo_engine is not None:
+                from adlb_tpu.obs.slo import SloEngine
+
+                if self._slo_engine is None:
+                    eng = SloEngine(
+                        self.cfg.slo_eval_interval
+                        or self.cfg.obs_sync_interval
+                    )
+                    for doc in self.cfg.slo or ():
+                        try:
+                            eng.add(doc)
+                        except ValueError:
+                            pass
+                    self._slo_engine = eng
+                for doc in slo_docs:
+                    try:
+                        self._slo_engine.add(doc)
+                    except ValueError:
+                        pass  # config duplicate: already installed
+                # churn hold: alert lifecycles re-enter quietly — the
+                # takeover transient must not re-fire a page
+                self._slo_engine.note_epoch(
+                    int(brain.get("epoch", 0) or 0), now
+                )
+                self._slo_engine.note_epoch(self.world.epoch, now)
+        pol = getattr(mirror, "control_policy", None)
+        if self._controller is None and (pol or self.cfg.control):
+            from adlb_tpu.control import Controller
+
+            self._controller = Controller(
+                {
+                    "dry_run": self.cfg.control_dry_run,
+                    "min_servers": self.cfg.control_min_servers,
+                    "max_servers": self.cfg.control_max_servers,
+                    "cooldown_s": self.cfg.control_cooldown_s,
+                    "scaleout_pressure": self.cfg.control_scaleout_pressure,
+                    "scalein_pressure": self.cfg.control_scalein_pressure,
+                },
+                eval_interval=(self.cfg.control_interval
+                               or self.cfg.obs_sync_interval),
+            )
+        if self._controller is not None:
+            if pol:
+                try:
+                    self._controller.update_policy(dict(pol))
+                except ValueError:
+                    pass
+            self._controller.note_epoch(
+                int(brain.get("epoch", 0) or 0), now
+            )
+            self._controller.note_epoch(self.world.epoch, now)
+        if (
+            getattr(mirror, "scale_pending", None) is not None
+            and self._scale_pending is None
+        ):
+            self._scale_pending = dict(mirror.scale_pending)
+        # 4) the balancer brain restarts here, against the snapshot
+        # store the gossip refills (and the _send_snapshot at the end
+        # of _promote primes with our own inventory)
+        if self.cfg.balancer == "tpu" and self._balancer is None:
+            self._balancer = _BalancerWorker(self)
+            self._balancer.start()
+        # 5) ops endpoint rebind: always an EPHEMERAL port — the dead
+        # master's HTTP thread may still hold cfg.ops_port (in-proc
+        # death is a connectivity fault, not a process exit). The new
+        # port travels in the takeover frame and the rendezvous dir.
+        if armed and self.ops is None:
+            from adlb_tpu.obs.ops_server import maybe_start
+
+            self.ops = maybe_start(self, self.cfg, port=0)
+        self._announce_ops_endpoint()
+        # 6) succession fan-out behind an ack barrier
+        self._master_takeover_fan()
+        mttr = (now - t0) * 1e3
+        # lazily minted: only a world that actually promoted a master
+        # carries the row (frame identity for everyone else)
+        self.metrics.gauge("master_failover_mttr_ms").set(mttr)
+        self.flight.record(
+            f"master_takeover dead={dead} epoch={self.world.epoch} "
+            f"mttr_ms={mttr:.1f} slo={len(self._slo_engine.objectives) if self._slo_engine else 0} "
+            f"control={'y' if self._controller else 'n'} "
+            f"ops_port={self.ops.port if self.ops else None}"
+        )
+        aprintf(
+            True, self.rank,
+            f"promoted to master (epoch {self.world.epoch}, "
+            f"mttr {mttr:.1f} ms)",
+        )
+        # 7) this new master's own buddy is the NEXT deputy: ship it the
+        # whole brain so sequential master deaths keep succeeding
+        if self.repl is not None:
+            self._repl_brain()
+            if self._slo_engine is not None:
+                for o in self._slo_engine.objectives:
+                    self.repl.log_slo(dict(o))
+            if self._controller is not None:
+                self.repl.log_control(self._controller.policy_doc())
+            if self._scale_pending is not None:
+                self.repl.log_scale(dict(self._scale_pending))
+            for j in self.jobs.values():
+                if j.weight != 1.0:
+                    self.repl.log_job_weight(j.job_id, j.weight)
+
+    def _announce_ops_endpoint(self) -> None:
+        """Publish the live ops endpoint to Config(ops_announce_dir):
+        the out-of-band rendezvous an HTTP consumer polls across a
+        succession (the old port dies with the old master)."""
+        d = self.cfg.ops_announce_dir
+        if not d or self.ops is None:
+            return
+        try:
+            import json as _json
+            import os as _os
+
+            tmp = _os.path.join(d, ".ops_endpoint.tmp")
+            with open(tmp, "w") as f:
+                _json.dump({
+                    "host": "127.0.0.1",
+                    "port": self.ops.port,
+                    "master": self.rank,
+                    "epoch": self.world.epoch,
+                }, f)
+            _os.replace(tmp, _os.path.join(d, "ops_endpoint.json"))
+        except OSError:
+            pass  # rendezvous is best-effort; the takeover frame is not
+
+    def _master_takeover_fan(self) -> None:
+        """Fan SS_MASTER_TAKEOVER to every live server behind an ack
+        barrier (the membership-barrier shape): until every survivor
+        acks the new epoch, no exhaustion vote starts here and no END
+        ring kicks — the no-raced-verdict guarantee."""
+        self._takeover_tok += 1
+        tok = self._takeover_tok
+        fields = dict(
+            new_master=self.rank, epoch=self.world.epoch,
+            member_tok=tok,
+        )
+        if self.ops is not None:
+            fields["host"], fields["port"] = "127.0.0.1", self.ops.port
+        need = set()
+        for s in self._live_servers():
+            try:
+                self.ep.send(
+                    s, msg(Tag.SS_MASTER_TAKEOVER, self.rank, **fields)
+                )
+                need.add(s)
+            except OSError:
+                self._note_server_unreachable(s)
+        if need:
+            self._takeover_pending = {
+                "need": need, "tok": tok,
+                "deadline": time.monotonic() + 5.0,
+            }
+        else:
+            self._master_takeover_done()
+
+    def _master_takeover_done(self) -> None:
+        self._takeover_pending = None
+        self.activity += 1
+        self._exhaust_held_since = None
+        # re-initiate the termination ring: an END token the dead master
+        # originated died with it (or voids on the bumped epoch); if the
+        # world was terminating, this master re-kicks under the new epoch
+        if (
+            not self.done and (self._ending or self._end1_pending)
+            and self._finalized >= self.local_apps
+        ):
+            self._end1_pending = True
+            self._forward_end1(
+                {"origin": self.rank, "epoch": self.world.epoch}
+            )
+        else:
+            self._maybe_complete_finalize()
+
+    def _on_master_takeover(self, m: Msg) -> None:
+        if m.data.get("mop") == "ack":
+            p = self._takeover_pending
+            if p is None or m.data.get("member_tok") != p["tok"]:
+                return
+            p["need"].discard(m.src)
+            if not p["need"]:
+                self._master_takeover_done()
+            return
+        new_master = int(m.data["new_master"])
+        epoch = int(m.data.get("epoch", 0) or 0)
+        self.world.set_master(new_master, epoch)
+        self._g_epoch.set(self.world.epoch)
+        self.flight.record(
+            f"master_takeover_seen new_master={new_master} "
+            f"epoch={epoch} ops_port={m.data.get('port')}"
+        )
+        # the succession is activity (a held exhaustion vote must not
+        # conclude across it) and voids any stale-epoch token we relay
+        self.activity += 1
+        self._exhaust_held_since = None
+        tok = m.data.get("member_tok")
+        if tok:
+            try:
+                self.ep.send(
+                    m.src, msg(Tag.SS_MASTER_TAKEOVER, self.rank,
+                               mop="ack", member_tok=tok)
+                )
+            except OSError:
+                pass
 
     # -- takeover translation (content-addressed messages) --------------------
 

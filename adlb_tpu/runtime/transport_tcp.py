@@ -930,19 +930,15 @@ def spawn_world(
                     )
                     missing = [r for r in missing if not world.is_app(r)]
                 if cfg.on_server_failure == "failover":
-                    # non-master servers that died without reporting are
-                    # the failover casualties (SIGKILLed mid-run); their
-                    # buddies completed the world around them. The master
-                    # is still fatal.
+                    # servers that died without reporting are the
+                    # failover casualties (SIGKILLed mid-run); their
+                    # buddies completed the world around them — the
+                    # MASTER included: its ring buddy is the standing
+                    # deputy and promotes (see server._promote_master)
                     server_casualties.extend(
-                        r for r in missing
-                        if world.is_server(r) and r != world.master_server_rank
+                        r for r in missing if world.is_server(r)
                     )
-                    missing = [
-                        r for r in missing
-                        if not (world.is_server(r)
-                                and r != world.master_server_rank)
-                    ]
+                    missing = [r for r in missing if not world.is_server(r)]
                 if missing:
                     errors.append(
                         f"rank(s) {missing} died without reporting a result"

@@ -159,8 +159,15 @@ class Config:
     # takes over home-server duty for the dead server's app ranks, and
     # clients learn the epoch-stamped remap via TA_HOME_TAKEOVER.
     # Replication-lag losses are bounded and counted (failover_lost /
-    # InfoKey.FAILOVER_LOST). Master death (and a buddy dying before its
-    # promotion completes — the double failure) still aborts. Requires
+    # InfoKey.FAILOVER_LOST). The MASTER is covered too: its ring buddy
+    # is a standing deputy — the master streams its brain (job table,
+    # membership snapshot + fleet epoch, live SLO objectives, control
+    # policy, parked scale requests, per-job weights) over the same
+    # replication plane, and on the master's death the deputy promotes
+    # under a bumped epoch, fans SS_MASTER_TAKEOVER behind an ack
+    # barrier, rebinds the ops endpoint, and resumes termination duty
+    # with exact unit accounting. A buddy dying before its promotion
+    # completes (the double failure) still aborts. Requires
     # server_impl="python"; inert when nservers == 1.
     on_server_failure: str = "abort"
     # how long a client waits for the buddy's TA_HOME_TAKEOVER after
@@ -389,6 +396,12 @@ class Config:
     # as Server.ops.port). Enable periodic_log_interval for the
     # world-aggregated rows.
     ops_port: Optional[int] = None
+    # ops-endpoint rendezvous directory: when set, the serving master
+    # atomically writes <dir>/ops_endpoint.json ({"host","port","master",
+    # "epoch"}) at startup AND after a master failover rebinds the
+    # endpoint on an ephemeral port — external scrapers re-discover the
+    # promoted deputy's /metrics without parsing logs. None = off.
+    ops_announce_dir: Optional[str] = None
     # restore pool state from checkpoint shards written by ctx.checkpoint()
     # (no reference analogue — SURVEY §5: checkpoint/resume absent there);
     # requires the same world shape the checkpoint was taken with

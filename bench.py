@@ -880,6 +880,104 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — own containment
         failover_rows = {"failover_error": repr(e)[:200]}
 
+    # MASTER-failover recovery cost: the same TCP world but the MASTER
+    # is the one SIGKILLed — the ring buddy is the standing deputy and
+    # promotes under a bumped fleet epoch (ISSUE 20). Records the median
+    # detection->takeover MTTR over 3 worlds (the kill frame halves per
+    # retry until the kill lands inside the run, like the chaos draw),
+    # plus what the standing deputy costs when nothing dies: wall-clock
+    # of an identical in-proc put-storm world with the brain stream on
+    # ("failover") vs off ("abort"), as a ratio. Own containment.
+    def master_failover_bench():
+        import struct
+
+        from adlb_tpu.api import run_world as _rw
+        from adlb_tpu.runtime.transport_tcp import spawn_world as _sw
+        from adlb_tpu.types import ADLB_SUCCESS
+        from adlb_tpu.types import InfoKey as _IK
+
+        n_units = 160
+
+        def app(ctx):
+            if ctx.rank == 0:
+                for i in range(n_units):
+                    ctx.put(struct.pack("<q", i), 1)
+            got = []
+            while True:
+                rc, w = ctx.get_work([1])
+                if rc != ADLB_SUCCESS:
+                    return got
+                got.append(struct.unpack("<q", w.payload)[0])
+                time.sleep(0.002)
+
+        mttrs, lost_total = [], 0
+        for rep in range(3):
+            frame = 80
+            for _attempt in range(3):
+                res = _sw(
+                    6, 2, [1], app,
+                    cfg=Config(on_server_failure="failover",
+                               exhaust_check_interval=0.2,
+                               failover_client_wait=30.0,
+                               fault_spec={"seed": 21 + rep,
+                                           "kill_server_at_frame":
+                                               {0: frame}}),
+                    timeout=240.0,
+                )
+                assert not res.aborted
+                done = [x for v in res.app_results.values() for x in v]
+                lost = sum(s.get(int(_IK.FAILOVER_LOST), 0.0)
+                           for s in res.server_stats.values())
+                missing = len(set(range(n_units)) - set(done))
+                assert missing <= lost, \
+                    f"{missing} units vanished, {lost} counted"
+                if res.server_casualties:
+                    break
+                frame = max(10, frame // 2)
+            assert res.server_casualties, "master outlived every retry"
+            lost_total += int(lost)
+            mttrs.append(max(
+                (s.get(int(_IK.FAILOVER_MTTR_MS), 0.0)
+                 for s in res.server_stats.values()),
+                default=0.0,
+            ))
+
+        def storm_s(policy):
+            def sapp(ctx):
+                if ctx.rank == 0:
+                    for i in range(400):
+                        ctx.put(struct.pack("<q", i), 1)
+                n = 0
+                while True:
+                    rc, _w = ctx.get_work([1])
+                    if rc != ADLB_SUCCESS:
+                        return n
+                    n += 1
+
+            t0 = time.monotonic()
+            _rw(4, 2, [1], sapp,
+                cfg=Config(on_server_failure=policy,
+                           exhaust_check_interval=0.2),
+                timeout=120.0)
+            return time.monotonic() - t0
+
+        on = median_by([storm_s("failover") for _ in range(3)])
+        off = median_by([storm_s("abort") for _ in range(3)])
+        return {
+            "master_failover_mttr_ms": round(median_by(mttrs), 1),
+            "master_failover_mttr_reps_ms": [round(m, 1) for m in mttrs],
+            "master_failover_units_lost": lost_total,
+            "brain_repl_on_s": round(on, 3),
+            "brain_repl_off_s": round(off, 3),
+            "brain_repl_overhead_ratio":
+                round(on / off, 3) if off > 0 else 0.0,
+        }
+
+    try:
+        master_failover_rows = master_failover_bench()
+    except Exception as e:  # noqa: BLE001 — own containment
+        master_failover_rows = {"master_failover_error": repr(e)[:200]}
+
     # gray-failure recovery cost (lease_timeout_s armed): a worker
     # SIGSTOPped mid-trickle while holding an unfetched reservation —
     # hang_mttr_ms is stall-to-redelivery (expiry detection + re-enqueue
@@ -2201,6 +2299,7 @@ def main() -> None:
             "tpu_pop_p50_reps": [
                 round(r.latency_p50_ms, 3) for r in coin_runs["tpu"]],
             **failover_rows,
+            **master_failover_rows,
             **gray_rows,
             **service_rows,
             **shm_rows,
@@ -2327,6 +2426,10 @@ def main() -> None:
             "disp_fast_p50": round(tric_fast.dispatch_p50_ms, 2),
             # pop service latency (coinop), paired-rep medians
             "failover_mttr_ms": failover_rows.get("failover_mttr_ms"),
+            "master_failover_mttr_ms":
+                master_failover_rows.get("master_failover_mttr_ms"),
+            "brain_repl_overhead_ratio":
+                master_failover_rows.get("brain_repl_overhead_ratio"),
             "hang_mttr_ms": gray_rows.get("hang_mttr_ms"),
             "storm_backoffs": gray_rows.get("put_storm_backoffs"),
             "restart_replay_ms": service_rows.get("restart_replay_ms"),

@@ -146,6 +146,17 @@ GUARDS = {
     "fairness": [
         ("weighted", "fairness_weighted_p99_ms"),
     ],
+    # master failover (r20 metrics; older baselines skip with a note,
+    # the r08 policy): the ring-deputy's detection->takeover MTTR with
+    # the MASTER SIGKILLed mid-run (median over 3 TCP worlds), and the
+    # standing deputy's quiet-time cost — put-storm wall-clock with the
+    # brain stream on over the identical world with it off. The ratio
+    # cell is unitless; a regression there means the always-on brain
+    # replication started taxing the hot path while nothing was dying.
+    "master_failover": [
+        ("mttr", "master_failover_mttr_ms"),
+        ("brain-ratio", "brain_repl_overhead_ratio"),
+    ],
     # fleet controller (r13 metric; older baselines skip with a note):
     # closed-loop scale-out reaction — pressure step to the
     # controller-spawned shard live in the membership table. Once a

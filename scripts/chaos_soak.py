@@ -687,6 +687,175 @@ def hedge_world(rng, apps, mode, policy, fabric=None):
                 policy=policy, stall_s=stall_s, n_units=n_units)
 
 
+def master_kill_world(rng, seed, apps, servers, mode, policy, draw,
+                      fabric=None):
+    """Master-kill adversity (ISSUE 20): the MASTER dies mid-run under
+    ``on_server_failure="failover"`` — SIGKILL on the spawn plane, a
+    fault-injected disconnect on the in-proc ``mid_attach`` draw. The
+    ring-buddy deputy must promote and the world must complete with
+    exact id coverage modulo the counted replication-lag losses
+    (``failover_lost``); a promotion additionally mints the
+    ``master_failover_mttr_ms`` row. Draws vary WHEN the brain dies:
+
+    * ``idle``          — late frame: the fleet is mostly drained
+    * ``mid_plan``      — ``balancer="tpu"``: the brain dies while the
+                          planner owns dispatch
+    * ``mid_attach``    — in-proc: a rank attaches across the
+                          succession; the joiner must land at the
+                          promoted deputy, never the corpse
+    * ``alerts_firing`` — an SLO objective is live (and likely FIRING)
+                          when the master dies; the deputy rebuilds the
+                          engine under a churn hold and re-announces
+                          the rebound ops endpoint via the rendezvous
+                          file
+    """
+    n_units = rng.randint(24, 60)
+    # mid_attach pins steal: the in-proc disconnect is FRAME-based and
+    # fires only when the master's outbound counter reaches it — the
+    # periodic steal-mode qmstat tick walks it deterministically even
+    # while the consumers idle, whereas tpu mode event-gates the
+    # broadcast and an idle planner can stall below the kill frame
+    # forever (planner-owned succession is mid_plan's job)
+    kw = dict(
+        balancer="tpu" if draw == "mid_plan"
+        else ("steal" if draw == "mid_attach" else mode),
+        exhaust_check_interval=0.2,
+        on_worker_failure=policy,
+        on_server_failure="failover",
+        failover_client_wait=30.0,
+    )
+    desc = dict(workload="master_kill", draw=draw, apps=apps,
+                servers=servers, mode=kw["balancer"], policy=policy)
+    master_rank = apps  # server index 0
+
+    if draw == "mid_attach":
+        from adlb_tpu.runtime.membership import ElasticWorld
+
+        kw["fault_spec"] = {
+            "seed": seed,
+            "disconnect_server_at": {0: rng.randint(30, 90)},
+        }
+        cfg = Config(**kw)
+        ew = ElasticWorld(apps, servers, [1], cfg=cfg)
+        stormed = threading.Event()  # every put acked
+        hold = threading.Event()     # succession done; drain the pool
+
+        def consume(ctx):
+            hold.wait(90)
+            got = []
+            while True:
+                rc, w = ctx.get_work([1])
+                if rc != ADLB_SUCCESS:
+                    return got
+                got.append(struct.unpack("<q", w.payload)[0])
+
+        def producer(ctx):
+            for i in range(n_units):
+                assert ctx.put(struct.pack("<q", i), 1) == ADLB_SUCCESS
+            stormed.set()
+            return consume(ctx)
+
+        ew.run_app(0, producer)
+        for r in range(1, apps):
+            ew.run_app(r, consume)
+        assert stormed.wait(60), "put storm never finished"
+        # the master's gossip/reactor traffic walks its frame count to
+        # the injected disconnect; wait for the succession, then attach
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            m = ew.current_master
+            if m.rank != master_rank and m.is_master:
+                break
+            time.sleep(0.02)
+        promoted = ew.current_master
+        assert promoted.rank != master_rank and promoted.is_master, \
+            "deputy never promoted"
+        # the attach dials the CURRENT master (MemberView-aware): a
+        # joiner landing at the corpse would time the rpc out
+        joiner = ew.attach_app(consume)
+        assert joiner.rank != master_rank
+        hold.set()
+        results = ew.finish(timeout=120)
+        got = sorted(x for v in results.values() if v for x in v)
+        lost = sum(
+            s.metrics.value("failover_lost")
+            for r, s in ew.servers.items() if r != master_rank
+        )
+        missing = set(range(n_units)) - set(got)
+        assert len(missing) <= lost, (sorted(missing), lost)
+        assert promoted.metrics.value("master_failover_mttr_ms") > 0.0
+        # the promoted brain's snapshot names the succession
+        snap = promoted.world.snapshot()
+        assert snap.get("master") == promoted.rank, snap
+        desc["promoted"] = promoted.rank
+        return desc
+
+    # spawn-plane draws: a real SIGKILL of the master process
+    frame = {
+        "idle": rng.randint(100, 180),
+        "mid_plan": rng.randint(30, 90),
+        "alerts_firing": rng.randint(80, 150),
+    }[draw]
+    if fabric:
+        kw["fabric"] = fabric
+    announce_dir = None
+    if draw == "alerts_firing":
+        # every close breaches the 0.01 ms p99 -> the alert is live
+        # (likely FIRING) when the master dies; warn severity keeps the
+        # incident capture out of the oracle's way
+        kw["ops_port"] = probe_free_ports(1)[0]
+        kw["obs_sync_interval"] = 0.25
+        kw["slo"] = ({
+            "name": f"mkill-{seed}", "job": 0, "type": 1,
+            "p99_ms": 0.01, "window_s": 60.0, "fast_s": 1.0,
+            "for_s": 0.2, "cooldown_s": 5.0, "min_count": 1,
+            "severity": "warn",
+        },)
+        announce_dir = __import__("tempfile").mkdtemp(prefix="adlb-ann-")
+        kw["ops_announce_dir"] = announce_dir
+    # the kill frame is drawn against an unknown world length: if the
+    # world exhausts before the master's outbound frame counter reaches
+    # it, the draw proved nothing — retry earlier until the kill LANDS
+    # (a frame inside the put storm always exists)
+    for _attempt in range(3):
+        kw["fault_spec"] = {"seed": seed,
+                            "kill_server_at_frame": {0: frame}}
+        cfg = Config(**kw)
+        res = spawn_world(apps, servers, [1, 2], coverage_pool(n_units),
+                          cfg=cfg, timeout=150.0)
+        assert not res.aborted
+        done = [x for v in res.app_results.values() for x in v]
+        lost = sum(s.get(int(InfoKey.FAILOVER_LOST), 0.0)
+                   for s in res.server_stats.values())
+        missing = set(range(n_units)) - set(done)
+        assert len(missing) <= lost, (sorted(missing), lost)
+        desc["killed"] = master_rank in res.server_casualties
+        if desc["killed"]:
+            break
+        frame = max(10, frame // 2)
+    desc["kill_frame"] = frame
+    if desc["killed"]:
+        # the master actually died mid-run: a promotion must have been
+        # counted and timed somewhere in the surviving fleet
+        promoted = sum(s.get(int(InfoKey.NUM_FAILOVERS), 0.0)
+                       for s in res.server_stats.values())
+        assert promoted >= 1, "master died but nobody promoted"
+        mttr = max(s.get(int(InfoKey.FAILOVER_MTTR_MS), 0.0)
+                   for s in res.server_stats.values())
+        assert mttr > 0.0, "promotion did not record an MTTR"
+        if announce_dir is not None:
+            # the rendezvous file was atomically re-written by the
+            # promoted deputy: it must name a SURVIVING master
+            import json as _json
+
+            p = os.path.join(announce_dir, "ops_endpoint.json")
+            assert os.path.exists(p), "no ops rendezvous written"
+            with open(p) as fh:
+                doc = _json.load(fh)
+            assert doc["master"] != master_rank, doc
+    return desc
+
+
 def one_iter(seed, fabric=None):
     rng = random.Random(seed)
     apps = rng.randint(3, 7)
@@ -712,6 +881,18 @@ def one_iter(seed, fabric=None):
         and servers >= 2 and rng.random() < 0.3
     )
     s_policy = rng.choice(["abort", "failover"]) if do_skill else "abort"
+    # master-kill adversity (ISSUE 20): the MASTER dies mid-run under
+    # "failover" — the standing deputy must promote and the world must
+    # complete with exact id coverage; the draw varies when the brain
+    # dies (idle / mid-plan / mid-attach / alerts-firing), under both
+    # worker policies
+    do_mkill = (
+        workload == "economy" and not do_abort and not do_kill
+        and not do_skill and servers >= 2 and rng.random() < 0.3
+    )
+    mkill_draw = rng.choice(
+        ["idle", "mid_plan", "mid_attach", "alerts_firing"]
+    ) if do_mkill else None
     # gray adversities (lease_timeout_s armed): a worker SIGSTOPped
     # mid-lease (expiry + fencing must redeliver its unit and reject its
     # post-SIGCONT fetch), or a poison-typed unit that kills every
@@ -720,11 +901,12 @@ def one_iter(seed, fabric=None):
     # policies; python servers only (the daemon has no lease table)
     do_stall = (
         workload == "economy" and not do_abort and not do_kill
-        and not do_skill and apps >= 3 and rng.random() < 0.35
+        and not do_skill and not do_mkill and apps >= 3
+        and rng.random() < 0.35
     )
     do_poison = (
         workload == "economy" and not do_abort and not do_kill
-        and not do_skill and not do_stall and apps >= 5
+        and not do_skill and not do_mkill and not do_stall and apps >= 5
         and rng.random() < 0.35
     )
     # service-mode adversity: two jobs multiplexed over one fleet, a
@@ -735,7 +917,7 @@ def one_iter(seed, fabric=None):
     # quarantine even though only job A's half-pool ever touches it)
     do_two_jobs = (
         workload == "economy" and not do_abort and not do_kill
-        and not do_skill and not do_stall and not do_poison
+        and not do_skill and not do_mkill and not do_stall and not do_poison
         and apps >= 5 and rng.random() < 0.4
     )
     # tail-hedging adversity (ISSUE 17): a straggler frozen strictly
@@ -743,7 +925,7 @@ def one_iter(seed, fabric=None):
     # it early; zero double-count asserted under both worker policies
     do_hedge = (
         workload == "economy" and not do_abort and not do_kill
-        and not do_skill and not do_stall and not do_poison
+        and not do_skill and not do_mkill and not do_stall and not do_poison
         and not do_two_jobs and apps >= 3 and rng.random() < 0.3
     )
     # elastic-membership churn (ISSUE 15): ranks joining/leaving
@@ -751,9 +933,15 @@ def one_iter(seed, fabric=None):
     # policies; python servers only (the daemon keeps the fixed world)
     do_churn = (
         workload == "economy" and not do_abort and not do_kill
-        and not do_skill and not do_stall and not do_poison
+        and not do_skill and not do_mkill and not do_stall and not do_poison
         and not do_two_jobs and not do_hedge and rng.random() < 0.35
     )
+    if do_mkill:
+        return master_kill_world(
+            rng, seed, apps, servers, mode,
+            policy=rng.choice(["abort", "reclaim"]),
+            draw=mkill_draw, fabric=fabric,
+        )
     if do_hedge:
         return hedge_world(
             rng, apps, mode,

@@ -345,10 +345,18 @@ def test_double_failure_aborts_cleanly():
     assert aborts, "double failure did not broadcast an abort"
 
 
-def test_master_death_aborts_under_failover():
+def test_master_death_promotes_the_deputy():
+    """The master's ring buddy is its standing deputy: a brain-carrying
+    replication stream makes the master's death one more failover, not
+    an abort (the full succession matrix lives in
+    tests/test_master_failover.py)."""
     srv, fabric = _mini(3)
+    log = replica.ReplicationLog(buddy=3)
+    log.log_member({"master": 2, "epoch": 0, "member": {}})
+    srv._handle(msg(Tag.SS_REPL, 2, blob=log.take(), seq=1))
     srv._handle(Msg(tag=Tag.PEER_EOF, src=2))  # master's EOF
-    assert srv._aborted and srv.done
+    assert not srv._aborted
+    assert srv.is_master and srv.world.master_server_rank == 3
 
 
 def test_server_death_under_abort_policy_unchanged():

@@ -15,6 +15,7 @@ Three layers of coverage:
 
 import struct
 import threading
+import time
 
 import pytest
 
@@ -329,7 +330,7 @@ def test_home_forwards_fused_and_confirms():
     home, ep = _mk_server(rank=2)
     home.rq.add(RqEntry(world_rank=0, rqseqno=9, req_types=frozenset([T]),
                         fetch=True))
-    home._rfr_out.add(0)
+    home._rfr_out[0] = time.monotonic()
     home._handle(msg(Tag.SS_RFR_RESP, 3, found=True, for_rank=0, rqseqno=9,
                      seqno=77, work_type=T, prio=0, target_rank=-1,
                      work_len=5, answer_rank=-1, common_len=0,
